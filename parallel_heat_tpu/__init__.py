@@ -1,0 +1,34 @@
+"""parallel_heat_tpu — a TPU-native heat-diffusion simulation framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``manospits/parallel_heat`` (MPI C + CUDA, see ``/root/reference``):
+
+- 2D 5-point (and 3D 7-point) Jacobi heat stencils, double-buffered,
+  Dirichlet boundary (reference: ``cuda/cuda_heat.cu:57-65``,
+  ``mpi/mpi_heat_improved_persistent_stat.c:166-176``).
+- Fixed-step and epsilon-convergence modes (``cuda/cuda_heat.cu:219-236``).
+- 2D spatial domain decomposition with halo exchange over a TPU ICI mesh
+  (``shard_map`` + ``lax.ppermute`` — replacing the reference's persistent
+  MPI sends, ``mpi/...stat.c:130-161``).
+- Compute/communication overlap via an interior/edge split
+  (``mpi/...stat.c:162-234``).
+- On-device fused convergence reduction (``lax.pmax`` — replacing the
+  CUDA shared-memory flag trees + host polling, ``cuda/cuda_heat.cu:66-137``).
+- Pallas VMEM stencil kernels for the hot loop.
+- Golden-file compatible ``.dat`` I/O (``mpi/...stat.c:326-341``).
+"""
+
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.solver import HeatResult, solve
+from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "HeatConfig",
+    "HeatResult",
+    "solve",
+    "HeatPlate2D",
+    "HeatPlate3D",
+    "__version__",
+]

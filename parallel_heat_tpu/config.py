@@ -1,0 +1,146 @@
+"""Runtime configuration for the heat solver.
+
+The reference compiles one binary per configuration via ``-D`` macros
+(``NXPROB``, ``NYPROB``, ``STEPS``, ``STEP``, ``CONVERGE`` — see
+``mpi/Makefile:1-25`` and ``mpi/mpi_heat_improved_persistent_stat.c:7-21``).
+Here the same knobs are a runtime dataclass; one program serves every
+configuration, and everything downstream of it is traced/compiled by XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+_VALID_DTYPES = ("float32", "bfloat16", "float64")
+_VALID_BACKENDS = ("auto", "jnp", "pallas")
+
+
+@dataclass(frozen=True)
+class HeatConfig:
+    """Full runtime configuration of one simulation.
+
+    Defaults mirror the reference's in-source macro defaults
+    (``NXPROB=NYPROB=20``, ``STEPS``, ``STEP``/``CHECK_INTERVAL=20``,
+    ``cx=cy=0.1`` — ``mpi/...stat.c:7-32``, ``cuda/cuda_heat.cu:7-23``).
+    """
+
+    # Grid extent (number of cells including the fixed Dirichlet boundary).
+    nx: int = 20
+    ny: int = 20
+    nz: Optional[int] = None  # set for the 3D 7-point extension
+
+    # Diffusion coefficients (Parms struct, mpi/...stat.c:29-32).
+    cx: float = 0.1
+    cy: float = 0.1
+    cz: float = 0.1
+
+    # Stepping. `steps` is the exact iteration count in fixed mode and the
+    # upper bound in converge mode (CUDA semantics: `i < STEPS`,
+    # cuda/cuda_heat.cu:204 — the reference MPI off-by-one `it <= STEPS`
+    # is deliberately NOT replicated).
+    steps: int = 100
+    converge: bool = False
+    eps: float = 1e-3
+    check_interval: int = 20  # CHECK_INTERVAL, cuda/cuda_heat.cu:16
+
+    # Numerics: storage dtype. Stencil arithmetic always accumulates in
+    # float32 (the reference's own C/CUDA variants disagree about promotion,
+    # SURVEY.md §2d.7 — we define pure-f32 accumulation as the semantics).
+    dtype: str = "float32"
+
+    # Compute backend for the per-shard stencil: "jnp" (XLA-fused slicing),
+    # "pallas" (hand-written TPU kernel), or "auto" (pallas on TPU, jnp
+    # elsewhere).
+    backend: str = "auto"
+
+    # Device mesh (dx, dy[, dz]) for spatial domain decomposition, or None
+    # for single-device execution. The analog of MPI_Dims_create
+    # (mpi/...stat.c:52).
+    mesh_shape: Optional[Tuple[int, ...]] = None
+
+    # Preserve the reference's interior/edge split so XLA can overlap the
+    # halo ppermutes with interior compute (mpi/...stat.c:162-234).
+    overlap: bool = True
+
+    # --- derived helpers -------------------------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return 3 if self.nz is not None else 2
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        if self.ndim == 3:
+            return (self.nx, self.ny, self.nz)
+        return (self.nx, self.ny)
+
+    @property
+    def coefficients(self) -> Tuple[float, ...]:
+        if self.ndim == 3:
+            return (self.cx, self.cy, self.cz)
+        return (self.cx, self.cy)
+
+    def mesh_or_unit(self) -> Tuple[int, ...]:
+        """The mesh shape, defaulting to the all-ones (single device) mesh."""
+        if self.mesh_shape is None:
+            return (1,) * self.ndim
+        return tuple(self.mesh_shape)
+
+    def block_shape(self) -> Tuple[int, ...]:
+        """Per-device block extent under the mesh decomposition."""
+        return tuple(n // d for n, d in zip(self.shape, self.mesh_or_unit()))
+
+    def validate(self) -> "HeatConfig":
+        if self.nx < 3 or self.ny < 3 or (self.nz is not None and self.nz < 3):
+            raise ValueError(
+                f"grid must be at least 3 cells per axis, got {self.shape}"
+            )
+        if self.steps < 0:
+            raise ValueError(f"steps must be >= 0, got {self.steps}")
+        if self.converge and self.check_interval < 1:
+            raise ValueError(
+                f"check_interval must be >= 1, got {self.check_interval}"
+            )
+        if self.converge and self.eps <= 0.0:
+            raise ValueError(f"eps must be > 0, got {self.eps}")
+        if self.dtype not in _VALID_DTYPES:
+            raise ValueError(
+                f"dtype must be one of {_VALID_DTYPES}, got {self.dtype!r}"
+            )
+        if self.backend not in _VALID_BACKENDS:
+            raise ValueError(
+                f"backend must be one of {_VALID_BACKENDS}, got {self.backend!r}"
+            )
+        mesh = self.mesh_or_unit()
+        if len(mesh) != self.ndim:
+            raise ValueError(
+                f"mesh_shape {mesh} rank does not match grid rank {self.ndim}"
+            )
+        if any(d < 1 for d in mesh):
+            raise ValueError(f"mesh_shape entries must be >= 1, got {mesh}")
+        for n, d, name in zip(self.shape, mesh, "xyz"):
+            if n % d != 0:
+                # The reference silently assumes divisibility
+                # (mpi/...stat.c:72-73, SURVEY.md §2d.6); we make it loud.
+                raise ValueError(
+                    f"grid n{name}={n} is not divisible by mesh d{name}={d}"
+                )
+        return self
+
+    # --- (de)serialization ----------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "HeatConfig":
+        d = json.loads(s)
+        if d.get("mesh_shape") is not None:
+            d["mesh_shape"] = tuple(d["mesh_shape"])
+        return cls(**d).validate()
+
+    def replace(self, **kw) -> "HeatConfig":
+        return dataclasses.replace(self, **kw)

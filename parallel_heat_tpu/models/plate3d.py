@@ -1,0 +1,71 @@
+"""The 3D heated-volume model — 7-point stencil extension.
+
+The reference is strictly 2D; this is the planned 3D extension from the
+build plan (BASELINE.json config 5: 512^3, 7-point). The initial condition
+generalizes the reference's separable polynomial (``inidat``,
+``mpi/...stat.c:315-321``) to three axes, again vanishing on the boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class HeatPlate3D:
+    """3D volume with separable polynomial initial condition."""
+
+    ndim = 3
+
+    def __init__(self, nx: int, ny: int, nz: int,
+                 cx: float = 0.1, cy: float = 0.1, cz: float = 0.1):
+        self.nx = int(nx)
+        self.ny = int(ny)
+        self.nz = int(nz)
+        self.cx = float(cx)
+        self.cy = float(cy)
+        self.cz = float(cz)
+
+    @property
+    def shape(self) -> Tuple[int, int, int]:
+        return (self.nx, self.ny, self.nz)
+
+    @property
+    def coefficients(self) -> Tuple[float, float, float]:
+        return (self.cx, self.cy, self.cz)
+
+    def init_grid_np(self, dtype=np.float32) -> np.ndarray:
+        nx, ny, nz = self.shape
+        ix = np.arange(nx, dtype=np.float64)[:, None, None]
+        iy = np.arange(ny, dtype=np.float64)[None, :, None]
+        iz = np.arange(nz, dtype=np.float64)[None, None, :]
+        u = ix * (nx - ix - 1) * iy * (ny - iy - 1) * iz * (nz - iz - 1)
+        return u.astype(dtype)
+
+    def init_grid(self, dtype=jnp.float32) -> jnp.ndarray:
+        """Outer product of exact per-axis f32 factors (two roundings —
+        may differ from the float64 oracle by ~1 ulp; see plate2d)."""
+        nx, ny, nz = self.shape
+        fx = jnp.arange(nx, dtype=jnp.float32)
+        fy = jnp.arange(ny, dtype=jnp.float32)
+        fz = jnp.arange(nz, dtype=jnp.float32)
+        fx = fx * (nx - fx - 1)
+        fy = fy * (ny - fy - 1)
+        fz = fz * (nz - fz - 1)
+        u = fx[:, None, None] * fy[None, :, None] * fz[None, None, :]
+        return u.astype(dtype)
+
+    def init_block(self, block_shape, block_index, dtype=jnp.float32):
+        bx, by, bz = block_shape
+        g0 = [bi * bs for bi, bs in zip(block_index, block_shape)]
+        nx, ny, nz = self.shape
+        fx = g0[0] + jnp.arange(bx, dtype=jnp.float32)
+        fy = g0[1] + jnp.arange(by, dtype=jnp.float32)
+        fz = g0[2] + jnp.arange(bz, dtype=jnp.float32)
+        fx = fx * (nx - fx - 1)
+        fy = fy * (ny - fy - 1)
+        fz = fz * (nz - fz - 1)
+        u = fx[:, None, None] * fy[None, :, None] * fz[None, None, :]
+        return u.astype(dtype)

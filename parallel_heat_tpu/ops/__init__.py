@@ -1,0 +1,17 @@
+from parallel_heat_tpu.ops.stencil import (
+    step_2d,
+    step_2d_residual,
+    step_3d,
+    step_3d_residual,
+    stencil_interior_2d,
+    stencil_interior_3d,
+)
+
+__all__ = [
+    "step_2d",
+    "step_2d_residual",
+    "step_3d",
+    "step_3d_residual",
+    "stencil_interior_2d",
+    "stencil_interior_3d",
+]

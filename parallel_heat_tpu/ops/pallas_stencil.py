@@ -1,0 +1,29 @@
+"""Pallas TPU stencil kernels (stage 4 — currently delegating to jnp).
+
+This module will hold the hand-written VMEM stencil kernels (the analog
+of the CUDA ``heat`` kernels, ``cuda/cuda_heat.cu:43-163``). Until they
+land, both entry points return the XLA-fused jnp implementations so the
+``backend="pallas"`` path is functional everywhere.
+"""
+
+from __future__ import annotations
+
+from parallel_heat_tpu.ops.stencil import step_2d, step_2d_residual
+from parallel_heat_tpu.parallel import halo as _halo
+
+
+def single_grid_steps(config):
+    """(step, step_residual) on a full single-device 2D grid."""
+    cx, cy = config.cx, config.cy
+    return (
+        lambda u: step_2d(u, cx, cy),
+        lambda u: step_2d_residual(u, cx, cy),
+    )
+
+
+def block_steps(config, kw):
+    """(step, step_residual) on a shard block inside ``shard_map``."""
+    return (
+        lambda u: _halo.block_step_2d(u, **kw),
+        lambda u: _halo.block_step_2d_residual(u, **kw),
+    )

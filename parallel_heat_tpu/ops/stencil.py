@@ -1,0 +1,88 @@
+"""Jacobi stencil update ops — the pure-JAX (XLA-fused) compute path.
+
+The update rule (identical across reference variants,
+``cuda/cuda_heat.cu:57-65``, ``mpi/...stat.c:166-176``):
+
+    u'[i,j] = u[i,j] + cx*(u[i+1,j] + u[i-1,j] - 2*u[i,j])
+                     + cy*(u[i,j+1] + u[i,j-1] - 2*u[i,j])
+
+applied to interior cells only; boundary cells are Dirichlet (never
+written — ``cuda/cuda_heat.cu:57`` guards ``1 <= i < n-1``).
+
+All arithmetic accumulates in float32 regardless of storage dtype (the
+semantics fix for the reference's double-vs-float drift, SURVEY.md §2d.7).
+Everything here is shape-polymorphic pure functions: XLA fuses the shifted
+reads into a single HBM pass, which on TPU makes this path bandwidth-bound
+— the Pallas kernels in ``pallas_stencil.py`` exist to beat that bound via
+temporal blocking, not to reproduce it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_ACC = jnp.float32
+
+
+def stencil_interior_2d(u, cx: float, cy: float):
+    """5-point update of every *expressible* cell of ``u``.
+
+    Input ``(m, n)`` -> output ``(m-2, n-2)``: the update value for each
+    cell that has all four neighbors inside ``u``. Used both on full grids
+    (interior = non-boundary) and on halo-padded shard blocks (interior =
+    the whole block).
+    """
+    u = u.astype(_ACC)
+    c = u[1:-1, 1:-1]
+    return (
+        c
+        + cx * (u[2:, 1:-1] + u[:-2, 1:-1] - 2.0 * c)
+        + cy * (u[1:-1, 2:] + u[1:-1, :-2] - 2.0 * c)
+    )
+
+
+def stencil_interior_3d(u, cx: float, cy: float, cz: float):
+    """7-point update; input ``(m, n, p)`` -> output ``(m-2, n-2, p-2)``."""
+    u = u.astype(_ACC)
+    c = u[1:-1, 1:-1, 1:-1]
+    return (
+        c
+        + cx * (u[2:, 1:-1, 1:-1] + u[:-2, 1:-1, 1:-1] - 2.0 * c)
+        + cy * (u[1:-1, 2:, 1:-1] + u[1:-1, :-2, 1:-1] - 2.0 * c)
+        + cz * (u[1:-1, 1:-1, 2:] + u[1:-1, 1:-1, :-2] - 2.0 * c)
+    )
+
+
+def step_2d(u, cx: float, cy: float):
+    """One full-grid step: interior updated, boundary carried over."""
+    new_interior = stencil_interior_2d(u, cx, cy).astype(u.dtype)
+    return u.at[1:-1, 1:-1].set(new_interior)
+
+
+def step_2d_residual(u, cx: float, cy: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One step plus the max-norm residual ``max |u' - u|``.
+
+    The residual is the convergence quantity: the reference checks
+    ``|old - new| < 1e-3`` per cell (``cuda/cuda_heat.cu:67``,
+    ``mpi/...stat.c:245``); a single fused max-norm replaces its
+    flag-vote reductions. Residual is computed in f32 over interior
+    cells (boundary cells never change).
+    """
+    old_interior = u[1:-1, 1:-1].astype(_ACC)
+    new_interior = stencil_interior_2d(u, cx, cy)
+    residual = jnp.max(jnp.abs(new_interior - old_interior))
+    return u.at[1:-1, 1:-1].set(new_interior.astype(u.dtype)), residual
+
+
+def step_3d(u, cx: float, cy: float, cz: float):
+    new_interior = stencil_interior_3d(u, cx, cy, cz).astype(u.dtype)
+    return u.at[1:-1, 1:-1, 1:-1].set(new_interior)
+
+
+def step_3d_residual(u, cx: float, cy: float, cz: float):
+    old_interior = u[1:-1, 1:-1, 1:-1].astype(_ACC)
+    new_interior = stencil_interior_3d(u, cx, cy, cz)
+    residual = jnp.max(jnp.abs(new_interior - old_interior))
+    return u.at[1:-1, 1:-1, 1:-1].set(new_interior.astype(u.dtype)), residual

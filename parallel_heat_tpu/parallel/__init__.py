@@ -1,0 +1,16 @@
+from parallel_heat_tpu.parallel.mesh import make_heat_mesh, pick_mesh_shape
+from parallel_heat_tpu.parallel.halo import (
+    exchange_halos_2d,
+    block_step_2d,
+    block_step_2d_residual,
+    interior_mask_2d,
+)
+
+__all__ = [
+    "make_heat_mesh",
+    "pick_mesh_shape",
+    "exchange_halos_2d",
+    "block_step_2d",
+    "block_step_2d_residual",
+    "interior_mask_2d",
+]

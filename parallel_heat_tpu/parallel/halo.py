@@ -1,0 +1,205 @@
+"""Halo exchange and per-block stencil steps inside ``shard_map``.
+
+TPU-native redesign of the reference's communication layer:
+
+- The 16 persistent MPI requests (2 buffers x 4 directions x send/recv,
+  ``mpi/mpi_heat_improved_persistent_stat.c:130-155``) become four
+  ``lax.ppermute`` shifts with statically-built permutation tables. Under
+  ``jit`` these compile to XLA collective-permutes riding the ICI mesh —
+  as "persistent" as it gets.
+- Non-periodic edges: devices with no neighbor receive zeros from
+  ``ppermute`` (the analog of ``MPI_PROC_NULL``, reference report §2(f)).
+  Those halo values are never *used*: global-boundary cells are masked
+  back to their Dirichlet values.
+- The reference's compute/communication overlap — update the interior
+  while halos are in flight, then the edges (``mpi/...stat.c:160-234``) —
+  is preserved structurally: the interior update reads only local data,
+  so XLA's latency-hiding scheduler can overlap it with the permutes.
+- The convergence vote ``MPI_Allreduce(MPI_LAND)`` (``mpi/...stat.c:255``)
+  becomes a single ``lax.pmax`` of the per-block residual max-norm.
+
+Everything here runs *inside* ``shard_map``: arrays are per-device blocks,
+and ``axis_index`` provides the block coordinates (the analog of
+``MPI_Cart_coords``, ``mpi/...stat.c:63``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_heat_tpu.ops.stencil import stencil_interior_2d
+
+_ACC = jnp.float32
+
+
+# --------------------------------------------------------------------------
+# ppermute shifts
+# --------------------------------------------------------------------------
+
+def _shift_down(x, axis_name: str, axis_size: int):
+    """Each device receives ``x`` from its lower-index neighbor (i-1 -> i).
+
+    Devices at index 0 receive zeros (no neighbor — non-periodic domain,
+    ``period={0,0}`` in ``mpi/...stat.c:56``).
+    """
+    if axis_size == 1:
+        return jnp.zeros_like(x)
+    perm = [(i, i + 1) for i in range(axis_size - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def _shift_up(x, axis_name: str, axis_size: int):
+    """Each device receives ``x`` from its higher-index neighbor (i+1 -> i)."""
+    if axis_size == 1:
+        return jnp.zeros_like(x)
+    perm = [(i + 1, i) for i in range(axis_size - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def exchange_halos_2d(u, mesh_shape: Tuple[int, int],
+                      axis_names: Tuple[str, str] = ("x", "y")):
+    """Exchange the four 1-cell-wide halos of a ``(bx, by)`` block.
+
+    Returns ``(halo_n, halo_s, halo_w, halo_e)`` with shapes
+    ``(1, by), (1, by), (bx, 1), (bx, 1)`` — the rows/columns owned by the
+    north/south/west/east neighbors adjacent to this block. Corners are
+    not exchanged (the 5-point stencil never reads them).
+    """
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    # North neighbor (x-1) sends its last row; south (x+1) its first row.
+    halo_n = _shift_down(u[-1:, :], ax, dx)
+    halo_s = _shift_up(u[:1, :], ax, dx)
+    # West neighbor (y-1) sends its last column; east (y+1) its first.
+    halo_w = _shift_down(u[:, -1:], ay, dy)
+    halo_e = _shift_up(u[:, :1], ay, dy)
+    return halo_n, halo_s, halo_w, halo_e
+
+
+# --------------------------------------------------------------------------
+# Global-boundary masking
+# --------------------------------------------------------------------------
+
+def interior_mask_2d(block_shape: Tuple[int, int],
+                     grid_shape: Tuple[int, int],
+                     block_index) -> jnp.ndarray:
+    """Boolean ``(bx, by)`` mask: True where the cell is global-interior.
+
+    Global-boundary cells are Dirichlet — the stencil must not write them
+    (the reference guards them with index tests, ``cuda/cuda_heat.cu:57``,
+    ``mpi/...stat.c:187``).
+    """
+    bx, by = block_shape
+    nx, ny = grid_shape
+    bi, bj = block_index
+    row = bi * bx + jnp.arange(bx, dtype=jnp.int32)
+    col = bj * by + jnp.arange(by, dtype=jnp.int32)
+    rmask = (row >= 1) & (row <= nx - 2)
+    cmask = (col >= 1) & (col <= ny - 2)
+    return rmask[:, None] & cmask[None, :]
+
+
+# --------------------------------------------------------------------------
+# Per-block stencil step
+# --------------------------------------------------------------------------
+
+def _pad_block(u, halos):
+    """Assemble the ``(bx+2, by+2)`` halo-padded block (zero corners)."""
+    halo_n, halo_s, halo_w, halo_e = halos
+    z = jnp.zeros((1, 1), dtype=u.dtype)
+    rows = jnp.concatenate([halo_n.astype(u.dtype), u,
+                            halo_s.astype(u.dtype)], axis=0)
+    wcol = jnp.concatenate([z, halo_w.astype(u.dtype), z], axis=0)
+    ecol = jnp.concatenate([z, halo_e.astype(u.dtype), z], axis=0)
+    return jnp.concatenate([wcol, rows, ecol], axis=1)
+
+
+def _row_update(center, up, down, lw, re, cx, cy):
+    """Stencil update of one row; lw/re are the out-of-block end neighbors."""
+    center = center.astype(_ACC)
+    up = up.astype(_ACC)
+    down = down.astype(_ACC)
+    left = jnp.concatenate([lw.astype(_ACC).reshape(1), center[:-1]])
+    right = jnp.concatenate([center[1:], re.astype(_ACC).reshape(1)])
+    return (center + cx * (up + down - 2.0 * center)
+            + cy * (left + right - 2.0 * center))
+
+
+def _col_update(center, left, right, up1, dn1, cx, cy):
+    """Stencil update of one column interior (rows 1..bx-2)."""
+    center = center.astype(_ACC)
+    left = left.astype(_ACC)
+    right = right.astype(_ACC)
+    up = jnp.concatenate([up1.astype(_ACC).reshape(1), center[:-1]])
+    down = jnp.concatenate([center[1:], dn1.astype(_ACC).reshape(1)])
+    return (center + cx * (up + down - 2.0 * center)
+            + cy * (left + right - 2.0 * center))
+
+
+def _block_update_overlap(u, halos, cx, cy):
+    """Updated values for every cell of the block, overlap-friendly.
+
+    The local interior ``[1:-1, 1:-1]`` is computed from ``u`` alone — no
+    data dependency on the halos — mirroring the reference's
+    interior-between-Startall-and-Waitall structure
+    (``mpi/...stat.c:160-177``). Only the four edge strips read the
+    permuted halos, so XLA may overlap the collectives with the bulk of
+    the FLOPs.
+    """
+    halo_n, halo_s, halo_w, halo_e = halos
+    # Bulk interior: depends only on local block.
+    inner = stencil_interior_2d(u, cx, cy)  # (bx-2, by-2)
+    # Edge strips: depend on halos (the reference's edge passes,
+    # mpi/...stat.c:178-234).
+    top = _row_update(u[0, :], halo_n[0, :], u[1, :],
+                      halo_w[0, 0], halo_e[0, 0], cx, cy)
+    bot = _row_update(u[-1, :], u[-2, :], halo_s[0, :],
+                      halo_w[-1, 0], halo_e[-1, 0], cx, cy)
+    wcol = _col_update(u[1:-1, 0], halo_w[1:-1, 0], u[1:-1, 1],
+                       u[0, 0], u[-1, 0], cx, cy)
+    ecol = _col_update(u[1:-1, -1], u[1:-1, -2], halo_e[1:-1, 0],
+                       u[0, -1], u[-1, -1], cx, cy)
+    mid = jnp.concatenate([wcol[:, None], inner, ecol[:, None]], axis=1)
+    return jnp.concatenate([top[None, :], mid, bot[None, :]], axis=0)
+
+
+def _block_update_padded(u, halos, cx, cy):
+    """Updated values for every cell via the simple pad-then-stencil path."""
+    return stencil_interior_2d(_pad_block(u, halos), cx, cy)
+
+
+def _pick_update(u, overlap):
+    # The overlap formulation needs at least 2 rows and 2 columns per
+    # block (it materializes distinct top/bottom rows and west/east
+    # columns); degenerate blocks use the padded path, which handles
+    # extent-1 axes correctly. Shapes are static, so this is trace-time.
+    if overlap and u.shape[0] >= 2 and u.shape[1] >= 2:
+        return _block_update_overlap
+    return _block_update_padded
+
+
+def block_step_2d(u, *, mesh_shape, grid_shape, block_index, cx, cy,
+                  axis_names=("x", "y"), overlap=True):
+    """One sharded step on a ``(bx, by)`` block: exchange, update, mask."""
+    halos = exchange_halos_2d(u, mesh_shape, axis_names)
+    update = _pick_update(u, overlap)
+    new = update(u, halos, cx, cy)
+    mask = interior_mask_2d(u.shape, grid_shape, block_index)
+    return jnp.where(mask, new.astype(u.dtype), u)
+
+
+def block_step_2d_residual(u, *, mesh_shape, grid_shape, block_index, cx, cy,
+                           axis_names=("x", "y"), overlap=True):
+    """Sharded step plus the *global* max-norm residual (replicated)."""
+    halos = exchange_halos_2d(u, mesh_shape, axis_names)
+    update = _pick_update(u, overlap)
+    new = update(u, halos, cx, cy)
+    mask = interior_mask_2d(u.shape, grid_shape, block_index)
+    diff = jnp.where(mask, jnp.abs(new - u.astype(_ACC)), 0.0)
+    local_res = jnp.max(diff)
+    res = lax.pmax(local_res, axis_names)
+    return jnp.where(mask, new.astype(u.dtype), u), res

@@ -1,0 +1,81 @@
+"""3D halo exchange and per-block 7-point stencil steps (shard_map).
+
+The 3D extension of ``halo.py``: six face halos over a ``('x','y','z')``
+mesh instead of four edge halos. Same design: statically-built
+``ppermute`` tables (non-periodic — edge devices receive zeros, which
+are never consumed thanks to the global-boundary mask), ``pmax``
+convergence vote. The reference is strictly 2D; this implements
+BASELINE.json config 5 (512^3, 7-point).
+
+The per-block update uses the pad-then-stencil formulation; the
+interior/edge overlap split of the 2D path generalizes to six face
+slabs and is left to the Pallas kernel layer.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_heat_tpu.ops.stencil import stencil_interior_3d
+from parallel_heat_tpu.parallel.halo import _shift_down, _shift_up
+
+_ACC = jnp.float32
+
+
+def exchange_halos_3d(u, mesh_shape: Tuple[int, int, int],
+                      axis_names: Tuple[str, str, str] = ("x", "y", "z")):
+    """Exchange the six 1-cell-thick face halos of a ``(bx, by, bz)`` block."""
+    dx, dy, dz = mesh_shape
+    ax, ay, az = axis_names
+    lo_x = _shift_down(u[-1:, :, :], ax, dx)  # from x-1 neighbor
+    hi_x = _shift_up(u[:1, :, :], ax, dx)     # from x+1 neighbor
+    lo_y = _shift_down(u[:, -1:, :], ay, dy)
+    hi_y = _shift_up(u[:, :1, :], ay, dy)
+    lo_z = _shift_down(u[:, :, -1:], az, dz)
+    hi_z = _shift_up(u[:, :, :1], az, dz)
+    return lo_x, hi_x, lo_y, hi_y, lo_z, hi_z
+
+
+def interior_mask_3d(block_shape, grid_shape, block_index):
+    """Boolean ``(bx, by, bz)`` mask of global-interior cells."""
+    masks = []
+    for bs, n, bi in zip(block_shape, grid_shape, block_index):
+        idx = bi * bs + jnp.arange(bs, dtype=jnp.int32)
+        masks.append((idx >= 1) & (idx <= n - 2))
+    mx, my, mz = masks
+    return mx[:, None, None] & my[None, :, None] & mz[None, None, :]
+
+
+def _pad_block_3d(u, halos):
+    """Assemble the ``(bx+2, by+2, bz+2)`` padded block (zero edges)."""
+    lo_x, hi_x, lo_y, hi_y, lo_z, hi_z = (h.astype(u.dtype) for h in halos)
+    u = jnp.concatenate([lo_x, u, hi_x], axis=0)  # (bx+2, by, bz)
+    zpad = lambda f: jnp.pad(f, ((1, 1), (0, 0), (0, 0)))
+    u = jnp.concatenate([zpad(lo_y), u, zpad(hi_y)], axis=1)  # (bx+2, by+2, bz)
+    zpad2 = lambda f: jnp.pad(f, ((1, 1), (1, 1), (0, 0)))
+    return jnp.concatenate([zpad2(lo_z), u, zpad2(hi_z)], axis=2)
+
+
+def block_step_3d(u, *, mesh_shape, grid_shape, block_index, cx, cy, cz,
+                  axis_names=("x", "y", "z"), overlap=True):
+    """One sharded 7-point step: exchange, pad, update, mask."""
+    del overlap  # 3D uses the padded formulation (see module docstring)
+    halos = exchange_halos_3d(u, mesh_shape, axis_names)
+    new = stencil_interior_3d(_pad_block_3d(u, halos), cx, cy, cz)
+    mask = interior_mask_3d(u.shape, grid_shape, block_index)
+    return jnp.where(mask, new.astype(u.dtype), u)
+
+
+def block_step_3d_residual(u, *, mesh_shape, grid_shape, block_index,
+                           cx, cy, cz, axis_names=("x", "y", "z"),
+                           overlap=True):
+    del overlap
+    halos = exchange_halos_3d(u, mesh_shape, axis_names)
+    new = stencil_interior_3d(_pad_block_3d(u, halos), cx, cy, cz)
+    mask = interior_mask_3d(u.shape, grid_shape, block_index)
+    diff = jnp.where(mask, jnp.abs(new - u.astype(_ACC)), 0.0)
+    res = lax.pmax(jnp.max(diff), axis_names)
+    return jnp.where(mask, new.astype(u.dtype), u), res

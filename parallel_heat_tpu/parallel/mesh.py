@@ -1,0 +1,73 @@
+"""Device mesh topology — the TPU-native replacement for the reference's
+MPI Cartesian communicator.
+
+Reference: ``MPI_Dims_create`` factorizes the rank count into a 2D grid,
+``MPI_Cart_create``/``MPI_Cart_shift`` discover neighbors
+(``mpi/mpi_heat_improved_persistent_stat.c:51-69``). Here the same roles
+are played by :func:`pick_mesh_shape` (factorization) and
+``jax.sharding.Mesh`` (topology); neighbor "discovery" is implicit in the
+statically-built ``ppermute`` permutation tables in ``halo.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def pick_mesh_shape(n_devices: int, ndim: int = 2) -> Tuple[int, ...]:
+    """Factor ``n_devices`` into ``ndim`` near-equal factors.
+
+    The analog of ``MPI_Dims_create(numtasks, 2, dims)``
+    (``mpi/...stat.c:52``): balanced factors minimize halo surface area.
+    Factors are sorted descending like MPI's convention.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    dims = [1] * ndim
+    remaining = n_devices
+    # Greedy: repeatedly pull the largest prime factor into the smallest dim.
+    primes = []
+    n = remaining
+    f = 2
+    while f * f <= n:
+        while n % f == 0:
+            primes.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        primes.append(n)
+    for p in sorted(primes, reverse=True):
+        dims[dims.index(min(dims))] *= p
+    return tuple(sorted(dims, reverse=True))
+
+
+def make_heat_mesh(
+    mesh_shape: Sequence[int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named device mesh of the given shape.
+
+    Axis names follow the spatial axes ``('x', 'y'[, 'z'])`` so sharding
+    specs read like the domain decomposition they implement.
+    """
+    mesh_shape = tuple(mesh_shape)
+    names = AXIS_NAMES[: len(mesh_shape)]
+    if devices is None:
+        n = 1
+        for d in mesh_shape:
+            n *= d
+        avail = jax.devices()
+        if n > len(avail):
+            raise ValueError(
+                f"mesh {mesh_shape} needs {n} devices, have {len(avail)}"
+            )
+        devices = avail[:n]
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    return Mesh(dev_array, names)

@@ -1,0 +1,300 @@
+"""Solver driver: the orchestration layer.
+
+The reference's ``main()`` functions (``mpi/...stat.c:35-310``,
+``cuda/cuda_heat.cu:166-269``) interleave allocation, distribution, the
+step loop, convergence polling and collection imperatively. Here the whole
+simulation — N steps, halo exchanges, convergence votes — is a single
+jitted XLA program:
+
+- fixed-step mode: ``lax.fori_loop`` over fused steps (the CUDA
+  ``i < STEPS`` semantics, ``cuda/cuda_heat.cu:204``);
+- converge mode: ``lax.while_loop`` whose body advances
+  ``check_interval`` steps and computes the residual max-norm *on
+  device*, replacing the reference's host-polled flag reduction
+  (``cuda/cuda_heat.cu:219-236``) and MPI allreduce vote
+  (``mpi/...stat.c:235-262``) with zero host round-trips;
+- distribution: ``shard_map`` over a named ICI mesh — the grid is born
+  sharded (no master scatter/gather, ``mpi/...stat.c:86-127,270-298``).
+
+Double buffering falls out of functional purity + buffer donation: XLA
+ping-pongs the two HBM buffers exactly like the reference's
+``old = 1-old`` swap (``cuda/cuda_heat.cu:217``).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+from parallel_heat_tpu.ops import (
+    step_2d,
+    step_2d_residual,
+    step_3d,
+    step_3d_residual,
+)
+from parallel_heat_tpu.parallel.halo import (
+    block_step_2d,
+    block_step_2d_residual,
+)
+from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+
+try:  # JAX >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+@dataclass
+class HeatResult:
+    """Outcome of one simulation run."""
+
+    grid: jax.Array
+    steps_run: int
+    converged: Optional[bool]
+    residual: Optional[float]
+    elapsed_s: float
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather the (possibly sharded) final grid to host memory."""
+        return np.asarray(self.grid)
+
+
+def model_for(config: HeatConfig):
+    if config.ndim == 3:
+        return HeatPlate3D(config.nx, config.ny, config.nz,
+                           config.cx, config.cy, config.cz)
+    return HeatPlate2D(config.nx, config.ny, config.cx, config.cy)
+
+
+def _resolve_backend(config: HeatConfig) -> str:
+    if config.backend != "auto":
+        return config.backend
+    plat = jax.devices()[0].platform
+    return "pallas" if plat in ("tpu", "axon") else "jnp"
+
+
+def _dtype_of(config: HeatConfig):
+    return jnp.dtype(config.dtype)
+
+
+# --------------------------------------------------------------------------
+# Loop construction (shared by single-device and per-shard programs)
+# --------------------------------------------------------------------------
+
+def _make_loop(step, step_residual, config: HeatConfig):
+    """Build ``run(u) -> (u, steps_run, converged, residual)``.
+
+    ``step``/``step_residual`` operate on whatever array the caller gives
+    (full grid or shard block); this function only encodes the stepping /
+    convergence policy, so the same loop serves every backend and mesh.
+    """
+    steps = config.steps
+
+    if not config.converge:
+
+        def run_fixed(u):
+            u = lax.fori_loop(0, steps, lambda i, uu: step(uu), u)
+            return (u, jnp.int32(steps), jnp.bool_(False),
+                    jnp.float32(jnp.nan))
+
+        return run_fixed
+
+    ci = config.check_interval
+    eps = config.eps
+    n_full = steps // ci
+    rem = steps % ci
+    full_steps = n_full * ci
+
+    def chunk(u):
+        # ci-1 plain steps, then one step with a fused residual — the
+        # residual is the diff of the *last* step of the chunk, matching
+        # the reference's consecutive-buffer check (mpi/...stat.c:245).
+        u = lax.fori_loop(0, ci - 1, lambda i, uu: step(uu), u)
+        return step_residual(u)
+
+    def cond(carry):
+        _, k, res = carry
+        return (res >= eps) & (k < full_steps)
+
+    def body(carry):
+        u, k, _ = carry
+        u, res = chunk(u)
+        return (u, k + ci, res)
+
+    def run_converge(u):
+        u, k, res = lax.while_loop(
+            cond, body, (u, jnp.int32(0), jnp.float32(jnp.inf))
+        )
+        converged = res < eps
+        if rem > 0:
+            # Tail iterations past the last full check window (the
+            # reference likewise runs them uninspected when STEPS is not
+            # a multiple of STEP).
+            u = lax.cond(
+                converged,
+                lambda uu: uu,
+                lambda uu: lax.fori_loop(0, rem, lambda i, x: step(x), uu),
+                u,
+            )
+            k = jnp.where(converged, k, k + rem)
+        return u, k, converged, res
+
+    return run_converge
+
+
+# --------------------------------------------------------------------------
+# Runner builders (cached per config)
+# --------------------------------------------------------------------------
+
+def _single_steps(config: HeatConfig, backend: str):
+    """(step, step_residual) on the full grid for one device."""
+    if backend == "pallas":
+        from parallel_heat_tpu.ops import pallas_stencil
+
+        if config.ndim == 2:
+            return pallas_stencil.single_grid_steps(config)
+        backend = "jnp"  # 3D pallas: fall back (jnp path is XLA-fused)
+    if config.ndim == 3:
+        cx, cy, cz = config.cx, config.cy, config.cz
+        return (
+            lambda u: step_3d(u, cx, cy, cz),
+            lambda u: step_3d_residual(u, cx, cy, cz),
+        )
+    cx, cy = config.cx, config.cy
+    return (
+        lambda u: step_2d(u, cx, cy),
+        lambda u: step_2d_residual(u, cx, cy),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_runner(config: HeatConfig):
+    """Compile the full simulation program for ``config``.
+
+    Returns ``(fn, mesh_or_None)`` where ``fn(u0)`` ->
+    ``(grid, steps_run, converged, residual)``.
+    """
+    config.validate()
+    backend = _resolve_backend(config)
+    mesh_shape = config.mesh_or_unit()
+    is_sharded = any(d > 1 for d in mesh_shape)
+
+    if not is_sharded:
+        step, step_residual = _single_steps(config, backend)
+        run = _make_loop(step, step_residual, config)
+        return jax.jit(run, donate_argnums=0), None
+
+    if config.ndim == 3:
+        from parallel_heat_tpu.parallel import halo3d
+
+        mesh = make_heat_mesh(mesh_shape)
+        names = mesh.axis_names
+        spec = P(*names)
+
+        def local_run3(u_local):
+            bidx = tuple(lax.axis_index(n) for n in names)
+            kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
+                      block_index=bidx, cx=config.cx, cy=config.cy,
+                      cz=config.cz, axis_names=names,
+                      overlap=config.overlap)
+            step = lambda u: halo3d.block_step_3d(u, **kw)
+            stepr = lambda u: halo3d.block_step_3d_residual(u, **kw)
+            return _make_loop(step, stepr, config)(u_local)
+
+        run = _shard_map(
+            local_run3, mesh=mesh, in_specs=spec,
+            out_specs=(spec, P(), P(), P()),
+        )
+        return jax.jit(run, donate_argnums=0), mesh
+
+    mesh = make_heat_mesh(mesh_shape)
+    names = mesh.axis_names
+    spec = P(*names)
+    use_pallas = backend == "pallas"
+
+    def local_run(u_local):
+        bidx = tuple(lax.axis_index(n) for n in names)
+        kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
+                  block_index=bidx, cx=config.cx, cy=config.cy,
+                  axis_names=names, overlap=config.overlap)
+        if use_pallas:
+            from parallel_heat_tpu.ops import pallas_stencil
+
+            step, stepr = pallas_stencil.block_steps(config, kw)
+        else:
+            step = lambda u: block_step_2d(u, **kw)
+            stepr = lambda u: block_step_2d_residual(u, **kw)
+        return _make_loop(step, stepr, config)(u_local)
+
+    run = _shard_map(
+        local_run, mesh=mesh, in_specs=spec,
+        out_specs=(spec, P(), P(), P()),
+    )
+    return jax.jit(run, donate_argnums=0), mesh
+
+
+def make_initial_grid(config: HeatConfig) -> jax.Array:
+    """Build the initial grid, sharded over the mesh when one is set.
+
+    The grid is *born sharded*: each device materializes its block from
+    an iota formula under GSPMD — no host-side full grid, no master
+    scatter (contrast ``mpi/...stat.c:86-127`` and SURVEY.md §2d.1-2).
+    """
+    config.validate()
+    model = model_for(config)
+    dtype = _dtype_of(config)
+    mesh_shape = config.mesh_or_unit()
+    if any(d > 1 for d in mesh_shape):
+        mesh = make_heat_mesh(mesh_shape)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        build = jax.jit(
+            lambda: model.init_grid(dtype), out_shardings=sharding
+        )
+        return build()
+    return jax.jit(lambda: model.init_grid(dtype))()
+
+
+def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
+          block_until_ready: bool = True) -> HeatResult:
+    """Run one simulation end-to-end. The main entry point.
+
+    ``initial`` defaults to the model's polynomial initial condition.
+    A caller-supplied ``initial`` is copied first: the compiled runner
+    donates its input buffer (the double-buffer swap), which would
+    otherwise invalidate the caller's array. Timing covers the step
+    loop only (compile time excluded on cache hits), synchronized like
+    the reference's wall-clock brackets (``cuda/cuda_heat.cu:203,239``).
+    """
+    import time
+
+    config = config.validate()
+    runner, _ = _build_runner(config)
+    if initial is None:
+        initial = make_initial_grid(config)
+    else:
+        initial = jnp.copy(initial)  # runner donates; protect the caller
+    initial = jax.block_until_ready(initial)
+
+    t0 = time.perf_counter()
+    grid, steps_run, converged, residual = runner(initial)
+    if block_until_ready:
+        jax.block_until_ready(grid)
+    elapsed = time.perf_counter() - t0
+
+    steps_run = int(steps_run)
+    if config.converge:
+        conv: Optional[bool] = bool(converged)
+        res: Optional[float] = float(residual)
+    else:
+        conv, res = None, None
+    return HeatResult(grid=grid, steps_run=steps_run, converged=conv,
+                      residual=res, elapsed_s=elapsed)

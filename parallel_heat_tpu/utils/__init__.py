@@ -1,0 +1,4 @@
+from parallel_heat_tpu.utils.io import write_dat, read_dat
+from parallel_heat_tpu.utils.timing import Timer
+
+__all__ = ["write_dat", "read_dat", "Timer"]

@@ -1,0 +1,59 @@
+"""Grid file I/O — byte-compatible with the reference's ``prtdat``.
+
+``prtdat`` format (identical in both reference programs,
+``mpi/mpi_heat_improved_persistent_stat.c:326-341``,
+``cuda/cuda_heat.cu:285-300``): iterate ``iy`` from ``ny-1`` down to 0
+(outer) and ``ix`` from 0 to ``nx-1`` (inner), printing ``u[ix, iy]`` with
+C ``"%6.1f"``, a single space between values, and a newline after each
+``iy`` row. So each output *line* is one ``iy`` column of the array.
+
+A native C++ fast path (``parallel_heat_tpu/native``) is used when its
+shared library has been built; the NumPy/Python path below is the always-
+available fallback and the semantics oracle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _format_dat_python(u: np.ndarray) -> str:
+    """Pure-Python reference formatter (slow, exact)."""
+    nx, ny = u.shape
+    lines = []
+    for iy in range(ny - 1, -1, -1):
+        lines.append(" ".join(f"{float(u[ix, iy]):6.1f}" for ix in range(nx)))
+    return "\n".join(lines) + "\n"
+
+
+def write_dat(path: str | os.PathLike, u, use_native: bool = True) -> None:
+    """Write a 2D grid in the reference ``.dat`` text format."""
+    u = np.asarray(u, dtype=np.float32)
+    if u.ndim != 2:
+        raise ValueError(f".dat format is 2D-only, got shape {u.shape}")
+    if use_native:
+        try:
+            from parallel_heat_tpu.native import binding as _native
+
+            if _native.available():
+                _native.write_dat(str(path), u)
+                return
+        except Exception:
+            pass  # fall back to Python writer
+    with open(path, "w") as fp:
+        fp.write(_format_dat_python(u))
+
+
+def read_dat(path: str | os.PathLike) -> np.ndarray:
+    """Read a ``.dat`` file back into the ``(nx, ny)`` array convention."""
+    rows = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip("\n")
+            if not line.strip():
+                continue
+            rows.append([float(tok) for tok in line.split()])
+    arr = np.array(rows, dtype=np.float32)  # (ny, nx), iy descending
+    return arr[::-1].T.copy()  # back to u[ix, iy]

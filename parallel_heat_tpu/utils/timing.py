@@ -1,0 +1,38 @@
+"""Wall-clock timing — the analog of the reference's ``timestamp.h``
+(``getTimestamp``/``getElapsedtime``, ``cuda/timestamp.h:8-26``) and the
+``MPI_Wtime`` pairs (``mpi/...stat.c:88,298``).
+
+On an async backend like JAX, a bare ``perf_counter`` delta measures
+dispatch, not compute; ``Timer`` therefore blocks on the provided arrays
+before reading the clock.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+class Timer:
+    """Context-manager wall-clock timer with device synchronization."""
+
+    def __init__(self, sync_on=None):
+        self._sync_on = sync_on
+        self.elapsed_s: float = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync_on is not None:
+            jax.block_until_ready(self._sync_on)
+        self.elapsed_s = time.perf_counter() - self._t0
+        return False
+
+    def stop(self, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        self.elapsed_s = time.perf_counter() - self._t0
+        return self.elapsed_s

@@ -1,0 +1,17 @@
+"""Test configuration: run everything on 8 virtual CPU devices.
+
+The distributed paths (``shard_map`` + ``ppermute``) then run on CPU
+exactly as they would over an 8-chip ICI mesh (SURVEY.md §4). A pytest
+plugin imports jax before this conftest loads, so env vars are too late;
+``jax.config.update`` still works because the backend itself is only
+initialized on first use.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # the shell pins a TPU platform
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert len(jax.devices()) == 8, (
+    "tests require 8 virtual CPU devices; got " + str(jax.devices())
+)

@@ -1,0 +1,68 @@
+"""NumPy reference implementations — the semantics oracle for all tests.
+
+Independent of the package's JAX code paths: plain NumPy loops/slices in
+float64, written straight from the update rule in the reference
+(``cuda/cuda_heat.cu:57-65``).
+"""
+
+import numpy as np
+
+
+def init_grid(nx, ny, dtype=np.float64):
+    u = np.empty((nx, ny), dtype=np.float64)
+    for ix in range(nx):
+        for iy in range(ny):
+            u[ix, iy] = ix * (nx - ix - 1) * iy * (ny - iy - 1)
+    return u.astype(dtype)
+
+
+def step(u, cx=0.1, cy=0.1):
+    """One Jacobi step, interior only (float64)."""
+    u = u.astype(np.float64)
+    v = u.copy()
+    c = u[1:-1, 1:-1]
+    v[1:-1, 1:-1] = (
+        c
+        + cx * (u[2:, 1:-1] + u[:-2, 1:-1] - 2.0 * c)
+        + cy * (u[1:-1, 2:] + u[1:-1, :-2] - 2.0 * c)
+    )
+    return v
+
+
+def run(u, steps, cx=0.1, cy=0.1):
+    for _ in range(steps):
+        u = step(u, cx, cy)
+    return u
+
+
+def run_converge(u, max_steps, check_interval, eps, cx=0.1, cy=0.1):
+    """Chunked convergence semantics matching the package's definition."""
+    k = 0
+    n_full = max_steps // check_interval
+    for _ in range(n_full):
+        prev = u
+        for _ in range(check_interval):
+            prev = u
+            u = step(u, cx, cy)
+        k += check_interval
+        res = np.max(np.abs(u - prev))
+        if res < eps:
+            return u, k, True, res
+    rem = max_steps % check_interval
+    for _ in range(rem):
+        u = step(u, cx, cy)
+    k += rem
+    return u, k, False, np.inf if n_full == 0 else res
+
+
+def step3d(u, cx=0.1, cy=0.1, cz=0.1):
+    u = u.astype(np.float64)
+    v = u.copy()
+    c = u[1:-1, 1:-1, 1:-1]
+    v[1:-1, 1:-1, 1:-1] = (
+        c
+        + cx * (u[2:, 1:-1, 1:-1] + u[:-2, 1:-1, 1:-1] - 2.0 * c)
+        + cy * (u[1:-1, 2:, 1:-1] + u[1:-1, :-2, 1:-1] - 2.0 * c)
+        + cz * (u[1:-1, 1:-1, 2:] + u[1:-1, 1:-1, :-2] - 2.0 * c)
+    )
+    return v

@@ -1,0 +1,53 @@
+import pytest
+
+from parallel_heat_tpu import HeatConfig
+
+
+def test_defaults_mirror_reference_macros():
+    c = HeatConfig()
+    assert (c.nx, c.ny) == (20, 20)
+    assert (c.cx, c.cy) == (0.1, 0.1)
+    assert c.check_interval == 20
+    assert c.eps == 1e-3
+    c.validate()
+
+
+def test_shape_and_block_shape():
+    c = HeatConfig(nx=64, ny=32, mesh_shape=(2, 4)).validate()
+    assert c.shape == (64, 32)
+    assert c.block_shape() == (32, 8)
+    assert c.mesh_or_unit() == (2, 4)
+    assert HeatConfig().mesh_or_unit() == (1, 1)
+
+
+def test_3d_shape():
+    c = HeatConfig(nx=8, ny=8, nz=8).validate()
+    assert c.ndim == 3
+    assert c.shape == (8, 8, 8)
+    assert c.coefficients == (0.1, 0.1, 0.1)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(nx=2),
+        dict(steps=-1),
+        dict(converge=True, check_interval=0),
+        dict(converge=True, eps=0.0),
+        dict(dtype="int8"),
+        dict(backend="cuda"),
+        dict(mesh_shape=(3,)),          # rank mismatch
+        dict(nx=20, mesh_shape=(3, 1)),  # 20 % 3 != 0
+        dict(mesh_shape=(0, 1)),
+    ],
+)
+def test_validation_rejects(kw):
+    with pytest.raises(ValueError):
+        HeatConfig(**kw).validate()
+
+
+def test_json_roundtrip():
+    c = HeatConfig(nx=128, ny=64, steps=500, converge=True,
+                   mesh_shape=(2, 2), dtype="bfloat16")
+    c2 = HeatConfig.from_json(c.to_json())
+    assert c2 == c

@@ -1,0 +1,51 @@
+import numpy as np
+
+from parallel_heat_tpu.utils.io import read_dat, write_dat, _format_dat_python
+
+
+def test_format_matches_handwritten_golden(tmp_path):
+    # u[ix, iy]; prtdat prints iy=ny-1..0 per line, ix ascending within it,
+    # C "%6.1f" with single-space separators (mpi/...stat.c:326-341).
+    u = np.array(
+        [[0.0, 1.5, 2.25],
+         [10.0, -3.0, 100.0],
+         [1234.56, 7.0, -0.04]],
+        dtype=np.float32,
+    )  # (nx=3, ny=3)
+    golden = (
+        "   2.2  100.0   -0.0\n"
+        "   1.5   -3.0    7.0\n"
+        "   0.0   10.0 1234.6\n"
+    )
+    p = tmp_path / "g.dat"
+    write_dat(p, u, use_native=False)
+    assert p.read_text() == golden
+
+
+def test_wide_values_overflow_width_like_c(tmp_path):
+    # C %6.1f is a *minimum* width: big values take more columns.
+    u = np.array([[1234567.0, 2.0]], dtype=np.float32)  # nx=1, ny=2
+    p = tmp_path / "w.dat"
+    write_dat(p, u, use_native=False)
+    assert p.read_text() == "   2.0\n1234567.0\n"
+
+
+def test_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    u = (rng.standard_normal((17, 11)) * 100).astype(np.float32)
+    p = tmp_path / "r.dat"
+    write_dat(p, u, use_native=False)
+    back = read_dat(p)
+    np.testing.assert_allclose(back, u, atol=0.05)  # %.1f quantization
+
+
+def test_python_formatter_is_c_compatible():
+    # Cross-check the formatter against printf semantics via ctypes libc.
+    import ctypes
+
+    libc = ctypes.CDLL(None)
+    buf = ctypes.create_string_buffer(64)
+    vals = [0.0, -0.05, 3.14159, 99999.99, -1234.5, 2.5, 3.5]
+    for v in vals:
+        libc.snprintf(buf, 64, b"%6.1f", ctypes.c_double(v))
+        assert buf.value.decode() == f"{v:6.1f}", v

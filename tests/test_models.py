@@ -1,0 +1,50 @@
+import jax.numpy as jnp
+import numpy as np
+
+import oracle
+from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+
+
+def test_init_matches_reference_formula():
+    m = HeatPlate2D(20, 20)
+    got = m.init_grid_np(np.float32)
+    want = oracle.init_grid(20, 20, np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_init_boundary_is_zero():
+    m = HeatPlate2D(13, 9)
+    u = m.init_grid_np()
+    assert np.all(u[0, :] == 0) and np.all(u[-1, :] == 0)
+    assert np.all(u[:, 0] == 0) and np.all(u[:, -1] == 0)
+
+
+def test_device_init_matches_numpy_init():
+    m = HeatPlate2D(32, 24)
+    np.testing.assert_allclose(
+        np.asarray(m.init_grid(jnp.float32)), m.init_grid_np(np.float32),
+        rtol=1e-6,
+    )
+
+
+def test_block_init_assembles_to_global():
+    m = HeatPlate2D(24, 16)
+    full = m.init_grid_np(np.float32)
+    bx, by = 12, 4
+    for bi in range(2):
+        for bj in range(4):
+            blk = np.asarray(m.init_block((bx, by), (bi, bj)))
+            np.testing.assert_allclose(
+                blk, full[bi * bx:(bi + 1) * bx, bj * by:(bj + 1) * by],
+                rtol=1e-6,
+            )
+
+
+def test_3d_init_separable_and_zero_boundary():
+    m = HeatPlate3D(6, 7, 8)
+    u = m.init_grid_np()
+    assert u.shape == (6, 7, 8)
+    assert np.all(u[0] == 0) and np.all(u[-1] == 0)
+    assert np.all(u[:, 0, :] == 0) and np.all(u[:, :, -1] == 0)
+    # spot value
+    assert u[2, 3, 4] == 2 * 3 * 3 * 3 * 4 * 3

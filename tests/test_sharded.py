@@ -1,0 +1,77 @@
+"""Sharded-vs-single equivalence on the 8-device virtual CPU mesh.
+
+The per-cell arithmetic uses identical expression trees in the single-
+device and per-block paths, so results must match *bitwise* in f32 —
+commutativity (not associativity) is the only reordering involved.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.solver import make_initial_grid
+
+MESHES = [(1, 1), (2, 1), (1, 2), (2, 2), (2, 4), (4, 2), (8, 1), (1, 8)]
+
+
+def _single(nx, ny, **kw):
+    return solve(HeatConfig(nx=nx, ny=ny, backend="jnp", **kw))
+
+
+@pytest.mark.parametrize("mesh", MESHES)
+def test_fixed_steps_sharded_equals_single(mesh):
+    kw = dict(steps=30)
+    want = _single(16, 16, **kw).to_numpy()
+    got = solve(
+        HeatConfig(nx=16, ny=16, backend="jnp", mesh_shape=mesh, **kw)
+    ).to_numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("mesh", [(2, 2), (2, 4), (8, 1)])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_overlap_and_padded_paths_agree(mesh, overlap):
+    want = _single(24, 16, steps=25).to_numpy()
+    got = solve(
+        HeatConfig(nx=24, ny=16, steps=25, backend="jnp",
+                   mesh_shape=mesh, overlap=overlap)
+    ).to_numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("mesh", [(2, 2), (2, 4)])
+def test_converge_sharded_equals_single(mesh):
+    kw = dict(steps=2000, converge=True, check_interval=20, eps=1e-3)
+    want = _single(20, 20, **kw)
+    got = solve(
+        HeatConfig(nx=20, ny=20, backend="jnp", mesh_shape=mesh, **kw)
+    )
+    assert got.converged == want.converged is True
+    assert got.steps_run == want.steps_run
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_sharded_initial_grid_matches_single():
+    cfg_s = HeatConfig(nx=32, ny=32, mesh_shape=(2, 4))
+    cfg_1 = HeatConfig(nx=32, ny=32)
+    np.testing.assert_allclose(
+        np.asarray(make_initial_grid(cfg_s)),
+        np.asarray(make_initial_grid(cfg_1)),
+        rtol=1e-6,
+    )
+
+
+def test_sharded_result_is_actually_sharded():
+    cfg = HeatConfig(nx=16, ny=16, steps=4, backend="jnp",
+                     mesh_shape=(2, 4))
+    res = solve(cfg)
+    assert len(res.grid.sharding.device_set) == 8
+
+
+@pytest.mark.parametrize("mesh", [(2, 2)])
+def test_nonsquare_blocks(mesh):
+    want = _single(12, 36, steps=17).to_numpy()
+    got = solve(
+        HeatConfig(nx=12, ny=36, steps=17, backend="jnp", mesh_shape=mesh)
+    ).to_numpy()
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+import oracle
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.solver import make_initial_grid
+
+
+def test_zero_steps_returns_initial():
+    cfg = HeatConfig(nx=12, ny=10, steps=0, backend="jnp")
+    res = solve(cfg)
+    np.testing.assert_array_equal(
+        res.to_numpy(), np.asarray(make_initial_grid(cfg))
+    )
+    assert res.steps_run == 0
+    assert res.converged is None
+
+
+@pytest.mark.parametrize("steps", [1, 7, 50])
+def test_fixed_steps_match_oracle(steps):
+    cfg = HeatConfig(nx=16, ny=12, steps=steps, backend="jnp")
+    res = solve(cfg)
+    want = oracle.run(oracle.init_grid(16, 12), steps)
+    np.testing.assert_allclose(res.to_numpy(), want, rtol=1e-5, atol=1e-3)
+    assert res.steps_run == steps
+
+
+def test_converge_mode_reference_default_grid():
+    # The reference's 20x20 default converges well before 10k steps.
+    cfg = HeatConfig(nx=20, ny=20, steps=10_000, converge=True,
+                     check_interval=20, eps=1e-3, backend="jnp")
+    res = solve(cfg)
+    assert res.converged is True
+    assert res.steps_run % 20 == 0
+    assert 0 < res.steps_run < 10_000
+    assert res.residual < 1e-3
+
+
+def test_converge_semantics_match_oracle():
+    cfg = HeatConfig(nx=14, ny=14, steps=400, converge=True,
+                     check_interval=10, eps=1e-2, backend="jnp")
+    res = solve(cfg)
+    want_u, want_k, want_conv, _ = oracle.run_converge(
+        oracle.init_grid(14, 14), 400, 10, 1e-2
+    )
+    assert res.steps_run == want_k
+    assert res.converged == want_conv
+    np.testing.assert_allclose(res.to_numpy(), want_u, rtol=1e-5, atol=1e-2)
+
+
+def test_converge_with_tiny_eps_runs_all_steps():
+    # eps unreachable -> must run exactly `steps`, including the tail
+    # chunk when steps is not a multiple of check_interval.
+    cfg = HeatConfig(nx=12, ny=12, steps=47, converge=True,
+                     check_interval=20, eps=1e-30, backend="jnp")
+    res = solve(cfg)
+    assert res.converged is False
+    assert res.steps_run == 47
+    fixed = solve(HeatConfig(nx=12, ny=12, steps=47, backend="jnp"))
+    np.testing.assert_array_equal(res.to_numpy(), fixed.to_numpy())
+
+
+def test_converge_steps_smaller_than_interval():
+    cfg = HeatConfig(nx=12, ny=12, steps=5, converge=True,
+                     check_interval=20, backend="jnp")
+    res = solve(cfg)
+    assert res.steps_run == 5
+    assert res.converged is False
+
+
+def test_3d_fixed_steps_match_oracle():
+    cfg = HeatConfig(nx=8, ny=9, nz=10, steps=11, backend="jnp")
+    res = solve(cfg)
+    u = np.asarray(make_initial_grid(cfg), dtype=np.float64)
+    for _ in range(11):
+        u = oracle.step3d(u)
+    np.testing.assert_allclose(res.to_numpy(), u, rtol=1e-5, atol=1e-3)
+
+
+def test_3d_converge():
+    cfg = HeatConfig(nx=10, ny=10, nz=10, steps=5000, converge=True,
+                     check_interval=25, eps=1e-3, backend="jnp")
+    res = solve(cfg)
+    assert res.converged is True
+    assert res.steps_run % 25 == 0

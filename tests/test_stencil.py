@@ -1,0 +1,75 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracle
+from parallel_heat_tpu.ops import (
+    step_2d,
+    step_2d_residual,
+    step_3d,
+    stencil_interior_2d,
+)
+
+
+@pytest.mark.parametrize("shape", [(3, 3), (5, 7), (16, 12), (33, 9)])
+def test_step_matches_oracle(shape):
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(shape).astype(np.float32) * 10
+    got = np.asarray(step_2d(jnp.asarray(u), 0.1, 0.1))
+    want = oracle.step(u)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+def test_boundary_never_written():
+    rng = np.random.default_rng(1)
+    u = rng.standard_normal((10, 14)).astype(np.float32)
+    v = np.asarray(step_2d(jnp.asarray(u), 0.1, 0.1))
+    np.testing.assert_array_equal(v[0, :], u[0, :])
+    np.testing.assert_array_equal(v[-1, :], u[-1, :])
+    np.testing.assert_array_equal(v[:, 0], u[:, 0])
+    np.testing.assert_array_equal(v[:, -1], u[:, -1])
+
+
+def test_uniform_grid_is_fixed_point():
+    u = jnp.full((9, 9), 3.5, dtype=jnp.float32)
+    v = step_2d(u, 0.1, 0.1)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(u))
+
+
+def test_residual_matches_direct_diff():
+    rng = np.random.default_rng(2)
+    u = rng.standard_normal((12, 12)).astype(np.float32)
+    v, res = step_2d_residual(jnp.asarray(u), 0.1, 0.1)
+    want = np.max(np.abs(np.asarray(v) - u))
+    np.testing.assert_allclose(float(res), want, rtol=1e-6)
+
+
+def test_residual_zero_on_fixed_point():
+    u = jnp.zeros((8, 8), dtype=jnp.float32)
+    _, res = step_2d_residual(u, 0.1, 0.1)
+    assert float(res) == 0.0
+
+
+def test_interior_op_shape():
+    u = jnp.zeros((10, 20))
+    assert stencil_interior_2d(u, 0.1, 0.1).shape == (8, 18)
+
+
+def test_step_3d_matches_oracle():
+    rng = np.random.default_rng(3)
+    u = rng.standard_normal((6, 7, 8)).astype(np.float32)
+    got = np.asarray(step_3d(jnp.asarray(u), 0.1, 0.1, 0.1))
+    want = oracle.step3d(u)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+def test_bf16_storage_f32_accumulation():
+    rng = np.random.default_rng(4)
+    u32 = rng.standard_normal((16, 16)).astype(np.float32)
+    ub = jnp.asarray(u32).astype(jnp.bfloat16)
+    v = step_2d(ub, 0.1, 0.1)
+    assert v.dtype == jnp.bfloat16
+    want = oracle.step(np.asarray(ub.astype(jnp.float32)))
+    got = np.asarray(v.astype(jnp.float32))
+    # bf16 storage rounding only — accumulation must have been f32.
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
