@@ -1,0 +1,51 @@
+# Build/run entry points mirroring the reference's Makefile matrix
+# (mpi/Makefile:12-21 built heat_$(SIZE) / heat_omp_ / heat_con_ /
+# heat_con_omp_ binary variants). Here the variants are run targets on
+# one runtime-configured program, and BACKEND=tpu selects the TPU
+# compute path (the BASELINE.json north-star Make entry).
+
+SIZE ?= 900
+STEPS ?= 10000
+STEP ?= 20
+BACKEND ?= tpu
+MESH ?=
+PY ?= python
+
+ifeq ($(BACKEND),tpu)
+BACKEND_FLAG = --backend auto
+else
+BACKEND_FLAG = --backend $(BACKEND)
+endif
+
+ifneq ($(MESH),)
+MESH_FLAG = --mesh $(MESH)
+endif
+
+RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
+      --check-interval $(STEP) $(BACKEND_FLAG) $(MESH_FLAG)
+
+.PHONY: all heat heat_con native test bench clean
+
+all: heat
+
+# fixed-step run (reference: heat_$(SIZE))
+heat:
+	$(RUN) --out final_im.dat --initial-out initial_im.dat
+
+# converge-until-eps run (reference: heat_con_$(SIZE))
+heat_con:
+	$(RUN) --converge --out final_im.dat --initial-out initial_im.dat
+
+# native C++ I/O runtime library
+native:
+	$(MAKE) -C parallel_heat_tpu/native
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -f final_im.dat initial_im.dat *.npz
+	rm -rf parallel_heat_tpu/native/build

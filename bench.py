@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline config: 1000x1000 grid, 10 000 fixed steps, f32 — the
+reference's flagship CUDA result (best variant: 2.812 s on a 2016 GPU,
+Heat.pdf p.11 Table 6, i.e. ~3556 Mcells*steps/s; see BASELINE.md).
+``vs_baseline`` is our per-chip throughput over that number.
+
+Run from the repo root: ``python bench.py`` (add ``--full`` for the
+secondary configs; they print as extra JSON lines *after* the headline).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_MCELLS_PER_S = 3556.0  # derived in BASELINE.md / SURVEY.md §6
+
+
+def _bench_config(cfg, repeats=3):
+    """Best wall-clock over `repeats` timed runs (first compile excluded)."""
+    import jax
+
+    from parallel_heat_tpu import solve
+    from parallel_heat_tpu.solver import make_initial_grid
+
+    u0 = jax.block_until_ready(make_initial_grid(cfg))
+    solve(cfg, initial=u0)  # compile + warm up
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = solve(cfg, initial=u0)
+        jax.block_until_ready(res.grid)
+        best = min(best, time.perf_counter() - t0)
+    return best, res
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="also run secondary configs (extra JSON lines)")
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+    args.repeats = max(1, args.repeats)
+
+    from parallel_heat_tpu import HeatConfig
+
+    headline = HeatConfig(nx=1000, ny=1000, steps=10_000,
+                          backend=args.backend)
+    elapsed, _ = _bench_config(headline, args.repeats)
+    mcells = headline.nx * headline.ny * headline.steps / elapsed / 1e6
+    print(json.dumps({
+        "metric": "Mcells*steps/s/chip (1000^2, 10k steps, f32, fixed)",
+        "value": round(mcells, 1),
+        "unit": "Mcells*steps/s",
+        "vs_baseline": round(mcells / BASELINE_MCELLS_PER_S, 3),
+    }))
+    sys.stdout.flush()
+
+    if args.full:
+        secondary = [
+            ("4096^2 + eps-convergence (wall-clock s)",
+             HeatConfig(nx=4096, ny=4096, steps=10_000, converge=True,
+                        check_interval=20, backend=args.backend)),
+            ("16384^2, 1k steps f32 (Mcells*steps/s)",
+             HeatConfig(nx=16384, ny=16384, steps=1000,
+                        backend=args.backend)),
+            ("32768^2, 100 steps bf16 (Mcells*steps/s)",
+             HeatConfig(nx=32768, ny=32768, steps=100, dtype="bfloat16",
+                        backend=args.backend)),
+            ("512^3, 100 steps 3D 7-point (Mcells*steps/s)",
+             HeatConfig(nx=512, ny=512, nz=512, steps=100,
+                        backend=args.backend)),
+        ]
+        for name, cfg in secondary:
+            try:
+                elapsed, res = _bench_config(cfg, max(1, args.repeats - 1))
+                cells = cfg.nx * cfg.ny * (cfg.nz or 1)
+                out = {
+                    "metric": name,
+                    "wall_s": round(elapsed, 4),
+                    "mcells_steps_per_s": round(
+                        cells * res.steps_run / elapsed / 1e6, 1),
+                }
+                if cfg.converge:
+                    out["steps_to_converge"] = res.steps_run
+                    out["converged"] = res.converged
+                print(json.dumps(out))
+            except Exception as e:  # keep the headline line valid
+                print(json.dumps({"metric": name, "error": repr(e)}))
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Command-line interface — the runtime replacement for the reference's
+compile-time macro matrix.
+
+The reference builds one binary per configuration (``mpi/Makefile:12-21``
+bakes ``SIZE``/``STEPS``/``STEP``/``CONVERGE``/``OMPCH`` into four binary
+variants; the binaries take no arguments). Here every knob is a flag, and
+the output mirrors the reference's console report: startup banner
+(``mpi/...stat.c:90-96``), converged-at (``:300-305``), elapsed time
+(``:306``), plus ``initial_im.dat`` / ``final_im.dat`` dumps (``:98,299``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="parallel_heat_tpu",
+        description="TPU-native Jacobi heat-diffusion solver",
+    )
+    ap.add_argument("--nx", type=int, default=20, help="grid rows (NXPROB)")
+    ap.add_argument("--ny", type=int, default=20, help="grid cols (NYPROB)")
+    ap.add_argument("--nz", type=int, default=None,
+                    help="grid depth; enables the 3D 7-point stencil")
+    ap.add_argument("--steps", type=int, default=10_000,
+                    help="step count (exact in fixed mode, cap in converge)")
+    ap.add_argument("--converge", action="store_true",
+                    help="stop when max |du| < eps (CONVERGE build flag)")
+    ap.add_argument("--eps", type=float, default=1e-3)
+    ap.add_argument("--check-interval", type=int, default=20,
+                    help="steps between convergence checks (STEP macro)")
+    ap.add_argument("--cx", type=float, default=0.1)
+    ap.add_argument("--cy", type=float, default=0.1)
+    ap.add_argument("--cz", type=float, default=0.1)
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "jnp", "pallas"])
+    ap.add_argument("--mesh", default=None,
+                    help="device mesh, e.g. '2,4' (default: single device; "
+                         "'auto' factorizes over all local devices)")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="disable the interior/edge comm-compute overlap")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write final grid (.dat for 2D, .npy otherwise)")
+    ap.add_argument("--initial-out", default=None, metavar="FILE",
+                    help="write initial grid (reference: initial_im.dat)")
+    ap.add_argument("--checkpoint", default=None, metavar="FILE",
+                    help="write an .npz checkpoint of the final state")
+    ap.add_argument("--resume", default=None, metavar="FILE",
+                    help="resume from an .npz checkpoint")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the run")
+    ap.add_argument("--quiet", action="store_true")
+    return ap
+
+
+def _parse_mesh(arg: Optional[str], ndim: int):
+    if arg is None:
+        return None
+    import jax
+
+    if arg == "auto":
+        from parallel_heat_tpu.parallel.mesh import pick_mesh_shape
+
+        return pick_mesh_shape(len(jax.devices()), ndim)
+    try:
+        shape = tuple(int(t) for t in arg.split(","))
+    except ValueError:
+        raise SystemExit(f"invalid --mesh {arg!r}: expected e.g. '2,4'")
+    return shape
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import make_initial_grid
+
+    ndim = 3 if args.nz is not None else 2
+    config = HeatConfig(
+        nx=args.nx, ny=args.ny, nz=args.nz,
+        cx=args.cx, cy=args.cy, cz=args.cz,
+        steps=args.steps, converge=args.converge, eps=args.eps,
+        check_interval=args.check_interval, dtype=args.dtype,
+        backend=args.backend, mesh_shape=_parse_mesh(args.mesh, ndim),
+        overlap=not args.no_overlap,
+    )
+    try:
+        config.validate()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    say = (lambda *a: None) if args.quiet else print
+    mesh = config.mesh_or_unit()
+    n_dev = 1
+    for d in mesh:
+        n_dev *= d
+    say(f"Starting parallel_heat_tpu on {n_dev} device(s), mesh {mesh}.")
+    if config.converge:
+        say(f"Grid size: {'x'.join(map(str, config.shape))}  "
+            f"Time steps: - (converge, eps={config.eps:g})")
+    else:
+        say(f"Grid size: {'x'.join(map(str, config.shape))}  "
+            f"Time steps: {config.steps}")
+
+    initial = None
+    start_step = 0
+    if args.resume:
+        from parallel_heat_tpu.utils.checkpoint import load_checkpoint
+
+        try:
+            initial, start_step, _ = load_checkpoint(args.resume, config)
+        except (OSError, ValueError, EOFError, KeyError) as e:
+            print(f"error: cannot resume from {args.resume}: {e}",
+                  file=sys.stderr)
+            return 2
+        say(f"Resumed from {args.resume} at step {start_step}.")
+        remaining = max(0, config.steps - start_step)
+        config = config.replace(steps=remaining)
+
+    if args.initial_out:
+        written = _write_grid(args.initial_out, initial if initial is not None
+                              else make_initial_grid(config))
+        say(f"Initial grid written to {written}")
+
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            result = solve(config, initial=initial)
+        say(f"Profiler trace written to {args.profile}")
+    else:
+        result = solve(config, initial=initial)
+
+    total_steps = start_step + result.steps_run
+    if config.converge:
+        if result.converged:
+            say(f"Converged after {total_steps} steps")
+        else:
+            say(f"Did not converge (ran {total_steps} steps, "
+                f"residual {result.residual:g})")
+    say(f"Elapsed time {result.elapsed_s:.6f} secs")
+
+    if args.out:
+        written = _write_grid(args.out, result.grid)
+        say(f"Final grid written to {written}")
+    if args.checkpoint:
+        from parallel_heat_tpu.utils.checkpoint import save_checkpoint
+
+        written = save_checkpoint(args.checkpoint, result.grid,
+                                  total_steps, config)
+        say(f"Checkpoint written to {written}")
+    return 0
+
+
+def _write_grid(path: str, grid) -> str:
+    """Write the grid; returns the path actually written (3D grids have
+    no .dat representation and are stored as .npy)."""
+    import numpy as np
+
+    path = str(path)
+    arr = np.asarray(grid)
+    if path.endswith(".npy") or arr.ndim != 2:
+        if not path.endswith(".npy"):
+            path += ".npy"
+        np.save(path, arr)
+        return path
+    from parallel_heat_tpu.utils.io import write_dat
+
+    write_dat(path, arr)
+    return path
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

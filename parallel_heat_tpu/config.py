@@ -110,6 +110,14 @@ class HeatConfig:
             raise ValueError(
                 f"dtype must be one of {_VALID_DTYPES}, got {self.dtype!r}"
             )
+        if self.dtype == "float64":
+            import jax
+
+            if not jax.config.jax_enable_x64:
+                raise ValueError(
+                    "dtype='float64' requires jax_enable_x64 (otherwise JAX "
+                    "silently computes in float32)"
+                )
         if self.backend not in _VALID_BACKENDS:
             raise ValueError(
                 f"backend must be one of {_VALID_BACKENDS}, got {self.backend!r}"

@@ -1,0 +1,97 @@
+"""ctypes binding for the native runtime library, with auto-build.
+
+The library is built on first use (one ``g++ -O3 -shared`` invocation via
+the sibling Makefile) and cached in ``native/build/``. Every entry point
+degrades gracefully: callers check :func:`available` and fall back to the
+Python implementations, so the package works on machines without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "build", "libheat_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.heat_write_dat.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_char_p,
+            ]
+            lib.heat_write_dat.restype = ctypes.c_int
+            lib.heat_init_grid.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+            lib.heat_native_abi_version.restype = ctypes.c_int
+            if lib.heat_native_abi_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def write_dat(path: str, u: np.ndarray) -> None:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    u = np.ascontiguousarray(u, dtype=np.float32)
+    nx, ny = u.shape
+    rc = lib.heat_write_dat(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        nx, ny, str(path).encode(),
+    )
+    if rc != 0:
+        raise OSError(f"heat_write_dat failed with code {rc} for {path!r}")
+
+
+def init_grid(nx: int, ny: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    u = np.empty((nx, ny), dtype=np.float32)
+    lib.heat_init_grid(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nx, ny
+    )
+    return u

@@ -1,0 +1,68 @@
+// Native runtime pieces: fast .dat serialization and grid init.
+//
+// The reference's runtime is C throughout; its I/O layer is prtdat/inidat
+// (mpi/mpi_heat_improved_persistent_stat.c:315-341, cuda/cuda_heat.cu:274-300).
+// The TPU build keeps compute in XLA, but host-side I/O at benchmark sizes
+// (e.g. a 32768^2 grid is a ~8.6 GB text file) is far too slow through
+// Python string formatting, so the writer is native: identical byte output
+// to C fprintf("%6.1f") — which both use snprintf semantics — with a
+// buffered column-major walk.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 dependency).
+
+#include <cstdio>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Write u[nx][ny] (row-major, C order) in prtdat format:
+// for iy = ny-1..0: print u[0][iy] .. u[nx-1][iy], single-space
+// separated, newline-terminated. Returns 0 on success, errno-style
+// negative on failure.
+int heat_write_dat(const float* u, int64_t nx, int64_t ny,
+                   const char* path) {
+    FILE* fp = std::fopen(path, "w");
+    if (!fp) return -1;
+    // Buffered line assembly: worst-case %6.1f of float32 is ~48 chars
+    // (large magnitudes print in full), plus separator.
+    std::vector<char> line;
+    line.reserve(static_cast<size_t>(nx) * 16 + 64);
+    char tok[64];
+    int rc = 0;
+    for (int64_t iy = ny - 1; iy >= 0; --iy) {
+        line.clear();
+        for (int64_t ix = 0; ix < nx; ++ix) {
+            int n = std::snprintf(tok, sizeof tok, "%6.1f",
+                                  static_cast<double>(u[ix * ny + iy]));
+            if (n < 0) { rc = -2; goto done; }
+            line.insert(line.end(), tok, tok + n);
+            line.push_back(ix == nx - 1 ? '\n' : ' ');
+        }
+        if (std::fwrite(line.data(), 1, line.size(), fp) != line.size()) {
+            rc = -3;
+            goto done;
+        }
+    }
+done:
+    if (std::fclose(fp) != 0 && rc == 0) rc = -4;
+    return rc;
+}
+
+// inidat: u[ix][iy] = ix*(nx-ix-1)*iy*(ny-iy-1), evaluated in double then
+// cast (NOT the reference's int arithmetic, which overflows for nx>~215).
+void heat_init_grid(float* u, int64_t nx, int64_t ny) {
+    for (int64_t ix = 0; ix < nx; ++ix) {
+        double fx = static_cast<double>(ix) * static_cast<double>(nx - ix - 1);
+        for (int64_t iy = 0; iy < ny; ++iy) {
+            double fy =
+                static_cast<double>(iy) * static_cast<double>(ny - iy - 1);
+            u[ix * ny + iy] = static_cast<float>(fx * fy);
+        }
+    }
+}
+
+int heat_native_abi_version() { return 1; }
+
+}  // extern "C"

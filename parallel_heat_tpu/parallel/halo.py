@@ -182,24 +182,28 @@ def _pick_update(u, overlap):
     return _block_update_padded
 
 
+def _exchanged_update_2d(u, mesh_shape, grid_shape, block_index, cx, cy,
+                         axis_names, overlap):
+    """Shared exchange -> update -> mask sequence; returns ``(new, mask)``."""
+    halos = exchange_halos_2d(u, mesh_shape, axis_names)
+    new = _pick_update(u, overlap)(u, halos, cx, cy)
+    mask = interior_mask_2d(u.shape, grid_shape, block_index)
+    return new, mask
+
+
 def block_step_2d(u, *, mesh_shape, grid_shape, block_index, cx, cy,
                   axis_names=("x", "y"), overlap=True):
     """One sharded step on a ``(bx, by)`` block: exchange, update, mask."""
-    halos = exchange_halos_2d(u, mesh_shape, axis_names)
-    update = _pick_update(u, overlap)
-    new = update(u, halos, cx, cy)
-    mask = interior_mask_2d(u.shape, grid_shape, block_index)
+    new, mask = _exchanged_update_2d(u, mesh_shape, grid_shape, block_index,
+                                     cx, cy, axis_names, overlap)
     return jnp.where(mask, new.astype(u.dtype), u)
 
 
 def block_step_2d_residual(u, *, mesh_shape, grid_shape, block_index, cx, cy,
                            axis_names=("x", "y"), overlap=True):
     """Sharded step plus the *global* max-norm residual (replicated)."""
-    halos = exchange_halos_2d(u, mesh_shape, axis_names)
-    update = _pick_update(u, overlap)
-    new = update(u, halos, cx, cy)
-    mask = interior_mask_2d(u.shape, grid_shape, block_index)
+    new, mask = _exchanged_update_2d(u, mesh_shape, grid_shape, block_index,
+                                     cx, cy, axis_names, overlap)
     diff = jnp.where(mask, jnp.abs(new - u.astype(_ACC)), 0.0)
-    local_res = jnp.max(diff)
-    res = lax.pmax(local_res, axis_names)
+    res = lax.pmax(jnp.max(diff), axis_names)
     return jnp.where(mask, new.astype(u.dtype), u), res

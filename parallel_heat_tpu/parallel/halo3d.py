@@ -59,13 +59,21 @@ def _pad_block_3d(u, halos):
     return jnp.concatenate([zpad2(lo_z), u, zpad2(hi_z)], axis=2)
 
 
+def _exchanged_update_3d(u, mesh_shape, grid_shape, block_index,
+                         cx, cy, cz, axis_names):
+    """Shared exchange -> update -> mask sequence; returns ``(new, mask)``."""
+    halos = exchange_halos_3d(u, mesh_shape, axis_names)
+    new = stencil_interior_3d(_pad_block_3d(u, halos), cx, cy, cz)
+    mask = interior_mask_3d(u.shape, grid_shape, block_index)
+    return new, mask
+
+
 def block_step_3d(u, *, mesh_shape, grid_shape, block_index, cx, cy, cz,
                   axis_names=("x", "y", "z"), overlap=True):
     """One sharded 7-point step: exchange, pad, update, mask."""
     del overlap  # 3D uses the padded formulation (see module docstring)
-    halos = exchange_halos_3d(u, mesh_shape, axis_names)
-    new = stencil_interior_3d(_pad_block_3d(u, halos), cx, cy, cz)
-    mask = interior_mask_3d(u.shape, grid_shape, block_index)
+    new, mask = _exchanged_update_3d(u, mesh_shape, grid_shape, block_index,
+                                     cx, cy, cz, axis_names)
     return jnp.where(mask, new.astype(u.dtype), u)
 
 
@@ -73,9 +81,8 @@ def block_step_3d_residual(u, *, mesh_shape, grid_shape, block_index,
                            cx, cy, cz, axis_names=("x", "y", "z"),
                            overlap=True):
     del overlap
-    halos = exchange_halos_3d(u, mesh_shape, axis_names)
-    new = stencil_interior_3d(_pad_block_3d(u, halos), cx, cy, cz)
-    mask = interior_mask_3d(u.shape, grid_shape, block_index)
+    new, mask = _exchanged_update_3d(u, mesh_shape, grid_shape, block_index,
+                                     cx, cy, cz, axis_names)
     diff = jnp.where(mask, jnp.abs(new - u.astype(_ACC)), 0.0)
     res = lax.pmax(jnp.max(diff), axis_names)
     return jnp.where(mask, new.astype(u.dtype), u), res

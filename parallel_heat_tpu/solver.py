@@ -90,19 +90,42 @@ def _dtype_of(config: HeatConfig):
 # Loop construction (shared by single-device and per-shard programs)
 # --------------------------------------------------------------------------
 
-def _make_loop(step, step_residual, config: HeatConfig):
+def steps_to_multistep(step, step_residual):
+    """Lift single-step fns to the ``multi_step(u, k)`` interface.
+
+    Backends that fuse many steps per invocation (the VMEM-resident
+    Pallas kernel) provide ``multi_step`` natively; plain per-step
+    backends get this fori_loop lifting.
+    """
+
+    def multi_step(u, k):
+        return lax.fori_loop(0, k, lambda i, uu: step(uu), u)
+
+    def multi_step_residual(u, k):
+        # k-1 plain steps, then one step with a fused residual — the
+        # residual is the diff of the *last* step of the chunk, matching
+        # the reference's consecutive-buffer check (mpi/...stat.c:245).
+        u = lax.fori_loop(0, k - 1, lambda i, uu: step(uu), u)
+        return step_residual(u)
+
+    return multi_step, multi_step_residual
+
+
+def _make_loop(multi_step, multi_step_residual, config: HeatConfig):
     """Build ``run(u) -> (u, steps_run, converged, residual)``.
 
-    ``step``/``step_residual`` operate on whatever array the caller gives
-    (full grid or shard block); this function only encodes the stepping /
-    convergence policy, so the same loop serves every backend and mesh.
+    ``multi_step(u, k)`` / ``multi_step_residual(u, k)`` (static ``k``)
+    operate on whatever array the caller gives (full grid or shard
+    block); this function only encodes the stepping / convergence
+    policy, so the same loop serves every backend and mesh.
     """
     steps = config.steps
 
     if not config.converge:
 
         def run_fixed(u):
-            u = lax.fori_loop(0, steps, lambda i, uu: step(uu), u)
+            if steps > 0:
+                u = multi_step(u, steps)
             return (u, jnp.int32(steps), jnp.bool_(False),
                     jnp.float32(jnp.nan))
 
@@ -114,20 +137,13 @@ def _make_loop(step, step_residual, config: HeatConfig):
     rem = steps % ci
     full_steps = n_full * ci
 
-    def chunk(u):
-        # ci-1 plain steps, then one step with a fused residual — the
-        # residual is the diff of the *last* step of the chunk, matching
-        # the reference's consecutive-buffer check (mpi/...stat.c:245).
-        u = lax.fori_loop(0, ci - 1, lambda i, uu: step(uu), u)
-        return step_residual(u)
-
     def cond(carry):
         _, k, res = carry
         return (res >= eps) & (k < full_steps)
 
     def body(carry):
         u, k, _ = carry
-        u, res = chunk(u)
+        u, res = multi_step_residual(u, ci)
         return (u, k + ci, res)
 
     def run_converge(u):
@@ -142,7 +158,7 @@ def _make_loop(step, step_residual, config: HeatConfig):
             u = lax.cond(
                 converged,
                 lambda uu: uu,
-                lambda uu: lax.fori_loop(0, rem, lambda i, x: step(x), uu),
+                lambda uu: multi_step(uu, rem),
                 u,
             )
             k = jnp.where(converged, k, k + rem)
@@ -155,22 +171,21 @@ def _make_loop(step, step_residual, config: HeatConfig):
 # Runner builders (cached per config)
 # --------------------------------------------------------------------------
 
-def _single_steps(config: HeatConfig, backend: str):
-    """(step, step_residual) on the full grid for one device."""
-    if backend == "pallas":
+def _single_multistep(config: HeatConfig, backend: str):
+    """(multi_step, multi_step_residual) on the full grid, one device."""
+    if backend == "pallas" and config.ndim == 2:
         from parallel_heat_tpu.ops import pallas_stencil
 
-        if config.ndim == 2:
-            return pallas_stencil.single_grid_steps(config)
-        backend = "jnp"  # 3D pallas: fall back (jnp path is XLA-fused)
+        return pallas_stencil.single_grid_multistep(config)
+    # jnp backend (and the 3D fallback — that path is XLA-fused anyway).
     if config.ndim == 3:
         cx, cy, cz = config.cx, config.cy, config.cz
-        return (
+        return steps_to_multistep(
             lambda u: step_3d(u, cx, cy, cz),
             lambda u: step_3d_residual(u, cx, cy, cz),
         )
     cx, cy = config.cx, config.cy
-    return (
+    return steps_to_multistep(
         lambda u: step_2d(u, cx, cy),
         lambda u: step_2d_residual(u, cx, cy),
     )
@@ -189,8 +204,8 @@ def _build_runner(config: HeatConfig):
     is_sharded = any(d > 1 for d in mesh_shape)
 
     if not is_sharded:
-        step, step_residual = _single_steps(config, backend)
-        run = _make_loop(step, step_residual, config)
+        multi_step, multi_step_residual = _single_multistep(config, backend)
+        run = _make_loop(multi_step, multi_step_residual, config)
         return jax.jit(run, donate_argnums=0), None
 
     if config.ndim == 3:
@@ -206,9 +221,11 @@ def _build_runner(config: HeatConfig):
                       block_index=bidx, cx=config.cx, cy=config.cy,
                       cz=config.cz, axis_names=names,
                       overlap=config.overlap)
-            step = lambda u: halo3d.block_step_3d(u, **kw)
-            stepr = lambda u: halo3d.block_step_3d_residual(u, **kw)
-            return _make_loop(step, stepr, config)(u_local)
+            ms, msr = steps_to_multistep(
+                lambda u: halo3d.block_step_3d(u, **kw),
+                lambda u: halo3d.block_step_3d_residual(u, **kw),
+            )
+            return _make_loop(ms, msr, config)(u_local)
 
         run = _shard_map(
             local_run3, mesh=mesh, in_specs=spec,
@@ -229,15 +246,25 @@ def _build_runner(config: HeatConfig):
         if use_pallas:
             from parallel_heat_tpu.ops import pallas_stencil
 
-            step, stepr = pallas_stencil.block_steps(config, kw)
+            # The pallas block step carries an extended block between
+            # steps; pre/post convert at loop entry/exit.
+            step, stepr, pre, post = pallas_stencil.block_steps(config, kw)
         else:
             step = lambda u: block_step_2d(u, **kw)
             stepr = lambda u: block_step_2d_residual(u, **kw)
-        return _make_loop(step, stepr, config)(u_local)
+            pre = post = lambda u: u
+        ms, msr = steps_to_multistep(step, stepr)
+        u_out, k, c, r = _make_loop(ms, msr, config)(pre(u_local))
+        return post(u_out), k, c, r
 
+    # check_vma off for the pallas backend: pallas_call's internal slices
+    # don't carry varying-manual-axes annotations (notably under the HLO
+    # interpreter). Replication of the scalar outputs is guaranteed by
+    # the pmax in the residual step either way.
     run = _shard_map(
         local_run, mesh=mesh, in_specs=spec,
         out_specs=(spec, P(), P(), P()),
+        check_vma=not use_pallas,
     )
     return jax.jit(run, donate_argnums=0), mesh
 
@@ -281,7 +308,16 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     if initial is None:
         initial = make_initial_grid(config)
     else:
-        initial = jnp.copy(initial)  # runner donates; protect the caller
+        if tuple(initial.shape) != config.shape:
+            raise ValueError(
+                f"initial grid shape {tuple(initial.shape)} does not match "
+                f"config shape {config.shape}"
+            )
+        # Copy (the runner donates its input buffer — protect the caller)
+        # and honor the configured storage dtype (e.g. resuming an f32
+        # checkpoint into a bf16 run).
+        initial = jnp.asarray(initial).astype(_dtype_of(config))
+        initial = jnp.copy(initial)
     initial = jax.block_until_ready(initial)
 
     t0 = time.perf_counter()

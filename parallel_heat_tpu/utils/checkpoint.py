@@ -1,0 +1,54 @@
+"""Checkpoint / resume — a capability gap in the reference (SURVEY.md §5:
+state lives only in the two buffers; output only at the end). Snapshots
+are plain ``.npz`` (grid + step counter + config fingerprint), cheap and
+dependency-free; the grid is gathered to host, so this targets
+operational resume, not in-flight failover.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+from parallel_heat_tpu.config import HeatConfig
+
+_FORMAT_VERSION = 1
+
+
+def save_checkpoint(path, grid, step: int, config: HeatConfig) -> str:
+    """Write a snapshot; returns the actual path written (always .npz —
+    normalized here rather than letting np.savez append it silently)."""
+    path = str(path)
+    if not path.endswith(".npz"):
+        path += ".npz"
+    np.savez_compressed(
+        path,
+        grid=np.asarray(grid),
+        step=np.int64(step),
+        config=np.frombuffer(config.to_json().encode(), dtype=np.uint8),
+        version=np.int64(_FORMAT_VERSION),
+    )
+    return path
+
+
+def load_checkpoint(path, expect_config: HeatConfig | None = None
+                    ) -> Tuple[np.ndarray, int, HeatConfig]:
+    """Returns ``(grid, step, saved_config)``.
+
+    When ``expect_config`` is given, grid geometry must match (other
+    fields — steps, eps, mesh — may legitimately differ on resume).
+    """
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+        grid = z["grid"]
+        step = int(z["step"])
+        saved = HeatConfig.from_json(bytes(z["config"]).decode())
+    if expect_config is not None and saved.shape != expect_config.shape:
+        raise ValueError(
+            f"checkpoint grid {saved.shape} != configured {expect_config.shape}"
+        )
+    return grid, step, saved

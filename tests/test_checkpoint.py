@@ -1,0 +1,37 @@
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def test_roundtrip(tmp_path):
+    cfg = HeatConfig(nx=16, ny=12, steps=7, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "c.npz"
+    save_checkpoint(p, res.grid, res.steps_run, cfg)
+    grid, step, saved = load_checkpoint(p)
+    np.testing.assert_array_equal(grid, res.to_numpy())
+    assert step == 7
+    assert saved.shape == (16, 12)
+
+
+def test_geometry_mismatch_rejected(tmp_path):
+    cfg = HeatConfig(nx=16, ny=12, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "c.npz"
+    save_checkpoint(p, res.grid, 1, cfg)
+    with pytest.raises(ValueError, match="checkpoint grid"):
+        load_checkpoint(p, HeatConfig(nx=8, ny=8))
+
+
+def test_resume_continues_exactly(tmp_path):
+    cfg30 = HeatConfig(nx=16, ny=16, steps=30, backend="jnp")
+    mid = solve(cfg30)
+    p = tmp_path / "c.npz"
+    save_checkpoint(p, mid.grid, 30, cfg30)
+    grid, step, _ = load_checkpoint(p)
+    rest = solve(HeatConfig(nx=16, ny=16, steps=20, backend="jnp"),
+                 initial=grid)
+    direct = solve(HeatConfig(nx=16, ny=16, steps=50, backend="jnp"))
+    np.testing.assert_array_equal(rest.to_numpy(), direct.to_numpy())

@@ -1,0 +1,66 @@
+import numpy as np
+
+from parallel_heat_tpu.cli import main
+from parallel_heat_tpu.utils.io import read_dat
+
+
+def test_cli_fixed_run_writes_dat(tmp_path, capsys):
+    out = tmp_path / "final_im.dat"
+    init = tmp_path / "initial_im.dat"
+    rc = main(["--nx", "20", "--ny", "20", "--steps", "50",
+               "--backend", "jnp", "--out", str(out),
+               "--initial-out", str(init)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Grid size: 20x20  Time steps: 50" in text
+    assert "Elapsed time" in text
+    assert out.exists() and init.exists()
+    assert read_dat(out).shape == (20, 20)
+
+
+def test_cli_converge_reports_steps(capsys):
+    rc = main(["--nx", "20", "--ny", "20", "--steps", "10000",
+               "--converge", "--backend", "jnp"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Converged after" in text
+
+
+def test_cli_mesh_run(capsys):
+    rc = main(["--nx", "32", "--ny", "32", "--steps", "10",
+               "--backend", "jnp", "--mesh", "2,4", "--quiet"])
+    assert rc == 0
+    assert capsys.readouterr().out == ""
+
+
+def test_cli_rejects_bad_config(capsys):
+    rc = main(["--nx", "20", "--ny", "20", "--mesh", "3,1",
+               "--backend", "jnp"])
+    assert rc == 2
+    assert "not divisible" in capsys.readouterr().err
+
+
+def test_cli_3d_npy_output(tmp_path):
+    out = tmp_path / "vol.npy"
+    rc = main(["--nx", "8", "--ny", "8", "--nz", "8", "--steps", "3",
+               "--backend", "jnp", "--out", str(out), "--quiet"])
+    assert rc == 0
+    assert np.load(out).shape == (8, 8, 8)
+
+
+def test_cli_checkpoint_resume_matches_uninterrupted(tmp_path, capsys):
+    ck = tmp_path / "ck.npz"
+    # run 30 steps, checkpointing
+    assert main(["--nx", "16", "--ny", "16", "--steps", "30",
+                 "--backend", "jnp", "--checkpoint", str(ck),
+                 "--quiet"]) == 0
+    # resume to 50 total
+    out = tmp_path / "resumed.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "50",
+                 "--backend", "jnp", "--resume", str(ck),
+                 "--out", str(out), "--quiet"]) == 0
+    # uninterrupted 50
+    out2 = tmp_path / "direct.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "50",
+                 "--backend", "jnp", "--out", str(out2), "--quiet"]) == 0
+    np.testing.assert_array_equal(read_dat(out), read_dat(out2))

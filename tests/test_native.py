@@ -1,0 +1,51 @@
+"""Native C++ I/O runtime vs the Python oracle implementations."""
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.native import binding
+from parallel_heat_tpu.utils.io import _format_dat_python, write_dat
+
+needs_native = pytest.mark.skipif(
+    not binding.available(), reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_native_writer_byte_identical_to_python(tmp_path):
+    rng = np.random.default_rng(0)
+    cases = [
+        (rng.standard_normal((13, 7)) * 100).astype(np.float32),
+        np.array([[1234567.0, -0.04, 2.25]], dtype=np.float32),
+        HeatPlate2D(64, 64).init_grid_np(np.float32),
+    ]
+    for i, u in enumerate(cases):
+        p = tmp_path / f"n{i}.dat"
+        binding.write_dat(p, u)
+        assert p.read_bytes() == _format_dat_python(u).encode()
+
+
+@needs_native
+def test_write_dat_prefers_native_and_matches(tmp_path):
+    u = (np.random.default_rng(1).standard_normal((33, 17)) * 50).astype(
+        np.float32
+    )
+    p1, p2 = tmp_path / "a.dat", tmp_path / "b.dat"
+    write_dat(p1, u, use_native=True)
+    write_dat(p2, u, use_native=False)
+    assert p1.read_bytes() == p2.read_bytes()
+
+
+@needs_native
+def test_native_init_matches_model():
+    got = binding.init_grid(100, 80)
+    want = HeatPlate2D(100, 80).init_grid_np(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_native_writer_error_on_bad_path():
+    u = np.zeros((3, 3), dtype=np.float32)
+    with pytest.raises(OSError):
+        binding.write_dat("/nonexistent-dir/x.dat", u)
