@@ -19,7 +19,12 @@ BASELINE_MCELLS_PER_S = 3556.0  # derived in BASELINE.md / SURVEY.md §6
 
 
 def _bench_config(cfg, repeats=3):
-    """Best wall-clock over `repeats` timed runs (first compile excluded)."""
+    """Best step-loop wall-clock over `repeats` runs (compile excluded).
+
+    Uses ``HeatResult.elapsed_s``, which brackets exactly the jitted
+    step loop — the same scope as the reference's timers
+    (``cuda/cuda_heat.cu:203,239`` around the kernel loop only).
+    """
     import jax
 
     from parallel_heat_tpu import solve
@@ -29,10 +34,8 @@ def _bench_config(cfg, repeats=3):
     solve(cfg, initial=u0)  # compile + warm up
     best = float("inf")
     for _ in range(repeats):
-        t0 = time.perf_counter()
         res = solve(cfg, initial=u0)
-        jax.block_until_ready(res.grid)
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, res.elapsed_s)
     return best, res
 
 

@@ -61,7 +61,8 @@ def fits_vmem(shape: Tuple[int, int], dtype) -> bool:
 # --------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=32)
-def _build_vmem_multistep(shape, dtype_name, cx, cy, k):
+def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
+                          strip_rows=128):
     """K steps fully in VMEM; returns ``fn(u) -> (u', residual)``.
 
     The residual is the interior max-norm of the *last* step's update —
@@ -80,7 +81,7 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k):
     # Interior row strips (static): bounding the per-strip temporaries to
     # (R+2) x N keeps Mosaic's scoped-VMEM footprint at the two grid
     # buffers plus ~1 strip, instead of several full-grid intermediates.
-    R = 128
+    R = strip_rows
     strips = []
     r0 = 1
     while r0 < M - 1:

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Sweep kernel-A strip heights on the real chip (stage-8 tuning aid).
+
+Run from the repo root: ``python tools/tune_vmem_kernel.py``.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from parallel_heat_tpu.models import HeatPlate2D  # noqa: E402
+from parallel_heat_tpu.ops import pallas_stencil as ps  # noqa: E402
+
+
+def bench(shape, r, k=1000, reps=3):
+    u = HeatPlate2D(*shape).init_grid(jnp.float32)
+    fn = ps._build_vmem_multistep(shape, "float32", 0.1, 0.1, k,
+                                  strip_rows=r)
+    run = jax.jit(lambda x: fn(x)[0], donate_argnums=0)
+    u = jax.block_until_ready(run(u))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        u = jax.block_until_ready(run(u))
+        best = min(best, time.perf_counter() - t0)
+    cells = shape[0] * shape[1]
+    print(f"shape={shape} R={r:4d}: {best*1e6/k:8.2f} us/step  "
+          f"{cells*k/best/1e9:8.1f} Gcells*steps/s")
+    return best
+
+
+if __name__ == "__main__":
+    for shape in [(1000, 1000), (1024, 1024)]:
+        for r in [64, 128, 248, 256, 504, 512]:
+            if shape[0] % 8 == 0 and r > shape[0]:
+                continue
+            try:
+                bench(shape, r)
+            except Exception as e:
+                print(f"shape={shape} R={r}: FAILED {repr(e)[:120]}")
