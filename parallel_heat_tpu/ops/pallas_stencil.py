@@ -373,6 +373,9 @@ def single_grid_multistep(config):
     from parallel_heat_tpu.solver import steps_to_multistep
 
     built = _build_strip_kernel(shape, dtype, cx, cy, shape, sharded=False)
+    if built is None:  # rows too wide to stream whole: 2D-tiled kernel
+        built = _build_tiled_kernel(shape, dtype, cx, cy, shape,
+                                    sharded=False)
     if built is None:  # awkward geometry: XLA-fused fallback
         return steps_to_multistep(
             lambda u: step_2d(u, cx, cy),
@@ -433,10 +436,13 @@ def block_steps(config, kw):
     # neighbor (core[:, 1] / core[:, -2]); single-column blocks take the
     # jnp halo path (whose padded formulation handles them).
     if by >= 2:
-        built = _build_strip_kernel(
-            (bx, by), config.dtype, float(config.cx), float(config.cy),
-            config.shape, sharded=True, vma=tuple(kw["axis_names"]),
-        )
+        args = ((bx, by), config.dtype, float(config.cx), float(config.cy),
+                config.shape)
+        built = _build_strip_kernel(*args, sharded=True,
+                                    vma=tuple(kw["axis_names"]))
+        if built is None:
+            built = _build_tiled_kernel(*args, sharded=True,
+                                        vma=tuple(kw["axis_names"]))
     else:
         built = None
     ident = lambda u: u
@@ -485,3 +491,345 @@ def block_steps(config, kw):
         return new_ext, lax.pmax(local_res, axis_names)
 
     return step, step_residual, pre, post
+
+
+# --------------------------------------------------------------------------
+# Kernel C: 2D-tiled streaming step (wide grids)
+# --------------------------------------------------------------------------
+
+_LANE = 128  # lane tiling granularity (all dtypes)
+
+
+def _pick_tile_2d(out_rows: int, n_cols: int, dtype, sharded: bool):
+    """(T, CW) for the 2D-tiled kernel, or None.
+
+    Both axes are DMA-windowed, so column width no longer caps the strip
+    height: scratch is 2*(T+4*SUB)*(CW+4*LANE), plus the double-buffered
+    (T, CW) output and (for sub-f32 storage) the f32 cast temporaries.
+    Requires at least 2 column chunks — narrower grids take kernel B.
+    """
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    budget = 13 * 1024 * 1024
+    best = None
+    for cw in (1024, 2048, 4096):
+        if n_cols % cw != 0 or n_cols // cw < 2:
+            continue
+        t_max = 512 if sharded else min(512, out_rows - 2 * sub)
+        for t in range(sub, t_max + 1, sub):
+            if out_rows % t != 0:
+                continue
+            cost = (2 * (t + 4 * sub) * (cw + 4 * _LANE) + 2 * t * cw) \
+                * itemsize
+            if itemsize < 4:
+                cost += 5 * t * cw * 4
+            if cost <= budget and (best is None or t * cw > best[0] * best[1]):
+                best = (t, cw)
+    return best
+
+
+@functools.lru_cache(maxsize=32)
+def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
+                        sharded, vma=None):
+    """One fused Jacobi step over 2D DMA-windowed tiles.
+
+    The generalization of the strip kernel for grids too wide to stream
+    full rows: each (T, CW) output tile fetches a window with SUB-row /
+    LANE-column halos, clamped by whole tiles at the edges with the
+    destination offset compensating (same alignment scheme as kernel B,
+    applied to both axes). Lateral neighbors come from the window — no
+    rolls at all. Sharded mode mirrors kernel B: extended input rows
+    carry the ppermuted halo rows; block-edge columns are the caller's
+    epilogue.
+
+    Returns ``(fn, SUB)`` or None when the geometry doesn't tile.
+    """
+    O, N = core_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    tile = _pick_tile_2d(O, N, dtype, sharded)
+    if tile is None:
+        return None
+    T, CW = tile
+    n_rows = O // T
+    n_cols = N // CW
+    WR = T + 2 * SUB            # window rows
+    WC = CW + 2 * _LANE         # window cols
+    C0R = 2 * SUB               # scratch row of tile row 0
+    C0C = 2 * _LANE             # scratch col of tile col 0
+
+    def kernel(offs_ref, u_hbm, out_ref, res_ref, scratch, sems):
+        s = pl.program_id(0)
+        c = pl.program_id(1)
+        nr = pl.num_programs(0)
+        nc = pl.num_programs(1)
+        idx = s * nc + c
+
+        def dma(slot, sr, sc):
+            if sharded:
+                row_start = pl.multiple_of(sr * T, SUB)
+                row_dst = SUB
+            else:
+                row_start = pl.multiple_of(
+                    jnp.clip(sr * T - SUB, 0, O - WR), SUB)
+                row_dst = pl.multiple_of(C0R + row_start - sr * T, SUB)
+            col_start = pl.multiple_of(
+                jnp.clip(sc * CW - _LANE, 0, N - WC), _LANE)
+            col_dst = pl.multiple_of(C0C + col_start - sc * CW, _LANE)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(row_start, WR), pl.ds(col_start, WC)],
+                scratch.at[slot, pl.ds(row_dst, WR), pl.ds(col_dst, WC)],
+                sems.at[slot],
+            )
+
+        @pl.when(idx == 0)
+        def _():
+            dma(0, 0, 0).start()
+
+        @pl.when(idx + 1 < nr * nc)
+        def _():
+            c1 = c + 1
+            s_next = jnp.where(c1 < nc, s, s + 1)
+            c_next = jnp.where(c1 < nc, c1, 0)
+            dma((idx + 1) % 2, s_next, c_next).start()
+
+        slot = lax.rem(idx, 2)
+        dma(slot, s, c).wait()
+
+        sl = scratch.at[slot]
+        U = sl[C0R - 1:C0R - 1 + T, C0C:C0C + CW].astype(_ACC)
+        C = sl[C0R:C0R + T, C0C:C0C + CW].astype(_ACC)
+        D = sl[C0R + 1:C0R + 1 + T, C0C:C0C + CW].astype(_ACC)
+        Lf = sl[C0R:C0R + T, C0C - 1:C0C - 1 + CW].astype(_ACC)
+        Rt = sl[C0R:C0R + T, C0C + 1:C0C + 1 + CW].astype(_ACC)
+        new = (C + cx * (U + D - 2.0 * C) + cy * (Lf + Rt - 2.0 * C))
+
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+        rows_g = (row_off + s * T
+                  + lax.broadcasted_iota(jnp.int32, (T, CW), 0))
+        cols_l = (c * CW
+                  + lax.broadcasted_iota(jnp.int32, (T, CW), 1))
+        cols_g = col_off + cols_l
+        interior = ((rows_g >= 1) & (rows_g <= NX - 2)
+                    & (cols_g >= 1) & (cols_g <= NY - 2))
+        if sharded:
+            interior = interior & (cols_l >= 1) & (cols_l <= N - 2)
+
+        out_ref[:] = jnp.where(interior, new, C).astype(dtype)
+
+        partial = jnp.max(jnp.where(interior, jnp.abs(new - C), 0.0))
+
+        @pl.when(idx == 0)
+        def _():
+            res_ref[0, 0] = partial
+
+        @pl.when(idx > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], partial)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_rows, n_cols),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((T, CW), lambda s, c, offs: (s, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s, c, offs: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, T + 4 * SUB, CW + 4 * _LANE), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((O, N), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )
+
+    def fn(u, row_off, col_off):
+        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+        new, res = call(offs, u)
+        return new, res[0, 0]
+
+    return fn, SUB
+
+
+# --------------------------------------------------------------------------
+# Kernel D: 3D slab streaming (7-point)
+# --------------------------------------------------------------------------
+
+def _pick_slab_3d(shape, dtype):
+    """(SX, TY) for the 3D kernel, or None.
+
+    X slabs (leading, untiled dim — windows need no alignment) crossed
+    with Y strips (sublane dim — SUB-aligned windows); Z stays whole
+    (lane dim). Maximizes window efficiency SX*TY / ((SX+2)*(TY+2*SUB))
+    under the VMEM budget.
+    """
+    X, Y, Z = shape
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    budget = 12 * 1024 * 1024
+    if Z % _LANE != 0:
+        # The slab DMA copies whole-Z panes; Mosaic requires lane-dim
+        # slice extents to be 128-aligned. Smaller/odd Z: jnp fallback.
+        return None
+    best = None
+    best_eff = 0.0
+    for sx in (2, 4, 8, 16, 32):
+        if X % sx != 0 or sx > X - 2:  # clamped windows need X >= SX+2
+            continue
+        for ty in range(sub, min(Y - 2 * sub, 256) + 1, sub):
+            if Y % ty != 0:
+                continue
+            cost = (2 * (sx + 4) * (ty + 4 * sub) * Z * itemsize
+                    + 2 * sx * ty * Z * itemsize
+                    + 6 * sx * ty * Z * 4)
+            if cost > budget:
+                continue
+            eff = (sx * ty) / ((sx + 2) * (ty + 2 * sub))
+            if eff > best_eff:
+                best_eff, best = eff, (sx, ty)
+    return best
+
+
+@functools.lru_cache(maxsize=16)
+def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
+    """One fused 7-point step over DMA-pipelined (SX, TY, Z) slabs.
+
+    Single-device only (the 3D sharded path uses the jnp halo layer).
+    Same alignment scheme as kernels B/C: the X axis is untiled so its
+    +-1 halo windows clamp freely; the Y axis clamps by whole SUB blocks
+    with destination-offset compensation; Z neighbors come from masked
+    lane rolls. Returns ``fn(u) -> (new, residual)`` or None.
+    """
+    X, Y, Z = shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    pick = _pick_slab_3d(shape, dtype)
+    if pick is None or X < 3 or Y < 3:
+        return None
+    SX, TY = pick
+    n_x = X // SX
+    n_y = Y // TY
+    WX = SX + 2
+    WY = TY + 2 * SUB
+    C0Y = 2 * SUB
+
+    def kernel(u_hbm, out_ref, res_ref, scratch, sems):
+        sx = pl.program_id(0)
+        sy = pl.program_id(1)
+        nx_p = pl.num_programs(0)
+        ny_p = pl.num_programs(1)
+        idx = sx * ny_p + sy
+
+        def dma(slot, px, py):
+            x_start = jnp.clip(px * SX - 1, 0, X - WX)
+            x_dst = 2 + x_start - px * SX  # leading dim: no alignment
+            y_start = pl.multiple_of(
+                jnp.clip(py * TY - SUB, 0, Y - WY), SUB)
+            y_dst = pl.multiple_of(C0Y + y_start - py * TY, SUB)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(x_start, WX), pl.ds(y_start, WY), :],
+                scratch.at[slot, pl.ds(x_dst, WX), pl.ds(y_dst, WY), :],
+                sems.at[slot],
+            )
+
+        @pl.when(idx == 0)
+        def _():
+            dma(0, 0, 0).start()
+
+        @pl.when(idx + 1 < nx_p * ny_p)
+        def _():
+            y1 = sy + 1
+            px = jnp.where(y1 < ny_p, sx, sx + 1)
+            py = jnp.where(y1 < ny_p, y1, 0)
+            dma((idx + 1) % 2, px, py).start()
+
+        slot = lax.rem(idx, 2)
+        dma(slot, sx, sy).wait()
+
+        sl = scratch.at[slot]
+        C = sl[2:2 + SX, C0Y:C0Y + TY, :].astype(_ACC)
+        Xm = sl[1:1 + SX, C0Y:C0Y + TY, :].astype(_ACC)
+        Xp = sl[3:3 + SX, C0Y:C0Y + TY, :].astype(_ACC)
+        Ym = sl[2:2 + SX, C0Y - 1:C0Y - 1 + TY, :].astype(_ACC)
+        Yp = sl[2:2 + SX, C0Y + 1:C0Y + 1 + TY, :].astype(_ACC)
+        Zm = jnp.roll(C, 1, axis=2)
+        Zp = jnp.roll(C, -1, axis=2)
+        new = (C
+               + cx * (Xm + Xp - 2.0 * C)
+               + cy * (Ym + Yp - 2.0 * C)
+               + cz * (Zm + Zp - 2.0 * C))
+
+        xs = (sx * SX
+              + lax.broadcasted_iota(jnp.int32, (SX, TY, Z), 0))
+        ys = (sy * TY
+              + lax.broadcasted_iota(jnp.int32, (SX, TY, Z), 1))
+        zs = lax.broadcasted_iota(jnp.int32, (SX, TY, Z), 2)
+        interior = ((xs >= 1) & (xs <= X - 2)
+                    & (ys >= 1) & (ys <= Y - 2)
+                    & (zs >= 1) & (zs <= Z - 2))
+
+        out_ref[:] = jnp.where(interior, new, C).astype(dtype)
+        partial = jnp.max(jnp.where(interior, jnp.abs(new - C), 0.0))
+
+        @pl.when(idx == 0)
+        def _():
+            res_ref[0, 0] = partial
+
+        @pl.when(idx > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], partial)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_x, n_y),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((SX, TY, Z), lambda sx, sy: (sx, sy, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda sx, sy: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((X, Y, Z), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SX + 4, TY + 4 * SUB, Z), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return new, res[0, 0]
+
+    return fn
+
+
+def single_grid_multistep_3d(config):
+    """``(multi_step, multi_step_residual)`` for one device, 3D."""
+    from parallel_heat_tpu.ops.stencil import step_3d, step_3d_residual
+    from parallel_heat_tpu.solver import steps_to_multistep
+
+    cx, cy, cz = (float(config.cx), float(config.cy), float(config.cz))
+    fn = _build_slab_kernel_3d(config.shape, config.dtype, cx, cy, cz)
+    if fn is None:
+        return steps_to_multistep(
+            lambda u: step_3d(u, cx, cy, cz),
+            lambda u: step_3d_residual(u, cx, cy, cz),
+        )
+    return steps_to_multistep(lambda u: fn(u)[0], lambda u: fn(u))

@@ -1,0 +1,93 @@
+"""Multi-host distributed runtime — the reference's MPI-over-LAN analog.
+
+The reference scales across machines with ``mpirun`` + MPI over the lab
+network (``mpi/mpi_heat_improved_persistent_stat.c:48-50``; report §5
+ran up to 10 machines). The TPU-native equivalent is the XLA collectives
+runtime: intra-pod traffic rides ICI, cross-host traffic rides DCN, and
+all of it is driven by the same ``shard_map``/``ppermute`` code that
+runs single-host — only the mesh construction changes.
+
+Usage on each host of a multi-host deployment::
+
+    from parallel_heat_tpu.parallel import distributed as dist
+    dist.initialize()                    # env-driven (GKE/TPU VM) or
+    dist.initialize(coordinator_address="host0:1234",
+                    num_processes=4, process_id=rank)  # explicit
+    mesh_shape = dist.suggest_mesh_shape(ndim=2)
+    result = solve(config.replace(mesh_shape=mesh_shape))
+    grid = dist.gather_to_host(result.grid)  # only if it fits on host
+
+Single-host runs need none of this — ``solve`` works directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from parallel_heat_tpu.parallel.mesh import pick_mesh_shape
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    With no arguments, relies on environment auto-detection (TPU VMs /
+    GKE set the coordinator automatically). Replaces ``MPI_Init`` +
+    ``MPI_Comm_rank``/``size`` (``mpi/...stat.c:48-50``).
+    """
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if not kwargs and jax.device_count() == jax.local_device_count():
+        # Single-process, nothing to join; stay uninitialized so local
+        # runs don't require a coordinator.
+        _initialized = True
+        return
+    jax.distributed.initialize(**kwargs)  # pragma: no cover (multi-host)
+    _initialized = True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_id, process_count) — the rank/size analog."""
+    return jax.process_index(), jax.process_count()
+
+
+def suggest_mesh_shape(ndim: int = 2) -> Tuple[int, ...]:
+    """Factor *all* addressable devices (across hosts) into a mesh.
+
+    The multi-host ``MPI_Dims_create``: uses the global device count, so
+    the resulting mesh spans hosts; XLA routes the halo ppermutes over
+    ICI within a pod slice and DCN across slices.
+    """
+    return pick_mesh_shape(jax.device_count(), ndim)
+
+
+def gather_to_host(x) -> np.ndarray:
+    """Gather a (possibly multi-host sharded) array to host memory.
+
+    Single-host shardings gather directly; cross-host shardings go
+    through ``process_allgather`` (the analog of the reference's master
+    gather, ``mpi/...stat.c:279-297`` — but only ever used for final
+    output, never inside the step loop).
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    return np.asarray(
+        multihost_utils.process_allgather(x, tiled=True)
+    )  # pragma: no cover

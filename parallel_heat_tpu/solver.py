@@ -173,11 +173,12 @@ def _make_loop(multi_step, multi_step_residual, config: HeatConfig):
 
 def _single_multistep(config: HeatConfig, backend: str):
     """(multi_step, multi_step_residual) on the full grid, one device."""
-    if backend == "pallas" and config.ndim == 2:
+    if backend == "pallas":
         from parallel_heat_tpu.ops import pallas_stencil
 
-        return pallas_stencil.single_grid_multistep(config)
-    # jnp backend (and the 3D fallback — that path is XLA-fused anyway).
+        if config.ndim == 2:
+            return pallas_stencil.single_grid_multistep(config)
+        return pallas_stencil.single_grid_multistep_3d(config)
     if config.ndim == 3:
         cx, cy, cz = config.cx, config.cy, config.cz
         return steps_to_multistep(

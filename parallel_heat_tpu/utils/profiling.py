@@ -1,0 +1,113 @@
+"""Profiling — the analog of the reference's Paraver trace study.
+
+The reference's report dedicates a section (Heat.pdf §7) to Paraver
+traces of the MPI runs: blocking-send phases, per-step communication
+cost, the Allreduce stall pattern. The TPU-native equivalents:
+
+- :func:`trace`: wrap any region in a ``jax.profiler`` trace viewable
+  in Perfetto/XProf/TensorBoard — kernel timeline, DMA, collectives.
+- :func:`step_stats`: cheap quantitative summary (steps/sec,
+  Mcells*steps/sec, effective HBM GB/s) without a trace viewer.
+
+On transports with deeply asynchronous dispatch, ``block_until_ready``
+alone may under-synchronize; :func:`sync` forces a device->host read,
+which is a true pipeline flush (used by bench.py between repetitions).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+
+import jax
+
+
+def sync(x) -> None:
+    """True synchronization: a device->host read of one element."""
+    jax.block_until_ready(x)
+    float(x.ravel()[0])
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, sync_on=None):
+    """``jax.profiler`` trace context; view with TensorBoard/XProf.
+
+    ``sync_on``: optional array to synchronize on before the trace ends,
+    so the traced region contains the full computation.
+    """
+    with jax.profiler.trace(str(log_dir)):
+        yield
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+
+
+@dataclass
+class StepStats:
+    """Throughput summary of a timed run."""
+
+    cells: int
+    steps: int
+    elapsed_s: float
+    bytes_per_cell: int = 8  # one read + one write of f32 per step
+
+    @property
+    def steps_per_s(self) -> float:
+        return self.steps / self.elapsed_s
+
+    @property
+    def mcells_steps_per_s(self) -> float:
+        return self.cells * self.steps / self.elapsed_s / 1e6
+
+    @property
+    def effective_hbm_gb_s(self) -> float:
+        """Lower bound on achieved HBM bandwidth for a streaming step."""
+        return (self.cells * self.bytes_per_cell * self.steps
+                / self.elapsed_s / 1e9)
+
+    def summary(self) -> str:
+        return (f"{self.steps} steps on {self.cells} cells in "
+                f"{self.elapsed_s:.4f}s: "
+                f"{self.mcells_steps_per_s:,.0f} Mcells*steps/s, "
+                f"{self.steps_per_s:,.0f} steps/s, "
+                f">= {self.effective_hbm_gb_s:.0f} GB/s effective")
+
+
+def step_stats(result, config) -> StepStats:
+    """Build :class:`StepStats` from a solver result + config."""
+    cells = 1
+    for n in config.shape:
+        cells *= n
+    import jax.numpy as jnp
+
+    return StepStats(
+        cells=cells,
+        steps=max(result.steps_run, 1),
+        elapsed_s=result.elapsed_s,
+        bytes_per_cell=2 * jnp.dtype(config.dtype).itemsize,
+    )
+
+
+class Timeline:
+    """Lightweight phase timer for driver-level instrumentation
+    (the ``MPI_Wtime`` bracketing of the reference, ``mpi/...stat.c:88``,
+    generalized to named phases)."""
+
+    def __init__(self):
+        self.phases: list[tuple[str, float]] = []
+        self._t0 = time.perf_counter()
+
+    def mark(self, name: str, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        now = time.perf_counter()
+        dt = now - self._t0
+        self.phases.append((name, dt))
+        self._t0 = now
+        return dt
+
+    def summary(self) -> str:
+        total = sum(dt for _, dt in self.phases)
+        lines = [f"  {name:<24s} {dt:9.4f}s ({dt/total*100:5.1f}%)"
+                 for name, dt in self.phases]
+        return "\n".join(lines + [f"  {'total':<24s} {total:9.4f}s"])

@@ -1,0 +1,61 @@
+"""Auxiliary subsystems: profiling helpers, distributed runtime wrapper."""
+
+import numpy as np
+
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.parallel import distributed as dist
+from parallel_heat_tpu.utils.profiling import (
+    StepStats,
+    Timeline,
+    step_stats,
+    sync,
+    trace,
+)
+
+
+def test_step_stats_summary():
+    cfg = HeatConfig(nx=32, ny=32, steps=10, backend="jnp")
+    res = solve(cfg)
+    st = step_stats(res, cfg)
+    assert st.cells == 1024 and st.steps == 10
+    assert st.mcells_steps_per_s > 0
+    assert "steps/s" in st.summary()
+
+
+def test_stats_bf16_bytes():
+    cfg = HeatConfig(nx=32, ny=32, steps=4, dtype="bfloat16", backend="jnp")
+    st = step_stats(solve(cfg), cfg)
+    assert st.bytes_per_cell == 4  # read+write of 2-byte cells
+
+
+def test_trace_writes_profile(tmp_path):
+    cfg = HeatConfig(nx=16, ny=16, steps=3, backend="jnp")
+    with trace(tmp_path / "prof"):
+        res = solve(cfg)
+    sync(res.grid)
+    files = list((tmp_path / "prof").rglob("*"))
+    assert files, "profiler trace produced no files"
+
+
+def test_timeline():
+    tl = Timeline()
+    tl.mark("init")
+    tl.mark("run")
+    s = tl.summary()
+    assert "init" in s and "run" in s and "total" in s
+
+
+def test_distributed_single_process():
+    dist.initialize()  # no-op single process
+    pid, count = dist.process_info()
+    assert pid == 0 and count == 1
+    shape = dist.suggest_mesh_shape(2)
+    assert len(shape) == 2 and shape[0] * shape[1] == 8  # 8 CPU devices
+
+
+def test_gather_to_host_single_process():
+    cfg = HeatConfig(nx=16, ny=16, steps=2, backend="jnp",
+                     mesh_shape=(2, 4))
+    res = solve(cfg)
+    arr = dist.gather_to_host(res.grid)
+    assert isinstance(arr, np.ndarray) and arr.shape == (16, 16)
