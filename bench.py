@@ -29,6 +29,7 @@ def _bench_config(cfg, repeats=3):
 
     from parallel_heat_tpu import solve
     from parallel_heat_tpu.solver import make_initial_grid
+    from parallel_heat_tpu.utils.profiling import sync
 
     u0 = jax.block_until_ready(make_initial_grid(cfg))
     solve(cfg, initial=u0)  # compile + warm up
@@ -38,8 +39,7 @@ def _bench_config(cfg, repeats=3):
         # Force a device->host read between reps: on some transports
         # (axon tunnel) this is the only true pipeline flush, keeping
         # one rep's compute from bleeding into the next rep's timing.
-        # (Element indexing — ravel() would materialize a grid copy.)
-        float(res.grid[(0,) * res.grid.ndim])
+        sync(res.grid)
         best = min(best, res.elapsed_s)
     return best, res
 

@@ -42,8 +42,17 @@ def initialize(coordinator_address: Optional[str] = None,
     ``MPI_Comm_rank``/``size`` (``mpi/...stat.c:48-50``).
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
+        return
+    # IMPORTANT: do not touch jax.process_count()/device_count() here —
+    # querying them initializes the local XLA backend, after which
+    # jax.distributed.initialize() raises (explicit args) or silently
+    # no-ops into a single-host run (env-driven args). Check the
+    # distributed client state directly instead.
+    from jax._src import distributed as _jax_dist
+
+    if _jax_dist.global_state.client is not None:
+        _initialized = True  # someone already initialized the runtime
         return
     kwargs = {}
     if coordinator_address is not None:
@@ -52,13 +61,44 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    if not kwargs and jax.device_count() == jax.local_device_count():
+    if not kwargs and _single_process_env():
         # Single-process, nothing to join; stay uninitialized so local
         # runs don't require a coordinator.
         _initialized = True
         return
     jax.distributed.initialize(**kwargs)  # pragma: no cover (multi-host)
     _initialized = True
+
+
+def _single_process_env() -> bool:
+    """True when the environment names no multi-process coordinator.
+
+    Reads only env vars (never jax device/process APIs, which would
+    initialize the backend prematurely). Covers JAX's own auto-detect
+    sources: explicit JAX_COORDINATOR_ADDRESS, and the cluster
+    environments JAX ships detectors for (TPU pod metadata is not
+    env-visible, so TPU-VM users on pods should pass explicit args or
+    call jax.distributed.initialize() themselves first).
+    """
+    import os
+
+    markers = (
+        "JAX_COORDINATOR_ADDRESS",   # jax explicit env override
+        "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+        "OMPI_MCA_orte_hnp_uri",     # OpenMPI
+    )
+    if any(os.environ.get(m) for m in markers):
+        return False
+    # Count-valued markers: present even on single-host setups (e.g.
+    # TPU_WORKER_HOSTNAMES=localhost on a 1-worker TPU VM), so only a
+    # count > 1 means multi-process.
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")  # GkeTpuCluster
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return False
+    if os.environ.get("SLURM_JOB_NUM_NODES", "1").strip() not in ("", "1"):
+        return False
+    return True
 
 
 def process_info() -> Tuple[int, int]:

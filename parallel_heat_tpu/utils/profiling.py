@@ -24,22 +24,34 @@ import jax
 
 
 def sync(x) -> None:
-    """True synchronization: a device->host read of one element."""
+    """True synchronization: a device->host read of one element.
+
+    Element indexing, not ``ravel()[0]`` — ravel would materialize a
+    full copy of the grid just to read one value.
+    """
+    x = getattr(x, "grid", x)  # accept a HeatResult directly
     jax.block_until_ready(x)
-    float(x.ravel()[0])
+    float(x[(0,) * x.ndim])
 
 
 @contextlib.contextmanager
-def trace(log_dir: str, sync_on=None):
+def trace(log_dir: str):
     """``jax.profiler`` trace context; view with TensorBoard/XProf.
 
-    ``sync_on``: optional array to synchronize on before the trace ends,
-    so the traced region contains the full computation.
+    Yields a one-argument callable: pass it the result array (produced
+    *inside* the region) and it synchronizes before the trace closes, so
+    the profile contains the full device computation, not just its
+    dispatch::
+
+        with trace("/tmp/prof") as done:
+            res = solve(cfg)
+            done(res.grid)
     """
+    targets = []
     with jax.profiler.trace(str(log_dir)):
-        yield
-        if sync_on is not None:
-            jax.block_until_ready(sync_on)
+        yield targets.append
+        for t in targets:
+            jax.block_until_ready(t)
 
 
 @dataclass

@@ -30,9 +30,11 @@ def test_stats_bf16_bytes():
 
 def test_trace_writes_profile(tmp_path):
     cfg = HeatConfig(nx=16, ny=16, steps=3, backend="jnp")
-    with trace(tmp_path / "prof"):
+    with trace(tmp_path / "prof") as done:
         res = solve(cfg)
-    sync(res.grid)
+        done(res.grid)
+    sync(res.grid)  # also exercises the element-indexed flush
+    sync(res)       # and the HeatResult overload
     files = list((tmp_path / "prof").rglob("*"))
     assert files, "profiler trace produced no files"
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Scaling study — the analog of the reference report's benchmark tables.
+
+The reference's Heat.pdf measures wall-clock across grid sizes and
+machine counts and derives speedup/efficiency (Tables 1-4, pp.5-7:
+size sweep 20..1000 x {1,10} machines; weak-ish scaling 1..10 machines).
+This tool reproduces that methodology for the TPU build: it sweeps
+grid sizes x mesh shapes over whatever devices JAX exposes, times the
+jitted step loop only (the reference's timer scope), and prints the
+speedup/efficiency table plus one JSON line per cell.
+
+Run on a real pod as-is, or methodology-check on a virtual CPU mesh:
+
+    python tools/scaling_study.py --cpu-devices 8 --sizes 128,256,512 \
+        --meshes 1x1,2x2,2x4 --steps 200 --backend jnp
+
+Speedup for mesh M at size S = T(first mesh, S) / T(M, S); efficiency =
+speedup / (devices(M) / devices(first mesh)) — the report's definitions
+(Heat.pdf p.5). CPU-mesh numbers validate the harness and communication
+structure, not TPU performance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_mesh(s: str):
+    return tuple(int(p) for p in s.replace("x", ",").split(",") if p)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="128,256,512",
+                    help="comma-separated square grid sizes")
+    ap.add_argument("--meshes", default="1x1,2x2,2x4",
+                    help="comma-separated mesh shapes (dxXdy), first is the "
+                         "speedup baseline")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--converge", action="store_true")
+    ap.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                    help="run on N virtual CPU devices (env vars are "
+                         "overridden by a pinned TPU platform; this uses "
+                         "jax.config, which works pre-initialization)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import make_initial_grid
+    from parallel_heat_tpu.utils.profiling import sync
+
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    meshes = [parse_mesh(m) for m in args.meshes.split(",") if m]
+    n_dev = len(jax.devices())
+    usable = [m for m in meshes if _prod(m) <= n_dev]
+    skipped = [m for m in meshes if _prod(m) > n_dev]
+    if skipped:
+        print(f"# skipping meshes needing more than {n_dev} devices: "
+              f"{skipped}", file=sys.stderr)
+    if not usable:
+        raise SystemExit(f"no requested mesh fits the {n_dev} visible devices")
+
+    times: dict[tuple, float] = {}
+    for mesh in usable:
+        for size in sizes:
+            cfg = HeatConfig(
+                nx=size, ny=size, steps=args.steps, dtype=args.dtype,
+                backend=args.backend, converge=args.converge,
+                mesh_shape=None if _prod(mesh) == 1 else mesh,
+            ).validate()
+            u0 = jax.block_until_ready(make_initial_grid(cfg))
+            solve(cfg, initial=u0)  # compile + warm up
+            best = float("inf")
+            for _ in range(max(1, args.repeats)):
+                res = solve(cfg, initial=u0)
+                sync(res.grid)  # pipeline flush between reps
+                best = min(best, res.elapsed_s)
+            times[(mesh, size)] = best
+            base = times[(usable[0], size)]
+            devs = _prod(mesh)
+            base_devs = _prod(usable[0])
+            speedup = base / best
+            print(json.dumps({
+                "mesh": "x".join(map(str, mesh)), "devices": devs,
+                "size": size, "steps": res.steps_run,
+                "wall_s": round(best, 5),
+                "mcells_steps_per_s": round(
+                    size * size * res.steps_run / best / 1e6, 1),
+                "speedup": round(speedup, 3),
+                "efficiency": round(speedup / (devs / base_devs), 3),
+            }))
+            sys.stdout.flush()
+
+    # Reference-style table: configs as rows, sizes as columns.
+    w = max(8, *(len(str(s)) for s in sizes))
+    hdr = "| config      | " + " | ".join(f"{s:>{w}}" for s in sizes) + " |"
+    print("\n" + hdr)
+    print("|" + "-" * 13 + ("|" + "-" * (w + 2)) * len(sizes) + "|")
+    for mesh in usable:
+        name = f"mesh {'x'.join(map(str, mesh))}"
+        row = [f"{times[(mesh, s)]:>{w}.4f}" for s in sizes]
+        print(f"| {name:<11} | " + " | ".join(row) + " |")
+    last = usable[-1]
+    if _prod(last) > _prod(usable[0]):
+        sp = [times[(usable[0], s)] / times[(last, s)] for s in sizes]
+        print(f"| {'speedup':<11} | "
+              + " | ".join(f"{v:>{w}.3f}" for v in sp) + " |")
+        ratio = _prod(last) / _prod(usable[0])
+        print(f"| {'efficiency':<11} | "
+              + " | ".join(f"{v / ratio:>{w}.3f}" for v in sp) + " |")
+
+
+def _prod(t):
+    out = 1
+    for v in t:
+        out *= v
+    return out
+
+
+if __name__ == "__main__":
+    main()
