@@ -42,9 +42,18 @@ from parallel_heat_tpu.parallel.halo import exchange_halos_2d
 
 _ACC = jnp.float32
 
-# Usable VMEM for the resident kernel's two grid buffers (conservative:
-# ~16 MB/core total, leave room for the output block and spills).
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+# Usable VMEM for the resident kernel's two grid buffers. v5e has
+# 128 MiB of VMEM per core (empirically probed: a 127 MiB scratch
+# compiles and runs); leave room for the per-strip f32 temporaries and
+# Mosaic's own spills.
+_VMEM_BUDGET_BYTES = 80 * 1024 * 1024
+
+# Mosaic's default *scoped* VMEM limit is 16 MiB — far below the
+# hardware's 128 MiB. Every kernel here raises it so the budgets above
+# are real (without this, any kernel whose buffers exceed 16 MiB fails
+# with a scoped-vmem stack OOM at compile time).
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    vmem_limit_bytes=128 * 1024 * 1024)
 
 
 def _interpret() -> bool:
@@ -53,7 +62,29 @@ def _interpret() -> bool:
 
 def fits_vmem(shape: Tuple[int, int], dtype) -> bool:
     cells = shape[0] * shape[1]
-    return 2 * cells * jnp.dtype(dtype).itemsize <= _VMEM_BUDGET_BYTES
+    # Two grid buffers plus the resident kernel's ~4 full-strip f32
+    # compute temporaries (same temp model as the streaming pickers) —
+    # all must fit under the 128 MiB vmem_limit with margin.
+    temps = 4 * (128 + 2) * shape[1] * 4
+    return (2 * cells * jnp.dtype(dtype).itemsize + temps
+            <= _VMEM_BUDGET_BYTES)
+
+
+def _clamped_window(idx, tile, halo, limit, win, align, c0):
+    """Aligned DMA window for tile ``idx`` along one axis.
+
+    The shared idiom of every streaming kernel here: fetch
+    ``[idx*tile - halo, idx*tile - halo + win)`` clamped into
+    ``[0, limit - win]`` by whole ``align`` blocks, with the
+    *destination* offset compensating so that tile row/col 0 always
+    lands at scratch offset ``c0`` (``pl.multiple_of`` carries the
+    alignment proof to Mosaic). Garbage entering at the clamped edges
+    only ever reaches cells the interior mask resets. Returns
+    ``(src_start, dst_offset)``.
+    """
+    start = pl.multiple_of(jnp.clip(idx * tile - halo, 0, limit - win), align)
+    dst = pl.multiple_of(c0 + start - idx * tile, align)
+    return start, dst
 
 
 # --------------------------------------------------------------------------
@@ -157,6 +188,7 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
         scratch_shapes=[pltpu.VMEM((M, N), dtype)],
         input_output_aliases={0: 0},
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )
 
     def fn(u):
@@ -187,7 +219,7 @@ def _pick_strip_rows(out_rows: int, n_cols: int, dtype,
     """
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 13 * 1024 * 1024
+    budget = 100 * 1024 * 1024
     t_max = 512
     if not sharded:
         t_max = min(t_max, out_rows - 2 * sub)
@@ -196,12 +228,12 @@ def _pick_strip_rows(out_rows: int, n_cols: int, dtype,
         if out_rows % t != 0:
             continue
         cost = (2 * (t + 4 * sub) + 2 * t) * n_cols * itemsize
+        # The stencil arithmetic materializes ~4 full-strip f32
+        # temporaries (casts for sub-f32 storage; rolls/products for
+        # all dtypes) — count them or Mosaic scoped-vmem OOMs.
+        cost += 4 * t * n_cols * 4
         if itemsize < 4:
-            # Sub-f32 storage is cast to f32 for the arithmetic; those
-            # casts materialize full-strip f32 temporaries (observed
-            # empirically via Mosaic scoped-vmem OOMs at 32768-wide
-            # bf16 rows — f32 strips fuse better and need no such term).
-            cost += 5 * t * n_cols * 4
+            cost += t * n_cols * 4
         if cost <= budget:
             best = t
     return best
@@ -253,10 +285,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
                 start = pl.multiple_of(strip * T, SUB)
                 dst_off = SUB
             else:
-                raw = strip * T - SUB
-                start = pl.multiple_of(
-                    jnp.clip(raw, 0, O - W), SUB)
-                dst_off = pl.multiple_of(C0 + start - strip * T, SUB)
+                start, dst_off = _clamped_window(strip, T, SUB, O, W, SUB, C0)
             return pltpu.make_async_copy(
                 u_hbm.at[pl.ds(start, W), :],
                 scratch.at[slot, pl.ds(dst_off, W), :],
@@ -332,6 +361,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ),
         grid_spec=grid_spec,
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )
 
     def fn(u, row_off, col_off):
@@ -340,6 +370,206 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         return new, res[0, 0]
 
     return fn, SUB
+
+
+# --------------------------------------------------------------------------
+# Kernel E: temporally-blocked streaming strip (K steps per HBM pass)
+# --------------------------------------------------------------------------
+
+def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
+    """Strip height for the temporal kernel, or None.
+
+    Buffers: 2 DMA slots + 1 ping-pong scratch, each (T + 4*SUB, N),
+    plus the pipeline's double-buffered (T, N) output block and ~4
+    sub-strip f32 temporaries. Larger T amortizes the per-step halo
+    recompute (2*SUB extra rows per intermediate step).
+    """
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    budget = 100 * 1024 * 1024
+    temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
+    t_max = min(512, out_rows - 2 * sub)
+    best = None
+    for t in range(sub, t_max + 1, sub):
+        if out_rows % t != 0:
+            continue
+        cost = (3 * (t + 4 * sub) + 2 * t) * n_cols * itemsize + temps
+        if cost <= budget:
+            best = t
+    return best
+
+
+_SUBSTRIP = 64  # rows per in-kernel compute chunk (bounds f32 temporaries)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_temporal_strip(shape, dtype_name, cx, cy, k):
+    """K Jacobi steps per grid traversal; ``fn(u) -> (u', residual)``.
+
+    The stencil-world analog of kernel fusion over *time*: where kernel
+    B moves 2 grid copies over the HBM bus per step, this kernel moves
+    them once per K steps — each DMA'd strip carries a SUB-row halo on
+    both sides and advances K <= SUB steps entirely in VMEM before its
+    central T rows are written back. HBM traffic per step drops ~K-fold,
+    which turns large f32 grids from bandwidth-bound into compute-bound
+    (the CUDA reference cannot do this at all: every kernel launch
+    re-reads global memory, ``cuda/cuda_heat.cu:204-217``).
+
+    Validity of the K-deep halo: the DMA window covers the output strip
+    plus SUB valid rows on each side (grid edges instead end at a
+    Dirichlet row, which the interior mask pins every step — garbage
+    beyond it never crosses). Each step consumes one halo row, so after
+    K <= SUB steps the central T rows are exact. Intermediate steps
+    update the aligned range ``[C0-SUB, C0+T+SUB)``; the final step
+    computes exactly the output rows with the fused residual max-norm
+    (the *last* step's update, matching the solver's convergence
+    semantics).
+
+    Single-device, f32 only (sub-f32 storage would round each step to
+    the storage dtype; its SUB=16 halos also make the recompute
+    overhead unattractive — those stay on kernel B/C). Sharded blocks
+    stay on K=1 kernels: K > 1 would need K-deep ppermuted halos plus
+    corner exchanges.
+    """
+    M, N = shape
+    dtype = jnp.dtype(dtype_name)
+    assert dtype.itemsize == 4, "temporal kernel is f32-only"
+    SUB = _sub_rows(dtype)
+    assert 1 <= k <= SUB
+    T = _pick_temporal_strip(M, N, dtype)
+    if T is None:
+        return None
+    n_strips = M // T
+    W = T + 2 * SUB                      # DMA window rows
+    SCR = T + 4 * SUB                    # scratch rows (clamp slack)
+    C0 = 2 * SUB                         # scratch row of the strip's row 0
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        colmask = (cols >= 1) & (cols <= N - 2)
+
+        def dma(slot, strip):
+            start, dst_off = _clamped_window(strip, T, SUB, M, W, SUB, C0)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, pl.ds(dst_off, W), :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        def chunk_new(src, r0, h):
+            """One stencil step on scratch rows [r0, r0+h) of ``src``."""
+            blk = src[r0 - 1:r0 + h + 1, :]
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            Lf = jnp.roll(C, 1, axis=1)
+            Rt = jnp.roll(C, -1, axis=1)
+            new = (C + cx * (U + D - 2.0 * C) + cy * (Lf + Rt - 2.0 * C))
+            rows_g = (s * T + (r0 - C0)
+                      + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+            keep = colmask & (rows_g >= 1) & (rows_g <= M - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            """One masked step over scratch rows [lo, hi), chunked."""
+            r0 = lo
+            while r0 < hi:
+                h = min(_SUBSTRIP, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :] = new
+                r0 += h
+
+        # K-1 intermediate steps ping-pong slot <-> pp over the output
+        # rows plus one SUB halo; the final step computes exactly the
+        # output rows into the pipelined out block, with the residual.
+        src, dst = slots.at[slot], pp
+        for _ in range(k - 1):
+            step_into(src, dst, SUB, T + 3 * SUB)
+            src, dst = dst, src
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new
+            r_acc = jnp.maximum(
+                r_acc, jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        @pl.when(s > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, N), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, N), dtype),
+            pltpu.VMEM((SCR, N), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return new, res[0, 0]
+
+    return fn
+
+
+def _temporal_multistep(shape, dtype, cx, cy):
+    """(multi_step, multi_step_residual) built on the temporal kernel,
+    or None if the geometry declines."""
+    SUB = _sub_rows(dtype)
+    if _build_temporal_strip(shape, dtype, cx, cy, SUB) is None:
+        return None
+
+    def run(u, k):
+        K = min(SUB, k)
+        full, rem = divmod(k, K)
+        fn = _build_temporal_strip(shape, dtype, cx, cy, K)
+        u = lax.fori_loop(0, full - 1, lambda i, uu: fn(uu)[0], u)
+        u, res = fn(u)
+        if rem:
+            u, res = _build_temporal_strip(shape, dtype, cx, cy, rem)(u)
+        return u, res
+
+    def multi_step(u, k):
+        return run(u, k)[0]
+
+    return multi_step, run
 
 
 # --------------------------------------------------------------------------
@@ -372,10 +602,29 @@ def single_grid_multistep(config):
 
     from parallel_heat_tpu.solver import steps_to_multistep
 
-    built = _build_strip_kernel(shape, dtype, cx, cy, shape, sharded=False)
-    if built is None:  # rows too wide to stream whole: 2D-tiled kernel
-        built = _build_tiled_kernel(shape, dtype, cx, cy, shape,
-                                    sharded=False)
+    if jnp.dtype(dtype).itemsize == 4:
+        # f32 grids beyond VMEM: K-steps-per-pass temporal blocking.
+        temporal = _temporal_multistep(shape, dtype, cx, cy)
+        if temporal is not None:
+            return temporal
+
+    # Single-step streaming: strips (B) vs 2D tiles (C), whichever
+    # fetches fewer halo cells per useful cell. Wide sub-f32 grids are
+    # the case where C wins: the f32 cast temporaries cap B's strip
+    # height, and skinny strips re-fetch most of what they read.
+    sub = _sub_rows(dtype)
+    t_b = _pick_strip_rows(shape[0], shape[1], dtype, sharded=False)
+    t_c = _pick_tile_2d(shape[0], shape[1], dtype, sharded=False)
+    eff_b = t_b / (t_b + 2 * sub) if t_b else 0.0
+    eff_c = (t_c[0] * t_c[1] / ((t_c[0] + 2 * sub) * (t_c[1] + 2 * _LANE))
+             if t_c else 0.0)
+    order = ([_build_tiled_kernel, _build_strip_kernel] if eff_c > eff_b
+             else [_build_strip_kernel, _build_tiled_kernel])
+    built = None
+    for build in order:
+        built = build(shape, dtype, cx, cy, shape, sharded=False)
+        if built is not None:
+            break
     if built is None:  # awkward geometry: XLA-fused fallback
         return steps_to_multistep(
             lambda u: step_2d(u, cx, cy),
@@ -510,9 +759,9 @@ def _pick_tile_2d(out_rows: int, n_cols: int, dtype, sharded: bool):
     """
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 13 * 1024 * 1024
+    budget = 100 * 1024 * 1024
     best = None
-    for cw in (1024, 2048, 4096):
+    for cw in (1024, 2048, 4096, 8192):
         if n_cols % cw != 0 or n_cols // cw < 2:
             continue
         t_max = 512 if sharded else min(512, out_rows - 2 * sub)
@@ -521,11 +770,16 @@ def _pick_tile_2d(out_rows: int, n_cols: int, dtype, sharded: bool):
                 continue
             cost = (2 * (t + 4 * sub) * (cw + 4 * _LANE) + 2 * t * cw) \
                 * itemsize
+            cost += 4 * t * cw * 4  # f32 compute temporaries
             if itemsize < 4:
-                cost += 5 * t * cw * 4
-            if cost <= budget and (best is None or t * cw > best[0] * best[1]):
-                best = (t, cw)
-    return best
+                cost += t * cw * 4
+            if cost > budget:
+                continue
+            # DMA efficiency: useful cells over fetched window cells.
+            eff = (t * cw) / ((t + 2 * sub) * (cw + 2 * _LANE))
+            if best is None or eff > best[0]:
+                best = (eff, t, cw)
+    return None if best is None else (best[1], best[2])
 
 
 @functools.lru_cache(maxsize=32)
@@ -571,12 +825,10 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
                 row_start = pl.multiple_of(sr * T, SUB)
                 row_dst = SUB
             else:
-                row_start = pl.multiple_of(
-                    jnp.clip(sr * T - SUB, 0, O - WR), SUB)
-                row_dst = pl.multiple_of(C0R + row_start - sr * T, SUB)
-            col_start = pl.multiple_of(
-                jnp.clip(sc * CW - _LANE, 0, N - WC), _LANE)
-            col_dst = pl.multiple_of(C0C + col_start - sc * CW, _LANE)
+                row_start, row_dst = _clamped_window(
+                    sr, T, SUB, O, WR, SUB, C0R)
+            col_start, col_dst = _clamped_window(
+                sc, CW, _LANE, N, WC, _LANE, C0C)
             return pltpu.make_async_copy(
                 u_hbm.at[pl.ds(row_start, WR), pl.ds(col_start, WC)],
                 scratch.at[slot, pl.ds(row_dst, WR), pl.ds(col_dst, WC)],
@@ -654,6 +906,7 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ),
         grid_spec=grid_spec,
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )
 
     def fn(u, row_off, col_off):
@@ -679,17 +932,17 @@ def _pick_slab_3d(shape, dtype):
     X, Y, Z = shape
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 12 * 1024 * 1024
+    budget = 100 * 1024 * 1024
     if Z % _LANE != 0:
         # The slab DMA copies whole-Z panes; Mosaic requires lane-dim
         # slice extents to be 128-aligned. Smaller/odd Z: jnp fallback.
         return None
     best = None
     best_eff = 0.0
-    for sx in (2, 4, 8, 16, 32):
+    for sx in (2, 4, 8, 16, 32, 64):
         if X % sx != 0 or sx > X - 2:  # clamped windows need X >= SX+2
             continue
-        for ty in range(sub, min(Y - 2 * sub, 256) + 1, sub):
+        for ty in range(sub, min(Y - 2 * sub, 512) + 1, sub):
             if Y % ty != 0:
                 continue
             cost = (2 * (sx + 4) * (ty + 4 * sub) * Z * itemsize
@@ -734,11 +987,9 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
         idx = sx * ny_p + sy
 
         def dma(slot, px, py):
-            x_start = jnp.clip(px * SX - 1, 0, X - WX)
-            x_dst = 2 + x_start - px * SX  # leading dim: no alignment
-            y_start = pl.multiple_of(
-                jnp.clip(py * TY - SUB, 0, Y - WY), SUB)
-            y_dst = pl.multiple_of(C0Y + y_start - py * TY, SUB)
+            # leading dim: align=1 (no tiling constraint), halo 1, c0=2
+            x_start, x_dst = _clamped_window(px, SX, 1, X, WX, 1, 2)
+            y_start, y_dst = _clamped_window(py, TY, SUB, Y, WY, SUB, C0Y)
             return pltpu.make_async_copy(
                 u_hbm.at[pl.ds(x_start, WX), pl.ds(y_start, WY), :],
                 scratch.at[slot, pl.ds(x_dst, WX), pl.ds(y_dst, WY), :],
@@ -811,6 +1062,7 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )
 
     def fn(u):
