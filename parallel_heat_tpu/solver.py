@@ -324,10 +324,17 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     t0 = time.perf_counter()
     grid, steps_run, converged, residual = runner(initial)
     if block_until_ready:
+        # One host-visible scalar read *is* the flush: on remote-TPU
+        # transports (axon tunnel) block_until_ready returns at
+        # dispatch, so reading a device value is the only way to
+        # bracket completion. steps_run is scalar-replicated, so this
+        # is a single-element transfer, not a grid gather.
         jax.block_until_ready(grid)
+        steps_run = int(steps_run)
     elapsed = time.perf_counter() - t0
 
-    steps_run = int(steps_run)
+    if not block_until_ready:
+        steps_run = int(steps_run)
     if config.converge:
         conv: Optional[bool] = bool(converged)
         res: Optional[float] = float(residual)

@@ -34,9 +34,14 @@ def test_bench_helper_on_tiny_config():
     import bench
     from parallel_heat_tpu import HeatConfig
 
-    elapsed, res = bench._bench_config(
-        HeatConfig(nx=32, ny=32, steps=10, backend="jnp"), repeats=1
+    elapsed = bench._bench_fixed(
+        HeatConfig(nx=32, ny=32, steps=10, backend="jnp"), budget_s=0.2
     )
     assert elapsed > 0
-    assert res.steps_run == 10
+    elapsed_c, res = bench._bench_converge(
+        HeatConfig(nx=32, ny=32, steps=10, converge=True,
+                   check_interval=5, backend="jnp"), repeats=1
+    )
+    assert elapsed_c > 0
+    assert res.steps_run <= 10
     assert np.isfinite(res.to_numpy()).all()

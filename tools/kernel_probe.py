@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""One-off kernel anatomy probe: where does kernel A's time go?
+
+Variants of a k-step VMEM-resident loop, each changing one cost.
+Slope timing (chained batches, terminal device->host flush).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.utils.profiling import sync
+
+CP = pltpu.CompilerParams(vmem_limit_bytes=128 * 1024 * 1024)
+
+
+def build(shape, k, variant):
+    M, N = shape
+    dtype = jnp.float32
+    cx = cy = 0.1
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+
+    def kernel(u_ref, out_ref, a_ref):
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        colmask = (cols >= 1) & (cols <= N - 2)
+        fmask = jnp.where(colmask, jnp.float32(1.0), 0.0)
+        a_ref[:] = u_ref[:]
+        b_ref = out_ref
+
+        def step_into(src, dst):
+            blk = src[:, :]
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            if variant == "noroll":
+                L = C
+                R = C
+            else:
+                L = jnp.roll(C, 1, axis=1)
+                R = jnp.roll(C, -1, axis=1)
+            if variant in ("coeff", "coeffmul"):
+                new = a0 * C + cx * (U + D) + cy * (L + R)
+            elif variant == "combined":
+                new = a0 * C + cx * (U + D + L + R)
+            else:
+                new = (C + cx * (U + D - 2.0 * C)
+                       + cy * (L + R - 2.0 * C))
+            if variant == "coeffmul":
+                new = C + fmask * (new - C)
+            elif variant != "nomask":
+                new = jnp.where(colmask, new, C)
+            dst[0:1, :] = src[0:1, :]
+            dst[M - 1:M, :] = src[M - 1:M, :]
+            dst[1:M - 1, :] = new
+
+        def double_step(_, c):
+            step_into(a_ref, b_ref)
+            step_into(b_ref, a_ref)
+            return 0
+
+        lax.fori_loop(0, k // 2, double_step, 0)
+        out_ref[:] = a_ref[:]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((M, N), dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=CP,
+    )
+
+
+def chain(run, u0, reps):
+    g = u0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = run(g)
+    sync(g)
+    return time.perf_counter() - t0
+
+
+def bench(shape, k, variant, r2=12):
+    u0 = jax.block_until_ready(HeatPlate2D(*shape).init_grid(jnp.float32))
+    run = jax.jit(build(shape, k, variant))
+    sync(run(u0))
+    t1 = chain(run, u0, 2)
+    t2 = chain(run, u0, 2 + r2)
+    per = (t2 - t1) / r2 / k
+    cells = shape[0] * shape[1]
+    print(f"{shape} k={k:5d} {variant:10s}: {per*1e6:8.3f} us/step "
+          f"{cells/per/1e9:8.1f} Gcells*steps/s")
+
+
+if __name__ == "__main__":
+    shape = (1000, 1000)
+    for variant in ["full", "coeff", "coeffmul", "combined",
+                    "nomask", "noroll"]:
+        bench(shape, 2000, variant)

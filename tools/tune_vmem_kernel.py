@@ -1,7 +1,13 @@
 #!/usr/bin/env python
 """Sweep kernel-A strip heights on the real chip (stage-8 tuning aid).
 
-Run from the repo root: ``python tools/tune_vmem_kernel.py``.
+Run from the repo root: ``python tools/tune_vmem_kernel.py [shapes] [Rs]``.
+
+Timing: steady-state slope between two chained batches (the kernel's
+output feeds the next call), with one device->host read as the
+terminal flush — the same protocol as bench.py. On the axon transport
+a single dispatch+readback costs ~0.2 s, so naive per-call timing
+measures the tunnel, not the kernel.
 """
 
 import sys
@@ -14,29 +20,45 @@ import jax.numpy as jnp  # noqa: E402
 
 from parallel_heat_tpu.models import HeatPlate2D  # noqa: E402
 from parallel_heat_tpu.ops import pallas_stencil as ps  # noqa: E402
+from parallel_heat_tpu.utils.profiling import sync  # noqa: E402
 
 
-def bench(shape, r, k=1000, reps=3):
-    u = HeatPlate2D(*shape).init_grid(jnp.float32)
+def chain(run, u0, reps):
+    g = jnp.copy(u0)  # the runner donates its input; protect u0
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = run(g)
+    sync(g)
+    return time.perf_counter() - t0
+
+
+def bench(shape, r, k=2000, r2=12):
+    u0 = jax.block_until_ready(HeatPlate2D(*shape).init_grid(jnp.float32))
     fn = ps._build_vmem_multistep(shape, "float32", 0.1, 0.1, k,
                                   strip_rows=r)
     run = jax.jit(lambda x: fn(x)[0], donate_argnums=0)
-    u = jax.block_until_ready(run(u))  # compile + warm
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        u = jax.block_until_ready(run(u))
-        best = min(best, time.perf_counter() - t0)
+    sync(run(jnp.copy(u0)))  # compile + warm
+    t1 = chain(run, u0, 2)
+    t2 = chain(run, u0, 2 + r2)
+    per_step = (t2 - t1) / r2 / k
     cells = shape[0] * shape[1]
-    print(f"shape={shape} R={r:4d}: {best*1e6/k:8.2f} us/step  "
-          f"{cells*k/best/1e9:8.1f} Gcells*steps/s")
-    return best
+    print(f"shape={shape} R={r:4d}: {per_step*1e6:8.3f} us/step  "
+          f"{cells/per_step/1e9:8.1f} Gcells*steps/s")
+    return per_step
 
 
 if __name__ == "__main__":
-    for shape in [(1000, 1000), (1024, 1024)]:
-        for r in [64, 128, 248, 256, 504, 512]:
-            if shape[0] % 8 == 0 and r > shape[0]:
+    shapes = [(1000, 1000), (1024, 1024)]
+    rs = [64, 128, 248, 256, 504, 512]
+    if len(sys.argv) > 1:
+        shapes = [tuple(int(x) for x in a.split("x"))
+                  for a in sys.argv[1].split(",")]
+    if len(sys.argv) > 2:
+        rs = [int(x) for x in sys.argv[2].split(",")]
+    for shape in shapes:
+        for r in rs:
+            if r > shape[0]:
                 continue
             try:
                 bench(shape, r)
