@@ -425,15 +425,16 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
     (the *last* step's update, matching the solver's convergence
     semantics).
 
-    Single-device, f32 only (sub-f32 storage would round each step to
-    the storage dtype; its SUB=16 halos also make the recompute
-    overhead unattractive — those stay on kernel B/C). Sharded blocks
-    stay on K=1 kernels: K > 1 would need K-deep ppermuted halos plus
-    corner exchanges.
+    Works for any storage dtype: arithmetic is f32 per SEMANTICS.md,
+    and intermediate steps round to the storage dtype in VMEM scratch —
+    bit-identical to running K single-step kernels (which round to
+    storage in HBM each step). Sub-f32 dtypes pay SUB=16 halos (larger
+    recompute overlap) but win back ~K× HBM traffic, which is what
+    bounds them at 32k². Sharded blocks stay on K=1 kernels: K > 1
+    would need K-deep ppermuted halos plus corner exchanges.
     """
     M, N = shape
     dtype = jnp.dtype(dtype_name)
-    assert dtype.itemsize == 4, "temporal kernel is f32-only"
     SUB = _sub_rows(dtype)
     assert 1 <= k <= SUB
     T = _pick_temporal_strip(M, N, dtype)
@@ -472,7 +473,7 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
 
         def chunk_new(src, r0, h):
             """One stencil step on scratch rows [r0, r0+h) of ``src``."""
-            blk = src[r0 - 1:r0 + h + 1, :]
+            blk = src[r0 - 1:r0 + h + 1, :].astype(_ACC)
             C = blk[1:-1]
             U = blk[:-2]
             D = blk[2:]
@@ -490,23 +491,39 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
             while r0 < hi:
                 h = min(_SUBSTRIP, hi - r0)
                 new, _, _ = chunk_new(src, r0, h)
-                dst[r0:r0 + h, :] = new
+                dst[r0:r0 + h, :] = new.astype(dtype)
                 r0 += h
 
         # K-1 intermediate steps ping-pong slot <-> pp over the output
         # rows plus one SUB halo; the final step computes exactly the
         # output rows into the pipelined out block, with the residual.
-        src, dst = slots.at[slot], pp
-        for _ in range(k - 1):
-            step_into(src, dst, SUB, T + 3 * SUB)
-            src, dst = dst, src
+        # Paired steps run under fori_loop so the emitted code stays
+        # O(1) in K (a Python unroll at K=16, N=32k made Mosaic compile
+        # times pathological). Intermediates always sweep the same fixed
+        # row band; the garbage frontier (one row per step from each
+        # side) is re-overwritten every step and, for K <= SUB, never
+        # reaches the central T output rows.
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            step_into(pp, sref, SUB, T + 3 * SUB)
+            return 0
+
+        lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            src = pp
 
         r_acc = jnp.float32(0.0)
         r0 = C0
         while r0 < C0 + T:
             h = min(_SUBSTRIP, C0 + T - r0)
             new, C, keep = chunk_new(src, r0, h)
-            out_ref[r0 - C0:r0 - C0 + h, :] = new
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
             r_acc = jnp.maximum(
                 r_acc, jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
             r0 += h
@@ -602,11 +619,12 @@ def single_grid_multistep(config):
 
     from parallel_heat_tpu.solver import steps_to_multistep
 
-    if jnp.dtype(dtype).itemsize == 4:
-        # f32 grids beyond VMEM: K-steps-per-pass temporal blocking.
-        temporal = _temporal_multistep(shape, dtype, cx, cy)
-        if temporal is not None:
-            return temporal
+    # Grids beyond VMEM: K-steps-per-pass temporal blocking (any
+    # storage dtype; arithmetic is f32 with per-step storage rounding
+    # either way, so this is bit-identical to K single-step passes).
+    temporal = _temporal_multistep(shape, dtype, cx, cy)
+    if temporal is not None:
+        return temporal
 
     # Single-step streaming: strips (B) vs 2D tiles (C), whichever
     # fetches fewer halo cells per useful cell. Wide sub-f32 grids are

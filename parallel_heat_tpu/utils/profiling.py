@@ -34,6 +34,52 @@ def sync(x) -> None:
     float(x[(0,) * x.ndim])
 
 
+def chain_time(step_fn, u0, reps: int) -> float:
+    """Wall-clock seconds for ``reps`` chained ``step_fn`` applications.
+
+    The chained-slope timing protocol shared by ``bench.py`` and the
+    tuning tools: copy ``u0`` first (compiled runners donate their input
+    buffer — the copy protects the caller's array), apply
+    ``g = step_fn(g)`` ``reps`` times with no intermediate host sync,
+    then one terminal :func:`sync` as the true pipeline flush. Timing
+    the slope between two batch sizes cancels the constant
+    dispatch+readback latency (~0.2 s per call on the axon tunnel).
+    ``step_fn`` must return the next grid (unwrap any extra outputs).
+    """
+    import jax.numpy as jnp
+
+    g = jnp.copy(u0)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = step_fn(g)
+    sync(g)
+    return time.perf_counter() - t0
+
+
+def chain_slope(step_fn, u0, reps_a: int, reps_b: int) -> float:
+    """Steady-state seconds per ``step_fn`` call via the chained slope.
+
+    Runs batches of ``reps_a`` and ``reps_b`` calls and returns
+    ``(t_b - t_a) / (reps_b - reps_a)``. Raises ``RuntimeError`` when
+    the slope is non-positive (timer noise swamped the measurement —
+    e.g. the per-call compute is far below the transport's dispatch
+    latency); callers must surface that rather than report a garbage
+    throughput number.
+    """
+    assert reps_b > reps_a >= 1
+    t_a = chain_time(step_fn, u0, reps_a)
+    t_b = chain_time(step_fn, u0, reps_b)
+    per = (t_b - t_a) / (reps_b - reps_a)
+    if per <= 0:
+        raise RuntimeError(
+            f"non-positive chained slope ({t_b:.4f}s for {reps_b} reps vs "
+            f"{t_a:.4f}s for {reps_a}): measurement noise exceeds per-call "
+            f"compute; increase the batch budget"
+        )
+    return per
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """``jax.profiler`` trace context; view with TensorBoard/XProf.

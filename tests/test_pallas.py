@@ -310,6 +310,32 @@ def test_temporal_strip_dirichlet_boundary():
     np.testing.assert_array_equal(g[:, -1], w[:, -1])
 
 
+def test_temporal_strip_bf16_matches_jnp():
+    # Sub-f32 storage: f32 arithmetic with per-step rounding to bf16 in
+    # VMEM scratch — must agree with K jnp steps (which round to bf16 in
+    # HBM each step) up to FMA-contraction differences.
+    shape = (96, 128)
+    k = 6
+    u = jnp.asarray(_rand(shape, seed=6)).astype(jnp.bfloat16)
+    fn = ps._build_temporal_strip(shape, "bfloat16", 0.1, 0.1, k)
+    assert fn is not None
+    got, res = fn(u)
+    assert got.dtype == jnp.bfloat16
+    want = u
+    for _ in range(k):
+        want, wres = step_2d_residual(want, 0.1, 0.1)
+    np.testing.assert_allclose(
+        np.asarray(got.astype(jnp.float32)),
+        np.asarray(want.astype(jnp.float32)),
+        rtol=0.05, atol=0.05,
+    )
+    np.testing.assert_allclose(float(res), float(wres), rtol=0.1, atol=1e-4)
+    # Dirichlet boundary bit-exact through the cast round trips.
+    g, w = np.asarray(got), np.asarray(u)
+    np.testing.assert_array_equal(g[0, :], w[0, :])
+    np.testing.assert_array_equal(g[:, -1], w[:, -1])
+
+
 def test_temporal_pick_declines_small_rows():
     # Too few rows for a clamped window (O < 3*SUB): decline.
     assert ps._pick_temporal_strip(16, 128, "float32") is None

@@ -6,7 +6,6 @@ Slope timing (chained batches, terminal device->host flush).
 """
 
 import sys
-import time
 
 sys.path.insert(0, ".")
 
@@ -17,7 +16,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from parallel_heat_tpu.models import HeatPlate2D
-from parallel_heat_tpu.utils.profiling import sync
+from parallel_heat_tpu.utils.profiling import chain_slope, sync
 
 CP = pltpu.CompilerParams(vmem_limit_bytes=128 * 1024 * 1024)
 
@@ -80,22 +79,14 @@ def build(shape, k, variant):
     )
 
 
-def chain(run, u0, reps):
-    g = u0
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        g = run(g)
-    sync(g)
-    return time.perf_counter() - t0
-
-
 def bench(shape, k, variant, r2=12):
+    # The in-kernel fori_loop runs k//2 double steps: odd k would
+    # silently run k-1 steps while normalizing by k.
+    assert k % 2 == 0, f"k must be even, got {k}"
     u0 = jax.block_until_ready(HeatPlate2D(*shape).init_grid(jnp.float32))
     run = jax.jit(build(shape, k, variant))
     sync(run(u0))
-    t1 = chain(run, u0, 2)
-    t2 = chain(run, u0, 2 + r2)
-    per = (t2 - t1) / r2 / k
+    per = chain_slope(run, u0, 2, 2 + r2) / k
     cells = shape[0] * shape[1]
     print(f"{shape} k={k:5d} {variant:10s}: {per*1e6:8.3f} us/step "
           f"{cells/per/1e9:8.1f} Gcells*steps/s")

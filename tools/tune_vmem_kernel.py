@@ -11,7 +11,6 @@ measures the tunnel, not the kernel.
 """
 
 import sys
-import time
 
 sys.path.insert(0, ".")
 
@@ -20,17 +19,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from parallel_heat_tpu.models import HeatPlate2D  # noqa: E402
 from parallel_heat_tpu.ops import pallas_stencil as ps  # noqa: E402
-from parallel_heat_tpu.utils.profiling import sync  # noqa: E402
-
-
-def chain(run, u0, reps):
-    g = jnp.copy(u0)  # the runner donates its input; protect u0
-    jax.block_until_ready(g)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        g = run(g)
-    sync(g)
-    return time.perf_counter() - t0
+from parallel_heat_tpu.utils.profiling import chain_slope, sync  # noqa: E402
 
 
 def bench(shape, r, k=2000, r2=12):
@@ -39,9 +28,7 @@ def bench(shape, r, k=2000, r2=12):
                                   strip_rows=r)
     run = jax.jit(lambda x: fn(x)[0], donate_argnums=0)
     sync(run(jnp.copy(u0)))  # compile + warm
-    t1 = chain(run, u0, 2)
-    t2 = chain(run, u0, 2 + r2)
-    per_step = (t2 - t1) / r2 / k
+    per_step = chain_slope(run, u0, 2, 2 + r2) / k
     cells = shape[0] * shape[1]
     print(f"shape={shape} R={r:4d}: {per_step*1e6:8.3f} us/step  "
           f"{cells/per_step/1e9:8.1f} Gcells*steps/s")
