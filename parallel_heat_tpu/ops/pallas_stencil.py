@@ -566,27 +566,40 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
     return fn
 
 
+def _chunked_multistep(build_fn, K):
+    """Lift a family of k-step kernels to ``(multi_step, run)``.
+
+    ``build_fn(k) -> fn(u) -> (u', res)`` for any ``1 <= k <= K``. An
+    n-step advance runs ``n // kk`` full kernels of ``kk = min(K, n)``
+    steps plus one remainder kernel; the residual returned is the last
+    executed step's, exactly as the solver's convergence loop expects.
+    Shared by the 2D (kernel E) and 3D (kernel F) temporal paths.
+    """
+
+    def run(u, n):
+        kk = min(K, n)
+        full, rem = divmod(n, kk)
+        fn = build_fn(kk)
+        u = lax.fori_loop(0, full - 1, lambda i, uu: fn(uu)[0], u)
+        u, res = fn(u)
+        if rem:
+            u, res = build_fn(rem)(u)
+        return u, res
+
+    def multi_step(u, n):
+        return run(u, n)[0]
+
+    return multi_step, run
+
+
 def _temporal_multistep(shape, dtype, cx, cy):
     """(multi_step, multi_step_residual) built on the temporal kernel,
     or None if the geometry declines."""
     SUB = _sub_rows(dtype)
     if _build_temporal_strip(shape, dtype, cx, cy, SUB) is None:
         return None
-
-    def run(u, k):
-        K = min(SUB, k)
-        full, rem = divmod(k, K)
-        fn = _build_temporal_strip(shape, dtype, cx, cy, K)
-        u = lax.fori_loop(0, full - 1, lambda i, uu: fn(uu)[0], u)
-        u, res = fn(u)
-        if rem:
-            u, res = _build_temporal_strip(shape, dtype, cx, cy, rem)(u)
-        return u, res
-
-    def multi_step(u, k):
-        return run(u, k)[0]
-
-    return multi_step, run
+    return _chunked_multistep(
+        lambda k: _build_temporal_strip(shape, dtype, cx, cy, k), SUB)
 
 
 # --------------------------------------------------------------------------
@@ -1090,12 +1103,238 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
     return fn
 
 
+# --------------------------------------------------------------------------
+# Kernel F: 3D X-slab streaming, temporal-blocked
+# --------------------------------------------------------------------------
+
+def _xslab_chunk(plane_f32: int) -> int:
+    """Compute-chunk planes for kernel F: bounds the ~4 full-chunk f32
+    stencil temporaries to ~24 MiB. The picker's VMEM cost model and the
+    builder must agree on this, or the picker admits geometries whose
+    real allocation OOMs at build time."""
+    return max(1, 6 * 1024 * 1024 // plane_f32)
+
+
+def _pick_xslab_3d(shape, dtype):
+    """``(SX, K)`` for the X-slab kernel, or None.
+
+    Kernel D's XY-tiled windows are strided at Z-row (2 KB) granularity,
+    which caps its DMA streams at ~350 GB/s (measured: its runtime is
+    pure DMA time; masks and stencil hide entirely). An X slab spanning
+    full (Y, Z) planes is ONE contiguous HBM range, so it streams at
+    near peak — and because X is the untiled leading dim, halo planes
+    need no alignment blocks: K-step temporal blocking costs only
+    2K extra planes per window. Scores each (SX, K) by modeled
+    max(bandwidth time, VPU time) per cell-step and returns the best
+    that fits VMEM. Requires Z % 128 == 0 (lane-aligned planes) and
+    full (Y, Z) planes small enough to buffer ~3 windows.
+    """
+    X, Y, Z = shape
+    itemsize = jnp.dtype(dtype).itemsize
+    if Z % _LANE != 0:
+        return None
+    plane = Y * Z * itemsize
+    plane_f32 = Y * Z * 4
+    budget = 100 * 1024 * 1024
+    bw = 350e9          # achieved read+write HBM mix, bytes/s (measured
+                        # on v5e: k=1 variants of both 3D kernels time
+                        # out at exactly this rate regardless of window
+                        # contiguity)
+    rate = 140e9        # VPU 7-point cells/s at full occupancy
+    ch = _xslab_chunk(plane_f32)
+    best = None
+    best_t = float("inf")
+    for k in range(1, 9):
+        for sx in (64, 32, 16, 8, 4):
+            if X % sx != 0 or sx + 2 * k > X:
+                continue
+            scr = sx + 4 * k
+            cost = (2 * scr * plane            # DMA slots
+                    + (scr * plane if k > 1 else 0)  # ping-pong scratch
+                    + 2 * sx * plane           # pipelined out block
+                    + 4 * ch * plane_f32)      # f32 compute temporaries
+            if itemsize < 4:
+                cost += ch * plane_f32
+            if cost > budget:
+                continue
+            amp = (sx + 2 * k) / sx
+            t = max((amp + 1) * itemsize / k / bw, amp / rate)
+            if t < best_t:
+                best_t, best = t, (sx, k)
+    return best
+
+
+@functools.lru_cache(maxsize=16)
+def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k):
+    """K 7-point steps per contiguous X-slab pass; ``fn(u) -> (u', res)``.
+
+    The 3D analog of kernel E (`_build_temporal_strip`): each DMA window
+    carries K halo planes per side and advances K steps in VMEM before
+    its central SX planes are written back. Validity is the same
+    shrinking-frontier argument — each step consumes one halo plane, and
+    intermediate sweeps re-overwrite the garbage frontier, which for
+    K <= halo depth never reaches the output planes. Y neighbors come
+    from sublane rolls and Z neighbors from lane rolls of the center
+    plane; the wrapped values land only in cells the interior mask
+    resets (Dirichlet faces, same masking as kernel D).
+    """
+    X, Y, Z = shape
+    dtype = jnp.dtype(dtype_name)
+    assert k >= 1 and X % sx == 0 and sx + 2 * k <= X
+    W = sx + 2 * k
+    SCR = sx + 4 * k
+    C0 = 2 * k
+    n_slabs = X // sx
+    CH = _xslab_chunk(Y * Z * 4)
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        ys = lax.broadcasted_iota(jnp.int32, (1, Y, 1), 1)
+        zs = lax.broadcasted_iota(jnp.int32, (1, 1, Z), 2)
+        yzmask = ((ys >= 1) & (ys <= Y - 2)
+                  & (zs >= 1) & (zs <= Z - 2))
+
+        def dma(slot, slab):
+            start, dst = _clamped_window(slab, sx, k, X, W, 1, C0)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :, :],
+                slots.at[slot, pl.ds(dst, W), :, :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        def chunk_new(src, r0, h):
+            """One stencil step on scratch planes [r0, r0+h) of ``src``."""
+            blk = src[r0 - 1:r0 + h + 1, :, :].astype(_ACC)
+            C = blk[1:-1]
+            Xm = blk[:-2]
+            Xp = blk[2:]
+            Ym = jnp.roll(C, 1, axis=1)
+            Yp = jnp.roll(C, -1, axis=1)
+            Zm = jnp.roll(C, 1, axis=2)
+            Zp = jnp.roll(C, -1, axis=2)
+            new = (C + cx * (Xm + Xp - 2.0 * C)
+                   + cy * (Ym + Yp - 2.0 * C)
+                   + cz * (Zm + Zp - 2.0 * C))
+            rows_g = (s * sx + (r0 - C0)
+                      + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
+            keep = yzmask & (rows_g >= 1) & (rows_g <= X - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(CH, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :, :] = new.astype(dtype)
+                r0 += h
+
+        # K-1 intermediate steps ping-pong slot <-> pp over the fixed
+        # band [k, sx+3k) (paired under fori_loop, O(1) code in K — see
+        # kernel E); the final step computes exactly the output planes.
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, k, sx + 3 * k)
+            step_into(pp, sref, k, sx + 3 * k)
+            return 0
+
+        if m > 0:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, k, sx + 3 * k)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + sx:
+            h = min(CH, C0 + sx - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :, :] = new.astype(dtype)
+            r_acc = jnp.maximum(
+                r_acc, jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        @pl.when(s > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    # k == 1 runs straight from the DMA slot; a dummy 2-plane ping-pong
+    # keeps one kernel signature (Mosaic allocates it but it is unused).
+    pp_planes = SCR if k > 1 else 2
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_slabs,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((X, Y, Z), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        out_specs=(
+            pl.BlockSpec((sx, Y, Z), lambda s: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, Y, Z), dtype),
+            pltpu.VMEM((pp_planes, Y, Z), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return new, res[0, 0]
+
+    return fn
+
+
+def _xslab_multistep_3d(shape, dtype, cx, cy, cz):
+    """(multi_step, multi_step_residual) on kernel F, or None."""
+    pick = _pick_xslab_3d(shape, dtype)
+    if pick is None:
+        return None
+    sx, K = pick
+    return _chunked_multistep(
+        lambda k: _build_xslab_3d(shape, dtype, cx, cy, cz, sx, k), K)
+
+
 def single_grid_multistep_3d(config):
-    """``(multi_step, multi_step_residual)`` for one device, 3D."""
+    """``(multi_step, multi_step_residual)`` for one device, 3D.
+
+    Preference order: X-slab temporal kernel (contiguous DMA, K steps
+    per pass) > XY-tiled slab kernel (planes too large for full-plane
+    buffering) > XLA-fused jnp.
+    """
     from parallel_heat_tpu.ops.stencil import step_3d, step_3d_residual
     from parallel_heat_tpu.solver import steps_to_multistep
 
     cx, cy, cz = (float(config.cx), float(config.cy), float(config.cz))
+    xslab = _xslab_multistep_3d(config.shape, config.dtype, cx, cy, cz)
+    if xslab is not None:
+        return xslab
     fn = _build_slab_kernel_3d(config.shape, config.dtype, cx, cy, cz)
     if fn is None:
         return steps_to_multistep(

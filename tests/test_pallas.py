@@ -240,6 +240,71 @@ def test_solve_pallas_3d_matches_jnp():
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3)
 
 
+# --------------------------------------------------------------------------
+# Kernel F: 3D X-slab streaming, temporal-blocked
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_xslab_3d_matches_jnp(k):
+    from parallel_heat_tpu.ops.stencil import step_3d_residual
+
+    shape = (24, 16, 128)
+    rng = np.random.default_rng(8)
+    u = jnp.asarray((rng.standard_normal(shape) * 10).astype(np.float32))
+    fn = ps._build_xslab_3d(shape, "float32", 0.1, 0.1, 0.1, 8, k)
+    got, res = fn(u)
+    want = u
+    for _ in range(k):
+        want, wres = step_3d_residual(want, 0.1, 0.1, 0.1)
+    _close(got, want)
+    np.testing.assert_allclose(float(res), float(wres), rtol=1e-4, atol=1e-6)
+
+
+def test_xslab_multistep_3d_chunks():
+    # Full K-sized passes plus a remainder pass; residual = last step's.
+    from parallel_heat_tpu.ops.stencil import step_3d_residual
+
+    shape = (24, 16, 128)
+    rng = np.random.default_rng(9)
+    u = jnp.asarray((rng.standard_normal(shape) * 10).astype(np.float32))
+    built = ps._xslab_multistep_3d(shape, "float32", 0.1, 0.1, 0.1)
+    assert built is not None
+    multi_step, multi_step_residual = built
+    got, res = multi_step_residual(u, 10)
+    want = u
+    for _ in range(10):
+        want, wres = step_3d_residual(want, 0.1, 0.1, 0.1)
+    _close(got, want)
+    np.testing.assert_allclose(float(res), float(wres), rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(multi_step(u, 10)),
+                                  np.asarray(got))
+
+
+def test_xslab_3d_dirichlet_boundary():
+    # All six faces bit-identical to the input after K steps.
+    shape = (16, 16, 128)
+    rng = np.random.default_rng(10)
+    u = jnp.asarray((rng.standard_normal(shape) * 10).astype(np.float32))
+    fn = ps._build_xslab_3d(shape, "float32", 0.1, 0.1, 0.1, 8, 3)
+    got, _ = fn(u)
+    g, w = np.asarray(got), np.asarray(u)
+    np.testing.assert_array_equal(g[0], w[0])
+    np.testing.assert_array_equal(g[-1], w[-1])
+    np.testing.assert_array_equal(g[:, 0, :], w[:, 0, :])
+    np.testing.assert_array_equal(g[:, -1, :], w[:, -1, :])
+    np.testing.assert_array_equal(g[:, :, 0], w[:, :, 0])
+    np.testing.assert_array_equal(g[:, :, -1], w[:, :, -1])
+
+
+def test_pick_xslab_3d():
+    # Unaligned Z declines; aligned Z returns a geometry that divides X.
+    assert ps._pick_xslab_3d((64, 64, 100), "float32") is None
+    pick = ps._pick_xslab_3d((512, 512, 512), "float32")
+    assert pick is not None
+    sx, k = pick
+    assert 512 % sx == 0 and 1 <= k <= 8
+
+
 def test_solve_sharded_tiled_kernel_end_to_end(monkeypatch):
     # Force block_steps down the strip-declines -> tiled-accepts branch
     # (normally reached only on very wide shard blocks) and check the
