@@ -43,7 +43,10 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        # Always invoke make: a no-op when build/ is current, and the
+        # only way a stale .so from an older ABI gets rebuilt (the
+        # Makefile depends on heat_native.cpp).
+        if not _build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -54,16 +57,32 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p,
             ]
             lib.heat_write_dat.restype = ctypes.c_int
+            lib.heat_write_dat_mt.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.heat_write_dat_mt.restype = ctypes.c_int
+            lib.heat_read_dat.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.heat_read_dat.restype = ctypes.c_int
+            lib.heat_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
             lib.heat_init_grid.argtypes = [
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.c_int64,
                 ctypes.c_int64,
             ]
             lib.heat_native_abi_version.restype = ctypes.c_int
-            if lib.heat_native_abi_version() != 1:
+            if lib.heat_native_abi_version() != 2:
                 return None
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
             _lib = None
         return _lib
 
@@ -72,18 +91,41 @@ def available() -> bool:
     return _load() is not None
 
 
-def write_dat(path: str, u: np.ndarray) -> None:
+def write_dat(path: str, u: np.ndarray, threads: int | None = None) -> None:
+    """Write in prtdat format; formatting parallelized for large grids."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     u = np.ascontiguousarray(u, dtype=np.float32)
     nx, ny = u.shape
-    rc = lib.heat_write_dat(
+    if threads is None:
+        # Threaded formatting pays off once the file is tens of MB.
+        threads = min(os.cpu_count() or 1, 8) if u.size >= 4_000_000 else 1
+    rc = lib.heat_write_dat_mt(
         u.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        nx, ny, str(path).encode(),
+        nx, ny, str(path).encode(), int(threads),
     )
     if rc != 0:
         raise OSError(f"heat_write_dat failed with code {rc} for {path!r}")
+
+
+def read_dat(path: str) -> np.ndarray:
+    """Parse a prtdat file into the ``(nx, ny)`` array convention."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = ctypes.POINTER(ctypes.c_float)()
+    nx = ctypes.c_int64()
+    ny = ctypes.c_int64()
+    rc = lib.heat_read_dat(str(path).encode(), ctypes.byref(out),
+                           ctypes.byref(nx), ctypes.byref(ny))
+    if rc != 0:
+        raise OSError(f"heat_read_dat failed with code {rc} for {path!r}")
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(nx.value, ny.value)).copy()
+    finally:
+        lib.heat_free(out)
+    return arr
 
 
 def init_grid(nx: int, ny: int) -> np.ndarray:

@@ -1,54 +1,250 @@
-// Native runtime pieces: fast .dat serialization and grid init.
+// Native runtime pieces: fast .dat serialization/parsing and grid init.
 //
 // The reference's runtime is C throughout; its I/O layer is prtdat/inidat
 // (mpi/mpi_heat_improved_persistent_stat.c:315-341, cuda/cuda_heat.cu:274-300).
 // The TPU build keeps compute in XLA, but host-side I/O at benchmark sizes
 // (e.g. a 32768^2 grid is a ~8.6 GB text file) is far too slow through
-// Python string formatting, so the writer is native: identical byte output
-// to C fprintf("%6.1f") — which both use snprintf semantics — with a
-// buffered column-major walk.
+// Python string formatting, so the writer/reader are native: identical byte
+// output to C fprintf("%6.1f") — which both use snprintf semantics — with a
+// buffered column-major walk, optionally formatted by a thread pool.
 //
 // Exposed via a plain C ABI for ctypes (no pybind11 dependency).
 
 #include <cstdio>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <string>
+#include <thread>
 #include <vector>
 
-extern "C" {
+namespace {
 
-// Write u[nx][ny] (row-major, C order) in prtdat format:
-// for iy = ny-1..0: print u[0][iy] .. u[nx-1][iy], single-space
-// separated, newline-terminated. Returns 0 on success, errno-style
-// negative on failure.
-int heat_write_dat(const float* u, int64_t nx, int64_t ny,
-                   const char* path) {
-    FILE* fp = std::fopen(path, "w");
-    if (!fp) return -1;
-    // Buffered line assembly: worst-case %6.1f of float32 is ~48 chars
-    // (large magnitudes print in full), plus separator.
-    std::vector<char> line;
-    line.reserve(static_cast<size_t>(nx) * 16 + 64);
+// Format lines iy = [iy_hi .. iy_lo] (descending) into `out`.
+// Each output line is one iy column: u[0][iy] .. u[nx-1][iy].
+int format_lines(const float* u, int64_t nx, int64_t ny,
+                 int64_t iy_hi, int64_t iy_lo, std::string& out) {
     char tok[64];
-    int rc = 0;
-    for (int64_t iy = ny - 1; iy >= 0; --iy) {
-        line.clear();
+    out.clear();
+    out.reserve(static_cast<size_t>(iy_hi - iy_lo + 1) * (nx * 8 + 1));
+    for (int64_t iy = iy_hi; iy >= iy_lo; --iy) {
         for (int64_t ix = 0; ix < nx; ++ix) {
             int n = std::snprintf(tok, sizeof tok, "%6.1f",
                                   static_cast<double>(u[ix * ny + iy]));
-            if (n < 0) { rc = -2; goto done; }
-            line.insert(line.end(), tok, tok + n);
-            line.push_back(ix == nx - 1 ? '\n' : ' ');
-        }
-        if (std::fwrite(line.data(), 1, line.size(), fp) != line.size()) {
-            rc = -3;
-            goto done;
+            if (n < 0) return -2;
+            out.append(tok, static_cast<size_t>(n));
+            out.push_back(ix == nx - 1 ? '\n' : ' ');
         }
     }
-done:
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write u[nx][ny] (row-major, C order) in prtdat format with a formatting
+// thread pool: batches of `threads` chunks are formatted concurrently and
+// written in order, so memory stays O(threads * chunk) rather than O(file).
+// threads <= 1 degrades to the single-threaded walk. Returns 0 on success,
+// negative on failure.
+int heat_write_dat_mt(const float* u, int64_t nx, int64_t ny,
+                      const char* path, int threads) {
+    FILE* fp = std::fopen(path, "w");
+    if (!fp) return -1;
+    if (threads < 1) threads = 1;
+    // ~8 MB of text per chunk keeps the pipeline balanced.
+    int64_t chunk_lines = (8 << 20) / (nx * 8 + 2);
+    if (chunk_lines < 1) chunk_lines = 1;
+    if (chunk_lines > ny) chunk_lines = ny;
+
+    std::vector<std::string> bufs(static_cast<size_t>(threads));
+    std::vector<int> rcs(static_cast<size_t>(threads), 0);
+    int rc = 0;
+    for (int64_t top = ny - 1; top >= 0 && rc == 0;) {
+        int live = 0;
+        std::vector<std::thread> pool;
+        for (int t = 0; t < threads && top >= 0; ++t, ++live) {
+            int64_t hi = top;
+            int64_t lo = hi - chunk_lines + 1;
+            if (lo < 0) lo = 0;
+            top = lo - 1;
+            pool.emplace_back([&, t, hi, lo] {
+                rcs[static_cast<size_t>(t)] =
+                    format_lines(u, nx, ny, hi, lo,
+                                 bufs[static_cast<size_t>(t)]);
+            });
+        }
+        for (auto& th : pool) th.join();
+        for (int t = 0; t < live && rc == 0; ++t) {
+            const std::string& b = bufs[static_cast<size_t>(t)];
+            if (rcs[static_cast<size_t>(t)] != 0) {
+                rc = rcs[static_cast<size_t>(t)];
+            } else if (std::fwrite(b.data(), 1, b.size(), fp) != b.size()) {
+                rc = -3;
+            }
+        }
+    }
     if (std::fclose(fp) != 0 && rc == 0) rc = -4;
     return rc;
 }
+
+// Single-threaded variant (kept for ABI stability and as the oracle).
+int heat_write_dat(const float* u, int64_t nx, int64_t ny,
+                   const char* path) {
+    return heat_write_dat_mt(u, nx, ny, path, 1);
+}
+
+}  // extern "C"
+
+namespace {
+
+constexpr size_t kReadChunk = 8 << 20;  // streaming parse buffer
+
+inline bool is_sep(char c) {
+    // Must agree with strtof's skippable whitespace (minus '\n', the
+    // line terminator) and the Python parser's str.split().
+    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
+
+// Stream the file line by line in O(kReadChunk) memory, invoking
+// cb(line_start, line_end, line_index) for each non-empty line. Lines
+// longer than the chunk are handled by a carry that grows as needed.
+template <typename Fn>
+int for_each_line(FILE* fp, Fn&& cb) {
+    std::vector<char> buf(kReadChunk);
+    std::string carry;
+    int64_t line = 0;
+    for (;;) {
+        size_t got = std::fread(buf.data(), 1, buf.size(), fp);
+        if (got == 0) {
+            if (std::ferror(fp)) return -1;
+            break;
+        }
+        const char* p = buf.data();
+        const char* end = p + got;
+        while (p < end) {
+            const char* nl = static_cast<const char*>(
+                std::memchr(p, '\n', static_cast<size_t>(end - p)));
+            if (!nl) {
+                carry.append(p, static_cast<size_t>(end - p));
+                break;
+            }
+            const char* ls;
+            const char* le;
+            if (carry.empty()) {
+                ls = p;
+                le = nl;
+            } else {
+                carry.append(p, static_cast<size_t>(nl - p));
+                ls = carry.data();
+                le = ls + carry.size();
+            }
+            bool blank = true;
+            for (const char* q = ls; q < le; ++q) {
+                if (!is_sep(*q)) { blank = false; break; }
+            }
+            if (!blank) {
+                int rc = cb(ls, le, line++);
+                if (rc != 0) return rc;
+            }
+            carry.clear();
+            p = nl + 1;
+        }
+    }
+    if (!carry.empty()) {
+        const char* ls = carry.data();
+        const char* le = ls + carry.size();
+        bool blank = true;
+        for (const char* q = ls; q < le; ++q) {
+            if (!is_sep(*q)) { blank = false; break; }
+        }
+        if (!blank) {
+            int rc = cb(ls, le, line);
+            if (rc != 0) return rc;
+        }
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a .dat file (whitespace-separated float grid, one iy line per
+// row, iy descending — the prtdat layout). Two streaming passes in
+// O(chunk) memory (mirroring the writer's O(threads*chunk) design): the
+// first counts lines and validates every line has the same token count,
+// the second fills the malloc'd output. On success returns 0 and sets
+// *out (heat_free() it), *nx, *ny. Negative on failure (-7: parse error
+// or ragged line).
+int heat_read_dat(const char* path, float** out, int64_t* nx, int64_t* ny) {
+    *out = nullptr;
+    *nx = 0;
+    *ny = 0;
+    FILE* fp = std::fopen(path, "rb");
+    if (!fp) return -1;
+
+    // Pass 1: dimensions + per-line token-count validation.
+    int64_t ny_ = 0, nx_ = 0;
+    int rc = for_each_line(fp, [&](const char* ls, const char* le,
+                                   int64_t) -> int {
+        int64_t toks = 0;
+        const char* q = ls;
+        while (q < le) {
+            while (q < le && is_sep(*q)) ++q;
+            if (q >= le) break;
+            ++toks;
+            while (q < le && !is_sep(*q)) ++q;
+        }
+        if (ny_ == 0) {
+            nx_ = toks;
+        } else if (toks != nx_) {
+            return -7;  // ragged line: refuse rather than mis-place cells
+        }
+        ++ny_;
+        return 0;
+    });
+    if (rc != 0 || nx_ <= 0 || ny_ <= 0) {
+        std::fclose(fp);
+        return rc != 0 ? rc : -5;
+    }
+
+    float* buf = static_cast<float*>(
+        std::malloc(sizeof(float) * static_cast<size_t>(nx_) *
+                    static_cast<size_t>(ny_)));
+    if (!buf) { std::fclose(fp); return -6; }
+
+    // Pass 2: parse. Line j (top-down) is iy = ny-1-j; token i is ix = i.
+    // Output layout u[ix * ny + iy] (row-major (nx, ny), matching the
+    // writer's input convention).
+    std::rewind(fp);
+    std::string tokbuf;
+    rc = for_each_line(fp, [&](const char* ls, const char* le,
+                               int64_t j) -> int {
+        int64_t iy = ny_ - 1 - j;
+        // strtof needs NUL-terminated input; copy the line once.
+        tokbuf.assign(ls, static_cast<size_t>(le - ls));
+        char* p = tokbuf.data();
+        char* lend = p + tokbuf.size();
+        for (int64_t ix = 0; ix < nx_; ++ix) {
+            char* next = nullptr;
+            float v = std::strtof(p, &next);
+            if (next == p || next > lend) return -7;
+            buf[ix * ny_ + iy] = v;
+            p = next;
+        }
+        return 0;
+    });
+    std::fclose(fp);
+    if (rc != 0) { std::free(buf); return rc; }
+    *out = buf;
+    *nx = nx_;
+    *ny = ny_;
+    return 0;
+}
+
+void heat_free(float* p) { std::free(p); }
 
 // inidat: u[ix][iy] = ix*(nx-ix-1)*iy*(ny-iy-1), evaluated in double then
 // cast (NOT the reference's int arithmetic, which overflows for nx>~215).
@@ -63,6 +259,6 @@ void heat_init_grid(float* u, int64_t nx, int64_t ny) {
     }
 }
 
-int heat_native_abi_version() { return 1; }
+int heat_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -38,6 +38,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from parallel_heat_tpu.ops.stencil import combine_2d, combine_3d
 from parallel_heat_tpu.parallel.halo import exchange_halos_2d
 
 _ACC = jnp.float32
@@ -134,7 +135,7 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
             D = blk[2:]
             L = jnp.roll(C, 1, axis=1)
             Rt = jnp.roll(C, -1, axis=1)
-            new = (C + cx * (U + D - 2.0 * C) + cy * (L + Rt - 2.0 * C))
+            new = combine_2d(C, U, D, L, Rt, cx, cy)
             return jnp.where(colmask, new, C), C
 
         def step_into(src, dst):
@@ -309,7 +310,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         D = sl[C0 + 1:C0 + 1 + T, :].astype(_ACC)
         Lf = jnp.roll(C, 1, axis=1)
         Rt = jnp.roll(C, -1, axis=1)
-        new = (C + cx * (U + D - 2.0 * C) + cy * (Lf + Rt - 2.0 * C))
+        new = combine_2d(C, U, D, Lf, Rt, cx, cy)
 
         row_off = offs_ref[0]
         col_off = offs_ref[1]
@@ -388,7 +389,10 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     itemsize = jnp.dtype(dtype).itemsize
     budget = 100 * 1024 * 1024
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
-    t_max = min(512, out_rows - 2 * sub)
+    # T caps at 256: measured on v5e (tools/probe_temporal.py), T=512
+    # variants hit Mosaic register-allocator spills (up to 45 MiB of
+    # spill slots) and run anywhere from 8% to 5x slower than T=256.
+    t_max = min(256, out_rows - 2 * sub)
     best = None
     for t in range(sub, t_max + 1, sub):
         if out_rows % t != 0:
@@ -479,7 +483,7 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
             D = blk[2:]
             Lf = jnp.roll(C, 1, axis=1)
             Rt = jnp.roll(C, -1, axis=1)
-            new = (C + cx * (U + D - 2.0 * C) + cy * (Lf + Rt - 2.0 * C))
+            new = combine_2d(C, U, D, Lf, Rt, cx, cy)
             rows_g = (s * T + (r0 - C0)
                       + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
             keep = colmask & (rows_g >= 1) & (rows_g <= M - 2)
@@ -686,9 +690,8 @@ def _edge_column_update(core, halos, row_off, col_off, grid_shape, cx, cy):
         center = center.astype(_ACC)
         up = jnp.concatenate([up_h.astype(_ACC).reshape(1), center[:-1]])
         down = jnp.concatenate([center[1:], dn_h.astype(_ACC).reshape(1)])
-        new = (center + cx * (up + down - 2.0 * center)
-               + cy * (left.astype(_ACC) + right.astype(_ACC)
-                       - 2.0 * center))
+        new = combine_2d(center, up, down, left.astype(_ACC),
+                         right.astype(_ACC), cx, cy)
         mask = rmask & (col_g >= 1) & (col_g <= NY - 2)
         out = jnp.where(mask, new, center)
         res = jnp.max(jnp.where(mask, jnp.abs(new - center), 0.0))
@@ -886,7 +889,7 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         D = sl[C0R + 1:C0R + 1 + T, C0C:C0C + CW].astype(_ACC)
         Lf = sl[C0R:C0R + T, C0C - 1:C0C - 1 + CW].astype(_ACC)
         Rt = sl[C0R:C0R + T, C0C + 1:C0C + 1 + CW].astype(_ACC)
-        new = (C + cx * (U + D - 2.0 * C) + cy * (Lf + Rt - 2.0 * C))
+        new = combine_2d(C, U, D, Lf, Rt, cx, cy)
 
         row_off = offs_ref[0]
         col_off = offs_ref[1]
@@ -1049,10 +1052,7 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
         Yp = sl[2:2 + SX, C0Y + 1:C0Y + 1 + TY, :].astype(_ACC)
         Zm = jnp.roll(C, 1, axis=2)
         Zp = jnp.roll(C, -1, axis=2)
-        new = (C
-               + cx * (Xm + Xp - 2.0 * C)
-               + cy * (Ym + Yp - 2.0 * C)
-               + cz * (Zm + Zp - 2.0 * C))
+        new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
 
         xs = (sx * SX
               + lax.broadcasted_iota(jnp.int32, (SX, TY, Z), 0))
@@ -1225,9 +1225,7 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k):
             Yp = jnp.roll(C, -1, axis=1)
             Zm = jnp.roll(C, 1, axis=2)
             Zp = jnp.roll(C, -1, axis=2)
-            new = (C + cx * (Xm + Xp - 2.0 * C)
-                   + cy * (Ym + Yp - 2.0 * C)
-                   + cz * (Zm + Zp - 2.0 * C))
+            new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
             rows_g = (s * sx + (r0 - C0)
                       + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
             keep = yzmask & (rows_g >= 1) & (rows_g <= X - 2)

@@ -15,6 +15,22 @@ Everything here is shape-polymorphic pure functions: XLA fuses the shifted
 reads into a single HBM pass, which on TPU makes this path bandwidth-bound
 — the Pallas kernels in ``pallas_stencil.py`` exist to beat that bound via
 temporal blocking, not to reproduce it.
+
+Two combine forms coexist, by design:
+
+- The **jnp paths** (this module + ``parallel/halo.py``) evaluate the
+  reference's textbook tree ``c + cx*(up+down-2c) + cy*(left+right-2c)``.
+  Measured on XLA:CPU, this tree compiles shape-independently — the
+  foundation of the "sharded == single-device, bitwise" invariant
+  (SEMANTICS.md) — whereas factored forms get FMA-contracted differently
+  at different shapes (one-ulp divergence between a full grid and a
+  shard block of the same program).
+- The **Pallas kernels** evaluate the factored form
+  :func:`combine_2d` / :func:`combine_3d` (``a0*c + cx*(up+down) +
+  cy*(left+right)``, ``a0 = 1-2cx-2cy``): 5 VPU ops per cell instead
+  of 8, measured ~1.75x faster on the streaming kernels
+  (tools/probe_temporal.py). Pallas-vs-jnp agreement is specified as
+  few-ulp, never bitwise (SEMANTICS.md "Precision").
 """
 
 from __future__ import annotations
@@ -26,13 +42,31 @@ import jax.numpy as jnp
 _ACC = jnp.float32
 
 
+def combine_2d(c, up, down, left, right, cx: float, cy: float):
+    """Factored 5-point combine (Pallas compute paths only — see module
+    docstring): ``a0*c + cx*(up+down) + cy*(left+right)``.
+
+    Algebraically identical to the reference's form
+    (``cuda/cuda_heat.cu:57-65``) with ``a0 = 1 - 2cx - 2cy`` folded to
+    one f32 constant at trace time. All operands must already be f32.
+    """
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+    return a0 * c + cx * (up + down) + cy * (left + right)
+
+
+def combine_3d(c, xm, xp, ym, yp, zm, zp, cx: float, cy: float, cz: float):
+    """7-point combine, same factoring: ``a0 = 1 - 2cx - 2cy - 2cz``."""
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy - 2.0 * cz
+    return a0 * c + cx * (xm + xp) + cy * (ym + yp) + cz * (zm + zp)
+
+
 def stencil_interior_2d(u, cx: float, cy: float):
     """5-point update of every *expressible* cell of ``u``.
 
     Input ``(m, n)`` -> output ``(m-2, n-2)``: the update value for each
     cell that has all four neighbors inside ``u``. Used both on full grids
     (interior = non-boundary) and on halo-padded shard blocks (interior =
-    the whole block).
+    the whole block). Textbook tree — see module docstring.
     """
     u = u.astype(_ACC)
     c = u[1:-1, 1:-1]

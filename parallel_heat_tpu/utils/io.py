@@ -46,8 +46,16 @@ def write_dat(path: str | os.PathLike, u, use_native: bool = True) -> None:
         fp.write(_format_dat_python(u))
 
 
-def read_dat(path: str | os.PathLike) -> np.ndarray:
+def read_dat(path: str | os.PathLike, use_native: bool = True) -> np.ndarray:
     """Read a ``.dat`` file back into the ``(nx, ny)`` array convention."""
+    if use_native:
+        try:
+            from parallel_heat_tpu.native import binding as _native
+
+            if _native.available():
+                return _native.read_dat(str(path))
+        except Exception:
+            pass  # fall back to Python parser
     rows = []
     with open(path) as fp:
         for line in fp:

@@ -49,3 +49,45 @@ def test_native_writer_error_on_bad_path():
     u = np.zeros((3, 3), dtype=np.float32)
     with pytest.raises(OSError):
         binding.write_dat("/nonexistent-dir/x.dat", u)
+
+
+@needs_native
+def test_native_mt_writer_byte_identical(tmp_path):
+    u = (np.random.default_rng(2).standard_normal((257, 129)) * 300).astype(
+        np.float32
+    )
+    p1, p2 = tmp_path / "mt.dat", tmp_path / "st.dat"
+    binding.write_dat(p1, u, threads=4)
+    binding.write_dat(p2, u, threads=1)
+    assert p1.read_bytes() == p2.read_bytes()
+    assert p1.read_bytes() == _format_dat_python(u).encode()
+
+
+@needs_native
+def test_native_reader_roundtrip():
+    from parallel_heat_tpu.utils.io import read_dat
+
+    u = HeatPlate2D(41, 23).init_grid_np(np.float32)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rt.dat")
+        write_dat(p, u)
+        got_native = read_dat(p, use_native=True)
+        got_python = read_dat(p, use_native=False)
+    np.testing.assert_array_equal(got_native, got_python)
+    # %6.1f quantizes to 0.1: compare against the rounded grid
+    np.testing.assert_allclose(got_native, np.round(u, 1), atol=0.051)
+
+
+@needs_native
+def test_native_reader_error_on_missing_file():
+    with pytest.raises(OSError):
+        binding.read_dat("/nonexistent-dir/x.dat")
+
+
+@needs_native
+def test_native_reader_rejects_ragged_lines(tmp_path):
+    p = tmp_path / "ragged.dat"
+    p.write_text("   1.0    2.0\n   3.0\n")
+    with pytest.raises(OSError):
+        binding.read_dat(str(p))

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Kernel-E anatomy probe: where does the temporal strip kernel's time go?
+
+Kernel A (VMEM-resident) sustains ~189 Gcells*steps/s; kernel E at
+16384^2 K=8 reaches ~113 even though its HBM traffic (~0.4 ms/step
+equivalent) should hide entirely behind compute (~1.4 ms/step at kernel
+A's rate). Each variant below changes one suspected cost. Slope timing
+(chained batches, terminal device->host flush), like kernel_probe.py.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.utils.profiling import chain_slope, sync
+
+CP = pltpu.CompilerParams(vmem_limit_bytes=128 * 1024 * 1024)
+SUB = 8
+LANE = 128
+
+
+def build(shape, k, T, substrip, variant):
+    M, N = shape
+    dtype = jnp.float32
+    cx = cy = 0.1
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+    n_strips = M // T
+    W = T + 2 * SUB
+    SCR = T + 4 * SUB
+    C0 = 2 * SUB
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        colmask = (cols >= 1) & (cols <= N - 2)
+
+        def dma(slot, strip):
+            start = pl.multiple_of(
+                jnp.clip(strip * T - SUB, 0, M - W), SUB)
+            dst = pl.multiple_of(C0 + start - strip * T, SUB)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, pl.ds(dst, W), :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :]
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            L = jnp.roll(C, 1, axis=1)
+            R = jnp.roll(C, -1, axis=1)
+            if variant in ("coeff",):
+                new = a0 * C + cx * (U + D) + cy * (L + R)
+            else:
+                new = (C + cx * (U + D - 2.0 * C)
+                       + cy * (L + R - 2.0 * C))
+            if variant == "norowmask":
+                keep = colmask & jnp.ones((h, 1), jnp.bool_)
+            else:
+                rows_g = (s * T + (r0 - C0)
+                          + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+                keep = colmask & (rows_g >= 1) & (rows_g <= M - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(substrip, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :] = new.astype(dtype)
+                r0 += h
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        if variant == "unroll":
+            src = sref
+            for i in range(m):
+                dstb = pp if src is sref else sref
+                step_into(src, dstb, SUB, T + 3 * SUB)
+                src = dstb
+        else:
+            def double_step(_, carry):
+                del carry
+                step_into(sref, pp, SUB, T + 3 * SUB)
+                step_into(pp, sref, SUB, T + 3 * SUB)
+                return 0
+
+            lax.fori_loop(0, m // 2, double_step, 0)
+            src = sref
+            if m % 2 == 1:
+                step_into(sref, pp, SUB, T + 3 * SUB)
+                src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(substrip, C0 + T - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
+            if variant != "nores":
+                r_acc = jnp.maximum(
+                    r_acc, jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        @pl.when(s > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, N), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, N), dtype),
+            pltpu.VMEM((SCR, N), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=CP,
+    )
+
+
+def bench(shape, k, T, substrip, variant, r2=8):
+    u0 = jax.block_until_ready(HeatPlate2D(*shape).init_grid(jnp.float32))
+    call = build(shape, k, T, substrip, variant)
+    run = jax.jit(lambda u: call(u)[0])
+    sync(run(u0))
+    per = chain_slope(run, u0, 1, 1 + r2) / k
+    cells = shape[0] * shape[1]
+    print(f"{shape} k={k:2d} T={T:4d} sub={substrip:4d} {variant:10s}: "
+          f"{per*1e6:9.1f} us/step {cells/per/1e9:7.1f} Gcells*steps/s")
+
+
+if __name__ == "__main__":
+    shape = (8192, 8192)
+    for variant in ["base", "coeff", "nores", "norowmask", "unroll"]:
+        bench(shape, 8, 256, 64, variant)
+    for T in (128, 256, 512):
+        for substrip in (64, 128, 256):
+            if substrip > T + 2 * SUB:
+                continue
+            bench(shape, 8, T, substrip, "base")
+    for k in (2, 4, 8):
+        bench(shape, k, 256, 64, "base")
