@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write initial grid (reference: initial_im.dat)")
     ap.add_argument("--checkpoint", default=None, metavar="FILE",
                     help="write an .npz checkpoint of the final state")
+    ap.add_argument("--checkpoint-every", type=int, default=None,
+                    metavar="N",
+                    help="also checkpoint every N steps during the run "
+                         "(requires --checkpoint; the file is overwritten "
+                         "each time, so --resume always sees the latest)")
     ap.add_argument("--resume", default=None, metavar="FILE",
                     help="resume from an .npz checkpoint")
     ap.add_argument("--profile", default=None, metavar="DIR",
@@ -128,14 +133,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               else make_initial_grid(config))
         say(f"Initial grid written to {written}")
 
+    if args.checkpoint_every is not None:
+        if not args.checkpoint:
+            print("error: --checkpoint-every requires --checkpoint",
+                  file=sys.stderr)
+            return 2
+        if args.checkpoint_every < 1:
+            print(f"error: --checkpoint-every must be >= 1, got "
+                  f"{args.checkpoint_every}", file=sys.stderr)
+            return 2
+
+    def _run():
+        if args.checkpoint_every is None:
+            return solve(config, initial=initial)
+        # Periodic-checkpoint driver: chunked solve, snapshot after
+        # every chunk (overwriting, so a crash resumes from the latest).
+        from parallel_heat_tpu.solver import solve_stream
+        from parallel_heat_tpu.utils.checkpoint import save_checkpoint
+
+        result = None
+        for result in solve_stream(config, initial=initial,
+                                   chunk_steps=args.checkpoint_every):
+            written = save_checkpoint(args.checkpoint, result.to_numpy(),
+                                      start_step + result.steps_run, config)
+            say(f"Checkpoint at step {start_step + result.steps_run} "
+                f"-> {written}")
+        if result is None:  # steps == 0
+            result = solve(config, initial=initial)
+        return result
+
     if args.profile:
         import jax
 
         with jax.profiler.trace(args.profile):
-            result = solve(config, initial=initial)
+            result = _run()
         say(f"Profiler trace written to {args.profile}")
     else:
-        result = solve(config, initial=initial)
+        result = _run()
 
     total_steps = start_step + result.steps_run
     if config.converge:

@@ -90,22 +90,29 @@ def _dtype_of(config: HeatConfig):
 # Loop construction (shared by single-device and per-shard programs)
 # --------------------------------------------------------------------------
 
-def steps_to_multistep(step, step_residual):
+def steps_to_multistep(step, step_residual, unroll: int = 1):
     """Lift single-step fns to the ``multi_step(u, k)`` interface.
 
     Backends that fuse many steps per invocation (the VMEM-resident
     Pallas kernel) provide ``multi_step`` natively; plain per-step
     backends get this fori_loop lifting.
+
+    ``unroll > 1`` amortizes the per-iteration loop-carry copy XLA
+    inserts when the body ends in a custom call (a Pallas kernel's
+    output cannot alias the fixed carry buffer); pure-HLO jnp steps
+    update the carry in place and should keep ``unroll=1``.
     """
 
     def multi_step(u, k):
-        return lax.fori_loop(0, k, lambda i, uu: step(uu), u)
+        return lax.fori_loop(0, k, lambda i, uu: step(uu), u,
+                             unroll=unroll)
 
     def multi_step_residual(u, k):
         # k-1 plain steps, then one step with a fused residual — the
         # residual is the diff of the *last* step of the chunk, matching
         # the reference's consecutive-buffer check (mpi/...stat.c:245).
-        u = lax.fori_loop(0, k - 1, lambda i, uu: step(uu), u)
+        u = lax.fori_loop(0, k - 1, lambda i, uu: step(uu), u,
+                          unroll=unroll)
         return step_residual(u)
 
     return multi_step, multi_step_residual
@@ -291,6 +298,78 @@ def make_initial_grid(config: HeatConfig) -> jax.Array:
     return jax.jit(lambda: model.init_grid(dtype))()
 
 
+def _prepare_initial(config: HeatConfig,
+                     initial: Optional[jax.Array]) -> jax.Array:
+    """Default, validate, copy (runners donate their input buffer)."""
+    if initial is None:
+        return jax.block_until_ready(make_initial_grid(config))
+    if tuple(initial.shape) != config.shape:
+        raise ValueError(
+            f"initial grid shape {tuple(initial.shape)} does not match "
+            f"config shape {config.shape}"
+        )
+    # Copy (the runner donates its input buffer — protect the caller)
+    # and honor the configured storage dtype (e.g. resuming an f32
+    # checkpoint into a bf16 run).
+    out = jnp.copy(jnp.asarray(initial).astype(_dtype_of(config)))
+    return jax.block_until_ready(out)
+
+
+def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
+                 chunk_steps: Optional[int] = None):
+    """Iterate the simulation in host-visible chunks; yields a
+    :class:`HeatResult` after each chunk (cumulative ``steps_run``).
+
+    The periodic-snapshot driver: between chunks the caller may
+    checkpoint (``utils.checkpoint.save_checkpoint``), stream metrics,
+    or render — state the reference exposes only at program exit
+    (SURVEY.md §5 "Checkpoint/resume: none"). Each chunk runs the same
+    compiled program ``solve`` uses (donated double-buffers, on-device
+    convergence), so chunking costs one dispatch per chunk, nothing
+    more. In converge mode ``chunk_steps`` is rounded up to a multiple
+    of ``check_interval``, keeping the check schedule identical to an
+    unchunked run; iteration stops at convergence.
+
+    Consume each yielded grid (e.g. ``np.asarray`` / checkpoint) before
+    advancing the generator: the next chunk donates that buffer to XLA.
+    """
+    config = config.validate()
+    if chunk_steps is not None and chunk_steps < 1:
+        raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+    total = config.steps
+    chunk = chunk_steps if chunk_steps else max(1, total)
+    if config.converge:
+        ci = config.check_interval
+        chunk = ((chunk + ci - 1) // ci) * ci
+    u = _prepare_initial(config, initial)
+
+    import time
+
+    done = 0
+    elapsed = 0.0
+    while done < total:
+        c = min(chunk, total - done)
+        runner, _ = _build_runner(config.replace(steps=c))
+        t0 = time.perf_counter()
+        grid, k, conv, res = runner(u)
+        jax.block_until_ready(grid)
+        k = int(k)
+        elapsed += time.perf_counter() - t0
+        done += k
+        u = grid
+        if config.converge:
+            out_conv: Optional[bool] = bool(conv)
+            out_res: Optional[float] = float(res)
+        else:
+            out_conv, out_res = None, None
+        yield HeatResult(grid=grid, steps_run=done, converged=out_conv,
+                         residual=out_res, elapsed_s=elapsed)
+        if config.converge and out_conv:
+            return
+        if k < c:  # defensive: a chunk that under-ran without converging
+            return
+
+
 def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
           block_until_ready: bool = True) -> HeatResult:
     """Run one simulation end-to-end. The main entry point.
@@ -306,20 +385,7 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
 
     config = config.validate()
     runner, _ = _build_runner(config)
-    if initial is None:
-        initial = make_initial_grid(config)
-    else:
-        if tuple(initial.shape) != config.shape:
-            raise ValueError(
-                f"initial grid shape {tuple(initial.shape)} does not match "
-                f"config shape {config.shape}"
-            )
-        # Copy (the runner donates its input buffer — protect the caller)
-        # and honor the configured storage dtype (e.g. resuming an f32
-        # checkpoint into a bf16 run).
-        initial = jnp.asarray(initial).astype(_dtype_of(config))
-        initial = jnp.copy(initial)
-    initial = jax.block_until_ready(initial)
+    initial = _prepare_initial(config, initial)
 
     t0 = time.perf_counter()
     grid, steps_run, converged, residual = runner(initial)

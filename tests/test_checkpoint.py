@@ -35,3 +35,50 @@ def test_resume_continues_exactly(tmp_path):
                  initial=grid)
     direct = solve(HeatConfig(nx=16, ny=16, steps=50, backend="jnp"))
     np.testing.assert_array_equal(rest.to_numpy(), direct.to_numpy())
+
+
+def test_solve_stream_matches_unchunked():
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=16, ny=16, steps=50, backend="jnp")
+    direct = solve(cfg)
+    seen = []
+    last = None
+    for last in solve_stream(cfg, chunk_steps=20):
+        seen.append((last.steps_run, last.to_numpy()))
+    assert [s for s, _ in seen] == [20, 40, 50]
+    np.testing.assert_array_equal(last.to_numpy(), direct.to_numpy())
+
+
+def test_solve_stream_converge_stops_early():
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=12, ny=12, steps=10_000, converge=True,
+                     check_interval=20, backend="jnp")
+    direct = solve(cfg)
+    results = list(solve_stream(cfg, chunk_steps=500))
+    last = results[-1]
+    assert last.converged
+    assert last.steps_run == direct.steps_run
+    np.testing.assert_array_equal(last.to_numpy(), direct.to_numpy())
+
+
+def test_solve_stream_chunk_rounds_to_check_interval():
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=12, ny=12, steps=100, converge=True,
+                     check_interval=20, backend="jnp")
+    # chunk 30 -> rounded to 40; schedule stays identical to unchunked
+    steps_seen = [r.steps_run for r in solve_stream(cfg, chunk_steps=30)]
+    direct = solve(cfg)
+    assert steps_seen[-1] == direct.steps_run
+
+
+def test_solve_stream_rejects_bad_chunk():
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=12, ny=12, steps=10, backend="jnp")
+    with pytest.raises(ValueError, match="chunk_steps"):
+        next(solve_stream(cfg, chunk_steps=0))
+    with pytest.raises(ValueError, match="chunk_steps"):
+        next(solve_stream(cfg, chunk_steps=-8))

@@ -64,3 +64,34 @@ def test_cli_checkpoint_resume_matches_uninterrupted(tmp_path, capsys):
     assert main(["--nx", "16", "--ny", "16", "--steps", "50",
                  "--backend", "jnp", "--out", str(out2), "--quiet"]) == 0
     np.testing.assert_array_equal(read_dat(out), read_dat(out2))
+
+
+def test_cli_checkpoint_every(tmp_path, capsys):
+    ck = tmp_path / "live.npz"
+    rc = main(["--nx", "16", "--ny", "16", "--steps", "50",
+               "--backend", "jnp", "--checkpoint", str(ck),
+               "--checkpoint-every", "20"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("Checkpoint at step") == 3  # 20, 40, 50
+    from parallel_heat_tpu.utils.checkpoint import load_checkpoint
+
+    grid, step, _ = load_checkpoint(ck)
+    assert step == 50
+    from parallel_heat_tpu import HeatConfig, solve
+
+    direct = solve(HeatConfig(nx=16, ny=16, steps=50, backend="jnp"))
+    np.testing.assert_array_equal(grid, direct.to_numpy())
+
+
+def test_cli_checkpoint_every_requires_checkpoint():
+    rc = main(["--nx", "16", "--ny", "16", "--steps", "50",
+               "--backend", "jnp", "--checkpoint-every", "20"])
+    assert rc == 2
+
+
+def test_cli_checkpoint_every_rejects_nonpositive(tmp_path):
+    rc = main(["--nx", "16", "--ny", "16", "--steps", "50",
+               "--backend", "jnp", "--checkpoint", str(tmp_path / "c.npz"),
+               "--checkpoint-every", "-8"])
+    assert rc == 2
