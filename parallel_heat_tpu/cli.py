@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "'auto' factorizes over all local devices)")
     ap.add_argument("--no-overlap", action="store_true",
                     help="disable the interior/edge comm-compute overlap")
+    ap.add_argument("--halo-depth", type=int, default=1, metavar="K",
+                    help="exchange K-deep halos once per K steps instead "
+                         "of 1-deep every step (sharded 2D runs)")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write final grid (.dat for 2D, .npy otherwise)")
     ap.add_argument("--initial-out", default=None, metavar="FILE",
@@ -92,13 +95,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         steps=args.steps, converge=args.converge, eps=args.eps,
         check_interval=args.check_interval, dtype=args.dtype,
         backend=args.backend, mesh_shape=_parse_mesh(args.mesh, ndim),
-        overlap=not args.no_overlap,
+        overlap=not args.no_overlap, halo_depth=args.halo_depth,
     )
     try:
         config.validate()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.checkpoint_every is not None:
+        # Validate before any side effect (banner, resume load, file
+        # writes) so a pure argument error leaves nothing behind.
+        if not args.checkpoint:
+            print("error: --checkpoint-every requires --checkpoint",
+                  file=sys.stderr)
+            return 2
+        if args.checkpoint_every < 1:
+            print(f"error: --checkpoint-every must be >= 1, got "
+                  f"{args.checkpoint_every}", file=sys.stderr)
+            return 2
 
     say = (lambda *a: None) if args.quiet else print
     mesh = config.mesh_or_unit()
@@ -132,16 +146,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         written = _write_grid(args.initial_out, initial if initial is not None
                               else make_initial_grid(config))
         say(f"Initial grid written to {written}")
-
-    if args.checkpoint_every is not None:
-        if not args.checkpoint:
-            print("error: --checkpoint-every requires --checkpoint",
-                  file=sys.stderr)
-            return 2
-        if args.checkpoint_every < 1:
-            print(f"error: --checkpoint-every must be >= 1, got "
-                  f"{args.checkpoint_every}", file=sys.stderr)
-            return 2
 
     def _run():
         if args.checkpoint_every is None:

@@ -65,6 +65,13 @@ class HeatConfig:
     # halo ppermutes with interior compute (mpi/...stat.c:162-234).
     overlap: bool = True
 
+    # Temporal blocking across the mesh: exchange K-deep halos once per
+    # K steps instead of 1-deep halos every step (parallel/temporal.py)
+    # — K x fewer collective rounds. 1 = the classic per-step exchange.
+    # Only meaningful for sharded 2D runs; results are bitwise identical
+    # either way on the jnp path.
+    halo_depth: int = 1
+
     # --- derived helpers -------------------------------------------------
 
     @property
@@ -136,6 +143,31 @@ class HeatConfig:
                 raise ValueError(
                     f"grid n{name}={n} is not divisible by mesh d{name}={d}"
                 )
+        if self.halo_depth < 1:
+            raise ValueError(
+                f"halo_depth must be >= 1, got {self.halo_depth}"
+            )
+        if self.halo_depth > 1:
+            if self.ndim != 2:
+                raise ValueError("halo_depth > 1 is 2D-only (for now)")
+            if self.backend == "pallas":
+                # The temporal-exchange path computes in jnp; silently
+                # dropping an explicit pallas request would surprise.
+                # (backend="auto" resolves to the jnp path, documented.)
+                raise ValueError(
+                    "halo_depth > 1 runs the jnp temporal-exchange path; "
+                    "use backend='jnp' or 'auto' (an explicit 'pallas' "
+                    "would be silently ignored)"
+                )
+            if any(d > 1 for d in mesh):
+                bmin = min(self.block_shape())
+                if self.halo_depth > bmin:
+                    # A deeper halo than one block would need multi-hop
+                    # exchanges (neighbors only own block-width strips).
+                    raise ValueError(
+                        f"halo_depth={self.halo_depth} exceeds the "
+                        f"smallest block extent {bmin}"
+                    )
         return self
 
     # --- (de)serialization ----------------------------------------------

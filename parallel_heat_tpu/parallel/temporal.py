@@ -1,0 +1,167 @@
+"""K-deep halo exchange: temporal blocking across the device mesh.
+
+The single-chip temporal kernels (``ops/pallas_stencil.py`` kernels E/F)
+advance K steps per HBM pass. This module applies the same trade across
+the *mesh*: exchange K-deep halos once, then advance K steps locally —
+K× fewer collective rounds per step than the 1-deep exchange of
+``parallel/halo.py``, at the cost of a thin band of redundant compute
+(``2K(bx+by+2K)`` cells per block per round, vanishing for large
+blocks). This is the stencil-world analog of ring-attention-style
+communication avoidance for long sequences: fewer, larger neighbor
+messages, latency hidden behind a K-step compute window — where the
+reference exchanges 1-cell halos every step over persistent MPI
+requests (``mpi/mpi_heat_improved_persistent_stat.c:130-161``).
+
+Corner exchange: after one step, a block-edge cell depends on diagonal
+neighbors' cells (the 5-point stencil's K-step dependency cone is the
+L1 ball ``|di|+|dj| <= K``, which for K >= 2 reaches into the corner
+blocks). The classic two-phase trick makes 4 messages carry all 8
+neighbors' data: exchange the K-wide *column* strips first, then the
+K-tall *row* strips of the column-extended block — the row strips then
+contain the corners.
+
+Validity at the domain boundary is the same shrinking-frontier argument
+as the clamped DMA windows in kernel E (``ops/pallas_stencil.py``):
+edge devices receive zeros from ``ppermute`` where no neighbor exists,
+but every step masks global-boundary cells back to their Dirichlet
+values, so out-of-domain garbage never crosses the boundary ring into
+the interior.
+
+All arithmetic is the jnp textbook tree (``stencil_interior_2d``), so
+results are bitwise identical to the 1-deep sharded path and to a
+single-device run (the jnp backend's invariant, SEMANTICS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_heat_tpu.ops.stencil import stencil_interior_2d
+from parallel_heat_tpu.parallel.halo import _shift_down, _shift_up
+
+_ACC = jnp.float32
+
+
+def exchange_halos_deep_2d(u, k: int, mesh_shape: Tuple[int, int],
+                           axis_names: Tuple[str, str] = ("x", "y")):
+    """Return the ``(bx+2k, by+2k)`` padded block, corners included.
+
+    Two ppermute phases of two shifts each (4 messages total, like the
+    1-deep exchange — the messages are just K rows/columns wide).
+    Devices at domain edges receive zeros for the missing neighbors.
+    """
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    dt = u.dtype
+    # Phase 1: K-wide column strips along the y axis.
+    halo_w = _shift_down(u[:, -k:], ay, dy)
+    halo_e = _shift_up(u[:, :k], ay, dy)
+    uy = jnp.concatenate([halo_w.astype(dt), u, halo_e.astype(dt)], axis=1)
+    # Phase 2: K-tall row strips of the *extended* block along x —
+    # these carry the corner data from the diagonal neighbors.
+    halo_n = _shift_down(uy[-k:, :], ax, dx)
+    halo_s = _shift_up(uy[:k, :], ax, dx)
+    return jnp.concatenate([halo_n.astype(dt), uy, halo_s.astype(dt)],
+                           axis=0)
+
+
+def _inner_mask(padded_shape, k, grid_shape, block_shape, block_index):
+    """Global-interior mask for the padded block's inner region.
+
+    Inner region = ``padded[1:-1, 1:-1]`` (every cell the stencil can
+    express). Cells outside the global grid, or on its Dirichlet
+    boundary, are masked (held at their current value).
+    """
+    px, py = padded_shape
+    nx, ny = grid_shape
+    bx, by = block_shape
+    bi, bj = block_index
+    row = bi * bx - k + 1 + jnp.arange(px - 2, dtype=jnp.int32)
+    col = bj * by - k + 1 + jnp.arange(py - 2, dtype=jnp.int32)
+    rmask = (row >= 1) & (row <= nx - 2)
+    cmask = (col >= 1) & (col <= ny - 2)
+    return rmask[:, None] & cmask[None, :]
+
+
+def block_multistep_2d(u, k: int, *, mesh_shape, grid_shape, block_index,
+                       cx, cy, axis_names=("x", "y"),
+                       with_residual: bool = False):
+    """Advance a ``(bx, by)`` block ``k`` steps with ONE halo exchange.
+
+    Returns ``new_block`` or ``(new_block, residual)`` — the residual is
+    the global (pmax-reduced) max-norm of the *last* step's update over
+    this block's core cells, matching the solver's convergence quantity.
+    After k masked steps on the k-deep padded block, the central core is
+    exact: each step consumes one ring of the halo (L1 dependency cone),
+    and the Dirichlet masking pins the boundary every step.
+    """
+    assert k >= 1
+    bx, by = u.shape
+    ext = exchange_halos_deep_2d(u, k, mesh_shape, axis_names)
+    mask = _inner_mask(ext.shape, k, grid_shape, (bx, by), block_index)
+
+    res = None
+    for j in range(k):
+        new_inner = stencil_interior_2d(ext, cx, cy)
+        cur_inner = ext[1:-1, 1:-1]
+        if with_residual and j == k - 1:
+            # Core cells sit at inner coords [k-1 : k-1+bx, k-1 : k-1+by].
+            diff = jnp.where(mask, jnp.abs(new_inner - cur_inner.astype(_ACC)),
+                             0.0)[k - 1:k - 1 + bx, k - 1:k - 1 + by]
+            res = lax.pmax(jnp.max(diff), axis_names)
+        upd = jnp.where(mask, new_inner.astype(ext.dtype), cur_inner)
+        ext = ext.at[1:-1, 1:-1].set(upd)
+
+    core = ext[k:-k, k:-k]
+    if with_residual:
+        return core, res
+    return core
+
+
+def block_temporal_multistep(config, kw):
+    """``(multi_step, multi_step_residual)`` on K-deep exchanges.
+
+    ``kw`` carries the block geometry (same contract as the per-step
+    halo path). An n-step advance runs ``n // K`` rounds of K plus one
+    remainder round of depth ``n % K`` — exact for any n, so the
+    convergence check schedule is untouched.
+    """
+    K = config.halo_depth
+
+    def rounds(u, n, with_residual):
+        full, rem = divmod(n, K)
+        out_res = None
+
+        def round_k(uu, depth, want_res):
+            return block_multistep_2d(uu, depth, with_residual=want_res,
+                                      **kw)
+
+        # All full rounds except the last run under fori_loop (pure-HLO
+        # body: the carry updates in place, no unroll needed).
+        last_full_wants_res = with_residual and rem == 0 and full > 0
+        plain = full - 1 if full > 0 else 0
+        if plain > 0:
+            u = lax.fori_loop(0, plain,
+                              lambda i, uu: round_k(uu, K, False), u)
+        if full > 0:
+            if last_full_wants_res:
+                u, out_res = round_k(u, K, True)
+            else:
+                u = round_k(u, K, False)
+        if rem:
+            if with_residual:
+                u, out_res = round_k(u, rem, True)
+            else:
+                u = round_k(u, rem, False)
+        return u, out_res
+
+    def multi_step(u, n):
+        return rounds(u, n, False)[0]
+
+    def multi_step_residual(u, n):
+        return rounds(u, n, True)
+
+    return multi_step, multi_step_residual

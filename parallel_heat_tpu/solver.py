@@ -244,24 +244,35 @@ def _build_runner(config: HeatConfig):
     mesh = make_heat_mesh(mesh_shape)
     names = mesh.axis_names
     spec = P(*names)
-    use_pallas = backend == "pallas"
+    # halo_depth > 1 selects the jnp temporal-exchange path.
+    use_pallas = backend == "pallas" and config.halo_depth == 1
 
     def local_run(u_local):
         bidx = tuple(lax.axis_index(n) for n in names)
         kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
                   block_index=bidx, cx=config.cx, cy=config.cy,
                   axis_names=names, overlap=config.overlap)
-        if use_pallas:
+        if config.halo_depth > 1:
+            # K-deep temporal exchange: K steps per collective round
+            # (parallel/temporal.py). jnp compute path.
+            from parallel_heat_tpu.parallel import temporal
+
+            tkw = dict(kw)
+            tkw.pop("overlap")
+            ms, msr = temporal.block_temporal_multistep(config, tkw)
+            pre = post = lambda u: u
+        elif use_pallas:
             from parallel_heat_tpu.ops import pallas_stencil
 
             # The pallas block step carries an extended block between
             # steps; pre/post convert at loop entry/exit.
             step, stepr, pre, post = pallas_stencil.block_steps(config, kw)
+            ms, msr = steps_to_multistep(step, stepr)
         else:
             step = lambda u: block_step_2d(u, **kw)
             stepr = lambda u: block_step_2d_residual(u, **kw)
             pre = post = lambda u: u
-        ms, msr = steps_to_multistep(step, stepr)
+            ms, msr = steps_to_multistep(step, stepr)
         u_out, k, c, r = _make_loop(ms, msr, config)(pre(u_local))
         return post(u_out), k, c, r
 

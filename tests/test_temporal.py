@@ -1,0 +1,102 @@
+"""K-deep halo exchange (parallel/temporal.py) vs single-device runs.
+
+The temporal path evaluates the same jnp textbook tree per step, so its
+results must be bitwise identical to both the 1-deep sharded path and a
+single-device run — including across chunk remainders (n % K != 0),
+converge mode, and domain-edge blocks (where ppermute supplies zeros
+the Dirichlet mask must neutralize).
+"""
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.solver import solve_stream
+
+MESHES = [(2, 1), (1, 2), (2, 2), (2, 4), (4, 2)]
+
+
+def _want(nx, ny, **kw):
+    return solve(HeatConfig(nx=nx, ny=ny, backend="jnp", **kw)).to_numpy()
+
+
+@pytest.mark.parametrize("mesh", MESHES)
+@pytest.mark.parametrize("depth", [2, 4])
+def test_deep_halo_fixed_equals_single(mesh, depth):
+    # steps chosen to exercise both full rounds and a remainder round
+    for steps in (depth * 3, depth * 3 + 1):
+        want = _want(32, 32, steps=steps)
+        got = solve(
+            HeatConfig(nx=32, ny=32, steps=steps, backend="jnp",
+                       mesh_shape=mesh, halo_depth=depth)
+        ).to_numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_converge_equals_single():
+    kw = dict(steps=10_000, converge=True, check_interval=20)
+    want = solve(HeatConfig(nx=20, ny=20, backend="jnp", **kw))
+    got = solve(HeatConfig(nx=20, ny=20, backend="jnp", mesh_shape=(2, 2),
+                           halo_depth=4, **kw))
+    assert got.converged == want.converged
+    assert got.steps_run == want.steps_run
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_deep_halo_check_interval_not_multiple_of_depth():
+    # ci=20, K=8 -> rounds of 8+8+4 per check; schedule must be exact
+    kw = dict(steps=200, converge=True, check_interval=20, eps=1e-9)
+    want = solve(HeatConfig(nx=24, ny=24, backend="jnp", **kw))
+    got = solve(HeatConfig(nx=24, ny=24, backend="jnp", mesh_shape=(2, 2),
+                           halo_depth=8, **kw))
+    assert got.steps_run == want.steps_run
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_deep_halo_depth_equals_block_extent():
+    # halo as deep as the whole block: every exchanged strip is a full
+    # block (the hardest corner case the validator admits)
+    want = _want(16, 16, steps=13)
+    got = solve(
+        HeatConfig(nx=16, ny=16, steps=13, backend="jnp",
+                   mesh_shape=(2, 2), halo_depth=8)
+    ).to_numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_rejects_depth_beyond_block():
+    with pytest.raises(ValueError, match="halo_depth"):
+        HeatConfig(nx=16, ny=16, mesh_shape=(4, 4), halo_depth=5).validate()
+    with pytest.raises(ValueError, match="halo_depth"):
+        HeatConfig(nx=16, ny=16, halo_depth=0).validate()
+    with pytest.raises(ValueError, match="2D-only"):
+        HeatConfig(nx=16, ny=16, nz=16, mesh_shape=(2, 2, 2),
+                   halo_depth=2).validate()
+
+
+def test_deep_halo_with_solve_stream():
+    cfg = HeatConfig(nx=32, ny=32, steps=50, backend="jnp",
+                     mesh_shape=(2, 2), halo_depth=4)
+    want = _want(32, 32, steps=50)
+    last = None
+    for last in solve_stream(cfg, chunk_steps=20):
+        pass
+    assert last.steps_run == 50
+    np.testing.assert_array_equal(last.to_numpy(), want)
+
+
+def test_deep_halo_bf16_storage():
+    # per-step storage rounding must match the single-device bf16 run
+    kw = dict(steps=17, dtype="bfloat16")
+    want = _want(32, 32, **kw)
+    got = solve(
+        HeatConfig(nx=32, ny=32, backend="jnp", mesh_shape=(2, 4),
+                   halo_depth=4, **kw)
+    ).to_numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_rejects_explicit_pallas():
+    with pytest.raises(ValueError, match="temporal-exchange"):
+        HeatConfig(nx=16, ny=16, mesh_shape=(2, 2), halo_depth=2,
+                   backend="pallas").validate()
