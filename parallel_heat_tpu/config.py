@@ -68,7 +68,7 @@ class HeatConfig:
     # Temporal blocking across the mesh: exchange K-deep halos once per
     # K steps instead of 1-deep halos every step (parallel/temporal.py)
     # — K x fewer collective rounds. 1 = the classic per-step exchange.
-    # Only meaningful for sharded 2D runs; results are bitwise identical
+    # Applies to sharded runs (2D and 3D); results are bitwise identical
     # either way on the jnp path.
     halo_depth: int = 1
 
@@ -148,8 +148,6 @@ class HeatConfig:
                 f"halo_depth must be >= 1, got {self.halo_depth}"
             )
         if self.halo_depth > 1:
-            if self.ndim != 2:
-                raise ValueError("halo_depth > 1 is 2D-only (for now)")
             if self.backend == "pallas":
                 # The temporal-exchange path computes in jnp; silently
                 # dropping an explicit pallas request would surprise.

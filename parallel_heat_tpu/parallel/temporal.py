@@ -39,7 +39,10 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import lax
 
-from parallel_heat_tpu.ops.stencil import stencil_interior_2d
+from parallel_heat_tpu.ops.stencil import (
+    stencil_interior_2d,
+    stencil_interior_3d,
+)
 from parallel_heat_tpu.parallel.halo import _shift_down, _shift_up
 
 _ACC = jnp.float32
@@ -75,69 +78,122 @@ def _inner_mask(padded_shape, k, grid_shape, block_shape, block_index):
     express). Cells outside the global grid, or on its Dirichlet
     boundary, are masked (held at their current value).
     """
-    px, py = padded_shape
-    nx, ny = grid_shape
-    bx, by = block_shape
-    bi, bj = block_index
-    row = bi * bx - k + 1 + jnp.arange(px - 2, dtype=jnp.int32)
-    col = bj * by - k + 1 + jnp.arange(py - 2, dtype=jnp.int32)
-    rmask = (row >= 1) & (row <= nx - 2)
-    cmask = (col >= 1) & (col <= ny - 2)
-    return rmask[:, None] & cmask[None, :]
+    dims = len(padded_shape)
+    masks = []
+    for p, n, bs, bi in zip(padded_shape, grid_shape, block_shape,
+                            block_index):
+        idx = bi * bs - k + 1 + jnp.arange(p - 2, dtype=jnp.int32)
+        masks.append((idx >= 1) & (idx <= n - 2))
+    out = masks[0].reshape(masks[0].shape + (1,) * (dims - 1))
+    for d in range(1, dims):
+        shape = (1,) * d + masks[d].shape + (1,) * (dims - 1 - d)
+        out = out & masks[d].reshape(shape)
+    return out
+
+
+def _block_multistep(u, k, exchange, stencil_interior, *, mesh_shape,
+                     grid_shape, block_index, axis_names, with_residual):
+    """Rank-generic core of the K-step round: exchange, K masked steps,
+    slice the exact central core. The residual is the global
+    (pmax-reduced) max-norm of the *last* step's update over this
+    block's core cells, matching the solver's convergence quantity.
+    After k masked steps on the k-deep padded block the core is exact:
+    each step consumes one ring of the halo (L1 dependency cone), and
+    the Dirichlet masking pins the boundary every step.
+    """
+    assert k >= 1
+    dims = u.ndim
+    block_shape = u.shape
+    inner = (slice(1, -1),) * dims
+    core_of_inner = tuple(slice(k - 1, k - 1 + b) for b in block_shape)
+    core_of_ext = (slice(k, -k),) * dims
+
+    ext = exchange(u, k, mesh_shape, axis_names)
+    mask = _inner_mask(ext.shape, k, grid_shape, block_shape, block_index)
+
+    res = None
+    for j in range(k):
+        new_inner = stencil_interior(ext)
+        cur_inner = ext[inner]
+        if with_residual and j == k - 1:
+            diff = jnp.where(mask, jnp.abs(new_inner - cur_inner.astype(_ACC)),
+                             0.0)[core_of_inner]
+            res = lax.pmax(jnp.max(diff), axis_names)
+        upd = jnp.where(mask, new_inner.astype(ext.dtype), cur_inner)
+        ext = ext.at[inner].set(upd)
+
+    core = ext[core_of_ext]
+    if with_residual:
+        return core, res
+    return core
 
 
 def block_multistep_2d(u, k: int, *, mesh_shape, grid_shape, block_index,
                        cx, cy, axis_names=("x", "y"),
                        with_residual: bool = False):
-    """Advance a ``(bx, by)`` block ``k`` steps with ONE halo exchange.
+    """Advance a ``(bx, by)`` block ``k`` steps with ONE halo exchange."""
+    return _block_multistep(
+        u, k, exchange_halos_deep_2d,
+        lambda ext: stencil_interior_2d(ext, cx, cy),
+        mesh_shape=mesh_shape, grid_shape=grid_shape,
+        block_index=block_index, axis_names=axis_names,
+        with_residual=with_residual,
+    )
 
-    Returns ``new_block`` or ``(new_block, residual)`` — the residual is
-    the global (pmax-reduced) max-norm of the *last* step's update over
-    this block's core cells, matching the solver's convergence quantity.
-    After k masked steps on the k-deep padded block, the central core is
-    exact: each step consumes one ring of the halo (L1 dependency cone),
-    and the Dirichlet masking pins the boundary every step.
-    """
-    assert k >= 1
-    bx, by = u.shape
-    ext = exchange_halos_deep_2d(u, k, mesh_shape, axis_names)
-    mask = _inner_mask(ext.shape, k, grid_shape, (bx, by), block_index)
 
-    res = None
-    for j in range(k):
-        new_inner = stencil_interior_2d(ext, cx, cy)
-        cur_inner = ext[1:-1, 1:-1]
-        if with_residual and j == k - 1:
-            # Core cells sit at inner coords [k-1 : k-1+bx, k-1 : k-1+by].
-            diff = jnp.where(mask, jnp.abs(new_inner - cur_inner.astype(_ACC)),
-                             0.0)[k - 1:k - 1 + bx, k - 1:k - 1 + by]
-            res = lax.pmax(jnp.max(diff), axis_names)
-        upd = jnp.where(mask, new_inner.astype(ext.dtype), cur_inner)
-        ext = ext.at[1:-1, 1:-1].set(upd)
+def exchange_halos_deep_3d(u, k: int, mesh_shape: Tuple[int, int, int],
+                           axis_names: Tuple[str, str, str] = ("x", "y", "z")):
+    """Return the ``(bx+2k, by+2k, bz+2k)`` padded block, edges/corners
+    included — three ppermute phases of two shifts each (6 messages,
+    like the 1-deep face exchange; each later phase sends the already-
+    extended block's strips, so edge and corner data ride along)."""
+    dx, dy, dz = mesh_shape
+    ax, ay, az = axis_names
+    dt = u.dtype
+    lo_z = _shift_down(u[:, :, -k:], az, dz)
+    hi_z = _shift_up(u[:, :, :k], az, dz)
+    u = jnp.concatenate([lo_z.astype(dt), u, hi_z.astype(dt)], axis=2)
+    lo_y = _shift_down(u[:, -k:, :], ay, dy)
+    hi_y = _shift_up(u[:, :k, :], ay, dy)
+    u = jnp.concatenate([lo_y.astype(dt), u, hi_y.astype(dt)], axis=1)
+    lo_x = _shift_down(u[-k:, :, :], ax, dx)
+    hi_x = _shift_up(u[:k, :, :], ax, dx)
+    return jnp.concatenate([lo_x.astype(dt), u, hi_x.astype(dt)], axis=0)
 
-    core = ext[k:-k, k:-k]
-    if with_residual:
-        return core, res
-    return core
+
+def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
+                       cx, cy, cz, axis_names=("x", "y", "z"),
+                       with_residual: bool = False):
+    """3D analog of :func:`block_multistep_2d` (7-point; the K-step
+    dependency cone is again the L1 ball, covered by the cubic pad)."""
+    return _block_multistep(
+        u, k, exchange_halos_deep_3d,
+        lambda ext: stencil_interior_3d(ext, cx, cy, cz),
+        mesh_shape=mesh_shape, grid_shape=grid_shape,
+        block_index=block_index, axis_names=axis_names,
+        with_residual=with_residual,
+    )
 
 
 def block_temporal_multistep(config, kw):
     """``(multi_step, multi_step_residual)`` on K-deep exchanges.
 
     ``kw`` carries the block geometry (same contract as the per-step
-    halo path). An n-step advance runs ``n // K`` rounds of K plus one
-    remainder round of depth ``n % K`` — exact for any n, so the
-    convergence check schedule is untouched.
+    halo path; 2D or 3D is selected by the config). An n-step advance
+    runs ``n // K`` rounds of K plus one remainder round of depth
+    ``n % K`` — exact for any n, so the convergence check schedule is
+    untouched.
     """
     K = config.halo_depth
+    block_fn = (block_multistep_3d if config.ndim == 3
+                else block_multistep_2d)
 
     def rounds(u, n, with_residual):
         full, rem = divmod(n, K)
         out_res = None
 
         def round_k(uu, depth, want_res):
-            return block_multistep_2d(uu, depth, with_residual=want_res,
-                                      **kw)
+            return block_fn(uu, depth, with_residual=want_res, **kw)
 
         # All full rounds except the last run under fori_loop (pure-HLO
         # body: the carry updates in place, no unroll needed).

@@ -227,12 +227,17 @@ def _build_runner(config: HeatConfig):
             bidx = tuple(lax.axis_index(n) for n in names)
             kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
                       block_index=bidx, cx=config.cx, cy=config.cy,
-                      cz=config.cz, axis_names=names,
-                      overlap=config.overlap)
-            ms, msr = steps_to_multistep(
-                lambda u: halo3d.block_step_3d(u, **kw),
-                lambda u: halo3d.block_step_3d_residual(u, **kw),
-            )
+                      cz=config.cz, axis_names=names)
+            if config.halo_depth > 1:
+                from parallel_heat_tpu.parallel import temporal
+
+                ms, msr = temporal.block_temporal_multistep(config, kw)
+            else:
+                kw["overlap"] = config.overlap
+                ms, msr = steps_to_multistep(
+                    lambda u: halo3d.block_step_3d(u, **kw),
+                    lambda u: halo3d.block_step_3d_residual(u, **kw),
+                )
             return _make_loop(ms, msr, config)(u_local)
 
         run = _shard_map(

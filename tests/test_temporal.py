@@ -69,9 +69,10 @@ def test_deep_halo_rejects_depth_beyond_block():
         HeatConfig(nx=16, ny=16, mesh_shape=(4, 4), halo_depth=5).validate()
     with pytest.raises(ValueError, match="halo_depth"):
         HeatConfig(nx=16, ny=16, halo_depth=0).validate()
-    with pytest.raises(ValueError, match="2D-only"):
-        HeatConfig(nx=16, ny=16, nz=16, mesh_shape=(2, 2, 2),
-                   halo_depth=2).validate()
+    with pytest.raises(ValueError, match="halo_depth"):
+        # 3D: depth bounded by the smallest block extent too
+        HeatConfig(nx=16, ny=16, nz=16, mesh_shape=(2, 2, 4),
+                   halo_depth=5).validate()
 
 
 def test_deep_halo_with_solve_stream():
@@ -100,3 +101,66 @@ def test_deep_halo_rejects_explicit_pallas():
     with pytest.raises(ValueError, match="temporal-exchange"):
         HeatConfig(nx=16, ny=16, mesh_shape=(2, 2), halo_depth=2,
                    backend="pallas").validate()
+
+
+@pytest.mark.parametrize("mesh", [(2, 2, 2), (2, 1, 2), (1, 2, 4)])
+def test_deep_halo_3d_equals_single(mesh):
+    for steps in (6, 7):
+        want = solve(HeatConfig(nx=12, ny=12, nz=16, steps=steps,
+                                backend="jnp")).to_numpy()
+        got = solve(
+            HeatConfig(nx=12, ny=12, nz=16, steps=steps, backend="jnp",
+                       mesh_shape=mesh, halo_depth=3)
+        ).to_numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_3d_converge_equals_single():
+    kw = dict(steps=2000, converge=True, check_interval=20)
+    want = solve(HeatConfig(nx=10, ny=10, nz=10, backend="jnp", **kw))
+    got = solve(HeatConfig(nx=10, ny=10, nz=10, backend="jnp",
+                           mesh_shape=(2, 1, 1), halo_depth=5, **kw))
+    assert got.converged == want.converged
+    assert got.steps_run == want.steps_run
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_deep_halo_reduces_collectives():
+    """One K-deep round advances K steps with the SAME 4 ppermutes a
+    single 1-deep step needs — the K x communication reduction, counted
+    directly in the traced programs (loop-free jaxprs)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_heat_tpu.parallel.halo import block_step_2d
+    from parallel_heat_tpu.parallel.temporal import block_multistep_2d
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+    from parallel_heat_tpu.solver import _shard_map
+
+    mesh = make_heat_mesh((2, 2))
+    spec = P("x", "y")
+    K = 4
+    kw = dict(mesh_shape=(2, 2), grid_shape=(32, 32), cx=0.1, cy=0.1,
+              axis_names=("x", "y"))
+
+    def deep(u):
+        bidx = (jax.lax.axis_index("x"), jax.lax.axis_index("y"))
+        return block_multistep_2d(u, K, block_index=bidx, **kw)
+
+    def shallow(u):
+        bidx = (jax.lax.axis_index("x"), jax.lax.axis_index("y"))
+        for _ in range(K):  # K steps, unrolled: K x 4 ppermutes
+            u = block_step_2d(u, block_index=bidx, **kw)
+        return u
+
+    import jax.numpy as jnp
+
+    u = jnp.zeros((16, 16), jnp.float32)
+    n_deep = str(jax.make_jaxpr(
+        _shard_map(deep, mesh=mesh, in_specs=spec, out_specs=spec))(u)
+    ).count("ppermute")
+    n_shallow = str(jax.make_jaxpr(
+        _shard_map(shallow, mesh=mesh, in_specs=spec, out_specs=spec))(u)
+    ).count("ppermute")
+    assert n_deep == 4, n_deep
+    assert n_shallow == 4 * K, n_shallow
