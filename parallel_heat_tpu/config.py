@@ -148,14 +148,16 @@ class HeatConfig:
                 f"halo_depth must be >= 1, got {self.halo_depth}"
             )
         if self.halo_depth > 1:
-            if self.backend == "pallas":
-                # The temporal-exchange path computes in jnp; silently
-                # dropping an explicit pallas request would surprise.
-                # (backend="auto" resolves to the jnp path, documented.)
+            sub = 16 if self.dtype == "bfloat16" else 8
+            if self.backend == "pallas" and self.halo_depth != sub:
+                # Kernel G only exists at depth == the dtype's sublane
+                # count; any other depth would silently fall back to
+                # jnp rounds against an explicit pallas request.
                 raise ValueError(
-                    "halo_depth > 1 runs the jnp temporal-exchange path; "
-                    "use backend='jnp' or 'auto' (an explicit 'pallas' "
-                    "would be silently ignored)"
+                    f"backend='pallas' with halo_depth > 1 requires "
+                    f"halo_depth == {sub} for dtype {self.dtype} (the "
+                    f"Mosaic block kernel's depth); other depths run "
+                    f"the jnp rounds — use backend='jnp' or 'auto'"
                 )
             if any(d > 1 for d in mesh):
                 bmin = min(self.block_shape())

@@ -620,6 +620,192 @@ def _temporal_multistep(shape, dtype, cx, cy):
 
 
 # --------------------------------------------------------------------------
+# Kernel G: temporal-blocked step on a K-deep halo-padded shard block
+# --------------------------------------------------------------------------
+
+def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
+    """Strip height for kernel G (multiple of SUB, divides out_rows,
+    VMEM: 2 DMA slots + 1 ping-pong of (T+2*SUB) rows, double-buffered
+    (T, n_cols) output, f32 chunk temporaries)."""
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    budget = 100 * 1024 * 1024
+    temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
+    best = None
+    for t in range(sub, min(256, out_rows) + 1, sub):
+        if out_rows % t != 0:
+            continue
+        cost = (3 * (t + 2 * sub) + 2 * t) * n_cols * itemsize + temps
+        if cost <= budget:
+            best = t
+    return best
+
+
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
+                          k, vma=None):
+    """K steps on a ``(bx+2k, by+2k)`` halo-padded shard block.
+
+    The shard-level counterpart of kernel E, closing the loop with the
+    K-deep mesh exchange (``parallel/temporal.py``): the caller
+    ppermutes a k-deep halo once, this kernel advances the k steps in
+    VMEM, and only the exact core comes back. Requires ``k ==
+    _sub_rows(dtype)`` (8 for f32, 16 for sub-f32) — then every DMA
+    window ``[s*T, s*T + T + 2k)`` is in bounds and sublane-aligned
+    with no clamping, and the validity margins are exactly tight:
+    garbage frontiers (window edges, column-roll wrap at the padded
+    width) advance one cell per step and reach at most ``k-1`` cells
+    inward, while the core starts ``k`` cells in. Global Dirichlet
+    cells are pinned every step via the prefetched block offsets
+    (out-of-domain cells beyond them never propagate inward, same
+    argument as kernel E's clamped edges).
+
+    Returns ``fn(ext, row_off, col_off) -> ((bx, by+2k) core rows,
+    residual)`` — residual over core cells only — or None if the
+    geometry declines. ``row_off`` = global row of core row 0;
+    ``col_off`` = global col of padded col 0.
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    if k != SUB or bx < SUB:
+        return None
+    Np = by + 2 * k                      # padded width
+    T = _pick_block_strip(bx, Np, dtype)
+    if T is None:
+        return None
+    n_strips = bx // T
+    W = T + 2 * SUB                      # DMA window rows (= scratch rows)
+    C0 = SUB                             # scratch row of the strip's row 0
+
+    def kernel(offs_ref, ext_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+
+        cols_l = lax.broadcasted_iota(jnp.int32, (1, Np), 1)
+        cols_g = col_off + cols_l
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        corecols = (cols_l >= k) & (cols_l <= k + by - 1)
+
+        def dma(slot, strip):
+            start = pl.multiple_of(strip * T, SUB)
+            return pltpu.make_async_copy(
+                ext_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, :, :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :].astype(_ACC)
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            Lf = jnp.roll(C, 1, axis=1)
+            Rt = jnp.roll(C, -1, axis=1)
+            new = combine_2d(C, U, D, Lf, Rt, cx, cy)
+            rows_g = (row_off + s * T + (r0 - C0)
+                      + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+            keep = colmask & (rows_g >= 1) & (rows_g <= NX - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(_SUBSTRIP, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :] = new.astype(dtype)
+                r0 += h
+
+        # k-1 intermediate steps over the full band minus the one-row
+        # read margin; the frontier argument above keeps the final rows
+        # exact. Paired under fori_loop (O(1) code in k, see kernel E).
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, 1, W - 1)
+            step_into(pp, sref, 1, W - 1)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, 1, W - 1)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
+            r_acc = jnp.maximum(
+                r_acc,
+                jnp.max(jnp.where(keep & corecols, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        @pl.when(s > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((T, Np), lambda s, offs: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s, offs: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, W, Np), dtype),
+            pltpu.VMEM((W, Np), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, Np), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )
+
+    def fn(ext, row_off, col_off):
+        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+        core_rows, res = call(offs, ext)
+        return core_rows, res[0, 0]
+
+    return fn
+
+
+# --------------------------------------------------------------------------
 # Solver-facing step factories
 # --------------------------------------------------------------------------
 

@@ -175,24 +175,75 @@ def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
     )
 
 
-def block_temporal_multistep(config, kw):
+def _pallas_round_2d(config, kw):
+    """Kernel-G round: K-deep exchange + K Mosaic steps, or None.
+
+    Available when the round depth equals the dtype's sublane count
+    (the kernel's alignment-free regime: halo_depth 8 for f32, 16 for
+    bf16) and the block geometry tiles. ``fn(u, want_res)`` advances
+    exactly ``config.halo_depth`` steps.
+    """
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    if config.ndim != 2:
+        return None
+    K = config.halo_depth
+    if K != ps._sub_rows(config.dtype):
+        return None
+    bx, by = config.block_shape()
+    axis_names = kw["axis_names"]
+    built = ps._build_temporal_block(
+        (bx, by), config.dtype, float(config.cx), float(config.cy),
+        config.shape, K, vma=tuple(axis_names))
+    if built is None:
+        return None
+    mesh_shape = kw["mesh_shape"]
+    block_index = kw["block_index"]
+    # axis_index('x') varies only on 'x'; broaden (see ops block_steps).
+    row_off = lax.pcast(block_index[0] * bx, (axis_names[1],), to="varying")
+    col_off = lax.pcast(block_index[1] * by - K, (axis_names[0],),
+                        to="varying")
+
+    def fn(u, want_res):
+        ext = exchange_halos_deep_2d(u, K, mesh_shape, axis_names)
+        core_rows, res = built(ext, row_off, col_off)
+        core = core_rows[:, K:K + by]
+        if want_res:
+            return core, lax.pmax(res, axis_names)
+        return core
+
+    return fn
+
+
+def block_temporal_multistep(config, kw, backend: str):
     """``(multi_step, multi_step_residual)`` on K-deep exchanges.
 
     ``kw`` carries the block geometry (same contract as the per-step
-    halo path; 2D or 3D is selected by the config). An n-step advance
-    runs ``n // K`` rounds of K plus one remainder round of depth
-    ``n % K`` — exact for any n, so the convergence check schedule is
-    untouched.
+    halo path; 2D or 3D is selected by the config); ``backend`` is the
+    caller's already-resolved backend (``solver._resolve_backend`` —
+    never "auto", so this module holds no platform heuristics of its
+    own). An n-step advance runs ``n // K`` rounds of K plus one
+    remainder round of depth ``n % K`` — exact for any n, so the
+    convergence check schedule is untouched. Full-depth rounds take the
+    Mosaic kernel-G path when the backend is pallas and the geometry
+    admits (see :func:`_pallas_round_2d`); remainder rounds and
+    declined geometries run the jnp rounds — both evaluate the same
+    semantics.
     """
     K = config.halo_depth
     block_fn = (block_multistep_3d if config.ndim == 3
                 else block_multistep_2d)
+    pallas_round = None
+    if backend == "pallas":
+        pallas_round = _pallas_round_2d(config, kw)
 
     def rounds(u, n, with_residual):
         full, rem = divmod(n, K)
         out_res = None
 
         def round_k(uu, depth, want_res):
+            if depth == K and pallas_round is not None:
+                return pallas_round(uu, want_res)
             return block_fn(uu, depth, with_residual=want_res, **kw)
 
         # All full rounds except the last run under fori_loop (pure-HLO

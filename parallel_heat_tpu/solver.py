@@ -231,7 +231,8 @@ def _build_runner(config: HeatConfig):
             if config.halo_depth > 1:
                 from parallel_heat_tpu.parallel import temporal
 
-                ms, msr = temporal.block_temporal_multistep(config, kw)
+                ms, msr = temporal.block_temporal_multistep(config, kw,
+                                                            backend=backend)
             else:
                 kw["overlap"] = config.overlap
                 ms, msr = steps_to_multistep(
@@ -249,8 +250,7 @@ def _build_runner(config: HeatConfig):
     mesh = make_heat_mesh(mesh_shape)
     names = mesh.axis_names
     spec = P(*names)
-    # halo_depth > 1 selects the jnp temporal-exchange path.
-    use_pallas = backend == "pallas" and config.halo_depth == 1
+    use_pallas = backend == "pallas"
 
     def local_run(u_local):
         bidx = tuple(lax.axis_index(n) for n in names)
@@ -259,12 +259,15 @@ def _build_runner(config: HeatConfig):
                   axis_names=names, overlap=config.overlap)
         if config.halo_depth > 1:
             # K-deep temporal exchange: K steps per collective round
-            # (parallel/temporal.py). jnp compute path.
+            # (parallel/temporal.py; Mosaic kernel G when the resolved
+            # backend is pallas and depth == the dtype's sublane count,
+            # jnp rounds otherwise).
             from parallel_heat_tpu.parallel import temporal
 
             tkw = dict(kw)
             tkw.pop("overlap")
-            ms, msr = temporal.block_temporal_multistep(config, tkw)
+            ms, msr = temporal.block_temporal_multistep(config, tkw,
+                                                        backend=backend)
             pre = post = lambda u: u
         elif use_pallas:
             from parallel_heat_tpu.ops import pallas_stencil
