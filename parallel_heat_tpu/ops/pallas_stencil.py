@@ -18,10 +18,13 @@ re-thought for the TPU memory hierarchy instead of translated:
   + ``semi_reduce`` + host polling (``cuda/cuda_heat.cu:66-137,219-236``)
   with one VPU reduction per strip.
 
-Both kernels compute the identical f32 expression tree as the jnp path
-(``ops/stencil.py``), so all backends agree bitwise. Dirichlet boundary
-cells (and, in sharded use, cells outside this shard's global-interior
-region) are masked back to their previous values in-register.
+All kernels evaluate the factored combine (``ops/stencil.py::
+combine_2d/_3d`` — 5 VPU ops/cell; the jnp path keeps the textbook tree
+for its bitwise shard-invariance, see the ``ops/stencil.py`` module
+docstring), so pallas-vs-jnp agreement is few-ulp per step, never
+bitwise (SEMANTICS.md "Precision"). Dirichlet boundary cells (and, in
+sharded use, cells outside this shard's global-interior region) are
+masked back to their previous values in-register.
 
 On non-TPU platforms the kernels run in interpreter mode (tests); the
 solver only selects this backend on TPU by default.

@@ -19,17 +19,47 @@ _FORMAT_VERSION = 1
 
 def save_checkpoint(path, grid, step: int, config: HeatConfig) -> str:
     """Write a snapshot; returns the actual path written (always .npz —
-    normalized here rather than letting np.savez append it silently)."""
+    normalized here rather than letting np.savez append it silently).
+
+    The write is atomic (temp file + ``os.replace``): the periodic
+    checkpointing driver (``solve_stream`` / ``--checkpoint-every``)
+    overwrites one rolling file, and a crash mid-write must leave the
+    previous snapshot intact — a torn file would defeat the feature's
+    whole purpose.
+    """
+    import os
+
     path = str(path)
     if not path.endswith(".npz"):
         path += ".npz"
-    np.savez_compressed(
-        path,
-        grid=np.asarray(grid),
-        step=np.int64(step),
-        config=np.frombuffer(config.to_json().encode(), dtype=np.uint8),
-        version=np.int64(_FORMAT_VERSION),
-    )
+    tmp = path + ".tmp.npz"  # must end .npz or np.savez appends it
+    try:
+        np.savez_compressed(
+            tmp,
+            grid=np.asarray(grid),
+            step=np.int64(step),
+            config=np.frombuffer(config.to_json().encode(), dtype=np.uint8),
+            version=np.int64(_FORMAT_VERSION),
+        )
+        # Durability, not just atomicity: flush the tmp file's data (and
+        # the directory entry) to stable storage before the rename makes
+        # it the live snapshot — otherwise a power loss right after
+        # os.replace can leave a torn file with the old snapshot gone.
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                        os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return path
 
 

@@ -82,3 +82,35 @@ def test_solve_stream_rejects_bad_chunk():
         next(solve_stream(cfg, chunk_steps=0))
     with pytest.raises(ValueError, match="chunk_steps"):
         next(solve_stream(cfg, chunk_steps=-8))
+
+
+def test_save_checkpoint_atomic_no_temp_left(tmp_path):
+    cfg = HeatConfig(nx=8, ny=8, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "roll.npz"
+    for step in (1, 2, 3):  # rolling overwrite, like --checkpoint-every
+        written = save_checkpoint(p, res.grid, step, cfg)
+    assert written == str(p)
+    _, step, _ = load_checkpoint(p)
+    assert step == 3
+    assert list(tmp_path.iterdir()) == [p]  # no temp debris
+
+
+def test_save_checkpoint_failure_preserves_previous(tmp_path, monkeypatch):
+    cfg = HeatConfig(nx=8, ny=8, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "roll.npz"
+    save_checkpoint(p, res.grid, 1, cfg)
+
+    def boom(path, **kw):
+        # simulate a crash mid-write: truncated tmp file then failure
+        open(path, "wb").write(b"torn")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez_compressed", boom)
+    with pytest.raises(OSError):
+        save_checkpoint(p, res.grid, 2, cfg)
+    monkeypatch.undo()
+    grid, step, _ = load_checkpoint(p)  # previous snapshot intact
+    assert step == 1
+    assert list(tmp_path.iterdir()) == [p]  # tmp debris removed

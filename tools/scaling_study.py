@@ -46,6 +46,9 @@ def main(argv=None):
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--converge", action="store_true")
+    ap.add_argument("--halo-depth", type=int, default=1, metavar="K",
+                    help="K-deep halo exchange: K steps per collective "
+                         "round on sharded meshes (parallel/temporal.py)")
     ap.add_argument("--cpu-devices", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (env vars are "
                          "overridden by a pinned TPU platform; this uses "
@@ -80,6 +83,7 @@ def main(argv=None):
                 nx=size, ny=size, steps=args.steps, dtype=args.dtype,
                 backend=args.backend, converge=args.converge,
                 mesh_shape=None if _prod(mesh) == 1 else mesh,
+                halo_depth=args.halo_depth if _prod(mesh) > 1 else 1,
             ).validate()
             u0 = jax.block_until_ready(make_initial_grid(cfg))
             solve(cfg, initial=u0)  # compile + warm up
