@@ -100,6 +100,17 @@ class HeatConfig:
         """Per-device block extent under the mesh decomposition."""
         return tuple(n // d for n, d in zip(self.shape, self.mesh_or_unit()))
 
+    def stability_margin(self) -> float:
+        """``1/2 - sum(coefficients)`` — the von Neumann stability margin.
+
+        The explicit Jacobi scheme amplifies the highest spatial mode by
+        ``1 - 4*sum(c)*sin^2(...)``; it stays bounded iff the
+        coefficient sum is <= 1/2. Negative margin means the run will
+        blow up to inf/NaN (the reference never checks: its fixed
+        cx=cy=0.1 sits safely at margin 0.3).
+        """
+        return 0.5 - sum(self.coefficients)
+
     def validate(self) -> "HeatConfig":
         if self.nx < 3 or self.ny < 3 or (self.nz is not None and self.nz < 3):
             raise ValueError(

@@ -51,3 +51,23 @@ def test_json_roundtrip():
                    mesh_shape=(2, 2), dtype="bfloat16")
     c2 = HeatConfig.from_json(c.to_json())
     assert c2 == c
+
+
+def test_stability_margin():
+    assert HeatConfig(cx=0.1, cy=0.1).stability_margin() == pytest.approx(0.3)
+    assert HeatConfig(cx=0.3, cy=0.3).stability_margin() < 0
+    assert HeatConfig(nx=8, ny=8, nz=8, cx=0.1, cy=0.1,
+                      cz=0.1).stability_margin() == pytest.approx(0.2)
+
+
+def test_unstable_coefficients_actually_diverge():
+    # the property the margin predicts: an unstable run blows up
+    import numpy as np
+
+    from parallel_heat_tpu import solve
+
+    cfg = HeatConfig(nx=16, ny=16, steps=500, cx=0.3, cy=0.3,
+                     backend="jnp")
+    assert cfg.stability_margin() < 0
+    out = solve(cfg).to_numpy()
+    assert not np.all(np.isfinite(out)) or np.max(np.abs(out)) > 1e18
