@@ -19,7 +19,12 @@ A ground-up JAX/XLA/Pallas re-design of the capabilities of
 """
 
 from parallel_heat_tpu.config import HeatConfig
-from parallel_heat_tpu.solver import HeatResult, solve
+from parallel_heat_tpu.solver import (
+    HeatResult,
+    make_initial_grid,
+    solve,
+    solve_stream,
+)
 from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
 
 __version__ = "0.1.0"
@@ -28,6 +33,8 @@ __all__ = [
     "HeatConfig",
     "HeatResult",
     "solve",
+    "solve_stream",
+    "make_initial_grid",
     "HeatPlate2D",
     "HeatPlate3D",
     "__version__",

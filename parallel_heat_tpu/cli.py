@@ -115,10 +115,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
 
     say = (lambda *a: None) if args.quiet else print
-    if config.stability_margin() < 0.0:
-        print(f"warning: coefficient sum {sum(config.coefficients):g} "
-              f"exceeds the stability bound 1/2 — the explicit scheme "
-              f"will diverge (values blow up to inf)", file=sys.stderr)
     mesh = config.mesh_or_unit()
     n_dev = 1
     for d in mesh:

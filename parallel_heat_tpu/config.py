@@ -112,6 +112,20 @@ class HeatConfig:
         return 0.5 - sum(self.coefficients)
 
     def validate(self) -> "HeatConfig":
+        if self.stability_margin() < 0.0:
+            # Warn (never error: instability is sometimes the thing
+            # being studied) from the one place every entry point —
+            # solve, solve_stream, the CLI, make_initial_grid — passes
+            # through.
+            import warnings
+
+            warnings.warn(
+                f"coefficient sum {sum(self.coefficients):g} exceeds the "
+                f"stability bound 1/2 — the explicit scheme will diverge "
+                f"(values blow up to inf)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         if self.nx < 3 or self.ny < 3 or (self.nz is not None and self.nz < 3):
             raise ValueError(
                 f"grid must be at least 3 cells per axis, got {self.shape}"

@@ -71,3 +71,16 @@ def test_unstable_coefficients_actually_diverge():
     assert cfg.stability_margin() < 0
     out = solve(cfg).to_numpy()
     assert not np.all(np.isfinite(out)) or np.max(np.abs(out)) > 1e18
+
+
+def test_unstable_coefficients_warn_on_validate():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        HeatConfig(cx=0.3, cy=0.3).validate()
+    assert any("stability bound" in str(x.message) for x in w)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        HeatConfig(cx=0.1, cy=0.1).validate()
+    assert not w
