@@ -60,7 +60,7 @@ def _bench_fixed(cfg, budget_s=8.0, batches=3):
     import jax.numpy as jnp
 
     from parallel_heat_tpu.solver import _build_runner, make_initial_grid
-    from parallel_heat_tpu.utils.profiling import chain_time, sync
+    from parallel_heat_tpu.utils.profiling import chain_slope, chain_time, sync
 
     runner, _ = _build_runner(cfg)
     u0 = jax.block_until_ready(make_initial_grid(cfg))
@@ -71,13 +71,7 @@ def _bench_fixed(cfg, budget_s=8.0, batches=3):
     t1 = chain_time(step, u0, 1)
     compute_est = max(t1 - _sync_floor(u0), 1e-3)
     r2 = 1 + max(1, min(24, int(budget_s / batches / compute_est)))
-    t_a = min(chain_time(step, u0, 1) for _ in range(batches))
-    t_b = min(chain_time(step, u0, r2) for _ in range(batches))
-    if t_b <= t_a:
-        raise RuntimeError(
-            f"non-positive slope: t_a={t_a:.4f}s t_b={t_b:.4f}s at r2={r2}"
-        )
-    return (t_b - t_a) / (r2 - 1)
+    return chain_slope(step, u0, 1, r2, batches=batches)
 
 
 def _bench_converge(cfg, repeats=2):

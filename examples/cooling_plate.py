@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Worked example: watch a hot plate relax toward steady state.
+
+Reproduces the reference's workflow (initial dump, simulate, final
+dump — `mpi/mpi_heat_improved_persistent_stat.c:97-99,299`) and then
+goes beyond it with the capabilities the reference lacks: streaming
+snapshots during the run (`solve_stream`), convergence monitoring, and
+a resumable checkpoint.
+
+Run anywhere (CPU works; a TPU just makes it fast)::
+
+    python examples/cooling_plate.py --nx 256 --ny 256 --snapshots 5
+
+Outputs land in ``./cooling_out/``: ``initial.dat``, numbered
+``snap_NNNNN.dat`` frames, ``final.dat``, and ``state.npz`` (resume
+with ``python -m parallel_heat_tpu --resume cooling_out/state.npz ...``).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nx", type=int, default=256)
+    ap.add_argument("--ny", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=20_000)
+    ap.add_argument("--snapshots", type=int, default=5)
+    ap.add_argument("--out", default="cooling_out")
+    args = ap.parse_args()
+
+    from parallel_heat_tpu import HeatConfig, make_initial_grid, solve_stream
+    from parallel_heat_tpu.utils.checkpoint import save_checkpoint
+    from parallel_heat_tpu.utils.io import write_dat
+
+    os.makedirs(args.out, exist_ok=True)
+    cfg = HeatConfig(nx=args.nx, ny=args.ny, steps=args.steps,
+                     converge=True, check_interval=20)
+
+    u0 = make_initial_grid(cfg)
+    write_dat(os.path.join(args.out, "initial.dat"), u0)
+    print(f"initial condition written; peak T = {float(u0.max()):.1f}")
+
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
+    chunk = max(cfg.check_interval,
+                args.steps // max(1, args.snapshots))
+    last = None
+    for last in solve_stream(cfg, initial=u0, chunk_steps=chunk):
+        frame = os.path.join(args.out, f"snap_{last.steps_run:05d}.dat")
+        write_dat(frame, last.to_numpy())
+        print(f"step {last.steps_run:6d}: residual {last.residual:.2e} "
+              f"-> {frame}")
+
+    write_dat(os.path.join(args.out, "final.dat"), last.to_numpy())
+    save_checkpoint(os.path.join(args.out, "state.npz"),
+                    last.to_numpy(), last.steps_run, cfg)
+    verdict = (f"converged after {last.steps_run} steps"
+               if last.converged else
+               f"not converged in {last.steps_run} steps "
+               f"(residual {last.residual:.2e})")
+    print(f"{verdict}; elapsed {last.elapsed_s:.3f} s; "
+          f"state checkpointed to {args.out}/state.npz")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,12 +119,14 @@ class HeatConfig:
             # through.
             import warnings
 
+            # No stacklevel: attributing the warning to this fixed line
+            # lets the default filter deduplicate it across the several
+            # validate() calls one run makes (CLI, solve, per chunk).
             warnings.warn(
                 f"coefficient sum {sum(self.coefficients):g} exceeds the "
                 f"stability bound 1/2 — the explicit scheme will diverge "
                 f"(values blow up to inf)",
                 RuntimeWarning,
-                stacklevel=2,
             )
         if self.nx < 3 or self.ny < 3 or (self.nz is not None and self.nz < 3):
             raise ValueError(

@@ -57,19 +57,24 @@ def chain_time(step_fn, u0, reps: int) -> float:
     return time.perf_counter() - t0
 
 
-def chain_slope(step_fn, u0, reps_a: int, reps_b: int) -> float:
+def chain_slope(step_fn, u0, reps_a: int, reps_b: int,
+                batches: int = 1) -> float:
     """Steady-state seconds per ``step_fn`` call via the chained slope.
 
-    Runs batches of ``reps_a`` and ``reps_b`` calls and returns
-    ``(t_b - t_a) / (reps_b - reps_a)``. Raises ``RuntimeError`` when
-    the slope is non-positive (timer noise swamped the measurement —
-    e.g. the per-call compute is far below the transport's dispatch
-    latency); callers must surface that rather than report a garbage
-    throughput number.
+    Measures each endpoint ``batches`` times, takes the minimum of the
+    *raw times* (transport noise — dispatch jitter, host scheduling —
+    is strictly additive on wall-clock, so min converges on the true
+    time; a min over per-batch *slopes* would instead be biased low,
+    preferentially keeping batches whose short endpoint got inflated),
+    then returns ``(min t_b - min t_a) / (reps_b - reps_a)``. Raises
+    ``RuntimeError`` when the slope is non-positive (noise swamped the
+    measurement — e.g. the per-call compute is far below the
+    transport's dispatch latency); callers must surface that rather
+    than report a garbage throughput number.
     """
-    assert reps_b > reps_a >= 1
-    t_a = chain_time(step_fn, u0, reps_a)
-    t_b = chain_time(step_fn, u0, reps_b)
+    assert reps_b > reps_a >= 1 and batches >= 1
+    t_a = min(chain_time(step_fn, u0, reps_a) for _ in range(batches))
+    t_b = min(chain_time(step_fn, u0, reps_b) for _ in range(batches))
     per = (t_b - t_a) / (reps_b - reps_a)
     if per <= 0:
         raise RuntimeError(

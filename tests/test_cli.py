@@ -95,3 +95,25 @@ def test_cli_checkpoint_every_rejects_nonpositive(tmp_path):
                "--backend", "jnp", "--checkpoint", str(tmp_path / "c.npz"),
                "--checkpoint-every", "-8"])
     assert rc == 2
+
+
+def test_example_cooling_plate(tmp_path, monkeypatch, capsys):
+    import importlib.util
+    import os
+    import sys
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "cooling_plate.py")
+    spec = importlib.util.spec_from_file_location("cooling_plate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(sys, "argv", [
+        "cooling_plate.py", "--nx", "16", "--ny", "16", "--steps", "200",
+        "--snapshots", "2", "--out", str(tmp_path / "out")])
+    mod.main()
+    out = capsys.readouterr().out
+    assert "state checkpointed" in out
+    names = sorted(p.name for p in (tmp_path / "out").iterdir())
+    assert "initial.dat" in names and "final.dat" in names
+    assert "state.npz" in names
+    assert any(n.startswith("snap_") for n in names)

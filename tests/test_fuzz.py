@@ -1,0 +1,71 @@
+"""Seeded configuration fuzz: random geometries x modes x backends.
+
+Breadth supplement to the systematic suites: each case draws a config
+from a seeded RNG and checks the core invariants — sharded == single
+(bitwise, jnp), pallas == jnp (few-ulp), converge metadata consistency.
+Seeds are fixed so failures reproduce; add seeds when a fuzz case ever
+catches something.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import HeatConfig, solve
+
+_MESHES = [None, (2, 1), (1, 2), (2, 2), (4, 2), (2, 4), (8, 1)]
+
+
+def _random_config(rng):
+    nx = int(rng.integers(3, 12)) * int(rng.choice([1, 2, 4]))
+    ny = int(rng.integers(3, 12)) * int(rng.choice([1, 2, 4]))
+    mesh = _MESHES[int(rng.integers(0, len(_MESHES)))]
+    if mesh is not None:
+        nx = max(nx, mesh[0]) * mesh[0]
+        ny = max(ny, mesh[1]) * mesh[1]
+    converge = bool(rng.integers(0, 2))
+    cfg = HeatConfig(
+        nx=nx, ny=ny,
+        steps=int(rng.integers(0, 40)),
+        cx=float(rng.uniform(0.01, 0.24)),
+        cy=float(rng.uniform(0.01, 0.24)),
+        converge=converge,
+        check_interval=int(rng.integers(1, 9)),
+        eps=10.0 ** float(rng.integers(-6, -1)),
+        dtype=str(rng.choice(["float32", "bfloat16"])),
+        mesh_shape=mesh,
+        overlap=bool(rng.integers(0, 2)),
+        backend="jnp",
+    )
+    if mesh is not None and bool(rng.integers(0, 2)):
+        depth = int(rng.integers(2, 9))
+        bmin = min(cfg.block_shape())
+        if depth <= bmin:
+            cfg = cfg.replace(halo_depth=depth)
+    return cfg.validate()
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_sharded_equals_single(seed):
+    rng = np.random.default_rng(1000 + seed)
+    cfg = _random_config(rng)
+    got = solve(cfg)
+    want = solve(cfg.replace(mesh_shape=None, halo_depth=1))
+    assert got.steps_run == want.steps_run, cfg
+    assert got.converged == want.converged, cfg
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy(),
+                                  err_msg=repr(cfg))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_pallas_matches_jnp(seed):
+    rng = np.random.default_rng(2000 + seed)
+    cfg = _random_config(rng).replace(mesh_shape=None, halo_depth=1,
+                                      steps=int(rng.integers(1, 20)))
+    want = solve(cfg)
+    got = solve(cfg.replace(backend="pallas"))
+    assert got.steps_run == want.steps_run, cfg
+    tol = dict(rtol=5e-2, atol=0.5) if cfg.dtype == "bfloat16" \
+        else dict(rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(got.to_numpy().astype(np.float64),
+                               want.to_numpy().astype(np.float64),
+                               err_msg=repr(cfg), **tol)
