@@ -390,6 +390,12 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     """
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
+    # 100 MiB is deliberate headroom under the 128 MiB vmem_limit.
+    # A 118 MiB budget (admitting T=256 instead of 128 at 16384^2) was
+    # A/B'd on v5e: bare-kernel chains preferred T=256 by ~25%, but
+    # end-to-end solver throughput was unchanged (152.8 vs 153.1
+    # Gcells*steps/s) with slight regressions on the bf16/converge
+    # rows — so the conservative budget stays.
     budget = 100 * 1024 * 1024
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
     # T caps at 256: measured on v5e (tools/probe_temporal.py), T=512
