@@ -48,13 +48,9 @@ def _sync_floor(u0):
 def _bench_fixed(cfg, budget_s=8.0, batches=3):
     """Steady-state seconds per run (fixed-step configs, chained slope).
 
-    Noise robustness: transport noise (axon dispatch jitter, host
-    scheduling) is strictly *additive on raw wall-clock times*, so each
-    endpoint is measured ``batches`` times and the minimum taken BEFORE
-    forming the one slope ``(min t_b - min t_a)/(r2 - 1)``. (Taking a
-    min over per-batch *slopes* would be biased low — a noise spike in
-    a batch's short endpoint shrinks that batch's slope, and min() then
-    preferentially keeps contaminated measurements.)
+    Noise robustness comes from ``chain_slope(batches=...)`` — min over
+    raw endpoint times before the one slope; see its docstring for why
+    min-of-slopes would instead bias low.
     """
     import jax
     import jax.numpy as jnp

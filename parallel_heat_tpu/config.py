@@ -175,6 +175,8 @@ class HeatConfig:
                 f"halo_depth must be >= 1, got {self.halo_depth}"
             )
         if self.halo_depth > 1:
+            # Mirrors ops.pallas_stencil._sub_rows (not imported here:
+            # validate() must stay cheap and pallas-free).
             sub = 16 if self.dtype == "bfloat16" else 8
             if self.backend == "pallas" and self.halo_depth != sub:
                 # Kernel G only exists at depth == the dtype's sublane

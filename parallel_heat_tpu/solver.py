@@ -254,9 +254,12 @@ def _build_runner(config: HeatConfig):
 
     def local_run(u_local):
         bidx = tuple(lax.axis_index(n) for n in names)
+        # The temporal path has no interior/edge split, so `overlap` is
+        # added only for the per-step paths (same pattern as the 3D
+        # branch above).
         kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
                   block_index=bidx, cx=config.cx, cy=config.cy,
-                  axis_names=names, overlap=config.overlap)
+                  axis_names=names)
         if config.halo_depth > 1:
             # K-deep temporal exchange: K steps per collective round
             # (parallel/temporal.py; Mosaic kernel G when the resolved
@@ -264,19 +267,19 @@ def _build_runner(config: HeatConfig):
             # jnp rounds otherwise).
             from parallel_heat_tpu.parallel import temporal
 
-            tkw = dict(kw)
-            tkw.pop("overlap")
-            ms, msr = temporal.block_temporal_multistep(config, tkw,
+            ms, msr = temporal.block_temporal_multistep(config, kw,
                                                         backend=backend)
             pre = post = lambda u: u
         elif use_pallas:
             from parallel_heat_tpu.ops import pallas_stencil
 
+            kw["overlap"] = config.overlap
             # The pallas block step carries an extended block between
             # steps; pre/post convert at loop entry/exit.
             step, stepr, pre, post = pallas_stencil.block_steps(config, kw)
             ms, msr = steps_to_multistep(step, stepr)
         else:
+            kw["overlap"] = config.overlap
             step = lambda u: block_step_2d(u, **kw)
             stepr = lambda u: block_step_2d_residual(u, **kw)
             pre = post = lambda u: u
