@@ -69,3 +69,42 @@ def test_fuzz_pallas_matches_jnp(seed):
     np.testing.assert_allclose(got.to_numpy().astype(np.float64),
                                want.to_numpy().astype(np.float64),
                                err_msg=repr(cfg), **tol)
+
+
+_MESHES_3D = [None, (2, 1, 1), (1, 2, 2), (2, 2, 2), (1, 1, 8)]
+
+
+def _random_config_3d(rng):
+    dims = [int(rng.integers(3, 8)) * int(rng.choice([1, 2])) for _ in range(3)]
+    mesh = _MESHES_3D[int(rng.integers(0, len(_MESHES_3D)))]
+    if mesh is not None:
+        dims = [max(d, m) * m for d, m in zip(dims, mesh)]
+    cfg = HeatConfig(
+        nx=dims[0], ny=dims[1], nz=dims[2],
+        steps=int(rng.integers(0, 20)),
+        cx=float(rng.uniform(0.01, 0.15)),
+        cy=float(rng.uniform(0.01, 0.15)),
+        cz=float(rng.uniform(0.01, 0.15)),
+        converge=bool(rng.integers(0, 2)),
+        check_interval=int(rng.integers(1, 7)),
+        dtype=str(rng.choice(["float32", "bfloat16"])),
+        mesh_shape=mesh,
+        backend="jnp",
+    )
+    if mesh is not None and bool(rng.integers(0, 2)):
+        depth = int(rng.integers(2, 6))
+        if depth <= min(cfg.block_shape()):
+            cfg = cfg.replace(halo_depth=depth)
+    return cfg.validate()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_3d_sharded_equals_single(seed):
+    rng = np.random.default_rng(3000 + seed)
+    cfg = _random_config_3d(rng)
+    got = solve(cfg)
+    want = solve(cfg.replace(mesh_shape=None, halo_depth=1))
+    assert got.steps_run == want.steps_run, cfg
+    assert got.converged == want.converged, cfg
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy(),
+                                  err_msg=repr(cfg))
