@@ -18,13 +18,16 @@ re-thought for the TPU memory hierarchy instead of translated:
   + ``semi_reduce`` + host polling (``cuda/cuda_heat.cu:66-137,219-236``)
   with one VPU reduction per strip.
 
-All kernels evaluate the factored combine (``ops/stencil.py::
-combine_2d/_3d`` — 5 VPU ops/cell; the jnp path keeps the textbook tree
-for its bitwise shard-invariance, see the ``ops/stencil.py`` module
-docstring), so pallas-vs-jnp agreement is few-ulp per step, never
-bitwise (SEMANTICS.md "Precision"). Dirichlet boundary cells (and, in
-sharded use, cells outside this shard's global-interior region) are
-masked back to their previous values in-register.
+All kernels evaluate the factored combine (``a0*c + cx*(up+down) +
+cy*(left+right)``, ``ops/stencil.py::combine_2d/_3d`` — 5 VPU ops/cell;
+the jnp path keeps the textbook tree for its bitwise shard-invariance,
+see the ``ops/stencil.py`` module docstring), so pallas-vs-jnp
+agreement is few-ulp per step, never bitwise (SEMANTICS.md
+"Precision"). Dirichlet boundary cells (and, in sharded use, cells
+outside this shard's global-interior region) are masked back to their
+previous values in-register — except kernel A, which pins boundary
+*columns* via column-dependent coefficient vectors (see its builder)
+plus an end-of-call snapshot/restore.
 
 On non-TPU platforms the kernels run in interpreter mode (tests); the
 solver only selects this backend on TPU by default.
@@ -125,9 +128,25 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
         r0 += h
 
     def kernel(u_ref, out_ref, res_ref, a_ref):
+        # Dirichlet boundary columns are pinned by column-dependent
+        # coefficient VECTORS instead of a per-cell select: a0 -> 1,
+        # cx/cy -> 0 at cols 0 and N-1, so a boundary cell computes
+        # exactly 1*C + 0 + 0 = C (a ~5% VPU win over the select,
+        # measured). Boundary rows are excluded structurally (strips
+        # span [1, M-1)). Caveat of the multiplicative form: when a
+        # *diverging* run drives interior neighbors to inf, 0*inf = NaN
+        # would leak into the boundary — the snapshot/restore below
+        # pins the OUTPUT boundary exactly either way (stable runs are
+        # bit-identical with or without it).
         cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
-        colmask = (cols >= 1) & (cols <= N - 2)
+        interior_c = (cols >= 1) & (cols <= N - 2)
+        a0 = 1.0 - 2.0 * cx - 2.0 * cy
+        a0v = jnp.where(interior_c, jnp.float32(a0), 1.0)
+        cxv = jnp.where(interior_c, jnp.float32(cx), 0.0)
+        cyv = jnp.where(interior_c, jnp.float32(cy), 0.0)
 
+        west = u_ref[:, 0:1]
+        east = u_ref[:, N - 1:N]
         a_ref[:] = u_ref[:]
         b_ref = out_ref  # aliases u_ref; u is already saved in a
 
@@ -138,8 +157,8 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
             D = blk[2:]
             L = jnp.roll(C, 1, axis=1)
             Rt = jnp.roll(C, -1, axis=1)
-            new = combine_2d(C, U, D, L, Rt, cx, cy)
-            return jnp.where(colmask, new, C), C
+            new = a0v * C + cxv * (U + D) + cyv * (L + Rt)
+            return new, C
 
         def step_into(src, dst):
             dst[0:1, :] = src[0:1, :]          # Dirichlet boundary rows
@@ -172,11 +191,15 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
             dst_ref[r:r + h, :] = new.astype(dtype)
             r_acc = jnp.maximum(
                 r_acc,
-                jnp.max(jnp.where(colmask, jnp.abs(new - C), 0.0)),
+                # boundary columns contribute |C - C| = 0 by the vector
+                # coefficients, so no mask is needed here
+                jnp.max(jnp.abs(new - C)),
             )
         res_ref[0, 0] = r_acc
         if dst_ref is not out_ref:
             out_ref[:] = dst_ref[:]
+        out_ref[:, 0:1] = west
+        out_ref[:, N - 1:N] = east
 
     call = pl.pallas_call(
         kernel,
