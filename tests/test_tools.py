@@ -1,0 +1,38 @@
+"""Smoke tests for the tools/ scripts (they must not rot)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.mark.parametrize("extra", [[], ["--halo-depth", "2"]])
+def test_scaling_study_smoke(extra):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "scaling_study.py"),
+         "--cpu-devices", "4", "--sizes", "64", "--meshes", "1x1,2x2",
+         "--steps", "20", "--repeats", "1", "--backend", "jnp"] + extra,
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert {r["mesh"] for r in rows} == {"1x1", "2x2"}
+    assert all(r["wall_s"] > 0 for r in rows)
+    assert "| mesh 2x2" in out.stdout  # the reference-style table
+
+
+def test_bench_importable_and_baseline_set():
+    sys.path.insert(0, _ROOT)
+    try:
+        import bench
+
+        assert bench.BASELINE_MCELLS_PER_S > 0
+        assert callable(bench.main)
+    finally:
+        sys.path.remove(_ROOT)
