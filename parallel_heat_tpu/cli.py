@@ -44,9 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "'auto' factorizes over all local devices)")
     ap.add_argument("--no-overlap", action="store_true",
                     help="disable the interior/edge comm-compute overlap")
-    ap.add_argument("--halo-depth", type=int, default=1, metavar="K",
+    ap.add_argument("--halo-depth", default="1", metavar="K",
                     help="exchange K-deep halos once per K steps instead "
-                         "of 1-deep every step (sharded 2D runs)")
+                         "of 1-deep every step (sharded runs); 'auto' "
+                         "picks the Mosaic block kernel's depth (the "
+                         "dtype's sublane count) when a mesh is set")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write final grid (.dat for 2D, .npy otherwise)")
     ap.add_argument("--initial-out", default=None, metavar="FILE",
@@ -89,13 +91,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from parallel_heat_tpu.solver import make_initial_grid
 
     ndim = 3 if args.nz is not None else 2
+    mesh_shape = _parse_mesh(args.mesh, ndim)
+    if args.halo_depth == "auto":
+        # The Mosaic block kernel's depth (kernel G) when sharded —
+        # clamped to the smallest block extent so 'auto' never errors
+        # on a value the user didn't choose. Single-device runs have no
+        # exchange to deepen. (A clamped depth simply runs jnp rounds.)
+        if mesh_shape is None:
+            halo_depth = 1
+        else:
+            from parallel_heat_tpu.config import sublane_count
+
+            dims = [args.nx, args.ny] + ([args.nz] if args.nz else [])
+            bmin = min(n // d for n, d in zip(dims, mesh_shape) if d > 0)
+            sub = sublane_count(args.dtype)
+            halo_depth = max(1, min(sub, bmin))
+            if args.backend == "pallas" and halo_depth != sub:
+                # explicit pallas only supports depth == sublane count;
+                # a clamped depth would be rejected by validate()
+                halo_depth = 1
+    else:
+        try:
+            halo_depth = int(args.halo_depth)
+        except ValueError:
+            print(f"error: --halo-depth must be an integer or 'auto', "
+                  f"got {args.halo_depth!r}", file=sys.stderr)
+            return 2
     config = HeatConfig(
         nx=args.nx, ny=args.ny, nz=args.nz,
         cx=args.cx, cy=args.cy, cz=args.cz,
         steps=args.steps, converge=args.converge, eps=args.eps,
         check_interval=args.check_interval, dtype=args.dtype,
-        backend=args.backend, mesh_shape=_parse_mesh(args.mesh, ndim),
-        overlap=not args.no_overlap, halo_depth=args.halo_depth,
+        backend=args.backend, mesh_shape=mesh_shape,
+        overlap=not args.no_overlap, halo_depth=halo_depth,
     )
     try:
         config.validate()

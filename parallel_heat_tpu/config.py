@@ -18,6 +18,14 @@ _VALID_DTYPES = ("float32", "bfloat16", "float64")
 _VALID_BACKENDS = ("auto", "jnp", "pallas")
 
 
+def sublane_count(dtype: str) -> int:
+    """TPU sublane tiling granularity for a storage dtype — the natural
+    ``halo_depth`` for the Mosaic block kernel (kernel G). Mirrors
+    ``ops.pallas_stencil._sub_rows`` (not imported there: this module
+    must stay pallas-free and cheap)."""
+    return 16 if dtype in ("bfloat16", "float16") else 8
+
+
 @dataclass(frozen=True)
 class HeatConfig:
     """Full runtime configuration of one simulation.
@@ -175,9 +183,7 @@ class HeatConfig:
                 f"halo_depth must be >= 1, got {self.halo_depth}"
             )
         if self.halo_depth > 1:
-            # Mirrors ops.pallas_stencil._sub_rows (not imported here:
-            # validate() must stay cheap and pallas-free).
-            sub = 16 if self.dtype == "bfloat16" else 8
+            sub = sublane_count(self.dtype)
             if self.backend == "pallas" and self.halo_depth != sub:
                 # Kernel G only exists at depth == the dtype's sublane
                 # count; any other depth would silently fall back to

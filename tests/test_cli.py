@@ -117,3 +117,41 @@ def test_example_cooling_plate(tmp_path, monkeypatch, capsys):
     assert "initial.dat" in names and "final.dat" in names
     assert "state.npz" in names
     assert any(n.startswith("snap_") for n in names)
+
+
+def test_cli_halo_depth_auto(tmp_path):
+    import jax
+
+    n = len(jax.devices())
+    if n < 4:
+        import pytest
+        pytest.skip("needs a multi-device mesh")
+    # auto on a mesh -> sublane depth; auto single-device -> 1
+    rc = main(["--nx", "32", "--ny", "32", "--steps", "8",
+               "--backend", "jnp", "--mesh", "2,2",
+               "--halo-depth", "auto", "--quiet",
+               "--out", str(tmp_path / "a.dat")])
+    assert rc == 0
+    rc = main(["--nx", "32", "--ny", "32", "--steps", "8",
+               "--backend", "jnp", "--halo-depth", "auto", "--quiet"])
+    assert rc == 0
+    rc = main(["--nx", "32", "--ny", "32", "--halo-depth", "bogus"])
+    assert rc == 2
+
+
+def test_cli_halo_depth_auto_clamps_to_block(tmp_path):
+    import jax
+
+    if len(jax.devices()) < 4:
+        import pytest
+        pytest.skip("needs a multi-device mesh")
+    # bf16 auto would be 16, but 20/2 = 10-cell blocks -> clamped, runs
+    rc = main(["--nx", "20", "--ny", "20", "--steps", "4",
+               "--dtype", "bfloat16", "--backend", "jnp",
+               "--mesh", "2,2", "--halo-depth", "auto", "--quiet"])
+    assert rc == 0
+    # explicit pallas with a clamped depth falls back to depth 1
+    rc = main(["--nx", "20", "--ny", "20", "--steps", "4",
+               "--dtype", "bfloat16", "--backend", "pallas",
+               "--mesh", "2,2", "--halo-depth", "auto", "--quiet"])
+    assert rc == 0
