@@ -36,3 +36,20 @@ def test_bench_importable_and_baseline_set():
         assert callable(bench.main)
     finally:
         sys.path.remove(_ROOT)
+
+
+def test_make_heat_smoke():
+    # The reference-style Make entry point must stay runnable.
+    run = lambda *a: subprocess.run(
+        ["make", "-C", _ROOT, *a], capture_output=True, text=True,
+        timeout=300, env={**os.environ})
+    out = run("heat", "SIZE=32", "STEPS=10", "BACKEND=jnp")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert os.path.exists(os.path.join(_ROOT, "final_im.dat"))
+    assert os.path.exists(os.path.join(_ROOT, "initial_im.dat"))
+    out = run("clean")
+    assert out.returncode == 0
+    assert not os.path.exists(os.path.join(_ROOT, "final_im.dat"))
+    # clean also drops the native build; restore it so later suites
+    # don't pay a rebuild
+    assert run("native").returncode == 0
