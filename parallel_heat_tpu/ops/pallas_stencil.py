@@ -692,10 +692,19 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
     (out-of-domain cells beyond them never propagate inward, same
     argument as kernel E's clamped edges).
 
-    Returns ``fn(ext, row_off, col_off) -> ((bx, by+2k) core rows,
-    residual)`` — residual over core cells only — or None if the
-    geometry declines. ``row_off`` = global row of core row 0;
-    ``col_off`` = global col of padded col 0.
+    Mosaic requires lane-dim slice extents to be 128-aligned, so the
+    input width is ``Np = roundup(by + 2k, 128)`` — the caller appends
+    ``Np - (by + 2k)`` junk columns when assembling the exchanged block
+    (:func:`parallel_heat_tpu.parallel.temporal._pallas_round_2d` folds
+    them into the concat for free). Junk-column garbage obeys the same
+    wrap-frontier bound: after the k steps it reaches only column
+    ``k + by``, one past the core's last column.
+
+    Returns ``fn(ext, row_off, col_off) -> ((bx, Np) core rows,
+    residual)`` — residual over core cells only; the caller slices
+    columns ``[k, k+by)``. Returns None if the geometry declines.
+    ``row_off`` = global row of core row 0; ``col_off`` = global col of
+    padded col 0. ``fn.padded_width`` exposes ``Np``.
     """
     bx, by = block_shape
     NX, NY = grid_shape
@@ -703,7 +712,7 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
     SUB = _sub_rows(dtype)
     if k != SUB or bx < SUB:
         return None
-    Np = by + 2 * k                      # padded width
+    Np = ((by + 2 * k + _LANE - 1) // _LANE) * _LANE  # lane-aligned width
     T = _pick_block_strip(bx, Np, dtype)
     if T is None:
         return None
@@ -834,6 +843,7 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
         core_rows, res = call(offs, ext)
         return core_rows, res[0, 0]
 
+    fn.padded_width = Np
     return fn
 
 

@@ -49,12 +49,17 @@ _ACC = jnp.float32
 
 
 def exchange_halos_deep_2d(u, k: int, mesh_shape: Tuple[int, int],
-                           axis_names: Tuple[str, str] = ("x", "y")):
-    """Return the ``(bx+2k, by+2k)`` padded block, corners included.
+                           axis_names: Tuple[str, str] = ("x", "y"),
+                           pad_cols: int = 0):
+    """Return the ``(bx+2k, by+2k+pad_cols)`` padded block, corners
+    included.
 
     Two ppermute phases of two shifts each (4 messages total, like the
     1-deep exchange — the messages are just K rows/columns wide).
     Devices at domain edges receive zeros for the missing neighbors.
+    ``pad_cols`` appends zero columns inside the same concatenation
+    (the Mosaic block kernel needs a lane-aligned width; folding the
+    pad here avoids a separate full-block copy).
     """
     dx, dy = mesh_shape
     ax, ay = axis_names
@@ -62,7 +67,10 @@ def exchange_halos_deep_2d(u, k: int, mesh_shape: Tuple[int, int],
     # Phase 1: K-wide column strips along the y axis.
     halo_w = _shift_down(u[:, -k:], ay, dy)
     halo_e = _shift_up(u[:, :k], ay, dy)
-    uy = jnp.concatenate([halo_w.astype(dt), u, halo_e.astype(dt)], axis=1)
+    parts = [halo_w.astype(dt), u, halo_e.astype(dt)]
+    if pad_cols:
+        parts.append(jnp.zeros((u.shape[0], pad_cols), dt))
+    uy = jnp.concatenate(parts, axis=1)
     # Phase 2: K-tall row strips of the *extended* block along x —
     # these carry the corner data from the diagonal neighbors.
     halo_n = _shift_down(uy[-k:, :], ax, dx)
@@ -203,9 +211,13 @@ def _pallas_round_2d(config, kw):
     row_off = lax.pcast(block_index[0] * bx, (axis_names[1],), to="varying")
     col_off = lax.pcast(block_index[1] * by - K, (axis_names[0],),
                         to="varying")
+    # Mosaic needs the kernel input's lane dim 128-aligned; the junk
+    # tail columns are masked/frontier-safe (see the builder docstring).
+    pad = built.padded_width - (by + 2 * K)
 
     def fn(u, want_res):
-        ext = exchange_halos_deep_2d(u, K, mesh_shape, axis_names)
+        ext = exchange_halos_deep_2d(u, K, mesh_shape, axis_names,
+                                     pad_cols=pad)
         core_rows, res = built(ext, row_off, col_off)
         core = core_rows[:, K:K + by]
         if want_res:

@@ -404,3 +404,29 @@ def test_temporal_strip_bf16_matches_jnp():
 def test_temporal_pick_declines_small_rows():
     # Too few rows for a clamped window (O < 3*SUB): decline.
     assert ps._pick_temporal_strip(16, 128, "float32") is None
+
+
+def test_temporal_block_kernel_single_block_vs_jnp():
+    # Kernel G driven directly (one block covering the whole grid,
+    # zero-padded K-deep halo + lane-alignment junk columns) — the same
+    # construction validated on real TPU hardware (Mosaic-compiled;
+    # Mosaic requires the lane-aligned width this test exercises).
+    from parallel_heat_tpu.models import HeatPlate2D
+
+    K = 8
+    for bx, by in [(16, 24), (32, 112)]:  # 24+16=40 -> pad; 112+16=128 -> none
+        m = HeatPlate2D(bx, by)
+        u0 = m.init_grid(jnp.float32)
+        fn = ps._build_temporal_block((bx, by), "float32", 0.1, 0.1,
+                                      (bx, by), K)
+        assert fn is not None
+        pad = fn.padded_width - (by + 2 * K)
+        ext = jnp.pad(u0, ((K, K), (K, K + pad)))
+        core_rows, res = fn(ext, 0, -K)
+        got = np.asarray(core_rows)[:, K:K + by]
+        want = u0
+        for _ in range(K):
+            want = step_2d(want, 0.1, 0.1)
+        np.testing.assert_allclose(got, np.asarray(want),
+                                   rtol=1e-5, atol=1e-3)
+        assert float(res) > 0
