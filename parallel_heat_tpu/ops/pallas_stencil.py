@@ -67,6 +67,14 @@ def _interpret() -> bool:
     return jax.devices()[0].platform not in ("tpu", "axon")
 
 
+def _needs_lane_alignment() -> bool:
+    """Mosaic (the real TPU compiler) requires lane-dim slice extents
+    to be 128-multiples; the interpreter does not — and small unaligned
+    shapes are exactly what the CPU test-suite drives the kernels with,
+    so the alignment guards only apply when compiling for hardware."""
+    return not _interpret()
+
+
 def fits_vmem(shape: Tuple[int, int], dtype) -> bool:
     cells = shape[0] * shape[1]
     # Two grid buffers plus the resident kernel's ~4 full-strip f32
@@ -243,7 +251,15 @@ def _pick_strip_rows(out_rows: int, n_cols: int, dtype,
     overlap by 2*SUB rows, so larger T amortizes the halo re-fetch. The
     unsharded variant clamps windows into the core grid, which needs
     O - (T + 2*SUB) >= 0.
+
+    Declines (None) when compiling for hardware and the width is not
+    lane-aligned: the full-row DMA windows slice the lane dim at extent
+    N, and Mosaic requires lane-dim slice extents to be multiples of
+    128 (verified on real hardware — a 5000-wide grid is a compile-time
+    MosaicError). The solver then falls back to the XLA-fused jnp path.
     """
+    if _needs_lane_alignment() and n_cols % _LANE != 0:
+        return None
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
     budget = 100 * 1024 * 1024
@@ -409,8 +425,11 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     Buffers: 2 DMA slots + 1 ping-pong scratch, each (T + 4*SUB, N),
     plus the pipeline's double-buffered (T, N) output block and ~4
     sub-strip f32 temporaries. Larger T amortizes the per-step halo
-    recompute (2*SUB extra rows per intermediate step).
+    recompute (2*SUB extra rows per intermediate step). Declines
+    non-lane-aligned widths on hardware (see :func:`_pick_strip_rows`).
     """
+    if _needs_lane_alignment() and n_cols % _LANE != 0:
+        return None
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
     # 100 MiB is deliberate headroom under the 128 MiB vmem_limit.

@@ -58,3 +58,20 @@ def test_vmem_kernel_boundary_pinned_even_when_diverging():
     np.testing.assert_array_equal(out[-1, :], u0[-1, :])
     np.testing.assert_array_equal(out[:, 0], u0[:, 0])
     np.testing.assert_array_equal(out[:, -1], u0[:, -1])
+
+
+def test_streaming_pickers_decline_non_lane_aligned_widths(monkeypatch):
+    # Mosaic rejects lane-dim slice extents that are not multiples of
+    # 128 (real-TPU compile error at 5000^2); when compiling for
+    # hardware the streaming pickers must decline so the solver falls
+    # back to the jnp path. (The interpreter has no such constraint —
+    # the CPU suite deliberately uses small unaligned widths.)
+    import parallel_heat_tpu.ops.pallas_stencil as ps
+
+    monkeypatch.setattr(ps, "_interpret", lambda: False)  # hardware mode
+    assert ps._pick_strip_rows(5000, 5000, "float32", sharded=False) is None
+    assert ps._pick_temporal_strip(5000, 5000, "float32") is None
+    # aligned widths still tile
+    assert ps._pick_temporal_strip(5120, 5120, "float32") is not None
+    monkeypatch.undo()
+    assert ps._pick_temporal_strip(5000, 5000, "float32") is not None
