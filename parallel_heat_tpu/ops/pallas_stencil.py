@@ -1394,6 +1394,12 @@ def _pick_xslab_3d(shape, dtype):
     itemsize = jnp.dtype(dtype).itemsize
     if Z % _LANE != 0:
         return None
+    if _needs_lane_alignment() and Y % _sub_rows(dtype) != 0:
+        # The whole-plane DMA slices the sublane dim at extent Y, which
+        # Mosaic requires tile-aligned (verified on hardware: Y=300 is
+        # a compile-time MosaicError). Kernel D's Y-strip divisibility
+        # implies alignment already; only this picker needs the guard.
+        return None
     plane = Y * Z * itemsize
     plane_f32 = Y * Z * 4
     budget = 100 * 1024 * 1024

@@ -75,3 +75,13 @@ def test_streaming_pickers_decline_non_lane_aligned_widths(monkeypatch):
     assert ps._pick_temporal_strip(5120, 5120, "float32") is not None
     monkeypatch.undo()
     assert ps._pick_temporal_strip(5000, 5000, "float32") is not None
+
+
+def test_xslab_picker_declines_unaligned_y(monkeypatch):
+    # Full-plane DMAs slice the sublane dim at extent Y; Mosaic needs
+    # it tile-aligned (Y=300 was a real-TPU compile error).
+    import parallel_heat_tpu.ops.pallas_stencil as ps
+
+    monkeypatch.setattr(ps, "_interpret", lambda: False)
+    assert ps._pick_xslab_3d((300, 300, 384), "float32") is None
+    assert ps._pick_xslab_3d((320, 320, 384), "float32") is not None
