@@ -448,7 +448,10 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     for t in range(sub, t_max + 1, sub):
         if out_rows % t != 0:
             continue
-        cost = (3 * (t + 4 * sub) + 2 * t) * n_cols * itemsize + temps
+        # 3*(t+4s) window/ping-pong + 2t pipelined out + the 2s-row
+        # zero band materialized for the edge-strip sanitization.
+        cost = ((3 * (t + 4 * sub) + 2 * t + 2 * sub) * n_cols
+                * itemsize + temps)
         if cost <= budget:
             best = t
     return best
@@ -487,6 +490,26 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
     recompute overlap) but win back ~K× HBM traffic, which is what
     bounds them at 32k². Sharded blocks stay on K=1 kernels: K > 1
     would need K-deep ppermuted halos plus corner exchanges.
+
+    Boundary handling is multiplicative, like kernel A's: coefficient
+    vectors pin the Dirichlet columns (a0→1, cx/cy→0 at cols 0/N-1)
+    and the same where'd coefficients pin the boundary/garbage rows —
+    no per-cell select in the hot path, measured +22% over the
+    select form at 16384² on v5e (tools/ab_temporal.py). Two guards
+    keep that exact: (1) the scratch bands the sweep reads but no DMA
+    writes are zeroed on the edge strips (0*0 = 0; uninitialized VMEM
+    could hold NaNs, and 0*NaN would poison a pinned row — interior
+    strips need no zeroing because their garbage rows are ≥ SUB+1
+    cells from any output row, and contamination travels one cell per
+    step for K ≤ SUB steps); (2) a diverging run's 0*inf = NaN must
+    not leak into the *output* boundary (the kernel-A caveat), so
+    ``fn`` re-pins the boundary row/columns from the untouched input
+    *outside* the kernel — four tiny XLA slice updates, bit-identical
+    for stable runs, exact Dirichlet semantics for diverging ones
+    (regression-tested). Doing this in-kernel instead (strided (T,1)
+    column snapshot/restore scratch) measured ~30% slower than the
+    select form it replaced — lane-strided column ops are Mosaic
+    relayout territory; keep them out of kernels.
     """
     M, N = shape
     dtype = jnp.dtype(dtype_name)
@@ -506,6 +529,10 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
 
         cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
         colmask = (cols >= 1) & (cols <= N - 2)
+        a0 = jnp.float32(1.0 - 2.0 * cx - 2.0 * cy)
+        a0v = jnp.where(colmask, a0, 1.0)
+        cxv = jnp.where(colmask, jnp.float32(cx), 0.0)
+        cyv = jnp.where(colmask, jnp.float32(cy), 0.0)
 
         def dma(slot, strip):
             start, dst_off = _clamped_window(strip, T, SUB, M, W, SUB, C0)
@@ -524,7 +551,25 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
             dma((s + 1) % 2, s + 1).start()
 
         slot = lax.rem(s, 2)
+
+        # Sanitize the scratch bands the sweep reads but no DMA writes
+        # (edge strips only; see docstring). Issued before the wait so
+        # the stores hide behind the in-flight copy — the bands are
+        # disjoint from every DMA window.
+        zband = jnp.zeros((2 * SUB, N), dtype)
+
+        @pl.when(s == 0)
+        def _():
+            slots[0, 0:C0, :] = zband
+            pp[0:C0, :] = zband
+
+        @pl.when(s == n - 1)
+        def _():
+            slots.at[slot][W:SCR, :] = zband
+            pp[W:SCR, :] = zband
+
         dma(slot, s).wait()
+        sref = slots.at[slot]
 
         def chunk_new(src, r0, h):
             """One stencil step on scratch rows [r0, r0+h) of ``src``."""
@@ -534,18 +579,21 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
             D = blk[2:]
             Lf = jnp.roll(C, 1, axis=1)
             Rt = jnp.roll(C, -1, axis=1)
-            new = combine_2d(C, U, D, Lf, Rt, cx, cy)
             rows_g = (s * T + (r0 - C0)
                       + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
-            keep = colmask & (rows_g >= 1) & (rows_g <= M - 2)
-            return jnp.where(keep, new, C), C, keep
+            interior_r = (rows_g >= 1) & (rows_g <= M - 2)
+            ra0 = jnp.where(interior_r, a0v, 1.0)
+            rcx = jnp.where(interior_r, cxv, 0.0)
+            rcy = jnp.where(interior_r, cyv, 0.0)
+            new = ra0 * C + rcx * (U + D) + rcy * (Lf + Rt)
+            return new, C
 
         def step_into(src, dst, lo, hi):
-            """One masked step over scratch rows [lo, hi), chunked."""
+            """One coefficient-pinned step over scratch rows [lo, hi)."""
             r0 = lo
             while r0 < hi:
                 h = min(_SUBSTRIP, hi - r0)
-                new, _, _ = chunk_new(src, r0, h)
+                new, _ = chunk_new(src, r0, h)
                 dst[r0:r0 + h, :] = new.astype(dtype)
                 r0 += h
 
@@ -559,7 +607,6 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
         # side) is re-overwritten every step and, for K <= SUB, never
         # reaches the central T output rows.
         m = k - 1
-        sref = slots.at[slot]
 
         def double_step(_, carry):
             del carry
@@ -577,10 +624,11 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
         r0 = C0
         while r0 < C0 + T:
             h = min(_SUBSTRIP, C0 + T - r0)
-            new, C, keep = chunk_new(src, r0, h)
+            new, C = chunk_new(src, r0, h)
             out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
-            r_acc = jnp.maximum(
-                r_acc, jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
+            # Boundary cells contribute |C - C| = 0 by the pinned
+            # coefficients, so the residual needs no mask.
+            r_acc = jnp.maximum(r_acc, jnp.max(jnp.abs(new - C)))
             r0 += h
 
         @pl.when(s == 0)
@@ -616,6 +664,13 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k):
 
     def fn(u):
         new, res = call(u)
+        # Guard 2 (docstring): re-pin the Dirichlet boundary from the
+        # untouched input. Bitwise a no-op for stable runs; keeps
+        # 0*inf = NaN of a *diverging* run out of the output boundary.
+        new = new.at[0:1, :].set(u[0:1, :])
+        new = new.at[M - 1:M, :].set(u[M - 1:M, :])
+        new = new.at[:, 0:1].set(u[:, 0:1])
+        new = new.at[:, N - 1:N].set(u[:, N - 1:N])
         return new, res[0, 0]
 
     return fn

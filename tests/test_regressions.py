@@ -60,6 +60,29 @@ def test_vmem_kernel_boundary_pinned_even_when_diverging():
     np.testing.assert_array_equal(out[:, -1], u0[:, -1])
 
 
+def test_temporal_kernel_boundary_pinned_even_when_diverging():
+    # Kernel E pins the boundary with multiplicative coefficient
+    # vectors; on a diverging run 0*inf = NaN inside the kernel must
+    # not leak into the output boundary — ``fn`` re-pins it from the
+    # untouched input (the four .at[].set() guards). This locks that
+    # guard in: without it, the stable-run suite stays green because
+    # the re-pin is a bitwise no-op there.
+    from parallel_heat_tpu.ops.pallas_stencil import _build_temporal_strip
+
+    fn = _build_temporal_strip((256, 256), "float32", 0.9, 0.9, 8)
+    assert fn is not None
+    u0 = HeatPlate2D(256, 256).init_grid(jnp.float32)
+    u = u0
+    for _ in range(20):
+        u, _ = fn(u)
+    out, ini = np.asarray(u), np.asarray(u0)
+    assert not np.all(np.isfinite(out))  # it did diverge
+    np.testing.assert_array_equal(out[0, :], ini[0, :])
+    np.testing.assert_array_equal(out[-1, :], ini[-1, :])
+    np.testing.assert_array_equal(out[:, 0], ini[:, 0])
+    np.testing.assert_array_equal(out[:, -1], ini[:, -1])
+
+
 def test_streaming_pickers_decline_non_lane_aligned_widths(monkeypatch):
     # Mosaic rejects lane-dim slice extents that are not multiples of
     # 128 (real-TPU compile error at 5000^2); when compiling for

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Batched A/B of kernel-E variants on real hardware.
+
+probe_temporal.py's single-slope timing turned out too noisy on the
+axon transport (the same config read 160 and 110 Gcells*steps/s within
+one run); this harness re-times the interesting variants with the
+bench.py protocol (``chain_slope(batches=3)``, min of raw endpoint
+times) so a variant must win reproducibly before it ships.
+
+Variants (cumulative changes against the production kernel):
+  prod     -- exactly today's kernel E arithmetic: combine_2d +
+              per-cell ``jnp.where(keep, new, C)`` boundary select
+  vcoeff   -- boundary COLUMNS pinned by coefficient vectors (kernel
+              A's trick, a0->1 cx,cy->0 at cols 0/N-1) instead of the
+              select; boundary ROWS pinned by a cheap (h,1) row-zero
+              vector on the same coefficients. No per-cell select at
+              all; the residual needs no mask either (boundary cells
+              contribute |C-C| = 0 by construction). UNSAFE as-is:
+              0 * garbage-NaN from the uninitialized scratch frontier
+              would poison the pinned rows — perf probe only.
+  rowcopy  -- columns multiplicative as in vcoeff; boundary ROWS
+              re-pinned structurally (the saved Dirichlet row is
+              copied back into the destination after every step, edge
+              strips only — kernel A's structural-pinning idea moved
+              into the streaming kernel). No select, no row
+              coefficients, NaN-garbage-safe: garbage spreads only
+              arithmetically (1 row/step, the documented frontier)
+              and the pinned row is restored before anyone reads it.
+              Residual masks rows with a select (final step only).
+
+  vzero    -- vcoeff + the scratch garbage bands zeroed after the DMA
+              wait (NaN-safe).
+  vzero2   -- vzero with the zeroing issued BEFORE the DMA wait so the
+              stores hide behind the in-flight copy. This is the form
+              production kernel E shipped (minus its out-of-kernel
+              boundary re-pin).
+
+Run: python tools/ab_temporal.py [--quick]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.utils.profiling import chain_slope, sync
+
+CP = pltpu.CompilerParams(vmem_limit_bytes=128 * 1024 * 1024)
+SUB = 8
+LANE = 128
+
+
+def build(shape, k, T, substrip, variant):
+    M, N = shape
+    dtype = jnp.float32
+    cx = cy = 0.1
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+    n_strips = M // T
+    W = T + 2 * SUB
+    SCR = T + 4 * SUB
+    C0 = 2 * SUB
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, pin, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        interior_c = (cols >= 1) & (cols <= N - 2)
+        a0v = jnp.where(interior_c, jnp.float32(a0), 1.0)
+        cxv = jnp.where(interior_c, jnp.float32(cx), 0.0)
+        cyv = jnp.where(interior_c, jnp.float32(cy), 0.0)
+
+        def dma(slot, strip):
+            start = pl.multiple_of(
+                jnp.clip(strip * T - SUB, 0, M - W), SUB)
+            dst = pl.multiple_of(C0 + start - strip * T, SUB)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, pl.ds(dst, W), :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+
+        if variant == "vzero2":
+            # Same band sanitization as vzero, but issued BEFORE the
+            # DMA wait: the zeroed rows are disjoint from this strip's
+            # DMA window, so the stores hide behind the in-flight copy.
+            zrow = jnp.zeros((C0, N), dtype)
+
+            @pl.when(s == 0)
+            def _():
+                slots[0, 0:C0, :] = zrow
+                pp[0:C0, :] = zrow
+
+            @pl.when(s == n - 1)
+            def _():
+                slots.at[slot][T + 2 * SUB:T + 4 * SUB, :] = zrow
+                pp[T + 2 * SUB:T + 4 * SUB, :] = zrow
+
+        dma(slot, s).wait()
+
+        if variant == "rowcopy":
+            # Save the Dirichlet rows once (they never change).
+            @pl.when(s == 0)
+            def _():
+                pin[0:1, :] = slots[slot, C0:C0 + 1, :]
+
+            @pl.when(s == n - 1)
+            def _():
+                pin[1:2, :] = slots[slot, C0 + T - 1:C0 + T, :]
+
+        if variant == "vzero":
+            # One-time sanitization of the scratch garbage bands on the
+            # edge strips: the rows the sweep reads but no DMA wrote.
+            # Keeps the multiplicative row pinning NaN-safe (0*0=0).
+            zrow = jnp.zeros((C0, N), dtype)
+
+            @pl.when(s == 0)
+            def _():
+                slots[0, 0:C0, :] = zrow
+                pp[0:C0, :] = zrow
+
+            @pl.when(s == n - 1)
+            def _():
+                sref_z = slots.at[slot]
+                sref_z[T + 2 * SUB:T + 4 * SUB, :] = zrow
+                pp[T + 2 * SUB:T + 4 * SUB, :] = zrow
+
+        def repin(dst):
+            @pl.when(s == 0)
+            def _():
+                dst[C0:C0 + 1, :] = pin[0:1, :]
+
+            @pl.when(s == n - 1)
+            def _():
+                dst[C0 + T - 1:C0 + T, :] = pin[1:2, :]
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :]
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            L = jnp.roll(C, 1, axis=1)
+            R = jnp.roll(C, -1, axis=1)
+            rows_g = (s * T + (r0 - C0)
+                      + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+            interior_r = (rows_g >= 1) & (rows_g <= M - 2)
+            if variant == "rowcopy":
+                new = a0v * C + cxv * (U + D) + cyv * (L + R)
+                return new, C, interior_r
+            if variant in ("vcoeff", "vzero", "vzero2"):
+                ra0 = jnp.where(interior_r, a0v, 1.0)
+                rcx = jnp.where(interior_r, cxv, 0.0)
+                rcy = jnp.where(interior_r, cyv, 0.0)
+                new = ra0 * C + rcx * (U + D) + rcy * (L + R)
+                return new, C, None
+            new = a0 * C + cx * (U + D) + cy * (L + R)
+            keep = interior_c & interior_r
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(substrip, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :] = new.astype(dtype)
+                r0 += h
+            if variant == "rowcopy":
+                repin(dst)
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            step_into(pp, sref, SUB, T + 3 * SUB)
+            return 0
+
+        lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(substrip, C0 + T - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
+            d = jnp.abs(new - C)
+            if keep is not None:
+                d = jnp.where(keep, d, 0.0)
+            r_acc = jnp.maximum(r_acc, jnp.max(d))
+            r0 += h
+        if variant == "rowcopy":
+            @pl.when(s == 0)
+            def _():
+                out_ref[0:1, :] = pin[0:1, :]
+
+            @pl.when(s == n - 1)
+            def _():
+                out_ref[T - 1:T, :] = pin[1:2, :]
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        @pl.when(s > 0)
+        def _():
+            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, N), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, N), dtype),
+            pltpu.VMEM((SCR, N), dtype),
+            pltpu.VMEM((8, N), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=CP,
+    )
+
+
+def bench(shape, k, T, substrip, variant, budget_s=6.0):
+    u0 = jax.block_until_ready(HeatPlate2D(*shape).init_grid(jnp.float32))
+    try:
+        call = build(shape, k, T, substrip, variant)
+        run = jax.jit(lambda u: call(u)[0])
+        sync(run(u0))
+    except Exception as e:
+        print(f"{shape} k={k:2d} T={T:4d} sub={substrip:4d} {variant:8s}: "
+              f"FAILED {type(e).__name__}")
+        return None
+    from parallel_heat_tpu.utils.profiling import chain_time
+    t1 = chain_time(run, u0, 1)
+    r2 = 1 + max(2, min(48, int(budget_s / 3 / max(t1 - 0.15, 1e-3))))
+    try:
+        per = chain_slope(run, u0, 1, r2, batches=3) / k
+    except RuntimeError as e:
+        print(f"{shape} k={k:2d} T={T:4d} sub={substrip:4d} {variant:8s}: "
+              f"noisy ({e})")
+        return None
+    cells = shape[0] * shape[1]
+    g = cells / per / 1e9
+    print(f"{shape} k={k:2d} T={T:4d} sub={substrip:4d} {variant:8s}: "
+          f"{per*1e6:9.1f} us/step {g:7.1f} Gcells*steps/s")
+    return g
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    # Config-3 geometry (16384^2, production pick today: T=128 sub=64).
+    for variant in ("prod", "vcoeff", "vzero", "vzero2"):
+        bench((16384, 16384), 8, 128, 64, variant)
+    if not args.quick:
+        # 8192^2: production picks T=256.
+        for variant in ("prod", "vzero2"):
+            bench((8192, 8192), 8, 256, 64, variant)
