@@ -1499,6 +1499,15 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k):
     from sublane rolls and Z neighbors from lane rolls of the center
     plane; the wrapped values land only in cells the interior mask
     resets (Dirichlet faces, same masking as kernel D).
+
+    Negative result, measured so it is not retried: kernel E's
+    coefficient-vector boundary pinning (+18% in 2D) was ported here
+    and REGRESSED 512^3 from ~108 to 61-74 Gcells*steps/s end-to-end
+    (bisected on v5e: ~30% from the (1,Y,Z)-tensor coefficient
+    multiplies — tensor-tensor VPU ops re-reading a full coefficient
+    plane per term, where 2D's (1,N) lane vectors broadcast for free —
+    and ~13 Gcells*steps/s more from edge-slab scratch zeroing). The
+    per-cell select form below is the faster design in 3D.
     """
     X, Y, Z = shape
     dtype = jnp.dtype(dtype_name)
