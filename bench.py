@@ -45,7 +45,7 @@ def _sync_floor(u0):
     return sorted(times)[1]
 
 
-def _bench_fixed(cfg, budget_s=8.0, batches=3):
+def _bench_fixed(cfg, budget_s=10.0, batches=3):
     """Steady-state seconds per run (fixed-step configs, chained slope).
 
     Noise robustness comes from ``chain_slope(batches=...)`` — min over
@@ -66,7 +66,7 @@ def _bench_fixed(cfg, budget_s=8.0, batches=3):
     sync(g)  # compile + warm
     t1 = chain_time(step, u0, 1)
     compute_est = max(t1 - _sync_floor(u0), 1e-3)
-    r2 = 1 + max(1, min(24, int(budget_s / batches / compute_est)))
+    r2 = 1 + max(1, min(40, int(budget_s / batches / compute_est)))
     return chain_slope(step, u0, 1, r2, batches=batches)
 
 
@@ -99,7 +99,7 @@ def main(argv=None):
     ap.add_argument("--full", action="store_true",
                     help="also run secondary configs (extra JSON lines)")
     ap.add_argument("--backend", default="auto")
-    ap.add_argument("--budget", type=float, default=8.0,
+    ap.add_argument("--budget", type=float, default=10.0,
                     help="target seconds for the chained timing batch")
     args = ap.parse_args(argv)
 
