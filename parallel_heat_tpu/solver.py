@@ -337,6 +337,35 @@ def _prepare_initial(config: HeatConfig,
     return jax.block_until_ready(out)
 
 
+def _warn_if_diverged(res: Optional[float], steps_run: int,
+                      checked: bool) -> None:
+    """Runtime divergence detection (converge mode only — fixed-step
+    runs compute no residual to inspect): a non-finite residual means
+    the scheme blew up (inf - inf = NaN in the diff, or overflow to
+    inf), the while-loop's ``res >= eps`` went False, and the run
+    stopped early reporting ``converged=False``. Surface that as a
+    warning so the early exit is not mistaken for a quiet
+    non-convergence — the reference has no such guard (SURVEY.md §5
+    "Failure detection: none"); this pairs with the pre-run
+    ``HeatConfig.stability_margin`` check.
+
+    ``checked`` must be False when no residual check actually ran
+    (fewer steps than one ``check_interval``): the loop seed is the
+    inf sentinel then, indistinguishable from a real non-finite
+    residual, and warning on it would flag perfectly stable runs."""
+    import math
+    import warnings
+
+    if checked and res is not None and not math.isfinite(res):
+        warnings.warn(
+            f"simulation diverged: non-finite residual after {steps_run} "
+            f"steps (coefficient sum past the stability bound? see "
+            f"HeatConfig.stability_margin); grid values are garbage, "
+            f"boundary cells remain exact",
+            RuntimeWarning,
+        )
+
+
 def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                  chunk_steps: Optional[int] = None):
     """Iterate the simulation in host-visible chunks; yields a
@@ -384,6 +413,7 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
             out_res: Optional[float] = float(res)
         else:
             out_conv, out_res = None, None
+        _warn_if_diverged(out_res, done, k >= config.check_interval)
         yield HeatResult(grid=grid, steps_run=done, converged=out_conv,
                          residual=out_res, elapsed_s=elapsed)
         if config.converge and out_conv:
@@ -428,5 +458,8 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
         res: Optional[float] = float(residual)
     else:
         conv, res = None, None
+    _warn_if_diverged(res, steps_run,
+                      config.converge
+                      and steps_run >= config.check_interval)
     return HeatResult(grid=grid, steps_run=steps_run, converged=conv,
                       residual=res, elapsed_s=elapsed)

@@ -88,3 +88,4 @@ def test_unstable_coefficients_warn_on_validate():
         warnings.simplefilter("always")
         HeatConfig(cx=0.1, cy=0.1).validate()
     assert not w
+

@@ -1,3 +1,5 @@
+import warnings
+
 import numpy as np
 import pytest
 
@@ -83,3 +85,47 @@ def test_3d_converge():
     res = solve(cfg)
     assert res.converged is True
     assert res.steps_run % 25 == 0
+
+
+def test_diverged_converge_run_warns_at_runtime():
+    # Runtime failure detection: a converge-mode run whose residual
+    # goes non-finite (inf - inf = NaN) stops early with
+    # converged=False AND emits a divergence warning, so the early
+    # exit cannot be mistaken for quiet non-convergence.
+    cfg = HeatConfig(nx=16, ny=16, steps=2000, cx=0.3, cy=0.3,
+                     backend="jnp", converge=True, check_interval=20)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        res = solve(cfg)
+    assert res.converged is False
+    assert not np.isfinite(res.residual)
+    assert any("diverged" in str(w.message) for w in caught
+               if issubclass(w.category, RuntimeWarning))
+
+
+def test_no_divergence_warning_when_no_check_ran():
+    # The while-loop's inf residual seed is not a divergence: a stable
+    # converge run with steps < check_interval never computes a
+    # residual and must NOT warn (regression: the sentinel used to
+    # trip the detector).
+    cfg = HeatConfig(nx=16, ny=16, steps=10, converge=True,
+                     check_interval=20, backend="jnp")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        res = solve(cfg)
+    assert res.converged is False and res.steps_run == 10
+    assert not any("diverged" in str(w.message) for w in caught)
+
+
+def test_no_divergence_warning_on_stream_partial_chunk():
+    # solve_stream's final partial chunk (steps not a multiple of
+    # check_interval) also carries the sentinel; it must not warn.
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=16, ny=16, steps=50, converge=True,
+                     check_interval=20, backend="jnp")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        results = list(solve_stream(cfg, chunk_steps=20))
+    assert results[-1].steps_run == 50
+    assert not any("diverged" in str(w.message) for w in caught)
