@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """Kernel-E anatomy probe: where does the temporal strip kernel's time go?
 
+SUPERSEDED for A/B decisions by tools/ab_temporal.py, which uses the
+batched chained-slope protocol — the single-slope timing below proved
+too noisy on the axon transport (the same config read 160 and 110
+Gcells*steps/s within one run). Kept for the variant zoo and history;
+the numbers in this header predate the coefficient-vector pinning.
+
 Kernel A (VMEM-resident) sustains ~189 Gcells*steps/s; kernel E at
 16384^2 K=8 reaches ~113 even though its HBM traffic (~0.4 ms/step
 equivalent) should hide entirely behind compute (~1.4 ms/step at kernel
