@@ -337,6 +337,38 @@ def _prepare_initial(config: HeatConfig,
     return jax.block_until_ready(out)
 
 
+_COMPILED_CACHE: dict = {}
+
+
+def _compiled_for(runner, config: HeatConfig, u):
+    """AOT-compile ``runner`` for ``u``'s shape/sharding, memoized.
+
+    Lowering+compiling *before* the caller starts its clock keeps
+    compile time out of ``elapsed_s`` even on the first run of a
+    config — the reference's binaries are likewise built before their
+    wall-clock brackets start (``mpi/Makefile``, ``cuda/Makefile``),
+    so one-shot timings stay comparable. ``jit``'s own cache is keyed
+    internally and would only be populated by a real (buffer-donating)
+    call; this explicit executable cache gives the same reuse without
+    running a simulation to warm it.
+
+    The runner object itself is part of the key (not just the config):
+    after ``_build_runner.cache_clear()`` a fresh jit wrapper misses
+    here naturally, so executables cannot outlive the runner-cache
+    invalidation the tests rely on. Holding the runner as a dict key
+    also keeps it alive, so identity cannot be recycled.
+    """
+    key = (runner, config, u.shape, str(u.dtype),
+           str(getattr(u, "sharding", None)))
+    hit = _COMPILED_CACHE.get(key)
+    if hit is None:
+        if len(_COMPILED_CACHE) >= 256:
+            _COMPILED_CACHE.clear()
+        hit = runner.lower(u).compile()
+        _COMPILED_CACHE[key] = hit
+    return hit
+
+
 def _warn_if_diverged(res: Optional[float], steps_run: int,
                       checked: bool) -> None:
     """Runtime divergence detection (converge mode only — fixed-step
@@ -400,9 +432,11 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     elapsed = 0.0
     while done < total:
         c = min(chunk, total - done)
-        runner, _ = _build_runner(config.replace(steps=c))
+        ccfg = config.replace(steps=c)
+        runner, _ = _build_runner(ccfg)
+        compiled = _compiled_for(runner, ccfg, u)
         t0 = time.perf_counter()
-        grid, k, conv, res = runner(u)
+        grid, k, conv, res = compiled(u)
         jax.block_until_ready(grid)
         k = int(k)
         elapsed += time.perf_counter() - t0
@@ -430,17 +464,20 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     A caller-supplied ``initial`` is copied first: the compiled runner
     donates its input buffer (the double-buffer swap), which would
     otherwise invalidate the caller's array. Timing covers the step
-    loop only (compile time excluded on cache hits), synchronized like
-    the reference's wall-clock brackets (``cuda/cuda_heat.cu:203,239``).
+    loop only — the program is AOT-compiled before the clock starts
+    (``_compiled_for``), so ``elapsed_s`` never includes compile, cold
+    or warm, matching the reference's wall-clock brackets around
+    precompiled binaries (``cuda/cuda_heat.cu:203,239``).
     """
     import time
 
     config = config.validate()
     runner, _ = _build_runner(config)
     initial = _prepare_initial(config, initial)
+    compiled = _compiled_for(runner, config, initial)
 
     t0 = time.perf_counter()
-    grid, steps_run, converged, residual = runner(initial)
+    grid, steps_run, converged, residual = compiled(initial)
     if block_until_ready:
         # One host-visible scalar read *is* the flush: on remote-TPU
         # transports (axon tunnel) block_until_ready returns at
