@@ -14,6 +14,9 @@ import json
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
+# float16 is deliberately absent: Mosaic rejects f16 VMEM refs on TPU
+# ("Unsupported type in mosaic dialect: 'f16'", probed on v5e) — bf16
+# is the 2-byte storage dtype TPUs actually support.
 _VALID_DTYPES = ("float32", "bfloat16", "float64")
 _VALID_BACKENDS = ("auto", "jnp", "pallas")
 
