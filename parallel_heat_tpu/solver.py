@@ -76,6 +76,13 @@ def model_for(config: HeatConfig):
 
 
 def _resolve_backend(config: HeatConfig) -> str:
+    if jnp.dtype(config.dtype).itemsize == 8:
+        # Mosaic has no 64-bit types ("Unsupported type in mosaic
+        # dialect: 'f64'", probed on v5e) — float64 always runs the
+        # XLA-fused path, declining exactly like the geometry-based
+        # picker declines. Without this, the default backend="auto"
+        # crashed at trace time on TPU for f64 configs.
+        return "jnp"
     if config.backend != "auto":
         return config.backend
     plat = jax.devices()[0].platform

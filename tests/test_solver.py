@@ -129,3 +129,25 @@ def test_no_divergence_warning_on_stream_partial_chunk():
         results = list(solve_stream(cfg, chunk_steps=20))
     assert results[-1].steps_run == 50
     assert not any("diverged" in str(w.message) for w in caught)
+
+
+def test_float64_declines_pallas_and_runs():
+    # Mosaic has no 64-bit types; every backend choice must route f64
+    # to the XLA-fused path instead of crashing at trace time
+    # (regression: backend="auto" raised NotImplementedError on TPU).
+    import jax
+
+    from parallel_heat_tpu.solver import _resolve_backend
+
+    was = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for backend in ("auto", "pallas", "jnp"):
+            cfg = HeatConfig(nx=32, ny=32, steps=20, dtype="float64",
+                             backend=backend)
+            assert _resolve_backend(cfg) == "jnp"
+            out = solve(cfg).to_numpy()
+            assert out.dtype == np.float64
+            assert np.isfinite(out).all()
+    finally:
+        jax.config.update("jax_enable_x64", was)
