@@ -17,7 +17,8 @@ from parallel_heat_tpu.config import HeatConfig
 _FORMAT_VERSION = 1
 
 
-def save_checkpoint(path, grid, step: int, config: HeatConfig) -> str:
+def save_checkpoint(path, grid, step: int, config: HeatConfig,
+                    compress: bool = False) -> str:
     """Write a snapshot; returns the actual path written (always .npz —
     normalized here rather than letting np.savez append it silently).
 
@@ -26,6 +27,12 @@ def save_checkpoint(path, grid, step: int, config: HeatConfig) -> str:
     overwrites one rolling file, and a crash mid-write must leave the
     previous snapshot intact — a torn file would defeat the feature's
     whole purpose.
+
+    ``compress`` defaults to off: deflate on f32 field data measured
+    8x slower for ~10% size (256 MB grid: 1.5 s vs 12 s) — at this
+    framework's benchmark sizes a compressed periodic checkpoint would
+    stall the run for minutes per snapshot. ``load_checkpoint`` reads
+    either format.
     """
     import os
 
@@ -33,8 +40,9 @@ def save_checkpoint(path, grid, step: int, config: HeatConfig) -> str:
     if not path.endswith(".npz"):
         path += ".npz"
     tmp = path + ".tmp.npz"  # must end .npz or np.savez appends it
+    saver = np.savez_compressed if compress else np.savez
     try:
-        np.savez_compressed(
+        saver(
             tmp,
             grid=np.asarray(grid),
             step=np.int64(step),
