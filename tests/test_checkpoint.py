@@ -107,9 +107,12 @@ def test_save_checkpoint_failure_preserves_previous(tmp_path, monkeypatch):
         open(path, "wb").write(b"torn")
         raise OSError("disk full")
 
-    monkeypatch.setattr(np, "savez_compressed", boom)
+    monkeypatch.setattr(np, "savez", boom)  # the default (uncompressed) path
     with pytest.raises(OSError):
         save_checkpoint(p, res.grid, 2, cfg)
+    monkeypatch.setattr(np, "savez_compressed", boom)
+    with pytest.raises(OSError):
+        save_checkpoint(p, res.grid, 2, cfg, compress=True)
     monkeypatch.undo()
     grid, step, _ = load_checkpoint(p)  # previous snapshot intact
     assert step == 1
