@@ -1,0 +1,78 @@
+"""True multi-process distributed runs (the multi-host/DCN analog).
+
+Everything else in the suite validates sharding within one process
+(8 virtual devices, one JAX runtime). These tests start TWO separate
+Python processes that form one 8-device global mesh through
+``jax.distributed.initialize`` — the same coordination-service path a
+real multi-host TPU pod uses over DCN (SURVEY.md §2c: the reference's
+analog is MPI ranks across lab machines). Each process owns 4 CPU
+devices; the sharded solve spans both, so the halo ``ppermute``s, the
+``pmax`` convergence vote, and the ``process_allgather`` in
+``gather_to_host`` all cross a process boundary.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=2, process_id=pid)
+sys.path.insert(0, {repo!r})
+import numpy as np
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.parallel.distributed import gather_to_host
+
+assert len(jax.devices()) == 8, jax.devices()
+kw = dict(nx=64, ny=64, steps=30, converge=True, check_interval=10,
+          backend="jnp")
+res = solve(HeatConfig(**kw, mesh_shape=(2, 4)))
+full = np.asarray(gather_to_host(res.grid))
+oracle = solve(HeatConfig(**kw)).to_numpy()
+assert res.steps_run == 30
+assert np.array_equal(full, oracle), "multi-process != single-device"
+print("WORKER-OK", pid, flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_solve_matches_single_device(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=REPO))
+    port = str(_free_port())
+    env = dict(os.environ)
+    # A parent JAX session must not leak its platform choice in.
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen([sys.executable, str(worker), str(i), port],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env, cwd=str(tmp_path))
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER-OK {i}" in out
