@@ -41,6 +41,12 @@ full = np.asarray(gather_to_host(res.grid))
 oracle = solve(HeatConfig(**kw)).to_numpy()
 assert res.steps_run == 30
 assert np.array_equal(full, oracle), "multi-process != single-device"
+
+# K-deep temporal exchange (one collective round per 5 steps) across
+# the same cross-process mesh must also match bitwise.
+deep = solve(HeatConfig(**kw, mesh_shape=(2, 4), halo_depth=5))
+assert np.array_equal(np.asarray(gather_to_host(deep.grid)), oracle), \\
+    "multi-process deep-halo != single-device"
 print("WORKER-OK", pid, flush=True)
 """
 
