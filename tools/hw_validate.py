@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""One-command hardware validation: run the real-TPU checks CI cannot.
+
+The pytest suite runs on 8 virtual CPU devices (Pallas in interpret
+mode), which is blind to Mosaic's compile-time constraints and to real
+VMEM/DMA behavior. This script drives every Pallas kernel family and
+the end-to-end solver on the attached accelerator and checks:
+
+  1. bitwise agreement of kernels E (2D temporal strip) and G
+     (shard-block temporal) with the factored-form oracle, f32 + bf16;
+  2. the diverging-run boundary-exactness guards of kernels A, E, G
+     (0*inf = NaN must never reach the output boundary);
+  3. an odd-geometry end-to-end sweep (unaligned widths decline to the
+     jnp fallback; aligned-but-odd shapes run Pallas) — pallas vs jnp
+     within the documented few-ulp contract;
+  4. the dtype x mode matrix (f32/bf16 x fixed/converge), plus f64
+     routing (must decline Pallas, not crash);
+  5. a solve_stream + checkpoint + resume round trip at a streaming-
+     kernel size, bitwise against the one-shot run.
+
+Exit code 0 = all checks passed. Run from the repo root:
+``python tools/hw_validate.py [--quick]``.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+FAILURES = []
+
+
+def check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"  [{status}] {name}" + (f" ({detail})" if detail else ""))
+    if not ok:
+        FAILURES.append(name)
+
+
+def factored_step_2d(u, cx, cy):
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu.ops.stencil import combine_2d
+
+    M, N = u.shape
+    acc = u.astype(jnp.float32)
+    new = combine_2d(acc, jnp.roll(acc, 1, 0), jnp.roll(acc, -1, 0),
+                     jnp.roll(acc, 1, 1), jnp.roll(acc, -1, 1), cx, cy)
+    rows = jnp.arange(M)[:, None]
+    cols = jnp.arange(N)[None, :]
+    keep = (rows >= 1) & (rows <= M - 2) & (cols >= 1) & (cols <= N - 2)
+    return jnp.where(keep, new, acc).astype(u.dtype)
+
+
+def kernel_bitwise_checks():
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu.models import HeatPlate2D
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    print("kernel bitwise vs factored oracle:")
+    for (M, N), dt in [((1024, 1024), "float32"), ((768, 1280), "bfloat16")]:
+        k = ps._sub_rows(jnp.dtype(dt))
+        u = HeatPlate2D(M, N).init_grid(jnp.dtype(dt))
+        v = u
+        for _ in range(k):
+            v = factored_step_2d(v, 0.1, 0.1)
+        want = np.asarray(v)
+
+        fnE = ps._build_temporal_strip((M, N), dt, 0.1, 0.1, k)
+        gotE = np.asarray(jax.jit(fnE)(u)[0]) if fnE else None
+        check(f"kernel E {M}x{N} {dt} k={k}",
+              gotE is not None and np.array_equal(gotE, want))
+
+        fnG = ps._build_temporal_block((M, N), dt, 0.1, 0.1, (M, N), k)
+        if fnG is None:
+            check(f"kernel G {M}x{N} {dt} k={k}", False, "builder declined")
+            continue
+        Np = fnG.padded_width
+        ext = jnp.zeros((M + 2 * k, Np), u.dtype).at[k:k + M, k:k + N].set(u)
+        core = np.asarray(jax.jit(lambda e: fnG(e, 0, -k))(ext)[0])
+        check(f"kernel G {M}x{N} {dt} k={k}",
+              np.array_equal(core[:, k:k + N], want))
+
+
+def divergence_guard_checks():
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu.models import HeatPlate2D
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    print("diverging-run boundary guards:")
+
+    def boundary_exact(out, ini):
+        return (np.array_equal(out[0], ini[0])
+                and np.array_equal(out[-1], ini[-1])
+                and np.array_equal(out[:, 0], ini[:, 0])
+                and np.array_equal(out[:, -1], ini[:, -1]))
+
+    u0 = HeatPlate2D(256, 256).init_grid(jnp.float32)
+
+    fnE = jax.jit(ps._build_temporal_strip((256, 256), "float32", 0.9, 0.9, 8))
+    u = u0
+    for _ in range(20):
+        u, _ = fnE(u)
+    out = np.asarray(u)
+    check("kernel E diverged + boundary exact",
+          (not np.all(np.isfinite(out))) and boundary_exact(out, np.asarray(u0)))
+
+    k = 8
+    fnG = ps._build_temporal_block((256, 256), "float32", 0.9, 0.9,
+                                   (256, 256), k)
+    Np = fnG.padded_width
+
+    def stepG(u):
+        ext = jnp.zeros((256 + 2 * k, Np), u.dtype)
+        ext = ext.at[k:k + 256, k:k + 256].set(u)
+        return fnG(ext, 0, -k)[0][:, k:k + 256]
+
+    stepG = jax.jit(stepG)
+    u = u0
+    for _ in range(20):
+        u = stepG(u)
+    out = np.asarray(u)
+    check("kernel G diverged + boundary exact",
+          (not np.all(np.isfinite(out))) and boundary_exact(out, np.asarray(u0)))
+
+
+def odd_geometry_sweep(quick):
+    from parallel_heat_tpu import HeatConfig, solve
+
+    print("odd-geometry end-to-end sweep (pallas vs jnp):")
+    cases = [
+        dict(nx=5000, ny=5000, steps=24),            # unaligned -> decline
+        dict(nx=4864, ny=4992, steps=24),            # aligned, odd divisors
+        dict(nx=1000, ny=1024, steps=24),
+        dict(nx=3072, ny=2944, steps=30, dtype="bfloat16"),
+        dict(nx=2048, ny=2048, steps=37, converge=True, check_interval=7),
+        dict(nx=300, ny=300, nz=384, steps=12),      # 3D unaligned Y
+        dict(nx=320, ny=320, nz=384, steps=12),      # 3D aligned
+    ]
+    if not quick:
+        cases += [dict(nx=131072, ny=512, steps=8),
+                  dict(nx=512, ny=131072, steps=8)]
+    for kw in cases:
+        cfg = HeatConfig(**kw)
+        a = solve(cfg.replace(backend="jnp")).to_numpy().astype(np.float64)
+        b = solve(cfg.replace(backend="pallas")).to_numpy().astype(np.float64)
+        name = "x".join(str(v) for v in cfg.shape)
+        check(f"{name} {cfg.dtype}{' conv' if cfg.converge else ''}",
+              np.allclose(a, b, rtol=2e-5, atol=1e-2),
+              f"maxdiff={np.max(np.abs(a - b)):.2g}")
+
+
+def dtype_mode_matrix():
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import _resolve_backend
+
+    print("dtype x mode matrix:")
+    for dt in ("float32", "bfloat16"):
+        for conv in (False, True):
+            kw = dict(nx=1024, ny=1024, steps=100, dtype=dt)
+            if conv:
+                kw.update(converge=True, check_interval=20)
+            out = solve(HeatConfig(**kw)).to_numpy().astype(np.float64)
+            check(f"{dt} conv={conv}", bool(np.isfinite(out).all()))
+    # f64 must route to jnp everywhere, never crash in Pallas.
+    ok = all(_resolve_backend(HeatConfig(nx=32, ny=32, dtype="float64",
+                                         backend=b)) == "jnp"
+             for b in ("auto", "pallas", "jnp"))
+    check("float64 declines pallas", ok)
+
+
+def stream_checkpoint_roundtrip():
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import solve_stream
+    from parallel_heat_tpu.utils.checkpoint import (load_checkpoint,
+                                                    save_checkpoint)
+
+    print("stream + checkpoint + resume round trip (4096^2):")
+    cfg = HeatConfig(nx=4096, ny=4096, steps=800)
+    d = tempfile.mkdtemp()
+    ck = os.path.join(d, "mid.npz")
+    res = None
+    for res in solve_stream(cfg, chunk_steps=200):
+        if res.steps_run == 400:
+            save_checkpoint(ck, res.grid, step=res.steps_run, config=cfg)
+    final_stream = res.to_numpy()
+    grid, step, _ = load_checkpoint(ck)
+    resumed = solve(HeatConfig(nx=4096, ny=4096, steps=800 - step),
+                    initial=grid).to_numpy()
+    check("resume == streamed", np.array_equal(final_stream, resumed))
+    check("one-shot == streamed",
+          np.array_equal(final_stream, solve(cfg).to_numpy()))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the slowest sweep cases")
+    args = ap.parse_args()
+
+    import jax
+    print(f"devices: {jax.devices()}")
+
+    kernel_bitwise_checks()
+    divergence_guard_checks()
+    dtype_mode_matrix()
+    odd_geometry_sweep(args.quick)
+    stream_checkpoint_roundtrip()
+
+    if FAILURES:
+        print(f"\n{len(FAILURES)} FAILED: {FAILURES}")
+        return 1
+    print("\nall hardware checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
