@@ -118,10 +118,20 @@ def main(argv=None):
     sys.stdout.flush()
 
     if args.full:
+        # The 4096^2 converge config provably does not reach eps=1e-3
+        # within 10k steps (REPORT.md), so its while_loop executes all
+        # 10k steps regardless of eps - the identical program can be
+        # timed with the chained-slope protocol by making eps
+        # unreachable (1e-30), which removes the one-shot transport
+        # noise that made this row jitter 163-181 Gcells*steps/s. The
+        # convergence machinery (every-20-step fused residual + pmax
+        # vote + while_loop) is fully included: measured ~4-7% over
+        # the fixed-step program at this size.
         secondary = [
-            ("4096^2 + eps-convergence (wall-clock s)",
+            ("4096^2 + eps-convergence machinery, 10k steps (wall-clock s)",
              HeatConfig(nx=4096, ny=4096, steps=10_000, converge=True,
-                        check_interval=20, backend=args.backend)),
+                        check_interval=20, eps=1e-30,
+                        backend=args.backend)),
             ("16384^2, 1k steps f32 (Mcells*steps/s)",
              HeatConfig(nx=16384, ny=16384, steps=1000,
                         backend=args.backend)),
@@ -134,12 +144,13 @@ def main(argv=None):
         ]
         for name, cfg in secondary:
             try:
-                if cfg.converge:
-                    elapsed, res = _bench_converge(cfg)
-                    steps_run = res.steps_run
-                else:
+                chainable = not cfg.converge or cfg.eps <= 1e-20
+                if chainable:
                     elapsed = _bench_fixed(cfg, args.budget)
                     steps_run = cfg.steps
+                else:
+                    elapsed, res = _bench_converge(cfg)
+                    steps_run = res.steps_run
                 cells = cfg.nx * cfg.ny * (cfg.nz or 1)
                 out = {
                     "metric": name,
@@ -147,7 +158,7 @@ def main(argv=None):
                     "mcells_steps_per_s": round(
                         cells * steps_run / elapsed / 1e6, 1),
                 }
-                if cfg.converge:
+                if cfg.converge and not chainable:
                     out["steps_to_converge"] = steps_run
                     out["converged"] = res.converged
                 print(json.dumps(out))
