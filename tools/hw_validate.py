@@ -144,6 +144,10 @@ def odd_geometry_sweep(quick):
         dict(nx=2048, ny=2048, steps=37, converge=True, check_interval=7),
         dict(nx=300, ny=300, nz=384, steps=12),      # 3D unaligned Y
         dict(nx=320, ny=320, nz=384, steps=12),      # 3D aligned
+        # asymmetric coefficients (different pinned-vector constants)
+        dict(nx=1024, ny=1024, steps=60, cx=0.12, cy=0.07),
+        dict(nx=4096, ny=4096, steps=40, cx=0.05, cy=0.21),
+        dict(nx=320, ny=320, nz=384, steps=12, cx=0.08, cy=0.11, cz=0.14),
     ]
     if not quick:
         cases += [dict(nx=131072, ny=512, steps=8),
