@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="resume from an .npz checkpoint")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the resolved execution path (backend, "
+                         "kernel pick, mesh) and exit without running")
     ap.add_argument("--quiet", action="store_true")
     return ap
 
@@ -130,6 +133,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.explain:
+        from parallel_heat_tpu.solver import explain
+
+        for key, val in explain(config).items():
+            print(f"{key}: {val}")
+        return 0
     if args.checkpoint_every is not None:
         # Validate before any side effect (banner, resume load, file
         # writes) so a pure argument error leaves nothing behind.

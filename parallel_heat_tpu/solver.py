@@ -344,6 +344,119 @@ def _prepare_initial(config: HeatConfig,
     return jax.block_until_ready(out)
 
 
+def explain(config: HeatConfig) -> dict:
+    """Resolve — without running anything — which execution path a
+    config takes: backend, mesh, and the exact kernel/pick the solver's
+    factories would choose (mirrors their decision order by calling the
+    same pickers). Surfaced by the CLI as ``--explain``; useful for
+    understanding why a geometry declined to a fallback.
+
+    Maintenance contract: each branch mirrors one factory —
+    ``single_grid_multistep`` (2D), ``single_grid_multistep_3d``,
+    ``block_steps`` (sharded per-step), ``temporal._pallas_round_2d``
+    (sharded K-deep). A change to any factory's pick order must be
+    mirrored here; ``tests/test_cli.py::
+    test_explain_resolves_expected_paths`` pins one case per branch.
+    """
+    config = config.validate()
+    backend = _resolve_backend(config)
+    mesh_shape = config.mesh_or_unit()
+    is_sharded = any(d > 1 for d in mesh_shape)
+    out = {
+        "backend": backend,
+        "dtype": config.dtype,
+        "shape": config.shape,
+        "mesh": mesh_shape if is_sharded else None,
+        "mode": "converge" if config.converge else "fixed",
+    }
+    if backend != "pallas":
+        out["path"] = "XLA-fused jnp stencil"
+        if is_sharded:
+            out["path"] += (
+                f" on shard blocks (halo_depth={config.halo_depth}: "
+                + ("K-deep temporal exchange rounds"
+                   if config.halo_depth > 1 else "per-step halo exchange")
+                + ")")
+        return out
+
+    import jax.numpy as _jnp
+
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    dtype = config.dtype
+    cx, cy = float(config.cx), float(config.cy)
+    sub = ps._sub_rows(dtype)
+
+    if is_sharded:
+        bx_by = config.block_shape()
+        if config.halo_depth > 1:
+            if config.ndim == 2 and config.halo_depth == sub:
+                built = ps._build_temporal_block(
+                    bx_by, dtype, cx, cy, config.shape, config.halo_depth)
+                if built is not None:
+                    out["path"] = (
+                        f"kernel G (shard-block temporal, K={sub}) per "
+                        f"exchange round, padded width {built.padded_width}")
+                    return out
+            out["path"] = (f"jnp K-deep temporal rounds "
+                           f"(halo_depth={config.halo_depth}) on shard "
+                           f"blocks")
+            return out
+        # Mirrors ops/pallas_stencil.block_steps: strip kernel first,
+        # tiled kernel as fallback, jnp when both decline or by < 2.
+        if config.ndim == 2 and bx_by[1] >= 2:
+            t = ps._pick_strip_rows(bx_by[0], bx_by[1], dtype, sharded=True)
+            if t is not None:
+                out["path"] = (f"kernel B (streaming strip, sharded) "
+                               f"T={t} + jnp edge-column epilogue")
+                return out
+            tc = ps._pick_tile_2d(bx_by[0], bx_by[1], dtype, sharded=True)
+            if tc is not None:
+                out["path"] = (f"kernel C (2D-tiled, sharded) "
+                               f"tile={tc[0]}x{tc[1]} + jnp edge-column "
+                               f"epilogue")
+                return out
+        out["path"] = "jnp block step (per-step halo exchange)"
+        return out
+
+    if config.ndim == 3:
+        pick = ps._pick_xslab_3d(config.shape, _jnp.dtype(dtype))
+        if pick is not None:
+            out["path"] = (f"kernel F (X-slab temporal) sx={pick[0]} "
+                           f"K={pick[1]}")
+            return out
+        pick = ps._pick_slab_3d(config.shape, _jnp.dtype(dtype))
+        if pick is not None and config.nx >= 3 and config.ny >= 3:
+            out["path"] = (f"kernel D (XY-tiled 3D slab) sx={pick[0]} "
+                           f"ty={pick[1]}")
+            return out
+        out["path"] = "XLA-fused jnp stencil (3D pickers declined)"
+        return out
+
+    if ps.fits_vmem(config.shape, dtype):
+        out["path"] = "kernel A (VMEM-resident multi-step)"
+        return out
+    t = ps._pick_temporal_strip(config.nx, config.ny, dtype)
+    if t is not None:
+        out["path"] = f"kernel E (temporal-blocked strip) T={t} K={sub}"
+        return out
+    t_b = ps._pick_strip_rows(config.nx, config.ny, dtype, sharded=False)
+    t_c = ps._pick_tile_2d(config.nx, config.ny, dtype, sharded=False)
+    eff_b = t_b / (t_b + 2 * sub) if t_b else 0.0
+    eff_c = (t_c[0] * t_c[1] / ((t_c[0] + 2 * sub)
+                                * (t_c[1] + 2 * ps._LANE))
+             if t_c else 0.0)
+    if t_c and eff_c > eff_b:
+        out["path"] = f"kernel C (2D-tiled streaming) tile={t_c[0]}x{t_c[1]}"
+    elif t_b:
+        out["path"] = f"kernel B (streaming strip) T={t_b}"
+    elif t_c:
+        out["path"] = f"kernel C (2D-tiled streaming) tile={t_c[0]}x{t_c[1]}"
+    else:
+        out["path"] = "XLA-fused jnp stencil (2D pickers declined)"
+    return out
+
+
 _COMPILED_CACHE: dict = {}
 
 

@@ -155,3 +155,41 @@ def test_cli_halo_depth_auto_clamps_to_block(tmp_path):
                "--dtype", "bfloat16", "--backend", "pallas",
                "--mesh", "2,2", "--halo-depth", "auto", "--quiet"])
     assert rc == 0
+
+
+def test_explain_flag(capsys):
+    from parallel_heat_tpu.cli import main
+
+    assert main(["--nx", "64", "--ny", "64", "--explain"]) == 0
+    out = capsys.readouterr().out
+    assert "path:" in out and "backend:" in out
+
+
+def test_explain_resolves_expected_paths():
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.solver import explain
+
+    # Mirrors the picker decision order without running anything.
+    assert "kernel A" in explain(
+        HeatConfig(nx=256, ny=256, backend="pallas"))["path"]
+    assert "kernel E" in explain(
+        HeatConfig(nx=16384, ny=16384, backend="pallas"))["path"]
+    assert "kernel F" in explain(
+        HeatConfig(nx=512, ny=512, nz=512, backend="pallas"))["path"]
+    assert "kernel G" in explain(
+        HeatConfig(nx=256, ny=256, mesh_shape=(2, 4), backend="pallas",
+                   halo_depth=8))["path"]
+    assert "jnp" in explain(
+        HeatConfig(nx=64, ny=64, backend="jnp"))["path"]
+
+
+def test_explain_sharded_tiled_fallback():
+    # block_steps' fallback order is strip -> tiled -> jnp; explain()
+    # must mirror all three (regression: the tiled stage was omitted,
+    # misreporting exactly the decline cases --explain exists for).
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.solver import explain
+
+    path = explain(HeatConfig(nx=1024, ny=524288, mesh_shape=(2, 2),
+                              backend="pallas", dtype="bfloat16"))["path"]
+    assert "kernel C" in path
