@@ -798,8 +798,13 @@ def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
 
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
-                          k, vma=None):
+                          k, vma=None, with_residual=True):
     """K steps on a ``(bx+2k, by+2k)`` halo-padded shard block.
+
+    ``with_residual=False`` omits the final sweep's fused max-norm
+    (same rationale as kernel E's plain variant: the caller's
+    fixed-step rounds discard it, and XLA cannot DCE through the
+    custom call).
 
     The shard-level counterpart of kernel E, closing the loop with the
     K-deep mesh exchange (``parallel/temporal.py``): the caller
@@ -920,21 +925,23 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
             h = min(_SUBSTRIP, C0 + T - r0)
             new, C = chunk_new(src, r0, h)
             out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
-            # Pinned cells contribute |C-C| = 0; halo/junk columns
-            # carry frontier garbage, so the core-column select stays
-            # (a (1, Np)-predicate broadcast — cheap, and NaN-safe).
-            r_acc = jnp.maximum(
-                r_acc,
-                jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0)))
+            if with_residual:
+                # Pinned cells contribute |C-C| = 0; halo/junk columns
+                # carry frontier garbage, so the core-column select
+                # stays (a (1, Np)-predicate broadcast — NaN-safe).
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0)))
             r0 += h
 
         @pl.when(s == 0)
         def _():
             res_ref[0, 0] = r_acc
 
-        @pl.when(s > 0)
-        def _():
-            res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

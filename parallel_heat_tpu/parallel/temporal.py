@@ -200,11 +200,14 @@ def _pallas_round_2d(config, kw):
         return None
     bx, by = config.block_shape()
     axis_names = kw["axis_names"]
-    built = ps._build_temporal_block(
-        (bx, by), config.dtype, float(config.cx), float(config.cy),
-        config.shape, K, vma=tuple(axis_names))
+    args = ((bx, by), config.dtype, float(config.cx), float(config.cy),
+            config.shape, K, tuple(axis_names))
+    built = ps._build_temporal_block(*args)
     if built is None:
         return None
+    # Rounds whose residual the caller discards use the plain variant
+    # (no fused max-norm sweep — see kernel E's rationale).
+    built_plain = ps._build_temporal_block(*args, with_residual=False)
     mesh_shape = kw["mesh_shape"]
     block_index = kw["block_index"]
     # axis_index('x') varies only on 'x'; broaden (see ops block_steps).
@@ -218,7 +221,8 @@ def _pallas_round_2d(config, kw):
     def fn(u, want_res):
         ext = exchange_halos_deep_2d(u, K, mesh_shape, axis_names,
                                      pad_cols=pad)
-        core_rows, res = built(ext, row_off, col_off)
+        kernel = built if want_res else built_plain
+        core_rows, res = kernel(ext, row_off, col_off)
         core = core_rows[:, K:K + by]
         if want_res:
             return core, lax.pmax(res, axis_names)
