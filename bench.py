@@ -146,6 +146,20 @@ def main(argv=None):
             try:
                 chainable = not cfg.converge or cfg.eps <= 1e-20
                 if chainable:
+                    if cfg.converge:
+                        # The chained-slope math assumes every run
+                        # executes all cfg.steps; verify the while_loop
+                        # really never exits early (a bitwise fixed
+                        # point would make residual exactly 0.0 < eps
+                        # and silently inflate the rate ~steps/ci-fold).
+                        from parallel_heat_tpu import solve as _solve
+
+                        probe = _solve(cfg)
+                        if probe.steps_run != cfg.steps:
+                            raise RuntimeError(
+                                f"converge config exited at step "
+                                f"{probe.steps_run} < {cfg.steps}; "
+                                f"chained timing invalid")
                     elapsed = _bench_fixed(cfg, args.budget)
                     steps_run = cfg.steps
                 else:

@@ -74,12 +74,12 @@ def main():
     cells = args.n ** 3
     print(f"converged={res.converged} after {res.steps_run} steps, "
           f"residual={res.residual:.3e}")
-    # One-shot elapsed includes jit compile (first run of a config)
-    # and the transport readback; re-run for steady-state numbers, or
-    # see bench.py for the chained-slope protocol that cancels both.
-    print(f"step loop + compile {res.elapsed_s:.3f}s "
+    # elapsed_s excludes compile (solve AOT-compiles before its clock)
+    # but a one-shot run still carries the transport dispatch/readback
+    # latency; see bench.py's chained-slope protocol for steady-state.
+    print(f"step loop {res.elapsed_s:.3f}s "
           f"({cells * res.steps_run / max(res.elapsed_s, 1e-9) / 1e6:.0f} "
-          f"Mcells*steps/s one-shot), total wall {wall:.1f}s")
+          f"Mcells*steps/s one-shot), total wall incl. compile {wall:.1f}s")
 
 
 if __name__ == "__main__":

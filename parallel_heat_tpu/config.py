@@ -187,10 +187,16 @@ class HeatConfig:
             )
         if self.halo_depth > 1:
             sub = sublane_count(self.dtype)
-            if self.backend == "pallas" and self.halo_depth != sub:
+            is_f64 = self.dtype == "float64"
+            if self.backend == "pallas" and self.halo_depth != sub \
+                    and not is_f64:
                 # Kernel G only exists at depth == the dtype's sublane
                 # count; any other depth would silently fall back to
                 # jnp rounds against an explicit pallas request.
+                # float64 is exempt: Mosaic has no 64-bit types, so the
+                # solver routes f64 to the jnp path for EVERY backend
+                # choice (a dtype-level decline, like the geometry
+                # declines) — the jnp rounds support any depth.
                 raise ValueError(
                     f"backend='pallas' with halo_depth > 1 requires "
                     f"halo_depth == {sub} for dtype {self.dtype} (the "

@@ -483,7 +483,9 @@ def _compiled_for(runner, config: HeatConfig, u):
     hit = _COMPILED_CACHE.get(key)
     if hit is None:
         if len(_COMPILED_CACHE) >= 256:
-            _COMPILED_CACHE.clear()
+            # Evict the oldest entry (dict preserves insertion order) —
+            # wiping everything would recompile still-hot configs.
+            _COMPILED_CACHE.pop(next(iter(_COMPILED_CACHE)))
         hit = runner.lower(u).compile()
         _COMPILED_CACHE[key] = hit
     return hit

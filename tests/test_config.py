@@ -89,3 +89,20 @@ def test_unstable_coefficients_warn_on_validate():
         HeatConfig(cx=0.1, cy=0.1).validate()
     assert not w
 
+
+def test_f64_deep_halo_any_depth_validates():
+    # f64 routes to the jnp path for every backend choice (Mosaic has
+    # no 64-bit types), and the jnp rounds support any depth — so the
+    # pallas depth==sublane restriction must not fire for f64
+    # (regression: explicit pallas + f64 + halo_depth=4 raised even
+    # though the program that actually runs supports it).
+    import jax
+
+    was = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        cfg = HeatConfig(nx=64, ny=64, dtype="float64", backend="pallas",
+                         mesh_shape=(2, 2), halo_depth=4)
+        cfg.validate()  # must not raise
+    finally:
+        jax.config.update("jax_enable_x64", was)
