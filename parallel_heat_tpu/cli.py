@@ -36,7 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--cy", type=float, default=0.1)
     ap.add_argument("--cz", type=float, default=0.1)
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "bfloat16"])
+                    choices=["float32", "bfloat16", "float64"],
+                    help="storage dtype (float64 enables jax x64 mode and "
+                         "always runs the XLA-fused jnp path: Mosaic has "
+                         "no 64-bit types)")
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "jnp", "pallas"])
     ap.add_argument("--mesh", default=None,
@@ -44,11 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "'auto' factorizes over all local devices)")
     ap.add_argument("--no-overlap", action="store_true",
                     help="disable the interior/edge comm-compute overlap")
-    ap.add_argument("--halo-depth", default="1", metavar="K",
+    ap.add_argument("--halo-depth", default="auto", metavar="K",
                     help="exchange K-deep halos once per K steps instead "
-                         "of 1-deep every step (sharded runs); 'auto' "
-                         "picks the Mosaic block kernel's depth (the "
-                         "dtype's sublane count) when a mesh is set")
+                         "of 1-deep every step (sharded runs). The "
+                         "default 'auto' picks the Mosaic block kernel's "
+                         "depth (the dtype's sublane count) when the "
+                         "resolved backend is pallas, a mesh is set and "
+                         "the geometry admits, else 1 — see --explain "
+                         "for the resolved value")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write final grid (.dat for 2D, .npy otherwise)")
     ap.add_argument("--initial-out", default=None, metavar="FILE",
@@ -93,26 +99,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from parallel_heat_tpu import HeatConfig, solve
     from parallel_heat_tpu.solver import make_initial_grid
 
+    if args.dtype == "float64":
+        # Must happen before any trace; validate() rejects f64 without
+        # x64 mode (JAX would silently compute in f32 otherwise).
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
     ndim = 3 if args.nz is not None else 2
     mesh_shape = _parse_mesh(args.mesh, ndim)
     if args.halo_depth == "auto":
-        # The Mosaic block kernel's depth (kernel G) when sharded —
-        # clamped to the smallest block extent so 'auto' never errors
-        # on a value the user didn't choose. Single-device runs have no
-        # exchange to deepen. (A clamped depth simply runs jnp rounds.)
-        if mesh_shape is None:
-            halo_depth = 1
-        else:
-            from parallel_heat_tpu.config import sublane_count
-
-            dims = [args.nx, args.ny] + ([args.nz] if args.nz else [])
-            bmin = min(n // d for n, d in zip(dims, mesh_shape) if d > 0)
-            sub = sublane_count(args.dtype)
-            halo_depth = max(1, min(sub, bmin))
-            if args.backend == "pallas" and halo_depth != sub:
-                # explicit pallas only supports depth == sublane count;
-                # a clamped depth would be rejected by validate()
-                halo_depth = 1
+        # Thin alias for the library default: halo_depth=None lets the
+        # solver resolve the depth (solver._resolve_halo_depth); the
+        # resolution is visible via --explain.
+        halo_depth = None
     else:
         try:
             halo_depth = int(args.halo_depth)

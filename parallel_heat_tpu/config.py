@@ -80,8 +80,14 @@ class HeatConfig:
     # K steps instead of 1-deep halos every step (parallel/temporal.py)
     # — K x fewer collective rounds. 1 = the classic per-step exchange.
     # Applies to sharded runs (2D and 3D); results are bitwise identical
-    # either way on the jnp path.
-    halo_depth: int = 1
+    # either way on the jnp path. None (the default) = auto: the solver
+    # picks the Mosaic block kernel's depth (the dtype's sublane count)
+    # when the resolved backend is pallas, a mesh is set, and the block
+    # geometry admits — the best comm schedule should not be opt-in
+    # (the reference's persistent-comms + overlap is likewise its
+    # default, mpi/...stat.c:130-234) — and 1 otherwise. Explicit
+    # values always win (``solver._resolve_halo_depth``).
+    halo_depth: Optional[int] = None
 
     # --- derived helpers -------------------------------------------------
 
@@ -181,11 +187,12 @@ class HeatConfig:
                 raise ValueError(
                     f"grid n{name}={n} is not divisible by mesh d{name}={d}"
                 )
-        if self.halo_depth < 1:
+        if self.halo_depth is not None and self.halo_depth < 1:
             raise ValueError(
-                f"halo_depth must be >= 1, got {self.halo_depth}"
+                f"halo_depth must be >= 1 (or None for auto), got "
+                f"{self.halo_depth}"
             )
-        if self.halo_depth > 1:
+        if self.halo_depth is not None and self.halo_depth > 1:
             sub = sublane_count(self.dtype)
             is_f64 = self.dtype == "float64"
             if self.backend == "pallas" and self.halo_depth != sub \

@@ -23,6 +23,7 @@ ping-pongs the two HBM buffers exactly like the reference's
 
 from __future__ import annotations
 
+import collections
 import functools
 from dataclasses import dataclass
 from typing import Optional
@@ -82,11 +83,75 @@ def _resolve_backend(config: HeatConfig) -> str:
         # XLA-fused path, declining exactly like the geometry-based
         # picker declines. Without this, the default backend="auto"
         # crashed at trace time on TPU for f64 configs.
+        if config.backend == "pallas":
+            # Loud decline (once per process): a user benchmarking an
+            # explicit 'pallas' request should not silently get jnp
+            # numbers. --explain shows the same routing on demand.
+            import warnings
+
+            warnings.warn(
+                "backend='pallas' with dtype='float64' runs the XLA-fused "
+                "jnp path: Mosaic has no 64-bit types (this dtype-level "
+                "decline mirrors the geometry declines; see --explain)",
+                RuntimeWarning,
+            )
         return "jnp"
     if config.backend != "auto":
         return config.backend
     plat = jax.devices()[0].platform
     return "pallas" if plat in ("tpu", "axon") else "jnp"
+
+
+def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
+    """Resolve ``halo_depth=None`` (auto) to a concrete exchange depth.
+
+    Auto picks the Mosaic block temporal kernel's depth (the dtype's
+    sublane count) exactly when that kernel would actually run: the
+    resolved backend is pallas, a mesh is set, and the block geometry
+    admits (probed by building the kernel — the builders are lru_cached,
+    so the probe is the build). Everything else resolves to 1 (the
+    classic per-step exchange, which keeps the interior/edge overlap
+    split). Explicit user values always win; ``config.validate()``
+    rejects explicit values the kernels cannot honor.
+    """
+    if config.halo_depth is not None:
+        return config.halo_depth
+    mesh_shape = config.mesh_or_unit()
+    if not any(d > 1 for d in mesh_shape) or backend != "pallas":
+        return 1
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+    from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
+    sub = ps._sub_rows(config.dtype)
+    if sub > min(config.block_shape()):
+        return 1
+    if config.ndim == 2:
+        bx, by = config.block_shape()
+        # Same args (incl. vma = the mesh axis names) as the real build
+        # in temporal._pallas_round_2d, so the probe IS the build —
+        # one lru_cache entry, and no probe/build divergence if the
+        # builder's decline logic ever becomes vma-dependent.
+        built = ps._build_temporal_block(
+            (bx, by), config.dtype, float(config.cx), float(config.cy),
+            config.shape, sub, AXIS_NAMES[:2])
+        return sub if built is not None else 1
+    return 1  # 3D sharded: no Mosaic block kernel yet
+
+
+def _resolved(config: HeatConfig):
+    """(config-with-concrete-depth, backend, was_auto) — the one place
+    the None-means-auto depth is substituted, shared by
+    :func:`_build_runner` and :func:`explain` so the reported path can
+    never diverge from the built one."""
+    backend = _resolve_backend(config)
+    depth = _resolve_halo_depth(config, backend)
+    was_auto = config.halo_depth is None
+    if config.halo_depth != depth:
+        # Downstream (the temporal module, block factories) reads
+        # config.halo_depth as the concrete depth; substitute the
+        # resolved value once here so None never escapes the driver.
+        config = config.replace(halo_depth=depth)
+    return config, backend, was_auto
 
 
 def _dtype_of(config: HeatConfig):
@@ -214,7 +279,7 @@ def _build_runner(config: HeatConfig):
     ``(grid, steps_run, converged, residual)``.
     """
     config.validate()
-    backend = _resolve_backend(config)
+    config, backend, _ = _resolved(config)
     mesh_shape = config.mesh_or_unit()
     is_sharded = any(d > 1 for d in mesh_shape)
 
@@ -359,7 +424,7 @@ def explain(config: HeatConfig) -> dict:
     test_explain_resolves_expected_paths`` pins one case per branch.
     """
     config = config.validate()
-    backend = _resolve_backend(config)
+    config, backend, auto_depth = _resolved(config)
     mesh_shape = config.mesh_or_unit()
     is_sharded = any(d > 1 for d in mesh_shape)
     out = {
@@ -369,6 +434,9 @@ def explain(config: HeatConfig) -> dict:
         "mesh": mesh_shape if is_sharded else None,
         "mode": "converge" if config.converge else "fixed",
     }
+    if is_sharded:
+        out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
+                             else config.halo_depth)
     if backend != "pallas":
         out["path"] = "XLA-fused jnp stencil"
         if is_sharded:
@@ -391,8 +459,11 @@ def explain(config: HeatConfig) -> dict:
         bx_by = config.block_shape()
         if config.halo_depth > 1:
             if config.ndim == 2 and config.halo_depth == sub:
+                from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
                 built = ps._build_temporal_block(
-                    bx_by, dtype, cx, cy, config.shape, config.halo_depth)
+                    bx_by, dtype, cx, cy, config.shape, config.halo_depth,
+                    AXIS_NAMES[:2])
                 if built is not None:
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}) per "
@@ -457,7 +528,7 @@ def explain(config: HeatConfig) -> dict:
     return out
 
 
-_COMPILED_CACHE: dict = {}
+_COMPILED_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 
 
 def _compiled_for(runner, config: HeatConfig, u):
@@ -483,11 +554,14 @@ def _compiled_for(runner, config: HeatConfig, u):
     hit = _COMPILED_CACHE.get(key)
     if hit is None:
         if len(_COMPILED_CACHE) >= 256:
-            # Evict the oldest entry (dict preserves insertion order) —
-            # wiping everything would recompile still-hot configs.
-            _COMPILED_CACHE.pop(next(iter(_COMPILED_CACHE)))
+            # Evict least-recently-USED (hits move entries to the end
+            # below), never a still-hot config; wiping everything would
+            # recompile those.
+            _COMPILED_CACHE.popitem(last=False)
         hit = runner.lower(u).compile()
         _COMPILED_CACHE[key] = hit
+    else:
+        _COMPILED_CACHE.move_to_end(key)
     return hit
 
 

@@ -57,13 +57,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_solve_matches_single_device(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER.format(repo=REPO))
-    port = str(_free_port())
-    env = dict(os.environ)
-    # A parent JAX session must not leak its platform choice in.
-    env.pop("JAX_PLATFORMS", None)
+def _run_workers(worker, port, env, tmp_path):
     procs = [
         subprocess.Popen([sys.executable, str(worker), str(i), port],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -79,6 +73,26 @@ def test_two_process_solve_matches_single_device(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs
+
+
+def test_two_process_solve_matches_single_device(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=REPO))
+    env = dict(os.environ)
+    # A parent JAX session must not leak its platform choice in.
+    env.pop("JAX_PLATFORMS", None)
+    # _free_port closes its probe socket before the coordinator binds
+    # it (TOCTOU): another process can grab the port in between, so a
+    # bind failure retries on a fresh port instead of flaking.
+    for attempt in range(3):
+        port = str(_free_port())
+        procs, outs = _run_workers(worker, port, env, tmp_path)
+        if attempt < 2 and any(p.returncode != 0 for p in procs) \
+                and any("already in use" in o.lower()
+                        or "address in use" in o.lower() for o in outs):
+            continue
+        break
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER-OK {i}" in out

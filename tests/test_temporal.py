@@ -206,3 +206,60 @@ def test_deep_halo_explicit_pallas_requires_sublane_depth():
                backend="pallas").validate()
     HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=16,
                dtype="bfloat16", backend="pallas").validate()
+
+
+def test_resolve_halo_depth_matrix():
+    """Pin the auto (halo_depth=None) resolution matrix.
+
+    Auto deepens to the dtype's sublane count exactly when the Mosaic
+    block kernel would run: resolved backend pallas + mesh + admitting
+    geometry. Everything else resolves to 1.
+    """
+    from parallel_heat_tpu.solver import _resolve_halo_depth
+
+    r = _resolve_halo_depth
+    # pallas + mesh + admitting geometry -> sublane depth
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2)), "pallas") == 8
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                        dtype="bfloat16"), "pallas") == 16
+    # jnp backend keeps the per-step overlap split
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2)), "jnp") == 1
+    # single device: no exchange to deepen
+    assert r(HeatConfig(nx=64, ny=64), "pallas") == 1
+    # block smaller than the sublane depth -> clamp to 1
+    assert r(HeatConfig(nx=8, ny=8, mesh_shape=(2, 2)), "pallas") == 1
+    # explicit value always wins
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=3),
+             "pallas") == 3
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=1),
+             "jnp") == 1
+    # 3D currently resolves to 1 (no sharded Mosaic kernel yet)
+    assert r(HeatConfig(nx=32, ny=32, nz=128, mesh_shape=(2, 2, 1)),
+             "pallas") == 1
+
+
+def test_auto_depth_solve_matches_explicit_depth():
+    # A bare sharded pallas config (auto depth) must match the same
+    # solve with the depth pinned explicitly and the jnp oracle.
+    kw = dict(nx=32, ny=32, steps=17)
+    import numpy as np
+
+    oracle = solve(HeatConfig(backend="jnp", **kw)).to_numpy()
+    auto = solve(HeatConfig(backend="pallas", mesh_shape=(2, 2),
+                            **kw)).to_numpy()
+    pinned = solve(HeatConfig(backend="pallas", mesh_shape=(2, 2),
+                              halo_depth=8, **kw)).to_numpy()
+    np.testing.assert_array_equal(auto, pinned)
+    np.testing.assert_allclose(auto, oracle, rtol=1e-4, atol=1e-3)
+
+
+def test_explain_reports_auto_depth():
+    from parallel_heat_tpu.solver import explain
+
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="pallas"))
+    assert out["halo_depth"] == "8 (auto)"
+    assert "kernel G" in out["path"]
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="jnp"))
+    assert out["halo_depth"] == "1 (auto)"
