@@ -49,18 +49,20 @@ from parallel_heat_tpu.parallel.halo import exchange_halos_2d
 
 _ACC = jnp.float32
 
-# Usable VMEM for the resident kernel's two grid buffers. v5e has
-# 128 MiB of VMEM per core (empirically probed: a 127 MiB scratch
-# compiles and runs); leave room for the per-strip f32 temporaries and
-# Mosaic's own spills.
-_VMEM_BUDGET_BYTES = 80 * 1024 * 1024
+# All VMEM budgets / bandwidth / VPU-rate constants the pickers use are
+# per-device-generation (measured on v5e, tabled/extrapolated for the
+# rest) and live in ops/tpu_params.py.
+from parallel_heat_tpu.ops.tpu_params import params as _params
 
-# Mosaic's default *scoped* VMEM limit is 16 MiB — far below the
-# hardware's 128 MiB. Every kernel here raises it so the budgets above
-# are real (without this, any kernel whose buffers exceed 16 MiB fails
-# with a scoped-vmem stack OOM at compile time).
-_COMPILER_PARAMS = pltpu.CompilerParams(
-    vmem_limit_bytes=128 * 1024 * 1024)
+
+def _compiler_params() -> pltpu.CompilerParams:
+    # Mosaic's default *scoped* VMEM limit is 16 MiB — far below the
+    # hardware's real VMEM. Every kernel raises it to the generation's
+    # physical size so the pickers' budgets are real (without this, any
+    # kernel whose buffers exceed 16 MiB fails with a scoped-vmem stack
+    # OOM at compile time).
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=_params().vmem_limit_bytes)
 
 
 def _interpret() -> bool:
@@ -79,10 +81,10 @@ def fits_vmem(shape: Tuple[int, int], dtype) -> bool:
     cells = shape[0] * shape[1]
     # Two grid buffers plus the resident kernel's ~4 full-strip f32
     # compute temporaries (same temp model as the streaming pickers) —
-    # all must fit under the 128 MiB vmem_limit with margin.
+    # all must fit under the generation's vmem_limit with margin.
     temps = 4 * (128 + 2) * shape[1] * 4
     return (2 * cells * jnp.dtype(dtype).itemsize + temps
-            <= _VMEM_BUDGET_BYTES)
+            <= _params().resident_budget_bytes)
 
 
 def _clamped_window(idx, tile, halo, limit, win, align, c0):
@@ -223,7 +225,7 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
         scratch_shapes=[pltpu.VMEM((M, N), dtype)],
         input_output_aliases={0: 0},
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u):
@@ -262,7 +264,7 @@ def _pick_strip_rows(out_rows: int, n_cols: int, dtype,
         return None
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 100 * 1024 * 1024
+    budget = _params().stream_budget_bytes
     t_max = 512
     if not sharded:
         t_max = min(t_max, out_rows - 2 * sub)
@@ -404,7 +406,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u, row_off, col_off):
@@ -432,13 +434,14 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
         return None
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    # 100 MiB is deliberate headroom under the 128 MiB vmem_limit.
+    # The stream budget is deliberate headroom under the generation's
+    # vmem_limit (100 of 128 MiB on v5e, where this was measured).
     # A 118 MiB budget (admitting T=256 instead of 128 at 16384^2) was
     # A/B'd on v5e: bare-kernel chains preferred T=256 by ~25%, but
     # end-to-end solver throughput was unchanged (152.8 vs 153.1
     # Gcells*steps/s) with slight regressions on the bf16/converge
     # rows — so the conservative budget stays.
-    budget = 100 * 1024 * 1024
+    budget = _params().stream_budget_bytes
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
     # T caps at 256: measured on v5e (tools/probe_temporal.py), T=512
     # variants hit Mosaic register-allocator spills (up to 45 MiB of
@@ -692,7 +695,7 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u):
@@ -784,7 +787,7 @@ def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     (T, n_cols) output, f32 chunk temporaries)."""
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 100 * 1024 * 1024
+    budget = _params().stream_budget_bytes
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
     best = None
     for t in range(sub, min(256, out_rows) + 1, sub):
@@ -969,7 +972,7 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
         ),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(ext, row_off, col_off):
@@ -1191,7 +1194,7 @@ def _pick_tile_2d(out_rows: int, n_cols: int, dtype, sharded: bool):
     """
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 100 * 1024 * 1024
+    budget = _params().stream_budget_bytes
     best = None
     for cw in (1024, 2048, 4096, 8192):
         if n_cols % cw != 0 or n_cols // cw < 2:
@@ -1338,7 +1341,7 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u, row_off, col_off):
@@ -1364,7 +1367,7 @@ def _pick_slab_3d(shape, dtype):
     X, Y, Z = shape
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = 100 * 1024 * 1024
+    budget = _params().stream_budget_bytes
     if Z % _LANE != 0:
         # The slab DMA copies whole-Z panes; Mosaic requires lane-dim
         # slice extents to be 128-aligned. Smaller/odd Z: jnp fallback.
@@ -1491,7 +1494,7 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u):
@@ -1539,12 +1542,13 @@ def _pick_xslab_3d(shape, dtype):
         return None
     plane = Y * Z * itemsize
     plane_f32 = Y * Z * 4
-    budget = 100 * 1024 * 1024
-    bw = 350e9          # achieved read+write HBM mix, bytes/s (measured
-                        # on v5e: k=1 variants of both 3D kernels time
-                        # out at exactly this rate regardless of window
-                        # contiguity)
-    rate = 140e9        # VPU 7-point cells/s at full occupancy
+    hw = _params()
+    budget = hw.stream_budget_bytes
+    bw = hw.hbm_stream_bytes_per_s   # achieved read+write HBM mix
+                        # (v5e-measured: k=1 variants of both 3D
+                        # kernels time out at exactly this rate
+                        # regardless of window contiguity)
+    rate = hw.vpu_cells_per_s        # VPU 7-point cells/s, full occupancy
     ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")
@@ -1718,7 +1722,7 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )
 
     def fn(u):

@@ -1,0 +1,126 @@
+"""Per-device-generation hardware parameters for the kernel pickers.
+
+Round 1 hard-coded v5e-measured literals (VMEM budgets, achieved HBM
+bandwidth, VPU stencil rate) throughout ``pallas_stencil.py``; on any
+other TPU generation those numbers would mis-budget the pickers — in
+the VMEM case badly enough to fail compiles (scoped-vmem OOM on a
+16 MiB-VMEM v3). This module is the one queried/overridable place they
+live now.
+
+Provenance of the numbers:
+
+- **v5e row: measured** on real hardware in round 1 (REPORT.md §2-§4).
+  The 128 MiB VMEM was probed empirically (a 127 MiB scratch compiles);
+  350 GB/s is the achieved read+write stencil-stream mix (both 3D
+  kernels' k=1 variants time out at exactly this rate); 140 Gcells/s is
+  the sustained VPU 7-point rate at full occupancy.
+- **Other rows: extrapolated, not measured.** VMEM sizes are public
+  (128 MiB for v4/v5p/v6e, 16 MiB for v2/v3); achieved bandwidth scales
+  the v5e measurement by the public spec-sheet HBM ratio (the stencil
+  stream pattern is identical); VPU rates are rough clock/width scalings
+  and only bias the (sx, K) scoring of kernel F's picker, never
+  correctness. First measurement on a new generation should replace its
+  row (``tools/kernel_probe.py``).
+
+The unknown-kind fallback is the v5e row — also used on CPU (interpret
+mode), which keeps the test suite's picker decisions identical to
+hardware's.
+
+No counterpart in the reference: its CUDA build bakes one
+architecture's geometry into compile-time macros (``cuda/Makefile:5``,
+``cuda_heat.cu:17-21``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+_MIB = 1024 * 1024
+
+
+@dataclass(frozen=True)
+class TpuParams:
+    kind: str                    # canonical generation name
+    vmem_bytes: int              # physical VMEM per core
+    hbm_stream_bytes_per_s: float  # achieved stencil read+write mix
+    vpu_cells_per_s: float       # sustained 7-point VPU rate
+
+    @property
+    def vmem_limit_bytes(self) -> int:
+        """Mosaic scoped-VMEM limit: the full physical VMEM (Mosaic's
+        own default is 16 MiB; every kernel raises it to this)."""
+        return self.vmem_bytes
+
+    @property
+    def resident_budget_bytes(self) -> int:
+        """Budget for kernel A's two whole-grid VMEM buffers — leaves
+        room for per-strip f32 temporaries and Mosaic's spills (the
+        measured-safe 80/128 fraction of physical VMEM)."""
+        return self.vmem_bytes * 80 // 128
+
+    @property
+    def stream_budget_bytes(self) -> int:
+        """Budget for the streaming kernels' scratch+output buffers
+        (the measured-safe 100/128 fraction)."""
+        return self.vmem_bytes * 100 // 128
+
+
+_V5E = TpuParams("v5e", 128 * _MIB, 350e9, 140e9)          # measured
+_TABLE = {
+    "v5e": _V5E,
+    # Extrapolated rows (see module docstring).
+    "v6e": TpuParams("v6e", 128 * _MIB, 700e9, 250e9),     # HBM 1640 GB/s
+    "v5p": TpuParams("v5p", 128 * _MIB, 1180e9, 250e9),    # HBM 2765 GB/s
+    "v4": TpuParams("v4", 128 * _MIB, 520e9, 170e9),       # HBM 1228 GB/s
+    "v3": TpuParams("v3", 16 * _MIB, 380e9, 100e9),        # HBM 900 GB/s
+    "v2": TpuParams("v2", 16 * _MIB, 300e9, 70e9),         # HBM 700 GB/s
+}
+
+_override: Optional[TpuParams] = None
+
+
+def classify_device_kind(device_kind: str) -> str:
+    """Map a raw ``jax.Device.device_kind`` string to a table row.
+
+    Kind strings observed across jax versions: "TPU v2".."TPU v4",
+    "TPU v4 lite", "TPU v5 lite" / "TPU v5e", "TPU v5p" / "TPU v5",
+    "TPU v6 lite" / "TPU v6e". Unknown kinds fall back to v5e.
+    """
+    k = device_kind.lower()
+    if "v6" in k:
+        return "v6e"
+    if "v5" in k:
+        return "v5e" if ("lite" in k or "v5e" in k) else "v5p"
+    if "v4" in k:
+        return "v4"
+    if "v3" in k:
+        return "v3"
+    if "v2" in k:
+        return "v2"
+    return "v5e"
+
+
+def set_override(params: Optional[TpuParams]) -> None:
+    """Force a parameter set (None restores auto-detection). For tests
+    and for running on generations the table mis-models; callers must
+    clear the kernel builders' lru_caches themselves if kernels were
+    already built under different parameters."""
+    global _override
+    _override = params
+
+
+def params() -> TpuParams:
+    """Parameters for the current backend's device generation."""
+    if _override is not None:
+        return _override
+    env = os.environ.get("PHT_TPU_KIND")
+    if env:
+        return _TABLE.get(classify_device_kind(env), _V5E)
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        return _V5E  # interpret mode: keep picks identical to hardware
+    return _TABLE[classify_device_kind(getattr(dev, "device_kind", ""))]

@@ -46,6 +46,16 @@ def pick_mesh_shape(n_devices: int, ndim: int = 2) -> Tuple[int, ...]:
     return tuple(sorted(dims, reverse=True))
 
 
+def _use_topology_order(avail) -> bool:
+    """Whether device placement should follow physical (ICI) topology.
+
+    Only TPU backends expose torus coordinates; elsewhere
+    ``create_device_mesh`` degenerates to enumeration order anyway.
+    Separated out so tests can fake a TPU platform without real chips.
+    """
+    return avail[0].platform in ("tpu", "axon")
+
+
 def make_heat_mesh(
     mesh_shape: Sequence[int],
     devices: Optional[Sequence[jax.Device]] = None,
@@ -54,20 +64,50 @@ def make_heat_mesh(
 
     Axis names follow the spatial axes ``('x', 'y'[, 'z'])`` so sharding
     specs read like the domain decomposition they implement.
+
+    Device order is ICI-topology-aware: when the mesh spans every
+    device of the backend (``jax.devices()`` — global across processes
+    in a multi-host run), ``mesh_utils.create_device_mesh`` assigns
+    devices by their physical torus coordinates, so the ±1 ``ppermute``
+    halo shifts in ``halo.py`` travel one ICI hop instead of arbitrary
+    routes — the analog of ``MPI_Cart_create``'s ``reorder=1``
+    (``mpi/...stat.c:60``), which likewise lets the runtime permute
+    ranks to match the physical network. In multi-host runs that
+    default also groups hosts sensibly (``create_device_mesh`` keeps
+    each host's devices contiguous); pass an explicit ``devices`` list
+    only to override that layout, e.g. to pin which mesh axis crosses
+    DCN — explicit lists always win and are used exactly as given.
+    Off-TPU (and for partial-device meshes, where jax has no contiguity
+    guarantee to exploit) this falls back to enumeration order, which
+    on the virtual CPU meshes of the test suite is exactly the old
+    behavior.
     """
-    mesh_shape = tuple(mesh_shape)
-    names = AXIS_NAMES[: len(mesh_shape)]
-    if devices is None:
-        n = 1
-        for d in mesh_shape:
-            n *= d
-        avail = jax.devices()
-        if n > len(avail):
-            raise ValueError(
-                f"mesh {mesh_shape} needs {n} devices, have {len(avail)}"
-            )
-        devices = avail[:n]
     import numpy as np
 
-    dev_array = np.asarray(devices).reshape(mesh_shape)
+    mesh_shape = tuple(mesh_shape)
+    names = AXIS_NAMES[: len(mesh_shape)]
+    if devices is not None:
+        dev_array = np.asarray(devices).reshape(mesh_shape)
+        return Mesh(dev_array, names)
+    n = 1
+    for d in mesh_shape:
+        n *= d
+    avail = jax.devices()
+    if n > len(avail):
+        raise ValueError(
+            f"mesh {mesh_shape} needs {n} devices, have {len(avail)}"
+        )
+    if n == len(avail) and _use_topology_order(avail):
+        from jax.experimental import mesh_utils
+
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                mesh_shape, devices=avail)
+        except (ValueError, NotImplementedError):
+            # Unfactorable topology/shape combination — fall back to
+            # enumeration order rather than refusing to build a mesh
+            # the arbitrary ordering can still serve.
+            dev_array = np.asarray(avail).reshape(mesh_shape)
+        return Mesh(dev_array, names)
+    dev_array = np.asarray(avail[:n]).reshape(mesh_shape)
     return Mesh(dev_array, names)

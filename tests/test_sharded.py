@@ -75,3 +75,57 @@ def test_nonsquare_blocks(mesh):
         HeatConfig(nx=12, ny=36, steps=17, backend="jnp", mesh_shape=mesh)
     ).to_numpy()
     np.testing.assert_array_equal(got, want)
+
+
+def test_mesh_topology_aware_device_order(monkeypatch):
+    """make_heat_mesh consults the physical topology (via
+    mesh_utils.create_device_mesh) when the mesh spans all devices on a
+    TPU platform — faked here so the assignment path runs on CPU."""
+    import numpy as np
+    import jax
+    from jax.experimental import mesh_utils
+    from parallel_heat_tpu.parallel import mesh as m
+
+    perm = list(reversed(jax.devices()))
+    calls = {}
+
+    def fake_create(shape, devices=None):
+        calls["shape"] = tuple(shape)
+        return np.asarray(perm).reshape(shape)
+
+    monkeypatch.setattr(m, "_use_topology_order", lambda avail: True)
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+    built = m.make_heat_mesh((2, 4))
+    assert calls["shape"] == (2, 4)
+    assert list(built.devices.flat) == perm
+    assert built.axis_names == ("x", "y")
+
+
+def test_mesh_partial_and_explicit_device_order():
+    # Partial meshes (fewer devices than available) and explicit device
+    # lists keep enumeration/user order — no topology reorder to rely on.
+    import jax
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+
+    devs = jax.devices()
+    built = make_heat_mesh((2, 2))
+    assert list(built.devices.flat) == devs[:4]
+    pick = [devs[3], devs[1], devs[0], devs[2]]
+    built = make_heat_mesh((2, 2), devices=pick)
+    assert list(built.devices.flat) == pick
+
+
+def test_mesh_topology_fallback_on_unfactorable(monkeypatch):
+    # create_device_mesh refusals (unfactorable shape/topology) fall
+    # back to enumeration order instead of erroring.
+    import jax
+    from jax.experimental import mesh_utils
+    from parallel_heat_tpu.parallel import mesh as m
+
+    def refuse(shape, devices=None):
+        raise ValueError("cannot factor topology")
+
+    monkeypatch.setattr(m, "_use_topology_order", lambda avail: True)
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", refuse)
+    built = m.make_heat_mesh((2, 4))
+    assert list(built.devices.flat) == jax.devices()

@@ -1,0 +1,87 @@
+"""Per-device-generation parameter table (ops/tpu_params.py).
+
+The pickers must re-budget when the device generation changes: a faked
+16 MiB-VMEM v3 must shrink or decline picks a 128 MiB v5e admits, and
+the kernel F scorer must respond to the bandwidth/VPU ratios. On CPU
+(this suite) the fallback row is v5e, pinning picker decisions to the
+hardware-validated ones.
+"""
+
+import pytest
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.ops import tpu_params as tp
+
+
+@pytest.fixture
+def restore():
+    yield
+    tp.set_override(None)
+
+
+def test_classify_device_kind():
+    assert tp.classify_device_kind("TPU v5 lite") == "v5e"
+    assert tp.classify_device_kind("TPU v5e") == "v5e"
+    assert tp.classify_device_kind("TPU v5p") == "v5p"
+    assert tp.classify_device_kind("TPU v5") == "v5p"
+    assert tp.classify_device_kind("TPU v6 lite") == "v6e"
+    assert tp.classify_device_kind("TPU v6e") == "v6e"
+    assert tp.classify_device_kind("TPU v4") == "v4"
+    assert tp.classify_device_kind("TPU v4 lite") == "v4"
+    assert tp.classify_device_kind("TPU v3") == "v3"
+    assert tp.classify_device_kind("TPU v2") == "v2"
+    assert tp.classify_device_kind("TPU weird future") == "v5e"
+
+
+def test_default_params_off_tpu_is_v5e():
+    assert tp.params().kind == "v5e"
+    assert tp.params().vmem_bytes == 128 * 1024 * 1024
+    # derived budgets match the round-1 measured-safe literals
+    assert tp.params().resident_budget_bytes == 80 * 1024 * 1024
+    assert tp.params().stream_budget_bytes == 100 * 1024 * 1024
+
+
+def test_env_override_selects_row(monkeypatch):
+    monkeypatch.setenv("PHT_TPU_KIND", "TPU v4")
+    assert tp.params().kind == "v4"
+
+
+def test_v3_budget_shrinks_picks(restore):
+    # v5e admits a 4096-wide f32 strip pick; a 16 MiB v3 must not.
+    t_v5e = ps._pick_strip_rows(4096, 4096, "float32", sharded=False)
+    assert t_v5e is not None
+    tp.set_override(tp._TABLE["v3"])
+    t_v3 = ps._pick_strip_rows(4096, 4096, "float32", sharded=False)
+    assert t_v3 is None or t_v3 < t_v5e
+    # resident kernel A: a grid that fits v5e VMEM does not fit v3
+    assert not ps.fits_vmem((1024, 1024), "float32")
+    tp.set_override(None)
+    assert ps.fits_vmem((1024, 1024), "float32")
+
+
+def test_xslab_scorer_responds_to_ratios(restore):
+    # On a generation with much higher bandwidth per VPU-cell (v5p),
+    # the scorer still returns a valid (sx, K) and the modeled regime
+    # shift never crashes the picker.
+    pick_v5e = ps._pick_xslab_3d((512, 512, 512), "float32")
+    assert pick_v5e is not None
+    tp.set_override(tp._TABLE["v5p"])
+    pick_v5p = ps._pick_xslab_3d((512, 512, 512), "float32")
+    assert pick_v5p is not None
+    sx, k = pick_v5p
+    assert 512 % sx == 0 and 1 <= k <= 8
+    # Faster HBM relative to VPU favors (weakly) fewer temporal steps.
+    assert k <= pick_v5e[1]
+
+
+def test_sane_picks_across_all_rows(restore):
+    # Every table row yields either a decline or a self-consistent pick
+    # for the flagship geometries (no crashes, no budget violations).
+    for kind, row in tp._TABLE.items():
+        tp.set_override(row)
+        t = ps._pick_strip_rows(16384, 16384, "float32", sharded=False)
+        if t is not None:
+            assert 16384 % t == 0 and t % 8 == 0
+        pick = ps._pick_xslab_3d((512, 512, 512), "float32")
+        if pick is not None:
+            assert 512 % pick[0] == 0

@@ -29,7 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_heat_tpu.models import HeatPlate2D
 from parallel_heat_tpu.utils.profiling import chain_slope, sync
 
-CP = pltpu.CompilerParams(vmem_limit_bytes=128 * 1024 * 1024)
+from parallel_heat_tpu.ops.tpu_params import params as _hw_params
+
+CP = pltpu.CompilerParams(
+    vmem_limit_bytes=_hw_params().vmem_limit_bytes)
 SUB = 8
 LANE = 128
 
