@@ -196,14 +196,17 @@ class HeatConfig:
             sub = sublane_count(self.dtype)
             is_f64 = self.dtype == "float64"
             if self.backend == "pallas" and self.halo_depth != sub \
-                    and not is_f64:
-                # Kernel G only exists at depth == the dtype's sublane
-                # count; any other depth would silently fall back to
-                # jnp rounds against an explicit pallas request.
-                # float64 is exempt: Mosaic has no 64-bit types, so the
-                # solver routes f64 to the jnp path for EVERY backend
-                # choice (a dtype-level decline, like the geometry
-                # declines) — the jnp rounds support any depth.
+                    and not is_f64 and self.ndim == 2:
+                # The 2D Mosaic block kernel (G) only exists at depth
+                # == the dtype's sublane count; any other depth would
+                # silently fall back to jnp rounds against an explicit
+                # pallas request. 3D is exempt: kernel H's slab windows
+                # are alignment-free in the slab dim, so it accepts any
+                # depth the geometry admits (declines fall back like
+                # geometry declines). float64 is exempt: Mosaic has no
+                # 64-bit types, so the solver routes f64 to the jnp
+                # path for EVERY backend choice — the jnp rounds
+                # support any depth.
                 raise ValueError(
                     f"backend='pallas' with halo_depth > 1 requires "
                     f"halo_depth == {sub} for dtype {self.dtype} (the "

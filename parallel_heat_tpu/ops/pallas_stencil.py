@@ -1743,6 +1743,391 @@ def _xslab_multistep_3d(shape, dtype, cx, cy, cz):
         K)
 
 
+# --------------------------------------------------------------------------
+# Kernel H: 3D shard-block temporal (the sharded kernel F)
+# --------------------------------------------------------------------------
+
+def _block_ext_geometry(block_shape, halos, dtype, hw_align=False):
+    """Extended-block geometry for kernel H's circular halo layout.
+
+    Per sharded axis the exchanged block is ``[u | hi | seam | lo]`` —
+    the *periodic ghost* layout: placed after the block, the hi halo is
+    genuinely adjacent to the block's last cell, and the lo halo wraps
+    (via the kernel's rolls) to the block's first cell, so every
+    neighbor access is real except the single hi<->lo seam, which sits
+    k cells from the core on both sides (the masked/discarded frontier).
+    Chosen over the naive ``[lo | u | hi]`` because every concatenated
+    piece then starts at a tile-aligned offset (u at 0, the tail at
+    ``by``/``bz``) and because the core sits at the origin, so the
+    kernel writes exactly ``(bx, by, bz)`` and the caller slices
+    nothing — the naive layout needs a misaligned-extent XLA core
+    slice (a full relayout copy) per round plus a 1.6x larger output
+    write. Measured end-to-end on v5e (jitted round, 300-round chained
+    slope): 62.3 Gcells*steps/s per device at 256^3 blocks, k=4 —
+    2.2x the jnp per-step block path (28.8), before counting the k x
+    fewer ppermute rounds real meshes also gain.
+
+    Returns ``(Ye, Ze, tail_y, tail_z)`` — extended plane extents and
+    tail widths (each ``roundup(2k, tile)`` for sharded axes with the
+    seam zeros making up the difference; z additionally rounds the
+    unsharded case's extent up to the lane tile on hardware), or None
+    where the geometry violates the hardware tiling rules.
+    """
+    bx, by, bz = block_shape
+    hx, hy, hz = halos
+    sub = _sub_rows(dtype)
+    hw = hw_align or _needs_lane_alignment()
+    if hw and (by % sub != 0 or bz % _LANE != 0):
+        # by/bz are the out-block tile extents and the in-kernel value
+        # slice widths — both must be tile-aligned on hardware.
+        return None
+    tail_y = ((2 * hy + sub - 1) // sub) * sub if hy else 0
+    if hz:
+        tail_z = ((2 * hz + _LANE - 1) // _LANE) * _LANE if hw else 2 * hz
+    else:
+        tail_z = ((-bz) % _LANE) if hw else 0
+    return by + tail_y, bz + tail_z, tail_y, tail_z
+
+
+def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
+    """``(sx, modeled seconds per core cell-step)`` for kernel H at
+    depth ``k``, or None.
+
+    Same cost/score model as :func:`_pick_xslab_3d`, on the circular
+    halo-extended block geometry (:func:`_block_ext_geometry`).
+    ``halos`` is the per-axis halo presence ``(hx, hy, hz)``, each
+    ``k`` (axis sharded) or ``0`` (axis spans the full grid — handled
+    by the same clamped windows / masked rolls as the single-device
+    kernel F).
+
+    ``hw_align=True`` applies the hardware alignment constraints even
+    in interpret mode — the auto-depth sweep uses it so a depth
+    resolved on the CPU test mesh is the depth real hardware runs.
+    """
+    bx, by, bz = block_shape
+    hx, hy, hz = halos
+    if any(h not in (0, k) for h in halos):
+        return None
+    geo = _block_ext_geometry(block_shape, halos, dtype, hw_align)
+    if geo is None:
+        return None
+    Ye, Ze, _, _ = geo
+    itemsize = jnp.dtype(dtype).itemsize
+    plane = Ye * Ze * itemsize
+    plane_f32 = Ye * Ze * 4
+    hw = _params()
+    budget = hw.stream_budget_bytes
+    ch = _xslab_chunk(plane_f32)
+    best = None
+    best_t = float("inf")
+    # Any divisor of bx works — the slab dim is untiled, so windows
+    # need no alignment (contrast kernel F's power-of-two sweep, whose
+    # grids are powers of two anyway; shard blocks often are not).
+    for sx in range(min(64, bx), 1, -1):
+        if bx % sx != 0:
+            continue
+        if hx == 0 and sx + 2 * k > bx:
+            continue  # clamped windows need the block to cover them
+        scr = sx + 4 * k
+        cost = (2 * scr * plane
+                + (scr * plane if k > 1 else 0)
+                + 2 * sx * by * bz * itemsize
+                + 4 * ch * plane_f32)
+        if itemsize < 4:
+            cost += ch * plane_f32
+        if cost > budget:
+            continue
+        # Modeled time per core cell-step: DMA reads W=sx+2k extended
+        # planes and writes sx core planes per k steps of sx*by*bz core
+        # cells; the VPU sweeps the (sx+2k)-plane band over full Ye*Ze
+        # planes every step.
+        core = sx * by * bz
+        t_bw = ((sx + 2 * k) * plane + sx * by * bz * itemsize) \
+            / (k * core) / hw.hbm_stream_bytes_per_s
+        t_vpu = (sx + 2 * k) * Ye * Ze / core / hw.vpu_cells_per_s
+        t = max(t_bw, t_vpu)
+        if t < best_t:
+            best_t, best = t, sx
+    if best is None:
+        return None
+    return best, best_t
+
+
+def _score_block_temporal_3d(block_shape, mesh_shape, dtype, k):
+    """(modeled seconds per core cell-step, sx) at depth ``k`` — the
+    kernel cost of :func:`_pick_block_xslab_3d` plus two per-round
+    costs that picker cannot see, both amortized 1/k (the terms that
+    reward depth): the XLA-level ext assembly (read the core, write the
+    extended block) and the deep exchange's ICI bytes + latency. The
+    model validates against v5e measurements at 256^3 blocks: predicted
+    ranking k=4 > k=3 > k=8 (sx=32/32/16), measured 62.3 / ~62 / 44.4
+    Gcells*steps/s per device. Returns None where the kernel
+    declines."""
+    halos = tuple(k if d > 1 else 0 for d in mesh_shape)
+    pick = _pick_block_xslab_3d(block_shape, halos, dtype, k,
+                                hw_align=True)
+    if pick is None:
+        return None
+    sx, t_kernel = pick  # same model that chose sx — no re-derivation
+    bx, by, bz = block_shape
+    hx, hy, hz = halos
+    itemsize = jnp.dtype(dtype).itemsize
+    hw = _params()
+    Ye, Ze, _, _ = _block_ext_geometry(block_shape, halos, dtype,
+                                       hw_align=True)
+    Xe = bx + 2 * hx
+    core = bx * by * bz
+    bytes_round = 2 * itemsize * (hx * by * bz + hy * Xe * bz
+                                  + hz * Xe * Ye)
+    t_comm = (bytes_round / hw.ici_bytes_per_s
+              + hw.collective_latency_s) / (k * core)
+    t_asm = ((core + Xe * Ye * Ze) * itemsize
+             / (k * core) / hw.hbm_stream_bytes_per_s)
+    return t_kernel + t_comm + t_asm, sx
+
+
+def _pick_block_temporal_3d(block_shape, mesh_shape, dtype):
+    """Best ``(sx, K)`` for kernel H over feasible depths, or None.
+
+    Used by the solver's auto halo-depth resolution for 3D meshes. The
+    depth sweep stops at the smallest block extent (deeper halos than
+    one block would need multi-hop exchanges — config.validate()'s
+    bound).
+    """
+    bmin = min(block_shape)
+    best = None
+    best_t = float("inf")
+    for k in range(1, min(16, bmin) + 1):
+        scored = _score_block_temporal_3d(block_shape, mesh_shape, dtype, k)
+        if scored is None:
+            continue
+        t, sx = scored
+        if t < best_t:
+            best_t, best = t, (sx, k)
+    return best
+
+
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
+                             grid_shape, k, halos, vma=None,
+                             with_residual=True):
+    """K 7-point steps on a circular halo-extended 3D shard block;
+    ``fn(ext, x_off, y_off, z_off) -> ((bx, by, bz) core, residual)``.
+
+    The shard-level counterpart of kernel F, closing the loop with the
+    mesh exchange the way kernel G does in 2D: the caller ppermutes
+    k-deep face halos once (``parallel/temporal.py::
+    exchange_halos_circular_3d``), this kernel advances the k steps
+    streaming X-slabs through VMEM, and the output IS the exact core
+    (the circular layout puts it at the origin — see
+    :func:`_block_ext_geometry`). Unlike kernel G there is **no
+    k == sublane constraint**: X is the untiled leading dim, so slab
+    windows need no alignment blocks at any depth.
+
+    ``halos = (hx, hy, hz)``, each ``k`` (axis sharded) or ``0`` (axis
+    spans the grid). Validity is kernel F's shrinking-frontier argument
+    per axis: garbage from the clamped window edges (x), the hi<->lo
+    seam (sharded y/z), or the alignment junk (unsharded z tail)
+    advances one cell per step and reaches at most ``k-1`` cells past
+    its source, while the core stays behind ``k``-deep halo data
+    (sharded axes) or a pinned Dirichlet face (unsharded axes). The
+    seam frontier is exactly tight: the halo cell adjacent to the seam
+    is consumed on the last step, one step before corruption reaches it.
+
+    Dirichlet cells are pinned by per-cell select against the global
+    offsets, the form measured faster than coefficient vectors in 3D
+    (kernel F's negative result). The offsets arrive as a plain SMEM
+    operand, not scalar prefetch: no index map depends on them, so
+    prefetch buys nothing, and ``PrefetchScalarGridSpec`` builds
+    measured consistently slower under eager dispatch on v5e (the
+    SMEM-operand build is bitwise identical and matches kernel F's
+    speed under jit). Select keeps boundary cells bitwise exact even
+    in diverging runs — no 0*inf path, so no fn-level re-pinning
+    (contrast kernel G).
+
+    The residual is the max-norm of the last step's update over this
+    block's core global-interior cells — ``lax.pmax`` by the caller
+    gives the solver's convergence quantity. Mirrors the CUDA fused
+    block reduction (``cuda/cuda_heat.cu:66-137``) at mesh scale.
+
+    ``x_off/y_off/z_off`` are the global coordinates of ext index 0 on
+    each axis: ``bi_x*bx - hx`` (x keeps the plain ``[lo|u|hi]`` order
+    — leading-dim concats are contiguous and free) and ``bi_y*by`` /
+    ``bi_z*bz`` (circular axes: u starts at index 0). ``fn.tail_y`` /
+    ``fn.tail_z`` expose the tail widths the exchange must build;
+    ``fn.sx`` the picked slab size.
+    """
+    bx, by, bz = block_shape
+    NX, NY, NZ = grid_shape
+    hx, hy, hz = halos
+    dtype = jnp.dtype(dtype_name)
+    assert k >= 1
+    pick = _pick_block_xslab_3d(block_shape, halos, dtype, k)
+    if pick is None:
+        return None
+    sx, _ = pick
+    Ye, Ze, tail_y, tail_z = _block_ext_geometry(block_shape, halos, dtype)
+    Xe = bx + 2 * hx
+    W = sx + 2 * k
+    SCR = sx + 4 * k
+    C0 = 2 * k
+    n_slabs = bx // sx
+    CH = _xslab_chunk(Ye * Ze * 4)
+
+    def kernel(offs_ref, ext_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        x_off = offs_ref[0]
+        y_off = offs_ref[1]
+        z_off = offs_ref[2]
+
+        ys_l = lax.broadcasted_iota(jnp.int32, (1, Ye, 1), 1)
+        zs_l = lax.broadcasted_iota(jnp.int32, (1, 1, Ze), 2)
+        # Circular axes: indices in the lo tail [Ye-k, Ye) are the
+        # cells just *before* the block (global y_off + i - Ye); the
+        # seam zeros in between get junk coords — harmless, they are
+        # never kept by the frontier argument.
+        ys_g = y_off + (jnp.where(ys_l >= Ye - k, ys_l - Ye, ys_l)
+                        if hy else ys_l)
+        zs_g = z_off + (jnp.where(zs_l >= Ze - k, zs_l - Ze, zs_l)
+                        if hz else zs_l)
+        yzmask = ((ys_g >= 1) & (ys_g <= NY - 2)
+                  & (zs_g >= 1) & (zs_g <= NZ - 2))
+        corebox = (ys_l < by) & (zs_l < bz)
+
+        def dma(slot, slab):
+            base = slab * sx + hx  # ext plane of the slab's first core plane
+            start = jnp.clip(base - k, 0, Xe - W)
+            dst = C0 + start - base
+            return pltpu.make_async_copy(
+                ext_hbm.at[pl.ds(start, W), :, :],
+                slots.at[slot, pl.ds(dst, W), :, :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        # Global x of scratch row 0 for this slab. The destination
+        # offset compensates clamping exactly, so ext plane e always
+        # lands at scratch row e + C0 - base — the mapping (and hence
+        # the mask) is clamp-invariant.
+        gx0 = x_off + s * sx + hx - C0
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :, :].astype(_ACC)
+            C = blk[1:-1]
+            Xm = blk[:-2]
+            Xp = blk[2:]
+            Ym = jnp.roll(C, 1, axis=1)
+            Yp = jnp.roll(C, -1, axis=1)
+            Zm = jnp.roll(C, 1, axis=2)
+            Zp = jnp.roll(C, -1, axis=2)
+            new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
+            rows_g = (gx0 + r0
+                      + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
+            keep = yzmask & (rows_g >= 1) & (rows_g <= NX - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(CH, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :, :] = new.astype(dtype)
+                r0 += h
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, k, sx + 3 * k)
+            step_into(pp, sref, k, sx + 3 * k)
+            return 0
+
+        if m > 0:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, k, sx + 3 * k)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + sx:
+            h = min(CH, C0 + sx - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            # The core is the origin box of the extended planes; the
+            # value slice is tile-aligned (by % SUB, bz % LANE — the
+            # geometry guard) and the out block is exactly the core:
+            # nothing to slice at the XLA level.
+            out_ref[r0 - C0:r0 - C0 + h, :, :] = \
+                new[:, :by, :bz].astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(keep & corebox,
+                                      jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    pp_planes = SCR if k > 1 else 2
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_slabs,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, by, bz), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((sx, by, bz), lambda s: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, Ye, Ze), dtype),
+            pltpu.VMEM((pp_planes, Ye, Ze), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(ext, x_off, y_off, z_off):
+        offs = jnp.stack([jnp.int32(x_off), jnp.int32(y_off),
+                          jnp.int32(z_off)])
+        core, res = call(offs, ext)
+        return core, res[0, 0]
+
+    fn.tail_y = tail_y
+    fn.tail_z = tail_z
+    fn.sx = sx
+    return fn
+
+
 def single_grid_multistep_3d(config):
     """``(multi_step, multi_step_residual)`` for one device, 3D.
 

@@ -46,6 +46,10 @@ class TpuParams:
     vmem_bytes: int              # physical VMEM per core
     hbm_stream_bytes_per_s: float  # achieved stencil read+write mix
     vpu_cells_per_s: float       # sustained 7-point VPU rate
+    # ICI terms (per-link order-of-magnitude; only bias the deep-halo
+    # depth scoring in _pick_block_temporal_3d, never correctness):
+    ici_bytes_per_s: float = 4.5e10
+    collective_latency_s: float = 5e-6
 
     @property
     def vmem_limit_bytes(self) -> int:
@@ -71,9 +75,12 @@ _V5E = TpuParams("v5e", 128 * _MIB, 350e9, 140e9)          # measured
 _TABLE = {
     "v5e": _V5E,
     # Extrapolated rows (see module docstring).
-    "v6e": TpuParams("v6e", 128 * _MIB, 700e9, 250e9),     # HBM 1640 GB/s
-    "v5p": TpuParams("v5p", 128 * _MIB, 1180e9, 250e9),    # HBM 2765 GB/s
-    "v4": TpuParams("v4", 128 * _MIB, 520e9, 170e9),       # HBM 1228 GB/s
+    "v6e": TpuParams("v6e", 128 * _MIB, 700e9, 250e9,      # HBM 1640 GB/s
+                     ici_bytes_per_s=9e10),
+    "v5p": TpuParams("v5p", 128 * _MIB, 1180e9, 250e9,     # HBM 2765 GB/s
+                     ici_bytes_per_s=9e10),
+    "v4": TpuParams("v4", 128 * _MIB, 520e9, 170e9,        # HBM 1228 GB/s
+                    ici_bytes_per_s=9e10),
     "v3": TpuParams("v3", 16 * _MIB, 380e9, 100e9),        # HBM 900 GB/s
     "v2": TpuParams("v2", 16 * _MIB, 300e9, 70e9),         # HBM 700 GB/s
 }

@@ -169,6 +169,48 @@ def exchange_halos_deep_3d(u, k: int, mesh_shape: Tuple[int, int, int],
     return jnp.concatenate([lo_x.astype(dt), u, hi_x.astype(dt)], axis=0)
 
 
+def exchange_halos_circular_3d(u, k: int, mesh_shape, axis_names,
+                               tail_y: int = 0, tail_z: int = 0):
+    """K-deep 3D exchange in kernel H's circular (periodic-ghost)
+    layout: per sharded y/z axis the block becomes ``[u | hi |
+    seam-zeros | lo]`` (tail width ``tail_y``/``tail_z`` from the
+    kernel's geometry — seam zeros are the alignment slack), and the
+    x axis keeps the plain ``[lo | u | hi]`` (leading-dim concats are
+    contiguous). Every concatenated piece then starts tile-aligned —
+    the reason this layout exists; see
+    ``ops.pallas_stencil._block_ext_geometry``. Axes with mesh dim 1
+    are skipped entirely (``tail_z`` may still be nonzero there: the
+    unsharded-z lane-alignment pad). Phase order z -> y -> x with
+    later phases sending the already-extended strips, so edge/corner
+    data between sharded axes ride along.
+    """
+    dx, dy, dz = mesh_shape
+    ax, ay, az = axis_names
+    dt = u.dtype
+    if dz > 1:
+        lo = _shift_down(u[:, :, -k:], az, dz).astype(dt)
+        hi = _shift_up(u[:, :, :k], az, dz).astype(dt)
+        pad = tail_z - 2 * k
+        parts = [u, hi] + ([jnp.zeros(u.shape[:2] + (pad,), dt)]
+                           if pad else []) + [lo]
+        u = jnp.concatenate(parts, axis=2)
+    elif tail_z:
+        u = jnp.concatenate(
+            [u, jnp.zeros(u.shape[:2] + (tail_z,), dt)], axis=2)
+    if dy > 1:
+        lo = _shift_down(u[:, -k:, :], ay, dy).astype(dt)
+        hi = _shift_up(u[:, :k, :], ay, dy).astype(dt)
+        pad = tail_y - 2 * k
+        parts = [u, hi] + ([jnp.zeros((u.shape[0], pad, u.shape[2]), dt)]
+                           if pad else []) + [lo]
+        u = jnp.concatenate(parts, axis=1)
+    if dx > 1:
+        lo_x = _shift_down(u[-k:, :, :], ax, dx)
+        hi_x = _shift_up(u[:k, :, :], ax, dx)
+        u = jnp.concatenate([lo_x.astype(dt), u, hi_x.astype(dt)], axis=0)
+    return u
+
+
 def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
                        cx, cy, cz, axis_names=("x", "y", "z"),
                        with_residual: bool = False):
@@ -231,6 +273,54 @@ def _pallas_round_2d(config, kw):
     return fn
 
 
+def _pallas_round_3d(config, kw):
+    """Kernel-H round: K-deep mixed exchange + K Mosaic steps, or None.
+
+    The 3D analog of :func:`_pallas_round_2d` — but with no depth
+    constraint beyond geometry (kernel H's X-slab windows are
+    alignment-free in the slab dim at any K; see its builder).
+    ``fn(u, want_res)`` advances exactly ``config.halo_depth`` steps.
+    """
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    if config.ndim != 3:
+        return None
+    K = config.halo_depth
+    blocks = config.block_shape()
+    mesh_shape = kw["mesh_shape"]
+    axis_names = tuple(kw["axis_names"])
+    halos = tuple(K if d > 1 else 0 for d in mesh_shape)
+    args = (blocks, config.dtype, float(config.cx), float(config.cy),
+            float(config.cz), config.shape, K, halos, axis_names)
+    built = ps._build_temporal_block_3d(*args)
+    if built is None:
+        return None
+    built_plain = ps._build_temporal_block_3d(*args, with_residual=False)
+    bi = kw["block_index"]
+    bx, by, bz = blocks
+    hx, hy, hz = halos
+    # axis_index(a) varies only on a; broaden each offset to all axes
+    # (same pcast pattern as the 2D round). Offsets are the global
+    # coords of ext index 0: x keeps the [lo|u|hi] order (hence -hx);
+    # circular y/z put u at index 0.
+    others = lambda i: tuple(a for j, a in enumerate(axis_names) if j != i)
+    x_off = lax.pcast(bi[0] * bx - hx, others(0), to="varying")
+    y_off = lax.pcast(bi[1] * by, others(1), to="varying")
+    z_off = lax.pcast(bi[2] * bz, others(2), to="varying")
+
+    def fn(u, want_res):
+        ext = exchange_halos_circular_3d(u, K, mesh_shape, axis_names,
+                                         tail_y=built.tail_y,
+                                         tail_z=built.tail_z)
+        kernel = built if want_res else built_plain
+        core, res = kernel(ext, x_off, y_off, z_off)
+        if want_res:
+            return core, lax.pmax(res, axis_names)
+        return core
+
+    return fn
+
+
 def block_temporal_multistep(config, kw, backend: str):
     """``(multi_step, multi_step_residual)`` on K-deep exchanges.
 
@@ -251,7 +341,8 @@ def block_temporal_multistep(config, kw, backend: str):
                 else block_multistep_2d)
     pallas_round = None
     if backend == "pallas":
-        pallas_round = _pallas_round_2d(config, kw)
+        pallas_round = (_pallas_round_3d(config, kw) if config.ndim == 3
+                        else _pallas_round_2d(config, kw))
 
     def rounds(u, n, with_residual):
         full, rem = divmod(n, K)

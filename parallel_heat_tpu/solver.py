@@ -122,10 +122,13 @@ def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
     from parallel_heat_tpu.ops import pallas_stencil as ps
     from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
 
-    sub = ps._sub_rows(config.dtype)
-    if sub > min(config.block_shape()):
-        return 1
     if config.ndim == 2:
+        sub = ps._sub_rows(config.dtype)
+        if sub > min(config.block_shape()):
+            # Kernel G's depth is the sublane count; blocks smaller
+            # than that cannot host it (3D has no such constraint —
+            # kernel H's sweep bounds depth by block extent itself).
+            return 1
         bx, by = config.block_shape()
         # Same args (incl. vma = the mesh axis names) as the real build
         # in temporal._pallas_round_2d, so the probe IS the build —
@@ -135,7 +138,11 @@ def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
             (bx, by), config.dtype, float(config.cx), float(config.cy),
             config.shape, sub, AXIS_NAMES[:2])
         return sub if built is not None else 1
-    return 1  # 3D sharded: no Mosaic block kernel yet
+    # 3D: kernel H supports any depth; score the feasible (sx, K)
+    # pairs (kernel cost + modeled exchange cost) and take the best.
+    pick = ps._pick_block_temporal_3d(config.block_shape(), mesh_shape,
+                                      config.dtype)
+    return pick[1] if pick is not None else 1
 
 
 def _resolved(config: HeatConfig):
@@ -316,6 +323,11 @@ def _build_runner(config: HeatConfig):
         run = _shard_map(
             local_run3, mesh=mesh, in_specs=spec,
             out_specs=(spec, P(), P(), P()),
+            # Same rationale as the 2D branch below: pallas_call's
+            # internal slices don't carry varying-manual-axes
+            # annotations; the pmax in the residual round guarantees
+            # the scalar outputs' replication either way.
+            check_vma=backend != "pallas",
         )
         return jax.jit(run, donate_argnums=0), mesh
 
@@ -458,9 +470,9 @@ def explain(config: HeatConfig) -> dict:
     if is_sharded:
         bx_by = config.block_shape()
         if config.halo_depth > 1:
-            if config.ndim == 2 and config.halo_depth == sub:
-                from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+            from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
 
+            if config.ndim == 2 and config.halo_depth == sub:
                 built = ps._build_temporal_block(
                     bx_by, dtype, cx, cy, config.shape, config.halo_depth,
                     AXIS_NAMES[:2])
@@ -468,6 +480,19 @@ def explain(config: HeatConfig) -> dict:
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}) per "
                         f"exchange round, padded width {built.padded_width}")
+                    return out
+            if config.ndim == 3:
+                # Mirrors temporal._pallas_round_3d's build args.
+                K = config.halo_depth
+                halos = tuple(K if d > 1 else 0 for d in mesh_shape)
+                built = ps._build_temporal_block_3d(
+                    bx_by, dtype, cx, cy, float(config.cz), config.shape,
+                    K, halos, AXIS_NAMES[:3])
+                if built is not None:
+                    out["path"] = (
+                        f"kernel H (3D shard-block temporal, K={K}) per "
+                        f"exchange round, sx={built.sx}, tails="
+                        f"({built.tail_y}, {built.tail_z})")
                     return out
             out["path"] = (f"jnp K-deep temporal rounds "
                            f"(halo_depth={config.halo_depth}) on shard "

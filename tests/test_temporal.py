@@ -233,9 +233,11 @@ def test_resolve_halo_depth_matrix():
              "pallas") == 3
     assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=1),
              "jnp") == 1
-    # 3D currently resolves to 1 (no sharded Mosaic kernel yet)
+    # 3D: kernel H's scored sweep picks a deep exchange
     assert r(HeatConfig(nx=32, ny=32, nz=128, mesh_shape=(2, 2, 1)),
-             "pallas") == 1
+             "pallas") > 1
+    assert r(HeatConfig(nx=32, ny=32, nz=128, mesh_shape=(2, 2, 1)),
+             "jnp") == 1
 
 
 def test_auto_depth_solve_matches_explicit_depth():
