@@ -60,14 +60,22 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--initial-out", default=None, metavar="FILE",
                     help="write initial grid (reference: initial_im.dat)")
     ap.add_argument("--checkpoint", default=None, metavar="FILE",
-                    help="write an .npz checkpoint of the final state")
+                    help="write a checkpoint of the final state (.npz, "
+                         "or a per-shard .ckpt directory for large "
+                         "sharded grids — see --checkpoint-layout)")
+    ap.add_argument("--checkpoint-layout", default="auto",
+                    choices=["auto", "gathered", "sharded"],
+                    help="gathered = one host-gathered .npz; sharded = "
+                         "per-process shard files, no host gather; "
+                         "auto picks sharded for large sharded grids")
     ap.add_argument("--checkpoint-every", type=int, default=None,
                     metavar="N",
                     help="also checkpoint every N steps during the run "
                          "(requires --checkpoint; the file is overwritten "
                          "each time, so --resume always sees the latest)")
     ap.add_argument("--resume", default=None, metavar="FILE",
-                    help="resume from an .npz checkpoint")
+                    help="resume from a checkpoint (.npz file or "
+                         "per-shard .ckpt directory)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run")
     ap.add_argument("--explain", action="store_true",
@@ -194,8 +202,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result = None
         for result in solve_stream(config, initial=initial,
                                    chunk_steps=args.checkpoint_every):
-            written = save_checkpoint(args.checkpoint, result.to_numpy(),
-                                      start_step + result.steps_run, config)
+            written = save_checkpoint(args.checkpoint, result.grid,
+                                      start_step + result.steps_run, config,
+                                      layout=args.checkpoint_layout)
             say(f"Checkpoint at step {start_step + result.steps_run} "
                 f"-> {written}")
         if result is None:  # steps == 0
@@ -227,7 +236,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from parallel_heat_tpu.utils.checkpoint import save_checkpoint
 
         written = save_checkpoint(args.checkpoint, result.grid,
-                                  total_steps, config)
+                                  total_steps, config,
+                                  layout=args.checkpoint_layout)
         say(f"Checkpoint written to {written}")
     return 0
 

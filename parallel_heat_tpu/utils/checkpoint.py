@@ -1,13 +1,29 @@
 """Checkpoint / resume — a capability gap in the reference (SURVEY.md §5:
-state lives only in the two buffers; output only at the end). Snapshots
-are plain ``.npz`` (grid + step counter + config fingerprint), cheap and
-dependency-free; the grid is gathered to host, so this targets
-operational resume, not in-flight failover.
+state lives only in the two buffers; output only at the end).
+
+Two layouts, selected automatically (``layout="auto"``):
+
+- **gathered** (small grids): one ``.npz`` (grid + step counter +
+  config fingerprint) with the grid gathered to host — cheap,
+  dependency-free, human-greppable.
+- **sharded** (large sharded grids): a ``<name>.ckpt/`` directory with
+  a JSON manifest plus one ``.npz`` per process holding only that
+  process's addressable shards, written shard-by-shard — the full grid
+  is never materialized on any host (a 32768^2 f32 grid would cost a
+  4 GiB host spike per snapshot through the gathered path), and resume
+  rebuilds the global array via
+  ``jax.make_array_from_single_device_arrays`` with no gather either.
+  Multi-process runs write concurrently (each process owns its file);
+  process 0 writes the manifest last, so a torn save leaves the
+  previous generation's manifest — and therefore the previous
+  snapshot — intact.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 from typing import Tuple
 
 import numpy as np
@@ -15,12 +31,78 @@ import numpy as np
 from parallel_heat_tpu.config import HeatConfig
 
 _FORMAT_VERSION = 1
+_MANIFEST_VERSION = 2
+# Shard files are generation-named; loaders and the pruner match this
+# EXACT pattern so orphaned temp files can never be mistaken for data.
+_SHARD_RE_TMPL = r"shards_{gen}_p\d{{5}}\.npz"
+# Auto layout: shard when the grid is device-sharded and big enough
+# that a host gather hurts; below this, one gathered file is simpler.
+_SHARD_THRESHOLD_BYTES = 64 * 1024 * 1024
+
+
+def _num_devices_of(grid) -> int:
+    sharding = getattr(grid, "sharding", None)
+    if sharding is None:
+        return 1
+    try:
+        return len(sharding.device_set)
+    except AttributeError:  # pragma: no cover - older jax
+        return 1
 
 
 def save_checkpoint(path, grid, step: int, config: HeatConfig,
-                    compress: bool = False) -> str:
-    """Write a snapshot; returns the actual path written (always .npz —
-    normalized here rather than letting np.savez append it silently).
+                    compress: bool = False, layout: str = "auto") -> str:
+    """Write a snapshot; returns the actual path written.
+
+    ``layout``: ``"gathered"`` (one .npz, grid gathered to host),
+    ``"sharded"`` (per-process shard directory, no host gather), or
+    ``"auto"`` — sharded when the grid spans non-addressable devices
+    (a multi-process run, where gathering is impossible, not merely
+    slow) or is sharded over more than one device and large enough
+    that gathering hurts (>= 64 MiB). See the module docstring for the
+    formats.
+    """
+    if layout not in ("auto", "gathered", "sharded"):
+        raise ValueError(f"unknown checkpoint layout {layout!r}")
+    fully_addressable = getattr(grid, "is_fully_addressable", True)
+    if layout == "gathered" and not fully_addressable:
+        raise ValueError(
+            "layout='gathered' cannot snapshot a grid that spans "
+            "non-addressable devices (multi-process run); use "
+            "'sharded' or 'auto'")
+    if layout == "sharded" or (layout == "auto" and (
+            not fully_addressable
+            or (_num_devices_of(grid) > 1
+                and grid.size * grid.dtype.itemsize
+                >= _SHARD_THRESHOLD_BYTES))):
+        return _save_sharded(path, grid, step, config, compress)
+    return _save_gathered(path, grid, step, config, compress)
+
+
+def _fsync_replace(tmp: str, dst: str) -> None:
+    """Durable atomic publish: fsync the temp file, rename it over the
+    destination, fsync the directory entry — a power loss at any point
+    leaves either the old or the new file complete, never a torn one.
+    """
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst)
+    dirfd = os.open(os.path.dirname(os.path.abspath(dst)) or ".",
+                    os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def _save_gathered(path, grid, step: int, config: HeatConfig,
+                   compress: bool = False) -> str:
+    """One .npz with the grid gathered to host; returns the path
+    written (always .npz — normalized here rather than letting
+    np.savez append it silently).
 
     The write is atomic (temp file + ``os.replace``): the periodic
     checkpointing driver (``solve_stream`` / ``--checkpoint-every``)
@@ -34,8 +116,6 @@ def save_checkpoint(path, grid, step: int, config: HeatConfig,
     stall the run for minutes per snapshot. ``load_checkpoint`` reads
     either format.
     """
-    import os
-
     path = str(path)
     if not path.endswith(".npz"):
         path += ".npz"
@@ -49,35 +129,246 @@ def save_checkpoint(path, grid, step: int, config: HeatConfig,
             config=np.frombuffer(config.to_json().encode(), dtype=np.uint8),
             version=np.int64(_FORMAT_VERSION),
         )
-        # Durability, not just atomicity: flush the tmp file's data (and
-        # the directory entry) to stable storage before the rename makes
-        # it the live snapshot — otherwise a power loss right after
-        # os.replace can leave a torn file with the old snapshot gone.
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, path)
-        dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
-                        os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
+        _fsync_replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
     return path
 
 
+def _ckpt_dir_of(path: str) -> str:
+    """Directory path for the sharded layout of a checkpoint name."""
+    path = str(path)
+    if path.endswith(".ckpt"):
+        return path
+    if path.endswith(".npz"):
+        path = path[:-4]
+    return path + ".ckpt"
+
+
+def _save_sharded(path, grid, step: int, config: HeatConfig,
+                  compress: bool = False) -> str:
+    """Per-process shard directory; returns the ``.ckpt`` dir written.
+
+    Each process writes ONE ``.npz`` holding its addressable shards
+    (keyed ``d<device_id>``), copied device->host one shard at a time —
+    peak host memory is a single shard, never the grid. Process 0
+    writes ``manifest.json`` LAST (atomic temp+replace), stamping a
+    fresh generation id: shard files are generation-named, so readers
+    always see a consistent (old or new) set and a crash between the
+    shard writes and the manifest write leaves the previous snapshot
+    live. Stale generations are pruned after the manifest lands.
+    """
+    import jax
+
+    d = _ckpt_dir_of(path)
+    os.makedirs(d, exist_ok=True)
+    proc = jax.process_index()
+    shards = sorted(grid.addressable_shards, key=lambda s: s.device.id)
+    # The generation id must agree across processes without
+    # communication; the step count (monotone within a run) is exactly
+    # that. A re-save of the same step overwrites file-atomically.
+    gen = f"s{int(step):012d}"
+    fname = f"shards_{gen}_p{proc:05d}.npz"
+    # Leading dot: temp names must never match the shard-file pattern a
+    # loader or pruner scans for (a crash can orphan them).
+    tmp = os.path.join(d, f".tmp-{os.getpid()}-{fname}")
+    import zipfile
+
+    try:
+        # Stream one zip member per shard (an .npz IS a zip of .npy
+        # members): each device->host copy is released before the next
+        # is made, so peak host memory is one shard, never the grid.
+        mode = zipfile.ZIP_DEFLATED if compress else zipfile.ZIP_STORED
+        with zipfile.ZipFile(tmp, "w", mode) as zf:
+            for sh in shards:
+                with zf.open(f"d{sh.device.id}.npy", "w",
+                             force_zip64=True) as fh:
+                    np.lib.format.write_array(fh, np.asarray(sh.data),
+                                              allow_pickle=False)
+        _fsync_replace(tmp, os.path.join(d, fname))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    if jax.process_count() > 1:  # pragma: no cover (multi-host barrier)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("heat_ckpt_shards_written")
+
+    if proc == 0:
+        # Global shard map: device id -> index, computable on p0 for
+        # every process without communication.
+        index_map = grid.sharding.devices_indices_map(grid.shape)
+        devices = {}
+        for dev, idx in index_map.items():
+            devices[str(dev.id)] = {
+                "process": dev.process_index,
+                "index": [[sl.start or 0,
+                           sl.stop if sl.stop is not None else n]
+                          for sl, n in zip(idx, grid.shape)],
+            }
+        manifest = {
+            "version": _MANIFEST_VERSION,
+            "generation": gen,
+            "step": int(step),
+            "config": config.to_json(),
+            "shape": list(grid.shape),
+            "dtype": str(grid.dtype),
+            "mesh_shape": list(config.mesh_or_unit()),
+            "process_count": jax.process_count(),
+            "devices": devices,
+        }
+        mtmp = os.path.join(d, f".tmp-{os.getpid()}-manifest")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+        _fsync_replace(mtmp, os.path.join(d, "manifest.json"))
+        # Prune stale generations AND orphaned temps (every live
+        # process has published its shard file before the barrier
+        # above, so any .tmp-* here is from a crashed earlier run).
+        live = _SHARD_RE_TMPL.format(gen=gen)
+        for old in os.listdir(d):
+            if old == "manifest.json":
+                continue
+            if re.fullmatch(live, old):
+                continue
+            if old.startswith((".tmp-", "shards_")):
+                try:
+                    os.unlink(os.path.join(d, old))
+                except OSError:
+                    pass
+        # A stale gathered .npz from an earlier, smaller run of the
+        # same name must not shadow this directory at load time
+        # (load_checkpoint prefers an existing file).
+        stem_npz = d[:-5] + ".npz"
+        if os.path.exists(stem_npz):
+            try:
+                os.unlink(stem_npz)
+            except OSError:
+                pass
+    if jax.process_count() > 1:  # pragma: no cover (multi-host barrier)
+        # Make save a proper collective: no process returns (and e.g.
+        # immediately resumes) before the manifest is live.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("heat_ckpt_manifest_written")
+    return d
+
+
+def _load_sharded(d: str, expect_config: HeatConfig | None):
+    """Load a ``.ckpt`` directory; returns ``(grid, step, config)``.
+
+    Fast path (no gather): when the current topology matches the saved
+    one (same process count; the saved mesh buildable on the current
+    devices), every process loads only its own shard file and the
+    global array is assembled with
+    ``jax.make_array_from_single_device_arrays`` — device-resident,
+    correctly sharded for the resuming solve. Single-process fallback
+    for a topology mismatch: assemble the full grid on host from all
+    shard files (the operational-resume path; still no *device* gather).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.load(f)
+    if man["version"] != _MANIFEST_VERSION:
+        raise ValueError(f"unsupported checkpoint version {man['version']}")
+    saved = HeatConfig.from_json(man["config"])
+    step = int(man["step"])
+    shape = tuple(man["shape"])
+    if expect_config is not None and saved.shape != expect_config.shape:
+        raise ValueError(
+            f"checkpoint grid {saved.shape} != configured "
+            f"{expect_config.shape}")
+    gen = man["generation"]
+    mesh_shape = tuple(man["mesh_shape"])
+    n_needed = 1
+    for m in mesh_shape:
+        n_needed *= m
+
+    same_topology = (jax.process_count() == man["process_count"]
+                     and len(jax.devices()) >= n_needed)
+    if same_topology:
+        mesh = make_heat_mesh(mesh_shape)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        index_map = sharding.devices_indices_map(shape)
+        proc = jax.process_index()
+        fname = os.path.join(d, f"shards_{gen}_p{proc:05d}.npz")
+        arrays = []
+        with np.load(fname) as z:
+            for dev, idx in index_map.items():
+                if dev.process_index != proc:
+                    continue
+                key = f"d{dev.id}"
+                info = man["devices"].get(str(dev.id))
+                want = [[sl.start or 0,
+                         sl.stop if sl.stop is not None else n]
+                        for sl, n in zip(idx, shape)]
+                if key not in z or info is None or info["index"] != want:
+                    # Device numbering or the device->block assignment
+                    # moved between runs (topology-aware mesh reorder, a
+                    # different host layout, an explicit devices= mesh at
+                    # save time): reassembling by id would place blocks
+                    # at the wrong coordinates — fall back to host
+                    # assembly, which trusts only the manifest's indices.
+                    arrays = None
+                    break
+                arrays.append(jax.device_put(z[key], dev))
+        if arrays is not None:
+            grid = jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+            return grid, step, saved
+
+    if jax.process_count() > 1:  # pragma: no cover
+        raise ValueError(
+            f"cannot resume sharded checkpoint {d}: saved topology "
+            f"(mesh {mesh_shape}, {man['process_count']} processes) "
+            f"does not match the current one")
+    # Single-process host assembly (topology changed): read every shard
+    # file and place each block into a full host grid.
+    full = np.empty(shape, dtype=np.dtype(man["dtype"]))
+    placed = 0
+    pat = _SHARD_RE_TMPL.format(gen=re.escape(gen))
+    for fname in sorted(os.listdir(d)):
+        if not re.fullmatch(pat, fname):
+            continue
+        with np.load(os.path.join(d, fname)) as z:
+            for key in z.files:
+                info = man["devices"].get(key[1:])
+                if info is None:
+                    raise ValueError(
+                        f"shard {key} in {fname} missing from manifest")
+                sl = tuple(slice(a, b) for a, b in info["index"])
+                full[sl] = z[key]
+                placed += 1
+    if placed != len(man["devices"]):
+        raise ValueError(
+            f"sharded checkpoint {d} incomplete: {placed} shards found, "
+            f"{len(man['devices'])} expected")
+    return full, step, saved
+
+
 def load_checkpoint(path, expect_config: HeatConfig | None = None
                     ) -> Tuple[np.ndarray, int, HeatConfig]:
     """Returns ``(grid, step, saved_config)``.
 
-    When ``expect_config`` is given, grid geometry must match (other
-    fields — steps, eps, mesh — may legitimately differ on resume).
+    Accepts either layout: a gathered ``.npz`` file or a sharded
+    ``.ckpt`` directory (also resolved from the stem the gathered
+    name would use, so ``--resume ck.npz`` finds ``ck.ckpt/``). When
+    ``expect_config`` is given, grid geometry must match (other fields
+    — steps, eps, mesh — may legitimately differ on resume). Sharded
+    checkpoints loaded on a matching topology come back as a
+    device-resident sharded ``jax.Array`` (no gather); see
+    :func:`_load_sharded`.
     """
+    path = str(path)
+    if os.path.isdir(path):
+        return _load_sharded(path, expect_config)
+    if not os.path.exists(path) and os.path.isdir(_ckpt_dir_of(path)):
+        return _load_sharded(_ckpt_dir_of(path), expect_config)
     with np.load(path) as z:
         version = int(z["version"])
         if version != _FORMAT_VERSION:

@@ -47,6 +47,21 @@ assert np.array_equal(full, oracle), "multi-process != single-device"
 deep = solve(HeatConfig(**kw, mesh_shape=(2, 4), halo_depth=5))
 assert np.array_equal(np.asarray(gather_to_host(deep.grid)), oracle), \\
     "multi-process deep-halo != single-device"
+
+# Per-shard checkpoint round trip across the process boundary: each
+# process writes only its own shards (no host gather), p0 writes the
+# manifest, and the fast-path load rebuilds the same sharded array.
+from parallel_heat_tpu.utils.checkpoint import (load_checkpoint,
+                                                save_checkpoint)
+
+cfg = HeatConfig(**kw, mesh_shape=(2, 4))
+d = save_checkpoint("mp_ck", deep.grid, deep.steps_run, cfg,
+                    layout="sharded")
+grid, step, _ = load_checkpoint(d, cfg)
+assert step == deep.steps_run
+assert not isinstance(grid, np.ndarray), "fast path must stay sharded"
+assert np.array_equal(np.asarray(gather_to_host(grid)), oracle), \\
+    "sharded checkpoint round trip != single-device"
 print("WORKER-OK", pid, flush=True)
 """
 
