@@ -20,9 +20,12 @@ Converge-mode configs can't be chained (a second run would start
 already converged), so they are timed one-shot minus the measured
 readback floor.
 
-Run from the repo root: ``python bench.py`` (add ``--full`` for the
-secondary configs; they print as extra JSON lines *after* the
-headline).
+Run from the repo root: ``python bench.py``. The headline is the ONE
+JSON line on stdout (the driver contract); the four secondary BASELINE
+configs also run by default and all five rows land in
+``bench_full.json`` so the per-round artifact corroborates REPORT §2's
+table (``--headline-only`` skips them; ``--full`` additionally prints
+them as extra stdout lines after the headline).
 """
 
 import argparse
@@ -97,7 +100,17 @@ def _bench_converge(cfg, repeats=2):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
-                    help="also run secondary configs (extra JSON lines)")
+                    help="also print the secondary configs' rows as "
+                         "extra stdout JSON lines (they run — and land "
+                         "in bench_full.json — by default)")
+    ap.add_argument("--headline-only", action="store_true",
+                    help="skip the secondary configs entirely")
+    ap.add_argument("--out-full", default=None,
+                    help="where to write the all-rows artifact "
+                         "(default bench_full.json; with "
+                         "--headline-only the artifact is skipped "
+                         "unless this flag is passed explicitly, so a "
+                         "quick check never clobbers a full table)")
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--budget", type=float, default=10.0,
                     help="target seconds for the chained timing batch")
@@ -109,15 +122,17 @@ def main(argv=None):
                           backend=args.backend)
     elapsed = _bench_fixed(headline, args.budget)
     mcells = headline.nx * headline.ny * headline.steps / elapsed / 1e6
-    print(json.dumps({
+    headline_row = {
         "metric": "Mcells*steps/s/chip (1000^2, 10k steps, f32, fixed)",
         "value": round(mcells, 1),
         "unit": "Mcells*steps/s",
         "vs_baseline": round(mcells / BASELINE_MCELLS_PER_S, 3),
-    }))
+    }
+    print(json.dumps(headline_row))
     sys.stdout.flush()
+    rows = [headline_row]
 
-    if args.full:
+    if not args.headline_only:
         # The 4096^2 converge config provably does not reach eps=1e-3
         # within 10k steps (REPORT.md), so its while_loop executes all
         # 10k steps regardless of eps - the identical program can be
@@ -175,10 +190,39 @@ def main(argv=None):
                 if cfg.converge and not chainable:
                     out["steps_to_converge"] = steps_run
                     out["converged"] = res.converged
-                print(json.dumps(out))
             except Exception as e:  # keep the headline line valid
-                print(json.dumps({"metric": name, "error": repr(e)}))
-            sys.stdout.flush()
+                out = {"metric": name, "error": repr(e)}
+            rows.append(out)
+            if args.full:
+                print(json.dumps(out))
+                sys.stdout.flush()
+            elif "error" in out:
+                # Keep failures visible on the default run: the row is
+                # only in the JSON artifact, so echo it to stderr too.
+                print(json.dumps(out), file=sys.stderr)
+
+    out_full = args.out_full
+    if out_full is None and not args.headline_only:
+        out_full = "bench_full.json"
+    if out_full:
+        # The corroborating artifact: every BASELINE config's measured
+        # row (headline included) in one machine-readable file, written
+        # atomically so a crashed run leaves no half-table.
+        import os
+
+        import jax
+
+        doc = {
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+            "backend_arg": args.backend,
+            "baseline_mcells_per_s": BASELINE_MCELLS_PER_S,
+            "rows": rows,
+        }
+        tmp = out_full + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, out_full)
 
 
 if __name__ == "__main__":
