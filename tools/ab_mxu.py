@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""A/B: can the MXU beat the VPU kernels on the Jacobi stencil?
+
+VERDICT round-1 item 5. The production kernels are pinned at the VPU
+ceiling (~1.08 Tflop/s measured, REPORT §3); the MXU has ~2 orders more
+flops. Two castings are measured against the production path:
+
+- **conv**: K fused steps as ONE (2K+1)^2 convolution whose kernel is
+  the K-fold self-convolution of the 5-point stencil
+  (`lax.conv_general_dilated` — XLA's conv lowering is the MXU path).
+  Interior-exact; Dirichlet boundaries would need a K-deep VPU
+  correction band in production (the K-step operator is not
+  translation-invariant near pinned cells), so the A/B measures the
+  raw interior throughput upper bound — if raw conv loses, the
+  banded/boundary engineering is moot.
+- **dot**: the separable form u' = A u + u B (A, B tridiagonal) fused
+  to K steps via u_K = sum_j C(K,j) A^j u B^(K-j), all as DENSE
+  matmuls — the textbook "stencils are matmuls" casting. Expected to
+  lose by construction at production sizes (2M flops/cell-step dense
+  vs 5 on the VPU: the band structure is thrown away), included to pin
+  the magnitude.
+
+Flop accounting per cell-step: VPU path 5 flops; conv 2(2K+1)^2/K
+(K=8: ~14x the VPU's 5, worth it only if the MXU rate advantage
+exceeds that); dense dot 2(M+N)(K+1)/K flops — ~800x the VPU's 5 at
+1000^2, unwinnable by construction.
+
+Run on the real chip: ``python tools/ab_mxu.py``. One JSON line per
+(size, variant). The verdict lands in REPORT §3c either way.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def kstep_kernel(cx: float, cy: float, k: int) -> np.ndarray:
+    """The K-fold self-convolution of the 5-point stencil, f64."""
+    base = np.zeros((3, 3), np.float64)
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+    base[1, 1] = a0
+    base[0, 1] = base[2, 1] = cx
+    base[1, 0] = base[1, 2] = cy
+    w = np.ones((1, 1), np.float64)
+    for _ in range(k):
+        # full 2D convolution of a tiny kernel — nine shift-adds,
+        # not worth a scipy import
+        out = np.zeros((w.shape[0] + 2, w.shape[1] + 2), np.float64)
+        for di in range(3):
+            for dj in range(3):
+                out[di:di + w.shape[0], dj:dj + w.shape[1]] += \
+                    base[di, dj] * w
+        w = out
+    return w
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.models import HeatPlate2D
+    from parallel_heat_tpu.solver import _build_runner
+    from parallel_heat_tpu.utils.profiling import chain_slope
+
+    K = 8
+    CX = CY = 0.1
+    wk = kstep_kernel(CX, CY, K)
+
+    def measure(fn, u0, reps=(4, 24), batches=3):
+        per = chain_slope(jax.jit(fn), u0, *reps, batches=batches)
+        return per
+
+    for nx, ny in [(1000, 1000), (16384, 16384)]:
+        u0 = HeatPlate2D(nx, ny).init_grid(jnp.float32)
+        cells = nx * ny
+
+        # -- production path: the solver's own compiled runner, K steps
+        cfg = HeatConfig(nx=nx, ny=ny, steps=K, backend="auto")
+        runner, _ = _build_runner(cfg)
+        prod = lambda g: runner(g)[0]
+        # runner donates; chain_slope copies u0 first, then chains.
+        per = chain_slope(prod, u0, 4, 24, batches=3)
+        print(json.dumps({
+            "size": f"{nx}x{ny}", "variant": "production (VPU kernels)",
+            "ms_per_K_steps": round(per * 1e3, 3),
+            "gcells_steps_per_s": round(K * cells / per / 1e9, 1)}))
+        sys.stdout.flush()
+
+        # -- conv casting (f32 and bf16-input variants). At 16384^2
+        #    the 1000^2 rate extrapolates to ~15 s per 8-step call
+        #    (>100x slower than production) — measuring it would burn
+        #    the whole budget to confirm a foregone loss; recorded as
+        #    an extrapolation row instead.
+        if nx > 4096:
+            print(json.dumps({
+                "size": f"{nx}x{ny}", "variant": "conv (both dtypes)",
+                "skipped": "extrapolates to ~15 s per 8 steps from the "
+                           "1000^2 rate (~0.15 Gcells*steps/s); conv "
+                           "with 1 channel never engages the MXU "
+                           "efficiently"}))
+            sys.stdout.flush()
+        conv_dts = ([] if nx > 4096 else
+                    [(jnp.float32, "conv f32-stored (TPU default "
+                                   "bf16-pass matmul precision)"),
+                     (jnp.bfloat16, "conv bf16-in f32-acc")])
+        for dt, label in conv_dts:
+            w = jnp.asarray(wk, dt).reshape(1, 1, 2 * K + 1, 2 * K + 1)
+
+            def conv_step(g, w=w, dt=dt):
+                x = g.astype(dt)[None, None]
+                y = lax.conv_general_dilated(
+                    x, w, window_strides=(1, 1),
+                    padding=[(K, K), (K, K)],
+                    preferred_element_type=jnp.float32)
+                return y[0, 0].astype(g.dtype)
+
+            try:
+                per = measure(conv_step, u0)
+                print(json.dumps({
+                    "size": f"{nx}x{ny}", "variant": label,
+                    "ms_per_K_steps": round(per * 1e3, 3),
+                    "gcells_steps_per_s": round(K * cells / per / 1e9, 1)}))
+            except Exception as e:
+                print(json.dumps({"size": f"{nx}x{ny}", "variant": label,
+                                  "error": repr(e)}))
+            sys.stdout.flush()
+
+        # -- dense separable matmul casting (1000^2 only; 16384^2 would
+        #    need a 16384^2 dense operator = 1 GiB and minutes per step)
+        if nx <= 2048:
+            a0 = 1.0 - 2.0 * CX - 2.0 * CY
+            A = (np.diag(np.full(nx, a0 / 2.0))
+                 + np.diag(np.full(nx - 1, CX), 1)
+                 + np.diag(np.full(nx - 1, CX), -1))
+            B = (np.diag(np.full(ny, a0 / 2.0))
+                 + np.diag(np.full(ny - 1, CY), 1)
+                 + np.diag(np.full(ny - 1, CY), -1))
+            # u_K = sum_j C(K,j) A^j u B^(K-j); precompute the powers.
+            from math import comb
+
+            Aj = [np.linalg.matrix_power(A, j) for j in range(K + 1)]
+            Bj = [np.linalg.matrix_power(B, j) for j in range(K + 1)]
+            AjT = [jnp.asarray(comb(K, j) * Aj[j], jnp.float32)
+                   for j in range(K + 1)]
+            BjT = [jnp.asarray(Bj[K - j], jnp.float32)
+                   for j in range(K + 1)]
+
+            def dot_step(g):
+                acc = jnp.zeros_like(g)
+                for j in range(K + 1):
+                    acc = acc + AjT[j] @ g @ BjT[j]
+                return acc
+
+            # Steady state: 16 K-blocks per dispatch, so per-call
+            # launch overhead amortizes exactly as the production
+            # kernels amortize theirs over thousands of fused steps.
+            def dot_chain(g):
+                return lax.fori_loop(0, 16, lambda i, gg: dot_step(gg), g)
+
+            for label, fn, blocks, reps in [
+                    ("dense separable matmul (TPU default bf16-pass "
+                     "matmul precision)", dot_step, 1, (4, 24)),
+                    ("dense separable matmul, steady state (16 "
+                     "K-blocks/dispatch)", dot_chain, 16, (2, 10)),
+            ]:
+                try:
+                    per = measure(fn, u0, reps=reps) / blocks
+                    print(json.dumps({
+                        "size": f"{nx}x{ny}", "variant": label,
+                        "ms_per_K_steps": round(per * 1e3, 3),
+                        "gcells_steps_per_s": round(
+                            K * cells / per / 1e9, 1)}))
+                except Exception as e:
+                    print(json.dumps({"size": f"{nx}x{ny}",
+                                      "variant": label, "error": repr(e)}))
+                sys.stdout.flush()
+
+            # Precision caveat, quantified: the TPU default runs these
+            # matmuls as bf16 passes; HIGHEST forces true f32 (6x the
+            # MXU passes) and is the honest like-for-like against the
+            # f32 VPU path.
+            def dot_step_f32(g):
+                acc = jnp.zeros_like(g)
+                for j in range(K + 1):
+                    acc = acc + jnp.matmul(
+                        jnp.matmul(AjT[j], g,
+                                   precision=lax.Precision.HIGHEST),
+                        BjT[j], precision=lax.Precision.HIGHEST)
+                return acc
+
+            try:
+                per = measure(dot_step_f32, u0, reps=(4, 24))
+                print(json.dumps({
+                    "size": f"{nx}x{ny}",
+                    "variant": "dense separable matmul, "
+                               "precision=HIGHEST (true f32)",
+                    "ms_per_K_steps": round(per * 1e3, 3),
+                    "gcells_steps_per_s": round(K * cells / per / 1e9,
+                                                1)}))
+            except Exception as e:
+                print(json.dumps({
+                    "size": f"{nx}x{ny}",
+                    "variant": "dense f32 HIGHEST", "error": repr(e)}))
+            sys.stdout.flush()
+
+        # numerical sanity: conv f32 == K jnp steps on the interior
+        # (boundary cone divergence expected and excluded)
+        if nx == 1000:
+            from parallel_heat_tpu.ops.stencil import step_2d
+
+            w = jnp.asarray(wk, jnp.float32).reshape(1, 1, 2 * K + 1,
+                                                     2 * K + 1)
+            x = u0.astype(jnp.float32)[None, None]
+            got = lax.conv_general_dilated(
+                x, w, (1, 1), [(K, K), (K, K)],
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)[0, 0]
+            want = u0
+            for _ in range(K):
+                want = step_2d(want, CX, CY)
+            core = np.s_[K + 1:-K - 1, K + 1:-K - 1]
+            err = float(jnp.max(jnp.abs(got[core] - want[core]))
+                        / jnp.max(jnp.abs(want[core])))
+            print(json.dumps({"check": "conv interior vs K jnp steps",
+                              "rel_err": f"{err:.2e}",
+                              "ok": bool(err < 1e-5)}))
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
