@@ -1010,39 +1010,24 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
 # Solver-facing step factories
 # --------------------------------------------------------------------------
 
-def single_grid_multistep(config):
-    """``(multi_step(u, k), multi_step_residual(u, k))`` for one device.
+def pick_single_2d(shape, dtype, cx, cy):
+    """The 2D single-device kernel decision: ``(kind, built_or_detail)``
+    with kind in {"A", "E", "B", "C", "jnp"}.
 
-    Small grids take the VMEM-resident kernel (whole chunks on-chip);
-    large aligned grids take the streaming strip kernel; anything else
-    falls back to the XLA-fused jnp path.
+    This is the ONE decision site — :func:`single_grid_multistep`
+    executes its result and ``solver.explain`` reports it, so the two
+    can never desynchronize (the regression --explain exists to avoid:
+    a pick-order change silently mirrored in only one place). The
+    _build_* functions are lru_cached (deciding never re-traces a
+    kernel, and the explain path shares the execution path's build
+    entries); the _pick_* searches re-run but are a few hundred cheap
+    iterations.
     """
-    from parallel_heat_tpu.ops.stencil import step_2d, step_2d_residual
-
-    shape = config.shape
-    dtype = config.dtype
-    cx, cy = float(config.cx), float(config.cy)
-
     if fits_vmem(shape, dtype):
-        def multi_step(u, k):
-            fn = _build_vmem_multistep(shape, dtype, cx, cy, k)
-            return fn(u)[0]
-
-        def multi_step_residual(u, k):
-            fn = _build_vmem_multistep(shape, dtype, cx, cy, k)
-            return fn(u)
-
-        return multi_step, multi_step_residual
-
-    from parallel_heat_tpu.solver import steps_to_multistep
-
-    # Grids beyond VMEM: K-steps-per-pass temporal blocking (any
-    # storage dtype; arithmetic is f32 with per-step storage rounding
-    # either way, so this is bit-identical to K single-step passes).
-    temporal = _temporal_multistep(shape, dtype, cx, cy)
-    if temporal is not None:
-        return temporal
-
+        return "A", None
+    t = _pick_temporal_strip(shape[0], shape[1], dtype)
+    if t is not None:
+        return "E", t
     # Single-step streaming: strips (B) vs 2D tiles (C), whichever
     # fetches fewer halo cells per useful cell. Wide sub-f32 grids are
     # the case where C wins: the f32 cast temporaries cap B's strip
@@ -1055,12 +1040,53 @@ def single_grid_multistep(config):
              if t_c else 0.0)
     order = ([_build_tiled_kernel, _build_strip_kernel] if eff_c > eff_b
              else [_build_strip_kernel, _build_tiled_kernel])
-    built = None
     for build in order:
         built = build(shape, dtype, cx, cy, shape, sharded=False)
         if built is not None:
-            break
-    if built is None:  # awkward geometry: XLA-fused fallback
+            return ("C" if build is _build_tiled_kernel else "B"), built
+    return "jnp", None
+
+
+def single_grid_multistep(config):
+    """``(multi_step(u, k), multi_step_residual(u, k))`` for one device.
+
+    Small grids take the VMEM-resident kernel (whole chunks on-chip);
+    large aligned grids take the streaming strip kernel; anything else
+    falls back to the XLA-fused jnp path. The decision lives in
+    :func:`pick_single_2d` (shared with ``solver.explain``).
+    """
+    from parallel_heat_tpu.ops.stencil import step_2d, step_2d_residual
+
+    shape = config.shape
+    dtype = config.dtype
+    cx, cy = float(config.cx), float(config.cy)
+    kind, built = pick_single_2d(shape, dtype, cx, cy)
+
+    if kind == "A":
+        def multi_step(u, k):
+            fn = _build_vmem_multistep(shape, dtype, cx, cy, k)
+            return fn(u)[0]
+
+        def multi_step_residual(u, k):
+            fn = _build_vmem_multistep(shape, dtype, cx, cy, k)
+            return fn(u)
+
+        return multi_step, multi_step_residual
+
+    from parallel_heat_tpu.solver import steps_to_multistep
+
+    if kind == "E":
+        # K-steps-per-pass temporal blocking (any storage dtype;
+        # arithmetic is f32 with per-step storage rounding either way,
+        # so this is bit-identical to K single-step passes).
+        temporal = _temporal_multistep(shape, dtype, cx, cy)
+        # pick==E implies the builder accepts (they share the decline
+        # conditions); assert so a future builder-only decline point
+        # fails loudly here instead of propagating None to the caller.
+        assert temporal is not None
+        return temporal
+
+    if kind == "jnp":  # awkward geometry: XLA-fused fallback
         return steps_to_multistep(
             lambda u: step_2d(u, cx, cy),
             lambda u: step_2d_residual(u, cx, cy),
@@ -1105,30 +1131,45 @@ def _edge_column_update(core, halos, row_off, col_off, grid_shape, cx, cy):
     return wcol, ecol, jnp.maximum(res_w, res_e)
 
 
+def pick_block_2d(config, axis_names):
+    """The sharded per-step kernel decision: ``(kind, built)`` with
+    kind in {"B", "C", "jnp"} — the one decision site shared by
+    :func:`block_steps` (execution) and ``solver.explain`` (reporting);
+    see :func:`pick_single_2d` for the rationale.
+
+    by < 2 declines outright: the edge-column epilogue needs a
+    same-block lateral neighbor (core[:, 1] / core[:, -2]);
+    single-column blocks take the jnp halo path (whose padded
+    formulation handles them).
+    """
+    bx, by = config.block_shape()
+    if by < 2:
+        return "jnp", None
+    args = ((bx, by), config.dtype, float(config.cx), float(config.cy),
+            config.shape)
+    built = _build_strip_kernel(*args, sharded=True,
+                                vma=tuple(axis_names))
+    if built is not None:
+        return "B", built
+    built = _build_tiled_kernel(*args, sharded=True,
+                                vma=tuple(axis_names))
+    if built is not None:
+        return "C", built
+    return "jnp", None
+
+
 def block_steps(config, kw):
     """``(step(u_ext), step_residual(u_ext), pre, post)`` on a shard
     block inside shard_map, carrying the SUB-extended block between
     steps (``pre``/``post`` convert at loop entry/exit).
 
     Falls back to the jnp halo path (with identity converters) when the
-    kernel declines the geometry.
+    kernel declines the geometry (:func:`pick_block_2d`).
     """
     from parallel_heat_tpu.parallel import halo as _halo
 
     bx, by = config.block_shape()
-    # by < 2: the edge-column epilogue needs a same-block lateral
-    # neighbor (core[:, 1] / core[:, -2]); single-column blocks take the
-    # jnp halo path (whose padded formulation handles them).
-    if by >= 2:
-        args = ((bx, by), config.dtype, float(config.cx), float(config.cy),
-                config.shape)
-        built = _build_strip_kernel(*args, sharded=True,
-                                    vma=tuple(kw["axis_names"]))
-        if built is None:
-            built = _build_tiled_kernel(*args, sharded=True,
-                                        vma=tuple(kw["axis_names"]))
-    else:
-        built = None
+    _, built = pick_block_2d(config, kw["axis_names"])
     ident = lambda u: u
     if built is None:
         return (
@@ -2128,25 +2169,42 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
     return fn
 
 
+def pick_single_3d(shape, dtype):
+    """The 3D single-device kernel decision: ``(kind, pick)`` with
+    kind in {"F", "D", "jnp"} — one decision site shared by
+    :func:`single_grid_multistep_3d` and ``solver.explain``; see
+    :func:`pick_single_2d` for the rationale. Preference order: X-slab
+    temporal kernel (contiguous DMA, K steps per pass) > XY-tiled slab
+    kernel (planes too large for full-plane buffering) > XLA-fused jnp.
+    """
+    pick = _pick_xslab_3d(shape, jnp.dtype(dtype))
+    if pick is not None:
+        return "F", pick
+    pick = _pick_slab_3d(shape, jnp.dtype(dtype))
+    if pick is not None and shape[0] >= 3 and shape[1] >= 3:
+        return "D", pick
+    return "jnp", None
+
+
 def single_grid_multistep_3d(config):
     """``(multi_step, multi_step_residual)`` for one device, 3D.
 
-    Preference order: X-slab temporal kernel (contiguous DMA, K steps
-    per pass) > XY-tiled slab kernel (planes too large for full-plane
-    buffering) > XLA-fused jnp.
+    The decision lives in :func:`pick_single_3d` (shared with
+    ``solver.explain``).
     """
     from parallel_heat_tpu.ops.stencil import step_3d, step_3d_residual
     from parallel_heat_tpu.solver import steps_to_multistep
 
     cx, cy, cz = (float(config.cx), float(config.cy), float(config.cz))
-    xslab = _xslab_multistep_3d(config.shape, config.dtype, cx, cy, cz)
-    if xslab is not None:
-        return xslab
-    fn = _build_slab_kernel_3d(config.shape, config.dtype, cx, cy, cz)
-    if fn is None:
-        return steps_to_multistep(
-            lambda u: step_3d(u, cx, cy, cz),
-            lambda u: step_3d_residual(u, cx, cy, cz),
-        )
-    return steps_to_multistep(lambda u: fn(u)[0], lambda u: fn(u),
-                              unroll=_UNROLL)
+    kind, _ = pick_single_3d(config.shape, config.dtype)
+    if kind == "F":
+        return _xslab_multistep_3d(config.shape, config.dtype, cx, cy, cz)
+    if kind == "D":
+        fn = _build_slab_kernel_3d(config.shape, config.dtype, cx, cy, cz)
+        assert fn is not None  # pick==D implies the builder accepts
+        return steps_to_multistep(lambda u: fn(u)[0], lambda u: fn(u),
+                                  unroll=_UNROLL)
+    return steps_to_multistep(
+        lambda u: step_3d(u, cx, cy, cz),
+        lambda u: step_3d_residual(u, cx, cy, cz),
+    )

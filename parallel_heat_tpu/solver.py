@@ -424,16 +424,17 @@ def _prepare_initial(config: HeatConfig,
 def explain(config: HeatConfig) -> dict:
     """Resolve — without running anything — which execution path a
     config takes: backend, mesh, and the exact kernel/pick the solver's
-    factories would choose (mirrors their decision order by calling the
-    same pickers). Surfaced by the CLI as ``--explain``; useful for
-    understanding why a geometry declined to a fallback.
+    factories would choose. Surfaced by the CLI as ``--explain``;
+    useful for understanding why a geometry declined to a fallback.
 
-    Maintenance contract: each branch mirrors one factory —
-    ``single_grid_multistep`` (2D), ``single_grid_multistep_3d``,
-    ``block_steps`` (sharded per-step), ``temporal._pallas_round_2d``
-    (sharded K-deep). A change to any factory's pick order must be
-    mirrored here; ``tests/test_cli.py::
-    test_explain_resolves_expected_paths`` pins one case per branch.
+    The kernel decisions are NOT mirrored here: each factory's choice
+    lives in a shared pick function (``ps.pick_single_2d`` /
+    ``pick_single_3d`` / ``pick_block_2d``; the temporal rounds probe
+    the same lru_cached builders with the same args the real rounds
+    use), so a pick-order change is automatically reflected —
+    mirroring once desynchronized exactly the decline cases --explain
+    exists for (the kernel-C omission, see test_explain_sharded_tiled_
+    fallback). Only the label formatting lives here.
     """
     config = config.validate()
     config, backend, auto_depth = _resolved(config)
@@ -458,8 +459,6 @@ def explain(config: HeatConfig) -> dict:
                    if config.halo_depth > 1 else "per-step halo exchange")
                 + ")")
         return out
-
-    import jax.numpy as _jnp
 
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
@@ -498,16 +497,19 @@ def explain(config: HeatConfig) -> dict:
                            f"(halo_depth={config.halo_depth}) on shard "
                            f"blocks")
             return out
-        # Mirrors ops/pallas_stencil.block_steps: strip kernel first,
-        # tiled kernel as fallback, jnp when both decline or by < 2.
-        if config.ndim == 2 and bx_by[1] >= 2:
-            t = ps._pick_strip_rows(bx_by[0], bx_by[1], dtype, sharded=True)
-            if t is not None:
+        if config.ndim == 2:
+            from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
+            kind, _ = ps.pick_block_2d(config, AXIS_NAMES[:2])
+            if kind == "B":
+                t = ps._pick_strip_rows(bx_by[0], bx_by[1], dtype,
+                                        sharded=True)
                 out["path"] = (f"kernel B (streaming strip, sharded) "
                                f"T={t} + jnp edge-column epilogue")
                 return out
-            tc = ps._pick_tile_2d(bx_by[0], bx_by[1], dtype, sharded=True)
-            if tc is not None:
+            if kind == "C":
+                tc = ps._pick_tile_2d(bx_by[0], bx_by[1], dtype,
+                                      sharded=True)
                 out["path"] = (f"kernel C (2D-tiled, sharded) "
                                f"tile={tc[0]}x{tc[1]} + jnp edge-column "
                                f"epilogue")
@@ -516,37 +518,29 @@ def explain(config: HeatConfig) -> dict:
         return out
 
     if config.ndim == 3:
-        pick = ps._pick_xslab_3d(config.shape, _jnp.dtype(dtype))
-        if pick is not None:
+        kind, pick = ps.pick_single_3d(config.shape, dtype)
+        if kind == "F":
             out["path"] = (f"kernel F (X-slab temporal) sx={pick[0]} "
                            f"K={pick[1]}")
-            return out
-        pick = ps._pick_slab_3d(config.shape, _jnp.dtype(dtype))
-        if pick is not None and config.nx >= 3 and config.ny >= 3:
+        elif kind == "D":
             out["path"] = (f"kernel D (XY-tiled 3D slab) sx={pick[0]} "
                            f"ty={pick[1]}")
-            return out
-        out["path"] = "XLA-fused jnp stencil (3D pickers declined)"
+        else:
+            out["path"] = "XLA-fused jnp stencil (3D pickers declined)"
         return out
 
-    if ps.fits_vmem(config.shape, dtype):
+    kind, _ = ps.pick_single_2d(config.shape, dtype, cx, cy)
+    if kind == "A":
         out["path"] = "kernel A (VMEM-resident multi-step)"
-        return out
-    t = ps._pick_temporal_strip(config.nx, config.ny, dtype)
-    if t is not None:
+    elif kind == "E":
+        t = ps._pick_temporal_strip(config.nx, config.ny, dtype)
         out["path"] = f"kernel E (temporal-blocked strip) T={t} K={sub}"
-        return out
-    t_b = ps._pick_strip_rows(config.nx, config.ny, dtype, sharded=False)
-    t_c = ps._pick_tile_2d(config.nx, config.ny, dtype, sharded=False)
-    eff_b = t_b / (t_b + 2 * sub) if t_b else 0.0
-    eff_c = (t_c[0] * t_c[1] / ((t_c[0] + 2 * sub)
-                                * (t_c[1] + 2 * ps._LANE))
-             if t_c else 0.0)
-    if t_c and eff_c > eff_b:
-        out["path"] = f"kernel C (2D-tiled streaming) tile={t_c[0]}x{t_c[1]}"
-    elif t_b:
+    elif kind == "B":
+        t_b = ps._pick_strip_rows(config.nx, config.ny, dtype,
+                                  sharded=False)
         out["path"] = f"kernel B (streaming strip) T={t_b}"
-    elif t_c:
+    elif kind == "C":
+        t_c = ps._pick_tile_2d(config.nx, config.ny, dtype, sharded=False)
         out["path"] = f"kernel C (2D-tiled streaming) tile={t_c[0]}x{t_c[1]}"
     else:
         out["path"] = "XLA-fused jnp stencil (2D pickers declined)"
