@@ -1006,6 +1006,226 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
     return fn
 
 
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
+                                   grid_shape, k, vma=None,
+                                   with_residual=True):
+    """Kernel G in the circular (periodic-ghost) column layout —
+    ``fn(ext, row_off, col_off) -> ((bx, by) core, residual)``.
+
+    Kernel H's layout back-ported to 2D: columns are ``[u | hi | seam |
+    lo]`` (``fn.tail`` wide, lane-tile rounded), so every exchanged
+    piece concatenates at a lane-aligned offset and the core starts at
+    column 0 — the kernel writes exactly ``(bx, by)`` and the caller
+    slices nothing (the legacy layout pays an extra lane-misaligned
+    core-slice pass per round). Rows keep the legacy ``[lo | u | hi]``
+    order and the ``k == sublane`` depth (row windows slice the sublane
+    dim; circular indexing cannot wrap a DMA). Requires ``by`` itself
+    lane-aligned on hardware — geometries that fail that take the
+    legacy builder (same results, one extra pass); see
+    ``pick_block_temporal_2d``.
+
+    Everything else — coefficient-vector pinning, zeroed ping-pong
+    edge rows, the frontier-margin argument, the fn-level diverging-run
+    re-pin — matches :func:`_build_temporal_block`; the circular wrap
+    adds one piecewise term to the global column coordinates (the lo
+    tail's columns sit just *before* the block) and the single hi<->lo
+    seam, whose garbage stays ``k`` columns from the core like every
+    other frontier. Offsets arrive as a plain SMEM operand (kernel H's
+    finding: scalar prefetch buys nothing when no index map needs it).
+    ``col_off`` is the global column of u's column 0 (not the padded
+    origin).
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    if k != SUB or bx < SUB:
+        return None
+    if _needs_lane_alignment():
+        if by % _LANE != 0:
+            return None
+        tail = ((2 * k + _LANE - 1) // _LANE) * _LANE
+    else:
+        tail = 2 * k
+    Ye = by + tail
+    T = _pick_block_strip(bx, Ye, dtype)
+    if T is None:
+        return None
+    n_strips = bx // T
+    W = T + 2 * SUB
+    C0 = SUB
+
+    def kernel(offs_ref, ext_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+
+        cols_l = lax.broadcasted_iota(jnp.int32, (1, Ye), 1)
+        # Circular: the lo tail [Ye-k, Ye) holds the columns just
+        # before the block; seam zeros in between get junk coords
+        # (harmless — never kept, same as kernel H).
+        cols_g = col_off + jnp.where(cols_l >= Ye - k, cols_l - Ye,
+                                     cols_l)
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        corecols = cols_l < by
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+
+        def dma(slot, strip):
+            start = pl.multiple_of(strip * T, SUB)
+            return pltpu.make_async_copy(
+                ext_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, :, :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+
+        @pl.when(s == 0)
+        def _():
+            pp[0:1, :] = jnp.zeros((1, Ye), dtype)
+            pp[W - 1:W, :] = jnp.zeros((1, Ye), dtype)
+
+        dma(slot, s).wait()
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, row_off + s * T, C0, NX, dtype)
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, 1, W - 1)
+            step_into(pp, sref, 1, W - 1)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, 1, W - 1)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C = chunk_new(src, r0, h)
+            # Core = origin columns; by is lane-aligned (the geometry
+            # guard), so the value slice is free and the out block is
+            # exactly the core.
+            out_ref[r0 - C0:r0 - C0 + h, :] = new[:, :by].astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, by), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, by), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, W, Ye), dtype),
+            pltpu.VMEM((W, Ye), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(ext, row_off, col_off):
+        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+        core, res = call(offs, ext)
+        # Diverging-run guard (same as the legacy builder): re-pin
+        # global Dirichlet cells from the input block — the
+        # multiplicative pinning's 0*inf would otherwise leak NaN.
+        ro = jnp.int32(row_off)
+        co = jnp.int32(col_off)
+
+        def fix_row(cr, i, pred):
+            return cr.at[i, :].set(
+                jnp.where(pred, ext[k + i, :by], cr[i, :]))
+
+        def fix_col(cr, j, pred):
+            return cr.at[:, j].set(
+                jnp.where(pred, ext[k:k + bx, j], cr[:, j]))
+
+        core = fix_row(core, 0, ro == 0)
+        core = fix_row(core, bx - 1, ro + bx == NX)
+        core = fix_col(core, 0, co == 0)
+        core = fix_col(core, by - 1, co + by == NY)
+        return core, res[0, 0]
+
+    fn.tail = tail
+    return fn
+
+
+def pick_block_temporal_2d(config, axis_names):
+    """The 2D K-deep round's kernel decision:
+    ``(kind, built, built_plain)`` with kind in {"G-circ", "G", "jnp"}
+    — one decision site shared by ``temporal._pallas_round_2d``
+    (execution), ``solver.explain`` (reporting) and
+    ``solver._resolve_halo_depth`` (the auto-depth probe); see
+    :func:`pick_single_2d` for the rationale. The circular layout is
+    preferred (no core-slice pass per round); geometries its
+    lane-alignment guard declines fall back to the legacy padded
+    layout, then to the jnp rounds. ``built_plain`` is the
+    with_residual=False twin, built here from the SAME args so the two
+    variants can never silently diverge (rounds whose residual the
+    caller discards use it — kernel E's rationale).
+    """
+    if config.ndim != 2:
+        return "jnp", None, None
+    K = config.halo_depth
+    if K != _sub_rows(config.dtype):
+        return "jnp", None, None
+    bx_by = config.block_shape()
+    args = (bx_by, config.dtype, float(config.cx), float(config.cy),
+            config.shape, K, tuple(axis_names))
+    built = _build_temporal_block_circular(*args)
+    if built is not None:
+        return ("G-circ", built,
+                _build_temporal_block_circular(*args, with_residual=False))
+    built = _build_temporal_block(*args)
+    if built is not None:
+        return ("G", built,
+                _build_temporal_block(*args, with_residual=False))
+    return "jnp", None, None
+
+
 # --------------------------------------------------------------------------
 # Solver-facing step factories
 # --------------------------------------------------------------------------

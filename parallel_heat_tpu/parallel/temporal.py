@@ -225,34 +225,72 @@ def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
     )
 
 
+def exchange_halos_circular_2d(u, k: int, mesh_shape, axis_names,
+                               tail: int):
+    """K-deep 2D exchange in the circular (periodic-ghost) column
+    layout the circular kernel-G builder consumes: columns become
+    ``[u | hi | seam-zeros | lo]`` (every piece lane-aligned — see
+    ``ops.pallas_stencil._build_temporal_block_circular``), then the
+    row phase sends K-row strips of the extended block (corner data
+    rides in the tails), keeping the legacy ``[north | u | south]``
+    row order.
+    """
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    dt = u.dtype
+    lo = _shift_down(u[:, -k:], ay, dy).astype(dt)
+    hi = _shift_up(u[:, :k], ay, dy).astype(dt)
+    pad = tail - 2 * k
+    parts = [u, hi] + ([jnp.zeros((u.shape[0], pad), dt)] if pad
+                       else []) + [lo]
+    uy = jnp.concatenate(parts, axis=1)
+    halo_n = _shift_down(uy[-k:, :], ax, dx)
+    halo_s = _shift_up(uy[:k, :], ax, dx)
+    return jnp.concatenate([halo_n.astype(dt), uy, halo_s.astype(dt)],
+                           axis=0)
+
+
 def _pallas_round_2d(config, kw):
     """Kernel-G round: K-deep exchange + K Mosaic steps, or None.
 
     Available when the round depth equals the dtype's sublane count
-    (the kernel's alignment-free regime: halo_depth 8 for f32, 16 for
-    bf16) and the block geometry tiles. ``fn(u, want_res)`` advances
-    exactly ``config.halo_depth`` steps.
+    (the row windows slice the sublane dim) and the block geometry
+    tiles; the circular-layout builder is preferred and the legacy
+    padded layout is the fallback — the decision lives in
+    ``ps.pick_block_temporal_2d`` (shared with explain and the
+    auto-depth probe). ``fn(u, want_res)`` advances exactly
+    ``config.halo_depth`` steps.
     """
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
-    if config.ndim != 2:
+    axis_names = tuple(kw["axis_names"])
+    kind, built, built_plain = ps.pick_block_temporal_2d(config,
+                                                         axis_names)
+    if kind == "jnp":
         return None
     K = config.halo_depth
-    if K != ps._sub_rows(config.dtype):
-        return None
     bx, by = config.block_shape()
-    axis_names = kw["axis_names"]
-    args = ((bx, by), config.dtype, float(config.cx), float(config.cy),
-            config.shape, K, tuple(axis_names))
-    built = ps._build_temporal_block(*args)
-    if built is None:
-        return None
-    # Rounds whose residual the caller discards use the plain variant
-    # (no fused max-norm sweep — see kernel E's rationale).
-    built_plain = ps._build_temporal_block(*args, with_residual=False)
     mesh_shape = kw["mesh_shape"]
     block_index = kw["block_index"]
-    # axis_index('x') varies only on 'x'; broaden (see ops block_steps).
+
+    if kind == "G-circ":
+        # axis_index('x') varies only on 'x'; broaden (see block_steps).
+        row_off = lax.pcast(block_index[0] * bx, (axis_names[1],),
+                            to="varying")
+        col_off = lax.pcast(block_index[1] * by, (axis_names[0],),
+                            to="varying")
+
+        def fn(u, want_res):
+            ext = exchange_halos_circular_2d(u, K, mesh_shape,
+                                             axis_names, tail=built.tail)
+            kernel = built if want_res else built_plain
+            core, res = kernel(ext, row_off, col_off)
+            if want_res:
+                return core, lax.pmax(res, axis_names)
+            return core
+
+        return fn
+
     row_off = lax.pcast(block_index[0] * bx, (axis_names[1],), to="varying")
     col_off = lax.pcast(block_index[1] * by - K, (axis_names[0],),
                         to="varying")

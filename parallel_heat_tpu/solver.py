@@ -129,15 +129,12 @@ def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
             # than that cannot host it (3D has no such constraint —
             # kernel H's sweep bounds depth by block extent itself).
             return 1
-        bx, by = config.block_shape()
-        # Same args (incl. vma = the mesh axis names) as the real build
-        # in temporal._pallas_round_2d, so the probe IS the build —
-        # one lru_cache entry, and no probe/build divergence if the
-        # builder's decline logic ever becomes vma-dependent.
-        built = ps._build_temporal_block(
-            (bx, by), config.dtype, float(config.cx), float(config.cy),
-            config.shape, sub, AXIS_NAMES[:2])
-        return sub if built is not None else 1
+        # The probe IS the build (pick_block_temporal_2d is the same
+        # decision site the real round and explain use — shared
+        # lru_cache entries, no probe/build divergence).
+        kind, _, _ = ps.pick_block_temporal_2d(
+            config.replace(halo_depth=sub), AXIS_NAMES[:2])
+        return sub if kind != "jnp" else 1
     # 3D: kernel H supports any depth; score the feasible (sx, K)
     # pairs (kernel cost + modeled exchange cost) and take the best.
     pick = ps._pick_block_temporal_3d(config.block_shape(), mesh_shape,
@@ -472,13 +469,19 @@ def explain(config: HeatConfig) -> dict:
             from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
 
             if config.ndim == 2 and config.halo_depth == sub:
-                built = ps._build_temporal_block(
-                    bx_by, dtype, cx, cy, config.shape, config.halo_depth,
-                    AXIS_NAMES[:2])
-                if built is not None:
+                kind, built, _ = ps.pick_block_temporal_2d(
+                    config, AXIS_NAMES[:2])
+                if kind == "G-circ":
                     out["path"] = (
-                        f"kernel G (shard-block temporal, K={sub}) per "
-                        f"exchange round, padded width {built.padded_width}")
+                        f"kernel G (shard-block temporal, K={sub}, "
+                        f"circular layout) per exchange round, "
+                        f"tail {built.tail}")
+                    return out
+                if kind == "G":
+                    out["path"] = (
+                        f"kernel G (shard-block temporal, K={sub}, "
+                        f"legacy padded layout) per exchange round, "
+                        f"padded width {built.padded_width}")
                     return out
             if config.ndim == 3:
                 # Mirrors temporal._pallas_round_3d's build args.

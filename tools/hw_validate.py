@@ -177,6 +177,19 @@ def kernel_bitwise_checks():
         check(f"kernel G {M}x{N} {dt} k={k}",
               np.array_equal(core[:, k:k + N], want))
 
+        fnGc = ps._build_temporal_block_circular((M, N), dt, 0.1, 0.1,
+                                                 (M, N), k)
+        if fnGc is None:
+            check(f"kernel G-circ {M}x{N} {dt} k={k}", False,
+                  "builder declined")
+            continue
+        # circular layout: u at the column origin, tail after it
+        extc = jnp.zeros((M + 2 * k, N + fnGc.tail), u.dtype)
+        extc = extc.at[k:k + M, :N].set(u)
+        corec = np.asarray(jax.jit(lambda e: fnGc(e, 0, 0))(extc)[0])
+        check(f"kernel G-circ {M}x{N} {dt} k={k}",
+              np.array_equal(corec, want))
+
 
 def divergence_guard_checks():
     import jax
