@@ -1781,8 +1781,9 @@ def _pick_xslab_3d(shape, dtype):
     """``(SX, K)`` for the X-slab kernel, or None.
 
     Kernel D's XY-tiled windows are strided at Z-row (2 KB) granularity,
-    which caps its DMA streams at ~350 GB/s (measured: its runtime is
-    pure DMA time; masks and stencil hide entirely). An X slab spanning
+    which caps its DMA streams well below the contiguous rate
+    (measured: its runtime is pure DMA time; masks and stencil hide
+    entirely). An X slab spanning
     full (Y, Z) planes is ONE contiguous HBM range, so it streams at
     near peak — and because X is the untiled leading dim, halo planes
     need no alignment blocks: K-step temporal blocking costs only
@@ -1804,17 +1805,27 @@ def _pick_xslab_3d(shape, dtype):
     plane = Y * Z * itemsize
     plane_f32 = Y * Z * 4
     hw = _params()
-    budget = hw.stream_budget_bytes
+    # Budget = the full vmem_limit, NOT the conservative stream budget:
+    # this picker's cost model systematically overcounts (measured at
+    # 512^3: the (16,2) schedule it models at 128 MB compiles and runs
+    # fine under the 128 MiB limit and is 30% faster than the
+    # stream-budget pick (8,3): 144.7 vs 110.9 Gcells*steps/s, while
+    # the schedules modeled past the limit — (16,4) at 152 MB, (32,2)
+    # at 208 MB — really do fail Mosaic compilation). The overcount is
+    # the safety margin.
+    budget = hw.vmem_limit_bytes
     bw = hw.hbm_stream_bytes_per_s   # achieved read+write HBM mix
-                        # (v5e-measured: k=1 variants of both 3D
-                        # kernels time out at exactly this rate
-                        # regardless of window contiguity)
+                        # (v5e-measured from the 512^3 schedule sweep;
+                        # see tpu_params' provenance note)
     rate = hw.vpu_cells_per_s        # VPU 7-point cells/s, full occupancy
     ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")
     for k in range(1, 9):
-        for sx in (64, 32, 16, 8, 4):
+        # Any divisor of X works (the slab dim is untiled — same sweep
+        # generalization as kernel H's picker); powers of two are just
+        # the common case.
+        for sx in range(min(64, X), 1, -1):
             if X % sx != 0 or sx + 2 * k > X:
                 continue
             scr = sx + 4 * k
@@ -2077,7 +2088,11 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     plane = Ye * Ze * itemsize
     plane_f32 = Ye * Ze * 4
     hw = _params()
-    budget = hw.stream_budget_bytes
+    # Full vmem_limit, same justification as _pick_xslab_3d: this
+    # shared cost model overcounts ~20% (measured at 512^3) — the
+    # overcount is the margin, and schedules modeled past the limit
+    # really do fail Mosaic compilation.
+    budget = hw.vmem_limit_bytes
     ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")

@@ -9,11 +9,12 @@ live now.
 
 Provenance of the numbers:
 
-- **v5e row: measured** on real hardware in round 1 (REPORT.md §2-§4).
-  The 128 MiB VMEM was probed empirically (a 127 MiB scratch compiles);
-  350 GB/s is the achieved read+write stencil-stream mix (both 3D
-  kernels' k=1 variants time out at exactly this rate); 140 Gcells/s is
-  the sustained VPU 7-point rate at full occupancy.
+- **v5e row: measured** on real hardware (rounds 1-2; REPORT.md
+  §2-§4, §3d). The 128 MiB VMEM was probed empirically (a 127 MiB
+  scratch compiles); 650 GB/s is the achieved read+write
+  stencil-stream mix (round 2's kernel-F schedule sweep — round 1's
+  350 GB/s k=1 probes were latency-bound, see the row comment);
+  140 Gcells/s is the sustained VPU 7-point rate at full occupancy.
 - **Other rows: extrapolated, not measured.** VMEM sizes are public
   (128 MiB for v4/v5p/v6e, 16 MiB for v2/v3); achieved bandwidth scales
   the v5e measurement by the public spec-sheet HBM ratio (the stencil
@@ -71,18 +72,24 @@ class TpuParams:
         return self.vmem_bytes * 100 // 128
 
 
-_V5E = TpuParams("v5e", 128 * _MIB, 350e9, 140e9)          # measured
+# v5e achieved-bandwidth provenance: round 1 measured 350 GB/s from
+# k=1 kernel variants, but round 2's kernel-F schedule sweep at 512^3
+# showed the (16,2) schedule sustaining 4.5 B/cell-step at 144.7
+# Gcells*steps/s = ~650 GB/s (79% of the 819 GB/s spec) — the k=1
+# probes were latency-, not bandwidth-, bound. 650 is the number that
+# makes the picker models rank measured schedules correctly.
+_V5E = TpuParams("v5e", 128 * _MIB, 650e9, 140e9)          # measured
 _TABLE = {
     "v5e": _V5E,
-    # Extrapolated rows (see module docstring).
-    "v6e": TpuParams("v6e", 128 * _MIB, 700e9, 250e9,      # HBM 1640 GB/s
+    # Extrapolated rows: spec-sheet HBM ratio x the v5e achieved rate.
+    "v6e": TpuParams("v6e", 128 * _MIB, 1300e9, 250e9,     # HBM 1640 GB/s
                      ici_bytes_per_s=9e10),
-    "v5p": TpuParams("v5p", 128 * _MIB, 1180e9, 250e9,     # HBM 2765 GB/s
+    "v5p": TpuParams("v5p", 128 * _MIB, 2190e9, 250e9,     # HBM 2765 GB/s
                      ici_bytes_per_s=9e10),
-    "v4": TpuParams("v4", 128 * _MIB, 520e9, 170e9,        # HBM 1228 GB/s
+    "v4": TpuParams("v4", 128 * _MIB, 975e9, 170e9,        # HBM 1228 GB/s
                     ici_bytes_per_s=9e10),
-    "v3": TpuParams("v3", 16 * _MIB, 380e9, 100e9),        # HBM 900 GB/s
-    "v2": TpuParams("v2", 16 * _MIB, 300e9, 70e9),         # HBM 700 GB/s
+    "v3": TpuParams("v3", 16 * _MIB, 700e9, 100e9),        # HBM 900 GB/s
+    "v2": TpuParams("v2", 16 * _MIB, 550e9, 70e9),         # HBM 700 GB/s
 }
 
 _override: Optional[TpuParams] = None

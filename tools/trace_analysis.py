@@ -31,7 +31,6 @@ STEPS = 50
 K = 8                 # kernel E temporal depth (f32 sublane count)
 VPU_CEILING = 208.9e9  # kernel A cells/s at 1000^2 (bench headline):
                        # pure-VPU rate with zero HBM traffic per step
-HBM_BW = 350e9         # achieved stream mix (ops/tpu_params.py, v5e)
 
 
 def main():
@@ -115,8 +114,11 @@ def main():
                     "arithmetic skipped"}))
         return 0
     band_amp = (T + 2 * K) / T
+    from parallel_heat_tpu.ops.tpu_params import params
+
     t_vpu = band_amp / VPU_CEILING              # s per cell-step
-    t_dma = ((T + 2 * K) + T) * 4 / (T * K) / HBM_BW
+    t_dma = (((T + 2 * K) + T) * 4 / (T * K)
+             / params().hbm_stream_bytes_per_s)
     t_meas = per_call / 1e3 / (K * N * N)
     hidden = (t_vpu + t_dma - t_meas) / t_dma
     print(json.dumps({
