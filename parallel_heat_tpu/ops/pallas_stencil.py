@@ -712,6 +712,20 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
     return fn
 
 
+def _repin_boundary_2d(new, u):
+    """Re-pin the Dirichlet boundary from the untouched input — the
+    diverging-run guard shared by kernels E and I (0*inf = NaN from
+    the multiplicative pinning must never reach the output boundary;
+    bitwise a no-op for stable runs). XLA-level ``.at[].set`` restores
+    are free in donated loop chains (measured — see kernel E)."""
+    M, N = u.shape
+    new = new.at[0:1, :].set(u[0:1, :])
+    new = new.at[M - 1:M, :].set(u[M - 1:M, :])
+    new = new.at[:, 0:1].set(u[:, 0:1])
+    new = new.at[:, N - 1:N].set(u[:, N - 1:N])
+    return new
+
+
 _UNROLL = 8  # kernel calls per fori_loop iteration (see _chunked_multistep)
 
 
@@ -1232,7 +1246,7 @@ def pick_block_temporal_2d(config, axis_names):
 
 def pick_single_2d(shape, dtype, cx, cy):
     """The 2D single-device kernel decision: ``(kind, built_or_detail)``
-    with kind in {"A", "E", "B", "C", "jnp"}.
+    with kind in {"A", "E", "I", "B", "C", "jnp"}.
 
     This is the ONE decision site — :func:`single_grid_multistep`
     executes its result and ``solver.explain`` reports it, so the two
@@ -1247,7 +1261,31 @@ def pick_single_2d(shape, dtype, cx, cy):
         return "A", None
     t = _pick_temporal_strip(shape[0], shape[1], dtype)
     if t is not None:
+        # Sub-f32 storage: the tiled temporal kernel (I) can beat the
+        # strip kernel (E) when its fetch-window amplification is
+        # lower — measured on v5e at 32768^2 bf16: I 166.3 vs E 153.7
+        # Gcells*steps/s (model agrees: amp 1.195 vs 1.25). For f32
+        # E always wins where it builds (measured 16384^2: E 208.7 vs
+        # I 142.8 despite I's lower modeled amp — I's 2D-strided
+        # windows cost more than the band model sees), so the
+        # comparison is gated to sub-f32.
+        if jnp.dtype(dtype).itemsize < 4:
+            ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype)
+            if ti is not None:
+                sub = _sub_rows(dtype)
+                hc = _col_halo_temporal(dtype)
+                amp_e = (t + 2 * sub) / t
+                amp_i = ((ti[0] + 2 * sub) * (ti[1] + 4 * hc)
+                         / (ti[0] * ti[1]))
+                if amp_i < amp_e:
+                    return "I", ti
         return "E", t
+    # E declined (typically: strips too skinny under the f32-temporary
+    # cap on very wide grids): the 2D-tiled temporal kernel keeps the
+    # K-steps-per-fetch amortization with column windowing.
+    ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype)
+    if ti is not None:
+        return "I", ti
     # Single-step streaming: strips (B) vs 2D tiles (C), whichever
     # fetches fewer halo cells per useful cell. Wide sub-f32 grids are
     # the case where C wins: the f32 cast temporaries cap B's strip
@@ -1304,6 +1342,11 @@ def single_grid_multistep(config):
         # conditions); assert so a future builder-only decline point
         # fails loudly here instead of propagating None to the caller.
         assert temporal is not None
+        return temporal
+
+    if kind == "I":
+        temporal = _tile_temporal_multistep(shape, dtype, cx, cy)
+        assert temporal is not None  # pick==I implies the builder accepts
         return temporal
 
     if kind == "jnp":  # awkward geometry: XLA-fused fallback
@@ -1611,6 +1654,241 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         return new, res[0, 0]
 
     return fn, SUB
+
+
+# --------------------------------------------------------------------------
+# Kernel I: 2D-tiled temporal (wide grids, K steps per fetched tile)
+# --------------------------------------------------------------------------
+
+def _col_halo_temporal(dtype) -> int:
+    """Kernel I's column halo: a whole lane tile on hardware (clamp
+    granularity must be lane-aligned); in interpret mode 2*SUB, so the
+    CPU suite can drive the kernel on test-sized grids (>= any k <=
+    SUB, which is all the frontier needs)."""
+    return _LANE if _needs_lane_alignment() else 2 * _sub_rows(dtype)
+
+
+def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype):
+    """(T, CW) for kernel I, or None.
+
+    Kernel C's two-axis windows sized for kernel E's K=sublane temporal
+    steps: the row margin (2*SUB) and column margin (2*LANE) both
+    exceed the K-step garbage frontier, so the SAME window shape that
+    serves one step serves K — the fetch is amortized K-fold. This is
+    the kernel for grids where E declines (strips too skinny under the
+    f32-temporary cap — exactly the wide bf16 regime of the 32768^2
+    north-star config, which kernel C served bandwidth-bound at ~650
+    GB/s). Scores candidates by modeled max(VPU band time, DMA time)
+    per cell-step.
+    """
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    hw = _params()
+    budget = hw.stream_budget_bytes
+    best = None
+    best_t = float("inf")
+    # Interpret mode admits small column tiles so the CPU suite can
+    # exercise the kernel on test-sized grids (hardware keeps the
+    # production candidates — small tiles are never competitive there).
+    cands = ((1024, 2048, 4096, 8192) if _needs_lane_alignment()
+             else (16, 32, 64, 1024, 2048, 4096, 8192))
+    hc = _col_halo_temporal(dtype)
+    for cw in cands:
+        if n_cols % cw != 0 or n_cols // cw < 2 or cw + 2 * hc > n_cols:
+            continue
+        scr_c = cw + 4 * hc
+        # T caps at 256 like kernel E's: T=512 variants hit Mosaic
+        # register-allocator spills (verified here too — the (512,
+        # 8192) f32 schedule fails compilation outright).
+        t_max = min(256, out_rows - 2 * sub)
+        for t in range(sub, t_max + 1, sub):
+            if out_rows % t != 0:
+                continue
+            scr_r = t + 4 * sub
+            cost = (3 * scr_r * scr_c + 2 * t * cw) * itemsize
+            cost += 4 * (_SUBSTRIP + 2) * scr_c * 4  # f32 chunk temps
+            if itemsize < 4:
+                cost += t * cw * 4
+            if cost > budget:
+                continue
+            core = t * cw
+            amp_vpu = ((t + 2 * sub) * scr_c) / core
+            t_vpu = amp_vpu / hw.vpu_cells_per_s
+            t_bw = (((t + 2 * sub) * (cw + 2 * hc) + core) * itemsize
+                    / (sub * core) / hw.hbm_stream_bytes_per_s)
+            score = max(t_vpu, t_bw)
+            if score < best_t:
+                best_t, best = score, (t, cw)
+    return best
+
+
+@functools.lru_cache(maxsize=32)
+def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
+                            with_residual=True):
+    """K steps per fetched (T, CW) tile; ``fn(u) -> (u', res)`` or None.
+
+    Kernel E's temporal machinery under kernel C's two-axis clamped
+    windows: each tile's window carries 2*SUB halo rows and 2*LANE halo
+    columns (clamped by whole tiles at the grid edges, destination
+    offsets compensating), the K-1 intermediate sweeps ping-pong over
+    the fixed row band [SUB, T+3*SUB) at full scratch width, and the
+    final sweep writes exactly the (T, CW) core. Validity is the usual
+    shrinking-frontier argument on both axes: window-edge/clamp garbage
+    advances one cell per step and the margins (SUB rows = K, 2*LANE
+    columns >> K) keep it out of the core; lateral neighbors come from
+    ``_pinned_stepper``'s rolls, whose wrap garbage at the scratch
+    edges obeys the same bound. Dirichlet pinning is the shared
+    coefficient-vector scheme — column vectors from the tile's static
+    global column range (clamp-invariant via the destination offset),
+    row coefficients from the stepper. All three scratch buffers are
+    zeroed once at tile 0: un-DMA'd margin bands must never hold
+    allocation NaN (0 * NaN would poison pinned cells; afterwards
+    stale-but-finite prior-tile data is frontier-safe).
+
+    The residual is the fused core max-norm (pinned cells contribute
+    zero; margin columns are excluded by the core slice). The fn-level
+    boundary re-pin mirrors kernel E's diverging-run guard.
+    """
+    M, N = shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    assert 1 <= k <= SUB
+    tile = _pick_tile_temporal_2d(M, N, dtype)
+    if tile is None:
+        return None
+    T, CW = tile
+    HC = _col_halo_temporal(dtype)
+    n_rows = M // T
+    n_cols = N // CW
+    WR = T + 2 * SUB
+    WC = CW + 2 * HC
+    SCR_R = T + 4 * SUB
+    SCR_C = CW + 4 * HC
+    C0R = 2 * SUB
+    C0C = 2 * HC
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        c = pl.program_id(1)
+        nr = pl.num_programs(0)
+        nc = pl.num_programs(1)
+        idx = s * nc + c
+
+        def dma(slot, sr, sc):
+            row_start, row_dst = _clamped_window(
+                sr, T, SUB, M, WR, SUB, C0R)
+            col_start, col_dst = _clamped_window(
+                sc, CW, HC, N, WC, HC, C0C)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(row_start, WR), pl.ds(col_start, WC)],
+                slots.at[slot, pl.ds(row_dst, WR), pl.ds(col_dst, WC)],
+                sems.at[slot],
+            )
+
+        @pl.when(idx == 0)
+        def _():
+            z = jnp.zeros((SCR_R, SCR_C), dtype)
+            slots[0] = z
+            slots[1] = z
+            pp[...] = z
+            dma(0, 0, 0).start()
+
+        @pl.when(idx + 1 < nr * nc)
+        def _():
+            c1 = c + 1
+            s_next = jnp.where(c1 < nc, s, s + 1)
+            c_next = jnp.where(c1 < nc, c1, 0)
+            dma((idx + 1) % 2, s_next, c_next).start()
+
+        slot = lax.rem(idx, 2)
+        dma(slot, s, c).wait()
+
+        # Global column of scratch col 0 is clamp-invariant: c*CW - C0C.
+        cols_g = (c * CW - C0C
+                  + lax.broadcasted_iota(jnp.int32, (1, SCR_C), 1))
+        colmask = (cols_g >= 1) & (cols_g <= N - 2)
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, s * T, C0R, M, dtype)
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            step_into(pp, sref, SUB, T + 3 * SUB)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0R
+        while r0 < C0R + T:
+            h = min(_SUBSTRIP, C0R + T - r0)
+            new, C = chunk_new(src, r0, h)
+            core_new = new[:, C0C:C0C + CW]
+            out_ref[r0 - C0R:r0 - C0R + h, :] = core_new.astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.abs(core_new - C[:, C0C:C0C + CW])))
+            r0 += h
+
+        @pl.when(idx == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(idx > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_rows, n_cols),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((T, CW), lambda s, c: (s, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s, c: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR_R, SCR_C), dtype),
+            pltpu.VMEM((SCR_R, SCR_C), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return _repin_boundary_2d(new, u), res[0, 0]
+
+    return fn
+
+
+def _tile_temporal_multistep(shape, dtype, cx, cy):
+    """(multi_step, multi_step_residual) on kernel I, or None."""
+    if _pick_tile_temporal_2d(shape[0], shape[1],
+                              jnp.dtype(dtype)) is None:
+        return None
+    SUB = _sub_rows(dtype)
+    return _chunked_multistep(
+        lambda k, res: _build_tile_temporal_2d(shape, dtype, cx, cy, k,
+                                               with_residual=res),
+        SUB)
 
 
 # --------------------------------------------------------------------------

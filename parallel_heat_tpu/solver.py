@@ -538,6 +538,10 @@ def explain(config: HeatConfig) -> dict:
     elif kind == "E":
         t = ps._pick_temporal_strip(config.nx, config.ny, dtype)
         out["path"] = f"kernel E (temporal-blocked strip) T={t} K={sub}"
+    elif kind == "I":
+        ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype)
+        out["path"] = (f"kernel I (2D-tiled temporal) tile="
+                       f"{ti[0]}x{ti[1]} K={sub}")
     elif kind == "B":
         t_b = ps._pick_strip_rows(config.nx, config.ny, dtype,
                                   sharded=False)

@@ -190,6 +190,22 @@ def kernel_bitwise_checks():
         check(f"kernel G-circ {M}x{N} {dt} k={k}",
               np.array_equal(corec, want))
 
+    # kernel I needs >= 2 column tiles of >= 1024 on hardware — its own
+    # shapes (otherwise the check silently never runs where it matters)
+    for (M, N), dt in [((1024, 2048), "float32"), ((768, 2048), "bfloat16")]:
+        k = ps._sub_rows(jnp.dtype(dt))
+        fnI = ps._build_tile_temporal_2d((M, N), dt, 0.1, 0.1, k)
+        if fnI is None:
+            check(f"kernel I {M}x{N} {dt} k={k}", False, "builder declined")
+            continue
+        u = HeatPlate2D(M, N).init_grid(jnp.dtype(dt))
+        v = u
+        for _ in range(k):
+            v = factored_step_2d(v, 0.1, 0.1)
+        gotI = np.asarray(jax.jit(lambda uu: fnI(uu)[0])(u))
+        check(f"kernel I {M}x{N} {dt} k={k}",
+              np.array_equal(gotI, np.asarray(v)))
+
 
 def divergence_guard_checks():
     import jax
