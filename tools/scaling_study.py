@@ -38,17 +38,24 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes", default="128,256,512",
                     help="comma-separated square grid sizes")
-    ap.add_argument("--meshes", default="1x1,2x2,2x4",
-                    help="comma-separated mesh shapes (dxXdy), first is the "
-                         "speedup baseline")
+    ap.add_argument("--meshes", default=None,
+                    help="comma-separated mesh shapes (dxXdy, or dxXdyXdz "
+                         "with --ndim 3), first is the speedup baseline; "
+                         "default 1x1,2x2,2x4 (2D) / 1x1x1,2x2x1,2x2x2 "
+                         "(3D)")
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--converge", action="store_true")
-    ap.add_argument("--halo-depth", type=int, default=1, metavar="K",
+    ap.add_argument("--ndim", type=int, default=2, choices=(2, 3),
+                    help="3 = cubic grids + 3D meshes (dxXdyXdz) — the "
+                         "kernel-H sharded path on virtual meshes")
+    ap.add_argument("--halo-depth", default="auto", metavar="K",
                     help="K-deep halo exchange: K steps per collective "
-                         "round on sharded meshes (parallel/temporal.py)")
+                         "round on sharded meshes (parallel/temporal.py); "
+                         "'auto' = the production default (the solver "
+                         "resolves the Mosaic block kernel's depth)")
     ap.add_argument("--cpu-devices", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (env vars are "
                          "overridden by a pinned TPU platform; this uses "
@@ -60,6 +67,21 @@ def main(argv=None):
     if args.cpu_devices:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.dtype == "float64":
+        # Same pre-trace requirement as cli.py: validate() rejects f64
+        # without x64 mode.
+        jax.config.update("jax_enable_x64", True)
+    if args.meshes is None:
+        args.meshes = "1x1,2x2,2x4" if args.ndim == 2 else \
+            "1x1x1,2x2x1,2x2x2"
+    if args.halo_depth == "auto":
+        depth = None
+    else:
+        try:
+            depth = int(args.halo_depth)
+        except ValueError:
+            raise SystemExit(f"--halo-depth must be an integer or "
+                             f"'auto', got {args.halo_depth!r}")
 
     from parallel_heat_tpu import HeatConfig, solve
     from parallel_heat_tpu.solver import make_initial_grid
@@ -67,6 +89,10 @@ def main(argv=None):
 
     sizes = [int(s) for s in args.sizes.split(",") if s]
     meshes = [parse_mesh(m) for m in args.meshes.split(",") if m]
+    bad = [m for m in meshes if len(m) != args.ndim]
+    if bad:
+        raise SystemExit(
+            f"--meshes rank must match --ndim {args.ndim}: {bad}")
     n_dev = len(jax.devices())
     usable = [m for m in meshes if _prod(m) <= n_dev]
     skipped = [m for m in meshes if _prod(m) > n_dev]
@@ -80,10 +106,11 @@ def main(argv=None):
     for mesh in usable:
         for size in sizes:
             cfg = HeatConfig(
-                nx=size, ny=size, steps=args.steps, dtype=args.dtype,
+                nx=size, ny=size, nz=size if args.ndim == 3 else None,
+                steps=args.steps, dtype=args.dtype,
                 backend=args.backend, converge=args.converge,
                 mesh_shape=None if _prod(mesh) == 1 else mesh,
-                halo_depth=args.halo_depth if _prod(mesh) > 1 else 1,
+                halo_depth=depth if _prod(mesh) > 1 else 1,
             ).validate()
             u0 = jax.block_until_ready(make_initial_grid(cfg))
             solve(cfg, initial=u0)  # compile + warm up
@@ -102,7 +129,8 @@ def main(argv=None):
                 "size": size, "steps": res.steps_run,
                 "wall_s": round(best, 5),
                 "mcells_steps_per_s": round(
-                    size * size * res.steps_run / best / 1e6, 1),
+                    size ** (3 if args.ndim == 3 else 2)
+                    * res.steps_run / best / 1e6, 1),
                 "speedup": round(speedup, 3),
                 "efficiency": round(speedup / (devs / base_devs), 3),
             }))
