@@ -48,6 +48,24 @@ deep = solve(HeatConfig(**kw, mesh_shape=(2, 4), halo_depth=5))
 assert np.array_equal(np.asarray(gather_to_host(deep.grid)), oracle), \\
     "multi-process deep-halo != single-device"
 
+# Kernel G (circular layout, interpret mode on CPU) across the process
+# boundary: the K-deep exchange's ppermutes cross DCN coordination and
+# the Mosaic round must still match the oracle to stencil-reassociation
+# tolerance (the factored kernel algebra is deliberately not bitwise
+# against the jnp tree).
+from parallel_heat_tpu.ops import pallas_stencil as _ps
+from parallel_heat_tpu.parallel.mesh import AXIS_NAMES as _AX
+
+pal_cfg = HeatConfig(**kw, mesh_shape=(2, 4),
+                     halo_depth=8).replace(backend="pallas")
+kind, _, _ = _ps.pick_block_temporal_2d(pal_cfg, _AX[:2])
+assert kind == "G-circ", f"expected the Mosaic round, got {{kind}}"
+pal = solve(pal_cfg)
+assert pal.steps_run == 30
+np.testing.assert_allclose(
+    np.asarray(gather_to_host(pal.grid), dtype=np.float64),
+    oracle.astype(np.float64), rtol=1e-4, atol=1e-2)
+
 # Per-shard checkpoint round trip across the process boundary: each
 # process writes only its own shards (no host gather), p0 writes the
 # manifest, and the fast-path load rebuilds the same sharded array.
