@@ -19,7 +19,9 @@ the end-to-end solver on the attached accelerator and checks:
      kernel size, bitwise against the one-shot run.
 
 Exit code 0 = all checks passed. Run from the repo root:
-``python tools/hw_validate.py [--quick]``.
+``python tools/hw_validate.py [--quick] [--sections bitwise,kernel_h]``
+(the full battery can exceed 10 minutes with cold compile caches;
+--sections splits it across invocations).
 """
 
 import argparse
@@ -351,6 +353,11 @@ def main():
         if unknown:
             raise SystemExit(f"unknown sections {unknown}; "
                              f"choose from {','.join(sections)}")
+        if not run:
+            # An empty selection must not masquerade as a green battery.
+            raise SystemExit("no sections selected (--sections was "
+                             "empty); choose from "
+                             + ",".join(sections))
 
     import jax
     print(f"devices: {jax.devices()}")
