@@ -1207,19 +1207,272 @@ def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
     return fn
 
 
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
+                                grid_shape, k, vma=None,
+                                with_residual=True):
+    """Kernel G, fused-assembly variant: the exchange pieces arrive as
+    SEPARATE operands and the DMA pipeline gathers them —
+    ``fn(u, tail, halo_n, halo_s, row_off, col_off) ->
+    ((bx, by) core, residual)``.
+
+    :func:`_build_temporal_block_circular` consumes a caller-assembled
+    ``(bx+2k, by+tail)`` extended block: the XLA-level concatenates
+    write the whole extended block to HBM and the kernel immediately
+    re-reads it — two extra full-block HBM passes per round, the
+    dominant recoverable cost of the sharded 2D path (REPORT §4b:
+    118.3 vs kernel E's 184.5 Gcells*steps/s on the same volume). Here
+    the caller passes the pieces the circular layout already keeps
+    tile-aligned:
+
+    - ``u``        (bx, by)   — the shard itself, untouched in HBM;
+    - ``tail``     (bx, tail) — the ``[hi | seam | lo]`` column block
+      (ppermuted west/east strips, lane-tile rounded);
+    - ``halo_n/s`` (k, Ye)    — the ppermuted row strips of the
+      column-extended block (corner data rides in their tails;
+      ``parallel/temporal.py::exchange_halos_fused_2d`` builds them
+      from edge rows only, never materializing the extended block).
+
+    Each strip's scratch window is assembled *in VMEM* by 2-3 async
+    copies (core columns from ``u``, tail columns from ``tail``, plus
+    a row strip on the first/last strip) instead of one copy from a
+    pre-assembled block — the same bytes land in the same scratch
+    layout, so the arithmetic, masking, frontier margins and results
+    are bitwise those of the circular builder; the full-block HBM
+    write+read simply never happens. The analog of the reference's
+    improved persistent exchange, whose point was removing per-step
+    assembly cost from the critical path
+    (``mpi/mpi_heat_improved_persistent_stat.c:130-161``, Heat.pdf
+    Table 5).
+
+    Geometry guards, offsets and the diverging-run re-pin are the
+    circular builder's (``col_off`` = global column of u's column 0;
+    the re-pin reads ``u`` directly). ``fn.tail`` exposes the tail
+    width the exchange must build.
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    if k != SUB or bx < SUB:
+        return None
+    if _needs_lane_alignment():
+        if by % _LANE != 0:
+            return None
+        tail = ((2 * k + _LANE - 1) // _LANE) * _LANE
+    else:
+        tail = 2 * k
+    Ye = by + tail
+    T = _pick_block_strip(bx, Ye, dtype)
+    if T is None:
+        return None
+    n_strips = bx // T
+    W = T + 2 * SUB
+    C0 = SUB
+
+    def kernel(offs_ref, u_hbm, tail_hbm, hn_hbm, hs_hbm,
+               out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+
+        cols_l = lax.broadcasted_iota(jnp.int32, (1, Ye), 1)
+        cols_g = col_off + jnp.where(cols_l >= Ye - k, cols_l - Ye,
+                                     cols_l)
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        corecols = cols_l < by
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+
+        def issue(slot, strip, start):
+            """Start (or wait) strip ``strip``'s gather copies into
+            ``slots[slot]``. The branch structure is a pure function of
+            ``strip``, so the waits (issued one grid step after the
+            starts) decrement exactly the semaphores their starts
+            incremented. Edge strips replace the out-of-block k rows
+            with the row-halo strips; every branch covers all W scratch
+            rows (slot-reuse garbage never survives)."""
+            def go(c):
+                c.start() if start else c.wait()
+
+            def u_copy(src0, rows, dst0):
+                return pltpu.make_async_copy(
+                    u_hbm.at[pl.ds(src0, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(0, by)],
+                    sems.at[slot, 0])
+
+            def t_copy(src0, rows, dst0):
+                return pltpu.make_async_copy(
+                    tail_hbm.at[pl.ds(src0, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(by, tail)],
+                    sems.at[slot, 1])
+
+            def hn_copy():
+                return pltpu.make_async_copy(
+                    hn_hbm.at[:, :], slots.at[slot, pl.ds(0, k), :],
+                    sems.at[slot, 2])
+
+            def hs_copy():
+                return pltpu.make_async_copy(
+                    hs_hbm.at[:, :],
+                    slots.at[slot, pl.ds(W - k, k), :],
+                    sems.at[slot, 3])
+
+            if n_strips == 1:
+                go(u_copy(0, bx, k))
+                go(t_copy(0, bx, k))
+                go(hn_copy())
+                go(hs_copy())
+                return
+
+            @pl.when(strip == 0)
+            def _():
+                go(u_copy(0, T + k, k))
+                go(t_copy(0, T + k, k))
+                go(hn_copy())
+
+            @pl.when(strip == n_strips - 1)
+            def _():
+                s0 = (n_strips - 1) * T - k
+                go(u_copy(s0, T + k, 0))
+                go(t_copy(s0, T + k, 0))
+                go(hs_copy())
+
+            if n_strips > 2:
+                @pl.when((strip > 0) & (strip < n_strips - 1))
+                def _():
+                    s0 = pl.multiple_of(strip * T - k, SUB)
+                    go(u_copy(s0, W, 0))
+                    go(t_copy(s0, W, 0))
+
+        @pl.when(s == 0)
+        def _():
+            issue(0, 0, True)
+
+        @pl.when(s + 1 < n)
+        def _():
+            issue((s + 1) % 2, s + 1, True)
+
+        slot = lax.rem(s, 2)
+
+        @pl.when(s == 0)
+        def _():
+            pp[0:1, :] = jnp.zeros((1, Ye), dtype)
+            pp[W - 1:W, :] = jnp.zeros((1, Ye), dtype)
+
+        issue(slot, s, False)
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, row_off + s * T, C0, NX, dtype)
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, 1, W - 1)
+            step_into(pp, sref, 1, W - 1)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, 1, W - 1)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new[:, :by].astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, by), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, by), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, W, Ye), dtype),
+            pltpu.VMEM((W, Ye), dtype),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
+        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+        core, res = call(offs, u, tail_arr, halo_n, halo_s)
+        # Diverging-run guard (same as the circular builder): re-pin
+        # global Dirichlet cells from the input block — the
+        # multiplicative pinning's 0*inf would otherwise leak NaN.
+        ro = jnp.int32(row_off)
+        co = jnp.int32(col_off)
+
+        def fix_row(cr, i, pred):
+            return cr.at[i, :].set(jnp.where(pred, u[i, :], cr[i, :]))
+
+        def fix_col(cr, j, pred):
+            return cr.at[:, j].set(jnp.where(pred, u[:, j], cr[:, j]))
+
+        core = fix_row(core, 0, ro == 0)
+        core = fix_row(core, bx - 1, ro + bx == NX)
+        core = fix_col(core, 0, co == 0)
+        core = fix_col(core, by - 1, co + by == NY)
+        return core, res[0, 0]
+
+    fn.tail = tail
+    return fn
+
+
 def pick_block_temporal_2d(config, axis_names):
     """The 2D K-deep round's kernel decision:
-    ``(kind, built, built_plain)`` with kind in {"G-circ", "G", "jnp"}
+    ``(kind, built, built_plain)`` with kind in {"G-fuse", "G-circ",
+    "G", "jnp"}
     — one decision site shared by ``temporal._pallas_round_2d``
     (execution), ``solver.explain`` (reporting) and
     ``solver._resolve_halo_depth`` (the auto-depth probe); see
-    :func:`pick_single_2d` for the rationale. The circular layout is
-    preferred (no core-slice pass per round); geometries its
-    lane-alignment guard declines fall back to the legacy padded
-    layout, then to the jnp rounds. ``built_plain`` is the
-    with_residual=False twin, built here from the SAME args so the two
-    variants can never silently diverge (rounds whose residual the
-    caller discards use it — kernel E's rationale).
+    :func:`pick_single_2d` for the rationale. The fused-assembly
+    variant is preferred (no extended-block HBM materialization at
+    all); the assembled circular layout is the fallback for parity/
+    A/B, then the legacy padded layout, then the jnp rounds. The
+    fused and circular guards are identical today, so the circular
+    branch is reachable only if the guards ever diverge.
+    ``built_plain`` is the with_residual=False twin, built here from
+    the SAME args so the two variants can never silently diverge
+    (rounds whose residual the caller discards use it — kernel E's
+    rationale).
     """
     if config.ndim != 2:
         return "jnp", None, None
@@ -1229,6 +1482,10 @@ def pick_block_temporal_2d(config, axis_names):
     bx_by = config.block_shape()
     args = (bx_by, config.dtype, float(config.cx), float(config.cy),
             config.shape, K, tuple(axis_names))
+    built = _build_temporal_block_fused(*args)
+    if built is not None:
+        return ("G-fuse", built,
+                _build_temporal_block_fused(*args, with_residual=False))
     built = _build_temporal_block_circular(*args)
     if built is not None:
         return ("G-circ", built,

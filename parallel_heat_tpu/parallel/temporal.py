@@ -250,16 +250,49 @@ def exchange_halos_circular_2d(u, k: int, mesh_shape, axis_names,
                            axis=0)
 
 
+def exchange_halos_fused_2d(u, k: int, mesh_shape, axis_names,
+                            tail: int):
+    """K-deep 2D exchange emitting the fused kernel-G operands
+    ``(tail_arr, halo_n, halo_s)`` — the pieces of the circular layout
+    WITHOUT assembling the extended block (the kernel's DMA pipeline
+    gathers them; see ``ops.pallas_stencil._build_temporal_block_fused``).
+
+    Bitwise the same data as :func:`exchange_halos_circular_2d`:
+    ``tail_arr`` is the extended block's column tail ``[hi | seam |
+    lo]``, and the row strips are the extended block's first/last k
+    rows — built here from ``u``'s and ``tail_arr``'s edge rows alone
+    (ppermute is elementwise across devices, so shifting the
+    concatenated edge rows equals concatenating the shifted pieces).
+    Same four ppermutes as every 2D exchange; the XLA-level assembly
+    shrinks from O(bx*by) to O((bx + by)*k + bx*tail).
+    """
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    dt = u.dtype
+    lo = _shift_down(u[:, -k:], ay, dy).astype(dt)
+    hi = _shift_up(u[:, :k], ay, dy).astype(dt)
+    pad = tail - 2 * k
+    parts = [hi] + ([jnp.zeros((u.shape[0], pad), dt)] if pad
+                    else []) + [lo]
+    tail_arr = jnp.concatenate(parts, axis=1)
+    top = jnp.concatenate([u[:k, :], tail_arr[:k, :]], axis=1)
+    bot = jnp.concatenate([u[-k:, :], tail_arr[-k:, :]], axis=1)
+    halo_n = _shift_down(bot, ax, dx).astype(dt)
+    halo_s = _shift_up(top, ax, dx).astype(dt)
+    return tail_arr, halo_n, halo_s
+
+
 def _pallas_round_2d(config, kw):
     """Kernel-G round: K-deep exchange + K Mosaic steps, or None.
 
     Available when the round depth equals the dtype's sublane count
     (the row windows slice the sublane dim) and the block geometry
-    tiles; the circular-layout builder is preferred and the legacy
-    padded layout is the fallback — the decision lives in
-    ``ps.pick_block_temporal_2d`` (shared with explain and the
-    auto-depth probe). ``fn(u, want_res)`` advances exactly
-    ``config.halo_depth`` steps.
+    tiles; the fused-assembly builder is preferred (exchange pieces as
+    separate kernel operands, no extended-block materialization), with
+    the assembled circular layout and then the legacy padded layout as
+    fallbacks — the decision lives in ``ps.pick_block_temporal_2d``
+    (shared with explain and the auto-depth probe). ``fn(u, want_res)``
+    advances exactly ``config.halo_depth`` steps.
     """
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
@@ -273,12 +306,25 @@ def _pallas_round_2d(config, kw):
     mesh_shape = kw["mesh_shape"]
     block_index = kw["block_index"]
 
-    if kind == "G-circ":
+    if kind in ("G-fuse", "G-circ"):
         # axis_index('x') varies only on 'x'; broaden (see block_steps).
         row_off = lax.pcast(block_index[0] * bx, (axis_names[1],),
                             to="varying")
         col_off = lax.pcast(block_index[1] * by, (axis_names[0],),
                             to="varying")
+
+        if kind == "G-fuse":
+            def fn(u, want_res):
+                tail_arr, halo_n, halo_s = exchange_halos_fused_2d(
+                    u, K, mesh_shape, axis_names, tail=built.tail)
+                kernel = built if want_res else built_plain
+                core, res = kernel(u, tail_arr, halo_n, halo_s,
+                                   row_off, col_off)
+                if want_res:
+                    return core, lax.pmax(res, axis_names)
+                return core
+
+            return fn
 
         def fn(u, want_res):
             ext = exchange_halos_circular_2d(u, K, mesh_shape,

@@ -471,6 +471,12 @@ def explain(config: HeatConfig) -> dict:
             if config.ndim == 2 and config.halo_depth == sub:
                 kind, built, _ = ps.pick_block_temporal_2d(
                     config, AXIS_NAMES[:2])
+                if kind == "G-fuse":
+                    out["path"] = (
+                        f"kernel G (shard-block temporal, K={sub}, "
+                        f"fused exchange assembly) per exchange round, "
+                        f"tail {built.tail}")
+                    return out
                 if kind == "G-circ":
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}, "

@@ -48,7 +48,7 @@ deep = solve(HeatConfig(**kw, mesh_shape=(2, 4), halo_depth=5))
 assert np.array_equal(np.asarray(gather_to_host(deep.grid)), oracle), \\
     "multi-process deep-halo != single-device"
 
-# Kernel G (circular layout, interpret mode on CPU) across the process
+# Kernel G (fused assembly, interpret mode on CPU) across the process
 # boundary: the K-deep exchange's ppermutes cross DCN coordination and
 # the Mosaic round must still match the oracle to stencil-reassociation
 # tolerance (the factored kernel algebra is deliberately not bitwise
@@ -59,7 +59,7 @@ from parallel_heat_tpu.parallel.mesh import AXIS_NAMES as _AX
 pal_cfg = HeatConfig(**kw, mesh_shape=(2, 4),
                      halo_depth=8).replace(backend="pallas")
 kind, _, _ = _ps.pick_block_temporal_2d(pal_cfg, _AX[:2])
-assert kind == "G-circ", f"expected the Mosaic round, got {{kind}}"
+assert kind == "G-fuse", f"expected the Mosaic round, got {{kind}}"
 pal = solve(pal_cfg)
 assert pal.steps_run == 30
 np.testing.assert_allclose(

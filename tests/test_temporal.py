@@ -267,10 +267,11 @@ def test_explain_reports_auto_depth():
     assert out["halo_depth"] == "1 (auto)"
 
 
-def test_kernel_g_circular_matches_legacy_and_jnp():
-    # The circular-layout kernel G must agree with the legacy padded
-    # layout bit-for-bit (same arithmetic, different data placement)
-    # and with the jnp oracle to stencil-reassociation tolerance.
+def test_kernel_g_fused_matches_circular_legacy_and_jnp():
+    # The fused-assembly kernel G must agree with the assembled
+    # circular layout AND the legacy padded layout bit-for-bit (same
+    # arithmetic, different data transport) and with the jnp oracle to
+    # stencil-reassociation tolerance.
     from parallel_heat_tpu.ops import pallas_stencil as ps
     from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
 
@@ -278,18 +279,25 @@ def test_kernel_g_circular_matches_legacy_and_jnp():
     cfg = HeatConfig(backend="pallas", mesh_shape=(2, 2), halo_depth=8,
                      **kw)
     kind, _, _ = ps.pick_block_temporal_2d(cfg, AXIS_NAMES[:2])
-    assert kind == "G-circ"
-    circ = solve(cfg).to_numpy()
+    assert kind == "G-fuse"
+    fused = solve(cfg).to_numpy()
     oracle = solve(HeatConfig(backend="jnp", **kw)).to_numpy()
-    np.testing.assert_allclose(circ, oracle, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(fused, oracle, rtol=1e-4, atol=1e-3)
 
-    # Force the legacy layout by mocking the circular builder away and
-    # clearing the runner cache; results must match bitwise.
+    # Force the assembled circular layout, then the legacy layout, by
+    # mocking the preferred builders away and clearing the runner
+    # cache; results must match bitwise at each downgrade.
     import pytest
     from parallel_heat_tpu import solver as slv
 
     mp = pytest.MonkeyPatch()
     try:
+        mp.setattr(ps, "_build_temporal_block_fused",
+                   lambda *a, **k: None)
+        slv._build_runner.cache_clear()
+        kind, _, _ = ps.pick_block_temporal_2d(cfg, AXIS_NAMES[:2])
+        assert kind == "G-circ"
+        circ = solve(cfg).to_numpy()
         mp.setattr(ps, "_build_temporal_block_circular",
                    lambda *a, **k: None)
         slv._build_runner.cache_clear()
@@ -299,6 +307,7 @@ def test_kernel_g_circular_matches_legacy_and_jnp():
     finally:
         mp.undo()
         slv._build_runner.cache_clear()
+    np.testing.assert_array_equal(fused, circ)
     np.testing.assert_array_equal(circ, legacy)
 
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Batched A/B: the kernel-G round with fused exchange assembly vs the
+assembled circular layout (and the legacy padded layout), on hardware.
+
+Protocol matches REPORT §4b's 118.3 measurement: one device, the FULL
+jitted round including the exchange-shaped assembly, zero halos
+standing in for the ppermuted strips (``mesh_shape=(1, 1)`` turns the
+shifts into zeros without needing ``shard_map``), timed with
+``chain_slope(batches=3)`` (min-of-raw-endpoints — the bench.py
+protocol). Kernel E on the same volume is printed as the
+no-exchange-at-all ceiling the VERDICT's "within ~15%" target is
+measured against.
+
+Run: python tools/ab_fused_g.py [--size 4096] [--dtype float32]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.parallel import temporal as tp
+from parallel_heat_tpu.utils.profiling import chain_slope, chain_time, sync
+
+
+def bench_round(name, round_fn, u0, k, budget_s=6.0):
+    run = jax.jit(round_fn)
+    try:
+        sync(run(u0))
+    except Exception as e:
+        print(f"{name:26s}: FAILED {type(e).__name__}: {e}")
+        return None
+    t1 = chain_time(run, u0, 1)
+    r2 = 1 + max(2, min(120, int(budget_s / 3 / max(t1 - 0.15, 1e-3))))
+    try:
+        per = chain_slope(run, u0, 1, r2, batches=3) / k
+    except RuntimeError as e:
+        print(f"{name:26s}: noisy ({e})")
+        return None
+    cells = u0.shape[0] * u0.shape[1]
+    g = cells / per / 1e9
+    print(f"{name:26s}: {per*1e6:9.1f} us/step {g:7.1f} Gcells*steps/s "
+          f"(reps {r2 - 1})")
+    return g
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--cols", type=int, default=None,
+                    help="block width (defaults to --size)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--skip-legacy", action="store_true")
+    args = ap.parse_args()
+    M = args.size
+    N = args.cols or args.size
+    dts = args.dtype
+    dt = jnp.dtype(dts)
+    k = ps._sub_rows(dt)
+    mesh_shape = (1, 1)
+    ax = ("x", "y")
+    gs = (M, N)  # block spans the grid: zero offsets
+    print(f"block {M}x{N} {dts} K={k}  (zero halos, full jitted round)")
+    u0 = jax.block_until_ready(HeatPlate2D(M, N).init_grid(dt))
+
+    fused = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
+                                           with_residual=False)
+    circ = ps._build_temporal_block_circular(gs, dts, 0.1, 0.1, gs, k,
+                                             with_residual=False)
+    if fused is not None:
+        def round_fused(u):
+            t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
+                                                   tail=fused.tail)
+            return fused(u, t, hn, hs, 0, 0)[0]
+        bench_round("G-fuse (fused assembly)", round_fused, u0, k)
+    else:
+        print("G-fuse: builder declined")
+    if circ is not None:
+        def round_circ(u):
+            ext = tp.exchange_halos_circular_2d(u, k, mesh_shape, ax,
+                                                tail=circ.tail)
+            return circ(ext, 0, 0)[0]
+        bench_round("G-circ (assembled)", round_circ, u0, k)
+    else:
+        print("G-circ: builder declined")
+    if not args.skip_legacy:
+        leg = ps._build_temporal_block(gs, dts, 0.1, 0.1, gs, k,
+                                       with_residual=False)
+        if leg is not None:
+            pad = leg.padded_width - (N + 2 * k)
+
+            def round_leg(u):
+                ext = tp.exchange_halos_deep_2d(u, k, mesh_shape, ax,
+                                                pad_cols=pad)
+                return leg(ext, 0, -k)[0][:, k:k + N]
+            bench_round("G (legacy padded)", round_leg, u0, k)
+
+    # Ceiling: kernel E on the same volume, no exchange at all.
+    fnE = ps._build_temporal_strip(gs, dts, 0.1, 0.1, k,
+                                   with_residual=False)
+    if fnE is not None:
+        bench_round("E (ceiling, no exchange)", lambda u: fnE(u)[0], u0, k)
+
+
+if __name__ == "__main__":
+    main()

@@ -192,6 +192,22 @@ def kernel_bitwise_checks():
         check(f"kernel G-circ {M}x{N} {dt} k={k}",
               np.array_equal(corec, want))
 
+        # fused assembly: same pieces as separate operands, zero halos
+        # (what ppermute delivers at domain edges)
+        fnGf = ps._build_temporal_block_fused((M, N), dt, 0.1, 0.1,
+                                              (M, N), k)
+        if fnGf is None:
+            check(f"kernel G-fuse {M}x{N} {dt} k={k}", False,
+                  "builder declined")
+            continue
+        tails = jnp.zeros((M, fnGf.tail), u.dtype)
+        hrow = jnp.zeros((k, N + fnGf.tail), u.dtype)
+        coref = np.asarray(jax.jit(
+            lambda uu, t, a, b: fnGf(uu, t, a, b, 0, 0))(
+                u, tails, hrow, hrow)[0])
+        check(f"kernel G-fuse {M}x{N} {dt} k={k}",
+              np.array_equal(coref, want))
+
     # kernel I needs >= 2 column tiles of >= 1024 on hardware — its own
     # shapes (otherwise the check silently never runs where it matters)
     for (M, N), dt in [((1024, 2048), "float32"), ((768, 2048), "bfloat16")]:
@@ -250,6 +266,22 @@ def divergence_guard_checks():
         u = stepG(u)
     out = np.asarray(u)
     check("kernel G diverged + boundary exact",
+          (not np.all(np.isfinite(out))) and boundary_exact(out, np.asarray(u0)))
+
+    fnGf = ps._build_temporal_block_fused((256, 256), "float32", 0.9, 0.9,
+                                          (256, 256), k)
+
+    def stepGf(u):
+        tails = jnp.zeros((256, fnGf.tail), u.dtype)
+        hrow = jnp.zeros((k, 256 + fnGf.tail), u.dtype)
+        return fnGf(u, tails, hrow, hrow, 0, 0)[0]
+
+    stepGf = jax.jit(stepGf)
+    u = u0
+    for _ in range(20):
+        u = stepGf(u)
+    out = np.asarray(u)
+    check("kernel G-fuse diverged + boundary exact",
           (not np.all(np.isfinite(out))) and boundary_exact(out, np.asarray(u0)))
 
 
