@@ -2939,6 +2939,283 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
     return fn
 
 
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
+                                   grid_shape, k, halos, vma=None,
+                                   with_residual=True):
+    """Kernel H, fused-assembly variant: the exchange pieces arrive as
+    SEPARATE operands and the slab DMA pipeline gathers them —
+    ``fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off) ->
+    ((bx, by, bz) core, residual)``.
+
+    The 3D counterpart of :func:`_build_temporal_block_fused`:
+    :func:`_build_temporal_block_3d` consumes a caller-assembled
+    ``(Xe, Ye, Ze)`` extended block whose XLA concatenates write the
+    whole extended volume to HBM and the kernel re-reads it — two
+    extra full-block HBM passes per round. Here the circular layout's
+    tile-aligned pieces come in directly:
+
+    - ``u``     (bx, by, bz)      — the shard, untouched in HBM;
+    - ``ztail`` (bx, by, tail_z)  — ``[hi | seam | lo]`` z-tail
+      (``None`` when z is unsharded: the lane-pad region is don't-care
+      garbage under the frontier argument — the select pinning keeps
+      NaN out arithmetically, unlike 2D's multiplicative pinning);
+    - ``ytail`` (bx, tail_y, Ze)  — z-extended y-tail (``None`` when y
+      is unsharded);
+    - ``xlo/xhi`` (k, Ye, Ze)     — fully yz-extended x-edge slabs
+      (``None`` when x is unsharded: windows then clamp into ``u``
+      exactly as in kernel F).
+
+    Each slab's scratch window is assembled in VMEM by 1-3 sub-region
+    copies (core box from ``u``, tails into their aligned column
+    ranges, x-slabs on the edge slabs) — same bytes, same scratch
+    layout, so arithmetic, masking and frontier margins are bitwise
+    those of the assembled builder. Geometry, offsets, pinning and the
+    residual match :func:`_build_temporal_block_3d`; ``fn.tail_y`` /
+    ``fn.tail_z`` / ``fn.sx`` are exposed the same way.
+    """
+    bx, by, bz = block_shape
+    NX, NY, NZ = grid_shape
+    hx, hy, hz = halos
+    dtype = jnp.dtype(dtype_name)
+    assert k >= 1
+    pick = _pick_block_xslab_3d(block_shape, halos, dtype, k)
+    if pick is None:
+        return None
+    sx, _ = pick
+    Ye, Ze, tail_y, tail_z = _block_ext_geometry(block_shape, halos, dtype)
+    W = sx + 2 * k
+    SCR = sx + 4 * k
+    C0 = 2 * k
+    n_slabs = bx // sx
+    CH = _xslab_chunk(Ye * Ze * 4)
+    has_z = hz > 0
+    has_y = hy > 0
+    has_x = hx > 0
+    n_ops = 1 + int(has_z) + int(has_y) + 2 * int(has_x)
+
+    def kernel(offs_ref, *refs):
+        ins = refs[:n_ops]
+        out_ref, res_ref, slots, pp, sems = refs[n_ops:]
+        u_hbm = ins[0]
+        i = 1
+        zt_hbm = yt_hbm = xlo_hbm = xhi_hbm = None
+        if has_z:
+            zt_hbm = ins[i]
+            i += 1
+        if has_y:
+            yt_hbm = ins[i]
+            i += 1
+        if has_x:
+            xlo_hbm, xhi_hbm = ins[i], ins[i + 1]
+
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        x_off = offs_ref[0]
+        y_off = offs_ref[1]
+        z_off = offs_ref[2]
+
+        ys_l = lax.broadcasted_iota(jnp.int32, (1, Ye, 1), 1)
+        zs_l = lax.broadcasted_iota(jnp.int32, (1, 1, Ze), 2)
+        ys_g = y_off + (jnp.where(ys_l >= Ye - k, ys_l - Ye, ys_l)
+                        if hy else ys_l)
+        zs_g = z_off + (jnp.where(zs_l >= Ze - k, zs_l - Ze, zs_l)
+                        if hz else zs_l)
+        yzmask = ((ys_g >= 1) & (ys_g <= NY - 2)
+                  & (zs_g >= 1) & (zs_g <= NZ - 2))
+        corebox = (ys_l < by) & (zs_l < bz)
+
+        def issue(slot, slab, start):
+            """Start (or wait) slab ``slab``'s gather copies into
+            ``slots[slot]`` — branch structure a pure function of
+            ``slab``, so waits mirror starts exactly (see the 2D fused
+            builder). Core rows are expressed in ``u``'s x index."""
+            def go(c):
+                c.start() if start else c.wait()
+
+            def piece(src, dst_y, ny, dst_z, nz, sem):
+                def copy(src0, rows, dst0):
+                    return pltpu.make_async_copy(
+                        src.at[pl.ds(src0, rows), :, :],
+                        slots.at[slot, pl.ds(dst0, rows),
+                                 pl.ds(dst_y, ny), pl.ds(dst_z, nz)],
+                        sems.at[slot, sem])
+                return copy
+
+            u_c = piece(u_hbm, 0, by, 0, bz, 0)
+            z_c = piece(zt_hbm, 0, by, bz, tail_z, 1) if has_z else None
+            y_c = piece(yt_hbm, by, tail_y, 0, Ze, 2) if has_y else None
+
+            def core_copies(src0, rows, dst0):
+                go(u_c(src0, rows, dst0))
+                if has_z:
+                    go(z_c(src0, rows, dst0))
+                if has_y:
+                    go(y_c(src0, rows, dst0))
+
+            if not has_x:
+                # Clamped windows into the block (kernel F's idiom);
+                # one shared dynamic start/dst for every piece.
+                base = slab * sx
+                start0 = jnp.clip(base - k, 0, bx - W)
+                dst0 = C0 + start0 - base
+                core_copies(start0, W, dst0)
+                return
+
+            def xlo_copy():
+                return pltpu.make_async_copy(
+                    xlo_hbm.at[:, :, :],
+                    slots.at[slot, pl.ds(k, k), :, :],
+                    sems.at[slot, 3])
+
+            def xhi_copy():
+                return pltpu.make_async_copy(
+                    xhi_hbm.at[:, :, :],
+                    slots.at[slot, pl.ds(2 * k + bx - (n_slabs - 1) * sx,
+                                         k), :, :],
+                    sems.at[slot, 4])
+
+            if n_slabs == 1:
+                core_copies(0, bx, 2 * k)
+                go(xlo_copy())
+                go(xhi_copy())
+                return
+
+            @pl.when(slab == 0)
+            def _():
+                core_copies(0, sx + k, 2 * k)
+                go(xlo_copy())
+
+            @pl.when(slab == n_slabs - 1)
+            def _():
+                core_copies((n_slabs - 1) * sx - k, sx + k, k)
+                go(xhi_copy())
+
+            if n_slabs > 2:
+                @pl.when((slab > 0) & (slab < n_slabs - 1))
+                def _():
+                    core_copies(slab * sx - k, W, k)
+
+        @pl.when(s == 0)
+        def _():
+            issue(0, 0, True)
+
+        @pl.when(s + 1 < n)
+        def _():
+            issue((s + 1) % 2, s + 1, True)
+
+        slot = lax.rem(s, 2)
+        issue(slot, s, False)
+
+        gx0 = x_off + s * sx + hx - C0
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :, :].astype(_ACC)
+            C = blk[1:-1]
+            Xm = blk[:-2]
+            Xp = blk[2:]
+            Ym = jnp.roll(C, 1, axis=1)
+            Yp = jnp.roll(C, -1, axis=1)
+            Zm = jnp.roll(C, 1, axis=2)
+            Zp = jnp.roll(C, -1, axis=2)
+            new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
+            rows_g = (gx0 + r0
+                      + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
+            keep = yzmask & (rows_g >= 1) & (rows_g <= NX - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(CH, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :, :] = new.astype(dtype)
+                r0 += h
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, k, sx + 3 * k)
+            step_into(pp, sref, k, sx + 3 * k)
+            return 0
+
+        if m > 0:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, k, sx + 3 * k)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + sx:
+            h = min(CH, C0 + sx - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :, :] = \
+                new[:, :by, :bz].astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(keep & corebox,
+                                      jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    pp_planes = SCR if k > 1 else 2
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_slabs,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, by, bz), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((sx, by, bz), lambda s: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, Ye, Ze), dtype),
+            pltpu.VMEM((pp_planes, Ye, Ze), dtype),
+            pltpu.SemaphoreType.DMA((2, 5)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off):
+        offs = jnp.stack([jnp.int32(x_off), jnp.int32(y_off),
+                          jnp.int32(z_off)])
+        ops = [u]
+        if has_z:
+            ops.append(ztail)
+        if has_y:
+            ops.append(ytail)
+        if has_x:
+            ops += [xlo, xhi]
+        core, res = call(offs, *ops)
+        return core, res[0, 0]
+
+    fn.tail_y = tail_y
+    fn.tail_z = tail_z
+    fn.sx = sx
+    return fn
+
+
 def pick_single_3d(shape, dtype):
     """The 3D single-device kernel decision: ``(kind, pick)`` with
     kind in {"F", "D", "jnp"} — one decision site shared by

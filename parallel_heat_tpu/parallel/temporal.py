@@ -211,6 +211,67 @@ def exchange_halos_circular_3d(u, k: int, mesh_shape, axis_names,
     return u
 
 
+def exchange_halos_fused_3d(u, k: int, mesh_shape, axis_names,
+                            tail_y: int, tail_z: int):
+    """K-deep 3D exchange emitting the fused kernel-H operands
+    ``(ztail, ytail, xlo, xhi)`` — the circular layout's pieces WITHOUT
+    assembling the extended volume (see
+    ``ops.pallas_stencil._build_temporal_block_3d_fused``); entries are
+    ``None`` for unsharded axes.
+
+    Bitwise the same data as :func:`exchange_halos_circular_3d` —
+    ppermute is elementwise across devices, so each later phase's edge
+    strips are built from ``u``'s and the earlier tails' edge slices
+    instead of slicing a materialized extended block. Same six
+    ppermutes; the XLA assembly shrinks from O(Xe*Ye*Ze) to the tails
+    themselves. When z is unsharded, ``ztail`` is ``None`` (the kernel
+    treats the lane-pad region as don't-care under the frontier
+    argument) but the *sent* y/x strips still carry the zero pad so
+    their layout matches the assembled path exactly.
+    """
+    dx, dy, dz = mesh_shape
+    ax, ay, az = axis_names
+    dt = u.dtype
+    bx, by, bz = u.shape
+    ztail = None
+    if dz > 1:
+        lo = _shift_down(u[:, :, -k:], az, dz).astype(dt)
+        hi = _shift_up(u[:, :, :k], az, dz).astype(dt)
+        pad = tail_z - 2 * k
+        parts = [hi] + ([jnp.zeros((bx, by, pad), dt)] if pad
+                        else []) + [lo]
+        ztail = jnp.concatenate(parts, axis=2)
+
+    def zext(a, zt_rows):
+        if dz > 1:
+            return jnp.concatenate([a, zt_rows], axis=2)
+        if tail_z:
+            return jnp.concatenate(
+                [a, jnp.zeros(a.shape[:2] + (tail_z,), dt)], axis=2)
+        return a
+
+    ytail = None
+    if dy > 1:
+        hi_s = zext(u[:, :k, :], ztail[:, :k, :] if dz > 1 else None)
+        lo_s = zext(u[:, -k:, :], ztail[:, -k:, :] if dz > 1 else None)
+        lo_y = _shift_down(lo_s, ay, dy).astype(dt)
+        hi_y = _shift_up(hi_s, ay, dy).astype(dt)
+        pad = tail_y - 2 * k
+        parts = [hi_y] + ([jnp.zeros((bx, pad, hi_y.shape[2]), dt)]
+                          if pad else []) + [lo_y]
+        ytail = jnp.concatenate(parts, axis=1)
+    xlo = xhi = None
+    if dx > 1:
+        top = zext(u[:k], ztail[:k] if dz > 1 else None)
+        bot = zext(u[-k:], ztail[-k:] if dz > 1 else None)
+        if ytail is not None:
+            top = jnp.concatenate([top, ytail[:k]], axis=1)
+            bot = jnp.concatenate([bot, ytail[-k:]], axis=1)
+        xlo = _shift_down(bot, ax, dx).astype(dt)
+        xhi = _shift_up(top, ax, dx).astype(dt)
+    return ztail, ytail, xlo, xhi
+
+
 def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
                        cx, cy, cz, axis_names=("x", "y", "z"),
                        with_residual: bool = False):
@@ -376,10 +437,15 @@ def _pallas_round_3d(config, kw):
     halos = tuple(K if d > 1 else 0 for d in mesh_shape)
     args = (blocks, config.dtype, float(config.cx), float(config.cy),
             float(config.cz), config.shape, K, halos, axis_names)
-    built = ps._build_temporal_block_3d(*args)
+    built = ps._build_temporal_block_3d_fused(*args)
+    fused = built is not None
+    if built is None:
+        built = ps._build_temporal_block_3d(*args)
     if built is None:
         return None
-    built_plain = ps._build_temporal_block_3d(*args, with_residual=False)
+    builder = (ps._build_temporal_block_3d_fused if fused
+               else ps._build_temporal_block_3d)
+    built_plain = builder(*args, with_residual=False)
     bi = kw["block_index"]
     bx, by, bz = blocks
     hx, hy, hz = halos
@@ -391,6 +457,20 @@ def _pallas_round_3d(config, kw):
     x_off = lax.pcast(bi[0] * bx - hx, others(0), to="varying")
     y_off = lax.pcast(bi[1] * by, others(1), to="varying")
     z_off = lax.pcast(bi[2] * bz, others(2), to="varying")
+
+    if fused:
+        def fn(u, want_res):
+            ztail, ytail, xlo, xhi = exchange_halos_fused_3d(
+                u, K, mesh_shape, axis_names,
+                tail_y=built.tail_y, tail_z=built.tail_z)
+            kernel = built if want_res else built_plain
+            core, res = kernel(u, ztail, ytail, xlo, xhi,
+                               x_off, y_off, z_off)
+            if want_res:
+                return core, lax.pmax(res, axis_names)
+            return core
+
+        return fn
 
     def fn(u, want_res):
         ext = exchange_halos_circular_3d(u, K, mesh_shape, axis_names,

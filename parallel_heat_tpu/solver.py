@@ -403,7 +403,20 @@ def make_initial_grid(config: HeatConfig) -> jax.Array:
 
 def _prepare_initial(config: HeatConfig,
                      initial: Optional[jax.Array]) -> jax.Array:
-    """Default, validate, copy (runners donate their input buffer)."""
+    """Default, validate, place on the mesh, copy (runners donate
+    their input buffer).
+
+    Sharded configs ``device_put`` caller-supplied grids with the
+    target ``NamedSharding`` BEFORE any device computation: host
+    (NumPy) inputs — a gathered ``.npz`` resume, the CLI's
+    ``--resume``, any user array — transfer per-shard slices
+    (O(N²/P) per device) and are dtype-cast on the host first. The
+    naive ``jnp.asarray`` spelling would commit the FULL grid to
+    device 0 and only then reshard — a 4 GiB single-device spike at
+    32768² f32, exactly the O(N²)-per-rank quirk of the reference
+    (``mpi/...stat.c:46,72-75``, SURVEY §2d.1) this framework
+    eliminates everywhere else.
+    """
     if initial is None:
         return jax.block_until_ready(make_initial_grid(config))
     if tuple(initial.shape) != config.shape:
@@ -411,10 +424,26 @@ def _prepare_initial(config: HeatConfig,
             f"initial grid shape {tuple(initial.shape)} does not match "
             f"config shape {config.shape}"
         )
-    # Copy (the runner donates its input buffer — protect the caller)
-    # and honor the configured storage dtype (e.g. resuming an f32
-    # checkpoint into a bf16 run).
-    out = jnp.copy(jnp.asarray(initial).astype(_dtype_of(config)))
+    dtype = _dtype_of(config)
+    mesh_shape = config.mesh_or_unit()
+    if any(d > 1 for d in mesh_shape):
+        mesh = make_heat_mesh(mesh_shape)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        if not isinstance(initial, jax.Array):
+            # Cast on the host so the device never sees the off-dtype
+            # full grid (e.g. resuming an f32 checkpoint into bf16).
+            initial = np.asarray(initial, dtype=dtype)
+        # device_put redistributes whatever the input's current
+        # placement is (host slices, single-device, other mesh) into
+        # per-shard blocks; astype+copy then run sharded (the copy
+        # also protects the caller from the runner's donation —
+        # device_put alone may alias an already-correctly-placed
+        # array).
+        out = jnp.copy(jax.device_put(initial, sharding).astype(dtype))
+    else:
+        # Copy (the runner donates its input buffer — protect the
+        # caller) and honor the configured storage dtype.
+        out = jnp.copy(jnp.asarray(initial).astype(dtype))
     return jax.block_until_ready(out)
 
 
@@ -493,14 +522,18 @@ def explain(config: HeatConfig) -> dict:
                 # Mirrors temporal._pallas_round_3d's build args.
                 K = config.halo_depth
                 halos = tuple(K if d > 1 else 0 for d in mesh_shape)
-                built = ps._build_temporal_block_3d(
-                    bx_by, dtype, cx, cy, float(config.cz), config.shape,
-                    K, halos, AXIS_NAMES[:3])
+                args3 = (bx_by, dtype, cx, cy, float(config.cz),
+                         config.shape, K, halos, AXIS_NAMES[:3])
+                built = ps._build_temporal_block_3d_fused(*args3)
+                label = "fused exchange assembly"
+                if built is None:
+                    built = ps._build_temporal_block_3d(*args3)
+                    label = "assembled layout"
                 if built is not None:
                     out["path"] = (
-                        f"kernel H (3D shard-block temporal, K={K}) per "
-                        f"exchange round, sx={built.sx}, tails="
-                        f"({built.tail_y}, {built.tail_z})")
+                        f"kernel H (3D shard-block temporal, K={K}, "
+                        f"{label}) per exchange round, sx={built.sx}, "
+                        f"tails=({built.tail_y}, {built.tail_z})")
                     return out
             out["path"] = (f"jnp K-deep temporal rounds "
                            f"(halo_depth={config.halo_depth}) on shard "

@@ -85,6 +85,115 @@ def chain_slope(step_fn, u0, reps_a: int, reps_b: int,
     return per
 
 
+def calibrated_slope(step_fn, u0, span_s: float = 0.5,
+                     batches: int = 3, max_reps: int = 3000) -> float:
+    """:func:`chain_slope` with the long endpoint sized so it holds
+    ``span_s`` seconds of REAL device work.
+
+    The failure mode this prevents (seen repeatedly on the axon
+    tunnel): a caller guesses the rep count from a single warm call,
+    whose time is dominated by the ~0.2 s dispatch+readback floor; for
+    sub-millisecond kernels the guessed span ends up a few ms of
+    device work, noise swamps the slope, and the tool prints garbage
+    rates (e.g. the same kernel reading 56 / 119 / 480 Gcells*steps/s
+    across three invocations). Calibration here is itself a slope —
+    ``(t_33 - t_1) / 32`` cancels the floor — so the final endpoint
+    really spans ``span_s`` of device time. Raises ``RuntimeError``
+    (from :func:`chain_slope`, or directly when even ``max_reps``
+    cannot fill the span) rather than returning a garbage number.
+    """
+    t1 = chain_time(step_fn, u0, 1)
+    t33 = chain_time(step_fn, u0, 33)
+    per_est = (t33 - t1) / 32
+    if per_est <= 0:
+        per_est = span_s / max_reps  # fall through to the reps cap
+    reps_b = 1 + max(32, int(span_s / per_est))
+    if reps_b > max_reps:
+        # Tolerate a modest shortfall (clock drift makes per_est fuzzy
+        # anyway); a span under ~60% of the requested device work is
+        # the garbage-rate regime this function exists to refuse.
+        if max_reps * per_est < 0.6 * span_s:
+            raise RuntimeError(
+                f"per-call compute ~{per_est*1e6:.1f} us: even "
+                f"{max_reps} reps span <{0.6 * span_s:.2f} s of device "
+                f"work; raise max_reps or use a larger problem")
+        reps_b = max_reps
+    return chain_slope(step_fn, u0, 1, reps_b, batches=batches)
+
+
+def calibrated_slope_paired(named_fns, u0, span_s: float = 0.5,
+                            batches: int = 3, max_reps: int = 3000):
+    """Paired :func:`calibrated_slope` over several step fns.
+
+    Device clock state drifts on tens-of-seconds scales (the same
+    kernel read 86 and 123 Gcells*steps/s in back-to-back invocations
+    while its competitor held steady), so sequential per-variant
+    timing can misrank variants. Here every batch interleaves ALL
+    variants' endpoint measurements, so drift lands on each variant
+    alike and the min-of-raw-endpoints slope compares like with like.
+    Returns ``{name: seconds per call}``; a variant whose slope comes
+    out non-positive maps to ``None`` (surface it, don't guess).
+    """
+    reps = {}
+    for name, fn in named_fns.items():
+        t1 = chain_time(fn, u0, 1)
+        t33 = chain_time(fn, u0, 33)
+        per_est = (t33 - t1) / 32
+        if per_est <= 0:
+            per_est = span_s / max_reps
+        reps[name] = min(1 + max(32, int(span_s / per_est)), max_reps)
+    t_a = {n: [] for n in named_fns}
+    t_b = {n: [] for n in named_fns}
+    for _ in range(batches):
+        for name, fn in named_fns.items():
+            t_a[name].append(chain_time(fn, u0, 1))
+            t_b[name].append(chain_time(fn, u0, reps[name]))
+    out = {}
+    for name in named_fns:
+        per = (min(t_b[name]) - min(t_a[name])) / (reps[name] - 1)
+        out[name] = per if per > 0 else None
+    return out
+
+
+def bench_rounds_paired(named_fns, u0, steps_per_call, span_s: float = 0.5,
+                        batches: int = 3):
+    """Jit, warm, and time a set of round fns with
+    :func:`calibrated_slope_paired`; print one line per variant and
+    return ``{name: Gcells*steps/s}``.
+
+    The shared driver of the A/B tools (``tools/ab_fused_g.py`` /
+    ``ab_fused_h.py``): a variant that fails to compile prints FAILED
+    and is excluded; a variant whose slope is noise prints so rather
+    than reporting a garbage rate. ``steps_per_call[name]`` is how many
+    stencil steps one call advances (K for temporal rounds).
+    """
+    import math
+
+    runs = {}
+    for name, fn in named_fns.items():
+        run = jax.jit(fn)
+        try:
+            sync(run(u0))
+        except Exception as e:  # noqa: BLE001 — surface, don't crash the A/B
+            print(f"{name:26s}: FAILED {type(e).__name__}: {e}")
+            continue
+        runs[name] = run
+    pers = calibrated_slope_paired(runs, u0, span_s=span_s,
+                                   batches=batches)
+    cells = math.prod(u0.shape)
+    out = {}
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:26s}: noisy (non-positive slope)")
+            continue
+        k = steps_per_call[name]
+        g = cells * k / per / 1e9
+        print(f"{name:26s}: {per*1e3:8.2f} ms/call {per/k*1e6:9.1f} "
+              f"us/step {g:7.1f} Gcells*steps/s")
+        out[name] = g
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """``jax.profiler`` trace context; view with TensorBoard/XProf.

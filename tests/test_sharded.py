@@ -68,6 +68,59 @@ def test_sharded_result_is_actually_sharded():
     assert len(res.grid.sharding.device_set) == 8
 
 
+def test_prepare_initial_host_grid_lands_sharded():
+    # A caller-supplied HOST grid (gathered-.npz resume, any NumPy
+    # array) must be placed with the mesh's NamedSharding before the
+    # run — per-shard slices, never a full-grid single-device commit
+    # (the reference's O(N^2)-per-rank quirk, SURVEY §2d.1).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+    from parallel_heat_tpu.solver import _prepare_initial
+
+    cfg = HeatConfig(nx=32, ny=16, mesh_shape=(2, 4), steps=5)
+    host = np.asarray(make_initial_grid(HeatConfig(nx=32, ny=16)))
+    prepared = _prepare_initial(cfg, host)
+    mesh = make_heat_mesh((2, 4))
+    want = NamedSharding(mesh, P(*mesh.axis_names))
+    assert prepared.sharding == want
+    # No device holds more than its block.
+    for s in prepared.addressable_shards:
+        assert s.data.shape == (16, 4)
+    # An f64 host grid resuming into a bf16 run is cast without a
+    # device-side full-grid commit and still lands sharded.
+    prepared16 = _prepare_initial(cfg.replace(dtype="bfloat16"),
+                                  host.astype(np.float64))
+    assert prepared16.dtype == np.dtype("bfloat16")
+    assert prepared16.sharding == want
+    # And the solve from a host initial equals the solve from the
+    # born-sharded initial, bitwise.
+    a = solve(cfg, initial=host).to_numpy()
+    b = solve(cfg).to_numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_prepare_initial_reshards_device_array():
+    # A single-device (or differently-sharded) jax.Array initial is
+    # redistributed to the mesh sharding; donation safety: the
+    # caller's array survives the solve.
+    import jax
+
+    from parallel_heat_tpu.solver import _prepare_initial
+
+    cfg = HeatConfig(nx=16, ny=16, mesh_shape=(2, 2), steps=3)
+    single = make_initial_grid(HeatConfig(nx=16, ny=16))
+    prepared = _prepare_initial(cfg, single)
+    assert len(prepared.sharding.device_set) == 4
+    res = solve(cfg, initial=single)
+    # the caller's buffer was not donated away
+    np.testing.assert_array_equal(np.asarray(single),
+                                  np.asarray(make_initial_grid(
+                                      HeatConfig(nx=16, ny=16))))
+    np.testing.assert_array_equal(res.to_numpy(),
+                                  solve(cfg).to_numpy())
+
+
 @pytest.mark.parametrize("mesh", [(2, 2)])
 def test_nonsquare_blocks(mesh):
     want = _single(12, 36, steps=17).to_numpy()

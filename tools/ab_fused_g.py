@@ -25,28 +25,7 @@ import jax.numpy as jnp
 from parallel_heat_tpu.models import HeatPlate2D
 from parallel_heat_tpu.ops import pallas_stencil as ps
 from parallel_heat_tpu.parallel import temporal as tp
-from parallel_heat_tpu.utils.profiling import chain_slope, chain_time, sync
-
-
-def bench_round(name, round_fn, u0, k, budget_s=6.0):
-    run = jax.jit(round_fn)
-    try:
-        sync(run(u0))
-    except Exception as e:
-        print(f"{name:26s}: FAILED {type(e).__name__}: {e}")
-        return None
-    t1 = chain_time(run, u0, 1)
-    r2 = 1 + max(2, min(120, int(budget_s / 3 / max(t1 - 0.15, 1e-3))))
-    try:
-        per = chain_slope(run, u0, 1, r2, batches=3) / k
-    except RuntimeError as e:
-        print(f"{name:26s}: noisy ({e})")
-        return None
-    cells = u0.shape[0] * u0.shape[1]
-    g = cells / per / 1e9
-    print(f"{name:26s}: {per*1e6:9.1f} us/step {g:7.1f} Gcells*steps/s "
-          f"(reps {r2 - 1})")
-    return g
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
 
 
 def main():
@@ -68,6 +47,8 @@ def main():
     print(f"block {M}x{N} {dts} K={k}  (zero halos, full jitted round)")
     u0 = jax.block_until_ready(HeatPlate2D(M, N).init_grid(dt))
 
+    rounds = {}
+    steps_per_call = {}
     fused = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
                                            with_residual=False)
     circ = ps._build_temporal_block_circular(gs, dts, 0.1, 0.1, gs, k,
@@ -77,7 +58,7 @@ def main():
             t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
                                                    tail=fused.tail)
             return fused(u, t, hn, hs, 0, 0)[0]
-        bench_round("G-fuse (fused assembly)", round_fused, u0, k)
+        rounds["G-fuse (fused assembly)"] = round_fused
     else:
         print("G-fuse: builder declined")
     if circ is not None:
@@ -85,7 +66,7 @@ def main():
             ext = tp.exchange_halos_circular_2d(u, k, mesh_shape, ax,
                                                 tail=circ.tail)
             return circ(ext, 0, 0)[0]
-        bench_round("G-circ (assembled)", round_circ, u0, k)
+        rounds["G-circ (assembled)"] = round_circ
     else:
         print("G-circ: builder declined")
     if not args.skip_legacy:
@@ -98,13 +79,14 @@ def main():
                 ext = tp.exchange_halos_deep_2d(u, k, mesh_shape, ax,
                                                 pad_cols=pad)
                 return leg(ext, 0, -k)[0][:, k:k + N]
-            bench_round("G (legacy padded)", round_leg, u0, k)
+            rounds["G (legacy padded)"] = round_leg
 
     # Ceiling: kernel E on the same volume, no exchange at all.
     fnE = ps._build_temporal_strip(gs, dts, 0.1, 0.1, k,
                                    with_residual=False)
     if fnE is not None:
-        bench_round("E (ceiling, no exchange)", lambda u: fnE(u)[0], u0, k)
+        rounds["E (ceiling, no exchange)"] = lambda u: fnE(u)[0]
+    bench_rounds_paired(rounds, u0, {name: k for name in rounds})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Batched A/B: the kernel-H round with fused exchange assembly vs the
+assembled circular layout, on hardware.
+
+Protocol matches REPORT §4c's 62.3 measurement: one device, the full
+jitted round including the exchange-shaped assembly, zeros standing in
+for the ppermuted faces/tails, ``chain_slope(batches=3)``. Kernel F on
+the same volume is printed as the no-exchange ceiling.
+
+Run: python tools/ab_fused_h.py [--shape 256,256,256] [--k 4]
+     [--halos 4,4,4] [--dtype float32]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate3D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", default="256,256,256")
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--halos", default="4,4,4")
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.shape.split(","))
+    halos = tuple(int(s) for s in args.halos.split(","))
+    k = args.k
+    dts = args.dtype
+    dt = jnp.dtype(dts)
+    X, Y, Z = shape
+    hx, hy, hz = halos
+    print(f"block {X}x{Y}x{Z} {dts} K={k} halos={halos} "
+          f"(zero faces, full jitted round)")
+    u0 = jax.block_until_ready(HeatPlate3D(X, Y, Z).init_grid(dt))
+
+    fused = ps._build_temporal_block_3d_fused(shape, dts, 0.1, 0.1, 0.1,
+                                              shape, k, halos,
+                                              with_residual=False)
+    asm = ps._build_temporal_block_3d(shape, dts, 0.1, 0.1, 0.1, shape,
+                                      k, halos, with_residual=False)
+    rounds = {}
+    steps_per_call = {}
+    if fused is not None:
+        Ye, Ze = Y + fused.tail_y, Z + fused.tail_z
+
+        def round_fused(u):
+            d = u.dtype
+            ztail = jnp.zeros((X, Y, fused.tail_z), d) if hz else None
+            ytail = jnp.zeros((X, fused.tail_y, Ze), d) if hy else None
+            xslab = jnp.zeros((k, Ye, Ze), d) if hx else None
+            return fused(u, ztail, ytail, xslab, xslab, -hx, 0, 0)[0]
+        print(f"  sx={fused.sx}")
+        rounds["H-fuse (fused assembly)"] = round_fused
+        steps_per_call["H-fuse (fused assembly)"] = k
+    else:
+        print("H-fuse: builder declined")
+    if asm is not None:
+        def round_asm(u):
+            ext = jnp.zeros((X + 2 * hx, Y + asm.tail_y, Z + asm.tail_z),
+                            u.dtype)
+            ext = ext.at[hx:hx + X, :Y, :Z].set(u)
+            return asm(ext, -hx, 0, 0)[0]
+        rounds["H (assembled)"] = round_asm
+        steps_per_call["H (assembled)"] = k
+    else:
+        print("H: builder declined")
+
+    # Ceiling: kernel F (single-grid X-slab temporal) on the same
+    # volume, no exchange at all (needs a k the picker accepts).
+    pickF = ps._pick_xslab_3d(shape, dt)
+    if pickF is not None:
+        sxF, kF = pickF
+        fnF = ps._build_xslab_3d(shape, dts, 0.1, 0.1, 0.1, sxF, kF,
+                                 with_residual=False)
+        if fnF is not None:
+            name = f"F (ceiling, K={kF})"
+            rounds[name] = lambda u: fnF(u)[0]
+            steps_per_call[name] = kF
+    bench_rounds_paired(rounds, u0, steps_per_call)
+
+
+if __name__ == "__main__":
+    main()

@@ -112,6 +112,39 @@ def _drive_kernel_h(shape, dt, k, halos, cx=0.1, cy=0.1, cz=0.1, steps=1):
     return np.asarray(u)
 
 
+def _drive_kernel_h_fused(shape, dt, k, halos, cx=0.1, cy=0.1, cz=0.1,
+                          steps=1):
+    """Fused-assembly analog of :func:`_drive_kernel_h`: zero tails and
+    x-slabs stand in for the ppermuted pieces."""
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu.models import HeatPlate3D
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    X, Y, Z = shape
+    hx, hy, hz = halos
+    fn = ps._build_temporal_block_3d_fused(shape, dt, cx, cy, cz, shape,
+                                           k, halos)
+    if fn is None:
+        return None
+    u = HeatPlate3D(X, Y, Z).init_grid(jnp.dtype(dt))
+    Ye, Ze = Y + fn.tail_y, Z + fn.tail_z
+
+    def round_k(u):
+        d = u.dtype
+        ztail = jnp.zeros((X, Y, fn.tail_z), d) if hz else None
+        ytail = jnp.zeros((X, fn.tail_y, Ze), d) if hy else None
+        xslab = jnp.zeros((k, Ye, Ze), d) if hx else None
+        core, _ = fn(u, ztail, ytail, xslab, xslab, -hx, 0, 0)
+        return core
+
+    round_k = jax.jit(round_k)
+    for _ in range(steps):
+        u = round_k(u)
+    return np.asarray(u)
+
+
 def kernel_h_checks():
     import jax.numpy as jnp
 
@@ -135,17 +168,25 @@ def kernel_h_checks():
         for _ in range(k):
             v = factored_step_3d(v, 0.1, 0.1, 0.1)
         check(name, np.array_equal(got, np.asarray(v)))
+        gotf = _drive_kernel_h_fused(shape, dt, k, halos)
+        namef = name.replace("kernel H", "kernel H-fuse")
+        if gotf is None:
+            check(namef, False, "builder declined")
+            continue
+        check(namef, np.array_equal(gotf, np.asarray(v)))
 
     # diverging run: boundary faces must stay bitwise exact
     shape = (128, 128, 256)
     ini = np.asarray(HeatPlate3D(*shape).init_grid(jnp.float32))
-    out = _drive_kernel_h(shape, "float32", 4, (4, 4, 4),
-                          cx=0.9, cy=0.9, cz=0.9, steps=12)
-    ok = (not np.all(np.isfinite(out))) and all(
-        np.array_equal(out[sl], ini[sl])
-        for sl in [np.s_[0], np.s_[-1], np.s_[:, 0], np.s_[:, -1],
-                   np.s_[:, :, 0], np.s_[:, :, -1]])
-    check("kernel H diverged + boundary exact", ok)
+    for tag, drive in [("H", _drive_kernel_h),
+                       ("H-fuse", _drive_kernel_h_fused)]:
+        out = drive(shape, "float32", 4, (4, 4, 4),
+                    cx=0.9, cy=0.9, cz=0.9, steps=12)
+        ok = (not np.all(np.isfinite(out))) and all(
+            np.array_equal(out[sl], ini[sl])
+            for sl in [np.s_[0], np.s_[-1], np.s_[:, 0], np.s_[:, -1],
+                       np.s_[:, :, 0], np.s_[:, :, -1]])
+        check(f"kernel {tag} diverged + boundary exact", ok)
 
 
 def kernel_bitwise_checks():
