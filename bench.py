@@ -202,8 +202,10 @@ def main(argv=None):
                 print(json.dumps(out), file=sys.stderr)
 
     out_full = args.out_full
+    defaulted = False
     if out_full is None and not args.headline_only:
         out_full = "bench_full.json"
+        defaulted = True
     if out_full:
         # The corroborating artifact: every BASELINE config's measured
         # row (headline included) in one machine-readable file, written
@@ -212,9 +214,27 @@ def main(argv=None):
 
         import jax
 
+        device = str(getattr(jax.devices()[0], "device_kind",
+                             jax.devices()[0].platform))
+        if defaulted and os.path.exists(out_full):
+            # Clobber guard: a default run on a different device (e.g.
+            # CPU) must not silently overwrite a committed measured-TPU
+            # table. An explicit --out-full always wins.
+            try:
+                with open(out_full) as f:
+                    prev = json.load(f)
+                prev_device = (prev.get("device")
+                               if isinstance(prev, dict) else None)
+            except (OSError, ValueError):
+                prev_device = None
+            if prev_device is not None and prev_device != device:
+                print(f"refusing to overwrite {out_full}: it records "
+                      f"device {prev_device!r}, this run is on "
+                      f"{device!r} (pass --out-full to force)",
+                      file=sys.stderr)
+                return
         doc = {
-            "device": str(getattr(jax.devices()[0], "device_kind",
-                                  jax.devices()[0].platform)),
+            "device": device,
             "backend_arg": args.backend,
             "baseline_mcells_per_s": BASELINE_MCELLS_PER_S,
             "rows": rows,

@@ -1210,7 +1210,7 @@ def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
                                 grid_shape, k, vma=None,
-                                with_residual=True):
+                                with_residual=True, defer_ns=False):
     """Kernel G, fused-assembly variant: the exchange pieces arrive as
     SEPARATE operands and the DMA pipeline gathers them —
     ``fn(u, tail, halo_n, halo_s, row_off, col_off) ->
@@ -1249,6 +1249,19 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
     circular builder's (``col_off`` = global column of u's column 0;
     the re-pin reads ``u`` directly). ``fn.tail`` exposes the tail
     width the exchange must build.
+
+    ``defer_ns=True`` builds the comm/compute-overlap variant: the
+    row-halo operands are dropped entirely — ``fn(u, tail, row_off,
+    col_off)`` — so the call has NO data dependency on the second
+    (x-direction) ppermute phase and XLA's latency-hiding scheduler
+    may overlap that collective hop with this kernel (the reference's
+    interior-between-Startall-and-Waitall structure at depth K,
+    ``mpi/...stat.c:160-177``). The scratch rows the halos would fill
+    hold garbage; by the frontier argument it reaches only the first/
+    last K output rows — the N/S bands — which the caller overwrites
+    with :func:`_build_band_fix_2d`'s output. The residual excludes
+    those band rows (the band kernel accounts for them), keeping
+    max(res_A, res_B) bitwise equal to the monolithic residual.
     """
     bx, by = block_shape
     NX, NY = grid_shape
@@ -1270,8 +1283,14 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
     W = T + 2 * SUB
     C0 = SUB
 
-    def kernel(offs_ref, u_hbm, tail_hbm, hn_hbm, hs_hbm,
-               out_ref, res_ref, slots, pp, sems):
+    def kernel(offs_ref, *refs):
+        if defer_ns:
+            u_hbm, tail_hbm = refs[:2]
+            hn_hbm = hs_hbm = None
+            out_ref, res_ref, slots, pp, sems = refs[2:]
+        else:
+            u_hbm, tail_hbm, hn_hbm, hs_hbm = refs[:4]
+            out_ref, res_ref, slots, pp, sems = refs[4:]
         s = pl.program_id(0)
         n = pl.num_programs(0)
         row_off = offs_ref[0]
@@ -1321,22 +1340,25 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
             if n_strips == 1:
                 go(u_copy(0, bx, k))
                 go(t_copy(0, bx, k))
-                go(hn_copy())
-                go(hs_copy())
+                if not defer_ns:
+                    go(hn_copy())
+                    go(hs_copy())
                 return
 
             @pl.when(strip == 0)
             def _():
                 go(u_copy(0, T + k, k))
                 go(t_copy(0, T + k, k))
-                go(hn_copy())
+                if not defer_ns:
+                    go(hn_copy())
 
             @pl.when(strip == n_strips - 1)
             def _():
                 s0 = (n_strips - 1) * T - k
                 go(u_copy(s0, T + k, 0))
                 go(t_copy(s0, T + k, 0))
-                go(hs_copy())
+                if not defer_ns:
+                    go(hs_copy())
 
             if n_strips > 2:
                 @pl.when((strip > 0) & (strip < n_strips - 1))
@@ -1387,9 +1409,16 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
             new, C = chunk_new(src, r0, h)
             out_ref[r0 - C0:r0 - C0 + h, :] = new[:, :by].astype(dtype)
             if with_residual:
+                keep = corecols
+                if defer_ns:
+                    # N/S band rows carry garbage here (no halo
+                    # operands); the band kernel owns their residual.
+                    rows_l = (s * T + (r0 - C0)
+                              + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+                    keep = keep & (rows_l >= k) & (rows_l < bx - k)
                 r_acc = jnp.maximum(
                     r_acc,
-                    jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0)))
+                    jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
             r0 += h
 
         @pl.when(s == 0)
@@ -1401,17 +1430,13 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
             def _():
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
+    n_ops = 2 if defer_ns else 4
     kw = {} if vma is None else {"vma": frozenset(vma)}
     call = pl.pallas_call(
         kernel,
         grid=(n_strips,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
         out_shape=(
             jax.ShapeDtypeStruct((bx, by), dtype, **kw),
             jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
@@ -1431,12 +1456,12 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
         compiler_params=_compiler_params(),
     )
 
-    def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
-        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
-        core, res = call(offs, u, tail_arr, halo_n, halo_s)
+    def finish(u, core, res, row_off, col_off):
         # Diverging-run guard (same as the circular builder): re-pin
         # global Dirichlet cells from the input block — the
         # multiplicative pinning's 0*inf would otherwise leak NaN.
+        # In defer_ns mode the N/S rows are skipped: the band kernel
+        # overwrites them (with its own pinning) either way.
         ro = jnp.int32(row_off)
         co = jnp.int32(col_off)
 
@@ -1446,14 +1471,238 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
         def fix_col(cr, j, pred):
             return cr.at[:, j].set(jnp.where(pred, u[:, j], cr[:, j]))
 
-        core = fix_row(core, 0, ro == 0)
-        core = fix_row(core, bx - 1, ro + bx == NX)
+        if not defer_ns:
+            core = fix_row(core, 0, ro == 0)
+            core = fix_row(core, bx - 1, ro + bx == NX)
         core = fix_col(core, 0, co == 0)
         core = fix_col(core, by - 1, co + by == NY)
         return core, res[0, 0]
 
+    if defer_ns:
+        def fn(u, tail_arr, row_off, col_off):
+            offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+            core, res = call(offs, u, tail_arr)
+            return finish(u, core, res, row_off, col_off)
+    else:
+        def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
+            offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+            core, res = call(offs, u, tail_arr, halo_n, halo_s)
+            return finish(u, core, res, row_off, col_off)
+
     fn.tail = tail
     return fn
+
+
+@functools.lru_cache(maxsize=32)
+def _build_band_fix_2d(block_shape, dtype_name, cx, cy, grid_shape, k,
+                       vma=None, with_residual=True):
+    """The N/S band pass of the overlapped kernel-G round —
+    ``fn(u, tail, halo_n, halo_s, row_off, col_off) ->
+    ((2k, by) bands, residual)``.
+
+    Computes the K-step values of the first and last k rows of the
+    block — the only cells the deferred-halo bulk kernel
+    (:func:`_build_temporal_block_fused` with ``defer_ns=True``) gets
+    wrong — from the ppermuted row strips plus the block's own edge
+    rows. The caller splices ``bands[:k]`` / ``bands[k:]`` over the
+    bulk output (an in-place dynamic-update-slice: the bulk buffer has
+    no other consumer). Two grid steps (top, bottom), each a
+    ``(3k, Ye)`` mini-problem in the circular column layout: scratch
+    rows ``[0,k)|[k,3k)`` = halo_n | u[0,2k) for the top band and
+    u[bx-2k,bx) | halo_s at ``[0,2k)|[2k,3k)`` for the bottom; the
+    band rows sit at scratch ``[k,2k)`` in both. Per-cell K-step
+    values depend only on the L1-K cone, which the window covers with
+    the same pinned-coefficient arithmetic as the bulk kernel, so the
+    spliced result is bitwise the monolithic round's (pinned by CPU
+    tests and the hardware battery). The zeroed ping-pong edge rows
+    are the usual frontier argument: their influence reaches scratch
+    rows ``< k`` / ``>= 2k`` only. The residual covers exactly the
+    band rows (within core columns) — the bulk kernel's complement.
+
+    Volume: ``2k`` of ``bx`` rows — <1% of the block at production
+    sizes. The point is not this kernel's speed but that the bulk
+    kernel above it no longer depends on the second ppermute phase.
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    if k != SUB or bx < 2 * k:
+        return None
+    if _needs_lane_alignment():
+        if by % _LANE != 0:
+            return None
+        tail = ((2 * k + _LANE - 1) // _LANE) * _LANE
+    else:
+        tail = 2 * k
+    Ye = by + tail
+    SC = 3 * k
+
+    def kernel(offs_ref, u_hbm, tail_hbm, hn_hbm, hs_hbm,
+               out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+
+        cols_l = lax.broadcasted_iota(jnp.int32, (1, Ye), 1)
+        cols_g = col_off + jnp.where(cols_l >= Ye - k, cols_l - Ye,
+                                     cols_l)
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        corecols = cols_l < by
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+
+        def issue(slot, band, start):
+            def go(c):
+                c.start() if start else c.wait()
+
+            def u_copy(src0, rows, dst0):
+                return pltpu.make_async_copy(
+                    u_hbm.at[pl.ds(src0, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(0, by)],
+                    sems.at[slot, 0])
+
+            def t_copy(src0, rows, dst0):
+                return pltpu.make_async_copy(
+                    tail_hbm.at[pl.ds(src0, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(by, tail)],
+                    sems.at[slot, 1])
+
+            def h_copy(src, dst0):
+                return pltpu.make_async_copy(
+                    src.at[:, :], slots.at[slot, pl.ds(dst0, k), :],
+                    sems.at[slot, 2])
+
+            @pl.when(band == 0)
+            def _():
+                go(h_copy(hn_hbm, 0))
+                go(u_copy(0, 2 * k, k))
+                go(t_copy(0, 2 * k, k))
+
+            @pl.when(band == 1)
+            def _():
+                go(u_copy(bx - 2 * k, 2 * k, 0))
+                go(t_copy(bx - 2 * k, 2 * k, 0))
+                go(h_copy(hs_hbm, 2 * k))
+
+        @pl.when(s == 0)
+        def _():
+            issue(0, 0, True)
+            issue(1, 1, True)
+            pp[0:1, :] = jnp.zeros((1, Ye), dtype)
+            pp[SC - 1:SC, :] = jnp.zeros((1, Ye), dtype)
+
+        issue(s, s, False)
+
+        # Global row of scratch row k (= band row 0): u row 0 for the
+        # top band, u row bx-k for the bottom.
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, row_off + s * (bx - k), k, NX, dtype)
+
+        m = k - 1
+        sref = slots.at[s]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, 1, SC - 1)
+            step_into(pp, sref, 1, SC - 1)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, 1, SC - 1)
+            src = pp
+
+        new, C = chunk_new(src, k, k)
+        out_ref[:] = new[:, :by].astype(dtype)
+        if with_residual:
+            r_acc = jnp.max(jnp.where(corecols, jnp.abs(new - C), 0.0))
+
+            @pl.when(s == 0)
+            def _():
+                res_ref[0, 0] = r_acc
+
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+        else:
+            @pl.when(s == 0)
+            def _():
+                res_ref[0, 0] = jnp.float32(0.0)
+
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_shape=(
+            jax.ShapeDtypeStruct((2 * k, by), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((k, by), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SC, Ye), dtype),
+            pltpu.VMEM((SC, Ye), dtype),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
+        offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+        bands, res = call(offs, u, tail_arr, halo_n, halo_s)
+        # Diverging-run guard, band edition: re-pin global Dirichlet
+        # cells from the block's own edge rows/columns.
+        ro = jnp.int32(row_off)
+        co = jnp.int32(col_off)
+        ub = jnp.concatenate([u[:k, :], u[bx - k:, :]], axis=0)
+        bands = bands.at[0, :].set(
+            jnp.where(ro == 0, u[0, :], bands[0, :]))
+        bands = bands.at[2 * k - 1, :].set(
+            jnp.where(ro + bx == NX, u[bx - 1, :], bands[2 * k - 1, :]))
+        bands = bands.at[:, 0].set(
+            jnp.where(co == 0, ub[:, 0], bands[:, 0]))
+        bands = bands.at[:, by - 1].set(
+            jnp.where(co + by == NY, ub[:, by - 1], bands[:, by - 1]))
+        return bands, res[0, 0]
+
+    fn.tail = tail
+    return fn
+
+
+def pick_block_temporal_2d_deferred(config, axis_names):
+    """The overlapped 2D round's kernel pair: ``(bulk_res, bulk_plain,
+    band_res, band_plain)`` or ``None``.
+
+    Available exactly when the fused monolithic kernel is AND the
+    block holds two disjoint k-bands (``bx >= 2k``). Shares the
+    builders' lru_cache with ``temporal._pallas_round_2d`` (execution)
+    and ``solver.explain`` (reporting).
+    """
+    if config.ndim != 2:
+        return None
+    K = config.halo_depth
+    if K != _sub_rows(config.dtype):
+        return None
+    args = (config.block_shape(), config.dtype, float(config.cx),
+            float(config.cy), config.shape, K, tuple(axis_names))
+    band = _build_band_fix_2d(*args)
+    if band is None:
+        return None
+    bulk = _build_temporal_block_fused(*args, defer_ns=True)
+    if bulk is None:
+        return None
+    return (bulk, _build_temporal_block_fused(*args, defer_ns=True,
+                                              with_residual=False),
+            band, _build_band_fix_2d(*args, with_residual=False))
 
 
 def pick_block_temporal_2d(config, axis_names):

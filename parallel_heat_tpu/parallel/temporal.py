@@ -375,6 +375,37 @@ def _pallas_round_2d(config, kw):
                             to="varying")
 
         if kind == "G-fuse":
+            deferred = ps.pick_block_temporal_2d_deferred(config,
+                                                          axis_names)
+            if deferred is not None:
+                # Overlapped round (the reference's interior-between-
+                # Startall-and-Waitall at depth K): the bulk kernel
+                # consumes only u and the phase-1 column tail, so the
+                # phase-2 (row strip) ppermutes have no path into it
+                # and XLA may run that collective hop concurrently
+                # with the bulk compute; the tiny band kernel then
+                # consumes the strips and its k-row outputs splice in
+                # place (DUS on a dead buffer). Bitwise equal to the
+                # monolithic round — pinned by tests.
+                bulk, bulk_plain, band, band_plain = deferred
+
+                def fn(u, want_res):
+                    tail_arr, halo_n, halo_s = exchange_halos_fused_2d(
+                        u, K, mesh_shape, axis_names, tail=built.tail)
+                    bk = bulk if want_res else bulk_plain
+                    bd = band if want_res else band_plain
+                    core, res_a = bk(u, tail_arr, row_off, col_off)
+                    bands, res_b = bd(u, tail_arr, halo_n, halo_s,
+                                      row_off, col_off)
+                    core = (core.at[:K].set(bands[:K])
+                            .at[bx - K:].set(bands[K:]))
+                    if want_res:
+                        return core, lax.pmax(
+                            jnp.maximum(res_a, res_b), axis_names)
+                    return core
+
+                return fn
+
             def fn(u, want_res):
                 tail_arr, halo_n, halo_s = exchange_halos_fused_2d(
                     u, K, mesh_shape, axis_names, tail=built.tail)

@@ -501,10 +501,14 @@ def explain(config: HeatConfig) -> dict:
                 kind, built, _ = ps.pick_block_temporal_2d(
                     config, AXIS_NAMES[:2])
                 if kind == "G-fuse":
+                    overl = ps.pick_block_temporal_2d_deferred(
+                        config, AXIS_NAMES[:2]) is not None
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}, "
-                        f"fused exchange assembly) per exchange round, "
-                        f"tail {built.tail}")
+                        f"fused exchange assembly"
+                        + (", deferred N/S bands — phase-2 ppermutes "
+                           "overlap the bulk kernel" if overl else "")
+                        + f") per exchange round, tail {built.tail}")
                     return out
                 if kind == "G-circ":
                     out["path"] = (

@@ -167,8 +167,13 @@ def _save_sharded(path, grid, step: int, config: HeatConfig,
     shards = sorted(grid.addressable_shards, key=lambda s: s.device.id)
     # The generation id must agree across processes without
     # communication; the step count (monotone within a run) is exactly
-    # that. A re-save of the same step overwrites file-atomically.
-    gen = f"s{int(step):012d}"
+    # that, with the process count folded in so a re-save of the same
+    # step from a different topology cannot leave stale shard files
+    # (e.g. higher p-indices from a larger earlier run) matching the
+    # live generation's pattern — they get pruned as a foreign
+    # generation instead. A same-step same-topology re-save still
+    # overwrites file-atomically.
+    gen = f"s{int(step):012d}c{jax.process_count():04d}"
     fname = f"shards_{gen}_p{proc:05d}.npz"
     # Leading dot: temp names must never match the shard-file pattern a
     # loader or pruner scans for (a crash can orphan them).
@@ -298,26 +303,45 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
         proc = jax.process_index()
         fname = os.path.join(d, f"shards_{gen}_p{proc:05d}.npz")
         arrays = []
-        with np.load(fname) as z:
-            for dev, idx in index_map.items():
-                if dev.process_index != proc:
-                    continue
-                key = f"d{dev.id}"
-                info = man["devices"].get(str(dev.id))
-                want = [[sl.start or 0,
-                         sl.stop if sl.stop is not None else n]
-                        for sl, n in zip(idx, shape)]
-                if key not in z or info is None or info["index"] != want:
-                    # Device numbering or the device->block assignment
-                    # moved between runs (topology-aware mesh reorder, a
-                    # different host layout, an explicit devices= mesh at
-                    # save time): reassembling by id would place blocks
-                    # at the wrong coordinates — fall back to host
-                    # assembly, which trusts only the manifest's indices.
-                    arrays = None
-                    break
-                arrays.append(jax.device_put(z[key], dev))
-        if arrays is not None:
+        try:
+            with np.load(fname) as z:
+                for dev, idx in index_map.items():
+                    if dev.process_index != proc:
+                        continue
+                    key = f"d{dev.id}"
+                    info = man["devices"].get(str(dev.id))
+                    want = [[sl.start or 0,
+                             sl.stop if sl.stop is not None else n]
+                            for sl, n in zip(idx, shape)]
+                    if (key not in z or info is None
+                            or info["index"] != want):
+                        # Device numbering or the device->block
+                        # assignment moved between runs (topology-aware
+                        # mesh reorder, a different host layout, an
+                        # explicit devices= mesh at save time):
+                        # reassembling by id would place blocks at the
+                        # wrong coordinates — fall back to host
+                        # assembly, which trusts only the manifest's
+                        # indices.
+                        arrays = None
+                        break
+                    arrays.append(jax.device_put(z[key], dev))
+        except OSError:
+            # A missing/unreadable per-process shard file is a
+            # topology mismatch in disguise (e.g. this process index
+            # had no shard in the saved run), not a crash.
+            arrays = None
+        ok = arrays is not None
+        if jax.process_count() > 1:  # pragma: no cover (multi-host)
+            # The fast-path-vs-fall-back decision must be COLLECTIVE:
+            # if some processes assembled their shards while others
+            # hit an index mismatch, the mixed control flow would hang
+            # at the next sync instead of failing cleanly.
+            from jax.experimental import multihost_utils
+
+            ok = bool(multihost_utils.process_allgather(
+                np.array([ok])).all())
+        if ok:
             grid = jax.make_array_from_single_device_arrays(
                 shape, sharding, arrays)
             return grid, step, saved
@@ -325,8 +349,9 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
     if jax.process_count() > 1:  # pragma: no cover
         raise ValueError(
             f"cannot resume sharded checkpoint {d}: saved topology "
-            f"(mesh {mesh_shape}, {man['process_count']} processes) "
-            f"does not match the current one")
+            f"(mesh {mesh_shape}, {man['process_count']} processes, "
+            f"generation {gen}) does not match the current one, or a "
+            f"per-process shard file is missing/mismatched")
     # Single-process host assembly (topology changed): read every shard
     # file and place each block into a full host grid.
     full = np.empty(shape, dtype=np.dtype(man["dtype"]))

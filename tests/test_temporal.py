@@ -280,18 +280,26 @@ def test_kernel_g_fused_matches_circular_legacy_and_jnp():
                      **kw)
     kind, _, _ = ps.pick_block_temporal_2d(cfg, AXIS_NAMES[:2])
     assert kind == "G-fuse"
-    fused = solve(cfg).to_numpy()
+    assert ps.pick_block_temporal_2d_deferred(cfg, AXIS_NAMES[:2]) \
+        is not None  # 16-row blocks host the overlapped round
+    overlapped = solve(cfg).to_numpy()
     oracle = solve(HeatConfig(backend="jnp", **kw)).to_numpy()
-    np.testing.assert_allclose(fused, oracle, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(overlapped, oracle, rtol=1e-4, atol=1e-3)
 
-    # Force the assembled circular layout, then the legacy layout, by
-    # mocking the preferred builders away and clearing the runner
-    # cache; results must match bitwise at each downgrade.
+    # Force the monolithic fused round, then the assembled circular
+    # layout, then the legacy layout, by mocking the preferred
+    # builders away and clearing the runner cache; results must match
+    # bitwise at each downgrade.
     import pytest
     from parallel_heat_tpu import solver as slv
 
     mp = pytest.MonkeyPatch()
     try:
+        mp.setattr(ps, "_build_band_fix_2d", lambda *a, **k: None)
+        slv._build_runner.cache_clear()
+        assert ps.pick_block_temporal_2d_deferred(
+            cfg, AXIS_NAMES[:2]) is None
+        fused = solve(cfg).to_numpy()
         mp.setattr(ps, "_build_temporal_block_fused",
                    lambda *a, **k: None)
         slv._build_runner.cache_clear()
@@ -307,8 +315,100 @@ def test_kernel_g_fused_matches_circular_legacy_and_jnp():
     finally:
         mp.undo()
         slv._build_runner.cache_clear()
+    np.testing.assert_array_equal(overlapped, fused)
     np.testing.assert_array_equal(fused, circ)
     np.testing.assert_array_equal(circ, legacy)
+
+
+def _flat_jaxpr_levels(jaxpr, out=None):
+    """All jaxpr levels reachable from ``jaxpr`` (params recursed)."""
+    if out is None:
+        out = []
+    out.append(jaxpr)
+    for e in jaxpr.eqns:
+        for v in e.params.values():
+            inner = getattr(v, "jaxpr", v)
+            if hasattr(inner, "eqns"):
+                _flat_jaxpr_levels(inner, out)
+    return out
+
+
+def _ancestor_eqns(jaxpr, eqn):
+    """Indices of ``jaxpr.eqns`` the given eqn transitively reads."""
+    prod = {}
+    for i, e in enumerate(jaxpr.eqns):
+        for v in e.outvars:
+            prod[v] = i
+    anc = set()
+    stack = [v for v in eqn.invars if not hasattr(v, "val")]
+    while stack:
+        v = stack.pop()
+        i = prod.get(v)
+        if i is None or i in anc:
+            continue
+        anc.add(i)
+        stack.extend(vv for vv in jaxpr.eqns[i].invars
+                     if not hasattr(vv, "val"))
+    return anc
+
+
+def test_overlap_bulk_kernel_independent_of_phase2_ppermutes():
+    # The whole point of the deferred-band round: the bulk Mosaic call
+    # must have NO data path from the second (row strip) ppermute
+    # phase, so XLA's scheduler may overlap that collective hop with
+    # the bulk compute (the reference's interior-between-Startall-and-
+    # Waitall, mpi/...stat.c:160-177). Proven on the traced program:
+    # in the shard_map body, the large pallas_call's ancestor set
+    # contains no ppermute that itself depends on another ppermute,
+    # while the band pallas_call's does.
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from parallel_heat_tpu.parallel import temporal as tp
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+    from parallel_heat_tpu.solver import _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    cfg = HeatConfig(nx=32, ny=32, steps=8, backend="pallas",
+                     mesh_shape=(2, 2), halo_depth=8)
+    mesh = make_heat_mesh((2, 2))
+    names = mesh.axis_names
+
+    def local_round(u):
+        bidx = tuple(lax.axis_index(n) for n in names)
+        kw = dict(mesh_shape=(2, 2), grid_shape=(32, 32),
+                  block_index=bidx, cx=0.1, cy=0.1, axis_names=names)
+        fn = tp._pallas_round_2d(cfg, kw)
+        assert fn is not None
+        return fn(u, False)
+
+    f = _shard_map(local_round, mesh=mesh, in_specs=P(*names),
+                   out_specs=P(*names), check_vma=False)
+    jx = jax.make_jaxpr(f)(jnp.zeros((32, 32), jnp.float32))
+    levels = [lv for lv in _flat_jaxpr_levels(jx.jaxpr)
+              if any(e.primitive.name == "ppermute" for e in lv.eqns)]
+    assert levels, "no ppermutes found in the traced round"
+    body = levels[0]
+    perms = [i for i, e in enumerate(body.eqns)
+             if e.primitive.name == "ppermute"]
+    assert len(perms) == 4  # two column shifts + two row-strip shifts
+    phase2 = {i for i in perms
+              if any(a in perms for a in _ancestor_eqns(body,
+                                                        body.eqns[i]))}
+    assert len(phase2) == 2  # the row strips depend on the tail
+    pallas = [(i, e) for i, e in enumerate(body.eqns)
+              if e.primitive.name == "pallas_call"]
+    assert len(pallas) == 2  # bulk + band
+    # The bulk call consumes (offs, u, tail); the band call also takes
+    # the two row-halo strips.
+    bulk = min(pallas, key=lambda ie: len(ie[1].invars))
+    band = max(pallas, key=lambda ie: len(ie[1].invars))
+    assert len(bulk[1].invars) == 3 and len(band[1].invars) == 5
+    assert not (phase2 & _ancestor_eqns(body, bulk[1])), \
+        "bulk kernel depends on phase-2 ppermutes: no overlap possible"
+    assert phase2 & _ancestor_eqns(body, band[1]), \
+        "band kernel should be the phase-2 consumer"
 
 
 def test_kernel_g_circular_diverging_boundary_exact():

@@ -61,6 +61,19 @@ def main():
         rounds["G-fuse (fused assembly)"] = round_fused
     else:
         print("G-fuse: builder declined")
+    defer = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
+                                           with_residual=False,
+                                           defer_ns=True)
+    bandk = ps._build_band_fix_2d(gs, dts, 0.1, 0.1, gs, k,
+                                  with_residual=False)
+    if defer is not None and bandk is not None:
+        def round_overlap(u):
+            t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
+                                                   tail=defer.tail)
+            core, _ = defer(u, t, 0, 0)
+            bands, _ = bandk(u, t, hn, hs, 0, 0)
+            return core.at[:k].set(bands[:k]).at[M - k:].set(bands[k:])
+        rounds["G-overlap (deferred bands)"] = round_overlap
     if circ is not None:
         def round_circ(u):
             ext = tp.exchange_halos_circular_2d(u, k, mesh_shape, ax,

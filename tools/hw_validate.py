@@ -249,6 +249,24 @@ def kernel_bitwise_checks():
         check(f"kernel G-fuse {M}x{N} {dt} k={k}",
               np.array_equal(coref, want))
 
+        # overlapped composition: deferred-halo bulk + N/S band splice
+        fnGd = ps._build_temporal_block_fused((M, N), dt, 0.1, 0.1,
+                                              (M, N), k, defer_ns=True)
+        fnB = ps._build_band_fix_2d((M, N), dt, 0.1, 0.1, (M, N), k)
+
+        def overlapped(uu, t, a, b):
+            core, _ = fnGd(uu, t, 0, 0)
+            bands, _ = fnB(uu, t, a, b, 0, 0)
+            return core.at[:k].set(bands[:k]).at[M - k:].set(bands[k:])
+
+        if fnGd is None or fnB is None:
+            check(f"kernel G-overlap {M}x{N} {dt} k={k}", False,
+                  "builder declined")
+            continue
+        coro = np.asarray(jax.jit(overlapped)(u, tails, hrow, hrow))
+        check(f"kernel G-overlap {M}x{N} {dt} k={k}",
+              np.array_equal(coro, want))
+
     # kernel I needs >= 2 column tiles of >= 1024 on hardware — its own
     # shapes (otherwise the check silently never runs where it matters)
     for (M, N), dt in [((1024, 2048), "float32"), ((768, 2048), "bfloat16")]:
