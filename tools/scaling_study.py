@@ -56,6 +56,11 @@ def main(argv=None):
                          "round on sharded meshes (parallel/temporal.py); "
                          "'auto' = the production default (the solver "
                          "resolves the Mosaic block kernel's depth)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write every cell (plus run metadata) to "
+                         "this JSON artifact — the per-round "
+                         "scaling_r{N}.json the REPORT tables are "
+                         "generated from")
     ap.add_argument("--cpu-devices", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (env vars are "
                          "overridden by a pinned TPU platform; this uses "
@@ -103,6 +108,7 @@ def main(argv=None):
         raise SystemExit(f"no requested mesh fits the {n_dev} visible devices")
 
     times: dict[tuple, float] = {}
+    cells = []
     for mesh in usable:
         for size in sizes:
             cfg = HeatConfig(
@@ -124,7 +130,7 @@ def main(argv=None):
             devs = _prod(mesh)
             base_devs = _prod(usable[0])
             speedup = base / best
-            print(json.dumps({
+            cell = {
                 "mesh": "x".join(map(str, mesh)), "devices": devs,
                 "size": size, "steps": res.steps_run,
                 "wall_s": round(best, 5),
@@ -133,7 +139,9 @@ def main(argv=None):
                     * res.steps_run / best / 1e6, 1),
                 "speedup": round(speedup, 3),
                 "efficiency": round(speedup / (devs / base_devs), 3),
-            }))
+            }
+            cells.append(cell)
+            print(json.dumps(cell))
             sys.stdout.flush()
 
     # Reference-style table: configs as rows, sizes as columns.
@@ -153,6 +161,23 @@ def main(argv=None):
         ratio = _prod(last) / _prod(usable[0])
         print(f"| {'efficiency':<11} | "
               + " | ".join(f"{v / ratio:>{w}.3f}" for v in sp) + " |")
+
+    if args.out:
+        doc = {
+            "ndim": args.ndim,
+            "backend_arg": args.backend,
+            "dtype": args.dtype,
+            "steps": args.steps,
+            "halo_depth": args.halo_depth,
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+            "n_devices": n_dev,
+            "cells": cells,
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
 
 
 def _prod(t):
