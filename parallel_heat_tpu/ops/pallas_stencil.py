@@ -2872,11 +2872,15 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     plane = Ye * Ze * itemsize
     plane_f32 = Ye * Ze * 4
     hw = _params()
-    # Full vmem_limit, same justification as _pick_xslab_3d: this
-    # shared cost model overcounts ~20% (measured at 512^3) — the
-    # overcount is the margin, and schedules modeled past the limit
-    # really do fail Mosaic compilation.
-    budget = hw.vmem_limit_bytes
+    # 0.92 x vmem_limit: the admission cliff was MEASURED in round 3's
+    # picker sweep at the 256^3 z-unsharded block — a schedule modeled
+    # at 117.6 MiB (sx=64, K=4) compiles and is the measured-best
+    # (123.1 Gcells*steps/s/device), while 122.3 MiB (sx=64, K=5) and
+    # above crash Mosaic compilation outright. 0.92 x 128 MiB = 117.9
+    # sits between the two measured endpoints; the earlier full-limit
+    # budget admitted known-infeasible schedules the solver would then
+    # die on at compile time.
+    budget = int(0.92 * hw.vmem_limit_bytes)
     ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")
@@ -2900,12 +2904,19 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
         # Modeled time per core cell-step: DMA reads W=sx+2k extended
         # planes and writes sx core planes per k steps of sx*by*bz core
         # cells; the VPU sweeps the (sx+2k)-plane band over full Ye*Ze
-        # planes every step.
+        # planes every step. ADDITIVE, not max: round-3 hardware sweeps
+        # fit round_time = HBM_pass + K*VPU_sweep almost exactly (256^3
+        # z-unsharded blocks: K=2 measured 0.37 ms/round, K=4 0.52 —
+        # i.e. F=0.22 ms + K*0.075 ms; the additive model predicts
+        # 95/134 Gcells*steps/s vs 91/129 measured), meaning kernel H's
+        # slab DMA is NOT hidden behind compute the way kernel E's
+        # strip DMA is. The earlier max() form mis-ranked depths by
+        # ignoring whichever term wasn't binding.
         core = sx * by * bz
         t_bw = ((sx + 2 * k) * plane + sx * by * bz * itemsize) \
             / (k * core) / hw.hbm_stream_bytes_per_s
         t_vpu = (sx + 2 * k) * Ye * Ze / core / hw.vpu_cells_per_s
-        t = max(t_bw, t_vpu)
+        t = t_bw + t_vpu
         if t < best_t:
             best_t, best = t, sx
     if best is None:
@@ -2933,15 +2944,24 @@ def _score_block_temporal_3d(block_shape, mesh_shape, dtype, k):
     hx, hy, hz = halos
     itemsize = jnp.dtype(dtype).itemsize
     hw = _params()
-    Ye, Ze, _, _ = _block_ext_geometry(block_shape, halos, dtype,
-                                       hw_align=True)
+    Ye, Ze, tail_y, tail_z = _block_ext_geometry(block_shape, halos,
+                                                 dtype, hw_align=True)
     Xe = bx + 2 * hx
     core = bx * by * bz
     bytes_round = 2 * itemsize * (hx * by * bz + hy * Xe * bz
                                   + hz * Xe * Ye)
     t_comm = (bytes_round / hw.ici_bytes_per_s
               + hw.collective_latency_s) / (k * core)
-    t_asm = ((core + Xe * Ye * Ze) * itemsize
+    # Fused-assembly pieces (round 3): the extended volume is never
+    # materialized — the XLA-level per-round traffic is the pieces
+    # themselves (z-tail, z-extended y-tail, x-edge slabs), written
+    # once by the exchange and re-read by the kernel's gather DMAs.
+    # (The pre-fusion term charged core + Xe*Ye*Ze here, which over-
+    # rewarded deep K; the measured K=3/4/5 flatness at the flagship
+    # block matches this corrected amortization.)
+    pieces = (bx * by * tail_z + bx * tail_y * Ze
+              + 2 * hx * Ye * Ze)
+    t_asm = (2 * pieces * itemsize
              / (k * core) / hw.hbm_stream_bytes_per_s)
     return t_kernel + t_comm + t_asm, sx
 
