@@ -801,6 +801,17 @@ def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     (T, n_cols) output, f32 chunk temporaries)."""
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
+    if _needs_lane_alignment() and itemsize < 4 and n_cols > 20608:
+        # Measured Mosaic register-spill cliff (round 3, v5e): the
+        # sub-f32 block temporal kernels (K = 16 sublanes in flight)
+        # compile and run at Ye = 20608 (154 Gcells*steps/s at a
+        # 4096-row block) but blow up with 82.6 MiB of register-
+        # allocator spill slots — a hard compile OOM — at Ye = 24704
+        # and 32896. f32 (K=8) is unaffected (measured fine at
+        # 32768 wide). Declining sends full-width bf16 shard blocks
+        # (the (8,1)-mesh decomposition the mesh picker never chooses
+        # for 2D) to the jnp rounds instead of a compile crash.
+        return None
     budget = _params().stream_budget_bytes
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
     best = None

@@ -267,6 +267,18 @@ def kernel_bitwise_checks():
         check(f"kernel G-overlap {M}x{N} {dt} k={k}",
               np.array_equal(coro, want))
 
+    # The sub-f32 block-temporal width guard: a 24576-wide bf16 shard
+    # block measurably spills Mosaic's register allocator (82.6 MiB of
+    # spill slots, compile OOM) — every kernel-G builder must DECLINE
+    # it, and the measured-good 20480-wide geometry must still build.
+    k16 = ps._sub_rows(jnp.dtype("bfloat16"))
+    bad = ps._build_temporal_block_fused((4096, 24576), "bfloat16",
+                                         0.1, 0.1, (4096, 24576), k16)
+    good = ps._build_temporal_block_fused((4096, 20480), "bfloat16",
+                                          0.1, 0.1, (4096, 20480), k16)
+    check("bf16 block-temporal width guard",
+          bad is None and good is not None)
+
     # kernel I needs >= 2 column tiles of >= 1024 on hardware — its own
     # shapes (otherwise the check silently never runs where it matters)
     for (M, N), dt in [((1024, 2048), "float32"), ((768, 2048), "bfloat16")]:
