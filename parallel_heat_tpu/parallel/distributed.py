@@ -106,13 +106,22 @@ def process_info() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
-def suggest_mesh_shape(ndim: int = 2) -> Tuple[int, ...]:
+def suggest_mesh_shape(ndim: int = 2, grid_shape=None,
+                       dtype="float32") -> Tuple[int, ...]:
     """Factor *all* addressable devices (across hosts) into a mesh.
 
     The multi-host ``MPI_Dims_create``: uses the global device count, so
     the resulting mesh spans hosts; XLA routes the halo ppermutes over
-    ICI within a pod slice and DCN across slices.
+    ICI within a pod slice and DCN across slices. Pass ``grid_shape``
+    (3D) to get the cost-model-scored factorization — the z lane-pad
+    asymmetry makes balanced factors measurably wrong on TPU
+    (``mesh.pick_mesh_shape_scored``).
     """
+    if grid_shape is not None and ndim == 3:
+        from parallel_heat_tpu.parallel.mesh import pick_mesh_shape_scored
+
+        return pick_mesh_shape_scored(jax.device_count(), grid_shape,
+                                      dtype)
     return pick_mesh_shape(jax.device_count(), ndim)
 
 

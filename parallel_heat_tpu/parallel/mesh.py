@@ -46,6 +46,61 @@ def pick_mesh_shape(n_devices: int, ndim: int = 2) -> Tuple[int, ...]:
     return tuple(sorted(dims, reverse=True))
 
 
+def _factorizations(n: int, ndim: int):
+    """All ordered ``ndim``-tuples of positive ints with product n."""
+    if ndim == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, ndim - 1):
+                yield (d,) + rest
+
+
+def pick_mesh_shape_scored(n_devices: int, grid_shape,
+                           dtype="float32") -> Tuple[int, ...]:
+    """Grid-aware mesh factorization — ``MPI_Dims_create`` upgraded
+    with the kernel cost model.
+
+    :func:`pick_mesh_shape` balances factors to minimize halo surface,
+    which is right for isotropic per-axis costs. On TPU the 3D z
+    (lane) axis is NOT isotropic: sharding it pads the exchanged tail
+    to the 128-lane tile (2k halo columns round up to 128) and widens
+    every VMEM plane the kernel sweeps — measured in round 3 at 102 vs
+    76 Gcells·steps/s per device for the same 256³ block with the z
+    axis unsharded vs sharded. This picker scores every ordered
+    factorization that divides the grid with the kernel-H model
+    (``_score_block_temporal_3d`` at its best (sx, K): kernel band +
+    ICI + assembly terms) and returns the cheapest, so device counts
+    whose balanced factorization would shard z get a z-free mesh
+    instead whenever the model prefers one. Falls back to the
+    balanced pick when no factorization admits the Mosaic kernel
+    (tiny grids, CPU test meshes). 2D grids keep the balanced pick
+    (no lane-pad asymmetry measured there — REPORT §4b).
+    """
+    grid_shape = tuple(grid_shape)
+    if len(grid_shape) != 3 or n_devices == 1:
+        return pick_mesh_shape(n_devices, len(grid_shape))
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    best = None
+    best_t = float("inf")
+    for mesh in _factorizations(n_devices, 3):
+        if any(n % d for n, d in zip(grid_shape, mesh)):
+            continue
+        block = tuple(n // d for n, d in zip(grid_shape, mesh))
+        pick = ps._pick_block_temporal_3d(block, mesh, dtype)
+        if pick is None:
+            continue
+        t = ps._score_block_temporal_3d(block, mesh, dtype,
+                                        pick[1])[0]
+        if t < best_t:
+            best_t, best = t, mesh
+    if best is None:
+        return pick_mesh_shape(n_devices, 3)
+    return best
+
+
 def _use_topology_order(avail) -> bool:
     """Whether device placement should follow physical (ICI) topology.
 

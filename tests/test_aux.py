@@ -55,6 +55,29 @@ def test_distributed_single_process():
     assert len(shape) == 2 and shape[0] * shape[1] == 8  # 8 CPU devices
 
 
+def test_scored_mesh_factorization_avoids_z():
+    # The kernel cost model prices the z lane-pad asymmetry (sharding
+    # z pads the exchanged tail to the 128-lane tile): at hardware-
+    # sized grids the scored 3D factorization must leave z unsharded
+    # (measured +20-40% per device vs the balanced (2,2,2) at 512^3/8)
+    # and fall back to the balanced pick where no Mosaic schedule
+    # exists.
+    from parallel_heat_tpu.parallel.mesh import (pick_mesh_shape,
+                                                 pick_mesh_shape_scored)
+
+    m = pick_mesh_shape_scored(8, (512, 512, 512))
+    assert m[2] == 1 and m[0] * m[1] == 8
+    m16 = pick_mesh_shape_scored(16, (512, 512, 512))
+    assert m16[2] == 1 and m16[0] * m16[1] == 16
+    # tiny grids: no schedule -> balanced fallback
+    assert pick_mesh_shape_scored(8, (16, 16, 16)) == \
+        pick_mesh_shape(8, 3)
+    # 2D passthrough
+    assert pick_mesh_shape_scored(8, (512, 512)) == pick_mesh_shape(8, 2)
+    # grid-aware suggest_mesh_shape routes through the scored picker
+    assert dist.suggest_mesh_shape(3, (512, 512, 512))[2] == 1
+
+
 def test_gather_to_host_single_process():
     cfg = HeatConfig(nx=16, ny=16, steps=2, backend="jnp",
                      mesh_shape=(2, 4))
