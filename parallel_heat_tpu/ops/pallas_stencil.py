@@ -3222,7 +3222,7 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
                                    grid_shape, k, halos, vma=None,
-                                   with_residual=True):
+                                   with_residual=True, defer_x=False):
     """Kernel H, fused-assembly variant: the exchange pieces arrive as
     SEPARATE operands and the slab DMA pipeline gathers them —
     ``fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off) ->
@@ -3253,12 +3253,29 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
     those of the assembled builder. Geometry, offsets, pinning and the
     residual match :func:`_build_temporal_block_3d`; ``fn.tail_y`` /
     ``fn.tail_z`` / ``fn.sx`` are exposed the same way.
+
+    ``defer_x=True`` (requires ``hx > 0``, ``bx >= 2k``) is the 3D
+    comm/compute-overlap variant (see the 2D ``defer_ns``): the x-edge
+    slab operands are dropped — ``fn(u, ztail, ytail, x_off, y_off,
+    z_off)`` — so the call has no data path from the THIRD exchange
+    phase (the x ppermutes, which serialize behind z and y) and XLA
+    may overlap that hop with the bulk compute. The schedule, windows
+    and branch structure stay EXACTLY the monolithic's (only the x
+    copies are skipped), so the inner output planes are bitwise the
+    monolithic round's; the first/last k output slabs come out
+    garbage (frontier argument) and are overwritten by
+    :func:`_build_band_fix_3d`'s splice (see its precision contract),
+    with the residual excluding them correspondingly. On the z-free
+    meshes the scored factorization prefers, the exchange critical
+    path then collapses to the y phase alone.
     """
     bx, by, bz = block_shape
     NX, NY, NZ = grid_shape
     hx, hy, hz = halos
     dtype = jnp.dtype(dtype_name)
     assert k >= 1
+    if defer_x and (hx == 0 or bx < 2 * k):
+        return None
     pick = _pick_block_xslab_3d(block_shape, halos, dtype, k)
     if pick is None:
         return None
@@ -3271,8 +3288,14 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
     CH = _xslab_chunk(Ye * Ze * 4)
     has_z = hz > 0
     has_y = hy > 0
+    # defer_x keeps the monolithic's window/branch structure and slab
+    # pick — bitwise equality between variants holds only at IDENTICAL
+    # schedules (different sx measurably shifts f32 results by ulps:
+    # chunk shapes change XLA's FMA contraction) — and merely skips
+    # the x-slab copies, leaving those scratch regions garbage.
     has_x = hx > 0
-    n_ops = 1 + int(has_z) + int(has_y) + 2 * int(has_x)
+    copy_x = has_x and not defer_x
+    n_ops = 1 + int(has_z) + int(has_y) + 2 * int(copy_x)
 
     def kernel(offs_ref, *refs):
         ins = refs[:n_ops]
@@ -3286,7 +3309,7 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
         if has_y:
             yt_hbm = ins[i]
             i += 1
-        if has_x:
+        if copy_x:
             xlo_hbm, xhi_hbm = ins[i], ins[i + 1]
 
         s = pl.program_id(0)
@@ -3357,19 +3380,22 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
 
             if n_slabs == 1:
                 core_copies(0, bx, 2 * k)
-                go(xlo_copy())
-                go(xhi_copy())
+                if copy_x:
+                    go(xlo_copy())
+                    go(xhi_copy())
                 return
 
             @pl.when(slab == 0)
             def _():
                 core_copies(0, sx + k, 2 * k)
-                go(xlo_copy())
+                if copy_x:
+                    go(xlo_copy())
 
             @pl.when(slab == n_slabs - 1)
             def _():
                 core_copies((n_slabs - 1) * sx - k, sx + k, k)
-                go(xhi_copy())
+                if copy_x:
+                    go(xhi_copy())
 
             if n_slabs > 2:
                 @pl.when((slab > 0) & (slab < n_slabs - 1))
@@ -3436,10 +3462,18 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
             out_ref[r0 - C0:r0 - C0 + h, :, :] = \
                 new[:, :by, :bz].astype(dtype)
             if with_residual:
+                keepb = keep & corebox
+                if defer_x:
+                    # The first/last k output slabs carry garbage here
+                    # (no x-halo operands); the band kernel owns their
+                    # residual.
+                    rows_l = (s * sx + (r0 - C0)
+                              + lax.broadcasted_iota(jnp.int32,
+                                                     (h, 1, 1), 0))
+                    keepb = keepb & (rows_l >= k) & (rows_l < bx - k)
                 r_acc = jnp.maximum(
                     r_acc,
-                    jnp.max(jnp.where(keep & corebox,
-                                      jnp.abs(new - C), 0.0)))
+                    jnp.max(jnp.where(keepb, jnp.abs(new - C), 0.0)))
             r0 += h
 
         @pl.when(s == 0)
@@ -3477,6 +3511,252 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
         compiler_params=_compiler_params(),
     )
 
+    if defer_x:
+        def fn(u, ztail, ytail, x_off, y_off, z_off):
+            offs = jnp.stack([jnp.int32(x_off), jnp.int32(y_off),
+                              jnp.int32(z_off)])
+            ops = [u]
+            if has_z:
+                ops.append(ztail)
+            if has_y:
+                ops.append(ytail)
+            core, res = call(offs, *ops)
+            return core, res[0, 0]
+    else:
+        def fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off):
+            offs = jnp.stack([jnp.int32(x_off), jnp.int32(y_off),
+                              jnp.int32(z_off)])
+            ops = [u]
+            if has_z:
+                ops.append(ztail)
+            if has_y:
+                ops.append(ytail)
+            if copy_x:
+                ops += [xlo, xhi]
+            core, res = call(offs, *ops)
+            return core, res[0, 0]
+
+    fn.tail_y = tail_y
+    fn.tail_z = tail_z
+    fn.sx = sx
+    return fn
+
+
+@functools.lru_cache(maxsize=32)
+def _build_band_fix_3d(block_shape, dtype_name, cx, cy, cz, grid_shape,
+                       k, halos, vma=None, with_residual=True):
+    """The x-band pass of the overlapped kernel-H round —
+    ``fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off) ->
+    ((2k, by, bz) bands, residual)``.
+
+    3D analog of :func:`_build_band_fix_2d`: computes the K-step
+    values of the first and last ``k`` x-slabs of the block — the only
+    cells the ``defer_x`` bulk kernel gets wrong — from the ppermuted
+    x-edge slabs plus the block's own yz-extended edge planes. Two
+    grid steps (low-x, high-x bands), each a ``(3k, Ye, Ze)``
+    mini-problem; the band planes sit at scratch ``[k, 2k)`` in both
+    (low: xlo | u[0, 2k); high: u[bx-2k, bx) | xhi). Select pinning
+    throughout, so no fn-level re-pin (kernel H's convention); the
+    ping-pong edge planes need no zeroing (their influence reaches
+    scratch planes ``< k`` / ``>= 2k`` only — the frontier argument).
+    The residual covers exactly the band planes within the core box —
+    the bulk kernel's complement.
+
+    Precision contract: the spliced result's INNER planes are bitwise
+    the monolithic round's (the deferred bulk keeps the identical
+    schedule); the band planes agree to f32 ulps but not bitwise —
+    the mini-problem's sweep shapes differ from the monolithic's
+    slab sweeps, and 3D chunk shape measurably shifts XLA's FMA
+    contraction by 1-2 ulps (verified directly: two monolithic builds
+    differing only in sx already disagree at the same magnitude).
+    This sits inside the pallas-vs-jnp tolerance the solver already
+    operates under (SEMANTICS.md "Precision"); the 2D band
+    (:func:`_build_band_fix_2d`) happens to be bitwise and is pinned
+    so by its tests.
+    """
+    bx, by, bz = block_shape
+    NX, NY, NZ = grid_shape
+    hx, hy, hz = halos
+    dtype = jnp.dtype(dtype_name)
+    if hx == 0 or hx != k or bx < 2 * k:
+        return None
+    geo = _block_ext_geometry(block_shape, halos, dtype)
+    if geo is None:
+        return None
+    Ye, Ze, tail_y, tail_z = geo
+    SC = 3 * k
+    CH = _xslab_chunk(Ye * Ze * 4)
+    has_z = hz > 0
+    has_y = hy > 0
+
+    def kernel(offs_ref, *refs):
+        u_hbm = refs[0]
+        i = 1
+        zt_hbm = yt_hbm = None
+        if has_z:
+            zt_hbm = refs[i]
+            i += 1
+        if has_y:
+            yt_hbm = refs[i]
+            i += 1
+        xlo_hbm, xhi_hbm = refs[i], refs[i + 1]
+        out_ref, res_ref, slots, pp, sems = refs[i + 2:]
+
+        s = pl.program_id(0)
+        x_off = offs_ref[0]
+        y_off = offs_ref[1]
+        z_off = offs_ref[2]
+
+        ys_l = lax.broadcasted_iota(jnp.int32, (1, Ye, 1), 1)
+        zs_l = lax.broadcasted_iota(jnp.int32, (1, 1, Ze), 2)
+        ys_g = y_off + (jnp.where(ys_l >= Ye - k, ys_l - Ye, ys_l)
+                        if hy else ys_l)
+        zs_g = z_off + (jnp.where(zs_l >= Ze - k, zs_l - Ze, zs_l)
+                        if hz else zs_l)
+        yzmask = ((ys_g >= 1) & (ys_g <= NY - 2)
+                  & (zs_g >= 1) & (zs_g <= NZ - 2))
+        corebox = (ys_l < by) & (zs_l < bz)
+
+        def issue(slot, band, start):
+            def go(c):
+                c.start() if start else c.wait()
+
+            def piece(src, dst_y, ny, dst_z, nz, sem):
+                def copy(src0, rows, dst0):
+                    return pltpu.make_async_copy(
+                        src.at[pl.ds(src0, rows), :, :],
+                        slots.at[slot, pl.ds(dst0, rows),
+                                 pl.ds(dst_y, ny), pl.ds(dst_z, nz)],
+                        sems.at[slot, sem])
+                return copy
+
+            u_c = piece(u_hbm, 0, by, 0, bz, 0)
+            z_c = piece(zt_hbm, 0, by, bz, tail_z, 1) if has_z else None
+            y_c = piece(yt_hbm, by, tail_y, 0, Ze, 2) if has_y else None
+
+            def core_copies(src0, rows, dst0):
+                go(u_c(src0, rows, dst0))
+                if has_z:
+                    go(z_c(src0, rows, dst0))
+                if has_y:
+                    go(y_c(src0, rows, dst0))
+
+            def x_copy(src, dst0, sem):
+                return pltpu.make_async_copy(
+                    src.at[:, :, :],
+                    slots.at[slot, pl.ds(dst0, k), :, :],
+                    sems.at[slot, sem])
+
+            @pl.when(band == 0)
+            def _():
+                go(x_copy(xlo_hbm, 0, 3))
+                core_copies(0, 2 * k, k)
+
+            @pl.when(band == 1)
+            def _():
+                core_copies(bx - 2 * k, 2 * k, 0)
+                go(x_copy(xhi_hbm, 2 * k, 4))
+
+        @pl.when(s == 0)
+        def _():
+            issue(0, 0, True)
+            issue(1, 1, True)
+
+        issue(s, s, False)
+
+        # Global x of scratch plane 0: x_off (= bi*bx - k) for the low
+        # band; the high band's scratch 0 is u plane bx-2k, i.e.
+        # x_off + bx - k.
+        gx0 = x_off + s * (bx - k)
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :, :].astype(_ACC)
+            C = blk[1:-1]
+            Xm = blk[:-2]
+            Xp = blk[2:]
+            Ym = jnp.roll(C, 1, axis=1)
+            Yp = jnp.roll(C, -1, axis=1)
+            Zm = jnp.roll(C, 1, axis=2)
+            Zp = jnp.roll(C, -1, axis=2)
+            new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
+            rows_g = (gx0 + r0
+                      + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
+            keep = yzmask & (rows_g >= 1) & (rows_g <= NX - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(CH, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :, :] = new.astype(dtype)
+                r0 += h
+
+        m = k - 1
+        sref = slots.at[s]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, 1, SC - 1)
+            step_into(pp, sref, 1, SC - 1)
+            return 0
+
+        if m > 0:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, 1, SC - 1)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = k
+        while r0 < 2 * k:
+            h = min(CH, 2 * k - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - k:r0 - k + h, :, :] = \
+                new[:, :by, :bz].astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(keep & corebox,
+                                      jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    n_ops = 3 + int(has_z) + int(has_y)
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
+        out_shape=(
+            jax.ShapeDtypeStruct((2 * k, by, bz), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((k, by, bz), lambda s: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SC, Ye, Ze), dtype),
+            pltpu.VMEM((SC, Ye, Ze), dtype),
+            pltpu.SemaphoreType.DMA((2, 5)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
     def fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off):
         offs = jnp.stack([jnp.int32(x_off), jnp.int32(y_off),
                           jnp.int32(z_off)])
@@ -3485,15 +3765,47 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
             ops.append(ztail)
         if has_y:
             ops.append(ytail)
-        if has_x:
-            ops += [xlo, xhi]
-        core, res = call(offs, *ops)
-        return core, res[0, 0]
+        ops += [xlo, xhi]
+        bands, res = call(offs, *ops)
+        return bands, res[0, 0]
 
     fn.tail_y = tail_y
     fn.tail_z = tail_z
-    fn.sx = sx
     return fn
+
+
+def pick_block_temporal_3d_deferred(config, kw_axis_names, mesh_shape):
+    """The overlapped 3D round's kernel pair: ``(bulk_res, bulk_plain,
+    band_res, band_plain)`` or ``None`` — available when x is sharded,
+    the run is multi-process, and both the deferred bulk and the
+    x-band builders accept.
+
+    The multi-process gate is a measured trade: unlike the free 2D
+    band splice, the 3D band pass costs ~11% of a round per device
+    (paired at the 256³ z-free block: 135.4 monolithic vs 120.8
+    overlapped Gcells·steps/s), which buys hiding ONE collective hop.
+    Within a host that hop rides ICI (microseconds) — a net loss; on
+    multi-host meshes the x axis (the outermost, host-spanning one
+    under ``create_device_mesh``) crosses DCN, whose ~100 µs+ latency
+    the overlap can actually pay for.
+    """
+    K = config.halo_depth
+    halos = tuple(K if d > 1 else 0 for d in mesh_shape)
+    if halos[0] == 0 or jax.process_count() == 1:
+        return None
+    args = (config.block_shape(), config.dtype, float(config.cx),
+            float(config.cy), float(config.cz), config.shape, K, halos,
+            tuple(kw_axis_names))
+    band = _build_band_fix_3d(*args)
+    if band is None:
+        return None
+    bulk = _build_temporal_block_3d_fused(*args, defer_x=True)
+    if bulk is None:
+        return None
+    return (bulk,
+            _build_temporal_block_3d_fused(*args, defer_x=True,
+                                           with_residual=False),
+            band, _build_band_fix_3d(*args, with_residual=False))
 
 
 def pick_single_3d(shape, dtype):

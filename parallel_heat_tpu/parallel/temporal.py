@@ -490,6 +490,36 @@ def _pallas_round_3d(config, kw):
     z_off = lax.pcast(bi[2] * bz, others(2), to="varying")
 
     if fused:
+        deferred = ps.pick_block_temporal_3d_deferred(config, axis_names,
+                                                      mesh_shape)
+        if deferred is not None:
+            # Overlapped round (3D): the bulk call consumes only the
+            # z/y-phase pieces, so the x-phase ppermutes — the third
+            # serialized exchange hop — have no path into it and may
+            # run concurrently with the bulk compute; the x-band
+            # kernel consumes them and splices in place. On the
+            # z-free meshes the scored factorization prefers, the
+            # exchange critical path collapses to the y phase alone.
+            bulk, bulk_plain, band, band_plain = deferred
+
+            def fn(u, want_res):
+                ztail, ytail, xlo, xhi = exchange_halos_fused_3d(
+                    u, K, mesh_shape, axis_names,
+                    tail_y=built.tail_y, tail_z=built.tail_z)
+                bk = bulk if want_res else bulk_plain
+                bd = band if want_res else band_plain
+                core, res_a = bk(u, ztail, ytail, x_off, y_off, z_off)
+                bands, res_b = bd(u, ztail, ytail, xlo, xhi,
+                                  x_off, y_off, z_off)
+                core = (core.at[:K].set(bands[:K])
+                        .at[bx - K:].set(bands[K:]))
+                if want_res:
+                    return core, lax.pmax(
+                        jnp.maximum(res_a, res_b), axis_names)
+                return core
+
+            return fn
+
         def fn(u, want_res):
             ztail, ytail, xlo, xhi = exchange_halos_fused_3d(
                 u, K, mesh_shape, axis_names,

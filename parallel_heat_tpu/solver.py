@@ -530,6 +530,10 @@ def explain(config: HeatConfig) -> dict:
                          config.shape, K, halos, AXIS_NAMES[:3])
                 built = ps._build_temporal_block_3d_fused(*args3)
                 label = "fused exchange assembly"
+                if built is not None and ps.pick_block_temporal_3d_deferred(
+                        config, AXIS_NAMES[:3], mesh_shape) is not None:
+                    label += (", deferred x bands — phase-3 ppermutes "
+                              "overlap the bulk kernel")
                 if built is None:
                     built = ps._build_temporal_block_3d(*args3)
                     label = "assembled layout"

@@ -92,19 +92,44 @@ def test_kernel_h_diverging_boundary_exact():
 
 @pytest.mark.parametrize("mesh", [(2, 2, 2), (2, 2, 1), (1, 2, 2)])
 def test_kernel_h_fused_matches_assembled_bitwise(mesh):
-    # The fused-assembly kernel H must agree with the assembled
-    # circular layout bit-for-bit (same bytes into the same scratch
-    # layout, different transport), mixed sharded/unsharded axes
-    # included.
+    # The default round (overlapped where x is sharded, monolithic
+    # fused otherwise) must agree with the monolithic fused round —
+    # bitwise on the inner planes, to f32 ulps on the k-deep x bands
+    # (the band mini-problem's sweep shapes shift FMA contraction;
+    # see _build_band_fix_3d's precision contract) — and the
+    # monolithic fused round must agree with the assembled circular
+    # layout bit-for-bit, mixed sharded/unsharded axes included.
     from parallel_heat_tpu import solver as slv
 
-    kw = dict(nx=16, ny=16, nz=16, steps=9)
+    # ONE K-round: after a second round the band ulps feed the inner
+    # region (each round mixes boundary-adjacent values inward), so
+    # the inner-bitwise property is per-round by construction.
+    kw = dict(nx=32, ny=16, nz=16, steps=4)
     cfg = HeatConfig(backend="pallas", mesh_shape=mesh, halo_depth=4,
                      **kw)
-    assert "fused" in explain(cfg)["path"]
-    fused = solve(cfg).to_numpy()
+    # Deferral additionally gates on multi-process (the band pass
+    # costs ~11%/device and only a DCN hop repays it); single-process
+    # runs must take the monolithic round.
+    assert ps.pick_block_temporal_3d_deferred(
+        cfg, ("x", "y", "z"), mesh) is None
+    assert "deferred" not in explain(cfg)["path"]
     mp = pytest.MonkeyPatch()
     try:
+        import jax as _jax
+
+        mp.setattr(_jax, "process_count", lambda: 2)
+        slv._build_runner.cache_clear()
+        path = explain(cfg)["path"]
+        assert "fused" in path
+        dp = ps.pick_block_temporal_3d_deferred(cfg, ("x", "y", "z"),
+                                                mesh)
+        assert ("deferred x bands" in path) == (dp is not None)
+        assert (dp is not None) == (mesh[0] > 1)
+        default = solve(cfg).to_numpy()
+        mp.setattr(ps, "_build_band_fix_3d", lambda *a, **k: None)
+        slv._build_runner.cache_clear()
+        assert "deferred" not in explain(cfg)["path"]
+        fused = solve(cfg).to_numpy()
         mp.setattr(ps, "_build_temporal_block_3d_fused",
                    lambda *a, **k: None)
         slv._build_runner.cache_clear()
@@ -114,6 +139,74 @@ def test_kernel_h_fused_matches_assembled_bitwise(mesh):
         mp.undo()
         slv._build_runner.cache_clear()
     np.testing.assert_array_equal(fused, assembled)
+    bx = 32 // mesh[0]
+    K = 4
+    # inner planes of every x-block: bitwise
+    for b in range(mesh[0]):
+        inner = np.s_[b * bx + K:(b + 1) * bx - K]
+        np.testing.assert_array_equal(default[inner], fused[inner])
+    np.testing.assert_allclose(default, fused, rtol=1e-6, atol=1e-3)
+
+
+def test_overlap_3d_bulk_kernel_independent_of_x_ppermutes():
+    # 3D analog of the 2D jaxpr proof: on a (2,2,1) mesh the round has
+    # four ppermutes — two y shifts (phase 1) and two x shifts whose
+    # payloads are built from the y-extended strips (phase 2). The
+    # bulk pallas_call must not depend on the phase-2 ppermutes.
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_heat_tpu.parallel import temporal as tp
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+    from parallel_heat_tpu.solver import _shard_map
+    from tests.test_temporal import _ancestor_eqns, _flat_jaxpr_levels
+
+    import pytest as _pytest
+
+    cfg = HeatConfig(nx=32, ny=16, nz=16, steps=8, backend="pallas",
+                     mesh_shape=(2, 2, 1), halo_depth=4)
+    mesh = make_heat_mesh((2, 2, 1))
+    names = mesh.axis_names
+
+    def local_round(u):
+        bidx = tuple(lax.axis_index(n) for n in names)
+        kw = dict(mesh_shape=(2, 2, 1), grid_shape=(32, 16, 16),
+                  block_index=bidx, cx=0.1, cy=0.1, axis_names=names)
+        fn = tp._pallas_round_3d(cfg, kw)
+        assert fn is not None
+        return fn(u, False)
+
+    f = _shard_map(local_round, mesh=mesh, in_specs=P(*names),
+                   out_specs=P(*names), check_vma=False)
+    mp = _pytest.MonkeyPatch()
+    try:
+        mp.setattr(jax, "process_count", lambda: 2)
+        jx = jax.make_jaxpr(f)(jnp.zeros((32, 16, 16), jnp.float32))
+    finally:
+        mp.undo()
+    levels = [lv for lv in _flat_jaxpr_levels(jx.jaxpr)
+              if any(e.primitive.name == "ppermute" for e in lv.eqns)]
+    assert levels, "no ppermutes found in the traced round"
+    body = levels[0]
+    perms = [i for i, e in enumerate(body.eqns)
+             if e.primitive.name == "ppermute"]
+    assert len(perms) == 4
+    phase2 = {i for i in perms
+              if any(a in perms
+                     for a in _ancestor_eqns(body, body.eqns[i]))}
+    assert len(phase2) == 2
+    pallas = [(i, e) for i, e in enumerate(body.eqns)
+              if e.primitive.name == "pallas_call"]
+    assert len(pallas) == 2
+    bulk = min(pallas, key=lambda ie: len(ie[1].invars))
+    band = max(pallas, key=lambda ie: len(ie[1].invars))
+    assert len(band[1].invars) == len(bulk[1].invars) + 2
+    assert not (phase2 & _ancestor_eqns(body, bulk[1])), \
+        "bulk kernel depends on x-phase ppermutes: no overlap possible"
+    assert phase2 & _ancestor_eqns(body, band[1]), \
+        "band kernel should be the x-phase consumer"
 
 
 def test_auto_depth_3d_resolves_to_kernel_h():
