@@ -66,6 +66,23 @@ np.testing.assert_allclose(
     np.asarray(gather_to_host(pal.grid), dtype=np.float64),
     oracle.astype(np.float64), rtol=1e-4, atol=1e-2)
 
+# Kernel H overlapped round across the process boundary: with a REAL
+# process_count == 2 the deferred-x band split engages (the DCN gate
+# that monkeypatched single-process tests can only simulate), so the
+# bulk Mosaic call runs with no data from the x-phase ppermutes and
+# the band kernel splices them in.
+from parallel_heat_tpu.solver import explain as _explain
+
+cfg3 = HeatConfig(nx=32, ny=16, nz=16, steps=8, mesh_shape=(2, 2, 2),
+                  halo_depth=4).replace(backend="pallas")
+p3 = _explain(cfg3)["path"]
+assert "deferred x bands" in p3, f"expected the overlapped round, got {{p3}}"
+res3 = solve(cfg3)
+oracle3 = solve(HeatConfig(nx=32, ny=16, nz=16, steps=8)).to_numpy()
+np.testing.assert_allclose(
+    np.asarray(gather_to_host(res3.grid), dtype=np.float64),
+    oracle3.astype(np.float64), rtol=1e-4, atol=1e-2)
+
 # Per-shard checkpoint round trip across the process boundary: each
 # process writes only its own shards (no host gather), p0 writes the
 # manifest, and the fast-path load rebuilds the same sharded array.
