@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""A/B: does keeping the slab sweeps OUT of the DMA slots restore
+kernel F's DMA/compute overlap?
+
+Hypothesis (from round 3's additive-cost finding, REPORT §4d): the
+intermediate sweeps write back into ``slots[slot]`` while the next
+slab's DMA is in flight into ``slots[other]``; the dynamic slot index
+may defeat Mosaic's disjointness proof, ordering the copy against the
+stores — which would serialize DMA behind compute exactly as the
+additive model measures. The variant here ping-pongs the K-1
+intermediate steps between TWO dedicated buffers (pp1/pp2) so the DMA
+slots are never stored to, at the cost of one extra (SCR, Y, Z) VMEM
+buffer. If the hypothesis holds, the variant approaches the
+max(DMA, compute) model instead of the sum.
+
+Run: python tools/ab_xslab_overlap.py [--sx 32] [--k 4] [--size 256]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.models import HeatPlate3D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.ops.stencil import combine_3d
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+_ACC = jnp.float32
+
+
+def build_3buf(shape, sx, k, cx=0.1, cy=0.1, cz=0.1):
+    X, Y, Z = shape
+    dtype = jnp.float32
+    W = sx + 2 * k
+    SCR = sx + 4 * k
+    C0 = 2 * k
+    n_slabs = X // sx
+    CH = ps._xslab_chunk(Y * Z * 4)
+
+    def kernel(u_hbm, out_ref, slots, pp1, pp2, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        ys = lax.broadcasted_iota(jnp.int32, (1, Y, 1), 1)
+        zs = lax.broadcasted_iota(jnp.int32, (1, 1, Z), 2)
+        yzmask = ((ys >= 1) & (ys <= Y - 2)
+                  & (zs >= 1) & (zs <= Z - 2))
+
+        def dma(slot, slab):
+            start, dst = ps._clamped_window(slab, sx, k, X, W, 1, C0)
+            return pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :, :],
+                slots.at[slot, pl.ds(dst, W), :, :],
+                sems.at[slot],
+            )
+
+        @pl.when(s == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            dma((s + 1) % 2, s + 1).start()
+
+        slot = lax.rem(s, 2)
+        dma(slot, s).wait()
+
+        def chunk_new(src, r0, h):
+            blk = src[r0 - 1:r0 + h + 1, :, :].astype(_ACC)
+            C = blk[1:-1]
+            Xm = blk[:-2]
+            Xp = blk[2:]
+            Ym = jnp.roll(C, 1, axis=1)
+            Yp = jnp.roll(C, -1, axis=1)
+            Zm = jnp.roll(C, 1, axis=2)
+            Zp = jnp.roll(C, -1, axis=2)
+            new = combine_3d(C, Xm, Xp, Ym, Yp, Zm, Zp, cx, cy, cz)
+            rows_g = (s * sx + (r0 - C0)
+                      + lax.broadcasted_iota(jnp.int32, (h, 1, 1), 0))
+            keep = yzmask & (rows_g >= 1) & (rows_g <= X - 2)
+            return jnp.where(keep, new, C), C, keep
+
+        def step_into(src, dst, lo, hi):
+            r0 = lo
+            while r0 < hi:
+                h = min(CH, hi - r0)
+                new, _, _ = chunk_new(src, r0, h)
+                dst[r0:r0 + h, :, :] = new.astype(dtype)
+                r0 += h
+
+        # K-1 intermediate steps, NEVER writing into the DMA slots:
+        # sref -> pp1 -> pp2 -> pp1 -> ...
+        sref = slots.at[slot]
+        m = k - 1
+        src = sref
+        bufs = [pp1, pp2]
+        for j in range(m):
+            dst = bufs[j % 2]
+            step_into(src, dst, k, sx + 3 * k)
+            src = dst
+
+        r0 = C0
+        while r0 < C0 + sx:
+            h = min(CH, C0 + sx - r0)
+            new, C, keep = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :, :] = new.astype(dtype)
+            r0 += h
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_slabs,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), dtype),
+        out_specs=pl.BlockSpec((sx, Y, Z), lambda s: (s, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, Y, Z), dtype),
+            pltpu.VMEM((SCR, Y, Z), dtype),
+            pltpu.VMEM((SCR, Y, Z), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=ps._compiler_params(),
+    )
+    return call
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--sx", type=int, default=32)
+    ap.add_argument("--k", type=int, default=4)
+    args = ap.parse_args()
+    shape = (args.size,) * 3
+    sx, k = args.sx, args.k
+    u0 = jax.block_until_ready(
+        HeatPlate3D(*shape).init_grid(jnp.float32))
+    prod = ps._build_xslab_3d(shape, "float32", 0.1, 0.1, 0.1, sx, k,
+                              with_residual=False)
+    v3 = build_3buf(shape, sx, k)
+    import numpy as np
+    a = np.asarray(jax.jit(lambda u: prod(u)[0])(u0))
+    b = np.asarray(jax.jit(v3)(u0))
+    print("agree:", np.array_equal(a, b),
+          f"maxdiff={np.abs(a - b).max():.3g}")
+    rounds = {
+        f"F prod (slot-writeback) sx={sx} k={k}":
+            lambda u: prod(u)[0],
+        f"F 3buf (slots read-only) sx={sx} k={k}": v3,
+    }
+    bench_rounds_paired(rounds, u0, {n: k for n in rounds},
+                        span_s=2.0, batches=4)
+
+
+if __name__ == "__main__":
+    main()
