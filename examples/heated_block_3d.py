@@ -52,11 +52,15 @@ def main():
             pass  # backend already initialized
 
     from parallel_heat_tpu import HeatConfig, solve
-    from parallel_heat_tpu.parallel.mesh import pick_mesh_shape
+    from parallel_heat_tpu.parallel.mesh import pick_mesh_shape_scored
 
     mesh = None
     if args.mesh == "auto":
-        mesh = pick_mesh_shape(len(jax.devices()), ndim=3)
+        # Grid-aware: the kernel cost model keeps the z (lane) axis
+        # unsharded where the device count allows (+20-40%/device
+        # measured — REPORT §4c); balanced fallback on tiny grids.
+        mesh = pick_mesh_shape_scored(len(jax.devices()),
+                                      (args.n, args.n, args.n))
     elif args.mesh:
         mesh = tuple(int(d) for d in args.mesh.split(","))
 
