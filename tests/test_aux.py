@@ -1,6 +1,7 @@
 """Auxiliary subsystems: profiling helpers, distributed runtime wrapper."""
 
 import numpy as np
+import pytest
 
 from parallel_heat_tpu import HeatConfig, solve
 from parallel_heat_tpu.parallel import distributed as dist
@@ -53,6 +54,60 @@ def test_distributed_single_process():
     assert pid == 0 and count == 1
     shape = dist.suggest_mesh_shape(2)
     assert len(shape) == 2 and shape[0] * shape[1] == 8  # 8 CPU devices
+
+
+def test_calibrated_slope_sizing_and_refusal(monkeypatch):
+    # The calibration must size the long endpoint to hold span_s of
+    # device work (computed from a two-point slope that cancels the
+    # dispatch floor), and must REFUSE rather than return a garbage
+    # rate when even max_reps cannot fill ~60% of the span.
+    from parallel_heat_tpu.utils import profiling as prof
+
+    calls = []
+
+    def fake_chain_time(fn, u0, reps, per=1e-3, floor=0.2):
+        calls.append(reps)
+        return floor + per * reps
+
+    monkeypatch.setattr(prof, "chain_time", fake_chain_time)
+    per = prof.calibrated_slope(None, None, span_s=0.5)
+    assert abs(per - 1e-3) < 1e-12
+    # endpoints: 1, 33 (calibration), then 1 and ~501 (the span)
+    assert calls[:2] == [1, 33] and calls[-1] >= 1 + int(0.5 / 1e-3)
+
+    calls.clear()
+    monkeypatch.setattr(
+        prof, "chain_time",
+        lambda fn, u0, reps: 0.2 + 1e-3 * reps)
+    with pytest.raises(RuntimeError, match="max_reps|span"):
+        prof.calibrated_slope(None, None, span_s=10.0, max_reps=100)
+
+
+def test_calibrated_slope_paired_interleaves(monkeypatch):
+    # Paired mode must interleave the variants' endpoint batches (the
+    # whole point: clock drift lands on every variant alike) and map a
+    # non-positive slope to None instead of a garbage rate.
+    from parallel_heat_tpu.utils import profiling as prof
+
+    seq = []
+
+    def fake_chain_time(fn, u0, reps):
+        seq.append((fn, reps))
+        return 0.2 + fn * reps  # fn doubles as the per-call time
+
+    monkeypatch.setattr(prof, "chain_time", fake_chain_time)
+    out = prof.calibrated_slope_paired({ "a": 1e-3, "b": 2e-3 },
+                                       None, span_s=0.1, batches=2)
+    assert abs(out["a"] - 1e-3) < 1e-12
+    assert abs(out["b"] - 2e-3) < 1e-12
+    # after the 4 calibration calls, batches interleave a,b,a,b
+    body = [fn for fn, _ in seq[4:]]
+    assert body == [1e-3, 1e-3, 2e-3, 2e-3, 1e-3, 1e-3, 2e-3, 2e-3]
+
+    monkeypatch.setattr(prof, "chain_time",
+                        lambda fn, u0, reps: 0.5)  # flat: zero slope
+    out = prof.calibrated_slope_paired({"a": None}, None, batches=1)
+    assert out["a"] is None
 
 
 def test_scored_mesh_factorization_avoids_z():
