@@ -179,19 +179,28 @@ def _drive_kernel_h_overlapped(shape, dt, k, halos, cx=0.1, cy=0.1,
     return np.asarray(u)
 
 
-def kernel_h_checks():
+_KERNEL_H_CASES = [
+    ((128, 128, 256), "float32", 4, (4, 4, 4)),
+    ((128, 128, 256), "float32", 4, (0, 4, 4)),
+    ((128, 128, 256), "float32", 4, (4, 4, 0)),
+    ((128, 128, 256), "bfloat16", 8, (8, 8, 8)),
+    ((96, 120, 384), "float32", 4, (4, 4, 4)),  # non-pow2 slabs
+]
+
+
+def kernel_h_checks(cases=None, divergence=True):
+    """The kernel-H battery. With cold compile caches the FULL case
+    list (each case builds assembled + fused + overlapped kernels)
+    exceeds a 600 s shell timeout — the ``kernel_h_a`` / ``kernel_h_b``
+    sections split it; ``kernel_h`` still runs everything for callers
+    without a timeout."""
     import jax.numpy as jnp
 
     from parallel_heat_tpu.models import HeatPlate3D
 
     print("kernel H (3D shard-block temporal) vs factored oracle:")
-    for shape, dt, k, halos in [
-        ((128, 128, 256), "float32", 4, (4, 4, 4)),
-        ((128, 128, 256), "float32", 4, (0, 4, 4)),
-        ((128, 128, 256), "float32", 4, (4, 4, 0)),
-        ((128, 128, 256), "bfloat16", 8, (8, 8, 8)),
-        ((96, 120, 384), "float32", 4, (4, 4, 4)),  # non-pow2 slabs
-    ]:
+    for shape, dt, k, halos in (cases if cases is not None
+                                else _KERNEL_H_CASES):
         got = _drive_kernel_h(shape, dt, k, halos)
         name = (f"kernel H {shape[0]}x{shape[1]}x{shape[2]} {dt} "
                 f"k={k} halos={halos}")
@@ -228,6 +237,8 @@ def kernel_h_checks():
                                   rtol=rtol, atol=1e-2))
             check(nameo, ok)
 
+    if not divergence:
+        return
     # diverging run: boundary faces must stay bitwise exact
     shape = (128, 128, 256)
     ini = np.asarray(HeatPlate3D(*shape).init_grid(jnp.float32))
@@ -409,26 +420,30 @@ def divergence_guard_checks():
           (not np.all(np.isfinite(out))) and boundary_exact(out, np.asarray(u0)))
 
 
-def odd_geometry_sweep(quick):
+_ODD_CASES = [
+    dict(nx=5000, ny=5000, steps=24),            # unaligned -> decline
+    dict(nx=4864, ny=4992, steps=24),            # aligned, odd divisors
+    dict(nx=1000, ny=1024, steps=24),
+    dict(nx=3072, ny=2944, steps=30, dtype="bfloat16"),
+    dict(nx=2048, ny=2048, steps=37, converge=True, check_interval=7),
+    dict(nx=300, ny=300, nz=384, steps=12),      # 3D unaligned Y
+    dict(nx=320, ny=320, nz=384, steps=12),      # 3D aligned
+    # asymmetric coefficients (different pinned-vector constants)
+    dict(nx=1024, ny=1024, steps=60, cx=0.12, cy=0.07),
+    dict(nx=4096, ny=4096, steps=40, cx=0.05, cy=0.21),
+    dict(nx=320, ny=320, nz=384, steps=12, cx=0.08, cy=0.11, cz=0.14),
+]
+
+
+def odd_geometry_sweep(quick, cases=None):
     from parallel_heat_tpu import HeatConfig, solve
 
     print("odd-geometry end-to-end sweep (pallas vs jnp):")
-    cases = [
-        dict(nx=5000, ny=5000, steps=24),            # unaligned -> decline
-        dict(nx=4864, ny=4992, steps=24),            # aligned, odd divisors
-        dict(nx=1000, ny=1024, steps=24),
-        dict(nx=3072, ny=2944, steps=30, dtype="bfloat16"),
-        dict(nx=2048, ny=2048, steps=37, converge=True, check_interval=7),
-        dict(nx=300, ny=300, nz=384, steps=12),      # 3D unaligned Y
-        dict(nx=320, ny=320, nz=384, steps=12),      # 3D aligned
-        # asymmetric coefficients (different pinned-vector constants)
-        dict(nx=1024, ny=1024, steps=60, cx=0.12, cy=0.07),
-        dict(nx=4096, ny=4096, steps=40, cx=0.05, cy=0.21),
-        dict(nx=320, ny=320, nz=384, steps=12, cx=0.08, cy=0.11, cz=0.14),
-    ]
-    if not quick:
-        cases += [dict(nx=131072, ny=512, steps=8),
-                  dict(nx=512, ny=131072, steps=8)]
+    if cases is None:
+        cases = list(_ODD_CASES)
+        if not quick:
+            cases += [dict(nx=131072, ny=512, steps=8),
+                      dict(nx=512, ny=131072, steps=8)]
     for kw in cases:
         cfg = HeatConfig(**kw)
         a = solve(cfg.replace(backend="jnp")).to_numpy().astype(np.float64)
@@ -485,9 +500,22 @@ def main():
     sections = {
         "bitwise": lambda a: kernel_bitwise_checks(),
         "kernel_h": lambda a: kernel_h_checks(),
+        # Each case compiles three kernel variants (~60 s each over
+        # the tunnel cold): two cases per invocation fits a 600 s
+        # shell timeout.
+        "kernel_h_a": lambda a: kernel_h_checks(
+            cases=_KERNEL_H_CASES[:2], divergence=False),
+        "kernel_h_b": lambda a: kernel_h_checks(
+            cases=_KERNEL_H_CASES[2:4], divergence=False),
+        "kernel_h_c": lambda a: kernel_h_checks(
+            cases=_KERNEL_H_CASES[4:], divergence=True),
         "divergence": lambda a: divergence_guard_checks(),
         "dtypes": lambda a: dtype_mode_matrix(),
         "odd": lambda a: odd_geometry_sweep(a.quick),
+        "odd_a": lambda a: odd_geometry_sweep(True,
+                                              cases=_ODD_CASES[:5]),
+        "odd_b": lambda a: odd_geometry_sweep(True,
+                                              cases=_ODD_CASES[5:]),
         "checkpoint": lambda a: stream_checkpoint_roundtrip(),
     }
     ap = argparse.ArgumentParser()
