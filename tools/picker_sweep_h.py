@@ -18,6 +18,7 @@ Run: python tools/picker_sweep_h.py [--top 3] [--cases N,M,...]
 """
 
 import argparse
+import json
 import sys
 
 sys.path.insert(0, ".")
@@ -50,7 +51,7 @@ def candidates(block, mesh, dts, top):
     return scored[:top]
 
 
-def run_case(block, mesh, dts, top, span_s, batches):
+def run_case(block, mesh, dts, top, span_s, batches, record=None):
     X, Y, Z = block
     dt = jnp.dtype(dts)
     cand = candidates(block, mesh, dts, top)
@@ -86,6 +87,13 @@ def run_case(block, mesh, dts, top, span_s, batches):
         steps[name] = k
     rates = bench_rounds_paired(rounds, u0, steps, span_s=span_s,
                                 batches=batches)
+    if record is not None:
+        record.append({
+            "block": list(block), "mesh": list(mesh), "dtype": dts,
+            "model_top": [{"sx": sx, "k": k, "t_model": t}
+                          for t, sx, k in cand],
+            "measured_gcells_steps_per_s": rates,
+        })
     if rates:
         best = max(rates, key=rates.get)
         top_rate = rates[best]
@@ -114,17 +122,40 @@ def main():
                          "spans measurably flip rankings that 2 s "
                          "spans pin as ties)")
     ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write every case's model ranking + measured "
+                         "rates to this JSON artifact")
     args = ap.parse_args()
     idx = (range(len(CASES)) if args.cases is None
            else [int(i) for i in args.cases.split(",")])
     results = []
+    record = [] if args.out else None
     for i in idx:
         block, mesh, dts = CASES[i]
         results.append((i, run_case(block, mesh, dts, args.top,
-                                    args.span, args.batches)))
-    print("\nsummary:", {i: ("holds" if r else "MIS-RANKED"
-                             if r is not None else "n/a")
-                         for i, r in results})
+                                    args.span, args.batches,
+                                    record=record)))
+    summary = {i: ("holds" if r else "MIS-RANKED"
+                   if r is not None else "n/a")
+               for i, r in results}
+    print("\nsummary:", summary)
+    if args.out:
+        import os
+
+        import jax
+
+        doc = {
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+            "span_s": args.span,
+            "batches": args.batches,
+            "summary": {str(k): v for k, v in summary.items()},
+            "cases": record,
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
 
 
 if __name__ == "__main__":
