@@ -801,16 +801,17 @@ def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     (T, n_cols) output, f32 chunk temporaries)."""
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    if _needs_lane_alignment() and itemsize < 4 and n_cols > 20608:
-        # Measured Mosaic register-spill cliff (round 3, v5e): the
-        # sub-f32 block temporal kernels (K = 16 sublanes in flight)
-        # compile and run at Ye = 20608 (154 Gcells*steps/s at a
-        # 4096-row block) but blow up with 82.6 MiB of register-
-        # allocator spill slots — a hard compile OOM — at Ye = 24704
-        # and 32896. f32 (K=8) is unaffected (measured fine at
-        # 32768 wide). Declining sends full-width bf16 shard blocks
-        # (the (8,1)-mesh decomposition the mesh picker never chooses
-        # for 2D) to the jnp rounds instead of a compile crash.
+    if (_needs_lane_alignment() and itemsize < 4
+            and n_cols > _params().spill_cliff_cols_sub_f32):
+        # Measured Mosaic register-spill cliff (v5e value and provenance
+        # in tpu_params.TpuParams.spill_cliff_cols_sub_f32): the sub-f32
+        # block temporal kernels (K = 16 sublanes in flight) compile and
+        # run at the cliff width (154 Gcells*steps/s at a 4096-row
+        # block) but hit a hard register-allocator spill OOM above it.
+        # f32 (K=8) is unaffected (measured fine at 32768 wide).
+        # Declining sends full-width bf16 shard blocks (the (8,1)-mesh
+        # decomposition the mesh picker never chooses for 2D) to the
+        # jnp rounds instead of a compile crash.
         return None
     budget = _params().stream_budget_bytes
     temps = 4 * (_SUBSTRIP + 2) * n_cols * 4
@@ -2883,15 +2884,16 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     plane = Ye * Ze * itemsize
     plane_f32 = Ye * Ze * 4
     hw = _params()
-    # 0.92 x vmem_limit: the admission cliff was MEASURED in round 3's
-    # picker sweep at the 256^3 z-unsharded block — a schedule modeled
-    # at 117.6 MiB (sx=64, K=4) compiles and is the measured-best
-    # (123.1 Gcells*steps/s/device), while 122.3 MiB (sx=64, K=5) and
-    # above crash Mosaic compilation outright. 0.92 x 128 MiB = 117.9
-    # sits between the two measured endpoints; the earlier full-limit
-    # budget admitted known-infeasible schedules the solver would then
-    # die on at compile time.
-    budget = int(0.92 * hw.vmem_limit_bytes)
+    # Admission margin below the scoped-VMEM limit: the cliff was
+    # MEASURED in round 3's picker sweep at the 256^3 z-unsharded
+    # block — a schedule modeled at 117.6 MiB (sx=64, K=4) compiles
+    # and is the measured-best (123.1 Gcells*steps/s/device), while
+    # 122.3 MiB (sx=64, K=5) and above crash Mosaic compilation
+    # outright. The margin lives per-generation in
+    # tpu_params.TpuParams.vmem_admission_margin; the earlier
+    # full-limit budget admitted known-infeasible schedules the
+    # solver would then die on at compile time.
+    budget = int(hw.vmem_admission_margin * hw.vmem_limit_bytes)
     ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")

@@ -51,6 +51,18 @@ class TpuParams:
     # depth scoring in _pick_block_temporal_3d, never correctness):
     ici_bytes_per_s: float = 4.5e10
     collective_latency_s: float = 5e-6
+    # Mosaic compile-feasibility cliffs, MEASURED on v5e (round 3) and
+    # inherited conservatively by the extrapolated rows until measured
+    # there (tools/picker_sweep_h.py / hw_validate re-measure them):
+    # - spill_cliff_cols_sub_f32: widest sub-f32 (16-sublane) block
+    #   temporal strip that compiles; 20608 lanes ran at 154 G, 24704+
+    #   died in register-allocator spill OOM (82.6 MiB of spill slots).
+    # - vmem_admission_margin: fraction of the scoped-VMEM limit a
+    #   kernel-H schedule may model before Mosaic's own bookkeeping
+    #   overflows it; 117.6 MiB compiled, 122.3 MiB crashed, 0.92*128
+    #   MiB = 117.9 sits between the measured endpoints.
+    spill_cliff_cols_sub_f32: int = 20608
+    vmem_admission_margin: float = 0.92
 
     @property
     def vmem_limit_bytes(self) -> int:

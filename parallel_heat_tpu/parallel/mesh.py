@@ -11,6 +11,7 @@ statically-built ``ppermute`` permutation tables in ``halo.py``.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -85,9 +86,11 @@ def pick_mesh_shape_scored(n_devices: int, grid_shape,
 
     best = None
     best_t = float("inf")
+    any_divisible = False
     for mesh in _factorizations(n_devices, 3):
         if any(n % d for n, d in zip(grid_shape, mesh)):
             continue
+        any_divisible = True
         block = tuple(n // d for n, d in zip(grid_shape, mesh))
         pick = ps._pick_block_temporal_3d(block, mesh, dtype)
         if pick is None:
@@ -97,7 +100,21 @@ def pick_mesh_shape_scored(n_devices: int, grid_shape,
         if t < best_t:
             best_t, best = t, mesh
     if best is None:
-        return pick_mesh_shape(n_devices, 3)
+        # Fall back to the balanced pick, loudly: a scored pick and a
+        # fallback look identical to the caller, and the balanced pick
+        # may shard z (the measured-slow axis) — a user of --mesh auto
+        # should be able to tell which they got and why.
+        fallback = pick_mesh_shape(n_devices, 3)
+        reason = ("no ndim-factorization of %d divides grid %r (prime "
+                  "or odd extents)" % (n_devices, grid_shape)
+                  if not any_divisible else
+                  "no divisible factorization admits the Mosaic block "
+                  "kernel at grid %r (blocks too small)" % (grid_shape,))
+        warnings.warn(
+            f"pick_mesh_shape_scored: {reason}; falling back to the "
+            f"balanced factorization {fallback}, which the kernel cost "
+            f"model did not score", stacklevel=2)
+        return fallback
     return best
 
 

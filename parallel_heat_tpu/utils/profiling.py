@@ -132,31 +132,45 @@ def calibrated_slope_paired(named_fns, u0, span_s: float = 0.5,
     variants' endpoint measurements, so drift lands on each variant
     alike and the min-of-raw-endpoints slope compares like with like.
     Returns ``{name: seconds per call}``; a variant whose slope comes
-    out non-positive maps to ``None`` (surface it, don't guess).
+    out non-positive maps to ``None`` (surface it, don't guess), and so
+    does one whose ``max_reps`` cannot hold at least 60% of ``span_s``
+    of device work — the same garbage-rate regime
+    :func:`calibrated_slope` refuses with an exception (here a ``None``
+    keeps the other variants' paired comparison alive).
     """
     reps = {}
+    short_span = set()
     for name, fn in named_fns.items():
         t1 = chain_time(fn, u0, 1)
         t33 = chain_time(fn, u0, 33)
         per_est = (t33 - t1) / 32
         if per_est <= 0:
             per_est = span_s / max_reps
-        reps[name] = min(1 + max(32, int(span_s / per_est)), max_reps)
-    t_a = {n: [] for n in named_fns}
-    t_b = {n: [] for n in named_fns}
+        want = 1 + max(32, int(span_s / per_est))
+        # >= 2 so the slope divisor below is never zero, whatever
+        # max_reps a caller passes.
+        reps[name] = max(2, min(want, max_reps))
+        if reps[name] < want and reps[name] * per_est < 0.6 * span_s:
+            short_span.add(name)
+    timed = [n for n in named_fns if n not in short_span]
+    t_a = {n: [] for n in timed}
+    t_b = {n: [] for n in timed}
     for _ in range(batches):
-        for name, fn in named_fns.items():
-            t_a[name].append(chain_time(fn, u0, 1))
-            t_b[name].append(chain_time(fn, u0, reps[name]))
+        for name in timed:
+            t_a[name].append(chain_time(named_fns[name], u0, 1))
+            t_b[name].append(chain_time(named_fns[name], u0, reps[name]))
     out = {}
     for name in named_fns:
+        if name in short_span:
+            out[name] = None
+            continue
         per = (min(t_b[name]) - min(t_a[name])) / (reps[name] - 1)
         out[name] = per if per > 0 else None
     return out
 
 
 def bench_rounds_paired(named_fns, u0, steps_per_call, span_s: float = 0.5,
-                        batches: int = 3):
+                        batches: int = 3, max_reps: int = 3000):
     """Jit, warm, and time a set of round fns with
     :func:`calibrated_slope_paired`; print one line per variant and
     return ``{name: Gcells*steps/s}``.
@@ -179,12 +193,13 @@ def bench_rounds_paired(named_fns, u0, steps_per_call, span_s: float = 0.5,
             continue
         runs[name] = run
     pers = calibrated_slope_paired(runs, u0, span_s=span_s,
-                                   batches=batches)
+                                   batches=batches, max_reps=max_reps)
     cells = math.prod(u0.shape)
     out = {}
     for name, per in pers.items():
         if per is None:
-            print(f"{name:26s}: noisy (non-positive slope)")
+            print(f"{name:26s}: no trustworthy slope "
+                  f"(non-positive, or max_reps spans <60% of span_s)")
             continue
         k = steps_per_call[name]
         g = cells * k / per / 1e9
