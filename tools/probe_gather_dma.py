@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Pin the fused kernel-G gather's raw DMA cost (VERDICT r3 #1).
+
+trace_fused_g.py shows the fused round's entire gap to kernel E lives
+inside the Mosaic call (0.898 vs 0.674 ms/round at 4096² f32) with the
+same bytes moved and slightly *less* sweep arithmetic — so it is either
+(a) the gather's strided-destination copies being slower than E's dense
+full-width copy, or (b) the gather failing to overlap compute. This
+probe measures the DMA patterns alone — no stencil compute — so (a)
+is pinned directly:
+
+- ``dense``    : E's pattern — (W, N) windows of a dense (M, N) HBM
+                 array into a (W, N) slot; row pitch matches.
+- ``gather``   : G-fuse's pattern — (W, by) windows into the first
+                 ``by`` lanes of a (W, Ye) slot (destination rows
+                 strided) plus the (W, tail) tail copy.
+- ``extdense`` : the candidate fix's pattern — (W, Ye) windows of a
+                 persistent (M, Ye) circular-layout HBM array into a
+                 (W, Ye) slot; dense again, at the extended width.
+
+Each kernel double-buffers exactly like the real kernels (start strip
+s+1, wait strip s) and touches one element per strip so nothing is
+dead. Run: python tools/probe_gather_dma.py [--size 4096]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import calibrated_slope_paired
+
+
+def build_probe(M, cols_src, cols_dst, T, k, n_sems, tail=0):
+    """DMA-only strip pipeline: per strip, copy (W, cols_src) from HBM
+    into lanes [0, cols_src) of a (W, cols_dst) slot; if ``tail``, also
+    copy (W, tail) from a second operand into lanes [cols_src, ...)."""
+    W = T + 2 * k
+    n_strips = M // T
+
+    def kernel(*refs):
+        if tail:
+            u_hbm, t_hbm, out_ref, slots, sems = refs
+        else:
+            u_hbm, out_ref, slots, sems = refs
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def copies(slot, strip):
+            # both strip*T and M-W are multiples of the sublane tiling;
+            # Mosaic can't prove it through the minimum, so annotate.
+            start = pl.multiple_of(jnp.minimum(strip * T, M - W), 8)
+            cs = [pltpu.make_async_copy(
+                u_hbm.at[pl.ds(start, W), :],
+                slots.at[slot, :, pl.ds(0, cols_src)],
+                sems.at[slot, 0])]
+            if tail:
+                cs.append(pltpu.make_async_copy(
+                    t_hbm.at[pl.ds(start, W), :],
+                    slots.at[slot, :, pl.ds(cols_src, tail)],
+                    sems.at[slot, 1]))
+            return cs
+
+        @pl.when(s == 0)
+        def _():
+            for c in copies(0, 0):
+                c.start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            for c in copies((s + 1) % 2, s + 1):
+                c.start()
+
+        slot = jax.lax.rem(s, 2)
+        for c in copies(slot, s):
+            c.wait()
+        out_ref[0, 0] = slots[slot, 0, 0]
+
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (2 if tail else 1)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=in_specs,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        out_specs=pl.BlockSpec((1, 1), lambda s: (0, 0),
+                               memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, W, cols_dst), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, n_sems)),
+        ],
+        compiler_params=ps._compiler_params(),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--span", type=float, default=0.5)
+    args = ap.parse_args()
+    M = N = args.size
+    k = 8
+    TAIL = 128
+    Ye = N + TAIL
+    T_e = ps._pick_temporal_strip(M, N, jnp.float32)
+    T_g = ps._pick_block_strip(M, Ye, jnp.float32)
+    if T_e is None or T_g is None:
+        raise SystemExit(f"no feasible strip at width {N} "
+                         f"(T_e={T_e}, T_g={T_g}); pick a smaller --size")
+    print(f"M={M} T_e={T_e} T_g={T_g} Ye={Ye}")
+
+    key = jax.random.PRNGKey(0)
+    u = jax.random.normal(key, (M, N), jnp.float32)
+    u_ext = jax.random.normal(key, (M, Ye), jnp.float32)
+    t_arr = jax.random.normal(key, (M, TAIL), jnp.float32)
+
+    dense = build_probe(M, N, N, T_e, k, 1)
+    gather = build_probe(M, N, Ye, T_g, k, 2, tail=TAIL)
+    extdense = build_probe(M, Ye, Ye, T_g, k, 1)
+
+    fns = {
+        "dense (E pattern)": lambda x: dense(u) + 0 * x[0, 0],
+        "gather (G pattern)": lambda x: gather(u, t_arr) + 0 * x[0, 0],
+        "extdense (fix pattern)": lambda x: extdense(u_ext) + 0 * x[0, 0],
+    }
+    runs = {n: jax.jit(f) for n, f in fns.items()}
+    x0 = jnp.zeros((1, 1), jnp.float32)
+    for r in runs.values():
+        jax.block_until_ready(r(x0))
+    pers = calibrated_slope_paired(runs, x0, span_s=args.span)
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:24s}: no trustworthy slope")
+            continue
+        gb = {"dense (E pattern)": (M // T_e) * (T_e + 2 * k) * N,
+              "gather (G pattern)": (M // T_g) * (T_g + 2 * k) * (N + TAIL),
+              "extdense (fix pattern)": (M // T_g) * (T_g + 2 * k) * Ye,
+              }[name] * 4 / 1e9
+        print(f"{name:24s}: {per*1e3:8.3f} ms/call  "
+              f"{gb/per:7.1f} GB/s achieved")
+
+
+if __name__ == "__main__":
+    main()

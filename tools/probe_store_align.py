@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Price sublane-(mis)aligned sweep stores (VERDICT r3 #1 follow-up).
+
+Kernel E's intermediate sweeps store at 8-row-tile-aligned offsets
+(rows [SUB, T+3*SUB)); fused kernel G's store at offset 1 (rows
+[1, W-1)) — every intermediate store chunk then straddles 8-row tiles,
+which Mosaic must handle with read-modify-write + sublane relayout.
+This probe times the identical ping-pong stencil sweep at store
+offsets 1 / 8 / 9 / 16 on one VMEM-resident buffer pair (finite data —
+the VPU's measured NaN penalty would otherwise poison the comparison,
+see REPORT §2c) to pin what row alignment is worth.
+
+Measured v5e answer (round 4): nothing — all offsets within noise
+(169-173 Gcells/s f32). Kept as the negative-result record.
+
+Run: python tools/probe_store_align.py [--rows 296] [--cols 4224]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import calibrated_slope_paired
+
+SUBSTRIP = 64
+
+
+def build(R, N, lo, rows, D, dtype=jnp.float32):
+    """D ping-pong sweeps over rows [lo, lo+rows) of an (R, N) pair."""
+    def kernel(u_ref, out_ref, scr):
+        a0 = jnp.float32(0.6)
+        cc = jnp.float32(0.1)
+        out_ref[:] = u_ref[:]
+
+        def sweep(src, dst):
+            r0 = lo
+            while r0 < lo + rows:
+                h = min(SUBSTRIP, lo + rows - r0)
+                blk = src[r0 - 1:r0 + h + 1, :].astype(jnp.float32)
+                C = blk[1:-1]
+                U = blk[:-2]
+                Dn = blk[2:]
+                L = jnp.roll(C, 1, axis=1)
+                Rt = jnp.roll(C, -1, axis=1)
+                new = a0 * C + cc * (U + Dn) + cc * (L + Rt)
+                dst[r0:r0 + h, :] = new.astype(dtype)
+                r0 += h
+
+        def double(_, c):
+            del c
+            sweep(out_ref, scr)
+            sweep(scr, out_ref)
+            return 0
+
+        lax.fori_loop(0, D // 2, double, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((R, N), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((R, N), dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=ps._compiler_params(),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=296)   # >= 17 + 256 + 1
+    ap.add_argument("--cols", type=int, default=4224)  # kernel G's Ye
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+    R, N, D = args.rows, args.cols, args.steps
+    dt = jnp.dtype(args.dtype)
+    rows = 256  # swept rows — constant across variants
+    fns = {}
+    for lo in (1, 8, 9, 16):
+        fns[f"store_off={lo}"] = build(R, N, lo, rows, D, dt)
+    u0 = jnp.ones((R, N), dt)
+    runs = {}
+    for name, f in fns.items():
+        r = jax.jit(f)
+        jax.block_until_ready(r(u0))
+        runs[name] = r
+    pers = calibrated_slope_paired(runs, u0, span_s=0.4)
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:14s}: no trustworthy slope")
+            continue
+        per_sweep = per / D
+        print(f"{name:14s}: {per_sweep*1e6:8.2f} us/sweep "
+              f"{rows*N/per_sweep/1e9:7.1f} Gcells/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Sweep-rate vs row width — the fused kernel-G gap's prime suspect
+(VERDICT r3 #1).
+
+Evidence so far: the fused round's whole gap to kernel E lives inside
+the Mosaic call (trace_fused_g.py), the gather DMA is efficient
+(probe_gather_dma.py: 635 GB/s), the gap GROWS with the compute share
+(bf16 at the same geometry: +52%/step vs f32's +31%), and store-row
+alignment is worth nothing (probe_store_align.py). What's left is the
+sweep width itself: kernel G sweeps Ye = by + 128 = 4224 columns — 33
+lane tiles, an odd count — where kernel E sweeps 32. This tool times
+the identical ping-pong stencil sweep at a ladder of widths to expose
+any tile-count cliffs; if 33 tiles is the cliff, the fix is picking a
+tail width that lands Ye on a fast tile count (the extra zero columns
+are ~3% more arithmetic against a ~20% cliff).
+
+Each variant closes over its own (R, width) buffer; the chained timing
+variable is a (1, 1) dummy so all variants share one protocol input.
+
+Run: python tools/probe_sweep_width.py [--widths 4096,4224,4352,4480,4608]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import calibrated_slope_paired
+
+SUBSTRIP = 64
+
+
+def build(R, N, rows, D, dtype=jnp.float32):
+    """D ping-pong sweeps over rows [8, 8+rows) of an (R, N) pair."""
+    lo = 8
+
+    def kernel(u_ref, out_ref, scr):
+        a0 = jnp.float32(0.6)
+        cc = jnp.float32(0.1)
+        out_ref[:] = u_ref[:]
+
+        def sweep(src, dst):
+            r0 = lo
+            while r0 < lo + rows:
+                h = min(SUBSTRIP, lo + rows - r0)
+                blk = src[r0 - 1:r0 + h + 1, :].astype(jnp.float32)
+                C = blk[1:-1]
+                U = blk[:-2]
+                Dn = blk[2:]
+                L = jnp.roll(C, 1, axis=1)
+                Rt = jnp.roll(C, -1, axis=1)
+                new = a0 * C + cc * (U + Dn) + cc * (L + Rt)
+                dst[r0:r0 + h, :] = new.astype(dtype)
+                r0 += h
+
+        def double(_, c):
+            del c
+            sweep(out_ref, scr)
+            sweep(scr, out_ref)
+            return 0
+
+        lax.fori_loop(0, D // 2, double, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((R, N), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((R, N), dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=ps._compiler_params(),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--widths",
+                    default="4096,4224,4352,4480,4608,5120")
+    ap.add_argument("--rows", type=int, default=272)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--span", type=float, default=0.4)
+    args = ap.parse_args()
+    R, D = args.rows, args.steps
+    dt = jnp.dtype(args.dtype)
+    rows = 256
+    widths = [int(w) for w in args.widths.split(",")]
+
+    runs = {}
+    for N in widths:
+        call = build(R, N, rows, D, dt)
+        u = jnp.ones((R, N), dt)
+
+        def fn(x, call=call, u=u):
+            return call(u)[0:1, 0:1] + 0.0 * x
+
+        r = jax.jit(fn)
+        x0 = jnp.zeros((1, 1), dt)
+        jax.block_until_ready(r(x0))
+        runs[f"w={N} ({N // 128} tiles)"] = r
+    x0 = jnp.zeros((1, 1), dt)
+    pers = calibrated_slope_paired(runs, x0, span_s=args.span)
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:20s}: no trustworthy slope")
+            continue
+        N = int(name.split("=")[1].split(" ")[0])
+        per_sweep = per / D
+        print(f"{name:20s}: {per_sweep*1e6:8.2f} us/sweep "
+              f"{rows*N/per_sweep/1e9:7.1f} Gcells/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Device-timeline trace of the fused kernel-G round (VERDICT r3 #1).
+
+REPORT §4b's round-3 tables leave ~15-20% of the fused round's gap to
+kernel E unattributed ("halo-band redundancy plus ppermuted-piece
+traffic" accounts for ~5%). This tool captures `jax.profiler` traces of
+the fused-G round and the kernel-E ceiling on the same volume and
+prints, per variant, every device-plane line's per-op aggregate — the
+Mosaic custom-call time, the XLA glue (exchange concats, boundary
+re-pins), and whatever DMA-queue lines the platform exposes — so the
+per-round timeline can be made to sum to the measured ms/call.
+
+Run on the real chip:  python tools/trace_fused_g.py [--size 4096]
+                       [--dtype float32] [--reps 40]
+"""
+
+import argparse
+import glob
+import json
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.parallel import temporal as tp
+from parallel_heat_tpu.utils.profiling import sync
+
+
+def build_rounds(M, N, dts):
+    dt = jnp.dtype(dts)
+    k = ps._sub_rows(dt)
+    mesh_shape = (1, 1)
+    ax = ("x", "y")
+    gs = (M, N)
+    rounds = {}
+    fused = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
+                                           with_residual=False)
+    if fused is not None:
+        def round_fused(u):
+            t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
+                                                   tail=fused.tail)
+            return fused(u, t, hn, hs, 0, 0)[0]
+        rounds["G-fuse"] = round_fused
+    fnE = ps._build_temporal_strip(gs, dts, 0.1, 0.1, k,
+                                   with_residual=False)
+    if fnE is not None:
+        rounds["E"] = lambda u: fnE(u)[0]
+    return rounds, k
+
+
+def capture(run, u0, reps):
+    """Trace `reps` chained calls; return the xplane file path."""
+    g = jnp.copy(u0)
+    g = run(g)
+    sync(g)  # compile + warm outside the capture
+    d = tempfile.mkdtemp(prefix="heat_traceg_")
+    g = jnp.copy(u0)
+    with jax.profiler.trace(d):
+        for _ in range(reps):
+            g = run(g)
+        sync(g)
+    files = glob.glob(f"{d}/**/*.xplane.pb", recursive=True)
+    return files[0] if files else None
+
+
+def analyze(path, reps, label):
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(path)
+    print(f"\n=== {label} ===")
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        for line in plane.lines:
+            agg = defaultdict(lambda: [0.0, 0])
+            for e in line.events:
+                key = e.name.split(" =")[0]
+                agg[key][0] += e.duration_ns / 1e6
+                agg[key][1] += 1
+            if not agg:
+                continue
+            total = sum(v[0] for v in agg.values())
+            print(f"-- line '{line.name}': {total:.2f} ms total, "
+                  f"{total / reps:.4f} ms/round over {reps} rounds")
+            for key, (ms, cnt) in sorted(agg.items(),
+                                         key=lambda kv: -kv[1][0])[:14]:
+                print(f"   {ms/reps:9.4f} ms/round  x{cnt:5d}  {key[:90]}")
+    return pd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--cols", type=int, default=None)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--reps", type=int, default=40)
+    ap.add_argument("--only", default=None, help="trace just this round")
+    args = ap.parse_args()
+    M = args.size
+    N = args.cols or args.size
+    rounds, k = build_rounds(M, N, args.dtype)
+    print(json.dumps({"block": [M, N], "dtype": args.dtype, "K": k,
+                      "reps": args.reps}))
+    for name, fn in rounds.items():
+        if args.only and name != args.only:
+            continue
+        run = jax.jit(fn)
+        path = capture(run, HeatPlate2D(M, N).init_grid(
+            jnp.dtype(args.dtype)), args.reps)
+        if path is None:
+            print(f"{name}: no xplane captured")
+            continue
+        analyze(path, args.reps, name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""VPU roofline: measure what the vector unit actually sustains, and
+place kernel A's stencil sweep against it (VERDICT r3 #2).
+
+REPORT §2 previously derived the "VPU-bound" ceiling from the stencil
+kernel's own rate — circular. This tool pins the roofline from first
+principles with VMEM-resident microbenchmarks (no HBM traffic in any
+timed loop), in the exact layout the stencil kernels use (f32 (R, N)
+buffers swept in 64-row strips, ping-ponging between two VMEM refs):
+
+- ``fma P=n``  : per strip pass, a depth-``n`` chain of fused
+  multiply-adds (``x = a*x + b`` n times) — one VMEM read + write per
+  pass, ``2n`` flops per element. Low n is VMEM-bandwidth-bound; the
+  saturating rate as n grows is the sustainable VPU flop rate.
+- ``stencil``  : the production 5-point mix per step — 2 sublane-
+  shifted reads (U/D), 2 lane rolls (L/R), 3 mul + 4 add — exactly
+  kernel A's ``strip_new`` arithmetic with coefficient vectors.
+- ``noroll``   : same minus the 2 lane rolls (U/D kept) — prices rolls.
+- ``noshift``  : same minus rolls AND sublane shifts (all operands
+  C-aligned) — the pure-arithmetic floor of the mix.
+
+Every variant runs D steps per kernel call under a ``fori_loop`` so
+per-call dispatch amortizes; rates come from the calibrated paired
+slope. Op accounting per stencil cell-step: 7 flops (3 mul, 4 add),
+2 lane rolls, 2 sublane-shifted operand reads, 1 store (+ cast), and
+the f32 accumulate cast of the load.
+
+Run: python tools/vpu_roofline.py [--rows 512] [--cols 4096] [--json out]
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import calibrated_slope_paired
+
+STRIP = 64  # rows per chunk — kernel A/E/G's _SUBSTRIP
+
+
+def _build(kind, R, N, D, P=8, dtype=jnp.float32):
+    """One Mosaic kernel: D passes of `kind` over a (R, N) buffer."""
+    a = jnp.float32(0.9999)
+    b = jnp.float32(1e-7)
+
+    def kernel(u_ref, out_ref, scr):
+        def strip_pass(src, dst, r, h):
+            if kind == "fma":
+                x = src[r:r + h, :].astype(jnp.float32)
+                for _ in range(P):
+                    x = a * x + b
+                dst[r:r + h, :] = x.astype(dtype)
+                return
+            blk = src[r - 1:r + h + 1, :].astype(jnp.float32)
+            C = blk[1:-1]
+            if kind == "noshift":
+                U, Dn = C, C
+            else:
+                U, Dn = blk[:-2], blk[2:]
+            if kind == "stencil":
+                L = jnp.roll(C, 1, axis=1)
+                Rt = jnp.roll(C, -1, axis=1)
+            else:
+                L, Rt = C, C
+            new = a * C + b * (U + Dn) + b * (L + Rt)
+            dst[r:r + h, :] = new.astype(dtype)
+
+        def sweep(src, dst):
+            r = 1
+            while r < R - 1:
+                h = min(STRIP, R - 1 - r)
+                strip_pass(src, dst, r, h)
+                r += h
+
+        scr[0:1, :] = u_ref[0:1, :]
+        scr[R - 1:R, :] = u_ref[R - 1:R, :]
+        out_ref[:] = u_ref[:]
+
+        def double(_, c):
+            del c
+            sweep(out_ref, scr)
+            sweep(scr, out_ref)
+            return 0
+
+        lax.fori_loop(0, D // 2, double, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((R, N), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((R, N), dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=ps._compiler_params(),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=512)
+    ap.add_argument("--cols", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=64,
+                    help="sweeps per kernel call")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--span", type=float, default=0.5)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    R, N, D = args.rows, args.cols, args.steps
+    dt = jnp.dtype(args.dtype)
+    cells = (R - 2) * N  # swept rows per pass
+
+    variants = {}
+    for p in (1, 2, 4, 8, 16):
+        variants[f"fma P={p}"] = ("fma", p)
+    for kind in ("noshift", "noroll", "stencil"):
+        variants[kind] = (kind, 0)
+
+    u0 = jnp.ones((R, N), dt)
+    runs = {}
+    for name, (kind, p) in variants.items():
+        r = jax.jit(_build(kind, R, N, D, P=p, dtype=dt))
+        jax.block_until_ready(r(u0))
+        runs[name] = r
+    pers = calibrated_slope_paired(runs, u0, span_s=args.span)
+
+    out = {"rows": R, "cols": N, "steps_per_call": D,
+           "dtype": args.dtype, "results": {}}
+    # every pass (fma included) sweeps rows [1, R-1): R-2 rows
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:12s}: no trustworthy slope")
+            continue
+        per_pass = per / D
+        if name.startswith("fma"):
+            p = int(name.split("=")[1])
+            el = (R - 2) * N
+            gflops = 2 * p * el / per_pass / 1e9
+            print(f"{name:12s}: {per_pass*1e6:9.2f} us/pass "
+                  f"{el/per_pass/1e9:7.1f} Gel/s  {gflops:8.1f} Gflop/s")
+            out["results"][name] = {"us_per_pass": per_pass * 1e6,
+                                    "gflops": gflops}
+        else:
+            gc = cells / per_pass / 1e9
+            print(f"{name:12s}: {per_pass*1e6:9.2f} us/pass "
+                  f"{gc:7.1f} Gcells/s  ({7*gc:7.1f} Gflop/s arith)")
+            out["results"][name] = {"us_per_pass": per_pass * 1e6,
+                                    "gcells_per_s": gc}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()
