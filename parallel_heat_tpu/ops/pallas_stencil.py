@@ -819,7 +819,10 @@ def _pick_block_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     for t in range(sub, min(256, out_rows) + 1, sub):
         if out_rows % t != 0:
             continue
-        cost = (3 * (t + 2 * sub) + 2 * t) * n_cols * itemsize + temps
+        # Scratch rows charged at the uniform builder's SCR = t+4*sub
+        # (the largest of the block-family layouts; fused/circular use
+        # t+2*sub, so this is slightly conservative for them).
+        cost = (3 * (t + 4 * sub) + 2 * t) * n_cols * itemsize + temps
         if cost <= budget:
             best = t
     return best
@@ -1219,6 +1222,35 @@ def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
     return fn
 
 
+def _finish_block_2d(u, core, res, row_off, col_off, block_shape,
+                     grid_shape, defer_ns):
+    """Shared epilogue of the fused/uniform kernel-G builders: re-pin
+    global Dirichlet cells from the input block (the multiplicative
+    pinning's 0*inf would otherwise leak a diverging run's NaN into
+    the output boundary). In ``defer_ns`` mode the N/S rows are
+    skipped: the band kernel overwrites them (with its own pinning)
+    either way. One definition so the two builders' bitwise-equality
+    contract cannot silently diverge (the ``_pinned_coeffs`` rationale).
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    ro = jnp.int32(row_off)
+    co = jnp.int32(col_off)
+
+    def fix_row(cr, i, pred):
+        return cr.at[i, :].set(jnp.where(pred, u[i, :], cr[i, :]))
+
+    def fix_col(cr, j, pred):
+        return cr.at[:, j].set(jnp.where(pred, u[:, j], cr[:, j]))
+
+    if not defer_ns:
+        core = fix_row(core, 0, ro == 0)
+        core = fix_row(core, bx - 1, ro + bx == NX)
+    core = fix_col(core, 0, co == 0)
+    core = fix_col(core, by - 1, co + by == NY)
+    return core, res[0, 0]
+
+
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
                                 grid_shape, k, vma=None,
@@ -1468,38 +1500,283 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
         compiler_params=_compiler_params(),
     )
 
-    def finish(u, core, res, row_off, col_off):
-        # Diverging-run guard (same as the circular builder): re-pin
-        # global Dirichlet cells from the input block — the
-        # multiplicative pinning's 0*inf would otherwise leak NaN.
-        # In defer_ns mode the N/S rows are skipped: the band kernel
-        # overwrites them (with its own pinning) either way.
-        ro = jnp.int32(row_off)
-        co = jnp.int32(col_off)
+    if defer_ns:
+        def fn(u, tail_arr, row_off, col_off):
+            offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+            core, res = call(offs, u, tail_arr)
+            return _finish_block_2d(u, core, res, row_off, col_off,
+                                    block_shape, grid_shape, defer_ns)
+    else:
+        def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
+            offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
+            core, res = call(offs, u, tail_arr, halo_n, halo_s)
+            return _finish_block_2d(u, core, res, row_off, col_off,
+                                    block_shape, grid_shape, defer_ns)
 
-        def fix_row(cr, i, pred):
-            return cr.at[i, :].set(jnp.where(pred, u[i, :], cr[i, :]))
+    fn.tail = tail
+    return fn
 
-        def fix_col(cr, j, pred):
-            return cr.at[:, j].set(jnp.where(pred, u[:, j], cr[:, j]))
 
+@functools.lru_cache(maxsize=32)
+def _build_temporal_block_uniform(block_shape, dtype_name, cx, cy,
+                                  grid_shape, k, vma=None,
+                                  with_residual=True, defer_ns=False):
+    """Kernel G, uniform-window fused variant (round 4) — same
+    interface, operands and bitwise outputs as
+    :func:`_build_temporal_block_fused`, with the strip DMA issued the
+    way kernel E issues it: every strip fetches the SAME ``W``-row
+    window shape through :func:`_clamped_window` (edge windows slide
+    inward; the destination offset compensates so core row 0 always
+    lands at scratch row ``2k``), so the big u/tail copies are
+    UNCONDITIONAL — no per-strip ``pl.when`` branch structure around
+    them — and only the k-row neighbor strips (``halo_n``/``halo_s``)
+    remain conditional, on the first/last strip. In ``defer_ns`` mode
+    (the production overlapped round's bulk call) those operands do not
+    exist and the DMA schedule is entirely branch-free.
+
+    Why: round-4 measurement (tools/trace_fused_g.py,
+    tools/ab_g_dmaonly.py) pinned the fused round's whole gap to
+    kernel E inside the Mosaic call and showed it is exactly ADDITIVE —
+    dma 0.258 ms + sweeps 0.669 ms = 0.927 ms measured at 4096² f32
+    K=8, where kernel E hides the same-order DMA behind the same
+    sweeps (0.732 ms ≈ max, not sum). Per-feature probes
+    (tools/probe_split_copy.py) could not isolate the overlap killer
+    above the cross-executable noise floor, so this builder removes
+    every structural difference from kernel E's pipeline at once and
+    the A/B against the branchy builder is the measurement of record
+    (tools/ab_fused_g.py).
+
+    Scratch geometry: ``SCR = W + 2k`` rows per buffer (kernel E's
+    exact convention for the same pipeline), core row 0 at
+    ``C0 = 2k`` (sublane-tile aligned for f32 AND sub-f32). Data spans
+    per strip: interior ``[k, k+W)``; first strip ``[2k, 2k+W)`` plus
+    ``halo_n`` at ``[k, 2k)``; last strip ``[0, W)`` plus ``halo_s`` at
+    ``[W, W+k)``. Intermediate sweeps cover the fixed aligned range
+    ``[k, T+3k)`` (W rows, kernel E's exact shape); rows ``k-1`` and
+    ``T+3k`` are read but never swept, and are zeroed once at program 0
+    (both slots + ping-pong, BEFORE any DMA start — ordering, not a
+    race: where a later strip-0 window covers row ``T+3k``, the DMA
+    lands after the store and real data wins). The frontier arithmetic
+    is exactly as tight as the branchy builder's: garbage from the
+    unwritten/stale boundary rows advances one row per step and never
+    reaches the core (non-defer), or reaches exactly the first/last
+    ``k-1`` core rows the band kernel owns (``defer_ns`` — in that
+    mode the would-be halo rows ``[k, 2k)`` / ``[W, W+k)`` are also
+    zeroed at program 0 so the first call computes on zeros, not
+    uninitialized NaNs, which the v5e VPU runs 3.8x slower on).
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    if k != SUB or bx < SUB:
+        return None
+    if _needs_lane_alignment():
+        if by % _LANE != 0:
+            return None
+        tail = ((2 * k + _LANE - 1) // _LANE) * _LANE
+    else:
+        tail = 2 * k
+    Ye = by + tail
+    T = _pick_block_strip(bx, Ye, dtype)
+    if T is None:
+        return None
+    n_strips = bx // T
+    W = T + 2 * SUB
+    if n_strips > 1 and bx < W:
+        # Only reachable at n_strips == 2 with T == k: the clamped
+        # window's bounds invert (bx - W < 0). Decline — the picker
+        # chain falls back to the branchy fused builder, which handles
+        # this tiny geometry with its explicit 2-strip branches.
+        return None
+    SCR = W + 2 * SUB
+    C0 = 2 * SUB
+
+    def kernel(offs_ref, *refs):
+        if defer_ns:
+            u_hbm, tail_hbm = refs[:2]
+            hn_hbm = hs_hbm = None
+            out_ref, res_ref, slots, pp, sems = refs[2:]
+        else:
+            u_hbm, tail_hbm, hn_hbm, hs_hbm = refs[:4]
+            out_ref, res_ref, slots, pp, sems = refs[4:]
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        row_off = offs_ref[0]
+        col_off = offs_ref[1]
+
+        cols_l = lax.broadcasted_iota(jnp.int32, (1, Ye), 1)
+        cols_g = col_off + jnp.where(cols_l >= Ye - k, cols_l - Ye,
+                                     cols_l)
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        corecols = cols_l < by
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+
+        if n_strips == 1:
+            rows, start0, dst00 = bx, 0, C0
+        else:
+            rows = W
+
+        def copies(slot, strip):
+            """The unconditional per-strip gather: u's window into
+            lanes [0, by), the column tail into [by, Ye) — same rows,
+            same destination offset, every strip."""
+            if n_strips == 1:
+                start, dst0 = start0, dst00
+            else:
+                start, dst0 = _clamped_window(strip, T, k, bx, W, SUB,
+                                              C0)
+            return [
+                pltpu.make_async_copy(
+                    u_hbm.at[pl.ds(start, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(0, by)],
+                    sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    tail_hbm.at[pl.ds(start, rows), :],
+                    slots.at[slot, pl.ds(dst0, rows), pl.ds(by, tail)],
+                    sems.at[slot, 1]),
+            ]
+
+        def hn_copy(slot):
+            return pltpu.make_async_copy(
+                hn_hbm.at[:, :], slots.at[slot, pl.ds(C0 - k, k), :],
+                sems.at[slot, 2])
+
+        def hs_copy(slot):
+            # Last strip's window sits at dst0 = 0 (n > 1) or C0
+            # (n == 1); its data ends k rows past the core, where the
+            # south neighbor rows belong.
+            dst = C0 + bx - (n_strips - 1) * T if n_strips == 1 else W
+            return pltpu.make_async_copy(
+                hs_hbm.at[:, :], slots.at[slot, pl.ds(dst, k), :],
+                sems.at[slot, 3])
+
+        zrow = jnp.zeros((1, Ye), dtype)
+        zband = jnp.zeros((k, Ye), dtype)
+
+        @pl.when(s == 0)
+        def _():
+            # Sentinels first, then the DMA starts (see docstring).
+            for sl in range(2 if n_strips > 1 else 1):
+                slots[sl, C0 - k - 1:C0 - k, :] = zrow
+                slots[sl, T + 3 * SUB:T + 3 * SUB + 1, :] = zrow
+                if defer_ns:
+                    slots[sl, C0 - k:C0, :] = zband
+                    slots[sl, W:W + k, :] = zband
+            pp[C0 - k - 1:C0 - k, :] = zrow
+            pp[T + 3 * SUB:T + 3 * SUB + 1, :] = zrow
+            for c in copies(0, 0):
+                c.start()
+            if not defer_ns:
+                hn_copy(0).start()
+                if n_strips == 1:
+                    hs_copy(0).start()
+
+        @pl.when(s + 1 < n)
+        def _():
+            for c in copies((s + 1) % 2, s + 1):
+                c.start()
+
+        if n_strips > 1 and not defer_ns:
+            @pl.when(s == n - 2)
+            def _():
+                hs_copy((n_strips - 1) % 2).start()
+
+        slot = lax.rem(s, 2)
+        for c in copies(slot, s):
+            c.wait()
         if not defer_ns:
-            core = fix_row(core, 0, ro == 0)
-            core = fix_row(core, bx - 1, ro + bx == NX)
-        core = fix_col(core, 0, co == 0)
-        core = fix_col(core, by - 1, co + by == NY)
-        return core, res[0, 0]
+            @pl.when(s == 0)
+            def _():
+                hn_copy(slot).wait()
+
+            @pl.when(s == n - 1)
+            def _():
+                hs_copy(slot).wait()
+
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, row_off + s * T, C0, NX, dtype)
+
+        m = k - 1
+        sref = slots.at[slot]
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, k, T + 3 * SUB)
+            step_into(pp, sref, k, T + 3 * SUB)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, k, T + 3 * SUB)
+            src = pp
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new[:, :by].astype(dtype)
+            if with_residual:
+                keep = corecols
+                if defer_ns:
+                    rows_l = (s * T + (r0 - C0)
+                              + lax.broadcasted_iota(jnp.int32, (h, 1), 0))
+                    keep = keep & (rows_l >= k) & (rows_l < bx - k)
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.where(keep, jnp.abs(new - C), 0.0)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    n_ops = 2 if defer_ns else 4
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
+        out_shape=(
+            jax.ShapeDtypeStruct((bx, by), dtype, **kw),
+            jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, by), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, Ye), dtype),
+            pltpu.VMEM((SCR, Ye), dtype),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
 
     if defer_ns:
         def fn(u, tail_arr, row_off, col_off):
             offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
             core, res = call(offs, u, tail_arr)
-            return finish(u, core, res, row_off, col_off)
+            return _finish_block_2d(u, core, res, row_off, col_off,
+                                    block_shape, grid_shape, defer_ns)
     else:
         def fn(u, tail_arr, halo_n, halo_s, row_off, col_off):
             offs = jnp.stack([jnp.int32(row_off), jnp.int32(col_off)])
             core, res = call(offs, u, tail_arr, halo_n, halo_s)
-            return finish(u, core, res, row_off, col_off)
+            return _finish_block_2d(u, core, res, row_off, col_off,
+                                    block_shape, grid_shape, defer_ns)
 
     fn.tail = tail
     return fn
@@ -1709,27 +1986,35 @@ def pick_block_temporal_2d_deferred(config, axis_names):
     band = _build_band_fix_2d(*args)
     if band is None:
         return None
-    bulk = _build_temporal_block_fused(*args, defer_ns=True)
-    if bulk is None:
-        return None
-    return (bulk, _build_temporal_block_fused(*args, defer_ns=True,
-                                              with_residual=False),
-            band, _build_band_fix_2d(*args, with_residual=False))
+    # The bulk call prefers the uniform-window builder (round 4: the
+    # branch-free DMA schedule measurably overlaps compute where the
+    # branchy one ran additive; outputs bitwise identical).
+    for bulk_builder in (_build_temporal_block_uniform,
+                         _build_temporal_block_fused):
+        bulk = bulk_builder(*args, defer_ns=True)
+        if bulk is not None:
+            return (bulk, bulk_builder(*args, defer_ns=True,
+                                       with_residual=False),
+                    band, _build_band_fix_2d(*args, with_residual=False))
+    return None
 
 
 def pick_block_temporal_2d(config, axis_names):
     """The 2D K-deep round's kernel decision:
-    ``(kind, built, built_plain)`` with kind in {"G-fuse", "G-circ",
-    "G", "jnp"}
+    ``(kind, built, built_plain)`` with kind in {"G-uni", "G-fuse",
+    "G-circ", "G", "jnp"}
     — one decision site shared by ``temporal._pallas_round_2d``
     (execution), ``solver.explain`` (reporting) and
     ``solver._resolve_halo_depth`` (the auto-depth probe); see
-    :func:`pick_single_2d` for the rationale. The fused-assembly
-    variant is preferred (no extended-block HBM materialization at
-    all); the assembled circular layout is the fallback for parity/
-    A/B, then the legacy padded layout, then the jnp rounds. The
-    fused and circular guards are identical today, so the circular
-    branch is reachable only if the guards ever diverge.
+    :func:`pick_single_2d` for the rationale. The uniform-window
+    fused variant is preferred (round 4: branch-free DMA schedule
+    that measurably overlaps compute — 165.9 vs the branchy fused's
+    115.8 Gcells*steps/s/device at the 4096² f32 block in the same
+    paired run); then the branchy fused assembly (still no
+    extended-block HBM materialization; also serves the tiny
+    2-strip geometry the uniform builder declines), then the
+    assembled circular layout, then the legacy padded layout, then
+    the jnp rounds.
     ``built_plain`` is the with_residual=False twin, built here from
     the SAME args so the two variants can never silently diverge
     (rounds whose residual the caller discards use it — kernel E's
@@ -1743,6 +2028,10 @@ def pick_block_temporal_2d(config, axis_names):
     bx_by = config.block_shape()
     args = (bx_by, config.dtype, float(config.cx), float(config.cy),
             config.shape, K, tuple(axis_names))
+    built = _build_temporal_block_uniform(*args)
+    if built is not None:
+        return ("G-uni", built,
+                _build_temporal_block_uniform(*args, with_residual=False))
     built = _build_temporal_block_fused(*args)
     if built is not None:
         return ("G-fuse", built,

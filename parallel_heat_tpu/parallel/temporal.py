@@ -367,14 +367,14 @@ def _pallas_round_2d(config, kw):
     mesh_shape = kw["mesh_shape"]
     block_index = kw["block_index"]
 
-    if kind in ("G-fuse", "G-circ"):
+    if kind in ("G-uni", "G-fuse", "G-circ"):
         # axis_index('x') varies only on 'x'; broaden (see block_steps).
         row_off = lax.pcast(block_index[0] * bx, (axis_names[1],),
                             to="varying")
         col_off = lax.pcast(block_index[1] * by, (axis_names[0],),
                             to="varying")
 
-        if kind == "G-fuse":
+        if kind in ("G-uni", "G-fuse"):
             deferred = ps.pick_block_temporal_2d_deferred(config,
                                                           axis_names)
             if deferred is not None:

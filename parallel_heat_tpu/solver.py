@@ -500,12 +500,14 @@ def explain(config: HeatConfig) -> dict:
             if config.ndim == 2 and config.halo_depth == sub:
                 kind, built, _ = ps.pick_block_temporal_2d(
                     config, AXIS_NAMES[:2])
-                if kind == "G-fuse":
+                if kind in ("G-uni", "G-fuse"):
                     overl = ps.pick_block_temporal_2d_deferred(
                         config, AXIS_NAMES[:2]) is not None
+                    layout = ("uniform-window fused"
+                              if kind == "G-uni" else "fused")
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}, "
-                        f"fused exchange assembly"
+                        f"{layout} exchange assembly"
                         + (", deferred N/S bands — phase-2 ppermutes "
                            "overlap the bulk kernel" if overl else "")
                         + f") per exchange round, tail {built.tail}")

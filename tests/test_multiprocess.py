@@ -59,7 +59,7 @@ from parallel_heat_tpu.parallel.mesh import AXIS_NAMES as _AX
 pal_cfg = HeatConfig(**kw, mesh_shape=(2, 4),
                      halo_depth=8).replace(backend="pallas")
 kind, _, _ = _ps.pick_block_temporal_2d(pal_cfg, _AX[:2])
-assert kind == "G-fuse", f"expected the Mosaic round, got {{kind}}"
+assert kind in ("G-uni", "G-fuse"), f"expected the Mosaic round, got {{kind}}"
 pal = solve(pal_cfg)
 assert pal.steps_run == 30
 np.testing.assert_allclose(

@@ -279,7 +279,7 @@ def test_kernel_g_fused_matches_circular_legacy_and_jnp():
     cfg = HeatConfig(backend="pallas", mesh_shape=(2, 2), halo_depth=8,
                      **kw)
     kind, _, _ = ps.pick_block_temporal_2d(cfg, AXIS_NAMES[:2])
-    assert kind == "G-fuse"
+    assert kind == "G-uni"  # round 4: uniform-window layout preferred
     assert ps.pick_block_temporal_2d_deferred(cfg, AXIS_NAMES[:2]) \
         is not None  # 16-row blocks host the overlapped round
     overlapped = solve(cfg).to_numpy()
@@ -299,7 +299,14 @@ def test_kernel_g_fused_matches_circular_legacy_and_jnp():
         slv._build_runner.cache_clear()
         assert ps.pick_block_temporal_2d_deferred(
             cfg, AXIS_NAMES[:2]) is None
+        uniform = solve(cfg).to_numpy()
+        mp.setattr(ps, "_build_temporal_block_uniform",
+                   lambda *a, **k: None)
+        slv._build_runner.cache_clear()
+        kind, _, _ = ps.pick_block_temporal_2d(cfg, AXIS_NAMES[:2])
+        assert kind == "G-fuse"
         fused = solve(cfg).to_numpy()
+        np.testing.assert_array_equal(uniform, fused)
         mp.setattr(ps, "_build_temporal_block_fused",
                    lambda *a, **k: None)
         slv._build_runner.cache_clear()

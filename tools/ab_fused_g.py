@@ -49,6 +49,16 @@ def main():
 
     rounds = {}
     steps_per_call = {}
+    uni = ps._build_temporal_block_uniform(gs, dts, 0.1, 0.1, gs, k,
+                                           with_residual=False)
+    if uni is not None:
+        def round_uni(u):
+            t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
+                                                   tail=uni.tail)
+            return uni(u, t, hn, hs, 0, 0)[0]
+        rounds["G-uni (uniform windows)"] = round_uni
+    else:
+        print("G-uni: builder declined")
     fused = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
                                            with_residual=False)
     circ = ps._build_temporal_block_circular(gs, dts, 0.1, 0.1, gs, k,
@@ -61,9 +71,13 @@ def main():
         rounds["G-fuse (fused assembly)"] = round_fused
     else:
         print("G-fuse: builder declined")
-    defer = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
-                                           with_residual=False,
-                                           defer_ns=True)
+    # Overlapped round's bulk: the production pick (uniform first).
+    defer = (ps._build_temporal_block_uniform(gs, dts, 0.1, 0.1, gs, k,
+                                              with_residual=False,
+                                              defer_ns=True)
+             or ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
+                                               with_residual=False,
+                                               defer_ns=True))
     bandk = ps._build_band_fix_2d(gs, dts, 0.1, 0.1, gs, k,
                                   with_residual=False)
     if defer is not None and bandk is not None:

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Compute-less (DMA-only) A/B of kernels G-fuse and E (VERDICT r3 #1).
+
+Times the real kernels' full DMA + grid-loop + output-pipeline
+structure with the VPU sweeps removed: ``_pinned_stepper`` is patched
+to emit zero chunks and no-op intermediate sweeps, and — the lesson of
+a discarded earlier tool — the patched builds are TRACED AND COMPILED
+INSIDE the patch context (Pallas traces kernel bodies at first jit
+trace, not at builder time; a patch that has already exited by then
+silently measures the unpatched kernel). Data stays real (all DMAs
+run), so the VPU's measured NaN penalty cannot confound anything: no
+sweeps execute at all.
+
+  G-dmaonly vs E-dmaonly  — the two kernels' DMA/pipeline structures
+                            compared directly;
+  G − G-dmaonly           — what the sweeps + their interaction with
+                            the gather cost inside G;
+  E − E-dmaonly           — same for E's dense single-copy pipeline.
+
+A sanity guard warns if a dmaonly variant fails to run well under its
+full counterpart — the signature of a patch that did not take.
+
+Run: python tools/ab_g_dmaonly.py [--size 4096] [--dtype float32]
+"""
+
+import argparse
+import sys
+from unittest import mock
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.parallel import temporal as tp
+from parallel_heat_tpu.utils.profiling import calibrated_slope_paired
+
+
+def _fake_pinned_stepper(coeffs, row_base, c0, nx, dtype):
+    def chunk_new(src, r0, h):
+        z = jnp.zeros((h, src.shape[1]), jnp.float32)
+        return z, z
+
+    def step_into(src, dst, lo, hi):
+        pass
+
+    return chunk_new, step_into
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--cols", type=int, default=None)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+    M = args.size
+    N = args.cols or args.size
+    dts = args.dtype
+    dt = jnp.dtype(dts)
+    k = ps._sub_rows(dt)
+    gs = (M, N)
+    ax = ("x", "y")
+    mesh_shape = (1, 1)
+    print(f"block {M}x{N} {dts} K={k}")
+    u0 = jax.block_until_ready(HeatPlate2D(M, N).init_grid(dt))
+
+    def ground(f):
+        def round_f(u):
+            t, hn, hs = tp.exchange_halos_fused_2d(u, k, mesh_shape, ax,
+                                                   tail=f.tail)
+            return f(u, t, hn, hs, 0, 0)[0]
+        return round_f
+
+    runs = {}
+    fused = ps._build_temporal_block_fused(gs, dts, 0.1, 0.1, gs, k,
+                                           with_residual=False)
+    fnE = ps._build_temporal_strip(gs, dts, 0.1, 0.1, k,
+                                   with_residual=False)
+    if fused is not None:
+        runs["G"] = jax.jit(ground(fused))
+    if fnE is not None:
+        runs["E"] = jax.jit(lambda u: fnE(u)[0])
+
+    # DMA-only builds: bypass the lru_cache AND trace/compile inside
+    # the patch so the kernel bodies really see the fake stepper.
+    with mock.patch.object(ps, "_pinned_stepper", _fake_pinned_stepper):
+        fused_d = ps._build_temporal_block_fused.__wrapped__(
+            gs, dts, 0.1, 0.1, gs, k, with_residual=False)
+        fnE_d = ps._build_temporal_strip.__wrapped__(
+            gs, dts, 0.1, 0.1, k, with_residual=False)
+        if fused_d is not None:
+            runs["G-dmaonly"] = (jax.jit(ground(fused_d))
+                                 .lower(u0).compile())
+        if fnE_d is not None:
+            runs["E-dmaonly"] = (jax.jit(lambda u: fnE_d(u)[0])
+                                 .lower(u0).compile())
+
+    for name, r in runs.items():
+        jax.block_until_ready(r(u0))
+    pers = calibrated_slope_paired(runs, u0, span_s=0.5)
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:12s}: no trustworthy slope")
+            continue
+        print(f"{name:12s}: {per*1e3:8.3f} ms/call")
+    for pair in (("G", "G-dmaonly"), ("E", "E-dmaonly")):
+        full, dmao = (pers.get(p) for p in pair)
+        if full and dmao and dmao > 0.6 * full:
+            print(f"WARNING: {pair[1]} is {dmao/full:.0%} of {pair[0]} "
+                  f"— the stepper patch may not have taken")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Does splitting the strip DMA kill kernel E's compute overlap?
+(VERDICT r3 #1 — the decisive experiment.)
+
+ab_g_dmaonly.py showed the fused kernel-G round is perfectly ADDITIVE
+(dma 0.258 + sweeps 0.669 = 0.927 measured) while kernel E hides its
+DMA behind the same sweeps (0.732 ≈ max, not sum). The kernels share
+the sweep code; E issues ONE dense full-width copy per strip on one
+semaphore, G issues 2-4 lane-sliced copies on separate semaphores.
+This probe rebuilds kernel E's exact strip pipeline with its one copy
+split several ways, full compute kept:
+
+- ``whole``     : one (W, N) copy, one semaphore — E as shipped;
+- ``lanes2``    : two (W, N/2) lane-sliced copies, two semaphores —
+                  G's gather form (core+tail) minus the width change;
+- ``lanes2-1sem``: same two copies, ONE shared semaphore;
+- ``rows2``     : two (W/2, N) row-sliced copies, two semaphores;
+- ``lanes4``    : four lane-sliced copies — G's edge-strip form.
+- ``subwin``    : slots widened to N+128 lanes; the copy writes lanes
+                  [0, N) only — G's destination-sub-window form (the
+                  sweep still reads N lanes, so compute is unchanged);
+- ``branchy``   : same data as ``whole`` but the copies are issued
+                  inside per-strip ``pl.when`` branches (first /
+                  last / interior) — G's issue() structure.
+
+Measured v5e answer (round 4): whole/lanes2/lanes2-1sem/rows2/lanes4
+all tie at 0.68 ms — split copies and multiple semaphores do NOT cost
+the overlap; the suspects are the sub-window destination and the
+branch-conditional issue structure.
+
+Run: python tools/probe_split_copy.py [--size 4096]
+"""
+
+import argparse
+import functools
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+
+def build(shape, k, split):
+    """Kernel E (fixed offsets, no residual) with a configurable
+    strip-copy split. Mirrors _build_temporal_strip's pipeline."""
+    M, N = shape
+    dtype = jnp.float32
+    SUB = ps._sub_rows(dtype)
+    T = ps._pick_temporal_strip(M, N, dtype)
+    n_strips = M // T
+    W = T + 2 * SUB
+    SCR = T + 4 * SUB
+    C0 = 2 * SUB
+    n_sems = {"whole": 1, "lanes2": 2, "lanes2-1sem": 1,
+              "rows2": 2, "lanes4": 4, "subwin": 1, "branchy": 1}[split]
+    NS = N + 128 if split == "subwin" else N  # slot lane width
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        colmask = (cols >= 1) & (cols <= N - 2)
+        coeffs = ps._pinned_coeffs(colmask, 0.1, 0.1)
+
+        def copies(slot, strip):
+            start, dst0 = ps._clamped_window(strip, T, SUB, M, W, SUB, C0)
+            cs = []
+            if split in ("whole", "branchy"):
+                cs.append(pltpu.make_async_copy(
+                    u_hbm.at[pl.ds(start, W), :],
+                    slots.at[slot, pl.ds(dst0, W), :],
+                    sems.at[slot, 0]))
+            elif split in ("lanes2", "lanes2-1sem"):
+                h = N // 2
+                for i in range(2):
+                    cs.append(pltpu.make_async_copy(
+                        u_hbm.at[pl.ds(start, W), pl.ds(i * h, h)],
+                        slots.at[slot, pl.ds(dst0, W), pl.ds(i * h, h)],
+                        sems.at[slot, 0 if split == "lanes2-1sem" else i]))
+            elif split == "lanes4":
+                h = N // 4
+                for i in range(4):
+                    cs.append(pltpu.make_async_copy(
+                        u_hbm.at[pl.ds(start, W), pl.ds(i * h, h)],
+                        slots.at[slot, pl.ds(dst0, W), pl.ds(i * h, h)],
+                        sems.at[slot, i]))
+            elif split == "rows2":
+                h = W // 2
+                for i in range(2):
+                    cs.append(pltpu.make_async_copy(
+                        u_hbm.at[pl.ds(start + i * h, h), :],
+                        slots.at[slot, pl.ds(dst0 + i * h, h), :],
+                        sems.at[slot, i]))
+            elif split == "subwin":
+                cs.append(pltpu.make_async_copy(
+                    u_hbm.at[pl.ds(start, W), :],
+                    slots.at[slot, pl.ds(dst0, W), pl.ds(0, N)],
+                    sems.at[slot, 0]))
+            return cs
+
+        def emit(slot, strip, start):
+            """Issue (or wait) a strip's copies — under G's three-way
+            per-strip branch structure for the `branchy` variant,
+            unconditionally otherwise."""
+            def go():
+                for c in copies(slot, strip):
+                    c.start() if start else c.wait()
+
+            if split != "branchy":
+                go()
+                return
+
+            @pl.when(strip == 0)
+            def _():
+                go()
+
+            @pl.when(strip == n_strips - 1)
+            def _():
+                go()
+
+            if n_strips > 2:
+                @pl.when((strip > 0) & (strip < n_strips - 1))
+                def _():
+                    go()
+
+        @pl.when(s == 0)
+        def _():
+            emit(0, 0, True)
+
+        @pl.when(s + 1 < n)
+        def _():
+            emit((s + 1) % 2, s + 1, True)
+
+        slot = lax.rem(s, 2)
+        zband_s = jnp.zeros((2 * SUB, NS), dtype)
+        zband = jnp.zeros((2 * SUB, N), dtype)
+
+        @pl.when(s == 0)
+        def _():
+            slots[0, 0:C0, :] = zband_s
+            pp[0:C0, :] = zband
+
+        @pl.when(s == n - 1)
+        def _():
+            slots.at[slot][W:SCR, :] = zband_s
+            pp[W:SCR, :] = zband
+
+        emit(slot, s, False)
+        sref = (slots.at[slot, :, pl.ds(0, N)] if split == "subwin"
+                else slots.at[slot])
+        chunk_new, step_into = ps._pinned_stepper(coeffs, s * T, C0, M,
+                                                  dtype)
+        m = k - 1
+
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            step_into(pp, sref, SUB, T + 3 * SUB)
+            return 0
+
+        lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, SUB, T + 3 * SUB)
+            src = pp
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(ps._SUBSTRIP, C0 + T - r0)
+            new, _ = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = jnp.float32(0.0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, N), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, NS), dtype),
+            pltpu.VMEM((SCR, N), dtype),
+            pltpu.SemaphoreType.DMA((2, n_sems)),
+        ],
+        compiler_params=ps._compiler_params(),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    args = ap.parse_args()
+    M = N = args.size
+    k = 8
+    u0 = jax.block_until_ready(
+        HeatPlate2D(M, N).init_grid(jnp.float32))
+    rounds = {}
+    for split in ("whole", "subwin", "branchy", "lanes2"):
+        call = build((M, N), k, split)
+        rounds[split] = (lambda c: (lambda u: c(u)[0]))(call)
+    bench_rounds_paired(rounds, u0, {n: k for n in rounds})
+
+
+if __name__ == "__main__":
+    main()
