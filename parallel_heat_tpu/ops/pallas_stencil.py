@@ -2854,6 +2854,44 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
 # Kernel F: 3D X-slab streaming, temporal-blocked
 # --------------------------------------------------------------------------
 
+def _xslab_cost_2slot(scr, sx, ext_plane, out_plane, k,
+                      itemsize) -> int:
+    """The X-slab family's shared 2-slot VMEM estimate: 2 DMA slots +
+    ping-pong (k > 1) of ``scr`` extended planes, double-buffered out
+    block of ``sx`` core planes, f32 chunk temporaries (+1 cast
+    temporary for sub-f32 storage). One definition — the pickers, the
+    slot-count gate and the builders must price the same footprint or
+    the gate admits geometries the build then dies on."""
+    plane = ext_plane * itemsize
+    ch = _xslab_chunk(ext_plane * 4)
+    cost = (2 * scr * plane + (scr * plane if k > 1 else 0)
+            + 2 * sx * out_plane * itemsize + 4 * ch * ext_plane * 4)
+    if itemsize < 4:
+        cost += ch * ext_plane * 4
+    return cost
+
+
+def _xslab_n_slots(scr_planes: int, plane_bytes: int,
+                   base_cost: int) -> int:
+    """DMA slot count for the X-slab pipelines: 3 when VMEM affords
+    the third slot, else the classic double buffer.
+
+    Round 3 left the X-slab kernels' small-plane DMA non-overlap as an
+    open question (512³-class planes overlap, 256³-class shard blocks
+    measure additive). Round 4's A/B (tools/ab_xslab_slots.py) pinned
+    it: with lookahead 2 the copies hide again — 256³ (sx=32, K=2)
+    measured 123.5 vs the double buffer's 86.9 Gcells*steps/s, (32,4)
+    119-131 vs 97.6 — the two-slot pipeline simply gives the DMA
+    engine too little slack at short copies. The budget naturally
+    gates the upgrade to exactly the small-plane regime that needs it:
+    512³-class planes can't afford a third slot and already overlap.
+    ``base_cost`` is the builder's 2-slot VMEM estimate.
+    """
+    hw = _params()
+    budget = int(hw.vmem_admission_margin * hw.vmem_limit_bytes)
+    return 3 if base_cost + scr_planes * plane_bytes <= budget else 2
+
+
 def _xslab_chunk(plane_f32: int) -> int:
     """Compute-chunk planes for kernel F: bounds the ~4 full-chunk f32
     stencil temporaries to ~24 MiB. The picker's VMEM cost model and the
@@ -2887,8 +2925,6 @@ def _pick_xslab_3d(shape, dtype):
         # a compile-time MosaicError). Kernel D's Y-strip divisibility
         # implies alignment already; only this picker needs the guard.
         return None
-    plane = Y * Z * itemsize
-    plane_f32 = Y * Z * 4
     hw = _params()
     # Budget = the full vmem_limit, NOT the conservative stream budget:
     # this picker's cost model systematically overcounts (measured at
@@ -2903,7 +2939,6 @@ def _pick_xslab_3d(shape, dtype):
                         # (v5e-measured from the 512^3 schedule sweep;
                         # see tpu_params' provenance note)
     rate = hw.vpu_cells_per_s        # VPU 7-point cells/s, full occupancy
-    ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")
     for k in range(1, 9):
@@ -2914,12 +2949,8 @@ def _pick_xslab_3d(shape, dtype):
             if X % sx != 0 or sx + 2 * k > X:
                 continue
             scr = sx + 4 * k
-            cost = (2 * scr * plane            # DMA slots
-                    + (scr * plane if k > 1 else 0)  # ping-pong scratch
-                    + 2 * sx * plane           # pipelined out block
-                    + 4 * ch * plane_f32)      # f32 compute temporaries
-            if itemsize < 4:
-                cost += ch * plane_f32
+            cost = _xslab_cost_2slot(scr, sx, Y * Z, Y * Z, k,
+                                     itemsize)
             if cost > budget:
                 continue
             amp = (sx + 2 * k) / sx
@@ -2931,7 +2962,7 @@ def _pick_xslab_3d(shape, dtype):
 
 @functools.lru_cache(maxsize=32)
 def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
-                    with_residual=True):
+                    with_residual=True, n_slots=None):
     """K 7-point steps per contiguous X-slab pass; ``fn(u) -> (u', res)``.
 
     ``with_residual=False`` omits the final sweep's fused max-norm
@@ -2964,6 +2995,11 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
     C0 = 2 * k
     n_slabs = X // sx
     CH = _xslab_chunk(Y * Z * 4)
+    if n_slots is None:
+        n_slots = _xslab_n_slots(
+            SCR, Y * Z * dtype.itemsize,
+            _xslab_cost_2slot(SCR, sx, Y * Z, Y * Z, k,
+                              dtype.itemsize))
 
     def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
         s = pl.program_id(0)
@@ -2982,15 +3018,21 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
                 sems.at[slot],
             )
 
+        # Slot pipeline with lookahead n_slots-1 (n_slots=2 is the
+        # production double-buffer; 3 probes whether a deeper DMA
+        # pipeline restores overlap at small plane sizes — the round-3
+        # open question, tools/ab_xslab_slots.py).
         @pl.when(s == 0)
         def _():
-            dma(0, 0).start()
+            for j in range(min(n_slots - 1, n_slabs)):
+                dma(j, j).start()
 
-        @pl.when(s + 1 < n)
+        @pl.when(s + (n_slots - 1) < n)
         def _():
-            dma((s + 1) % 2, s + 1).start()
+            dma((s + n_slots - 1) % n_slots,
+                s + n_slots - 1).start()
 
-        slot = lax.rem(s, 2)
+        slot = lax.rem(s, n_slots)
         dma(slot, s).wait()
 
         def chunk_new(src, r0, h):
@@ -3074,9 +3116,9 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
                          memory_space=pltpu.SMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, SCR, Y, Z), dtype),
+            pltpu.VMEM((n_slots, SCR, Y, Z), dtype),
             pltpu.VMEM((pp_planes, Y, Z), dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_slots,)),
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
@@ -3146,6 +3188,24 @@ def _block_ext_geometry(block_shape, halos, dtype, hw_align=False):
     return by + tail_y, bz + tail_z, tail_y, tail_z
 
 
+def _h_n_slots(block_shape, halos, dtype, k, sx):
+    """Slot-count decision for the kernel-H family at a chosen
+    ``(sx, k)``: the 2-slot VMEM estimate of
+    :func:`_pick_block_xslab_3d` fed through :func:`_xslab_n_slots`.
+    One definition so the builders and the picker's time model cannot
+    disagree about whether the third slot (and hence the overlapped
+    max-form cost) is in play."""
+    geo = _block_ext_geometry(block_shape, halos, dtype)
+    if geo is None:
+        return 2
+    Ye, Ze, _, _ = geo
+    bx, by, bz = block_shape
+    itemsize = jnp.dtype(dtype).itemsize
+    scr = sx + 4 * k
+    cost = _xslab_cost_2slot(scr, sx, Ye * Ze, by * bz, k, itemsize)
+    return _xslab_n_slots(scr, Ye * Ze * itemsize, cost)
+
+
 def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     """``(sx, modeled seconds per core cell-step)`` for kernel H at
     depth ``k``, or None.
@@ -3171,7 +3231,6 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     Ye, Ze, _, _ = geo
     itemsize = jnp.dtype(dtype).itemsize
     plane = Ye * Ze * itemsize
-    plane_f32 = Ye * Ze * 4
     hw = _params()
     # Admission margin below the scoped-VMEM limit: the cliff was
     # MEASURED in round 3's picker sweep at the 256^3 z-unsharded
@@ -3183,7 +3242,6 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
     # full-limit budget admitted known-infeasible schedules the
     # solver would then die on at compile time.
     budget = int(hw.vmem_admission_margin * hw.vmem_limit_bytes)
-    ch = _xslab_chunk(plane_f32)
     best = None
     best_t = float("inf")
     # Any divisor of bx works — the slab dim is untiled, so windows
@@ -3194,13 +3252,16 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
             continue
         if hx == 0 and sx + 2 * k > bx:
             continue  # clamped windows need the block to cover them
+        if hx and sx < k and bx > sx:
+            # Middle slabs receive no xlo/xhi operand data; their
+            # clamped windows reach rows only the x-halo pieces cover
+            # when sx < k, so the gather would leave garbage inside
+            # the frontier. (Latent in the old branch path too — a
+            # negative window start.) Decline the schedule.
+            continue
         scr = sx + 4 * k
-        cost = (2 * scr * plane
-                + (scr * plane if k > 1 else 0)
-                + 2 * sx * by * bz * itemsize
-                + 4 * ch * plane_f32)
-        if itemsize < 4:
-            cost += ch * plane_f32
+        cost = _xslab_cost_2slot(scr, sx, Ye * Ze, by * bz, k,
+                                 itemsize)
         if cost > budget:
             continue
         # Modeled time per core cell-step: DMA reads W=sx+2k extended
@@ -3209,11 +3270,17 @@ def _pick_block_xslab_3d(block_shape, halos, dtype, k, hw_align=False):
         # planes every step. ADDITIVE, not max: round-3 hardware sweeps
         # fit round_time = HBM_pass + K*VPU_sweep almost exactly (256^3
         # z-unsharded blocks: K=2 measured 0.37 ms/round, K=4 0.52 —
-        # i.e. F=0.22 ms + K*0.075 ms; the additive model predicts
-        # 95/134 Gcells*steps/s vs 91/129 measured), meaning kernel H's
-        # slab DMA is NOT hidden behind compute the way kernel E's
-        # strip DMA is. The earlier max() form mis-ranked depths by
-        # ignoring whichever term wasn't binding.
+        # i.e. F=0.22 ms + K*0.075 ms). Round 4's 3-slot pipeline
+        # (see _xslab_n_slots) makes the builders measurably faster at
+        # a FIXED schedule, but switching this model to the overlapped
+        # max() form was tried and MISRANKED depth on hardware: it
+        # picked (32, K=3) at the flagship 256^3 block, measured 63.7
+        # Gcells*steps/s/device vs the additive pick (32, K=4)'s 83.3
+        # — round times are near-constant at shard-block scale, so the
+        # 1/k amortization the additive t_bw term carries is what the
+        # ranking needs. The additive form stays; absolute modeled
+        # times are now conservative, rankings remain the
+        # hardware-validated quantity.
         core = sx * by * bz
         t_bw = ((sx + 2 * k) * plane + sx * by * bz * itemsize) \
             / (k * core) / hw.hbm_stream_bytes_per_s
@@ -3292,7 +3359,7 @@ def _pick_block_temporal_3d(block_shape, mesh_shape, dtype):
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
                              grid_shape, k, halos, vma=None,
-                             with_residual=True):
+                             with_residual=True, n_slots=None):
     """K 7-point steps on a circular halo-extended 3D shard block;
     ``fn(ext, x_off, y_off, z_off) -> ((bx, by, bz) core, residual)``.
 
@@ -3355,6 +3422,8 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
     C0 = 2 * k
     n_slabs = bx // sx
     CH = _xslab_chunk(Ye * Ze * 4)
+    if n_slots is None:
+        n_slots = _h_n_slots(block_shape, halos, dtype, k, sx)
 
     def kernel(offs_ref, ext_hbm, out_ref, res_ref, slots, pp, sems):
         s = pl.program_id(0)
@@ -3389,13 +3458,15 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
 
         @pl.when(s == 0)
         def _():
-            dma(0, 0).start()
+            for j in range(min(n_slots - 1, n_slabs)):
+                dma(j, j).start()
 
-        @pl.when(s + 1 < n)
+        @pl.when(s + (n_slots - 1) < n)
         def _():
-            dma((s + 1) % 2, s + 1).start()
+            dma((s + n_slots - 1) % n_slots,
+                s + n_slots - 1).start()
 
-        slot = lax.rem(s, 2)
+        slot = lax.rem(s, n_slots)
         dma(slot, s).wait()
 
         # Global x of scratch row 0 for this slab. The destination
@@ -3490,9 +3561,9 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
                          memory_space=pltpu.SMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, SCR, Ye, Ze), dtype),
+            pltpu.VMEM((n_slots, SCR, Ye, Ze), dtype),
             pltpu.VMEM((pp_planes, Ye, Ze), dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_slots,)),
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
@@ -3513,7 +3584,8 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
 @functools.lru_cache(maxsize=32)
 def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
                                    grid_shape, k, halos, vma=None,
-                                   with_residual=True, defer_x=False):
+                                   with_residual=True, defer_x=False,
+                                   n_slots=None):
     """Kernel H, fused-assembly variant: the exchange pieces arrive as
     SEPARATE operands and the slab DMA pipeline gathers them —
     ``fn(u, ztail, ytail, xlo, xhi, x_off, y_off, z_off) ->
@@ -3587,6 +3659,8 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
     has_x = hx > 0
     copy_x = has_x and not defer_x
     n_ops = 1 + int(has_z) + int(has_y) + 2 * int(copy_x)
+    if n_slots is None:
+        n_slots = _h_n_slots(block_shape, halos, dtype, k, sx)
 
     def kernel(offs_ref, *refs):
         ins = refs[:n_ops]
@@ -3676,6 +3750,32 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
                     go(xhi_copy())
                 return
 
+            if bx >= W:
+                # Uniform windows (round 4, the 2D kernel-G lesson):
+                # every slab fetches the SAME W-row window — edge
+                # windows slide inward, the destination offset keeps
+                # core row 0 at scratch row 2k — so the big core
+                # copies carry no per-slab branch structure (measured
+                # in 2D to cost the whole DMA/compute overlap); only
+                # the tiny k-plane x-halo copies stay conditional.
+                # Core outputs are bitwise unchanged: the extra
+                # fetched planes are real data in the garbage-frontier
+                # region the sweeps never let reach the core.
+                base = slab * sx
+                start0 = jnp.clip(base - k, 0, bx - W)
+                core_copies(start0, W, C0 + start0 - base)
+                if copy_x:
+                    @pl.when(slab == 0)
+                    def _():
+                        go(xlo_copy())
+
+                    @pl.when(slab == n_slabs - 1)
+                    def _():
+                        go(xhi_copy())
+                return
+
+            # bx < W (tiny 2-slab geometry): the clamp bounds invert;
+            # keep the explicit branches.
             @pl.when(slab == 0)
             def _():
                 core_copies(0, sx + k, 2 * k)
@@ -3695,13 +3795,15 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
 
         @pl.when(s == 0)
         def _():
-            issue(0, 0, True)
+            for j in range(min(n_slots - 1, n_slabs)):
+                issue(j, j, True)
 
-        @pl.when(s + 1 < n)
+        @pl.when(s + (n_slots - 1) < n)
         def _():
-            issue((s + 1) % 2, s + 1, True)
+            issue((s + n_slots - 1) % n_slots,
+                  s + n_slots - 1, True)
 
-        slot = lax.rem(s, 2)
+        slot = lax.rem(s, n_slots)
         issue(slot, s, False)
 
         gx0 = x_off + s * sx + hx - C0
@@ -3794,9 +3896,9 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
                          memory_space=pltpu.SMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, SCR, Ye, Ze), dtype),
+            pltpu.VMEM((n_slots, SCR, Ye, Ze), dtype),
             pltpu.VMEM((pp_planes, Ye, Ze), dtype),
-            pltpu.SemaphoreType.DMA((2, 5)),
+            pltpu.SemaphoreType.DMA((n_slots, 5)),
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),

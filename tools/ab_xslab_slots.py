@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Does a deeper DMA slot pipeline restore kernel F's overlap at
+small plane sizes? (VERDICT r3 #3.)
+
+Round 3 left the X-slab kernels' small-plane DMA non-overlap as a
+measured open question: at 512³-class planes kernel F's slab copy
+hides behind compute (max-model fits), at 256³-class shard blocks the
+round times fit `HBM_pass + K x VPU_sweep` almost exactly (additive).
+One hypothesis — the two-slot pipeline gives the DMA engine only one
+slab of slack, so shorter small-plane copies cannot stay ahead.
+
+This probes `_build_xslab_3d(..., n_slots=3)` (lookahead 2) against
+the production double buffer at three geometries, paired protocol.
+
+Run: python tools/ab_xslab_slots.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate3D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+CASES = [
+    ((256, 256, 256), 64, 2),
+    ((256, 256, 256), 32, 2),
+    ((256, 256, 256), 32, 4),
+]
+
+
+def main():
+    for shape, sx, k in CASES:
+        X, Y, Z = shape
+        print(f"-- {X}x{Y}x{Z} f32 (sx={sx}, K={k})")
+        u0 = jax.block_until_ready(
+            HeatPlate3D(X, Y, Z).init_grid(jnp.float32))
+        rounds = {}
+        for ns in (2, 3, 4):
+            fn = ps._build_xslab_3d(shape, "float32", 0.1, 0.1, 0.1,
+                                    sx, k, with_residual=False,
+                                    n_slots=ns)
+            rounds[f"slots={ns}"] = (lambda f: (lambda u: f(u)[0]))(fn)
+        bench_rounds_paired(rounds, u0, {n: k for n in rounds})
+
+
+if __name__ == "__main__":
+    main()
