@@ -48,10 +48,10 @@ STRIP = 64  # rows per chunk — kernel A/E/G's _SUBSTRIP
 
 def _build(kind, R, N, D, P=8, dtype=jnp.float32):
     """One Mosaic kernel: D passes of `kind` over a (R, N) buffer."""
-    a = jnp.float32(0.9999)
-    b = jnp.float32(1e-7)
 
     def kernel(u_ref, out_ref, scr):
+        a = jnp.float32(0.9999)
+        b = jnp.float32(1e-7)
         def strip_pass(src, dst, r, h):
             if kind == "fma":
                 x = src[r:r + h, :].astype(jnp.float32)
