@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""MODELED multi-chip scaling projection for a v5e-8 (VERDICT r3 #6).
+
+The reference's core empirical claim is its speedup/efficiency tables
+over 1-10 machines (Heat.pdf p.5-7, Tables 1-4). This environment has
+ONE real chip, so those tables cannot be measured; this tool computes
+the honest stand-in the verdict asked for: measured per-device round
+rates (kernel G-uni / I, round 4) combined with the ICI cost terms
+from ``tpu_params`` into projected speedup/efficiency at the
+north-star configs, CLEARLY LABELED MODELED, with ranges carrying the
+measured session variance instead of point estimates.
+
+Model (per K-step exchange round, per device):
+  t_compute = block_cells * K / rate_device      [rate: measured range]
+  t_ici     = halo_bytes / ici_bw + n_phases * latency
+  t_round   = t_compute + t_ici                  [no-overlap bound]
+  t_round'  = t_compute + max(0, t_ici - t_compute)  [overlap bound:
+              the deferred-band round's phase-2 hop may hide]
+  speedup   = T1 / t_round,  T1 = grid_cells * K / rate_single
+  efficiency = speedup / n_devices
+
+Assumptions recorded in the artifact: per-axis halo bytes for the
+corner-carrying two-phase exchange; ICI terms are the order-of-
+magnitude v5e row (4.5e10 B/s/link, 5 us/collective), NOT measured
+here — the single chip cannot measure ICI; session variance (~±10-20%
+on rates) dominates the projection's error budget either way.
+
+Run: python tools/scaling_model.py [--out scaling_r4.json]
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from parallel_heat_tpu.ops.tpu_params import params
+
+
+def project(name, grid, mesh, K, itemsize, rate_dev, rate_single,
+            provenance):
+    """One projection row; rates are (lo, hi) Gcells*steps/s."""
+    hw = params()
+    nx, ny = grid
+    dx, dy = mesh
+    bx, by = nx // dx, ny // dy
+    tail = 128
+    Ye = by + tail
+    n_dev = dx * dy
+    # Per-device halo traffic per round (send+recv both directions,
+    # both axes; phase-2 row strips span the extended width).
+    halo_bytes = (2 * 2 * bx * K + 2 * 2 * K * Ye) * itemsize
+    t_ici = halo_bytes / hw.ici_bytes_per_s + 4 * hw.collective_latency_s
+    rows = {}
+    for bound, hide in (("no_overlap", False), ("overlap", True)):
+        per = []
+        for r_dev, r_one in ((rate_dev[0], rate_single[1]),
+                             (rate_dev[1], rate_single[0])):
+            t_comp = bx * by * K / (r_dev * 1e9)
+            extra = max(0.0, t_ici - t_comp) if hide else t_ici
+            t_round = t_comp + extra
+            t1 = nx * ny * K / (r_one * 1e9)
+            sp = t1 / t_round
+            per.append((sp, sp / n_dev))
+        rows[bound] = {
+            "speedup": [round(min(p[0] for p in per), 2),
+                        round(max(p[0] for p in per), 2)],
+            "efficiency": [round(min(p[1] for p in per), 3),
+                           round(max(p[1] for p in per), 3)],
+        }
+    return {
+        "config": name, "grid": list(grid), "mesh": list(mesh),
+        "block": [bx, by], "K": K, "n_devices": n_dev,
+        "halo_bytes_per_round_per_device": halo_bytes,
+        "t_ici_us": round(t_ici * 1e6, 1),
+        "rate_per_device_gcells_s": list(rate_dev),
+        "rate_single_device_gcells_s": list(rate_single),
+        "rate_provenance": provenance,
+        "projection": rows,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    rows = [
+        project(
+            "16384^2 f32, K=8 rounds, v5e-8 (2,4) mesh",
+            (16384, 16384), (2, 4), 8, 4,
+            rate_dev=(153.0, 165.9),
+            rate_single=(181.4, 187.1),
+            provenance=(
+                "per-device: kernel G-uni measured at the 4096^2 f32 "
+                "block across 3 round-4 sessions (REPORT 4b.1); "
+                "single: kernel E solver rate, bench_full 16384^2 row "
+                "and round-4 paired ceilings"),
+        ),
+        project(
+            "32768^2 bf16, K=16 rounds, v5e-8 (2,4) mesh",
+            (32768, 32768), (2, 4), 16, 2,
+            rate_dev=(145.6, 207.7),
+            rate_single=(160.0, 170.0),
+            provenance=(
+                "per-device: lower bound = round-3 branchy fused at "
+                "the exact 16384x8192 block; upper = round-4 G-uni at "
+                "the 4096^2 bf16 block (uniform not yet measured at "
+                "the full-size block); single: kernel I 32768^2 row "
+                "(166.6 nominal, +/- session variance)"),
+        ),
+    ]
+    out = {
+        "MODELED": ("These are projections, not measurements: one "
+                    "real chip; ICI terms are spec-order v5e numbers "
+                    "from tpu_params, unmeasurable single-chip. "
+                    "Ranges propagate measured session variance."),
+        "assumptions": [
+            "per-device round rate at the full shard block equals the "
+            "rate measured at the nearest measured block (row-count "
+            "matched; wider rows measured mildly favorable in r3)",
+            "halo model: two-phase corner-carrying exchange, "
+            "send+recv both directions on both axes, phase-2 strips "
+            "span the lane-extended width",
+            "overlap bound assumes the deferred-band round hides the "
+            "phase-2 hop behind bulk compute (jaxpr-proven "
+            "independence, REPORT 4b); no-overlap bound charges all "
+            "ICI serially",
+            "ici_bytes_per_s=%.1e, collective_latency=%.0e s "
+            "(tpu_params v5e row)" % (params().ici_bytes_per_s,
+                                      params().collective_latency_s),
+        ],
+        "rows": rows,
+    }
+    print(json.dumps(out, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
