@@ -95,10 +95,13 @@ def _parse_mesh(arg: Optional[str], ndim: int, grid_shape=None,
         from parallel_heat_tpu.parallel.mesh import (
             pick_mesh_shape, pick_mesh_shape_scored)
 
-        if grid_shape is not None and ndim == 3:
-            # Grid-aware factorization: the kernel cost model prefers
-            # z-free meshes (the lane-pad asymmetry; measured +20-40%
-            # per device at 512^3/8 — REPORT §4d).
+        if grid_shape is not None and ndim in (2, 3):
+            # Grid-aware factorization: in 3D the kernel cost model
+            # prefers z-free meshes (the lane-pad asymmetry; measured
+            # +20-40% per device at 512^3/8 — REPORT §4d); in 2D it
+            # breaks near-ties toward the narrower block shape
+            # (measured +7% at the 32768^2 bf16 decompositions —
+            # REPORT §4b.1 follow-up, round 4).
             return pick_mesh_shape_scored(len(jax.devices()),
                                           grid_shape, dtype)
         return pick_mesh_shape(len(jax.devices()), ndim)

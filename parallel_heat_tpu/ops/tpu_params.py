@@ -63,6 +63,16 @@ class TpuParams:
     #   MiB = 117.9 sits between the measured endpoints.
     spill_cliff_cols_sub_f32: int = 20608
     vmem_admission_margin: float = 0.92
+    # Wide-row sweep penalty (round 4): sweep rates decline beyond
+    # ~8.5k lanes — measured on v5e at the 32768^2 bf16 mesh
+    # decompositions (kernel E 202.3 -> 181.7 Gcells/s, kernel G-uni
+    # 186.6 -> 173.7 at +8192 lanes). Modeled linear: rate divides by
+    # 1 + slope * (lanes - knee) / 16384 past the knee; the 0.2 slope
+    # brackets both measured pairs (+11.3% and +7.4%). Used by the 2D
+    # scored mesh factorization; inherited by the extrapolated rows
+    # until measured there.
+    wide_row_knee_lanes: int = 8448
+    wide_row_slope_per_16k: float = 0.2
 
     @property
     def vmem_limit_bytes(self) -> int:

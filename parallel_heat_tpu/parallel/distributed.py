@@ -113,11 +113,12 @@ def suggest_mesh_shape(ndim: int = 2, grid_shape=None,
     The multi-host ``MPI_Dims_create``: uses the global device count, so
     the resulting mesh spans hosts; XLA routes the halo ppermutes over
     ICI within a pod slice and DCN across slices. Pass ``grid_shape``
-    (3D) to get the cost-model-scored factorization — the z lane-pad
-    asymmetry makes balanced factors measurably wrong on TPU
+    to get the cost-model-scored factorization — in 3D the z lane-pad
+    asymmetry makes balanced factors measurably wrong on TPU, and in
+    2D near-ties break toward the measured-faster narrower block
     (``mesh.pick_mesh_shape_scored``).
     """
-    if grid_shape is not None and ndim == 3:
+    if grid_shape is not None and ndim in (2, 3):
         from parallel_heat_tpu.parallel.mesh import pick_mesh_shape_scored
 
         return pick_mesh_shape_scored(jax.device_count(), grid_shape,

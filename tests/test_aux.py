@@ -127,10 +127,48 @@ def test_scored_mesh_factorization_avoids_z():
     # tiny grids: no schedule -> balanced fallback
     assert pick_mesh_shape_scored(8, (16, 16, 16)) == \
         pick_mesh_shape(8, 3)
-    # 2D passthrough
-    assert pick_mesh_shape_scored(8, (512, 512)) == pick_mesh_shape(8, 2)
+    # 2D scored (round 4): the wide-row penalty picks the MEASURED
+    # best (2,4) at the 32768^2 bf16 north star (G-uni 186.6 vs the
+    # transpose's 173.7 Gcells*steps/s/device), where the balanced
+    # pick chose the transpose; the (8,1) decomposition past the bf16
+    # spill cliff is never offered. The f32 16384^2 pick is
+    # model-driven (both its shapes sit under the width knee); pinned
+    # so a model change is a visible decision, not drift.
+    assert pick_mesh_shape_scored(8, (32768, 32768), "bfloat16") == (2, 4)
+    assert pick_mesh_shape_scored(8, (16384, 16384)) == (4, 2)
+    # unaligned 2D extents: no feasible factorization -> loud fallback
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        assert pick_mesh_shape_scored(8, (200, 200)) == \
+            pick_mesh_shape(8, 2)
+    assert any("fall" in str(r.message) for r in rec)
     # grid-aware suggest_mesh_shape routes through the scored picker
     assert dist.suggest_mesh_shape(3, (512, 512, 512))[2] == 1
+    assert dist.suggest_mesh_shape(2, (32768, 32768),
+                                   "bfloat16") == (2, 4)
+
+
+def test_scored_2d_mesh_solve_equivalence():
+    # A solve on the scored 2D mesh agrees with the single-device
+    # solve to f32 ulps (the scored pick changes the decomposition;
+    # at this geometry the single-device path runs kernel A while the
+    # blocks run kernel G, whose different chunk shapes shift XLA's
+    # FMA contraction by ulps — the same precision contract as the 3D
+    # band kernels). Bitwise equality across the G-variant chain at a
+    # fixed mesh is pinned by test_temporal.
+    import numpy as np
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.parallel.mesh import pick_mesh_shape_scored
+
+    kw = dict(nx=64, ny=1024, steps=17, backend="pallas")
+    mesh = pick_mesh_shape_scored(8, (64, 1024))
+    assert mesh[0] * mesh[1] == 8
+    single = solve(HeatConfig(**kw)).to_numpy()
+    sharded = solve(HeatConfig(mesh_shape=mesh, halo_depth=8,
+                               **kw)).to_numpy()
+    np.testing.assert_allclose(single, sharded, rtol=1e-6, atol=0)
 
 
 def test_gather_to_host_single_process():

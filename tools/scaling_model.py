@@ -87,27 +87,30 @@ def main():
 
     rows = [
         project(
-            "16384^2 f32, K=8 rounds, v5e-8 (2,4) mesh",
-            (16384, 16384), (2, 4), 8, 4,
+            "16384^2 f32, K=8 rounds, v5e-8 (4,2) mesh "
+            "(the scored picker's choice)",
+            (16384, 16384), (4, 2), 8, 4,
             rate_dev=(153.0, 165.9),
             rate_single=(181.4, 187.1),
             provenance=(
                 "per-device: kernel G-uni measured at the 4096^2 f32 "
-                "block across 3 round-4 sessions (REPORT 4b.1); "
+                "block across 3 round-4 sessions (REPORT 4b.1; the "
+                "scored mesh's 4096x8192 block is row-count matched); "
                 "single: kernel E solver rate, bench_full 16384^2 row "
                 "and round-4 paired ceilings"),
         ),
         project(
             "32768^2 bf16, K=16 rounds, v5e-8 (2,4) mesh",
             (32768, 32768), (2, 4), 16, 2,
-            rate_dev=(145.6, 207.7),
+            rate_dev=(173.7, 207.7),
             rate_single=(160.0, 170.0),
             provenance=(
-                "per-device: lower bound = round-3 branchy fused at "
-                "the exact 16384x8192 block; upper = round-4 G-uni at "
-                "the 4096^2 bf16 block (uniform not yet measured at "
-                "the full-size block); single: kernel I 32768^2 row "
-                "(166.6 nominal, +/- session variance)"),
+                "per-device: G-uni measured 186.6 at the exact "
+                "16384x8192 block the scored (2,4) mesh assigns; "
+                "lower bound = G-uni at the transpose 8192x16384 "
+                "block (173.7), upper = G-uni at the 4096^2 bf16 "
+                "block (207.7); single: kernel I 32768^2 row (166.6 "
+                "nominal, +/- session variance)"),
         ),
     ]
     out = {
