@@ -240,6 +240,12 @@ def test_pick_block_temporal_3d_pins():
     # exchange schedule — re-measure before accepting.
     assert ps._pick_block_temporal_3d((256, 256, 256), (2, 2, 2),
                                       "float32") == (32, 4)
+    # Sub-f32 +1 depth correction (round 4): the hardware sweep
+    # consistently prefers one-deeper K at bf16 (K=7 measured over the
+    # model's K=6, rounds 3 AND 4) — auto-depth serves the measured
+    # best, not the model's raw pick.
+    assert ps._pick_block_temporal_3d((128, 128, 256), (2, 2, 2),
+                                      "bfloat16") == (64, 7)
     # Non-pow2 (but tile-aligned) blocks pick divisor slabs.
     sx, k = ps._pick_block_temporal_3d((120, 120, 384), (2, 2, 1),
                                        "float32")

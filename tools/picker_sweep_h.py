@@ -62,6 +62,16 @@ def run_case(block, mesh, dts, top, span_s, batches, record=None):
           f"{len(cand)}: " + ", ".join(
               f"(sx={sx}, K={k})" for _, sx, k in cand))
     u0 = jax.block_until_ready(HeatPlate3D(X, Y, Z).init_grid(dt))
+    # The PRODUCTION pick = the model plus the measured sub-f32 +1
+    # depth correction (round 4); the hold-check judges it, since it
+    # is what auto-depth serves. Make sure it is among the measured
+    # candidates even when the raw model ranks it past `top`.
+    prod = ps._pick_block_temporal_3d(block, mesh, dts)
+    if prod is not None and not any((sx, k) == prod
+                                    for _, sx, k in cand):
+        s = ps._score_block_temporal_3d(block, mesh, dts, prod[1])
+        if s is not None:
+            cand.append((s[0], prod[0], prod[1]))
     rounds = {}
     steps = {}
     for rank, (t_model, sx, k) in enumerate(cand, 1):
@@ -83,6 +93,8 @@ def run_case(block, mesh, dts, top, span_s, batches, record=None):
             return fn(u, ztail, ytail, xslab, xslab, -hx, 0, 0)[0]
 
         name = f"model#{rank} sx={fn.sx} K={k}"
+        if prod == (sx, k):
+            name += " [prod]"
         rounds[name] = round_k
         steps[name] = k
     rates = bench_rounds_paired(rounds, u0, steps, span_s=span_s,
@@ -97,17 +109,28 @@ def run_case(block, mesh, dts, top, span_s, batches, record=None):
     if rates:
         best = max(rates, key=rates.get)
         top_rate = rates[best]
-        model1 = next((n for n in rates if n.startswith("model#1")),
-                      None)
+        prodname = next((n for n in rates if n.endswith("[prod]")),
+                        None)
+        if prodname is None:
+            if any(n.endswith("[prod]") for n in rounds):
+                # The corrected pick was timed but its slope failed —
+                # report n/a rather than substituting another variant.
+                print(f"  -> measured best: {best} at {top_rate:.1f}; "
+                      f"production pick's slope untrustworthy (n/a)")
+                return None
+            # No sub-f32 correction applied: prod == model#1.
+            prodname = next((n for n in rates
+                             if n.startswith("model#1")), None)
         # The cost surface near the optimum is measured flat (K=3/4/5
         # within 2.5% at the flagship with 2 s spans): rankings inside
         # a 3% band are ties, not mis-rankings.
-        ok = model1 is not None and rates[model1] >= 0.97 * top_rate
+        ok = prodname is not None and \
+            rates[prodname] >= 0.97 * top_rate
         print(f"  -> measured best: {best} at {top_rate:.1f}; "
-              f"model#1 at "
-              f"{rates.get(model1, float('nan')):.1f} "
-              + ("(model ranking HOLDS within 3%)" if ok
-                 else "(model MIS-RANKED)"))
+              f"production pick at "
+              f"{rates.get(prodname, float('nan')):.1f} "
+              + ("(pick HOLDS within 3%)" if ok
+                 else "(pick MIS-RANKED)"))
         return ok
     return None
 
