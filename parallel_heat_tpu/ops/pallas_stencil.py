@@ -3303,6 +3303,14 @@ def _score_block_temporal_3d(block_shape, mesh_shape, dtype, k):
     ranking k=4 > k=3 > k=8 (sx=32/32/16), measured 62.3 / ~62 / 44.4
     Gcells*steps/s per device. Returns None where the kernel
     declines."""
+    if k > min(block_shape):
+        # Deeper halos than one block would need multi-hop exchanges —
+        # the same structural bound config.validate() enforces on
+        # explicit depths. Scoring such a k would let the picker's
+        # sub-f32 +1 correction step past the bound the main sweep
+        # caps at (round-4 advisor: grid (16,32,128), mesh (2,2,1),
+        # bf16 auto-resolved depth 9 on min-extent-8 blocks → NaNs).
+        return None
     halos = tuple(k if d > 1 else 0 for d in mesh_shape)
     pick = _pick_block_xslab_3d(block_shape, halos, dtype, k,
                                 hw_align=True)
@@ -3368,7 +3376,11 @@ def _pick_block_temporal_3d(block_shape, mesh_shape, dtype):
         t, sx = scored
         if t < best_t:
             best_t, best = t, (sx, k)
-    if best is not None and jnp.dtype(dtype).itemsize < 4:
+    if (best is not None and jnp.dtype(dtype).itemsize < 4
+            and best[1] + 1 <= bmin):
+        # The explicit bmin re-check is belt to _score's suspenders:
+        # the corrected depth must honor the same smallest-block-extent
+        # bound the main sweep caps at (multi-hop exchange limit).
         deeper = _score_block_temporal_3d(block_shape, mesh_shape,
                                           dtype, best[1] + 1)
         if deeper is not None:

@@ -154,7 +154,11 @@ def _resolved(config: HeatConfig):
         # Downstream (the temporal module, block factories) reads
         # config.halo_depth as the concrete depth; substitute the
         # resolved value once here so None never escapes the driver.
-        config = config.replace(halo_depth=depth)
+        # Re-validate: resolution happens after the caller's
+        # config.validate(), so an auto-picked depth must pass the
+        # same bounds an explicit one would (defense in depth against
+        # picker bugs like round 4's +1-past-bmin correction).
+        config = config.replace(halo_depth=depth).validate()
     return config, backend, was_auto
 
 

@@ -262,6 +262,30 @@ def test_pick_block_temporal_3d_pins():
                                    "float32", 4, hw_align=True) is None
 
 
+def test_pick_depth_capped_at_smallest_block_extent():
+    # Round-4 advisor high: the sub-f32 +1 correction must not step
+    # past the smallest block extent (config.validate()'s multi-hop
+    # bound). At (8,16,128) blocks the bf16 sweep's pick sits at
+    # bmin=8; before the fix the correction auto-resolved depth 9 and
+    # solve() silently returned NaNs.
+    pick = ps._pick_block_temporal_3d((8, 16, 128), (2, 2, 1),
+                                      "bfloat16")
+    assert pick is not None and pick[1] <= 8
+    # Scoring past the bound declines outright.
+    assert ps._score_block_temporal_3d((8, 16, 128), (2, 2, 1),
+                                       "bfloat16", 9) is None
+    # End-to-end at the advisor's repro geometry: auto depth resolves
+    # within bound and the sharded solve matches the jnp oracle (no
+    # NaNs).
+    kw = dict(nx=16, ny=32, nz=128, steps=10, dtype="bfloat16")
+    cfg = HeatConfig(backend="pallas", mesh_shape=(2, 2, 1), **kw)
+    depth = _resolve_halo_depth(cfg, "pallas")
+    assert depth <= 8
+    got = solve(cfg).to_numpy().astype("f8")
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, _oracle(**kw), **BF16_TOL)
+
+
 def test_validate_allows_any_3d_pallas_depth():
     # 2D pallas requires depth == sublane count; 3D (kernel H) does not.
     HeatConfig(nx=16, ny=16, nz=16, mesh_shape=(2, 2, 2), halo_depth=3,
