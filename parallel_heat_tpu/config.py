@@ -89,6 +89,21 @@ class HeatConfig:
     # values always win (``solver._resolve_halo_depth``).
     halo_depth: Optional[int] = None
 
+    # Sub-f32 accumulation semantics (SEMANTICS.md). "storage" (default):
+    # the state rounds to the storage dtype after EVERY step — K-step
+    # temporal kernels are bit-identical to K single-step passes.
+    # "f32chunk" (opt-in, 2D single-device, sub-f32 dtypes): the state
+    # carries float32 across each K-step kernel chunk (K = the dtype's
+    # sublane count, the temporal kernels' depth) and rounds to storage
+    # ONCE per chunk — K-fold fewer rounding events, measurably lower
+    # drift vs the f64 oracle, at a measured throughput cost (the f32
+    # VMEM ping-pong halves the streaming budget). The reference never
+    # resolved this choice — its MPI and CUDA variants silently disagree
+    # about promotion (mpi/...stat.c:171-174 double literals vs
+    # cuda/cuda_heat.cu:62 `2.0f`, SURVEY.md §2d.7); here it is an
+    # explicit, priced flag.
+    accumulate: str = "storage"
+
     # --- derived helpers -------------------------------------------------
 
     @property
@@ -222,6 +237,33 @@ class HeatConfig:
                         f"halo_depth={self.halo_depth} exceeds the "
                         f"smallest block extent {bmin}"
                     )
+        if self.accumulate not in ("storage", "f32chunk"):
+            raise ValueError(
+                f"accumulate must be 'storage' or 'f32chunk', got "
+                f"{self.accumulate!r}"
+            )
+        if self.accumulate == "f32chunk":
+            # Loud declines over silent fallbacks: the flag changes the
+            # numerics contract, so paths that cannot honor it refuse.
+            if self.dtype != "bfloat16":
+                raise ValueError(
+                    f"accumulate='f32chunk' only applies to sub-f32 "
+                    f"storage dtypes (got {self.dtype}: f32+ storage "
+                    f"already carries full f32 state — SEMANTICS.md)"
+                )
+            if self.ndim != 2:
+                raise ValueError(
+                    "accumulate='f32chunk' is 2D-only (the priced "
+                    "config-4 capability); 3D chunked accumulation is "
+                    "not yet built"
+                )
+            if any(d > 1 for d in mesh):
+                raise ValueError(
+                    "accumulate='f32chunk' is single-device only: "
+                    "sharded temporal rounds exchange storage-dtype "
+                    "halos, so the chunk carry cannot stay f32 across "
+                    "the mesh"
+                )
         return self
 
     # --- (de)serialization ----------------------------------------------

@@ -421,7 +421,8 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
 # Kernel E: temporally-blocked streaming strip (K steps per HBM pass)
 # --------------------------------------------------------------------------
 
-def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
+def _pick_temporal_strip(out_rows: int, n_cols: int, dtype,
+                         acc_f32: bool = False) -> int | None:
     """Strip height for the temporal kernel, or None.
 
     Buffers: 2 DMA slots + 1 ping-pong scratch, each (T + 4*SUB, N),
@@ -429,6 +430,11 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
     sub-strip f32 temporaries. Larger T amortizes the per-step halo
     recompute (2*SUB extra rows per intermediate step). Declines
     non-lane-aligned widths on hardware (see :func:`_pick_strip_rows`).
+
+    ``acc_f32``: price the f32-chunk variant's scratch — the single
+    storage-dtype ping-pong becomes TWO float32 buffers (the DMA slots
+    cannot hold the f32 carry), so bf16 strips pay 8 extra bytes/cell
+    of scratch and pick shorter T.
     """
     if _needs_lane_alignment() and n_cols % _LANE != 0:
         return None
@@ -455,6 +461,9 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype) -> int | None:
         # zero band materialized for the edge-strip sanitization.
         cost = ((3 * (t + 4 * sub) + 2 * t + 2 * sub) * n_cols
                 * itemsize + temps)
+        if acc_f32:
+            # f32chunk swaps the dtype ping-pong for two f32 buffers.
+            cost += (t + 4 * sub) * n_cols * (2 * 4 - itemsize)
         if cost <= budget:
             best = t
     return best
@@ -475,7 +484,7 @@ def _pinned_coeffs(colmask, cx, cy):
             jnp.where(colmask, jnp.float32(cy), 0.0))
 
 
-def _pinned_stepper(coeffs, row_base, c0, nx, dtype):
+def _pinned_stepper(coeffs, row_base, c0, nx, dtype, step_dtype=None):
     """``(chunk_new, step_into)`` for one coefficient-pinned 2D stencil
     step over scratch rows, shared by kernels E and G.
 
@@ -483,6 +492,14 @@ def _pinned_stepper(coeffs, row_base, c0, nx, dtype):
     boundary/garbage rows (global index outside ``[1, nx-2]``) get
     a0 -> 1, cx/cy -> 0 so they compute exactly ``C`` — no per-cell
     select in the hot path (the +18% trade measured on kernel E).
+
+    ``step_dtype``: the dtype ``step_into`` rounds intermediate sweeps
+    to (default: the storage dtype). The f32-chunk accumulation mode
+    passes float32 — intermediates then carry full f32 in f32 scratch
+    and only the caller's final core write rounds to storage
+    (SEMANTICS.md; ``chunk_new`` upcasts its source regardless, so a
+    mixed bf16-slots-first-step / f32-ping-pong chain needs no other
+    change).
     """
     a0v, cxv, cyv = coeffs
 
@@ -503,22 +520,82 @@ def _pinned_stepper(coeffs, row_base, c0, nx, dtype):
         new = ra0 * C + rcx * (U + D) + rcy * (Lf + Rt)
         return new, C
 
+    sdt = dtype if step_dtype is None else step_dtype
+
     def step_into(src, dst, lo, hi):
         """One coefficient-pinned step over scratch rows [lo, hi)."""
         r0 = lo
         while r0 < hi:
             h = min(_SUBSTRIP, hi - r0)
             new, _ = chunk_new(src, r0, h)
-            dst[r0:r0 + h, :] = new.astype(dtype)
+            dst[r0:r0 + h, :] = new.astype(sdt)
             r0 += h
 
     return chunk_new, step_into
 
 
+def _run_intermediates(step_into, m, sref, pp, acc_f32, lo, hi):
+    """The K-1 intermediate sweeps of a temporal kernel; returns the
+    ref holding the last intermediate state (``sref`` when m == 0).
+
+    One implementation for kernels E and I in both accumulation modes,
+    so the step-count accounting (1 + 2*(mm//2) + mm%2 == m) and the
+    frontier discipline can never diverge between them. Storage mode
+    ping-pongs the DMA slot with the single dtype scratch ``pp``;
+    f32chunk mode (``acc_f32``) lands the first step in ``pp.at[0]``
+    and ping-pongs the two f32 buffers — the DMA slots cannot hold the
+    f32 carry, and the only storage rounding is the caller's final
+    core write. Paired steps run under ``fori_loop`` so the emitted
+    code stays O(1) in K (the kernel-E compile-time rationale).
+    """
+    if not acc_f32:
+        def double_step(_, carry):
+            del carry
+            step_into(sref, pp, lo, hi)
+            step_into(pp, sref, lo, hi)
+            return 0
+
+        if m > 1:
+            lax.fori_loop(0, m // 2, double_step, 0)
+        src = sref
+        if m % 2 == 1:
+            step_into(sref, pp, lo, hi)
+            src = pp
+        return src
+
+    pa, pb = pp.at[0], pp.at[1]
+    src = sref
+    if m > 0:
+        step_into(sref, pa, lo, hi)
+        mm = m - 1
+
+        def double_step(_, carry):
+            del carry
+            step_into(pa, pb, lo, hi)
+            step_into(pb, pa, lo, hi)
+            return 0
+
+        if mm > 1:
+            lax.fori_loop(0, mm // 2, double_step, 0)
+        src = pa
+        if mm % 2 == 1:
+            step_into(pa, pb, lo, hi)
+            src = pb
+    return src
+
+
 @functools.lru_cache(maxsize=64)
 def _build_temporal_strip(shape, dtype_name, cx, cy, k,
-                          with_residual=True):
+                          with_residual=True, acc_f32=False):
     """K Jacobi steps per grid traversal; ``fn(u) -> (u', residual)``.
+
+    ``acc_f32`` (SEMANTICS.md f32chunk): the K-1 intermediate sweeps
+    ping-pong between TWO float32 scratch buffers instead of rounding
+    to the storage dtype each step — the chunk's state carries full f32
+    and rounds to storage exactly once, at the final core write. The
+    frontier/zeroing invariants are unchanged (the f32 buffers obey the
+    same band discipline as the dtype ping-pong they replace); only the
+    rounding points move.
 
     ``with_residual=False`` builds the same kernel minus the final
     sweep's |new−C| max-reduction (``res`` is then a constant 0.0):
@@ -577,7 +654,7 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
     dtype = jnp.dtype(dtype_name)
     SUB = _sub_rows(dtype)
     assert 1 <= k <= SUB
-    T = _pick_temporal_strip(M, N, dtype)
+    T = _pick_temporal_strip(M, N, dtype, acc_f32)
     if T is None:
         return None
     n_strips = M // T
@@ -620,39 +697,39 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
         @pl.when(s == 0)
         def _():
             slots[0, 0:C0, :] = zband
-            pp[0:C0, :] = zband
+            if acc_f32:
+                zf = zband.astype(jnp.float32)
+                pp[0, 0:C0, :] = zf
+                pp[1, 0:C0, :] = zf
+            else:
+                pp[0:C0, :] = zband
 
         @pl.when(s == n - 1)
         def _():
             slots.at[slot][W:SCR, :] = zband
-            pp[W:SCR, :] = zband
+            if acc_f32:
+                zf = zband.astype(jnp.float32)
+                pp[0, W:SCR, :] = zf
+                pp[1, W:SCR, :] = zf
+            else:
+                pp[W:SCR, :] = zband
 
         dma(slot, s).wait()
         sref = slots.at[slot]
-        chunk_new, step_into = _pinned_stepper(coeffs, s * T, C0, M, dtype)
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, s * T, C0, M, dtype,
+            step_dtype=jnp.float32 if acc_f32 else None)
 
-        # K-1 intermediate steps ping-pong slot <-> pp over the output
-        # rows plus one SUB halo; the final step computes exactly the
-        # output rows into the pipelined out block, with the residual.
-        # Paired steps run under fori_loop so the emitted code stays
-        # O(1) in K (a Python unroll at K=16, N=32k made Mosaic compile
-        # times pathological). Intermediates always sweep the same fixed
-        # row band; the garbage frontier (one row per step from each
-        # side) is re-overwritten every step and, for K <= SUB, never
-        # reaches the central T output rows.
-        m = k - 1
-
-        def double_step(_, carry):
-            del carry
-            step_into(sref, pp, SUB, T + 3 * SUB)
-            step_into(pp, sref, SUB, T + 3 * SUB)
-            return 0
-
-        lax.fori_loop(0, m // 2, double_step, 0)
-        src = sref
-        if m % 2 == 1:
-            step_into(sref, pp, SUB, T + 3 * SUB)
-            src = pp
+        # K-1 intermediate steps (``_run_intermediates``: storage mode
+        # ping-pongs slot <-> pp, f32chunk ping-pongs the two f32
+        # buffers); the final step computes exactly the output rows
+        # into the pipelined out block, with the residual.
+        # Intermediates always sweep the same fixed row band; the
+        # garbage frontier (one row per step from each side) is
+        # re-overwritten every step and, for K <= SUB, never reaches
+        # the central T output rows.
+        src = _run_intermediates(step_into, k - 1, sref, pp, acc_f32,
+                                 SUB, T + 3 * SUB)
 
         r_acc = jnp.float32(0.0)
         r0 = C0
@@ -691,7 +768,8 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
         ),
         scratch_shapes=[
             pltpu.VMEM((2, SCR, N), dtype),
-            pltpu.VMEM((SCR, N), dtype),
+            (pltpu.VMEM((2, SCR, N), jnp.float32) if acc_f32
+             else pltpu.VMEM((SCR, N), dtype)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
@@ -780,14 +858,16 @@ def _chunked_multistep(build_fn, K):
     return multi_step, run
 
 
-def _temporal_multistep(shape, dtype, cx, cy):
+def _temporal_multistep(shape, dtype, cx, cy, acc_f32=False):
     """(multi_step, multi_step_residual) built on the temporal kernel,
     or None if the geometry declines."""
     SUB = _sub_rows(dtype)
-    if _build_temporal_strip(shape, dtype, cx, cy, SUB) is None:
+    if _build_temporal_strip(shape, dtype, cx, cy, SUB,
+                             acc_f32=acc_f32) is None:
         return None
     return _chunked_multistep(
-        lambda k, res: _build_temporal_strip(shape, dtype, cx, cy, k, res),
+        lambda k, res: _build_temporal_strip(shape, dtype, cx, cy, k, res,
+                                             acc_f32=acc_f32),
         SUB)
 
 
@@ -2051,7 +2131,21 @@ def pick_block_temporal_2d(config, axis_names):
 # Solver-facing step factories
 # --------------------------------------------------------------------------
 
-def pick_single_2d(shape, dtype, cx, cy):
+def _temporal_amps(t_strip, tile_ti, dtype):
+    """(amp_E, amp_I): fetch-window amplification of kernel E's strips
+    vs kernel I's 2D tiles — the modeled quantity the E-vs-I choice
+    compares (validated on v5e at 32768^2 bf16: I 166.3 vs E 153.7,
+    model amp 1.195 vs 1.25). One site for the formula so the storage
+    and f32chunk decision branches can never drift apart."""
+    sub = _sub_rows(dtype)
+    hc = _col_halo_temporal(dtype)
+    amp_e = (t_strip + 2 * sub) / t_strip
+    amp_i = ((tile_ti[0] + 2 * sub) * (tile_ti[1] + 4 * hc)
+             / (tile_ti[0] * tile_ti[1]))
+    return amp_e, amp_i
+
+
+def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
     """The 2D single-device kernel decision: ``(kind, built_or_detail)``
     with kind in {"A", "E", "I", "B", "C", "jnp"}.
 
@@ -2063,7 +2157,30 @@ def pick_single_2d(shape, dtype, cx, cy):
     kernel, and the explain path shares the execution path's build
     entries); the _pick_* searches re-run but are a few hundred cheap
     iterations.
+
+    ``accumulate='f32chunk'`` (SEMANTICS.md) restricts the choice to
+    paths that honor the chunked-f32 contract: the temporal kernels'
+    acc variants (E or I, by the same amplification comparison against
+    the acc-aware pickers) or the chunked-f32 jnp fallback — the
+    single-step kernels (A/B/C) round every step by construction and
+    are never picked.
     """
+    if accumulate == "f32chunk":
+        # config.validate() restricts f32chunk to bfloat16, so the
+        # E-vs-I comparison applies whenever both pickers accept.
+        acc_t = _pick_temporal_strip(shape[0], shape[1], dtype,
+                                     acc_f32=True)
+        acc_ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype,
+                                        acc_f32=True)
+        if acc_t is not None and acc_ti is not None:
+            amp_e, amp_i = _temporal_amps(acc_t, acc_ti, dtype)
+            if amp_i < amp_e:
+                return "I", acc_ti
+        if acc_t is not None:
+            return "E", acc_t
+        if acc_ti is not None:
+            return "I", acc_ti
+        return "jnp", None
     if fits_vmem(shape, dtype):
         return "A", None
     t = _pick_temporal_strip(shape[0], shape[1], dtype)
@@ -2079,11 +2196,7 @@ def pick_single_2d(shape, dtype, cx, cy):
         if jnp.dtype(dtype).itemsize < 4:
             ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype)
             if ti is not None:
-                sub = _sub_rows(dtype)
-                hc = _col_halo_temporal(dtype)
-                amp_e = (t + 2 * sub) / t
-                amp_i = ((ti[0] + 2 * sub) * (ti[1] + 4 * hc)
-                         / (ti[0] * ti[1]))
+                amp_e, amp_i = _temporal_amps(t, ti, dtype)
                 if amp_i < amp_e:
                     return "I", ti
         return "E", t
@@ -2112,6 +2225,37 @@ def pick_single_2d(shape, dtype, cx, cy):
     return "jnp", None
 
 
+def f32chunk_jnp_multistep(shape, dtype, cx, cy):
+    """Chunked-f32 jnp multistep — the always-available f32chunk path.
+
+    Honors the SEMANTICS.md f32chunk contract exactly: chunks of
+    ``SUB`` (the dtype's sublane count, the temporal kernels' depth)
+    steps carried in f32, one rounding to storage per chunk, residual
+    from the last step's pre-rounding f32 update. Used when the
+    temporal kernels decline the geometry and by the jnp backend.
+    """
+    from parallel_heat_tpu.ops.stencil import step_2d, step_2d_residual
+
+    SUB = _sub_rows(dtype)
+    dt = jnp.dtype(dtype)
+
+    def build_fn(kk, want_res):
+        def fn(u):
+            v = u.astype(jnp.float32)
+            for _ in range(kk - 1):
+                v = step_2d(v, cx, cy)
+            if want_res:
+                v, r = step_2d_residual(v, cx, cy)
+            else:
+                v = step_2d(v, cx, cy)
+                r = jnp.float32(0.0)
+            return v.astype(dt), r
+
+        return fn
+
+    return _chunked_multistep(build_fn, SUB)
+
+
 def single_grid_multistep(config):
     """``(multi_step(u, k), multi_step_residual(u, k))`` for one device.
 
@@ -2125,6 +2269,22 @@ def single_grid_multistep(config):
     shape = config.shape
     dtype = config.dtype
     cx, cy = float(config.cx), float(config.cy)
+
+    if config.accumulate == "f32chunk":
+        kind, _ = pick_single_2d(shape, dtype, cx, cy,
+                                 accumulate="f32chunk")
+        if kind == "E":
+            temporal = _temporal_multistep(shape, dtype, cx, cy,
+                                           acc_f32=True)
+            assert temporal is not None
+            return temporal
+        if kind == "I":
+            temporal = _tile_temporal_multistep(shape, dtype, cx, cy,
+                                                acc_f32=True)
+            assert temporal is not None
+            return temporal
+        return f32chunk_jnp_multistep(shape, dtype, cx, cy)
+
     kind, built = pick_single_2d(shape, dtype, cx, cy)
 
     if kind == "A":
@@ -2475,7 +2635,8 @@ def _col_halo_temporal(dtype) -> int:
     return _LANE if _needs_lane_alignment() else 2 * _sub_rows(dtype)
 
 
-def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype):
+def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype,
+                           acc_f32: bool = False):
     """(T, CW) for kernel I, or None.
 
     Kernel C's two-axis windows sized for kernel E's K=sublane temporal
@@ -2516,6 +2677,11 @@ def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype):
             cost += 4 * (_SUBSTRIP + 2) * scr_c * 4  # f32 chunk temps
             if itemsize < 4:
                 cost += t * cw * 4
+            if acc_f32:
+                # f32chunk swaps the dtype ping-pong for two f32
+                # buffers (the f32-chunk carry cannot live in the DMA
+                # slots).
+                cost += scr_r * scr_c * (2 * 4 - itemsize)
             if cost > budget:
                 continue
             core = t * cw
@@ -2531,8 +2697,13 @@ def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype):
 
 @functools.lru_cache(maxsize=32)
 def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
-                            with_residual=True):
+                            with_residual=True, acc_f32=False):
     """K steps per fetched (T, CW) tile; ``fn(u) -> (u', res)`` or None.
+
+    ``acc_f32`` (SEMANTICS.md f32chunk): intermediate sweeps ping-pong
+    two float32 scratch buffers instead of rounding to storage each
+    step — one storage rounding per K-step chunk, at the final core
+    write. Same invariants as kernel E's variant.
 
     Kernel E's temporal machinery under kernel C's two-axis clamped
     windows: each tile's window carries 2*SUB halo rows and 2*LANE halo
@@ -2560,7 +2731,7 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
     dtype = jnp.dtype(dtype_name)
     SUB = _sub_rows(dtype)
     assert 1 <= k <= SUB
-    tile = _pick_tile_temporal_2d(M, N, dtype)
+    tile = _pick_tile_temporal_2d(M, N, dtype, acc_f32)
     if tile is None:
         return None
     T, CW = tile
@@ -2597,7 +2768,12 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
             z = jnp.zeros((SCR_R, SCR_C), dtype)
             slots[0] = z
             slots[1] = z
-            pp[...] = z
+            if acc_f32:
+                zf = z.astype(jnp.float32)
+                pp[0] = zf
+                pp[1] = zf
+            else:
+                pp[...] = z
             dma(0, 0, 0).start()
 
         @pl.when(idx + 1 < nr * nc)
@@ -2616,23 +2792,12 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
         colmask = (cols_g >= 1) & (cols_g <= N - 2)
         coeffs = _pinned_coeffs(colmask, cx, cy)
         chunk_new, step_into = _pinned_stepper(
-            coeffs, s * T, C0R, M, dtype)
+            coeffs, s * T, C0R, M, dtype,
+            step_dtype=jnp.float32 if acc_f32 else None)
 
-        m = k - 1
         sref = slots.at[slot]
-
-        def double_step(_, carry):
-            del carry
-            step_into(sref, pp, SUB, T + 3 * SUB)
-            step_into(pp, sref, SUB, T + 3 * SUB)
-            return 0
-
-        if m > 1:
-            lax.fori_loop(0, m // 2, double_step, 0)
-        src = sref
-        if m % 2 == 1:
-            step_into(sref, pp, SUB, T + 3 * SUB)
-            src = pp
+        src = _run_intermediates(step_into, k - 1, sref, pp, acc_f32,
+                                 SUB, T + 3 * SUB)
 
         r_acc = jnp.float32(0.0)
         r0 = C0R
@@ -2672,7 +2837,8 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
         ),
         scratch_shapes=[
             pltpu.VMEM((2, SCR_R, SCR_C), dtype),
-            pltpu.VMEM((SCR_R, SCR_C), dtype),
+            (pltpu.VMEM((2, SCR_R, SCR_C), jnp.float32) if acc_f32
+             else pltpu.VMEM((SCR_R, SCR_C), dtype)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(),
@@ -2686,15 +2852,16 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
     return fn
 
 
-def _tile_temporal_multistep(shape, dtype, cx, cy):
+def _tile_temporal_multistep(shape, dtype, cx, cy, acc_f32=False):
     """(multi_step, multi_step_residual) on kernel I, or None."""
     if _pick_tile_temporal_2d(shape[0], shape[1],
-                              jnp.dtype(dtype)) is None:
+                              jnp.dtype(dtype), acc_f32) is None:
         return None
     SUB = _sub_rows(dtype)
     return _chunked_multistep(
         lambda k, res: _build_tile_temporal_2d(shape, dtype, cx, cy, k,
-                                               with_residual=res),
+                                               with_residual=res,
+                                               acc_f32=acc_f32),
         SUB)
 
 

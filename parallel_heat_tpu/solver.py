@@ -273,6 +273,14 @@ def _single_multistep(config: HeatConfig, backend: str):
             lambda u: step_3d_residual(u, cx, cy, cz),
         )
     cx, cy = config.cx, config.cy
+    if config.accumulate == "f32chunk":
+        # The chunked-f32 contract is backend-independent (SEMANTICS.md):
+        # the jnp backend honors it with the same chunk depth the
+        # temporal kernels use.
+        from parallel_heat_tpu.ops import pallas_stencil
+
+        return pallas_stencil.f32chunk_jnp_multistep(
+            config.shape, config.dtype, float(cx), float(cy))
     return steps_to_multistep(
         lambda u: step_2d(u, cx, cy),
         lambda u: step_2d_residual(u, cx, cy),
@@ -481,6 +489,12 @@ def explain(config: HeatConfig) -> dict:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
     if backend != "pallas":
+        if config.accumulate == "f32chunk":
+            from parallel_heat_tpu.ops import pallas_stencil as ps
+
+            out["path"] = ("chunked-f32 jnp multistep "
+                           f"K={ps._sub_rows(config.dtype)}")
+            return out
         out["path"] = "XLA-fused jnp stencil"
         if is_sharded:
             out["path"] += (
@@ -585,7 +599,26 @@ def explain(config: HeatConfig) -> dict:
             out["path"] = "XLA-fused jnp stencil (3D pickers declined)"
         return out
 
-    kind, _ = ps.pick_single_2d(config.shape, dtype, cx, cy)
+    acc = config.accumulate == "f32chunk"
+    kind, _ = ps.pick_single_2d(config.shape, dtype, cx, cy,
+                                accumulate=config.accumulate)
+    if acc:
+        # Same decision site as execution (single_grid_multistep's
+        # f32chunk branch); the suffix names the changed numerics.
+        if kind == "E":
+            t = ps._pick_temporal_strip(config.nx, config.ny, dtype,
+                                        acc_f32=True)
+            out["path"] = (f"kernel E (temporal-blocked strip, f32-chunk "
+                           f"accumulation) T={t} K={sub}")
+        elif kind == "I":
+            ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype,
+                                           acc_f32=True)
+            out["path"] = (f"kernel I (2D-tiled temporal, f32-chunk "
+                           f"accumulation) tile={ti[0]}x{ti[1]} K={sub}")
+        else:
+            out["path"] = ("chunked-f32 jnp multistep (temporal kernels "
+                           f"declined) K={sub}")
+        return out
     if kind == "A":
         out["path"] = "kernel A (VMEM-resident multi-step)"
     elif kind == "E":

