@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Price the sharded converge-mode round (VERDICT r4 #4).
+
+The reference measured its convergence machinery both ways — the MPI
+allreduce check degrades efficiency (Heat.pdf Table 2 vs 1) and the
+CUDA host-polled reduction costs ~2x at its worst (Table 7 vs 6). Our
+analog was only measured single-chip (REPORT §2: ~4-7% at 4096²); the
+per-device cost of the fused residual inside a kernel G-uni / H round
+was never priced. This tool measures it: the FULL jitted exchange
+round (zero halos standing in for the ppermuted strips, the
+ab_fused_g.py protocol) with ``with_residual=True`` vs ``False``,
+paired-interleaved, at the blocks the verdict names.
+
+The cross-device `lax.pmax` vote itself is ICI (unmeasurable on one
+chip); its bound is one collective latency per check window
+(`tpu_params.collective_latency_s`, ~5 us — amortized over
+check_interval steps, <0.1% at any measured block), so the in-kernel
+residual sweep measured here is the whole material cost.
+
+Run: python tools/ab_converge_cost.py [--out ab_converge_r5.json]
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.parallel import temporal as tp
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+
+def case_2d(M, N, dts, span_s, batches):
+    dt = jnp.dtype(dts)
+    k = ps._sub_rows(dt)
+    gs = (M, N)
+    ax = ("x", "y")
+    mesh_shape = (1, 1)
+    print(f"\n== kernel G-uni block {M}x{N} {dts} K={k}")
+    u0 = jax.block_until_ready(HeatPlate2D(M, N).init_grid(dt))
+    rounds, steps = {}, {}
+    for want_res, name in ((False, "fixed (no residual)"),
+                           (True, "converge (fused residual)")):
+        uni = ps._build_temporal_block_uniform(gs, dts, 0.1, 0.1, gs, k,
+                                               with_residual=want_res)
+        if uni is None:
+            print(f"  {name}: builder declined")
+            continue
+
+        # The residual sweep is work INSIDE the opaque Pallas call —
+        # XLA cannot DCE it even when the res output is dropped
+        # (the _chunked_multistep rationale), so [0] times the true
+        # with/without cost without adding any consumption op.
+        def round_fn(u, uni=uni):
+            t, hn, hs = tp.exchange_halos_fused_2d(
+                u, k, mesh_shape, ax, tail=uni.tail)
+            return uni(u, t, hn, hs, 0, 0)[0]
+        rounds[name] = round_fn
+        steps[name] = k
+    rates = bench_rounds_paired(rounds, u0, steps, span_s=span_s,
+                                batches=batches)
+    return {"kernel": "G-uni", "block": [M, N], "dtype": dts, "K": k,
+            "rates_gcells_steps_per_s": rates,
+            "residual_cost_pct": _cost_pct(rates)}
+
+
+def case_3d(block, mesh, dts, span_s, batches):
+    X, Y, Z = block
+    dt = jnp.dtype(dts)
+    pick = ps._pick_block_temporal_3d(block, mesh, dts)
+    if pick is None:
+        print(f"3D case {block}: picker declined")
+        return None
+    k = pick[1]
+    halos = tuple(k if d > 1 else 0 for d in mesh)
+    hx, hy, hz = halos
+    print(f"\n== kernel H block {block} {dts} K={k} halos={halos}")
+    u0 = jax.block_until_ready(HeatPlate3D(X, Y, Z).init_grid(dt))
+    rounds, steps = {}, {}
+    for want_res, name in ((False, "fixed (no residual)"),
+                           (True, "converge (fused residual)")):
+        fn = ps._build_temporal_block_3d_fused(
+            block, dts, 0.1, 0.1, 0.1, block, k, halos,
+            with_residual=want_res)
+        if fn is None:
+            print(f"  {name}: builder declined")
+            continue
+        Ye, Ze = Y + fn.tail_y, Z + fn.tail_z
+
+        def round_fn(u, fn=fn, k=k):
+            d = u.dtype
+            ztail = jnp.zeros((X, Y, fn.tail_z), d) if hz else None
+            ytail = jnp.zeros((X, fn.tail_y, Ze), d) if hy else None
+            xslab = jnp.zeros((k, Ye, Ze), d) if hx else None
+            return fn(u, ztail, ytail, xslab, xslab, -hx, 0, 0)[0]
+        rounds[name] = round_fn
+        steps[name] = k
+    rates = bench_rounds_paired(rounds, u0, steps, span_s=span_s,
+                                batches=batches)
+    return {"kernel": "H", "block": list(block), "mesh": list(mesh),
+            "dtype": dts, "K": k,
+            "rates_gcells_steps_per_s": rates,
+            "residual_cost_pct": _cost_pct(rates)}
+
+
+def _cost_pct(rates):
+    vals = {("converge" if n.startswith("converge") else "fixed"): r
+            for n, r in rates.items() if r is not None}
+    if len(vals) == 2 and vals["fixed"]:
+        return round(100 * (1 - vals["converge"] / vals["fixed"]), 2)
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--span", type=float, default=2.0)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--out", default=None, metavar="FILE")
+    ap.add_argument("--cases", default="0,1,2",
+                    help="comma-separated case indices")
+    args = ap.parse_args()
+    cases = [int(i) for i in args.cases.split(",")]
+    results = []
+    if 0 in cases:
+        results.append(case_2d(4096, 4096, "float32",
+                               args.span, args.batches))
+    if 1 in cases:
+        results.append(case_2d(16384, 8192, "bfloat16",
+                               args.span, args.batches))
+    if 2 in cases:
+        results.append(case_3d((256, 256, 256), (2, 2, 2), "float32",
+                               args.span, args.batches))
+    results = [r for r in results if r]
+    out = {
+        "what": "per-device cost of the fused convergence residual "
+                "inside the sharded temporal rounds (zero-halo "
+                "single-chip protocol; the pmax vote is bounded by "
+                "one collective latency per check window, <0.1%)",
+        "cases": results,
+    }
+    print("\n" + json.dumps(out, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Measured attempt at kernel A's lane-roll overhead (VERDICT r4 #5).
+
+REPORT §2b prices the stencil's two lane rolls at ~11% of the pass
+(the `noroll` microbenchmark) and asserts that eliminating them costs
+more than it removes. This tool turns the assertion into a paired
+measurement: one concrete alternative, run against production kernel A
+with the interleaved calibrated-slope protocol, recorded either way —
+the reference tuned its hot kernel by experiment (the threads-per-row
+sweep, `cuda/cuda_heat.cu:17-21` + Heat.pdf Table 6), not assertion.
+
+Variant ``padslice``: the ping-pong state lives in (M, N+2) buffers
+with the grid at columns [1, N+1); the left/right neighbors are lane-
+OFFSET SLICES (cols [0, N) and [2, N+2)) instead of two `jnp.roll`s of
+an aligned row. The lane rearrangement does not disappear — it moves:
+C itself now reads at offset 1 and the store lands at offset 1, so the
+variant trades 2 explicit roll ops for 3 implicit relayouts (C read,
+R read, store; L is aligned). Structural op-count analysis says
+production's 2 rolls are already the minimum (a 5-point stencil needs
+the row at 3 lane alignments no matter how it is written, and pre-
+shifted copies/multi-row fusion materialize MORE VMEM traffic, not
+less — the f32 intermediates exceed vregs at any useful strip size).
+The measurement checks whether Mosaic prices slice-relayouts below
+explicit rolls anyway.
+
+Boundary semantics match production (coefficient-vector pinning; pad
+columns zeroed once and never written: 0-coeff x 0-value). Bitwise
+equality with production is asserted before timing.
+
+Run: python tools/ab_roll_pad.py [--size 2048] [--k 64]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.ops.tpu_params import params as _hw_params
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+CP = pltpu.CompilerParams(vmem_limit_bytes=_hw_params().vmem_limit_bytes)
+
+
+def build_padslice(shape, k, strip_rows=128):
+    """Kernel A with lane-offset-slice neighbors on padded buffers."""
+    M, N = shape
+    dtype = jnp.dtype(jnp.float32)
+    cx = cy = 0.1
+    a0 = 1.0 - 2.0 * cx - 2.0 * cy
+    NP = N + 2  # grid at cols [1, N+1); cols 0 and N+1 are dead pads
+
+    R = strip_rows
+    strips = []
+    r0 = 1
+    while r0 < M - 1:
+        h = min(R, M - 1 - r0)
+        strips.append((r0, h))
+        r0 += h
+
+    def kernel(u_ref, out_ref, res_ref, a_ref, b_ref):
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        interior_c = (cols >= 1) & (cols <= N - 2)
+        a0v = jnp.where(interior_c, jnp.float32(a0), 1.0)
+        cxv = jnp.where(interior_c, jnp.float32(cx), 0.0)
+        cyv = jnp.where(interior_c, jnp.float32(cy), 0.0)
+
+        # Load the grid into the padded ping buffer; zero the pads
+        # (read as L/R of pinned boundary columns: 0 coeff x 0 value).
+        zc = jnp.zeros((M, 1), dtype)
+        a_ref[:, 0:1] = zc
+        a_ref[:, NP - 1:NP] = zc
+        b_ref[:, 0:1] = zc
+        b_ref[:, NP - 1:NP] = zc
+        a_ref[:, 1:N + 1] = u_ref[:, :]
+
+        def strip_new(src, r, h):
+            blk = src[r - 1:r + h + 1, :].astype(jnp.float32)
+            C = blk[1:-1, 1:N + 1]   # offset-1 read (relayout)
+            U = blk[:-2, 1:N + 1]
+            D = blk[2:, 1:N + 1]
+            L = blk[1:-1, 0:N]       # aligned
+            Rt = blk[1:-1, 2:N + 2]  # offset-2 read (relayout)
+            new = a0v * C + cxv * (U + D) + cyv * (L + Rt)
+            return new, C
+
+        def step_into(src, dst):
+            dst[0:1, 1:N + 1] = src[0:1, 1:N + 1]
+            dst[M - 1:M, 1:N + 1] = src[M - 1:M, 1:N + 1]
+            for r, h in strips:
+                new, _ = strip_new(src, r, h)
+                dst[r:r + h, 1:N + 1] = new.astype(dtype)
+
+        m = k - 1
+
+        def double_step(_, carry):
+            del carry
+            step_into(a_ref, b_ref)
+            step_into(b_ref, a_ref)
+            return 0
+
+        lax.fori_loop(0, m // 2, double_step, 0)
+        if m % 2 == 1:
+            step_into(a_ref, b_ref)
+            src_ref, dst_ref = b_ref, a_ref
+        else:
+            src_ref, dst_ref = a_ref, b_ref
+
+        dst_ref[0:1, 1:N + 1] = src_ref[0:1, 1:N + 1]
+        dst_ref[M - 1:M, 1:N + 1] = src_ref[M - 1:M, 1:N + 1]
+        r_acc = jnp.float32(0.0)
+        for r, h in strips:
+            new, C = strip_new(src_ref, r, h)
+            dst_ref[r:r + h, 1:N + 1] = new.astype(dtype)
+            r_acc = jnp.maximum(r_acc, jnp.max(jnp.abs(new - C)))
+        res_ref[0, 0] = r_acc
+        out_ref[:, :] = dst_ref[:, 1:N + 1]
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((M, NP), dtype),
+                        pltpu.VMEM((M, NP), dtype)],
+        interpret=ps._interpret(),
+        compiler_params=CP,
+    )
+
+    def fn(u):
+        out, res = call(u)
+        return out, res[0, 0]
+
+    return fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--span", type=float, default=2.0)
+    ap.add_argument("--batches", type=int, default=4)
+    args = ap.parse_args()
+    M = N = args.size
+    k = args.k
+
+    prod = ps._build_vmem_multistep((M, N), "float32", 0.1, 0.1, k)
+    pad = build_padslice((M, N), k)
+
+    from parallel_heat_tpu.models import HeatPlate2D
+
+    u0 = jax.block_until_ready(
+        HeatPlate2D(M, N).init_grid(jnp.float32))
+
+    # Bitwise equivalence before timing: identical arithmetic, only
+    # the lane-rearrangement expression differs.
+    a = np.asarray(jax.jit(lambda u: prod(u)[0])(u0))
+    b = np.asarray(jax.jit(lambda u: pad(u)[0])(u0))
+    if not np.array_equal(a, b):
+        print(f"MISMATCH: max|d| = {np.abs(a - b).max()} — refusing "
+              f"to time a kernel that computes something else")
+        return 1
+
+    rates = bench_rounds_paired(
+        {"prod (2 rolls)": lambda u: prod(u)[0],
+         "padslice (offset slices)": lambda u: pad(u)[0]},
+        u0, {"prod (2 rolls)": k, "padslice (offset slices)": k},
+        span_s=args.span, batches=args.batches)
+    if len(rates) == 2:
+        r = list(rates.values())
+        print(f"\npadslice / prod = {r[1] / r[0]:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,11 +34,13 @@ sys.path.insert(0, ".")
 import numpy as np
 
 FAILURES = []
+CHECKS = []  # every check() call this invocation, for the --out artifact
 
 
 def check(name, ok, detail=""):
     status = "ok" if ok else "FAIL"
     print(f"  [{status}] {name}" + (f" ({detail})" if detail else ""))
+    CHECKS.append({"name": name, "ok": bool(ok), "detail": str(detail)})
     if not ok:
         FAILURES.append(name)
 
@@ -551,6 +553,14 @@ def main():
                          "transport the full battery can exceed 10 "
                          "minutes; splitting it across invocations "
                          "keeps each under a shell timeout")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="merge this invocation's per-section "
+                         "pass/fail + per-check results into a JSON "
+                         "artifact (append/update semantics, so the "
+                         "split-section protocol accumulates one "
+                         "committed per-round record — round-4 "
+                         "verdict: validation evidence should live in "
+                         "an artifact, not commit prose)")
     args = ap.parse_args()
     if args.sections is None:
         run = list(sections)
@@ -569,8 +579,37 @@ def main():
     import jax
     print(f"devices: {jax.devices()}")
 
+    per_section = {}
     for name in run:
+        n0 = len(CHECKS)
         sections[name](args)
+        per_section[name] = CHECKS[n0:]
+
+    if args.out:
+        import json
+        import os
+        import time
+
+        data = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                data = json.load(f)
+        data.setdefault("sections", {})
+        for name, recs in per_section.items():
+            data["sections"][name] = {
+                "ok": all(r["ok"] for r in recs) and bool(recs),
+                "n_checks": len(recs),
+                "checks": recs,
+            }
+        data["device"] = str(jax.devices()[0])
+        data["last_run"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        data["sections_green"] = sorted(
+            n for n, s in data["sections"].items() if s["ok"])
+        data["sections_failed"] = sorted(
+            n for n, s in data["sections"].items() if not s["ok"])
+        with open(args.out, "w") as f:
+            json.dump(data, f, indent=1)
+        print(f"merged {','.join(run)} into {args.out}")
 
     if FAILURES:
         print(f"\n{len(FAILURES)} FAILED: {FAILURES}")

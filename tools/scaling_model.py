@@ -76,6 +76,13 @@ def project(name, grid, mesh, K, itemsize, rate_dev, rate_single,
         "rate_per_device_gcells_s": list(rate_dev),
         "rate_single_device_gcells_s": list(rate_single),
         "rate_provenance": provenance,
+        # The number to quote (round-4 verdict: the conservative bound
+        # leads, not the range): worst measured per-device rate, no
+        # overlap credit, all ICI charged serially.
+        "conservative": {
+            "speedup": rows["no_overlap"]["speedup"][0],
+            "efficiency": rows["no_overlap"]["efficiency"][0],
+        },
         "projection": rows,
     }
 
@@ -118,6 +125,17 @@ def main():
                     "real chip; ICI terms are spec-order v5e numbers "
                     "from tpu_params, unmeasurable single-chip. "
                     "Ranges propagate measured session variance."),
+        "headline_conservative": {
+            "note": ("QUOTE THESE (round-4 verdict): worst measured "
+                     "per-device rate, no overlap credit. The bf16 "
+                     "row's upper range is superlinear (>1.0 "
+                     "efficiency) only because the single-chip 32768^2 "
+                     "comparison point is kernel I's slower wide-row "
+                     "regime while per-device blocks run G-uni's fast "
+                     "regime — a real mechanism, but the conservative "
+                     "bound is the defensible claim."),
+            "rows": {r["config"]: r["conservative"] for r in rows},
+        },
         "assumptions": [
             "per-device round rate at the full shard block equals the "
             "rate measured at the nearest measured block (row-count "
