@@ -498,6 +498,62 @@ def dtype_mode_matrix():
     check("float64 declines pallas", ok)
 
 
+def accumulate_checks():
+    """The f32chunk acc kernels on real hardware (round 5).
+
+    Kernels E-acc and I-acc vs the chunked-f32 jnp multistep: same
+    rounding points, factored-vs-textbook f32 forms — agreement to
+    storage-dtype ulps (SEMANTICS.md cross-path contract); plus the
+    boundary-exactness invariant under the new scratch layout.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+    from parallel_heat_tpu.solver import explain, make_initial_grid
+
+    print("f32chunk accumulation kernels vs chunked-f32 jnp:")
+    steps = 37
+    for nx, ny, kind in ((1024, 1024, "E"), (768, 2048, "I")):
+        cfg = HeatConfig(nx=nx, ny=ny, steps=steps, dtype="bfloat16",
+                         backend="pallas", accumulate="f32chunk")
+        u0 = make_initial_grid(cfg)
+        if kind == "E":
+            got = solve(cfg, initial=u0).to_numpy().astype(np.float64)
+            path = explain(cfg)["path"]
+            # Routing is its own check: a pick change must not
+            # masquerade as a numerics failure below.
+            check(f"kernel E-acc {nx}x{ny} routed via kernel E "
+                  f"f32-chunk", "kernel E" in path
+                  and "f32-chunk" in path, path)
+        else:
+            ms = ps._tile_temporal_multistep((nx, ny), "bfloat16",
+                                             0.1, 0.1, acc_f32=True)
+            if ms is None:
+                check(f"kernel I-acc {nx}x{ny} builds", False)
+                continue
+            got = np.asarray(
+                jax.jit(lambda u: ms[0](u, steps))(jnp.asarray(u0))
+            ).astype(np.float64)
+        ref_ms = ps.f32chunk_jnp_multistep((nx, ny), "bfloat16",
+                                           0.1, 0.1)
+        ref = np.asarray(
+            jax.jit(lambda u: ref_ms[0](u, steps))(jnp.asarray(u0))
+        ).astype(np.float64)
+        scale = np.abs(ref).max()
+        d = np.abs(got - ref).max()
+        ok = bool(np.isfinite(got).all()) and d <= 8e-3 * scale
+        check(f"kernel {kind}-acc {nx}x{ny} bf16 k-chunked", ok,
+              f"max|d|={d:.3g} scale={scale:.3g}")
+        u0n = np.asarray(u0).astype(np.float64)
+        bok = (np.array_equal(got[0, :], u0n[0, :])
+               and np.array_equal(got[-1, :], u0n[-1, :])
+               and np.array_equal(got[:, 0], u0n[:, 0])
+               and np.array_equal(got[:, -1], u0n[:, -1]))
+        check(f"kernel {kind}-acc boundary exact (4 edges)", bool(bok))
+
+
 def stream_checkpoint_roundtrip():
     from parallel_heat_tpu import HeatConfig, solve
     from parallel_heat_tpu.solver import solve_stream
@@ -536,6 +592,7 @@ def main():
             cases=_KERNEL_H_CASES[4:], divergence=True),
         "divergence": lambda a: divergence_guard_checks(),
         "dtypes": lambda a: dtype_mode_matrix(),
+        "accumulate": lambda a: accumulate_checks(),
         "odd": lambda a: odd_geometry_sweep(a.quick),
         "odd_a": lambda a: odd_geometry_sweep(True,
                                               cases=_ODD_CASES[:5]),
