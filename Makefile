@@ -9,6 +9,8 @@ STEPS ?= 10000
 STEP ?= 20
 BACKEND ?= tpu
 MESH ?=
+DTYPE ?= float32
+ACC ?= storage
 PY ?= python
 
 ifeq ($(BACKEND),tpu)
@@ -22,7 +24,8 @@ MESH_FLAG = --mesh $(MESH)
 endif
 
 RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
-      --check-interval $(STEP) $(BACKEND_FLAG) $(MESH_FLAG)
+      --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
+      $(BACKEND_FLAG) $(MESH_FLAG)
 
 .PHONY: all heat heat_con native test bench clean
 

@@ -159,6 +159,22 @@ def test_f32chunk_converge_mode():
     assert abs(a.steps_run - b.steps_run) <= 3 * kw["check_interval"]
 
 
+def test_solve_stream_f32chunk_matches_solve():
+    # The chunked driver must compose with the acc semantics: chunk
+    # boundaries land on multiples of chunk_steps (here a multiple of
+    # K=16), so streaming doesn't move any rounding point.
+    from parallel_heat_tpu.solver import solve_stream
+
+    kw = dict(nx=64, ny=256, steps=96, dtype="bfloat16",
+              backend="pallas", accumulate="f32chunk")
+    whole = solve(HeatConfig(**kw)).to_numpy()
+    last = None
+    for res in solve_stream(HeatConfig(**kw), chunk_steps=32):
+        last = res
+    assert last is not None and last.steps_run == 96
+    np.testing.assert_array_equal(last.to_numpy(), whole)
+
+
 def test_boundary_exact_under_f32chunk():
     cfg = HeatConfig(nx=64, ny=256, steps=33, dtype="bfloat16",
                      backend="pallas", accumulate="f32chunk")
