@@ -3473,10 +3473,10 @@ def _score_block_temporal_3d(block_shape, mesh_shape, dtype, k):
     if k > min(block_shape):
         # Deeper halos than one block would need multi-hop exchanges —
         # the same structural bound config.validate() enforces on
-        # explicit depths. Scoring such a k would let the picker's
-        # sub-f32 +1 correction step past the bound the main sweep
-        # caps at (round-4 advisor: grid (16,32,128), mesh (2,2,1),
-        # bf16 auto-resolved depth 9 on min-extent-8 blocks → NaNs).
+        # explicit depths. Kept even though the sub-f32 +1 correction
+        # that once stepped past it is gone (round-4 advisor: depth 9
+        # auto-resolved on min-extent-8 blocks → NaNs; correction
+        # removed in round 5): every scorer caller must see the bound.
         return None
     halos = tuple(k if d > 1 else 0 for d in mesh_shape)
     pick = _pick_block_xslab_3d(block_shape, halos, dtype, k,
@@ -3518,20 +3518,21 @@ def _pick_block_temporal_3d(block_shape, mesh_shape, dtype):
     one block would need multi-hop exchanges — config.validate()'s
     bound).
 
-    Sub-f32 dtypes get a measured +1 depth correction on the model's
-    pick: the hardware sweep consistently prefers one-deeper K than
-    the model at bf16 — round 3's five-geometry sweep measured K=7
-    6-19% over the picked K=6 across invocations, and the round-4
-    re-run with the 3-slot kernels again put K=7 on top (76.3
-    Gcells·steps/s vs K=8's 69.0 at the 128×128×256 block; the model
-    still ranks K=6 first). The model's cost terms are f32-calibrated
-    and miss whatever makes bf16's deeper rounds cheaper (the
-    2-byte HBM pass amortizes further than the linear term credits);
-    rather than overfit a dtype term into the model, the measured bias
-    is applied to its answer — the reference's own discipline of
-    *using* the sweep's conclusion (threads-per-row 8, not the
-    default, Heat.pdf Table 6). Applied only when the deeper schedule
-    is feasible (scored non-None).
+    History of the sub-f32 "+1 depth correction" (rounds 3-5, now
+    REMOVED): rounds 3 and 4's hardware sweeps consistently ranked
+    bf16 K=7 6-19% over the model's K=6 at the 128x128x256 block, so
+    round 4 applied a measured +1 to the model's pick. Round 5
+    attributed that ranking to the MEASUREMENT PROTOCOL, not the
+    device: these sub-0.4 ms rounds are host-enqueue-bound over the
+    axon tunnel (chained wall-clock measures calls/second, not device
+    time), and the device-plane trace (`tools/trace_small_h.py`) runs
+    the same block at 50.3/52.3/52.6/55.7 us/step for K=5/6/7/8 —
+    monotonically WORSE with depth, matching the model's (sx+2k)/sx
+    amplification almost exactly. The model's raw ranking was correct
+    all along; the correction cost ~0.5% in production (whole-solve
+    jitted programs have no per-round dispatch) and once shipped a
+    NaN bug (the round-4 advisor's bmin overstep). REPORT §4d.1 holds
+    the full elimination chain.
     """
     bmin = min(block_shape)
     best = None
@@ -3543,15 +3544,6 @@ def _pick_block_temporal_3d(block_shape, mesh_shape, dtype):
         t, sx = scored
         if t < best_t:
             best_t, best = t, (sx, k)
-    if (best is not None and jnp.dtype(dtype).itemsize < 4
-            and best[1] + 1 <= bmin):
-        # The explicit bmin re-check is belt to _score's suspenders:
-        # the corrected depth must honor the same smallest-block-extent
-        # bound the main sweep caps at (multi-hop exchange limit).
-        deeper = _score_block_temporal_3d(block_shape, mesh_shape,
-                                          dtype, best[1] + 1)
-        if deeper is not None:
-            best = (deeper[1], best[1] + 1)
     return best
 
 

@@ -240,12 +240,14 @@ def test_pick_block_temporal_3d_pins():
     # exchange schedule — re-measure before accepting.
     assert ps._pick_block_temporal_3d((256, 256, 256), (2, 2, 2),
                                       "float32") == (32, 4)
-    # Sub-f32 +1 depth correction (round 4): the hardware sweep
-    # consistently prefers one-deeper K at bf16 (K=7 measured over the
-    # model's K=6, rounds 3 AND 4) — auto-depth serves the measured
-    # best, not the model's raw pick.
+    # bf16 serves the model's raw pick (K=6). The rounds-3/4 "+1 depth
+    # correction" was removed in round 5: the sweeps that motivated it
+    # were host-enqueue-bound at these sub-ms rounds, and the
+    # device-plane trace shows per-step time monotonically WORSE with
+    # depth (50.3/52.3/52.6/55.7 us/step at K=5/6/7/8 —
+    # tools/trace_small_h.py, REPORT 4d.1).
     assert ps._pick_block_temporal_3d((128, 128, 256), (2, 2, 2),
-                                      "bfloat16") == (64, 7)
+                                      "bfloat16") == (64, 6)
     # Non-pow2 (but tile-aligned) blocks pick divisor slabs.
     sx, k = ps._pick_block_temporal_3d((120, 120, 384), (2, 2, 1),
                                        "float32")

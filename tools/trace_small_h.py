@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Device-timeline trace of the small-plane kernel-H round (round 5).
+
+`picker_sweep_r5.json` records a reproducible bias the cost model
+cannot express: at the (96, 120, 384) two-slab block, per-ROUND time
+is nearly flat in K (0.28 ms at K=4 -> 0.33 ms at K=7), so deeper K
+wins ~linearly — a fixed per-call cost dominates, and three candidate
+model terms were rejected against measurement (REPORT §4d.1). This
+tool answers "what IS the fixed cost": it traces the full jitted
+round at two depths and prints every device-plane line's per-op
+aggregate, so the flat component can be attributed (Mosaic custom
+call? XLA exchange glue? dispatch gaps between ops?).
+
+Run on the real chip:
+    python tools/trace_small_h.py [--k 4 --k2 7] [--reps 40]
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate3D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from tools.trace_fused_g import analyze, capture
+
+DEFAULT_BLOCK = "96,120,384"
+DEFAULT_MESH = "2,2,1"
+
+
+def build_round(k, dts, block, mesh):
+    X, Y, Z = block
+    halos = tuple(k if d > 1 else 0 for d in mesh)
+    hx, hy, hz = halos
+    fn = ps._build_temporal_block_3d_fused(
+        block, dts, 0.1, 0.1, 0.1, block, k, halos,
+        with_residual=False)
+    if fn is None:
+        return None
+    Ye, Ze = Y + fn.tail_y, Z + fn.tail_z
+
+    def round_k(u):
+        d = u.dtype
+        ztail = jnp.zeros((X, Y, fn.tail_z), d) if hz else None
+        ytail = jnp.zeros((X, fn.tail_y, Ze), d) if hy else None
+        xslab = jnp.zeros((k, Ye, Ze), d) if hx else None
+        return fn(u, ztail, ytail, xslab, xslab, -hx, 0, 0)[0]
+
+    return round_k
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--k2", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=40)
+    ap.add_argument("--block", default=DEFAULT_BLOCK)
+    ap.add_argument("--mesh", default=DEFAULT_MESH)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+    block = tuple(int(v) for v in args.block.split(","))
+    mesh = tuple(int(v) for v in args.mesh.split(","))
+    print(json.dumps({"block": list(block), "mesh": list(mesh),
+                      "dtype": args.dtype, "reps": args.reps}))
+    u0 = HeatPlate3D(*block).init_grid(jnp.dtype(args.dtype))
+    for k in (args.k, args.k2):
+        fn = build_round(k, args.dtype, block, mesh)
+        if fn is None:
+            print(f"K={k}: builder declined")
+            continue
+        path = capture(jax.jit(fn), u0, args.reps)
+        if path is None:
+            print(f"K={k}: no xplane captured")
+            continue
+        analyze(path, args.reps, f"kernel H K={k}")
+
+
+if __name__ == "__main__":
+    main()
