@@ -114,9 +114,35 @@ def main(argv=None):
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--budget", type=float, default=10.0,
                     help="target seconds for the chained timing batch")
+    ap.add_argument("--row", default="headline",
+                    choices=("headline", "conv256"),
+                    help="which single row the one-line stdout "
+                         "contract reports: the fixed-step headline "
+                         "(default) or the 256^2-to-eps converge row "
+                         "(--row conv256 runs ONLY that row and skips "
+                         "the artifact — the tools/headline_variance.py "
+                         "protocol hook)")
     args = ap.parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig
+
+    if args.row == "conv256":
+        # One-shot-minus-floor timing (a converged run cannot be
+        # chained); same config as the secondary table's row, printed
+        # as THE json line so fresh-process variance runs can parse it.
+        cfg = HeatConfig(nx=256, ny=256, steps=600_000, converge=True,
+                         check_interval=20, eps=1e-3,
+                         backend=args.backend)
+        elapsed, res = _bench_converge(cfg)
+        print(json.dumps({
+            "metric": "256^2 to eps=1e-3 convergence (wall-clock s)",
+            "wall_s": round(elapsed, 4),
+            "mcells_steps_per_s": round(
+                cfg.nx * cfg.ny * res.steps_run / elapsed / 1e6, 1),
+            "steps_to_converge": res.steps_run,
+            "converged": res.converged,
+        }))
+        return
 
     headline = HeatConfig(nx=1000, ny=1000, steps=10_000,
                           backend=args.backend)

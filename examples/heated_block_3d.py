@@ -47,7 +47,8 @@ def main():
     if args.cpu_devices:
         try:
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            from parallel_heat_tpu.utils.compat import request_cpu_devices
+            request_cpu_devices(args.cpu_devices)
         except RuntimeError:
             pass  # backend already initialized
 

@@ -21,6 +21,26 @@ _VALID_DTYPES = ("float32", "bfloat16", "float64")
 _VALID_BACKENDS = ("auto", "jnp", "pallas")
 
 
+def divisible_factorizations(n_devices: int, shape) -> list:
+    """Ordered ``len(shape)``-factorizations of ``n_devices`` whose
+    factors divide the grid extents — the mesh shapes a given device
+    count CAN legally take on a given grid. Used to make the
+    non-divisible-mesh error actionable and by ``--mesh auto``'s
+    fallback, so the two can never disagree about legality."""
+    shape = tuple(shape)
+
+    def rec(n, dims):
+        if len(dims) == 1:
+            return [(n,)] if dims[0] % n == 0 else []
+        out = []
+        for d in range(1, n + 1):
+            if n % d == 0 and dims[0] % d == 0:
+                out += [(d,) + rest for rest in rec(n // d, dims[1:])]
+        return out
+
+    return rec(n_devices, list(shape))
+
+
 def sublane_count(dtype: str) -> int:
     """TPU sublane tiling granularity for a storage dtype — the natural
     ``halo_depth`` for the Mosaic block kernel (kernel G). Mirrors
@@ -198,9 +218,32 @@ class HeatConfig:
         for n, d, name in zip(self.shape, mesh, "xyz"):
             if n % d != 0:
                 # The reference silently assumes divisibility
-                # (mpi/...stat.c:72-73, SURVEY.md §2d.6); we make it loud.
+                # (mpi/...stat.c:72-73, SURVEY.md §2d.6); we make it
+                # loud AND actionable: same device count, the mesh
+                # shapes that DO divide this grid — or, when none
+                # exists, the nearest grid sizes that would.
+                n_dev = 1
+                for dd in mesh:
+                    n_dev *= dd
+                valid = divisible_factorizations(n_dev, self.shape)
+                if valid:
+                    hint = (f"; valid {n_dev}-device mesh shapes for "
+                            f"this grid: "
+                            + ", ".join(str(v) for v in valid[:8])
+                            + (" ..." if len(valid) > 8 else ""))
+                else:
+                    near = []
+                    for nn, dd, nm in zip(self.shape, mesh, "xyz"):
+                        if nn % dd != 0:
+                            lo, hi = (nn // dd) * dd, (nn // dd + 1) * dd
+                            near.append(f"n{nm}={hi}" if lo == 0
+                                        else f"n{nm}={lo} or {hi}")
+                    hint = (f"; no factorization of {n_dev} devices "
+                            f"divides this grid — nearest divisible "
+                            f"sizes: " + ", ".join(near))
                 raise ValueError(
-                    f"grid n{name}={n} is not divisible by mesh d{name}={d}"
+                    f"grid n{name}={n} is not divisible by mesh "
+                    f"d{name}={d}" + hint
                 )
         if self.halo_depth is not None and self.halo_depth < 1:
             raise ValueError(

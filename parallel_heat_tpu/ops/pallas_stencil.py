@@ -46,6 +46,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from parallel_heat_tpu.ops.stencil import combine_2d, combine_3d
 from parallel_heat_tpu.parallel.halo import exchange_halos_2d
+from parallel_heat_tpu.utils.compat import (
+    pcast as _pcast,
+    tpu_compiler_params as _tpu_compiler_params,
+    vma_kw as _vma_kw,
+)
 
 _ACC = jnp.float32
 
@@ -61,7 +66,7 @@ def _compiler_params() -> pltpu.CompilerParams:
     # physical size so the pickers' budgets are real (without this, any
     # kernel whose buffers exceed 16 MiB fails with a scoped-vmem stack
     # OOM at compile time).
-    return pltpu.CompilerParams(
+    return _tpu_compiler_params(
         vmem_limit_bytes=_params().vmem_limit_bytes)
 
 
@@ -397,7 +402,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ],
     )
 
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         out_shape=(
@@ -422,7 +427,8 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
 # --------------------------------------------------------------------------
 
 def _pick_temporal_strip(out_rows: int, n_cols: int, dtype,
-                         acc_f32: bool = False) -> int | None:
+                         acc_f32: bool = False,
+                         uniform: bool = False) -> int | None:
     """Strip height for the temporal kernel, or None.
 
     Buffers: 2 DMA slots + 1 ping-pong scratch, each (T + 4*SUB, N),
@@ -435,6 +441,15 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype,
     storage-dtype ping-pong becomes TWO float32 buffers (the DMA slots
     cannot hold the f32 carry), so bf16 strips pay 8 extra bytes/cell
     of scratch and pick shorter T.
+
+    ``uniform``: size for the uniform-gather variant (E-uni). Scratch
+    cost is IDENTICAL (same SCR rows, same temporaries — the uniform
+    layout changes how bytes arrive, not where they live), but the
+    strip count must be >= 3: with <= 2 strips every strip is an edge
+    strip, the branch-free steady state the layout exists for never
+    forms, and kernel E's single clamped window is the right shape —
+    so the search caps T at out_rows // 3 and declines (the "2-strip
+    decline"; `pick_single_2d` then keeps kernel E).
     """
     if _needs_lane_alignment() and n_cols % _LANE != 0:
         return None
@@ -453,6 +468,8 @@ def _pick_temporal_strip(out_rows: int, n_cols: int, dtype,
     # variants hit Mosaic register-allocator spills (up to 45 MiB of
     # spill slots) and run anywhere from 8% to 5x slower than T=256.
     t_max = min(256, out_rows - 2 * sub)
+    if uniform:
+        t_max = min(t_max, out_rows // 3)
     best = None
     for t in range(sub, t_max + 1, sub):
         if out_rows % t != 0:
@@ -804,6 +821,197 @@ def _repin_boundary_2d(new, u):
     return new
 
 
+# --------------------------------------------------------------------------
+# Kernel E-uni: uniform-window gather variant of the temporal strip
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_temporal_strip_uniform(shape, dtype_name, cx, cy, k,
+                                  with_residual=True, acc_f32=False):
+    """Kernel E in the uniform-window gather layout (the round-4 G-uni
+    idiom back-ported to the single grid) — same interface, arithmetic
+    and bitwise outputs as :func:`_build_temporal_strip`.
+
+    Kernel E fetches each strip as ONE (W, N) clamped window whose
+    destination offset re-shapes at the edge strips, and sanitizes the
+    edge scratch bands under ``pl.when(s == n-1)`` — a branch evaluated
+    in the steady-state loop. At wide rows that single re-shaping
+    descriptor is also a *windowed* HBM walk: consecutive strips re-read
+    the 2*SUB overlap rows inside the main stream, so the stream never
+    runs at the linear-prefetch rate, and past the measured wide-row
+    knee the DMA stops hiding behind the sweeps (the same additive
+    signature `tools/trace_fused_g.py` pinned on the branchy kernel G —
+    REPORT §4b.1). Here the gather splits into three FIXED-shape
+    streams, the way G-uni splits u/tail:
+
+    - **core** (T, N): ``u[s*T : s*T+T)`` at scratch ``C0`` — issued
+      every strip, unconditional, and strictly sequential across
+      strips (each copy starts where the previous ended: the linear
+      walk HBM prefetchers like);
+    - **north/south halos** (SUB, N): the adjacent SUB-row bands at
+      ``C0-SUB`` / ``C0+T`` — same shape and destination every strip,
+      conditional ONLY at the two edge strips (``s > 0`` / ``s < n-1``,
+      G-uni's hn/hs discipline), riding their own semaphore lanes.
+
+    All sentinel zeroing happens once at program 0, both slots + the
+    ping-pong, BEFORE any DMA start (G-uni's ordering argument: where
+    a strip-0 copy covers a zeroed row, the DMA lands after the store
+    and real data wins) — the bands no DMA writes at the edge strips
+    ([C0-SUB, C0) on the first, [C0+T, C0+T+SUB) on the last) read as
+    zeros there and as stale-but-finite sweep data on later slot
+    reuses; both are frontier-safe (garbage advances one row per step,
+    K <= SUB, and beyond-grid rows are coefficient-pinned — 0*finite
+    = 0, so the Dirichlet rows stay exact and the influence dies one
+    row past the core, exactly kernel E's own margins). Scratch
+    geometry, sweep bands, chunk shapes, accumulation modes
+    (``acc_f32``) and the fn-level diverging-run re-pin are kernel E's
+    — outputs are bitwise kernel E's (pinned by tests and
+    hw_validate).
+
+    Declines (-> None, ``pick_single_2d`` keeps kernel E): lane-
+    misaligned widths on hardware (via the shared picker) and
+    geometries with fewer than 3 strips, where every strip is an edge
+    strip and no branch-free steady state exists (the "2-strip
+    decline" — the uniform picker caps T at out_rows // 3 so this
+    guard is normally unreachable; it backstops picker drift).
+    """
+    M, N = shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    assert 1 <= k <= SUB
+    T = _pick_temporal_strip(M, N, dtype, acc_f32, uniform=True)
+    if T is None:
+        return None
+    n_strips = M // T
+    if n_strips < 3:
+        return None
+    SCR = T + 4 * SUB                    # scratch rows (kernel E's)
+    C0 = 2 * SUB                         # scratch row of the strip's row 0
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        colmask = (cols >= 1) & (cols <= N - 2)
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+
+        def issue(slot, strip, start):
+            """Start (or wait) strip ``strip``'s gather copies. The
+            branch structure is a pure function of ``strip``, so waits
+            decrement exactly the semaphores their starts incremented
+            (the G-fuse/G-uni invariant)."""
+            def go(c):
+                c.start() if start else c.wait()
+
+            go(pltpu.make_async_copy(          # core: unconditional
+                u_hbm.at[pl.ds(pl.multiple_of(strip * T, SUB), T), :],
+                slots.at[slot, pl.ds(C0, T), :],
+                sems.at[slot, 0]))
+
+            @pl.when(strip > 0)
+            def _():
+                go(pltpu.make_async_copy(      # north halo band
+                    u_hbm.at[pl.ds(
+                        pl.multiple_of(strip * T - SUB, SUB), SUB), :],
+                    slots.at[slot, pl.ds(C0 - SUB, SUB), :],
+                    sems.at[slot, 1]))
+
+            @pl.when(strip < n - 1)
+            def _():
+                go(pltpu.make_async_copy(      # south halo band
+                    u_hbm.at[pl.ds(
+                        pl.multiple_of(strip * T + T, SUB), SUB), :],
+                    slots.at[slot, pl.ds(C0 + T, SUB), :],
+                    sems.at[slot, 2]))
+
+        zedge = jnp.zeros((2 * SUB, N), dtype)
+
+        @pl.when(s == 0)
+        def _():
+            # Sentinels first, then the DMA starts (docstring ordering
+            # argument). [0, C0) covers the read-margin row C0-SUB-1
+            # and the first strip's missing north band; [C0+T, SCR)
+            # covers the last strip's missing south band and the
+            # read-margin row T+3*SUB.
+            for sl in range(2):
+                slots[sl, 0:C0, :] = zedge
+                slots[sl, C0 + T:SCR, :] = zedge
+            if acc_f32:
+                zf = zedge.astype(jnp.float32)
+                for b in range(2):
+                    pp[b, 0:C0, :] = zf
+                    pp[b, C0 + T:SCR, :] = zf
+            else:
+                pp[0:C0, :] = zedge
+                pp[C0 + T:SCR, :] = zedge
+            issue(0, 0, True)
+
+        @pl.when(s + 1 < n)
+        def _():
+            issue((s + 1) % 2, s + 1, True)
+
+        slot = lax.rem(s, 2)
+        issue(slot, s, False)
+
+        sref = slots.at[slot]
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, s * T, C0, M, dtype,
+            step_dtype=jnp.float32 if acc_f32 else None)
+
+        src = _run_intermediates(step_into, k - 1, sref, pp, acc_f32,
+                                 SUB, T + 3 * SUB)
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0
+        while r0 < C0 + T:
+            h = min(_SUBSTRIP, C0 + T - r0)
+            new, C = chunk_new(src, r0, h)
+            out_ref[r0 - C0:r0 - C0 + h, :] = new.astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(r_acc, jnp.max(jnp.abs(new - C)))
+            r0 += h
+
+        @pl.when(s == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(s > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_strips,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        out_specs=(
+            pl.BlockSpec((T, N), lambda s: (s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR, N), dtype),
+            (pltpu.VMEM((2, SCR, N), jnp.float32) if acc_f32
+             else pltpu.VMEM((SCR, N), dtype)),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return _repin_boundary_2d(new, u), res[0, 0]
+
+    return fn
+
+
 _UNROLL = 8  # kernel calls per fori_loop iteration (see _chunked_multistep)
 
 
@@ -858,10 +1066,21 @@ def _chunked_multistep(build_fn, K):
     return multi_step, run
 
 
-def _temporal_multistep(shape, dtype, cx, cy, acc_f32=False):
-    """(multi_step, multi_step_residual) built on the temporal kernel,
-    or None if the geometry declines."""
+def _temporal_multistep(shape, dtype, cx, cy, acc_f32=False,
+                        uniform=False):
+    """(multi_step, multi_step_residual) built on the temporal kernel
+    (kernel E, or E-uni with ``uniform=True``), or None if the geometry
+    declines. A uniform request whose builder declines falls back to
+    kernel E — the clean decline path the picker relies on."""
     SUB = _sub_rows(dtype)
+    if uniform:
+        if _build_temporal_strip_uniform(shape, dtype, cx, cy, SUB,
+                                         acc_f32=acc_f32) is None:
+            return _temporal_multistep(shape, dtype, cx, cy, acc_f32)
+        return _chunked_multistep(
+            lambda k, res: _build_temporal_strip_uniform(
+                shape, dtype, cx, cy, k, res, acc_f32=acc_f32),
+            SUB)
     if _build_temporal_strip(shape, dtype, cx, cy, SUB,
                              acc_f32=acc_f32) is None:
         return None
@@ -1072,7 +1291,7 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
         ],
     )
 
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         out_shape=(
@@ -1248,7 +1467,7 @@ def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
             def _():
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(n_strips,),
@@ -1555,7 +1774,7 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     n_ops = 2 if defer_ns else 4
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(n_strips,),
@@ -1820,7 +2039,7 @@ def _build_temporal_block_uniform(block_shape, dtype_name, cx, cy,
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     n_ops = 2 if defer_ns else 4
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(n_strips,),
@@ -2000,7 +2219,7 @@ def _build_band_fix_2d(block_shape, dtype_name, cx, cy, grid_shape, k,
             def _():
                 res_ref[0, 0] = jnp.float32(0.0)
 
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(2,),
@@ -2145,9 +2364,77 @@ def _temporal_amps(t_strip, tile_ti, dtype):
     return amp_e, amp_i
 
 
+def _strip_temporal_score(t, dtype, wide: float = 1.0):
+    """Modeled max(VPU band time, DMA time) per cell·step for a
+    kernel-E strip — :func:`_tile_temporal_score`'s form with the row
+    band amplification only (full-width rows cancel out of both terms).
+    ``wide`` scales the VPU term by the measured wide-row penalty."""
+    sub = _sub_rows(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    hw = _params()
+    amp = (t + 2 * sub) / t
+    t_vpu = amp * wide / hw.vpu_cells_per_s
+    t_bw = (((t + 2 * sub) + t) * itemsize
+            / (sub * t) / hw.hbm_stream_bytes_per_s)
+    return max(t_vpu, t_bw)
+
+
+def _wide_row_factors(lanes):
+    """(windowed, uniform) sweep-rate penalty factors at ``lanes``
+    swept lanes — the measured wide-row decline split by DMA schedule
+    (TpuParams provenance: the re-shaping single-window schedules
+    degrade at the 0.2/16k slope, the uniform gather at 0.15/16k).
+    Both are 1.0 below the knee, so the uniform variants win the
+    schedule comparison EXACTLY where the model says the schedule
+    difference buys something — there is no hard-coded override."""
+    hw = _params()
+    over = max(0, lanes - hw.wide_row_knee_lanes) / 16384.0
+    return (1.0 + hw.wide_row_slope_per_16k * over,
+            1.0 + hw.wide_row_slope_uniform_per_16k * over)
+
+
+def _prefer_uniform_strip(shape, dtype, acc_f32=False):
+    """The measured E-vs-E-uni schedule choice: the uniform strip
+    height when the wide-row cost model strictly prefers the uniform
+    gather AND its geometry admits (>= 3 strips, aligned width), else
+    None (kernel E keeps the pick — below the knee the modeled scores
+    tie and the strict ``<`` keeps the incumbent)."""
+    t_u = _pick_temporal_strip(shape[0], shape[1], dtype, acc_f32,
+                               uniform=True)
+    if t_u is None:
+        return None
+    t_w = _pick_temporal_strip(shape[0], shape[1], dtype, acc_f32)
+    wide_w, wide_u = _wide_row_factors(shape[1])
+    if (_strip_temporal_score(t_u, dtype, wide_u)
+            < _strip_temporal_score(t_w, dtype, wide_w)):
+        return t_u
+    return None
+
+
+def _prefer_uniform_tile(shape, dtype, acc_f32=False):
+    """The I-vs-I-uni schedule choice (same rule as
+    :func:`_prefer_uniform_strip`): the uniform (T, CW) tile when the
+    model strictly prefers it, else None. The wide-row factor applies
+    at each schedule's own swept width (CW + 4*HC — the lanes one
+    sweep touches), so the comparison stays honest when the two
+    pickers land on different tiles."""
+    ti_u = _pick_tile_temporal_2d(shape[0], shape[1], dtype, acc_f32,
+                                  uniform=True)
+    if ti_u is None:
+        return None
+    ti_w = _pick_tile_temporal_2d(shape[0], shape[1], dtype, acc_f32)
+    hc = _col_halo_temporal(dtype)
+    wide_w, _ = _wide_row_factors(ti_w[1] + 4 * hc)
+    _, wide_u = _wide_row_factors(ti_u[1] + 4 * hc)
+    if (_tile_temporal_score(*ti_u, dtype, wide_u)
+            < _tile_temporal_score(*ti_w, dtype, wide_w)):
+        return ti_u
+    return None
+
+
 def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
     """The 2D single-device kernel decision: ``(kind, built_or_detail)``
-    with kind in {"A", "E", "I", "B", "C", "jnp"}.
+    with kind in {"A", "E", "E-uni", "I", "I-uni", "B", "C", "jnp"}.
 
     This is the ONE decision site — :func:`single_grid_multistep`
     executes its result and ``solver.explain`` reports it, so the two
@@ -2158,12 +2445,20 @@ def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
     entries); the _pick_* searches re-run but are a few hundred cheap
     iterations.
 
+    The temporal picks run a second, layout-level comparison: once the
+    E-vs-I family choice is made (window amplification, below), the
+    measured wide-row cost model decides windowed vs uniform-gather
+    schedule (:func:`_prefer_uniform_strip` / ``_tile``) — kinds
+    "E-uni"/"I-uni". Below the wide-row knee the modeled scores tie
+    and the incumbent windowed kernels keep the pick; declines
+    (2-strip, lane-misaligned) likewise keep E/I.
+
     ``accumulate='f32chunk'`` (SEMANTICS.md) restricts the choice to
     paths that honor the chunked-f32 contract: the temporal kernels'
-    acc variants (E or I, by the same amplification comparison against
-    the acc-aware pickers) or the chunked-f32 jnp fallback — the
-    single-step kernels (A/B/C) round every step by construction and
-    are never picked.
+    acc variants (E/E-uni or I/I-uni, by the same amplification
+    comparison against the acc-aware pickers) or the chunked-f32 jnp
+    fallback — the single-step kernels (A/B/C) round every step by
+    construction and are never picked.
     """
     if accumulate == "f32chunk":
         # config.validate() restricts f32chunk to bfloat16, so the
@@ -2175,10 +2470,19 @@ def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
         if acc_t is not None and acc_ti is not None:
             amp_e, amp_i = _temporal_amps(acc_t, acc_ti, dtype)
             if amp_i < amp_e:
+                ti_u = _prefer_uniform_tile(shape, dtype, acc_f32=True)
+                if ti_u is not None:
+                    return "I-uni", ti_u
                 return "I", acc_ti
         if acc_t is not None:
+            t_u = _prefer_uniform_strip(shape, dtype, acc_f32=True)
+            if t_u is not None:
+                return "E-uni", t_u
             return "E", acc_t
         if acc_ti is not None:
+            ti_u = _prefer_uniform_tile(shape, dtype, acc_f32=True)
+            if ti_u is not None:
+                return "I-uni", ti_u
             return "I", acc_ti
         return "jnp", None
     if fits_vmem(shape, dtype):
@@ -2198,13 +2502,22 @@ def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
             if ti is not None:
                 amp_e, amp_i = _temporal_amps(t, ti, dtype)
                 if amp_i < amp_e:
+                    ti_u = _prefer_uniform_tile(shape, dtype)
+                    if ti_u is not None:
+                        return "I-uni", ti_u
                     return "I", ti
+        t_u = _prefer_uniform_strip(shape, dtype)
+        if t_u is not None:
+            return "E-uni", t_u
         return "E", t
     # E declined (typically: strips too skinny under the f32-temporary
     # cap on very wide grids): the 2D-tiled temporal kernel keeps the
     # K-steps-per-fetch amortization with column windowing.
     ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype)
     if ti is not None:
+        ti_u = _prefer_uniform_tile(shape, dtype)
+        if ti_u is not None:
+            return "I-uni", ti_u
         return "I", ti
     # Single-step streaming: strips (B) vs 2D tiles (C), whichever
     # fetches fewer halo cells per useful cell. Wide sub-f32 grids are
@@ -2273,14 +2586,16 @@ def single_grid_multistep(config):
     if config.accumulate == "f32chunk":
         kind, _ = pick_single_2d(shape, dtype, cx, cy,
                                  accumulate="f32chunk")
-        if kind == "E":
+        if kind in ("E", "E-uni"):
             temporal = _temporal_multistep(shape, dtype, cx, cy,
-                                           acc_f32=True)
+                                           acc_f32=True,
+                                           uniform=kind == "E-uni")
             assert temporal is not None
             return temporal
-        if kind == "I":
+        if kind in ("I", "I-uni"):
             temporal = _tile_temporal_multistep(shape, dtype, cx, cy,
-                                                acc_f32=True)
+                                                acc_f32=True,
+                                                uniform=kind == "I-uni")
             assert temporal is not None
             return temporal
         return f32chunk_jnp_multistep(shape, dtype, cx, cy)
@@ -2300,19 +2615,23 @@ def single_grid_multistep(config):
 
     from parallel_heat_tpu.solver import steps_to_multistep
 
-    if kind == "E":
+    if kind in ("E", "E-uni"):
         # K-steps-per-pass temporal blocking (any storage dtype;
         # arithmetic is f32 with per-step storage rounding either way,
-        # so this is bit-identical to K single-step passes).
-        temporal = _temporal_multistep(shape, dtype, cx, cy)
+        # so this is bit-identical to K single-step passes). The
+        # uniform-gather variant is bitwise kernel E's; a uniform
+        # builder decline falls back to E inside the factory.
+        temporal = _temporal_multistep(shape, dtype, cx, cy,
+                                       uniform=kind == "E-uni")
         # pick==E implies the builder accepts (they share the decline
         # conditions); assert so a future builder-only decline point
         # fails loudly here instead of propagating None to the caller.
         assert temporal is not None
         return temporal
 
-    if kind == "I":
-        temporal = _tile_temporal_multistep(shape, dtype, cx, cy)
+    if kind in ("I", "I-uni"):
+        temporal = _tile_temporal_multistep(shape, dtype, cx, cy,
+                                            uniform=kind == "I-uni")
         assert temporal is not None  # pick==I implies the builder accepts
         return temporal
 
@@ -2416,8 +2735,8 @@ def block_steps(config, kw):
     # axis_index('x') is varying only on 'x' (resp. 'y'); the kernel
     # consumes the offsets together with the (x,y)-varying block, so
     # broaden each with pcast to satisfy shard_map's vma check.
-    row_off = lax.pcast(block_index[0] * bx, (axis_names[1],), to="varying")
-    col_off = lax.pcast(block_index[1] * by, (axis_names[0],), to="varying")
+    row_off = _pcast(block_index[0] * bx, (axis_names[1],), to="varying")
+    col_off = _pcast(block_index[1] * by, (axis_names[0],), to="varying")
 
     def pre(u):
         return jnp.pad(u, ((SUB, SUB), (0, 0)))
@@ -2603,7 +2922,7 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
         ],
     )
 
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         out_shape=(
@@ -2635,8 +2954,32 @@ def _col_halo_temporal(dtype) -> int:
     return _LANE if _needs_lane_alignment() else 2 * _sub_rows(dtype)
 
 
+def _tile_temporal_score(t, cw, dtype, wide: float = 1.0,
+                         acc_f32: bool = False):
+    """Modeled max(VPU band time, DMA time) per cell·step for a kernel-I
+    tile — the quantity :func:`_pick_tile_temporal_2d` minimizes.
+    ``wide`` scales the VPU term by the measured wide-row sweep penalty
+    (used by the windowed-vs-uniform schedule choice, NOT by tile
+    selection, which compares same-schedule candidates). ``acc_f32`` is
+    accepted for signature symmetry; the roofline terms do not change
+    (the f32 carry moves scratch bytes, not streamed bytes)."""
+    del acc_f32
+    sub = _sub_rows(dtype)
+    hc = _col_halo_temporal(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    hw = _params()
+    scr_c = cw + 4 * hc
+    core = t * cw
+    amp_vpu = ((t + 2 * sub) * scr_c) / core
+    t_vpu = amp_vpu * wide / hw.vpu_cells_per_s
+    t_bw = (((t + 2 * sub) * (cw + 2 * hc) + core) * itemsize
+            / (sub * core) / hw.hbm_stream_bytes_per_s)
+    return max(t_vpu, t_bw)
+
+
 def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype,
-                           acc_f32: bool = False):
+                           acc_f32: bool = False,
+                           uniform: bool = False):
     """(T, CW) for kernel I, or None.
 
     Kernel C's two-axis windows sized for kernel E's K=sublane temporal
@@ -2647,7 +2990,13 @@ def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype,
     f32-temporary cap — exactly the wide bf16 regime of the 32768^2
     north-star config, which kernel C served bandwidth-bound at ~650
     GB/s). Scores candidates by modeled max(VPU band time, DMA time)
-    per cell-step.
+    per cell-step (:func:`_tile_temporal_score`).
+
+    ``uniform``: size for the uniform-gather variant (I-uni): the VMEM
+    cost is identical (same scratch geometry), but the row-tile count
+    must be >= 3 — with <= 2 row bands every tile is a row-edge tile
+    and the branch-free row gather never reaches a steady state
+    (kernel E-uni's "2-strip decline", applied to the row axis).
     """
     sub = _sub_rows(dtype)
     itemsize = jnp.dtype(dtype).itemsize
@@ -2669,6 +3018,8 @@ def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype,
         # register-allocator spills (verified here too — the (512,
         # 8192) f32 schedule fails compilation outright).
         t_max = min(256, out_rows - 2 * sub)
+        if uniform:
+            t_max = min(t_max, out_rows // 3)
         for t in range(sub, t_max + 1, sub):
             if out_rows % t != 0:
                 continue
@@ -2684,12 +3035,7 @@ def _pick_tile_temporal_2d(out_rows: int, n_cols: int, dtype,
                 cost += scr_r * scr_c * (2 * 4 - itemsize)
             if cost > budget:
                 continue
-            core = t * cw
-            amp_vpu = ((t + 2 * sub) * scr_c) / core
-            t_vpu = amp_vpu / hw.vpu_cells_per_s
-            t_bw = (((t + 2 * sub) * (cw + 2 * hc) + core) * itemsize
-                    / (sub * core) / hw.hbm_stream_bytes_per_s)
-            score = max(t_vpu, t_bw)
+            score = _tile_temporal_score(t, cw, dtype)
             if score < best_t:
                 best_t, best = score, (t, cw)
     return best
@@ -2852,8 +3198,211 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
     return fn
 
 
-def _tile_temporal_multistep(shape, dtype, cx, cy, acc_f32=False):
-    """(multi_step, multi_step_residual) on kernel I, or None."""
+# --------------------------------------------------------------------------
+# Kernel I-uni: uniform-window gather variant of the 2D-tiled temporal
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _build_tile_temporal_2d_uniform(shape, dtype_name, cx, cy, k,
+                                    with_residual=True, acc_f32=False):
+    """Kernel I in the uniform-window gather layout — same interface,
+    arithmetic and bitwise outputs as :func:`_build_tile_temporal_2d`;
+    the row axis adopts kernel E-uni's fixed-shape gather.
+
+    Kernel I's per-tile fetch is one 2D-strided window whose ROW
+    destination re-shapes at the first/last row band (the clamped
+    window's compensating offset), so at wide-row geometries the same
+    re-shaping descriptor cost kernel E pays shows up here per tile.
+    I-uni splits the row axis into the three fixed streams — core
+    (T, WC) rows at scratch ``C0R``, unconditional; north/south
+    (SUB, WC) row-halo bands at ``C0R-SUB`` / ``C0R+T``, conditional
+    only at the grid's first/last row band — while the COLUMN axis
+    keeps kernel I's clamped window unchanged (adjacent column tiles
+    are not contiguous in HBM, so there is no linear column stream to
+    recover; the column margins already exceed the K-step frontier).
+    Within one row band the core copies of consecutive tiles walk the
+    rows of the same T-row slab left to right — the strided-but-
+    monotone order the round-4 gather probe measured at 635 GB/s vs
+    the dense re-shaping copy's 482 (`tools/probe_gather_dma.py`).
+
+    Zeroing keeps kernel I's once-at-tile-0 full-buffer discipline
+    (it already covers the un-DMA'd edge bands and the clamp margins;
+    later slot reuses leave stale-but-finite sweep data there, which
+    the frontier bound and the coefficient pinning neutralize exactly
+    as in kernel E-uni). Declines mirror E-uni's: fewer than 3 row
+    bands (every tile a row-edge tile — the 2-strip decline) on top
+    of everything :func:`_pick_tile_temporal_2d` already declines.
+    """
+    M, N = shape
+    dtype = jnp.dtype(dtype_name)
+    SUB = _sub_rows(dtype)
+    assert 1 <= k <= SUB
+    tile = _pick_tile_temporal_2d(M, N, dtype, acc_f32, uniform=True)
+    if tile is None:
+        return None
+    T, CW = tile
+    n_rows = M // T
+    if n_rows < 3:
+        return None
+    HC = _col_halo_temporal(dtype)
+    n_cols = N // CW
+    WC = CW + 2 * HC
+    SCR_R = T + 4 * SUB
+    SCR_C = CW + 4 * HC
+    C0R = 2 * SUB
+    C0C = 2 * HC
+
+    def kernel(u_hbm, out_ref, res_ref, slots, pp, sems):
+        s = pl.program_id(0)
+        c = pl.program_id(1)
+        nr = pl.num_programs(0)
+        nc = pl.num_programs(1)
+        idx = s * nc + c
+
+        def issue(slot, sr, sc, start):
+            """Start (or wait) tile (sr, sc)'s gather copies; branch
+            structure a pure function of (sr, sc) — the E-uni/G-uni
+            start/wait pairing invariant."""
+            col_start, col_dst = _clamped_window(
+                sc, CW, HC, N, WC, HC, C0C)
+
+            def go(cp):
+                cp.start() if start else cp.wait()
+
+            go(pltpu.make_async_copy(          # core rows: unconditional
+                u_hbm.at[pl.ds(pl.multiple_of(sr * T, SUB), T),
+                         pl.ds(col_start, WC)],
+                slots.at[slot, pl.ds(C0R, T), pl.ds(col_dst, WC)],
+                sems.at[slot, 0]))
+
+            @pl.when(sr > 0)
+            def _():
+                go(pltpu.make_async_copy(      # north row-halo band
+                    u_hbm.at[pl.ds(
+                        pl.multiple_of(sr * T - SUB, SUB), SUB),
+                        pl.ds(col_start, WC)],
+                    slots.at[slot, pl.ds(C0R - SUB, SUB),
+                             pl.ds(col_dst, WC)],
+                    sems.at[slot, 1]))
+
+            @pl.when(sr < nr - 1)
+            def _():
+                go(pltpu.make_async_copy(      # south row-halo band
+                    u_hbm.at[pl.ds(
+                        pl.multiple_of(sr * T + T, SUB), SUB),
+                        pl.ds(col_start, WC)],
+                    slots.at[slot, pl.ds(C0R + T, SUB),
+                             pl.ds(col_dst, WC)],
+                    sems.at[slot, 2]))
+
+        @pl.when(idx == 0)
+        def _():
+            # Kernel I's zero-once discipline: sentinels before the
+            # first DMA start, both slots + ping-pong.
+            z = jnp.zeros((SCR_R, SCR_C), dtype)
+            slots[0] = z
+            slots[1] = z
+            if acc_f32:
+                zf = z.astype(jnp.float32)
+                pp[0] = zf
+                pp[1] = zf
+            else:
+                pp[...] = z
+            issue(0, 0, 0, True)
+
+        @pl.when(idx + 1 < nr * nc)
+        def _():
+            c1 = c + 1
+            s_next = jnp.where(c1 < nc, s, s + 1)
+            c_next = jnp.where(c1 < nc, c1, 0)
+            issue((idx + 1) % 2, s_next, c_next, True)
+
+        slot = lax.rem(idx, 2)
+        issue(slot, s, c, False)
+
+        # Global column of scratch col 0 is clamp-invariant: c*CW - C0C.
+        cols_g = (c * CW - C0C
+                  + lax.broadcasted_iota(jnp.int32, (1, SCR_C), 1))
+        colmask = (cols_g >= 1) & (cols_g <= N - 2)
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+        chunk_new, step_into = _pinned_stepper(
+            coeffs, s * T, C0R, M, dtype,
+            step_dtype=jnp.float32 if acc_f32 else None)
+
+        sref = slots.at[slot]
+        src = _run_intermediates(step_into, k - 1, sref, pp, acc_f32,
+                                 SUB, T + 3 * SUB)
+
+        r_acc = jnp.float32(0.0)
+        r0 = C0R
+        while r0 < C0R + T:
+            h = min(_SUBSTRIP, C0R + T - r0)
+            new, C = chunk_new(src, r0, h)
+            core_new = new[:, C0C:C0C + CW]
+            out_ref[r0 - C0R:r0 - C0R + h, :] = core_new.astype(dtype)
+            if with_residual:
+                r_acc = jnp.maximum(
+                    r_acc,
+                    jnp.max(jnp.abs(core_new - C[:, C0C:C0C + CW])))
+            r0 += h
+
+        @pl.when(idx == 0)
+        def _():
+            res_ref[0, 0] = r_acc
+
+        if with_residual:
+            @pl.when(idx > 0)
+            def _():
+                res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_rows, n_cols),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((T, CW), lambda s, c: (s, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s, c: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((M, N), dtype),
+            jax.ShapeDtypeStruct((1, 1), _ACC),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, SCR_R, SCR_C), dtype),
+            (pltpu.VMEM((2, SCR_R, SCR_C), jnp.float32) if acc_f32
+             else pltpu.VMEM((SCR_R, SCR_C), dtype)),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u):
+        new, res = call(u)
+        return _repin_boundary_2d(new, u), res[0, 0]
+
+    return fn
+
+
+def _tile_temporal_multistep(shape, dtype, cx, cy, acc_f32=False,
+                             uniform=False):
+    """(multi_step, multi_step_residual) on kernel I (or I-uni), or
+    None. A uniform request whose builder declines falls back to the
+    windowed kernel I — the clean decline path the picker relies on."""
+    if uniform:
+        if _build_tile_temporal_2d_uniform(shape, dtype, cx, cy,
+                                           _sub_rows(dtype),
+                                           acc_f32=acc_f32) is None:
+            return _tile_temporal_multistep(shape, dtype, cx, cy,
+                                            acc_f32)
+        SUB = _sub_rows(dtype)
+        return _chunked_multistep(
+            lambda k, res: _build_tile_temporal_2d_uniform(
+                shape, dtype, cx, cy, k, with_residual=res,
+                acc_f32=acc_f32),
+            SUB)
     if _pick_tile_temporal_2d(shape[0], shape[1],
                               jnp.dtype(dtype), acc_f32) is None:
         return None
@@ -3733,7 +4282,7 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     pp_planes = SCR if k > 1 else 2
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(n_slabs,),
@@ -4070,7 +4619,7 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     pp_planes = SCR if k > 1 else 2
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(n_slabs,),
@@ -4316,7 +4865,7 @@ def _build_band_fix_3d(block_shape, dtype_name, cx, cy, cz, grid_shape,
                 res_ref[0, 0] = jnp.maximum(res_ref[0, 0], r_acc)
 
     n_ops = 3 + int(has_z) + int(has_y)
-    kw = {} if vma is None else {"vma": frozenset(vma)}
+    kw = _vma_kw(vma)
     call = pl.pallas_call(
         kernel,
         grid=(2,),

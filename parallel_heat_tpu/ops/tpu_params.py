@@ -73,6 +73,17 @@ class TpuParams:
     # until measured there.
     wide_row_knee_lanes: int = 8448
     wide_row_slope_per_16k: float = 0.2
+    # Uniform-gather schedule's wide-row slope (round 6): the round-4
+    # wide-row pairs split cleanly by DMA schedule — the re-shaping
+    # single-window schedules degrade at the full 0.2 slope (kernel E
+    # 202.3 -> 181.7, +11.3% == 0.226/16k), while the uniform gather
+    # held its overlap (kernel G-uni 186.6 -> 173.7, +7.4% == 0.148/16k
+    # at the same +8192 lanes). 0.15 brackets the uniform pair the way
+    # 0.2 brackets the windowed one. Used by pick_single_2d's
+    # windowed-vs-uniform schedule choice (E vs E-uni, I vs I-uni):
+    # below the knee the factors are equal and the incumbent windowed
+    # kernels keep the pick.
+    wide_row_slope_uniform_per_16k: float = 0.15
 
     @property
     def vmem_limit_bytes(self) -> int:

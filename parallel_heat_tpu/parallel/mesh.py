@@ -58,6 +58,35 @@ def _factorizations(n: int, ndim: int):
                 yield (d,) + rest
 
 
+def _balanced_divisible(n_devices: int, grid_shape) -> Optional[Tuple[int, ...]]:
+    """The most surface-balanced factorization that DIVIDES the grid,
+    or None when no factorization does.
+
+    The scored pickers' fallback: ``--mesh auto`` must never return a
+    mesh ``config.validate()`` then rejects, so when the cost model has
+    nothing to score the fallback still restricts itself to the legal
+    shapes (``config.divisible_factorizations`` — the same list the
+    validation error prints). "Balanced" minimizes total cut surface
+    ``sum_i (d_i - 1) * prod_{j != i} n_j`` (the halo bytes a mesh
+    exchanges), tie-broken toward descending factors like
+    :func:`pick_mesh_shape`.
+    """
+    from parallel_heat_tpu.config import divisible_factorizations
+
+    grid_shape = tuple(grid_shape)
+    best = None
+    for mesh in divisible_factorizations(n_devices, grid_shape):
+        total = 1
+        for n in grid_shape:
+            total *= n
+        cut = sum((d - 1) * (total // n)
+                  for d, n in zip(mesh, grid_shape))
+        key = (cut, tuple(-d for d in mesh))
+        if best is None or key < best[0]:
+            best = (key, mesh)
+    return None if best is None else best[1]
+
+
 def pick_mesh_shape_scored(n_devices: int, grid_shape,
                            dtype="float32") -> Tuple[int, ...]:
     """Grid-aware mesh factorization — ``MPI_Dims_create`` upgraded
@@ -103,20 +132,28 @@ def pick_mesh_shape_scored(n_devices: int, grid_shape,
         if t < best_t:
             best_t, best = t, mesh
     if best is None:
-        # Fall back to the balanced pick, loudly: a scored pick and a
-        # fallback look identical to the caller, and the balanced pick
-        # may shard z (the measured-slow axis) — a user of --mesh auto
-        # should be able to tell which they got and why.
-        fallback = pick_mesh_shape(n_devices, 3)
-        reason = ("no ndim-factorization of %d divides grid %r (prime "
-                  "or odd extents)" % (n_devices, grid_shape)
-                  if not any_divisible else
-                  "no divisible factorization admits the Mosaic block "
-                  "kernel at grid %r (blocks too small)" % (grid_shape,))
+        # Fall back, loudly: a scored pick and a fallback look
+        # identical to the caller, and the fallback may shard z (the
+        # measured-slow axis) — a user of --mesh auto should be able
+        # to tell which they got and why. The fallback is restricted
+        # to DIVISIBLE factorizations (config.validate() would reject
+        # anything else downstream with this same device count); when
+        # none exists the pick itself raises, actionably, instead of
+        # handing back a mesh the grid is guaranteed to reject.
+        if not any_divisible:
+            raise ValueError(
+                f"no {len(grid_shape)}-factor mesh of {n_devices} "
+                f"devices divides grid {grid_shape} (prime or odd "
+                f"extents); pass an explicit mesh for a different "
+                f"device count, or resize the grid to multiples of "
+                f"the device factors")
+        fallback = _balanced_divisible(n_devices, grid_shape)
         warnings.warn(
-            f"pick_mesh_shape_scored: {reason}; falling back to the "
-            f"balanced factorization {fallback}, which the kernel cost "
-            f"model did not score", stacklevel=2)
+            "pick_mesh_shape_scored: no divisible factorization "
+            "admits the Mosaic block kernel at grid %r (blocks too "
+            "small); falling back to the balanced divisible "
+            "factorization %r, which the kernel cost model did not "
+            "score" % (grid_shape, fallback), stacklevel=2)
         return fallback
     return best
 
@@ -191,13 +228,23 @@ def _pick_mesh_shape_scored_2d(n_devices: int, grid_shape,
                  + phases * hw.collective_latency_s) / K
         cands.append((t_vpu + t_ici, Ye, mesh))
     if not cands:
-        fallback = pick_mesh_shape(n_devices, 2)
+        # Same discipline as the 3D fallback: only divisible shapes
+        # may come back (--mesh auto must never pick a mesh
+        # config.validate() rejects); nothing divisible raises with
+        # the actionable story instead.
+        fallback = _balanced_divisible(n_devices, grid_shape)
+        if fallback is None:
+            raise ValueError(
+                f"no 2-factor mesh of {n_devices} devices divides "
+                f"grid {grid_shape} (prime or odd extents); pass an "
+                f"explicit mesh for a different device count, or "
+                f"resize the grid to multiples of the device factors")
         warnings.warn(
             f"pick_mesh_shape_scored: no factorization of {n_devices} "
             f"admits the 2D Mosaic block kernels at grid {grid_shape} "
             f"(unaligned or undivisible extents); falling back to the "
-            f"balanced factorization {fallback}, which the kernel cost "
-            f"model did not score", stacklevel=3)
+            f"balanced divisible factorization {fallback}, which the "
+            f"kernel cost model did not score", stacklevel=3)
         return fallback
     return min(cands)[2]
 

@@ -39,6 +39,8 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import lax
 
+from parallel_heat_tpu.utils.compat import pcast as _pcast
+
 from parallel_heat_tpu.ops.stencil import (
     stencil_interior_2d,
     stencil_interior_3d,
@@ -369,9 +371,9 @@ def _pallas_round_2d(config, kw):
 
     if kind in ("G-uni", "G-fuse", "G-circ"):
         # axis_index('x') varies only on 'x'; broaden (see block_steps).
-        row_off = lax.pcast(block_index[0] * bx, (axis_names[1],),
+        row_off = _pcast(block_index[0] * bx, (axis_names[1],),
                             to="varying")
-        col_off = lax.pcast(block_index[1] * by, (axis_names[0],),
+        col_off = _pcast(block_index[1] * by, (axis_names[0],),
                             to="varying")
 
         if kind in ("G-uni", "G-fuse"):
@@ -429,8 +431,8 @@ def _pallas_round_2d(config, kw):
 
         return fn
 
-    row_off = lax.pcast(block_index[0] * bx, (axis_names[1],), to="varying")
-    col_off = lax.pcast(block_index[1] * by - K, (axis_names[0],),
+    row_off = _pcast(block_index[0] * bx, (axis_names[1],), to="varying")
+    col_off = _pcast(block_index[1] * by - K, (axis_names[0],),
                         to="varying")
     # Mosaic needs the kernel input's lane dim 128-aligned; the junk
     # tail columns are masked/frontier-safe (see the builder docstring).
@@ -485,9 +487,9 @@ def _pallas_round_3d(config, kw):
     # coords of ext index 0: x keeps the [lo|u|hi] order (hence -hx);
     # circular y/z put u at index 0.
     others = lambda i: tuple(a for j, a in enumerate(axis_names) if j != i)
-    x_off = lax.pcast(bi[0] * bx - hx, others(0), to="varying")
-    y_off = lax.pcast(bi[1] * by, others(1), to="varying")
-    z_off = lax.pcast(bi[2] * bz, others(2), to="varying")
+    x_off = _pcast(bi[0] * bx - hx, others(0), to="varying")
+    y_off = _pcast(bi[1] * by, others(1), to="varying")
+    z_off = _pcast(bi[2] * bz, others(2), to="varying")
 
     if fused:
         deferred = ps.pick_block_temporal_3d_deferred(config, axis_names,

@@ -48,10 +48,7 @@ from parallel_heat_tpu.parallel.halo import (
 )
 from parallel_heat_tpu.parallel.mesh import make_heat_mesh
 
-try:  # JAX >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from parallel_heat_tpu.utils.compat import shard_map as _shard_map
 
 
 @dataclass
@@ -610,11 +607,23 @@ def explain(config: HeatConfig) -> dict:
                                         acc_f32=True)
             out["path"] = (f"kernel E (temporal-blocked strip, f32-chunk "
                            f"accumulation) T={t} K={sub}")
+        elif kind == "E-uni":
+            t = ps._pick_temporal_strip(config.nx, config.ny, dtype,
+                                        acc_f32=True, uniform=True)
+            out["path"] = (f"kernel E-uni (uniform-gather temporal "
+                           f"strip, f32-chunk accumulation) T={t} "
+                           f"K={sub}")
         elif kind == "I":
             ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype,
                                            acc_f32=True)
             out["path"] = (f"kernel I (2D-tiled temporal, f32-chunk "
                            f"accumulation) tile={ti[0]}x{ti[1]} K={sub}")
+        elif kind == "I-uni":
+            ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype,
+                                           acc_f32=True, uniform=True)
+            out["path"] = (f"kernel I-uni (uniform-gather 2D-tiled "
+                           f"temporal, f32-chunk accumulation) "
+                           f"tile={ti[0]}x{ti[1]} K={sub}")
         else:
             out["path"] = ("chunked-f32 jnp multistep (temporal kernels "
                            f"declined) K={sub}")
@@ -624,10 +633,20 @@ def explain(config: HeatConfig) -> dict:
     elif kind == "E":
         t = ps._pick_temporal_strip(config.nx, config.ny, dtype)
         out["path"] = f"kernel E (temporal-blocked strip) T={t} K={sub}"
+    elif kind == "E-uni":
+        t = ps._pick_temporal_strip(config.nx, config.ny, dtype,
+                                    uniform=True)
+        out["path"] = (f"kernel E-uni (uniform-gather temporal strip) "
+                       f"T={t} K={sub}")
     elif kind == "I":
         ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype)
         out["path"] = (f"kernel I (2D-tiled temporal) tile="
                        f"{ti[0]}x{ti[1]} K={sub}")
+    elif kind == "I-uni":
+        ti = ps._pick_tile_temporal_2d(config.nx, config.ny, dtype,
+                                       uniform=True)
+        out["path"] = (f"kernel I-uni (uniform-gather 2D-tiled "
+                       f"temporal) tile={ti[0]}x{ti[1]} K={sub}")
     elif kind == "B":
         t_b = ps._pick_strip_rows(config.nx, config.ny, dtype,
                                   sharded=False)
@@ -719,7 +738,17 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     convergence), so chunking costs one dispatch per chunk, nothing
     more. In converge mode ``chunk_steps`` is rounded up to a multiple
     of ``check_interval``, keeping the check schedule identical to an
-    unchunked run; iteration stops at convergence.
+    unchunked run; iteration stops at convergence. Under
+    ``accumulate='f32chunk'`` in fixed mode, ``chunk_steps`` is
+    likewise rounded up to a multiple of the dtype's sublane count (the
+    f32-accumulation chunk depth K): each stream chunk is an
+    independent compiled run whose state enters and leaves in the
+    storage dtype, so a boundary that is not K-aligned would silently
+    restart the f32 chunk mid-window and shift the rounding schedule
+    away from the unchunked run's (SEMANTICS.md "Sub-f32 rounding
+    points"). Converge mode needs no extra rounding there: the
+    check-interval rounding already reproduces the unchunked run's
+    per-``check_interval`` chunk restarts exactly.
 
     Consume each yielded grid (e.g. ``np.asarray`` / checkpoint) before
     advancing the generator: the next chunk donates that buffer to XLA.
@@ -732,6 +761,11 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     if config.converge:
         ci = config.check_interval
         chunk = ((chunk + ci - 1) // ci) * ci
+    elif config.accumulate == "f32chunk":
+        from parallel_heat_tpu.config import sublane_count
+
+        sub = sublane_count(config.dtype)
+        chunk = ((chunk + sub - 1) // sub) * sub
     u = _prepare_initial(config, initial)
 
     import time

@@ -1,0 +1,93 @@
+"""Version portability shims for the narrow band of jax APIs whose
+spelling changed between the versions this framework supports.
+
+The framework targets current jax, where shard_map replication checking
+is the varying-manual-axes (vma) system: outputs annotate their varying
+axes (``ShapeDtypeStruct(..., vma=...)``), ``lax.pcast`` broadens a
+value's varying set, and ``shard_map(check_vma=...)`` switches the
+checker. Pre-0.5 jax spells the same machinery ``check_rep`` with no
+per-output annotations and no ``pcast``. Everything else in the
+codebase is version-independent; these helpers are the single place
+the difference lives, so kernels and drivers never branch on version.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax import lax
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover (version-dependent)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# Probe once: does this jax annotate varying manual axes on avals?
+try:
+    jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    _HAS_VMA = True
+except TypeError:  # pre-0.5: check_rep world
+    _HAS_VMA = False
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag translated to
+    whatever this jax calls it.
+
+    On vma-aware jax the flag passes through as ``check_vma``. On
+    pre-0.5 jax the legacy ``check_rep`` checker has no replication
+    rule for ``while`` (every converge-mode loop), so it is forced off
+    there — the scalar outputs' replication is guaranteed by the
+    ``pmax`` in the residual round either way (the same argument the
+    pallas paths already rely on under the new checker).
+    """
+    if _HAS_VMA:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def pcast(x, axes, to="varying"):
+    """``lax.pcast`` where it exists; identity elsewhere (the broadened
+    annotation only feeds the vma checker, which old jax doesn't run)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to=to)
+    return x
+
+
+def vma_kw(vma) -> dict:
+    """ShapeDtypeStruct kwargs carrying the varying-manual-axes
+    annotation: ``{"vma": frozenset(...)}`` on vma-aware jax, ``{}``
+    when ``vma`` is None or this jax predates the annotation."""
+    if vma is None or not _HAS_VMA:
+        return {}
+    return {"vma": frozenset(vma)}
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` (new name) / ``TPUCompilerParams`` (old)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def request_cpu_devices(n: int) -> None:
+    """Ask for ``n`` virtual CPU devices, portably.
+
+    New jax has the ``jax_num_cpu_devices`` config; old jax only honors
+    the XLA flag, and only if the backend has not initialized yet —
+    callers must invoke this before touching ``jax.devices()``. The
+    env flag is set only on the old-jax path: it would leak into every
+    spawned subprocess (and stack up across calls), which the config
+    API avoids.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # pre-0.5: only the XLA flag works
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")

@@ -7,10 +7,20 @@ plugin imports jax before this conftest loads, so env vars are too late;
 initialized on first use.
 """
 
+import os
+
+# Older jax (< 0.5) has no jax_num_cpu_devices config; the XLA flag is
+# the portable spelling and must be set before the backend initializes.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")  # the shell pins a TPU platform
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.5 jax: the XLA flag above already did it
+    pass
 
 assert len(jax.devices()) == 8, (
     "tests require 8 virtual CPU devices; got " + str(jax.devices())

@@ -175,6 +175,38 @@ def test_solve_stream_f32chunk_matches_solve():
     np.testing.assert_array_equal(last.to_numpy(), whole)
 
 
+def test_solve_stream_f32chunk_misaligned_chunk_rounds_up():
+    # Regression (round-5 advisor finding): a chunk_steps that is NOT
+    # a multiple of K=16 used to restart the f32 chunk at every stream
+    # boundary — silently shifting the rounding schedule away from the
+    # unchunked run's. solve_stream now rounds chunk_steps up to the
+    # sublane multiple (SEMANTICS.md contract), so the stream is
+    # bitwise the one-shot run and each yield lands on the rounded
+    # boundary.
+    from parallel_heat_tpu.solver import solve_stream
+
+    kw = dict(nx=64, ny=256, steps=96, dtype="bfloat16",
+              backend="pallas", accumulate="f32chunk")
+    whole = solve(HeatConfig(**kw)).to_numpy()
+    seen = []
+    last = None
+    for res in solve_stream(HeatConfig(**kw), chunk_steps=10):
+        seen.append(res.steps_run)
+        last = res
+    assert seen == [16, 32, 48, 64, 80, 96]  # rounded to K, not 10
+    np.testing.assert_array_equal(last.to_numpy(), whole)
+    # Converge mode needs no extra rounding: check_interval rounding
+    # already reproduces the unchunked per-interval chunk restarts.
+    kwc = dict(nx=32, ny=64, steps=64, dtype="bfloat16", converge=True,
+               eps=1e-30, check_interval=4, backend="pallas",
+               accumulate="f32chunk")
+    wholec = solve(HeatConfig(**kwc)).to_numpy()
+    lastc = None
+    for res in solve_stream(HeatConfig(**kwc), chunk_steps=10):
+        lastc = res
+    np.testing.assert_array_equal(lastc.to_numpy(), wholec)
+
+
 def test_boundary_exact_under_f32chunk():
     cfg = HeatConfig(nx=64, ny=256, steps=33, dtype="bfloat16",
                      backend="pallas", accumulate="f32chunk")

@@ -183,6 +183,32 @@ def test_explain_resolves_expected_paths():
         HeatConfig(nx=64, ny=64, backend="jnp"))["path"]
 
 
+def test_explain_reports_uniform_kinds(monkeypatch):
+    # The uniform-gather variants must surface in --explain with their
+    # geometry, storage and f32chunk branches both (same decision site
+    # as execution — pick_single_2d). Hardware alignment rules pinned:
+    # kernel I's interpret-mode column halo (2*SUB, not a lane tile)
+    # puts the 32768^2 tile under the wide-row knee on CPU, and the
+    # production decision is the hardware one (picks never build).
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+    from parallel_heat_tpu.solver import explain
+
+    monkeypatch.setattr(ps, "_needs_lane_alignment", lambda: True)
+    p = explain(HeatConfig(nx=16384, ny=16384, backend="pallas"))["path"]
+    assert "kernel E-uni" in p and "T=" in p
+    p = explain(HeatConfig(nx=32768, ny=32768, dtype="bfloat16",
+                           backend="pallas"))["path"]
+    assert "kernel I-uni" in p and "tile=" in p
+    p = explain(HeatConfig(nx=16384, ny=16384, dtype="bfloat16",
+                           backend="pallas",
+                           accumulate="f32chunk"))["path"]
+    assert "kernel E-uni" in p and "f32-chunk" in p
+    # below the wide-row knee the incumbent keeps the pick
+    p = explain(HeatConfig(nx=8192, ny=8192, backend="pallas"))["path"]
+    assert "kernel E " in p or p.startswith("kernel E (")
+
+
 def test_explain_sharded_tiled_fallback():
     # block_steps' fallback order is strip -> tiled -> jnp; explain()
     # must mirror all three (regression: the tiled stage was omitted,

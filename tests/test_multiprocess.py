@@ -22,9 +22,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = """
 import sys
+sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    # Older jax CPU backends only run cross-process collectives over
+    # gloo; newer ones pick a working implementation themselves.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+from parallel_heat_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(4)
 pid = int(sys.argv[1]); port = sys.argv[2]
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=2, process_id=pid)
@@ -82,6 +90,14 @@ oracle3 = solve(HeatConfig(nx=32, ny=16, nz=16, steps=8)).to_numpy()
 np.testing.assert_allclose(
     np.asarray(gather_to_host(res3.grid), dtype=np.float64),
     oracle3.astype(np.float64), rtol=1e-4, atol=1e-2)
+# Save the gathered deferred-x result for the parent's bitwise check
+# against the SAME schedule run in one process (monkeypatched DCN
+# gate): the process boundary must change transport, never bits.
+# gather_to_host is a collective — BOTH processes must call it; only
+# p0 writes the file.
+_g3 = np.asarray(gather_to_host(res3.grid))
+if pid == 0:
+    np.save("mp_h_deferred.npy", _g3)
 
 # Per-shard checkpoint round trip across the process boundary: each
 # process writes only its own shards (no host gather), p0 writes the
@@ -146,3 +162,32 @@ def test_two_process_solve_matches_single_device(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER-OK {i}" in out
+
+    # Kernel-H deferred-x band path, bitwise across the process
+    # boundary: the worker ran the overlapped round under a REAL
+    # process_count == 2 (the DCN gate); re-running the identical
+    # config in THIS single process with the gate monkeypatched to 2
+    # must reproduce it bit for bit — same mesh, same Mosaic kernels,
+    # same deferred-x schedule, only the collective transport differs.
+    import jax
+    import pytest as _pytest
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import _build_runner, explain
+
+    got = np.load(tmp_path / "mp_h_deferred.npy")
+    cfg3 = HeatConfig(nx=32, ny=16, nz=16, steps=8, mesh_shape=(2, 2, 2),
+                      halo_depth=4).replace(backend="pallas")
+    mp = _pytest.MonkeyPatch()
+    try:
+        mp.setattr(jax, "process_count", lambda: 2)
+        # The runner cache must not serve a program built under the
+        # real (single-process) gate.
+        _build_runner.cache_clear()
+        assert "deferred x bands" in explain(cfg3)["path"]
+        ref = solve(cfg3).to_numpy()
+    finally:
+        mp.undo()
+        _build_runner.cache_clear()
+    assert np.array_equal(got, ref), \
+        "kernel-H deferred-x: multi-process != single-process (bitwise)"

@@ -475,16 +475,232 @@ def test_tile_temporal_diverging_boundary_exact():
 
 def test_pick_single_2d_prefers_I_for_wide_bf16(monkeypatch):
     # The measured rule: sub-f32 grids where kernel I's window
-    # amplification beats kernel E's route to I (32768^2 bf16 on v5e:
-    # 166.3 vs 153.7 Gcells*steps/s); f32 always keeps E where E
-    # builds (measured 16384^2: E 208.7 vs I 142.8). Pinned under
-    # HARDWARE alignment rules (the production decision), not the
+    # amplification beats kernel E's route to the I family (32768^2
+    # bf16 on v5e: 166.3 vs 153.7 Gcells*steps/s); f32 always keeps
+    # the E family where E builds (measured 16384^2: E 208.7 vs I
+    # 142.8). Within each family, the wide-row cost model then picks
+    # the uniform-gather schedule exactly past the measured knee
+    # (these geometries all sweep > 8448 lanes, so they route to the
+    # -uni variants; below-knee picks stay windowed — see
+    # test_uniform_pick_is_cost_model_driven). Pinned under HARDWARE
+    # alignment rules (the production decision), not the
     # interpret-mode parameters this suite otherwise runs with — the
     # pick functions never build kernels, so forcing the flag is safe.
     monkeypatch.setattr(ps, "_needs_lane_alignment", lambda: True)
     kind, ti = ps.pick_single_2d((32768, 32768), "bfloat16", 0.1, 0.1)
-    assert kind == "I" and ti == (256, 8192)
+    assert kind == "I-uni" and ti == (256, 8192)
     kind, _ = ps.pick_single_2d((16384, 16384), "float32", 0.1, 0.1)
-    assert kind == "E"
+    assert kind == "E-uni"
     kind, _ = ps.pick_single_2d((16384, 16384), "bfloat16", 0.1, 0.1)
-    assert kind == "E"
+    assert kind == "E-uni"
+
+
+# --------------------------------------------------------------------------
+# Kernels E-uni / I-uni: uniform-window gather variants
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 3, 8])
+def test_temporal_strip_uniform_bitwise_vs_e(k):
+    # The uniform gather moves the same bytes to the same scratch rows
+    # (core + conditional edge halos instead of one re-shaping
+    # window), so E-uni must be BITWISE kernel E — and therefore match
+    # the jnp oracle to E's own contract.
+    shape = (64, 128)
+    u = jnp.asarray(_rand(shape, seed=3))
+    fe = ps._build_temporal_strip(shape, "float32", 0.1, 0.1, k)
+    fu = ps._build_temporal_strip_uniform(shape, "float32", 0.1, 0.1, k)
+    assert fu is not None
+    ge, re_ = fe(u)
+    gu, ru = fu(u)
+    np.testing.assert_array_equal(np.asarray(ge), np.asarray(gu))
+    assert float(re_) == float(ru)
+    want = u
+    for _ in range(k):
+        want, wres = step_2d_residual(want, 0.1, 0.1)
+    _close(gu, want)
+    np.testing.assert_allclose(float(ru), float(wres), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_temporal_strip_uniform_bf16_and_plain():
+    # bf16 (SUB=16 halos) and the no-residual builder the converge
+    # path's non-final calls use — both bitwise kernel E's twins.
+    shape = (96, 128)
+    u = jnp.asarray(_rand(shape, seed=6)).astype(jnp.bfloat16)
+    for res in (True, False):
+        fe = ps._build_temporal_strip(shape, "bfloat16", 0.1, 0.1, 16,
+                                      with_residual=res)
+        fu = ps._build_temporal_strip_uniform(shape, "bfloat16",
+                                              0.1, 0.1, 16,
+                                              with_residual=res)
+        assert fu is not None
+        ge, re_ = fe(u)
+        gu, ru = fu(u)
+        np.testing.assert_array_equal(np.asarray(ge), np.asarray(gu))
+        assert float(re_) == float(ru)
+
+
+def test_temporal_uniform_multistep_fixed_and_converge():
+    # The lifted multistep (full chunks + remainder + last-step fused
+    # residual — the fixed AND converge entry points) stays bitwise
+    # the windowed lifting's.
+    shape = (64, 128)
+    u = jnp.asarray(_rand(shape, seed=4))
+    mw = ps._temporal_multistep(shape, "float32", 0.1, 0.1)
+    mu = ps._temporal_multistep(shape, "float32", 0.1, 0.1,
+                                uniform=True)
+    for n in (20, 8, 3):
+        gw = mw[0](u, n)
+        gu = mu[0](u, n)
+        np.testing.assert_array_equal(np.asarray(gw), np.asarray(gu))
+        gw, rw = mw[1](u, n)
+        gu, ru = mu[1](u, n)
+        np.testing.assert_array_equal(np.asarray(gw), np.asarray(gu))
+        assert float(rw) == float(ru)
+
+
+@pytest.mark.parametrize("case", [((32, 64), "float32", 8),
+                                  ((32, 64), "float32", 3),
+                                  ((64, 256), "bfloat16", 16)])
+def test_tile_temporal_uniform_bitwise_vs_i(case):
+    shape, dt, k = case
+    u = jnp.asarray(_rand(shape, seed=7)).astype(jnp.dtype(dt))
+    fi = ps._build_tile_temporal_2d(shape, dt, 0.1, 0.1, k)
+    fu = ps._build_tile_temporal_2d_uniform(shape, dt, 0.1, 0.1, k)
+    assert fi is not None and fu is not None
+    gi, ri = fi(u)
+    gu, ru = fu(u)
+    np.testing.assert_array_equal(np.asarray(gi), np.asarray(gu))
+    assert float(ri) == float(ru)
+    # plain builder too
+    fip = ps._build_tile_temporal_2d(shape, dt, 0.1, 0.1, k,
+                                     with_residual=False)
+    fup = ps._build_tile_temporal_2d_uniform(shape, dt, 0.1, 0.1, k,
+                                             with_residual=False)
+    np.testing.assert_array_equal(np.asarray(fip(u)[0]),
+                                  np.asarray(fup(u)[0]))
+
+
+def test_temporal_uniform_acc_f32_bitwise():
+    # f32chunk accumulation: the uniform variants share kernel E/I's
+    # f32 ping-pong discipline — bitwise twins in acc mode too.
+    shape = (96, 128)
+    u = jnp.asarray(_rand(shape, seed=8)).astype(jnp.bfloat16)
+    fe = ps._build_temporal_strip(shape, "bfloat16", 0.1, 0.1, 16,
+                                  acc_f32=True)
+    fu = ps._build_temporal_strip_uniform(shape, "bfloat16", 0.1, 0.1,
+                                          16, acc_f32=True)
+    np.testing.assert_array_equal(np.asarray(fe(u)[0]),
+                                  np.asarray(fu(u)[0]))
+    shape = (64, 256)
+    u = jnp.asarray(_rand(shape, seed=9)).astype(jnp.bfloat16)
+    fi = ps._build_tile_temporal_2d(shape, "bfloat16", 0.1, 0.1, 16,
+                                    acc_f32=True)
+    fiu = ps._build_tile_temporal_2d_uniform(shape, "bfloat16",
+                                             0.1, 0.1, 16,
+                                             acc_f32=True)
+    np.testing.assert_array_equal(np.asarray(fi(u)[0]),
+                                  np.asarray(fiu(u)[0]))
+
+
+def test_temporal_strip_uniform_diverging_boundary_exact():
+    shape = (64, 128)
+    u0 = jnp.asarray(_rand(shape, seed=5))
+    fu = ps._build_temporal_strip_uniform(shape, "float32", 0.9, 0.9, 8)
+    u = u0
+    for _ in range(20):
+        u, _ = fu(u)
+    out = np.asarray(u)
+    assert not np.all(np.isfinite(out))
+    ini = np.asarray(u0)
+    for sl in [np.s_[0], np.s_[-1], np.s_[:, 0], np.s_[:, -1]]:
+        np.testing.assert_array_equal(out[sl], ini[sl])
+
+
+def test_uniform_pick_is_cost_model_driven(monkeypatch):
+    # The windowed-vs-uniform choice comes from the measured wide-row
+    # cost model, never a hard-coded override: below the knee (8448
+    # swept lanes) the modeled scores tie and the incumbent windowed
+    # kernels keep the pick; past it the uniform schedule's shallower
+    # measured slope wins strictly. Hardware alignment rules, pick
+    # functions only (no kernel builds).
+    monkeypatch.setattr(ps, "_needs_lane_alignment", lambda: True)
+    assert ps.pick_single_2d((8192, 8192), "float32", 0.1, 0.1)[0] == "E"
+    assert ps.pick_single_2d((4096, 4096), "float32", 0.1, 0.1)[0] == "E"
+    assert ps.pick_single_2d((16384, 16384), "float32",
+                             0.1, 0.1)[0] == "E-uni"
+    # f32chunk branch runs the same comparison
+    assert ps.pick_single_2d((16384, 16384), "bfloat16", 0.1, 0.1,
+                             accumulate="f32chunk")[0] == "E-uni"
+    assert ps.pick_single_2d((32768, 32768), "bfloat16", 0.1, 0.1,
+                             accumulate="f32chunk")[0] == "I-uni"
+    # the model parameters themselves drive the choice: with the
+    # uniform slope pinned equal to the windowed one the advantage
+    # vanishes and the pick reverts — no override anywhere
+    from parallel_heat_tpu.ops import tpu_params as tpp
+
+    base = tpp.params()
+    try:
+        tpp.set_override(tpp.TpuParams(
+            base.kind, base.vmem_bytes, base.hbm_stream_bytes_per_s,
+            base.vpu_cells_per_s,
+            wide_row_slope_uniform_per_16k=base.wide_row_slope_per_16k))
+        assert ps.pick_single_2d((16384, 16384), "float32",
+                                 0.1, 0.1)[0] == "E"
+    finally:
+        tpp.set_override(None)
+
+
+def test_uniform_decline_paths(monkeypatch):
+    # Each decline path falls back to the windowed kernel, never jnp:
+    # (1) 2-strip geometries — the uniform picker caps T at rows//3,
+    #     so short grids decline at pick time;
+    assert ps._pick_temporal_strip(16, 128, "float32",
+                                   uniform=True) is None
+    # (2) the builder's own n_strips >= 3 backstop (reachable only if
+    #     the picker drifts — forced here);
+    monkeypatch.setattr(ps, "_pick_temporal_strip",
+                        lambda *a, **k: 32)
+    ps._build_temporal_strip_uniform.cache_clear()
+    assert ps._build_temporal_strip_uniform((64, 128), "float32",
+                                            0.1, 0.1, 8) is None
+    ps._build_temporal_strip_uniform.cache_clear()
+    monkeypatch.undo()
+    # (3) lane-misaligned widths on hardware decline the whole
+    #     temporal family; the pick must not be a uniform kind;
+    monkeypatch.setattr(ps, "_needs_lane_alignment", lambda: True)
+    kind, _ = ps.pick_single_2d((16384, 16400), "float32", 0.1, 0.1)
+    assert kind not in ("E-uni", "I-uni")
+    monkeypatch.undo()
+    # (4) a uniform builder decline inside the multistep factory falls
+    #     back to the windowed kernel E (not None, not a crash).
+    monkeypatch.setattr(ps, "_build_temporal_strip_uniform",
+                        lambda *a, **k: None)
+    mu = ps._temporal_multistep((64, 128), "float32", 0.1, 0.1,
+                                uniform=True)
+    assert mu is not None
+    u = jnp.asarray(_rand((64, 128), seed=2))
+    mw = ps._temporal_multistep((64, 128), "float32", 0.1, 0.1)
+    np.testing.assert_array_equal(np.asarray(mu[0](u, 12)),
+                                  np.asarray(mw[0](u, 12)))
+
+
+def test_uniform_dispatch_end_to_end(monkeypatch):
+    # single_grid_multistep must route the uniform kinds to the
+    # uniform factories (forced pick: interpret-mode sizes never sit
+    # past the wide-row knee) and produce the jnp chain's results.
+    from parallel_heat_tpu.config import HeatConfig
+
+    shape = (64, 128)
+    monkeypatch.setattr(ps, "pick_single_2d",
+                        lambda *a, **k: ("E-uni", 16))
+    cfg = HeatConfig(nx=shape[0], ny=shape[1], backend="pallas")
+    ms, msr = ps.single_grid_multistep(cfg)
+    u = jnp.asarray(_rand(shape, seed=11))
+    got, res = msr(u, 20)
+    want = u
+    for _ in range(20):
+        want, wres = step_2d_residual(want, 0.1, 0.1)
+    _close(got, want)
+    np.testing.assert_allclose(float(res), float(wres), rtol=1e-4,
+                               atol=1e-6)

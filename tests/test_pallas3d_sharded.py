@@ -160,7 +160,7 @@ def test_overlap_3d_bulk_kernel_independent_of_x_ppermutes():
 
     from parallel_heat_tpu.parallel import temporal as tp
     from parallel_heat_tpu.parallel.mesh import make_heat_mesh
-    from parallel_heat_tpu.solver import _shard_map
+    from parallel_heat_tpu.utils.compat import shard_map as _shard_map
     from tests.test_temporal import _ancestor_eqns, _flat_jaxpr_levels
 
     import pytest as _pytest

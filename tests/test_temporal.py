@@ -166,7 +166,7 @@ def test_deep_halo_reduces_collectives():
     from parallel_heat_tpu.parallel.halo import block_step_2d
     from parallel_heat_tpu.parallel.temporal import block_multistep_2d
     from parallel_heat_tpu.parallel.mesh import make_heat_mesh
-    from parallel_heat_tpu.solver import _shard_map
+    from parallel_heat_tpu.utils.compat import shard_map as _shard_map
 
     mesh = make_heat_mesh((2, 2))
     spec = P("x", "y")
@@ -374,7 +374,7 @@ def test_overlap_bulk_kernel_independent_of_phase2_ppermutes():
 
     from parallel_heat_tpu.parallel import temporal as tp
     from parallel_heat_tpu.parallel.mesh import make_heat_mesh
-    from parallel_heat_tpu.solver import _shard_map
+    from parallel_heat_tpu.utils.compat import shard_map as _shard_map
     from jax.sharding import PartitionSpec as P
 
     cfg = HeatConfig(nx=32, ny=32, steps=8, backend="pallas",

@@ -38,6 +38,39 @@ def test_bench_importable_and_baseline_set():
         sys.path.remove(_ROOT)
 
 
+def test_ab_uni_single_smoke(tmp_path):
+    # The windowed-vs-uniform A/B harness must run end to end (tiny
+    # grid, interpret-mode kernels) and emit its JSON artifact with
+    # rates for both kernel-E schedules.
+    out_json = tmp_path / "ab_uni.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "ab_uni_single.py"),
+         "--size", "64", "--json", str(out_json)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_ROOT,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out_json.read_text())
+    row = doc["rows"]["64x64 float32"]
+    assert "E (windowed)" in row["gcells_steps_per_s"]
+    assert "E-uni (uniform gather)" in row["gcells_steps_per_s"]
+    assert "pick_single_2d" in out.stdout
+
+
+def test_headline_variance_row_specs():
+    # The variance protocol's row table must stay in sync with
+    # bench.py's stdout contract fields.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hv", os.path.join(_ROOT, "tools", "headline_variance.py"))
+    hv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hv)
+    assert set(hv._ROWS) == {"headline", "conv256"}
+    assert hv._ROWS["conv256"]["field"] == "wall_s"
+    assert hv._ROWS["headline"]["field"] == "value"
+
+
 def test_make_heat_smoke():
     # The reference-style Make entry point must stay runnable.
     run = lambda *a: subprocess.run(

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Batched A/B: the single-grid temporal kernels, windowed vs
+uniform-gather layout — E vs E-uni and I vs I-uni, on hardware.
+
+Protocol matches ``tools/ab_fused_g.py`` (the measurement of record
+for the round-4 G-uni decision): full jitted kernel calls, paired
+interleaved slopes via ``bench_rounds_paired`` (min-of-raw-endpoints,
+the bench.py protocol), K = the dtype's sublane count per call. The
+point of record here is the wide-row regime: the committed
+``bench_full.json`` rows (16384² f32, 32768² bf16) sit 15-20% under
+what the same silicon sustains on block-shaped volumes, and the
+uniform gather is the one structural difference between those
+schedules — run at ``--size 16384`` f32 and ``--size 32768 --dtype
+bfloat16`` to reproduce the headline A/B; the default 4096 is the
+quick sanity size (below the wide-row knee, where the pair should
+tie within the session band).
+
+A ``--json FILE`` run merges ``{label: Gcells*steps/s}`` plus the
+device string into FILE (append/update), the committed-artifact
+discipline of hw_validate.
+
+Run: python tools/ab_uni_single.py [--size 16384] [--dtype float32]
+     [--rows N] [--json ab_uni.json]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from parallel_heat_tpu.models import HeatPlate2D
+from parallel_heat_tpu.ops import pallas_stencil as ps
+from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--rows", type=int, default=None,
+                    help="grid rows (defaults to --size; --size stays "
+                         "the width, the axis the wide-row story is "
+                         "about)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="merge {label: Gcells*steps/s} + device into "
+                         "this artifact")
+    args = ap.parse_args()
+    N = args.size
+    M = args.rows or args.size
+    dts = args.dtype
+    dt = jnp.dtype(dts)
+    k = ps._sub_rows(dt)
+    gs = (M, N)
+    print(f"grid {M}x{N} {dts} K={k}  (full jitted kernel calls)")
+    u0 = jax.block_until_ready(HeatPlate2D(M, N).init_grid(dt))
+
+    rounds = {}
+    # Plain (no-residual) builders: the fixed-step chain both kernels
+    # spend almost all their calls in — the same choice ab_fused_g
+    # makes, so the two A/Bs stay comparable.
+    pairs = [
+        ("E (windowed)", ps._build_temporal_strip(gs, dts, 0.1, 0.1, k,
+                                                  with_residual=False)),
+        ("E-uni (uniform gather)",
+         ps._build_temporal_strip_uniform(gs, dts, 0.1, 0.1, k,
+                                          with_residual=False)),
+        ("I (windowed)", ps._build_tile_temporal_2d(gs, dts, 0.1, 0.1,
+                                                    k,
+                                                    with_residual=False)),
+        ("I-uni (uniform gather)",
+         ps._build_tile_temporal_2d_uniform(gs, dts, 0.1, 0.1, k,
+                                            with_residual=False)),
+    ]
+    for name, fn in pairs:
+        if fn is None:
+            print(f"{name}: builder declined")
+            continue
+        rounds[name] = (lambda f: lambda u: f(u)[0])(fn)
+    if not rounds:
+        raise SystemExit("every builder declined this geometry")
+
+    out = bench_rounds_paired(rounds, u0, {name: k for name in rounds})
+
+    # What the cost model believes, next to what the silicon said —
+    # the picker's decision must be auditable against this printout.
+    wide_w, wide_u = ps._wide_row_factors(N)
+    t_w = ps._pick_temporal_strip(M, N, dt)
+    t_u = ps._pick_temporal_strip(M, N, dt, uniform=True)
+    if t_w is not None and t_u is not None:
+        print(f"model: E T={t_w} score={ps._strip_temporal_score(t_w, dt, wide_w):.3e}"
+              f"  E-uni T={t_u} score={ps._strip_temporal_score(t_u, dt, wide_u):.3e}"
+              f"  (wide factors {wide_w:.3f}/{wide_u:.3f})")
+    kind, detail = ps.pick_single_2d(gs, dts, 0.1, 0.1)
+    print(f"pick_single_2d: {kind} {detail}")
+
+    if args.json:
+        import json
+        import os
+
+        data = {}
+        if os.path.exists(args.json):
+            with open(args.json) as f:
+                data = json.load(f)
+        key = f"{M}x{N} {dts}"
+        data.setdefault("rows", {})[key] = {
+            "gcells_steps_per_s": out,
+            "pick": [kind, list(detail) if isinstance(detail, tuple)
+                     else detail],
+        }
+        data["device"] = str(jax.devices()[0])
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            data["platform_note"] = (
+                "CPU DRYRUN: interpret-mode rates demonstrate the "
+                "pipeline end to end; they do not predict hardware "
+                "ranking. Re-run on a TPU for the measurement of "
+                "record (the wide-row sizes in the module docstring).")
+        with open(args.json, "w") as f:
+            json.dump(data, f, indent=1)
+        print(f"merged {key} into {args.json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,8 @@ def main(argv=None):
 
     if args.cpu_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from parallel_heat_tpu.utils.compat import request_cpu_devices
+        request_cpu_devices(args.cpu_devices)
     if args.dtype == "float64":
         # Same pre-trace requirement as cli.py: validate() rejects f64
         # without x64 mode.
