@@ -27,7 +27,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test bench clean
+.PHONY: all heat heat_con native test chaos bench clean
 
 all: heat
 
@@ -45,6 +45,10 @@ native:
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# fault-injection smoke for the run supervisor (CPU only, no TPU needed)
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m chaos -q
 
 bench:
 	$(PY) bench.py

@@ -21,11 +21,18 @@ A ground-up JAX/XLA/Pallas re-design of the capabilities of
 from parallel_heat_tpu.config import HeatConfig
 from parallel_heat_tpu.solver import (
     HeatResult,
+    grid_all_finite,
     make_initial_grid,
     solve,
     solve_stream,
 )
 from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+from parallel_heat_tpu.supervisor import (
+    PermanentFailure,
+    SupervisorPolicy,
+    SupervisorResult,
+    run_supervised,
+)
 
 __version__ = "0.1.0"
 
@@ -35,6 +42,11 @@ __all__ = [
     "solve",
     "solve_stream",
     "make_initial_grid",
+    "grid_all_finite",
+    "run_supervised",
+    "SupervisorPolicy",
+    "SupervisorResult",
+    "PermanentFailure",
     "HeatPlate2D",
     "HeatPlate3D",
     "__version__",

@@ -84,7 +84,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "each time, so --resume always sees the latest)")
     ap.add_argument("--resume", default=None, metavar="FILE",
                     help="resume from a checkpoint (.npz file or "
-                         "per-shard .ckpt directory)")
+                         "per-shard .ckpt directory), or 'auto' to "
+                         "resume from the newest retained generation "
+                         "of --checkpoint (starts fresh when none "
+                         "exists — safe to put in a restart loop)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under the fault-tolerant supervisor: "
+                         "periodic retained checkpoint generations, "
+                         "on-device non-finite guard, retry-with-"
+                         "rollback on faults, SIGTERM/SIGINT-safe exit "
+                         "with a printed resume command (requires "
+                         "--checkpoint; cadence from --checkpoint-every, "
+                         "default steps/10)")
+    ap.add_argument("--guard-interval", type=int, default=None,
+                    metavar="N",
+                    help="steps between on-device isfinite-all guard "
+                         "checks (observation-only, never changes "
+                         "numerics — SEMANTICS.md). Unsupervised runs "
+                         "warn on a trip; --supervise rolls back and "
+                         "retries. Default: off unsupervised, every "
+                         "checkpoint under --supervise")
+    ap.add_argument("--max-retries", type=int, default=3, metavar="N",
+                    help="supervisor rollback-retry budget for "
+                         "transient faults (guard trips, retryable "
+                         "dispatch errors); exceeding it halts with a "
+                         "permanent-failure diagnosis")
+    ap.add_argument("--keep-checkpoints", type=int, default=3,
+                    metavar="N",
+                    help="checkpoint generations the supervisor "
+                         "retains (older ones are pruned)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run")
     ap.add_argument("--explain", action="store_true",
@@ -157,7 +185,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         check_interval=args.check_interval, dtype=args.dtype,
         backend=args.backend, mesh_shape=mesh_shape,
         overlap=not args.no_overlap, halo_depth=halo_depth,
-        accumulate=args.accumulate,
+        accumulate=args.accumulate, guard_interval=args.guard_interval,
     )
     try:
         config.validate()
@@ -181,6 +209,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"error: --checkpoint-every must be >= 1, got "
                   f"{args.checkpoint_every}", file=sys.stderr)
             return 2
+    if args.supervise and not args.checkpoint:
+        print("error: --supervise requires --checkpoint (the retained-"
+              "generation stem)", file=sys.stderr)
+        return 2
+    if args.keep_checkpoints < 1:
+        print(f"error: --keep-checkpoints must be >= 1, got "
+              f"{args.keep_checkpoints}", file=sys.stderr)
+        return 2
+    if args.max_retries < 0:
+        print(f"error: --max-retries must be >= 0, got "
+              f"{args.max_retries}", file=sys.stderr)
+        return 2
+    if args.resume == "auto" and not args.checkpoint:
+        print("error: --resume auto requires --checkpoint (the stem "
+              "whose newest generation to resume)", file=sys.stderr)
+        return 2
 
     say = (lambda *a: None) if args.quiet else print
     mesh = config.mesh_or_unit()
@@ -197,16 +241,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     initial = None
     start_step = 0
-    if args.resume:
+    resume_src = args.resume
+    if resume_src == "auto":
+        from parallel_heat_tpu.utils.checkpoint import latest_checkpoint
+
+        resume_src = latest_checkpoint(args.checkpoint)
+        if resume_src is None:
+            say("No checkpoint found for --resume auto; starting fresh.")
+    if resume_src:
         from parallel_heat_tpu.utils.checkpoint import load_checkpoint
 
         try:
-            initial, start_step, _ = load_checkpoint(args.resume, config)
+            initial, start_step, _ = load_checkpoint(resume_src, config)
         except (OSError, ValueError, EOFError, KeyError) as e:
-            print(f"error: cannot resume from {args.resume}: {e}",
+            print(f"error: cannot resume from {resume_src}: {e}",
                   file=sys.stderr)
             return 2
-        say(f"Resumed from {args.resume} at step {start_step}.")
+        say(f"Resumed from {resume_src} at step {start_step}.")
         remaining = max(0, config.steps - start_step)
         config = config.replace(steps=remaining)
 
@@ -215,7 +266,50 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               else make_initial_grid(config))
         say(f"Initial grid written to {written}")
 
+    sup_state = {}
+
     def _run():
+        if args.supervise:
+            from parallel_heat_tpu.supervisor import (
+                SupervisorPolicy, run_supervised)
+
+            every = args.checkpoint_every or max(1, config.steps // 10)
+            if config.accumulate == "f32chunk" \
+                    and args.checkpoint_every is None:
+                # The DEFAULT cadence must satisfy the supervisor's
+                # K-alignment requirement (stream boundaries are
+                # rounding points under f32chunk); explicit misaligned
+                # flags still fail loudly below.
+                from parallel_heat_tpu.config import sublane_count
+
+                sub = sublane_count(config.dtype)
+                every = ((every + sub - 1) // sub) * sub
+            policy = SupervisorPolicy(
+                checkpoint_every=every,
+                keep_checkpoints=args.keep_checkpoints,
+                guard_interval=args.guard_interval,
+                max_retries=args.max_retries,
+                layout=args.checkpoint_layout,
+            )
+            # Flags the resumed invocation must repeat to deliver what
+            # this one promised. NOT --initial-out: the t=0 grid was
+            # already written by this invocation, and a resumed run's
+            # `initial` is the checkpoint state — repeating the flag
+            # would overwrite the true initial condition with it.
+            extra = []
+            if args.out:
+                extra += ["--out", args.out]
+            if args.quiet:
+                extra += ["--quiet"]
+            sres = run_supervised(config, args.checkpoint, policy=policy,
+                                  initial=initial, start_step=start_step,
+                                  say=say, resume_extra_flags=tuple(extra))
+            sup_state["sres"] = sres
+            if sres.result is None and not sres.interrupted:
+                # Zero steps remaining (e.g. --resume auto of a finished
+                # run): produce the grid for reporting/--out anyway.
+                return solve(config, initial=initial)
+            return sres.result
         if args.checkpoint_every is None:
             return solve(config, initial=initial)
         # Periodic-checkpoint driver: chunked solve, snapshot after
@@ -235,16 +329,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             result = solve(config, initial=initial)
         return result
 
-    if args.profile:
-        import jax
+    from parallel_heat_tpu.supervisor import PermanentFailure
 
-        with jax.profiler.trace(args.profile):
+    try:
+        if args.profile:
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                result = _run()
+            say(f"Profiler trace written to {args.profile}")
+        else:
             result = _run()
-        say(f"Profiler trace written to {args.profile}")
-    else:
-        result = _run()
+    except PermanentFailure as e:
+        # The supervisor's no-retry verdict: diagnosis on stderr, the
+        # newest verified checkpoint is still on disk for inspection.
+        print(f"error: permanent failure: {e.diagnosis}", file=sys.stderr)
+        return 4
+    except ValueError as e:
+        if not args.supervise:
+            raise
+        # Bad supervisor flag combination (e.g. a cadence that breaks
+        # the f32chunk K-alignment contract): one-line CLI error like
+        # every other argument problem, not a traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
-    total_steps = start_step + result.steps_run
+    sres = sup_state.get("sres")
+    if sres is not None and sres.interrupted:
+        # Preemption-style exit: the supervisor flushed a checkpoint and
+        # `say` printed the resume command. Distinct exit code so
+        # restart loops can tell "preempted, resume me" from success.
+        return 3
+
+    # Supervised runs report the supervisor's absolute count (a rollback
+    # segment's stream restarts its own steps_run from 0).
+    total_steps = (sres.steps_done if sres is not None
+                   else start_step + result.steps_run)
     if config.converge:
         if result.converged:
             say(f"Converged after {total_steps} steps")
@@ -256,7 +376,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.out:
         written = _write_grid(args.out, result.grid)
         say(f"Final grid written to {written}")
-    if args.checkpoint:
+    if args.checkpoint and not args.supervise:
+        # Supervised runs already wrote their final retained generation;
+        # a plain-stem save here would shadow the generation family.
         from parallel_heat_tpu.utils.checkpoint import save_checkpoint
 
         written = save_checkpoint(args.checkpoint, result.grid,

@@ -124,6 +124,21 @@ class HeatConfig:
     # explicit, priced flag.
     accumulate: str = "storage"
 
+    # Runtime blow-up guard (SEMANTICS.md "Runtime guard"): steps between
+    # on-device isfinite-all checks of the evolving grid. None (default)
+    # = off — no guard program is ever built, and outputs are bitwise
+    # those of a guard-free run. When set, `solve_stream` evaluates the
+    # fused reduction at the first chunk boundary at-or-after each
+    # multiple of `guard_interval` (this is the FIXED-STEP failure
+    # detector the reference lacks — converge mode already inspects its
+    # residual), and `solve` checks the final grid once. The guard is
+    # observation-only: it reads the grid between dispatches, never
+    # writes, and is stripped from the compiled program's cache key, so
+    # enabling it cannot shift a bit of the simulation. The run
+    # supervisor (`parallel_heat_tpu.supervisor`) layers rollback/retry
+    # on top of the same check.
+    guard_interval: Optional[int] = None
+
     # --- derived helpers -------------------------------------------------
 
     @property
@@ -280,6 +295,11 @@ class HeatConfig:
                         f"halo_depth={self.halo_depth} exceeds the "
                         f"smallest block extent {bmin}"
                     )
+        if self.guard_interval is not None and self.guard_interval < 1:
+            raise ValueError(
+                f"guard_interval must be >= 1 (or None to disable the "
+                f"runtime guard), got {self.guard_interval}"
+            )
         if self.accumulate not in ("storage", "f32chunk"):
             raise ValueError(
                 f"accumulate must be 'storage' or 'f32chunk', got "

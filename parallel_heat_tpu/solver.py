@@ -60,6 +60,11 @@ class HeatResult:
     converged: Optional[bool]
     residual: Optional[float]
     elapsed_s: float
+    # Runtime-guard verdict (``HeatConfig.guard_interval``): True/False
+    # when the non-finite guard actually ran on this result's grid, None
+    # when no check ran (guard disabled, or this stream chunk fell
+    # between guard boundaries). Observation-only — see SEMANTICS.md.
+    finite: Optional[bool] = None
 
     def to_numpy(self) -> np.ndarray:
         """Gather the (possibly sharded) final grid to host memory."""
@@ -482,6 +487,9 @@ def explain(config: HeatConfig) -> dict:
         "mesh": mesh_shape if is_sharded else None,
         "mode": "converge" if config.converge else "fixed",
     }
+    if config.guard_interval is not None:
+        out["guard"] = (f"isfinite-all every {config.guard_interval} "
+                        f"steps (observation-only)")
     if is_sharded:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
@@ -725,6 +733,45 @@ def _warn_if_diverged(res: Optional[float], steps_run: int,
         )
 
 
+@jax.jit
+def _all_finite(u):
+    # The guard reduction: one fused isfinite-all over the grid. Under
+    # jit a sharded input reduces on device (psum-free all-reduce via
+    # GSPMD) and returns a replicated scalar — no grid gather. jit
+    # memoizes per shape/dtype/sharding, so repeated guard checks of a
+    # long run reuse one executable.
+    return jnp.isfinite(u).all()
+
+
+def grid_all_finite(grid) -> bool:
+    """On-device non-finite guard: True iff every cell is finite.
+
+    Observation-only (reads the grid, never writes, no donation) and
+    cheap — a single fused reduction, O(bytes) at memory bandwidth.
+    Used by :func:`solve_stream` / :func:`solve` when
+    ``HeatConfig.guard_interval`` is set, and by the run supervisor
+    (``parallel_heat_tpu.supervisor``) to decide rollback.
+    """
+    return bool(_all_finite(grid))
+
+
+def _warn_guard_tripped(step: int) -> None:
+    """The fixed-step analog of :func:`_warn_if_diverged`: the runtime
+    guard found non-finite values, so every step from the first bad one
+    on produced garbage (boundary cells remain exact — SEMANTICS.md
+    "Boundary exactness"). The supervisor upgrades this observation to
+    rollback/retry; plain streamed runs get the loud warning."""
+    import warnings
+
+    warnings.warn(
+        f"runtime guard: non-finite grid values detected at step {step} "
+        f"(coefficient sum past the stability bound? see "
+        f"HeatConfig.stability_margin); grid values are garbage from the "
+        f"first bad step on, boundary cells remain exact",
+        RuntimeWarning,
+    )
+
+
 def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                  chunk_steps: Optional[int] = None):
     """Iterate the simulation in host-visible chunks; yields a
@@ -754,6 +801,13 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     advancing the generator: the next chunk donates that buffer to XLA.
     """
     config = config.validate()
+    guard_interval = config.guard_interval
+    if guard_interval is not None:
+        # The guard is observation-only and never part of the compiled
+        # step program: strip it so the runner/executable caches key on
+        # the guard-free config — a guarded run reuses (and can never
+        # diverge from) the unguarded run's compiled programs.
+        config = config.replace(guard_interval=None)
     if chunk_steps is not None and chunk_steps < 1:
         raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
     total = config.steps
@@ -772,6 +826,7 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
 
     done = 0
     elapsed = 0.0
+    next_guard = guard_interval if guard_interval is not None else None
     while done < total:
         c = min(chunk, total - done)
         ccfg = config.replace(steps=c)
@@ -790,8 +845,26 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
         else:
             out_conv, out_res = None, None
         _warn_if_diverged(out_res, done, k >= config.check_interval)
+        finite: Optional[bool] = None
+        # Last yield of this stream? (all steps done, converged early,
+        # or the defensive under-run below) — the guard must not leave
+        # the FINAL grid unchecked just because the remaining steps
+        # never reached the next boundary (solve() always checks its
+        # end state; a short stream would otherwise be quietly
+        # unguarded).
+        is_last = (done >= total or bool(out_conv) or k < c)
+        if next_guard is not None and (done >= next_guard or is_last):
+            # First chunk boundary at-or-after the guard boundary: one
+            # fused reduction, outside the timed bracket (the guard is
+            # an observer, not part of the simulation).
+            finite = grid_all_finite(grid)
+            while next_guard <= done:
+                next_guard += guard_interval
+            if not finite:
+                _warn_guard_tripped(done)
         yield HeatResult(grid=grid, steps_run=done, converged=out_conv,
-                         residual=out_res, elapsed_s=elapsed)
+                         residual=out_res, elapsed_s=elapsed,
+                         finite=finite)
         if config.converge and out_conv:
             return
         if k < c:  # defensive: a chunk that under-ran without converging
@@ -814,6 +887,15 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     import time
 
     config = config.validate()
+    guard_interval = config.guard_interval
+    if guard_interval is not None:
+        # solve is ONE compiled dispatch — there is no intermediate
+        # boundary to observe, so the guard degrades to a single
+        # end-of-run check (use solve_stream or the supervisor for
+        # within-run detection). Stripped from the config so compiled
+        # programs are shared with (and bitwise identical to) unguarded
+        # runs.
+        config = config.replace(guard_interval=None)
     runner, _ = _build_runner(config)
     initial = _prepare_initial(config, initial)
     compiled = _compiled_for(runner, config, initial)
@@ -840,5 +922,10 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     _warn_if_diverged(res, steps_run,
                       config.converge
                       and steps_run >= config.check_interval)
+    finite: Optional[bool] = None
+    if guard_interval is not None:
+        finite = grid_all_finite(grid)
+        if not finite:
+            _warn_guard_tripped(steps_run)
     return HeatResult(grid=grid, steps_run=steps_run, converged=conv,
-                      residual=res, elapsed_s=elapsed)
+                      residual=res, elapsed_s=elapsed, finite=finite)

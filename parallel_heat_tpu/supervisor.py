@@ -1,0 +1,492 @@
+"""Run supervisor: the fault-tolerant long-run driver.
+
+The reference has no failure handling at all (SURVEY.md §5: "Failure
+detection: none", "Checkpoint/resume: none") — a blown-up run burns its
+whole budget on garbage, a preemption loses everything since launch.
+Production TPU simulation stacks treat the opposite as table stakes:
+a compiled inner loop bracketed by periodic guarded checkpointing is
+exactly the run-loop shape of the TPU CFD framework (arXiv:2108.11076)
+and the long-campaign Ising driver (arXiv:1903.11714). This module
+wires that shape around :func:`solver.solve_stream`:
+
+- **guard**: the on-device isfinite-all reduction
+  (:func:`solver.grid_all_finite`) runs at a configurable step cadence
+  AND before every checkpoint save — retained snapshots are
+  finite-verified by construction, so rollback targets are always good;
+- **checkpoint loop**: ``utils.checkpoint.save_generation`` keeps the
+  newest N generations (each individually crash-atomic), pruning older
+  ones; a kill between a sharded generation's shard write and its
+  manifest write leaves the previous generation discoverable
+  (``latest_checkpoint`` only sees COMPLETE saves — chaos-tested);
+- **preemption**: SIGTERM/SIGINT handlers set a flag (nothing else —
+  async-signal-safe); the loop notices at the next chunk boundary,
+  flushes a final checkpoint, and returns an ``interrupted`` result
+  carrying the exact resume command;
+- **retry-with-rollback**: a tripped guard or a transient dispatch
+  error rolls back to the newest retained generation and retries with
+  bounded exponential backoff; deterministic failures (stability-bound
+  violation) and exhausted budgets raise :class:`PermanentFailure`
+  with a diagnosis naming the first bad chunk.
+
+Everything here is observation + orchestration on the host side of
+chunk boundaries: the compiled simulation programs are bit-for-bit the
+ones an unsupervised run uses (SEMANTICS.md "Runtime guard and
+supervisor"), so a recovered or resumed run reproduces the
+uninterrupted run exactly (chaos-tested bitwise on the jnp backend).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import shlex
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.solver import (
+    HeatResult,
+    _prepare_initial,
+    grid_all_finite,
+    solve_stream,
+)
+from parallel_heat_tpu.utils import checkpoint as ckpt
+from parallel_heat_tpu.utils.faults import InjectedTransientError
+
+
+class PermanentFailure(RuntimeError):
+    """A failure retrying cannot fix; ``.diagnosis`` says what, where,
+    and what to do about it."""
+
+    def __init__(self, diagnosis: str):
+        super().__init__(diagnosis)
+        self.diagnosis = diagnosis
+
+
+class _GuardTrip(Exception):
+    """Internal: the non-finite guard fired. ``window`` is the
+    (last_known_good_step, detected_step] chunk the corruption landed
+    in."""
+
+    def __init__(self, window: Tuple[int, int]):
+        super().__init__(f"guard tripped in steps {window}")
+        self.window = window
+
+
+@dataclass
+class SupervisorPolicy:
+    """Knobs of the supervised run loop (all host-side; none affect
+    simulation numerics)."""
+
+    # Steps between retained checkpoint generations.
+    checkpoint_every: int = 1000
+    # Retained generations; older ones are pruned after each save.
+    keep_checkpoints: int = 3
+    # Steps between guard checks BETWEEN checkpoints. None: guard runs
+    # only at checkpoint boundaries (every save is finite-verified
+    # either way). The effective dispatch chunk is
+    # gcd(checkpoint_every, guard_interval) so both schedules land on
+    # exact chunk boundaries.
+    guard_interval: Optional[int] = None
+    # Rollback-retry budget for transient faults; exceeding it raises
+    # PermanentFailure.
+    max_retries: int = 3
+    # Bounded exponential backoff between retries:
+    # min(backoff_max_s, backoff_base_s * 2**(retry-1)).
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    # Checkpoint layout / compression, passed through to save_generation.
+    layout: str = "auto"
+    compress: bool = False
+
+    def validate(self) -> "SupervisorPolicy":
+        if self.checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got "
+                             f"{self.checkpoint_every}")
+        if self.keep_checkpoints < 1:
+            raise ValueError(f"keep_checkpoints must be >= 1, got "
+                             f"{self.keep_checkpoints}")
+        if self.guard_interval is not None and self.guard_interval < 1:
+            raise ValueError(f"guard_interval must be >= 1, got "
+                             f"{self.guard_interval}")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got "
+                             f"{self.max_retries}")
+        return self
+
+
+@dataclass
+class SupervisorResult:
+    """Outcome of one supervised invocation."""
+
+    # Final simulation result (None when the run was interrupted before
+    # any chunk, or config.steps == 0). `steps_run`/converged/residual
+    # are the LAST stream's view; `steps_done` below is authoritative.
+    result: Optional[HeatResult]
+    # Absolute step count the newest checkpoint (and `result.grid`)
+    # corresponds to.
+    steps_done: int
+    # True: a SIGTERM/SIGINT arrived; a final checkpoint was flushed and
+    # `resume_command` reproduces the run.
+    interrupted: bool
+    retries: int
+    rollbacks: int
+    guard_trips: int
+    # Absolute steps at which the guard detected non-finite values.
+    guard_trip_steps: Tuple[int, ...]
+    checkpoints_written: int
+    last_checkpoint: Optional[str]
+    resume_command: Optional[str]
+    # Signal name when interrupted ("SIGTERM"/"SIGINT"), else None.
+    signal_name: Optional[str] = None
+    wall_s: float = 0.0
+
+
+class _StopFlag:
+    __slots__ = ("signum",)
+
+    def __init__(self):
+        self.signum: Optional[int] = None
+
+
+@contextlib.contextmanager
+def _signal_handlers(flag: _StopFlag):
+    """Install SIGTERM/SIGINT handlers that ONLY set a flag (the whole
+    body is one attribute store — async-signal-safe; all real work
+    happens at the next chunk boundary). Restores previous handlers on
+    exit. Outside the main thread (where Python forbids signal.signal)
+    the run proceeds unguarded — preemption then behaves like the
+    unsupervised baseline."""
+    def handler(signum, frame):
+        flag.signum = signum
+
+    prev = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev[s] = signal.signal(s, handler)
+    except ValueError:  # not the main thread
+        prev = {}
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+def _is_transient_dispatch_error(e: BaseException) -> bool:
+    """Conservative transient classifier for real runtime errors: only
+    status strings the TPU runtime uses for go-away-and-retry
+    conditions. Anything else (shape errors, OOM-by-construction,
+    compile failures) re-raises — retrying deterministic bugs would
+    just burn the budget."""
+    if isinstance(e, InjectedTransientError):
+        return True
+    if type(e).__name__ not in ("XlaRuntimeError", "JaxRuntimeError"):
+        return False
+    msg = str(e)
+    return any(tok in msg for tok in
+               ("UNAVAILABLE", "ABORTED", "preempt", "Socket closed",
+                "connection reset"))
+
+
+def _resume_command(config: HeatConfig, stem: str, total_abs: int,
+                    policy: SupervisorPolicy,
+                    extra_flags: Tuple[str, ...] = ()) -> str:
+    """The exact CLI line that continues this run from its newest
+    checkpoint (printed on preemption; also in SupervisorResult).
+    ``extra_flags`` carries caller flags the config doesn't know about
+    (the CLI's --out/--initial-out etc.) so the resumed run still
+    delivers everything the original invocation asked for."""
+    parts = ["python -m parallel_heat_tpu",
+             f"--nx {config.nx}", f"--ny {config.ny}"]
+    if config.nz is not None:
+        parts.append(f"--nz {config.nz}")
+    parts.append(f"--steps {total_abs}")
+    if config.converge:
+        parts += ["--converge", f"--eps {config.eps:g}",
+                  f"--check-interval {config.check_interval}"]
+    for flag, val, default in (("--cx", config.cx, 0.1),
+                               ("--cy", config.cy, 0.1)):
+        if val != default:
+            parts.append(f"{flag} {val:g}")
+    if config.nz is not None and config.cz != 0.1:
+        parts.append(f"--cz {config.cz:g}")
+    if config.dtype != "float32":
+        parts.append(f"--dtype {config.dtype}")
+    if config.backend != "auto":
+        parts.append(f"--backend {config.backend}")
+    if config.mesh_shape is not None:
+        parts.append("--mesh " + ",".join(map(str, config.mesh_shape)))
+    if config.halo_depth is not None:
+        parts.append(f"--halo-depth {config.halo_depth}")
+    if not config.overlap:
+        parts.append("--no-overlap")
+    if config.accumulate != "storage":
+        parts.append(f"--accumulate {config.accumulate}")
+    parts += ["--supervise", f"--checkpoint {shlex.quote(stem)}",
+              f"--checkpoint-every {policy.checkpoint_every}",
+              f"--keep-checkpoints {policy.keep_checkpoints}",
+              f"--max-retries {policy.max_retries}"]
+    if policy.guard_interval is not None:
+        parts.append(f"--guard-interval {policy.guard_interval}")
+    if policy.layout != "auto":
+        parts.append(f"--checkpoint-layout {policy.layout}")
+    # Caller flags may carry paths ("--out", "my out.npy"): quote each
+    # token so the printed line survives a shell round trip verbatim.
+    parts.extend(shlex.quote(t) for t in extra_flags)
+    parts.append("--resume auto")
+    return " ".join(parts)
+
+
+def run_supervised(config: HeatConfig, checkpoint,
+                   policy: Optional[SupervisorPolicy] = None,
+                   initial=None, start_step: int = 0,
+                   faults=None, say=None,
+                   resume_extra_flags: Tuple[str, ...] = ()
+                   ) -> SupervisorResult:
+    """Run ``config.steps`` more steps under supervision (guard +
+    retained checkpoints + retry-with-rollback + preemption-safe exit).
+
+    ``config.steps`` counts steps REMAINING for this invocation (the
+    same convention the CLI's ``--resume`` reduction uses);
+    ``start_step`` is the absolute step ``initial`` corresponds to, so
+    checkpoint generations are stamped with absolute steps and a
+    resumed invocation continues the same generation family.
+    ``faults`` (a :class:`utils.faults.FaultPlan`) is the chaos-test
+    hook; production runs pass None and pay only the guard reduction
+    plus checkpoint I/O.
+
+    Raises :class:`PermanentFailure` for non-retryable failures; the
+    last retained checkpoint still holds the newest verified-good
+    state.
+    """
+    config = config.validate()
+    policy = (policy or SupervisorPolicy()).validate()
+    say = say or (lambda *a: None)
+    # The supervisor owns guarding — the inner stream runs guard-free
+    # (one compiled-program family shared with unsupervised runs).
+    run_base = (config.replace(guard_interval=None)
+                if config.guard_interval is not None else config)
+    guard_iv = (policy.guard_interval or config.guard_interval
+                or policy.checkpoint_every)
+    every = policy.checkpoint_every
+    chunk = math.gcd(every, guard_iv)
+    if chunk < min(every, guard_iv):
+        # Non-nested cadences (e.g. checkpoint_every=1000 with
+        # guard_interval=333 -> gcd 1): both schedules still land
+        # exactly, but every chunk is a separate host dispatch — a
+        # degenerate gcd silently turns a fused thousand-step run into
+        # per-step dispatch. Loud, because the fix is one flag away.
+        import warnings
+
+        warnings.warn(
+            f"supervisor dispatch chunk is gcd(checkpoint_every="
+            f"{every}, guard_interval={guard_iv}) = {chunk} steps — "
+            f"far smaller chunks mean more host dispatches per run; "
+            f"pick a guard_interval that divides checkpoint_every to "
+            f"dispatch {min(every, guard_iv)}-step chunks instead",
+            RuntimeWarning,
+        )
+    if config.accumulate == "f32chunk":
+        from parallel_heat_tpu.config import sublane_count
+
+        sub = sublane_count(config.dtype)
+        if every % sub or guard_iv % sub:
+            # Stream boundaries ARE rounding points under f32chunk
+            # (SEMANTICS.md): a non-K-multiple cadence would silently
+            # shift every boundary up and desync the guard/checkpoint
+            # schedule from the requested one. Make it loud instead.
+            raise ValueError(
+                f"accumulate='f32chunk' requires checkpoint_every and "
+                f"guard_interval to be multiples of the chunk depth "
+                f"K={sub} (stream boundaries are rounding points — "
+                f"SEMANTICS.md)")
+    total_abs = start_step + config.steps
+    stem = ckpt.checkpoint_stem(checkpoint)
+    ckpt_cfg = config.replace(steps=total_abs)  # self-describing target
+
+    retries = rollbacks = trips = n_ckpt = 0
+    trip_steps: list = []
+    trip_windows: list = []
+    last_path: Optional[str] = None
+    t0 = time.perf_counter()
+
+    def _mk(result, done, interrupted, signame=None, resume_cmd=None):
+        return SupervisorResult(
+            result=result, steps_done=done, interrupted=interrupted,
+            retries=retries, rollbacks=rollbacks, guard_trips=trips,
+            guard_trip_steps=tuple(trip_steps),
+            checkpoints_written=n_ckpt, last_checkpoint=last_path,
+            resume_command=resume_cmd, signal_name=signame,
+            wall_s=time.perf_counter() - t0)
+
+    def save(grid, step_abs):
+        nonlocal n_ckpt, last_path
+        last_path = ckpt.save_generation(
+            stem, grid, step_abs, ckpt_cfg, keep=policy.keep_checkpoints,
+            layout=policy.layout, compress=policy.compress)
+        n_ckpt += 1
+        say(f"Supervisor: checkpoint at step {step_abs} -> {last_path}")
+        return last_path
+
+    def interrupted(cur, done, signum, already_saved):
+        # Flush-and-exit on SIGTERM/SIGINT. The flushed state must honor
+        # the retained-generations-are-good invariant: a signal landing
+        # between a corruption and its guard boundary must not persist
+        # garbage, so the flush itself is guard-verified (skipped — the
+        # previous generation stays newest — when non-finite).
+        if not already_saved:
+            if grid_all_finite(cur):
+                save(cur, done)
+            else:
+                say(f"Supervisor: state at step {done} is non-finite; "
+                    f"keeping previous generation instead of flushing")
+        name = signal.Signals(signum).name
+        cmd = _resume_command(ckpt_cfg, stem, total_abs, policy,
+                              resume_extra_flags)
+        say(f"Supervisor: caught {name}; newest checkpoint "
+            f"{last_path}. Resume with:\n  {cmd}")
+        return _mk(None, done, True, signame=name, resume_cmd=cmd)
+
+    done = start_step
+    # Materialize the start state once (default init / host resume array
+    # -> placed, donation-protected device grid) so generation zero can
+    # be written before any step runs: rollback ALWAYS has a target,
+    # even for a fault in the very first chunk.
+    state = _prepare_initial(run_base, initial)
+    stop = _StopFlag()
+    final: Optional[HeatResult] = None
+
+    with _signal_handlers(stop):
+        save(state, done)
+        while done < total_abs and final is None:
+            seg_base = done
+            last_guarded = done  # guard-verified (or checkpoint-loaded)
+            stream = solve_stream(run_base.replace(steps=total_abs - done),
+                                  initial=state, chunk_steps=chunk)
+            cur = state  # freshest NOT-yet-donated grid
+            res = None
+            try:
+                while True:
+                    if faults is not None:
+                        faults.before_chunk()
+                    if stop.signum is not None:
+                        return interrupted(cur, done, stop.signum,
+                                           already_saved=False)
+                    try:
+                        res = next(stream)
+                    except StopIteration:
+                        break
+                    cur = res.grid
+                    step_abs = seg_base + res.steps_run
+                    ckpt_due = step_abs >= (
+                        (done // every + 1) * every) or step_abs >= total_abs
+                    guard_due = ckpt_due or step_abs >= (
+                        (done // guard_iv + 1) * guard_iv)
+                    if res.converged:
+                        ckpt_due = guard_due = True
+                    if faults is not None:
+                        # observed=guard_due: an injection landing on a
+                        # boundary the guard never inspects would be
+                        # silently dropped with the next chunk's
+                        # `cur = res.grid` — the plan defers it to the
+                        # first guarded boundary instead.
+                        cur = faults.corrupt(cur, step_abs,
+                                             observed=guard_due)
+                    if guard_due:
+                        if not grid_all_finite(cur):
+                            trips += 1
+                            trip_steps.append(step_abs)
+                            trip_windows.append((last_guarded, step_abs))
+                            raise _GuardTrip((last_guarded, step_abs))
+                        last_guarded = step_abs
+                    done = step_abs
+                    if ckpt_due:
+                        save(cur, step_abs)
+                    if res.converged:
+                        final = res
+                        break
+                    if stop.signum is not None:
+                        # Signal landed during this chunk: flush the
+                        # fresh (guard-verified above) state rather
+                        # than waiting for the pre-dispatch check.
+                        return interrupted(cur, done, stop.signum,
+                                           already_saved=ckpt_due)
+                if final is None:
+                    # Stream exhausted: complete (done == total_abs), or
+                    # a defensive under-run — either way `res` is the
+                    # last verified chunk (None only when steps == 0,
+                    # which never enters this loop).
+                    final = res
+            except Exception as e:
+                if isinstance(e, _GuardTrip):
+                    lo, hi = e.window
+                    if config.stability_margin() < 0:
+                        raise PermanentFailure(
+                            f"non-finite grid values in steps ({lo}, "
+                            f"{hi}]: coefficient sum "
+                            f"{sum(config.coefficients):g} exceeds the "
+                            f"stability bound 1/2 (margin "
+                            f"{config.stability_margin():g}) — the "
+                            f"explicit scheme diverges deterministically; "
+                            f"retrying cannot help. Reduce the "
+                            f"coefficients (cx/cy/cz) below a sum of "
+                            f"1/2. Last good checkpoint: step {lo}."
+                        ) from None
+                    kind = (f"guard trip: non-finite values in steps "
+                            f"({lo}, {hi}]")
+                elif _is_transient_dispatch_error(e):
+                    kind = f"transient dispatch error: {e}"
+                else:
+                    raise
+                retries += 1
+                if retries > policy.max_retries:
+                    # The window comes from the guard's own records
+                    # (the (last-verified, detected] span), never
+                    # reconstructed from the chunk size: the current
+                    # trip's window when this failure IS a trip, else
+                    # the first recorded one (labelled as such, since a
+                    # dispatch-error exhaustion may follow an earlier
+                    # recovered trip).
+                    if isinstance(e, _GuardTrip):
+                        lo, hi = e.window
+                        first = f" First bad chunk: steps ({lo}, {hi}]."
+                    elif trip_windows:
+                        lo, hi = trip_windows[0]
+                        first = (f" Earlier guard trip window: steps "
+                                 f"({lo}, {hi}].")
+                    else:
+                        first = ""
+                    raise PermanentFailure(
+                        f"{kind} — fault persisted through "
+                        f"{policy.max_retries} rollback retr"
+                        f"{'y' if policy.max_retries == 1 else 'ies'}."
+                        f"{first} Newest verified checkpoint: "
+                        f"{last_path}.") from None
+                delay = min(policy.backoff_max_s,
+                            policy.backoff_base_s * 2 ** (retries - 1))
+                say(f"Supervisor: {kind}; retry {retries}/"
+                    f"{policy.max_retries} after {delay:g}s backoff")
+                if delay > 0:
+                    time.sleep(delay)
+                src = ckpt.latest_checkpoint(stem)
+                if src is None:  # pragma: no cover (gen0 always exists)
+                    raise PermanentFailure(
+                        f"{kind} — and no checkpoint generation of "
+                        f"{stem!r} survives to roll back to.") from None
+                grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
+                rollbacks += 1
+                state, done = grid0, int(step0)
+                say(f"Supervisor: rolled back to {src} (step {done})")
+                continue
+        if final is not None and done < total_abs and not final.converged:
+            # Defensive stream under-run: record reality, don't loop.
+            say(f"Supervisor: stream under-ran at step {done} of "
+                f"{total_abs} without converging; stopping")
+        if final is None:
+            # config.steps == 0 (or resume already at/past the target):
+            # nothing ran; generation zero was still written.
+            return _mk(None, done, False)
+        return _mk(final, done, False)

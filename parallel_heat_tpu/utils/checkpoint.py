@@ -119,7 +119,23 @@ def _save_gathered(path, grid, step: int, config: HeatConfig,
     path = str(path)
     if not path.endswith(".npz"):
         path += ".npz"
-    tmp = path + ".tmp.npz"  # must end .npz or np.savez appends it
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        # The sharded layout creates its .ckpt directory (parents
+        # included); the gathered layout must extend the same courtesy
+        # to a not-yet-existing parent (`--checkpoint runs/ck` on a
+        # fresh host) instead of dying inside np.savez.
+        os.makedirs(parent, exist_ok=True)
+    # Pid-unique temp name (must end .npz or np.savez appends it): two
+    # concurrent savers of the same rolling file can never clobber each
+    # other's in-flight temp, and a SIGKILLed writer's orphan is
+    # recognizably stale (pruned below) instead of being the next
+    # writer's target. The destination itself is only ever touched by
+    # the atomic _fsync_replace, so a kill at ANY point leaves either
+    # the previous complete .npz or the new complete one — never a
+    # truncated file as the only copy.
+    tmp = f"{path}.tmp-{os.getpid()}.npz"
+    _prune_gathered_orphans(path, keep=tmp)
     saver = np.savez_compressed if compress else np.savez
     try:
         saver(
@@ -134,6 +150,45 @@ def _save_gathered(path, grid, step: int, config: HeatConfig,
         if os.path.exists(tmp):
             os.unlink(tmp)
     return path
+
+
+def _prune_gathered_orphans(path: str, keep: str) -> None:
+    """Remove stale ``<path>.tmp-<pid>.npz`` temps a SIGKILLed writer
+    left next to a gathered checkpoint (exception paths clean up in
+    ``finally``; a hard kill cannot). Loaders never read temps — the
+    load path takes the exact destination name — so orphans are only a
+    disk-space leak, but a rolling ``--checkpoint-every`` run would
+    accumulate one per crashed generation forever. A temp whose
+    embedded pid is still ALIVE on this host is a concurrent writer's
+    in-flight file, not an orphan — left alone (the pid-unique names
+    exist precisely so concurrent savers cannot clobber each other)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path) + ".tmp-"
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    mine = os.path.basename(keep)
+    for name in names:
+        if not (name.startswith(base) and name.endswith(".npz")) \
+                or name == mine:
+            continue
+        try:
+            pid = int(name[len(base):-len(".npz")])
+        except ValueError:
+            pid = None
+        if pid is not None:
+            try:
+                os.kill(pid, 0)  # alive (or not ours): not an orphan
+                continue
+            except ProcessLookupError:
+                pass  # dead -> genuinely orphaned
+            except OSError:
+                continue  # EPERM etc.: exists, leave it
+        try:
+            os.unlink(os.path.join(d, name))
+        except OSError:
+            pass
 
 
 def _ckpt_dir_of(path: str) -> str:
@@ -349,9 +404,14 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
     if jax.process_count() > 1:  # pragma: no cover
         raise ValueError(
             f"cannot resume sharded checkpoint {d}: saved topology "
-            f"(mesh {mesh_shape}, {man['process_count']} processes, "
-            f"generation {gen}) does not match the current one, or a "
-            f"per-process shard file is missing/mismatched")
+            f"(mesh {mesh_shape}, saved from {man['process_count']} "
+            f"process(es), generation {gen}) does not match the current "
+            f"one ({jax.process_count()} process(es), "
+            f"{len(jax.devices())} device(s)), or a per-process shard "
+            f"file is missing/mismatched. Multi-process resume needs "
+            f"the same process count as the save; to reshard instead, "
+            f"load on ONE process with every shard file visible (the "
+            f"host-assembly path reassembles and re-places the grid).")
     # Single-process host assembly (topology changed): read every shard
     # file and place each block into a full host grid.
     full = np.empty(shape, dtype=np.dtype(man["dtype"]))
@@ -371,9 +431,164 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
                 placed += 1
     if placed != len(man["devices"]):
         raise ValueError(
-            f"sharded checkpoint {d} incomplete: {placed} shards found, "
-            f"{len(man['devices'])} expected")
-    return full, step, saved
+            f"sharded checkpoint {d} incomplete: {placed} shard(s) "
+            f"found, {len(man['devices'])} expected (saved from "
+            f"{man['process_count']} process(es), loading on "
+            f"{jax.process_count()}). Each process of the saving run "
+            f"wrote its own shard file — if the save was multi-process, "
+            f"copy every shards_{gen}_p*.npz onto one filesystem before "
+            f"resuming here.")
+    return _replace_on_mesh(full, step, saved, expect_config)
+
+
+def _replace_on_mesh(full: np.ndarray, step: int, saved: HeatConfig,
+                     expect_config: HeatConfig | None):
+    """Reshard-on-load: after host assembly (the topology-changed path),
+    re-place the grid for the mesh the RESUMING run wants, when one is
+    requested and fits the current devices. Reuses
+    ``solver._prepare_initial``'s slice-transfer path — per-shard
+    host->device slices, never a full-grid transfer to one device — so
+    a checkpoint written on 8 devices resumes onto 4 (or 32) with the
+    same memory profile as a fresh sharded start. Without a placeable
+    ``expect_config`` mesh the host array is returned unchanged (the
+    caller's solve re-places it)."""
+    if expect_config is None:
+        return full, step, saved
+    mesh_wanted = expect_config.mesh_or_unit()
+    if not any(dd > 1 for dd in mesh_wanted):
+        return full, step, saved
+    import jax
+
+    n_dev = 1
+    for dd in mesh_wanted:
+        n_dev *= dd
+    if n_dev > len(jax.devices()):
+        return full, step, saved
+    from parallel_heat_tpu.solver import _prepare_initial
+
+    return _prepare_initial(expect_config, full), step, saved
+
+
+# ---------------------------------------------------------------------------
+# Retained generations (the supervisor's rollback targets)
+# ---------------------------------------------------------------------------
+#
+# A supervised run keeps N checkpoints, not one: the newest may be the
+# thing that needs rolling back FROM (a guard trip lands between the
+# corruption and its detection at the next boundary, and a preemption
+# can land mid-save). Each generation is an ordinary checkpoint (either
+# layout, each individually crash-atomic) named
+# ``<stem>.g<step:012>.npz`` / ``.ckpt``; discovery sorts by the step
+# embedded in the name, and pruning keeps the newest ``keep`` steps.
+
+_GEN_RE = re.compile(r"\.g(\d{12})(\.npz|\.ckpt)$")
+
+
+def checkpoint_stem(path) -> str:
+    """Normalize a user-facing checkpoint name to its generation stem:
+    strips a trailing ``.npz``/``.ckpt`` and any ``.g<step>`` suffix, so
+    every spelling of the same checkpoint family maps to one stem."""
+    p = str(path)
+    if p.endswith(".npz"):
+        p = p[:-4]
+    elif p.endswith(".ckpt"):
+        p = p[:-5]
+    m = re.search(r"\.g\d{12}$", p)
+    if m:
+        p = p[:m.start()]
+    return p
+
+
+def generation_paths(path) -> list:
+    """``(step, path)`` for every COMPLETE retained generation of
+    ``path``'s stem, ascending by step. Completeness is what the save
+    protocol guarantees survives a crash: a ``.npz`` exists only as an
+    atomic rename, a ``.ckpt`` counts only once its ``manifest.json``
+    landed — a generation killed between shard write and manifest write
+    is invisible here, so discovery falls back to the previous one."""
+    stem = checkpoint_stem(path)
+    d = os.path.dirname(os.path.abspath(stem)) or "."
+    base = os.path.basename(stem)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(base + ".g"):
+            continue
+        m = _GEN_RE.search(name)
+        if m is None or name[:m.start()] != base:
+            continue
+        full = os.path.join(d, name)
+        if name.endswith(".ckpt"):
+            if not (os.path.isdir(full)
+                    and os.path.isfile(os.path.join(full,
+                                                    "manifest.json"))):
+                continue
+        elif not os.path.isfile(full):
+            continue
+        out.append((int(m.group(1)), full))
+    out.sort()
+    return out
+
+
+def save_generation(path, grid, step: int, config: HeatConfig,
+                    keep: int = 3, layout: str = "auto",
+                    compress: bool = False) -> str:
+    """Write checkpoint generation ``step`` of ``path``'s stem and prune
+    generations beyond the newest ``keep`` steps; returns the path
+    written. ``keep=0`` disables pruning (unbounded retention). The
+    write itself is the ordinary :func:`save_checkpoint` atomicity;
+    pruning runs only AFTER the new generation is complete, so a crash
+    anywhere leaves at least the previously retained set intact."""
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    stem = checkpoint_stem(path)
+    written = save_checkpoint(f"{stem}.g{int(step):012d}", grid, step,
+                              config, compress=compress, layout=layout)
+    if keep:
+        gens = generation_paths(stem)
+        keep_steps = set(sorted({s for s, _ in gens})[-keep:])
+        for s, p in gens:
+            if s in keep_steps:
+                continue
+            try:
+                if os.path.isdir(p):
+                    import shutil
+
+                    shutil.rmtree(p, ignore_errors=True)
+                else:
+                    os.unlink(p)
+            except OSError:
+                pass
+    return written
+
+
+def latest_checkpoint(path):
+    """Discover the newest loadable checkpoint for ``path``: the
+    highest-step complete generation of its stem, else the plain
+    (generation-less) ``<stem>.npz`` / ``<stem>.ckpt``, else the exact
+    path itself, else ``None``. This is what ``--resume auto`` and the
+    supervisor's rollback resolve through — after any crash, the answer
+    is the newest snapshot whose save protocol COMPLETED."""
+    gens = generation_paths(path)
+    if gens:
+        return gens[-1][1]
+    stem = checkpoint_stem(path)
+    if os.path.isfile(stem + ".npz"):
+        return stem + ".npz"
+    d = stem + ".ckpt"
+    if os.path.isdir(d) and os.path.isfile(os.path.join(d,
+                                                        "manifest.json")):
+        return d
+    p = str(path)
+    if os.path.isfile(p):
+        return p
+    if os.path.isdir(p) and os.path.isfile(os.path.join(p,
+                                                        "manifest.json")):
+        return p
+    return None
 
 
 def load_checkpoint(path, expect_config: HeatConfig | None = None

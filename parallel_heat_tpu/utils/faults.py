@@ -1,0 +1,123 @@
+"""Deterministic fault injection for chaos-testing the run supervisor.
+
+The reference has nothing to test here — no failure detection, no
+checkpointing (SURVEY.md §5) — so this framework's recovery machinery
+needs its own adversary. A :class:`FaultPlan` injects the three failure
+shapes a long preemptible-TPU campaign actually sees, each at an exact,
+reproducible point:
+
+- **silent data corruption**: NaN written into one interior cell of a
+  chunk's output at the first chunk boundary at-or-after step ``k``
+  (models a flipped bit / bad HBM read — the thing the isfinite guard
+  exists to catch);
+- **transient dispatch failure**: a synthetic
+  :class:`InjectedTransientError` raised before dispatching chunk
+  ordinal ``n`` (models a runtime hiccup the retry policy should
+  absorb);
+- **preemption**: a real OS signal (default ``SIGTERM``) delivered to
+  this process before dispatching chunk ordinal ``n`` (models the
+  maintenance-event kill; drives the flush-checkpoint-and-exit path).
+
+Faults fire at supervisor hook points — ``before_chunk`` pre-dispatch,
+``corrupt`` on each chunk's output — never inside compiled programs,
+so the simulation numerics under test are exactly production's.
+Determinism contract: every fault names its firing point; one-shot
+faults (the default) record having fired, so the supervisor's
+rolled-back retry sees a clean rerun (the *transient* model), while
+``recurring=True`` re-fires on every pass (the *permanent* model that
+must exhaust the retry budget).
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+class InjectedTransientError(RuntimeError):
+    """Synthetic transient dispatch failure (chaos harness only).
+
+    The supervisor's classifier treats this exactly like a retryable
+    runtime error (preempted collective, transient RPC failure):
+    rollback to the last good checkpoint, backoff, retry.
+    """
+
+
+@dataclass
+class FaultPlan:
+    """A deterministic schedule of injected faults for one supervised
+    run. All fields are optional; an empty plan is a no-op."""
+
+    # Corrupt one interior cell of the chunk output with NaN at the
+    # first chunk boundary at-or-after this ABSOLUTE step count.
+    nan_at_step: Optional[int] = None
+    # False (default): the corruption is one-shot — a rolled-back retry
+    # reruns clean (transient-fault model). True: re-fires every time
+    # the step is re-reached (permanent-fault model).
+    recurring: bool = False
+
+    # Raise InjectedTransientError before dispatching these chunk
+    # ordinals. Ordinals count every before_chunk() call GLOBALLY
+    # across retries (dispatch attempts, not simulated steps), so a
+    # retried schedule naturally advances past a fired ordinal.
+    transient_on_chunks: Tuple[int, ...] = ()
+
+    # Deliver `signum` to this process before dispatching this chunk
+    # ordinal (once).
+    signal_at_chunk: Optional[int] = None
+    signum: int = int(_signal.SIGTERM)
+
+    # -- firing state (not part of the schedule) -------------------------
+    _chunks_seen: int = field(default=0, repr=False)
+    _nan_fired: bool = field(default=False, repr=False)
+    _transients_fired: set = field(default_factory=set, repr=False)
+    _signal_fired: bool = field(default=False, repr=False)
+
+    def before_chunk(self) -> int:
+        """Pre-dispatch hook; returns this dispatch's global ordinal.
+        May raise :class:`InjectedTransientError` or deliver a signal,
+        per the plan."""
+        i = self._chunks_seen
+        self._chunks_seen += 1
+        if self.signal_at_chunk == i and not self._signal_fired:
+            self._signal_fired = True
+            # A real signal through the real delivery path: the
+            # supervisor's handler (not this hook) must observe it,
+            # exactly as a preemption notice would arrive.
+            os.kill(os.getpid(), self.signum)
+        if i in self.transient_on_chunks and i not in self._transients_fired:
+            self._transients_fired.add(i)
+            raise InjectedTransientError(
+                f"injected transient dispatch error on chunk ordinal {i}")
+        return i
+
+    def corrupt(self, grid, step: int, observed: bool = True):
+        """Chunk-output hook: returns ``grid``, NaN-corrupted in one
+        interior cell if the plan says step ``step`` is past the
+        corruption point (a NEW array — the stream's own state is
+        untouched, like real corruption landing in a snapshot copy;
+        a tripped guard abandons the stream anyway).
+
+        ``observed=False`` (the supervisor passes its guard-due flag)
+        defers the fault: the supervisor only looks at chunk outputs it
+        guards, so corruption landing on an unobserved boundary would
+        be dropped with the next ``cur = res.grid`` and the one-shot
+        fault silently consumed — the chaos cell would then certify a
+        detection that never happened. Deferring keeps the injection
+        pending until the first boundary a guard actually inspects,
+        preserving determinism: fires at the first GUARDED boundary
+        at-or-after ``nan_at_step``."""
+        if self.nan_at_step is None or step < self.nan_at_step:
+            return grid
+        if not observed:
+            return grid
+        if self._nan_fired and not self.recurring:
+            return grid
+        self._nan_fired = True
+        import jax
+        import jax.numpy as jnp
+
+        idx = tuple(1 for _ in grid.shape)
+        return jax.jit(lambda u: u.at[idx].set(jnp.nan))(grid)

@@ -1,0 +1,434 @@
+"""Chaos suite: the run supervisor under injected faults.
+
+Every test runs on CPU (the 8-virtual-device conftest) — the failure
+machinery under test is host-side orchestration around the same
+compiled programs every backend uses, so CPU coverage IS the coverage
+(`pytest -m chaos` is the Makefile smoke line). The acceptance
+contract, from ISSUE 2:
+
+- NaN injected at step k in FIXED-STEP mode (where the reference and
+  the pre-supervisor repo checked nothing) is detected within one
+  ``guard_interval``;
+- a transient fault rolls back and recovers, bitwise equal to the
+  uninterrupted run; a permanent fault (stability violation, or a
+  fault that survives the retry budget) halts with a diagnosis;
+- SIGTERM mid-run leaves a loadable checkpoint whose resumed run
+  matches the uninterrupted run bitwise;
+- with the guard/supervisor disabled, ``solve`` outputs are bitwise
+  unchanged.
+"""
+
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import (
+    HeatConfig,
+    PermanentFailure,
+    SupervisorPolicy,
+    run_supervised,
+    solve,
+    solve_stream,
+)
+from parallel_heat_tpu.utils.checkpoint import (
+    generation_paths,
+    latest_checkpoint,
+    load_checkpoint,
+)
+from parallel_heat_tpu.utils.faults import FaultPlan, InjectedTransientError
+
+pytestmark = pytest.mark.chaos
+
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+def _policy(**kw):
+    kw.setdefault("checkpoint_every", 20)
+    kw.setdefault("guard_interval", 10)
+    kw.setdefault("backoff_base_s", 0.0)  # no real sleeping in tests
+    return SupervisorPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# The guard alone (no supervisor)
+# ---------------------------------------------------------------------------
+
+def test_guard_disabled_is_bitwise_identical_and_silent():
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    assert clean.finite is None  # no guard -> no verdict
+    guarded = solve(HeatConfig(steps=60, guard_interval=10, **_BASE))
+    np.testing.assert_array_equal(guarded.to_numpy(), clean.to_numpy())
+    assert guarded.finite is True
+
+
+def test_guard_detects_blowup_in_fixed_step_stream():
+    # Unstable coefficients in FIXED-STEP mode: before the guard,
+    # nothing in the repo checked this (converge mode at least saw its
+    # residual go NaN). The guard must flag it within one interval.
+    cfg = HeatConfig(steps=100, cx=5.0, cy=5.0, guard_interval=10,
+                     **_BASE)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flags = [(r.steps_run, r.finite)
+                 for r in solve_stream(cfg, chunk_steps=10)]
+    # every chunk boundary is a guard boundary here: no None verdicts
+    assert all(f is not None for _, f in flags)
+    first_bad = next(s for s, f in flags if f is False)
+    # 16x16 f32 with cx=cy=5 overflows within a few dozen steps; once
+    # bad, it stays bad — and the warning fired.
+    assert first_bad <= 60
+    assert all(not f for s, f in flags if s >= first_bad)
+    assert any("runtime guard" in str(x.message) for x in w)
+
+
+def test_guard_interval_cadence_leaves_between_chunks_unchecked():
+    cfg = HeatConfig(steps=60, guard_interval=20, **_BASE)
+    flags = [(r.steps_run, r.finite)
+             for r in solve_stream(cfg, chunk_steps=10)]
+    assert flags == [(10, None), (20, True), (30, None), (40, True),
+                     (50, None), (60, True)]
+
+
+def test_guard_checks_final_chunk_even_off_boundary():
+    # steps < guard_interval: the end state must still be checked (a
+    # short stream is not a license to skip guarding — solve() checks
+    # its end state too).
+    cfg = HeatConfig(steps=50, cx=5.0, cy=5.0, guard_interval=60,
+                     **_BASE)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flags = [(r.steps_run, r.finite)
+                 for r in solve_stream(cfg, chunk_steps=25)]
+    assert flags[-1][0] == 50 and flags[-1][1] is False
+    assert flags[:-1] == [(25, None)]
+    assert any("runtime guard" in str(x.message) for x in w)
+
+
+def test_supervisor_warns_on_non_nested_cadences(tmp_path):
+    with pytest.warns(RuntimeWarning, match="dispatch chunk is gcd"):
+        run_supervised(HeatConfig(steps=30, **_BASE), tmp_path / "ck",
+                       policy=_policy(checkpoint_every=15,
+                                      guard_interval=10))
+
+
+def test_guard_off_in_stream_yields_none_verdicts():
+    flags = [r.finite for r in
+             solve_stream(HeatConfig(steps=30, **_BASE), chunk_steps=10)]
+    assert flags == [None, None, None]
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: recovery, halts, preemption
+# ---------------------------------------------------------------------------
+
+def test_supervisor_clean_run_matches_solve_bitwise(tmp_path):
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(HeatConfig(steps=60, **_BASE),
+                          tmp_path / "ck", policy=_policy())
+    assert not sres.interrupted and sres.retries == 0
+    assert sres.steps_done == 60
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+    # generation zero + the periodic saves, pruned to keep_checkpoints
+    steps = [s for s, _ in generation_paths(tmp_path / "ck")]
+    assert steps == [20, 40, 60]
+
+
+def test_supervisor_detects_nan_within_one_guard_interval(tmp_path):
+    k = 35
+    sres = run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                          policy=_policy(),
+                          faults=FaultPlan(nan_at_step=k))
+    assert sres.guard_trips == 1
+    (detected,) = sres.guard_trip_steps
+    assert 0 < detected - k <= 10  # within one guard_interval of k
+
+
+def test_supervisor_recovers_transient_nan_bitwise(tmp_path):
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                          policy=_policy(),
+                          faults=FaultPlan(nan_at_step=35))
+    assert sres.retries == 1 and sres.rollbacks == 1
+    assert sres.steps_done == 60
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_one_shot_nan_on_unguarded_boundary_still_detected(tmp_path):
+    # chunk = gcd(15, 10) = 5: an injection at step 3 would land on
+    # boundary 5, which neither the guard nor the checkpoint schedule
+    # inspects — the plan defers it to the first GUARDED boundary (10)
+    # instead of letting the one-shot fault be silently consumed (and
+    # the cell certify a detection that never ran).
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    with pytest.warns(RuntimeWarning, match="dispatch chunk"):
+        sres = run_supervised(
+            HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+            policy=_policy(checkpoint_every=15, guard_interval=10),
+            faults=FaultPlan(nan_at_step=3))
+    assert sres.guard_trips == 1
+    assert sres.guard_trip_steps[0] == 10
+    assert sres.rollbacks == 1
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_supervisor_recovers_transient_dispatch_error_bitwise(tmp_path):
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                          policy=_policy(),
+                          faults=FaultPlan(transient_on_chunks=(2,)))
+    assert sres.retries == 1 and sres.guard_trips == 0
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_supervisor_halts_permanent_on_stability_violation(tmp_path):
+    cfg = HeatConfig(steps=100, cx=5.0, cy=5.0, **_BASE)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(PermanentFailure) as ei:
+            run_supervised(cfg, tmp_path / "ck", policy=_policy())
+    msg = str(ei.value)
+    # the diagnosis is actionable: names the bound, the margin, the
+    # first bad chunk window, and the no-retry verdict
+    assert "stability bound" in msg and "margin" in msg
+    assert "steps (" in msg and "retrying cannot help" in msg
+    # no retries were burned on a deterministic blow-up
+    assert "rollback retr" not in msg
+
+
+def test_supervisor_exhausts_retry_budget_on_recurring_fault(tmp_path):
+    with pytest.raises(PermanentFailure) as ei:
+        run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                       policy=_policy(max_retries=2),
+                       faults=FaultPlan(nan_at_step=35, recurring=True))
+    msg = str(ei.value)
+    assert "2 rollback retries" in msg
+    assert "First bad chunk" in msg
+    # the newest checkpoint named in the diagnosis is loadable and good
+    p = latest_checkpoint(tmp_path / "ck")
+    assert p is not None and str(p) in msg
+    grid, step, _ = load_checkpoint(p)
+    assert np.isfinite(np.asarray(grid, dtype=np.float64)).all()
+    assert step < 35
+
+
+def test_supervisor_unknown_errors_are_not_retried(tmp_path):
+    # A deterministic bug (here: a TypeError from a hostile fault hook)
+    # must propagate, not be classified transient and retried.
+    class Hostile:
+        def before_chunk(self):
+            raise TypeError("not a fault the classifier knows")
+
+        def corrupt(self, grid, step):
+            return grid
+
+    with pytest.raises(TypeError):
+        run_supervised(HeatConfig(steps=40, **_BASE), tmp_path / "ck",
+                       policy=_policy(), faults=Hostile())
+
+
+def test_sigterm_mid_run_checkpoint_then_resume_bitwise(tmp_path):
+    clean = solve(HeatConfig(steps=100, **_BASE))
+    stem = tmp_path / "ck"
+    sres = run_supervised(HeatConfig(steps=100, **_BASE), stem,
+                          policy=_policy(),
+                          faults=FaultPlan(signal_at_chunk=3,
+                                           signum=int(signal.SIGTERM)))
+    assert sres.interrupted and sres.signal_name == "SIGTERM"
+    assert "--resume auto" in sres.resume_command
+    assert "--supervise" in sres.resume_command
+    # the flushed checkpoint is loadable, and resuming from it finishes
+    # the run bitwise-identically to the uninterrupted one
+    p = latest_checkpoint(stem)
+    assert p is not None
+    grid, step, _ = load_checkpoint(p, HeatConfig(steps=100, **_BASE))
+    assert step == sres.steps_done
+    sres2 = run_supervised(HeatConfig(steps=100 - step, **_BASE), stem,
+                           policy=_policy(), initial=grid,
+                           start_step=step)
+    assert not sres2.interrupted and sres2.steps_done == 100
+    np.testing.assert_array_equal(sres2.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_sigint_is_absorbed_and_handlers_restored(tmp_path):
+    before = signal.getsignal(signal.SIGINT)
+    sres = run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                          policy=_policy(),
+                          faults=FaultPlan(signal_at_chunk=2,
+                                           signum=int(signal.SIGINT)))
+    assert sres.interrupted and sres.signal_name == "SIGINT"
+    assert signal.getsignal(signal.SIGINT) is before
+
+
+def test_supervisor_converge_mode_stops_early_and_checkpoints(tmp_path):
+    cfg = HeatConfig(nx=12, ny=12, steps=10_000, converge=True,
+                     check_interval=20, backend="jnp")
+    direct = solve(cfg)
+    sres = run_supervised(cfg, tmp_path / "ck",
+                          policy=_policy(checkpoint_every=500,
+                                         guard_interval=100))
+    assert sres.result.converged
+    assert sres.steps_done == direct.steps_run
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  direct.to_numpy())
+    # the convergence point itself was checkpointed
+    assert [s for s, _ in generation_paths(tmp_path / "ck")][-1] \
+        == direct.steps_run
+
+
+def test_supervisor_sharded_run_with_rollback(tmp_path):
+    kw = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 2))
+    clean = solve(HeatConfig(steps=60, **kw))
+    sres = run_supervised(HeatConfig(steps=60, **kw), tmp_path / "ck",
+                          policy=_policy(),
+                          faults=FaultPlan(nan_at_step=35))
+    assert sres.rollbacks == 1
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_supervisor_f32chunk_requires_aligned_cadence(tmp_path):
+    cfg = HeatConfig(nx=16, ny=128, steps=64, backend="jnp",
+                     dtype="bfloat16", accumulate="f32chunk")
+    with pytest.raises(ValueError, match="multiples of the chunk depth"):
+        run_supervised(cfg, tmp_path / "ck",
+                       policy=_policy(checkpoint_every=10))
+    # aligned cadence streams bitwise like the one-shot run
+    clean = solve(cfg)
+    sres = run_supervised(cfg, tmp_path / "ck",
+                          policy=_policy(checkpoint_every=32,
+                                         guard_interval=16))
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_resume_command_round_trips_non_default_flags(tmp_path):
+    # The printed resume command must reproduce the run it resumes:
+    # schedule-affecting flags (--no-overlap) and deliverables (--out)
+    # included, --initial-out excluded (a resumed run's `initial` is
+    # checkpoint state, not t=0).
+    cfg = HeatConfig(steps=60, overlap=False, dtype="bfloat16", **_BASE)
+    sres = run_supervised(cfg, tmp_path / "ck", policy=_policy(),
+                          faults=FaultPlan(signal_at_chunk=2))
+    cmd = sres.resume_command
+    assert "--no-overlap" in cmd and "--dtype bfloat16" in cmd
+    assert "--steps 60" in cmd and "--backend jnp" in cmd
+
+
+def test_cli_supervise_f32chunk_default_cadence_aligns(tmp_path):
+    from parallel_heat_tpu.cli import main
+
+    # steps//10 = 10 is not a multiple of bf16's K=16; the DEFAULT
+    # cadence must round itself up instead of crashing...
+    assert main(["--nx", "16", "--ny", "128", "--steps", "100",
+                 "--dtype", "bfloat16", "--accumulate", "f32chunk",
+                 "--backend", "jnp", "--supervise",
+                 "--checkpoint", str(tmp_path / "ck"), "--quiet"]) == 0
+    # ...while an EXPLICIT misaligned cadence fails with a clean
+    # one-line CLI error, not a traceback
+    assert main(["--nx", "16", "--ny", "128", "--steps", "100",
+                 "--dtype", "bfloat16", "--accumulate", "f32chunk",
+                 "--backend", "jnp", "--supervise",
+                 "--checkpoint", str(tmp_path / "ck2"),
+                 "--checkpoint-every", "10", "--quiet"]) == 2
+
+
+def test_fault_plan_determinism():
+    plan = FaultPlan(transient_on_chunks=(1,))
+    assert plan.before_chunk() == 0
+    with pytest.raises(InjectedTransientError):
+        plan.before_chunk()
+    # one-shot: the retried ordinal stream does not re-fire
+    assert plan.before_chunk() == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI integration
+# ---------------------------------------------------------------------------
+
+def test_cli_supervise_resume_auto_bitwise(tmp_path):
+    from parallel_heat_tpu.cli import main
+    from parallel_heat_tpu.utils.io import read_dat
+
+    ck = tmp_path / "ck"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "40",
+                 "--backend", "jnp", "--supervise",
+                 "--checkpoint", str(ck), "--checkpoint-every", "10",
+                 "--guard-interval", "5", "--quiet"]) == 0
+    assert latest_checkpoint(ck) is not None
+    out = tmp_path / "resumed.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "60",
+                 "--backend", "jnp", "--supervise",
+                 "--checkpoint", str(ck), "--resume", "auto",
+                 "--out", str(out), "--quiet"]) == 0
+    direct = tmp_path / "direct.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "60",
+                 "--backend", "jnp", "--out", str(direct),
+                 "--quiet"]) == 0
+    np.testing.assert_array_equal(read_dat(out), read_dat(direct))
+
+
+def test_cli_supervise_requires_checkpoint(capsys):
+    from parallel_heat_tpu.cli import main
+
+    assert main(["--nx", "12", "--ny", "12", "--steps", "10",
+                 "--supervise"]) == 2
+    assert "--supervise requires --checkpoint" in capsys.readouterr().err
+
+
+def test_cli_resume_auto_requires_checkpoint(capsys):
+    from parallel_heat_tpu.cli import main
+
+    assert main(["--nx", "12", "--ny", "12", "--steps", "10",
+                 "--resume", "auto"]) == 2
+    assert "--resume auto requires --checkpoint" in capsys.readouterr().err
+
+
+def test_cli_resume_auto_fresh_start_when_no_checkpoint(tmp_path):
+    from parallel_heat_tpu.cli import main
+    from parallel_heat_tpu.utils.io import read_dat
+
+    out = tmp_path / "fresh.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "20",
+                 "--backend", "jnp", "--checkpoint",
+                 str(tmp_path / "none"), "--resume", "auto",
+                 "--out", str(out), "--quiet"]) == 0
+    direct = tmp_path / "direct.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "20",
+                 "--backend", "jnp", "--out", str(direct),
+                 "--quiet"]) == 0
+    np.testing.assert_array_equal(read_dat(out), read_dat(direct))
+
+
+def test_cli_permanent_failure_exit_code(tmp_path, capsys):
+    from parallel_heat_tpu.cli import main
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rc = main(["--nx", "16", "--ny", "16", "--steps", "100",
+                   "--cx", "5.0", "--cy", "5.0", "--backend", "jnp",
+                   "--supervise", "--checkpoint",
+                   str(tmp_path / "ck"), "--checkpoint-every", "10",
+                   "--quiet"])
+    assert rc == 4
+    assert "permanent failure" in capsys.readouterr().err
+
+
+def test_guard_env_does_not_change_compiled_programs():
+    # The guard must reuse the unguarded config's compiled executables:
+    # stripping guard_interval keys both runs to the same cache entry.
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(steps=20, **_BASE)
+    solver._build_runner.cache_clear()
+    solve(cfg)
+    misses_before = solver._build_runner.cache_info().misses
+    solve(cfg.replace(guard_interval=5))
+    assert solver._build_runner.cache_info().misses == misses_before

@@ -326,6 +326,231 @@ def test_sharded_fastpath_falls_back_on_index_mismatch(tmp_path,
     np.testing.assert_array_equal(grid, res.to_numpy())
 
 
+def test_sharded_crash_between_shards_and_manifest_keeps_previous(
+        tmp_path, monkeypatch):
+    # The preemption-safe-by-construction claim, now actually tested:
+    # kill the save AFTER the new generation's shard files land but
+    # BEFORE its manifest replaces the old one — the previous
+    # generation must load back bit-exactly.
+    import os
+
+    from parallel_heat_tpu.utils import checkpoint as cp
+
+    kw = dict(nx=16, ny=16, backend="jnp", mesh_shape=(2, 2))
+    cfg = HeatConfig(steps=8, **kw)
+    a = solve(HeatConfig(steps=4, **kw))
+    b = solve(HeatConfig(steps=8, **kw))
+    d = cp.save_checkpoint(tmp_path / "ck", a.grid, 4, cfg,
+                           layout="sharded")
+
+    real = cp._fsync_replace
+
+    def crash_on_manifest(tmp, dst):
+        if os.path.basename(dst) == "manifest.json":
+            raise OSError("killed between shard write and manifest write")
+        return real(tmp, dst)
+
+    monkeypatch.setattr(cp, "_fsync_replace", crash_on_manifest)
+    with pytest.raises(OSError):
+        cp.save_checkpoint(tmp_path / "ck", b.grid, 8, cfg,
+                           layout="sharded")
+    monkeypatch.undo()
+    # new-generation shard files exist, but the manifest still names
+    # generation 4 — the load must recover it bit-exactly
+    files = sorted(os.listdir(d))
+    assert any("s000000000008" in f for f in files)
+    grid, step, _ = cp.load_checkpoint(d)
+    assert step == 4
+    np.testing.assert_array_equal(np.asarray(grid), a.to_numpy())
+    # and the next COMPLETE save prunes the orphaned gen-8 shards of
+    # the crashed attempt along with everything else stale
+    cp.save_checkpoint(tmp_path / "ck", b.grid, 8, cfg, layout="sharded")
+    grid, step, _ = cp.load_checkpoint(d)
+    assert step == 8
+    np.testing.assert_array_equal(np.asarray(grid), b.to_numpy())
+
+
+def test_gathered_kill_leaves_orphan_tmp_that_next_save_prunes(tmp_path):
+    # A SIGKILL mid-gathered-write cannot run `finally` cleanup: it
+    # leaves a pid-named temp next to the rolling file. The destination
+    # (written only by atomic rename) must still load the previous
+    # snapshot, and the next save must prune the orphan.
+    import os
+
+    cfg = HeatConfig(nx=8, ny=8, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "roll.npz"
+    save_checkpoint(p, res.grid, 1, cfg)
+    # pid 4999999 exceeds the default pid_max, so the liveness probe
+    # (temps of LIVE pids are concurrent writers, not orphans) always
+    # classifies this one as dead
+    orphan = tmp_path / "roll.npz.tmp-4999999.npz"
+    orphan.write_bytes(b"torn garbage from a SIGKILLed writer")
+    grid, step, _ = load_checkpoint(p)  # untouched by the orphan
+    assert step == 1
+    np.testing.assert_array_equal(grid, res.to_numpy())
+    save_checkpoint(p, res.grid, 2, cfg)
+    assert not os.path.exists(orphan)
+    assert sorted(x.name for x in tmp_path.iterdir()) == ["roll.npz"]
+
+
+def test_sharded_loader_exact_match_ignores_near_miss_names(tmp_path):
+    # The _SHARD_RE_TMPL exact-match guarantee: host assembly must
+    # ignore files whose names merely RESEMBLE shard files (backup
+    # copies, editor droppings), not read them as data.
+    import json
+    import os
+
+    from parallel_heat_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    kw = dict(nx=16, ny=16, backend="jnp", mesh_shape=(2, 2))
+    cfg = HeatConfig(steps=4, **kw)
+    res = solve(cfg)
+    d = save_checkpoint(tmp_path / "ck", res.grid, 4, cfg,
+                        layout="sharded")
+    for near_miss in ("shards_s000000000004c0001_p00000.npz.bak",
+                      "shards_s000000000004c0001_pXXXXX.npz",
+                      "shards_s000000000004c0001_p000001.npz"):
+        with open(os.path.join(d, near_miss), "wb") as f:
+            f.write(b"not a shard file")
+    # force host assembly (the path that scans the directory)
+    mpath = os.path.join(d, "manifest.json")
+    man = json.load(open(mpath))
+    man["mesh_shape"] = [16, 16]
+    json.dump(man, open(mpath, "w"))
+    grid, step, _ = load_checkpoint(d)
+    assert step == 4
+    np.testing.assert_array_equal(grid, res.to_numpy())
+
+
+def test_generations_save_prune_latest_discovery(tmp_path):
+    import os
+
+    from parallel_heat_tpu.utils.checkpoint import (
+        generation_paths, latest_checkpoint, save_generation)
+
+    cfg = HeatConfig(nx=8, ny=8, steps=30, backend="jnp")
+    res = solve(HeatConfig(nx=8, ny=8, steps=1, backend="jnp"))
+    stem = tmp_path / "gen"
+    for step in (10, 20, 30):
+        written = save_generation(stem, res.grid, step, cfg, keep=2)
+        assert os.path.exists(written)
+    gens = generation_paths(stem)
+    assert [s for s, _ in gens] == [20, 30]  # 10 pruned
+    assert latest_checkpoint(stem).endswith(".g000000000030.npz")
+    # step-embedded ordering, not mtime: touch the older file, the
+    # newest STEP still wins
+    os.utime(gens[0][1])
+    assert latest_checkpoint(stem) == gens[1][1]
+    # every spelling of the family resolves to the same stem
+    assert latest_checkpoint(str(stem) + ".npz") == gens[1][1]
+    assert latest_checkpoint(gens[0][1]) == gens[1][1]
+    # a torn .ckpt generation (no manifest) is invisible to discovery
+    os.makedirs(str(stem) + ".g000000000099.ckpt")
+    assert latest_checkpoint(stem) == gens[1][1]
+
+
+def test_save_checkpoint_creates_parent_dirs(tmp_path):
+    # `--checkpoint runs/ck` on a fresh host: both layouts must create
+    # the missing parent directory instead of dying inside np.savez
+    # (found by driving the supervised CLI end to end).
+    cfg = HeatConfig(nx=8, ny=8, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = save_checkpoint(tmp_path / "a" / "b" / "ck", res.grid, 1, cfg)
+    grid, step, _ = load_checkpoint(p)
+    assert step == 1
+    d = save_checkpoint(tmp_path / "c" / "d" / "ck", res.grid, 1, cfg,
+                        layout="sharded")
+    grid, step, _ = load_checkpoint(d)
+    assert step == 1
+
+
+def test_latest_checkpoint_falls_back_to_plain_files(tmp_path):
+    from parallel_heat_tpu.utils.checkpoint import latest_checkpoint
+
+    assert latest_checkpoint(tmp_path / "nothing") is None
+    cfg = HeatConfig(nx=8, ny=8, steps=1, backend="jnp")
+    res = solve(cfg)
+    p = tmp_path / "single.npz"
+    save_checkpoint(p, res.grid, 1, cfg)
+    assert latest_checkpoint(tmp_path / "single") == str(p)
+    assert latest_checkpoint(p) == str(p)
+    d = save_checkpoint(tmp_path / "shardy", res.grid, 1, cfg,
+                        layout="sharded")
+    assert latest_checkpoint(tmp_path / "shardy") == d
+
+
+def test_sharded_reshard_on_load_replaces_for_expected_mesh(tmp_path):
+    # Satellite: resume a sharded checkpoint onto a topology that
+    # cannot rebuild the saved mesh — host assembly must then re-place
+    # the grid for the mesh the RESUMING config wants (the
+    # _prepare_initial slice-transfer path), returning a device-
+    # resident sharded array, not a host ndarray.
+    import json
+    import os
+
+    import jax
+
+    from parallel_heat_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    kw = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 4))
+    cfg = HeatConfig(steps=20, **kw)
+    res = solve(cfg)
+    d = save_checkpoint(tmp_path / "ck", res.grid, 20, cfg,
+                        layout="sharded")
+    # claim the snapshot came from an impossible mesh -> saved-topology
+    # fast path cannot run
+    mpath = os.path.join(d, "manifest.json")
+    man = json.load(open(mpath))
+    man["mesh_shape"] = [16, 16]
+    json.dump(man, open(mpath, "w"))
+    want = HeatConfig(steps=40, nx=32, ny=32, backend="jnp",
+                      mesh_shape=(2, 2))
+    grid, step, _ = load_checkpoint(d, want)
+    assert isinstance(grid, jax.Array)
+    assert len(grid.sharding.device_set) == 4
+    np.testing.assert_array_equal(np.asarray(grid), res.to_numpy())
+    # and the resumed solve on the new mesh continues bitwise
+    rest = solve(HeatConfig(steps=20, nx=32, ny=32, backend="jnp",
+                            mesh_shape=(2, 2)), initial=grid)
+    full = solve(HeatConfig(steps=40, **kw))
+    np.testing.assert_array_equal(rest.to_numpy(), full.to_numpy())
+    # without an expected mesh the host array comes back unchanged
+    grid2, _, _ = load_checkpoint(d)
+    assert isinstance(grid2, np.ndarray)
+
+
+def test_sharded_incomplete_error_names_process_counts(tmp_path):
+    # Satellite: the multi-process mismatch error must be actionable —
+    # name the saved vs current process counts and say where the
+    # missing shard files live.
+    import json
+    import os
+
+    from parallel_heat_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    kw = dict(nx=16, ny=16, backend="jnp", mesh_shape=(2, 2))
+    cfg = HeatConfig(steps=4, **kw)
+    res = solve(cfg)
+    d = save_checkpoint(tmp_path / "ck", res.grid, 4, cfg,
+                        layout="sharded")
+    mpath = os.path.join(d, "manifest.json")
+    man = json.load(open(mpath))
+    man["mesh_shape"] = [16, 16]  # force host assembly
+    man["process_count"] = 3      # claim a multi-process save
+    json.dump(man, open(mpath, "w"))
+    shard = next(f for f in os.listdir(d) if f.startswith("shards_"))
+    os.unlink(os.path.join(d, shard))  # the "other host's" file
+    with pytest.raises(ValueError) as ei:
+        load_checkpoint(d)
+    msg = str(ei.value)
+    assert "3 process(es)" in msg and "loading on 1" in msg
+    assert "copy every shards_" in msg
+
+
 def test_gathered_layout_refuses_unreachable(monkeypatch, tmp_path):
     from parallel_heat_tpu.utils import checkpoint as cp
 

@@ -86,3 +86,26 @@ def test_make_heat_smoke():
     # clean also drops the native build; restore it so later suites
     # don't pay a rebuild
     assert run("native").returncode == 0
+
+
+@pytest.mark.chaos
+def test_chaos_matrix_dryrun_smoke(tmp_path):
+    # The fault x policy sweep must run end to end on CPU and certify
+    # its own contract (exit 0 == every bitwise/detection/halt check
+    # held); the committed chaos_r7_dryrun.json is this exact run.
+    out_json = tmp_path / "chaos.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "chaos_matrix.py"),
+         "--dryrun", "--json", str(out_json)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_ROOT,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out_json.read_text())
+    assert doc["ok"] is True
+    outcomes = {r["fault"]: r["outcome"] for r in doc["rows"]}
+    assert outcomes["nan_transient"] == "recovered"
+    assert outcomes["nan_recurring"] == "halted"
+    assert outcomes["unstable"] == "halted"
+    assert outcomes["sigterm"] == "interrupted+resumed"
+    assert all(r.get("bitwise_match", True) for r in doc["rows"])
