@@ -27,7 +27,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test chaos bench clean
+.PHONY: all heat heat_con native test chaos telemetry-smoke bench clean
 
 all: heat
 
@@ -49,6 +49,20 @@ test:
 # fault-injection smoke for the run supervisor (CPU only, no TPU needed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m chaos -q
+
+# telemetry pipeline smoke (CPU): a small supervised run with --metrics,
+# piped through the report tool — exit 0 means the JSONL is schema-valid
+# and anomaly-free
+telemetry-smoke:
+	rm -rf .telemetry_smoke && mkdir -p .telemetry_smoke
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 32 --ny 32 \
+	    --steps 60 --backend jnp --supervise \
+	    --checkpoint .telemetry_smoke/ck --checkpoint-every 20 \
+	    --guard-interval 10 --metrics .telemetry_smoke/metrics.jsonl \
+	    --heartbeat .telemetry_smoke/heartbeat.json --quiet
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
+	    .telemetry_smoke/metrics.jsonl --json
+	rm -rf .telemetry_smoke
 
 bench:
 	$(PY) bench.py

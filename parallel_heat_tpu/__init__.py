@@ -28,11 +28,14 @@ from parallel_heat_tpu.solver import (
 )
 from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
 from parallel_heat_tpu.supervisor import (
+    EXIT_PERMANENT_FAILURE,
+    EXIT_PREEMPTED,
     PermanentFailure,
     SupervisorPolicy,
     SupervisorResult,
     run_supervised,
 )
+from parallel_heat_tpu.utils.telemetry import Telemetry
 
 __version__ = "0.1.0"
 
@@ -47,6 +50,9 @@ __all__ = [
     "SupervisorPolicy",
     "SupervisorResult",
     "PermanentFailure",
+    "EXIT_PREEMPTED",
+    "EXIT_PERMANENT_FAILURE",
+    "Telemetry",
     "HeatPlate2D",
     "HeatPlate3D",
     "__version__",

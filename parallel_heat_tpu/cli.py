@@ -115,6 +115,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "retains (older ones are pruned)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run")
+    ap.add_argument("--trace", dest="profile", metavar="DIR",
+                    help="alias for --profile (the run-book name: view "
+                         "with XProf/Perfetto/TensorBoard; compiled "
+                         "phases appear under heat:* annotations and "
+                         "the heat_* kernel names)")
+    ap.add_argument("--metrics", default=None, metavar="FILE",
+                    help="append one JSONL telemetry event per stream "
+                         "chunk / supervisor action to FILE (schema-"
+                         "versioned: run-header, per-chunk throughput, "
+                         "checkpoint latency, guard/retry lifecycle — "
+                         "summarize with tools/metrics_report.py). "
+                         "Observation-only: compiled programs and "
+                         "results are bitwise the uninstrumented "
+                         "run's")
+    ap.add_argument("--heartbeat", default=None, metavar="FILE",
+                    help="atomically rewrite FILE with a small liveness "
+                         "JSON document on every telemetry event, for "
+                         "external probes of supervised runs")
     ap.add_argument("--explain", action="store_true",
                     help="print the resolved execution path (backend, "
                          "kernel pick, mesh) and exit without running")
@@ -266,6 +284,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               else make_initial_grid(config))
         say(f"Initial grid written to {written}")
 
+    telemetry = None
+    if args.metrics or args.heartbeat:
+        from parallel_heat_tpu.utils.telemetry import Telemetry
+
+        # Append mode: a resumed invocation continues the same JSONL
+        # stream (tools/metrics_report.py reads multi-segment files).
+        telemetry = Telemetry(args.metrics, heartbeat=args.heartbeat)
+        # Resumed segments report ABSOLUTE steps, continuing the first
+        # segment's numbering (the supervisor re-sets this per rollback
+        # segment itself).
+        telemetry.step_offset = start_step
+
     sup_state = {}
 
     def _run():
@@ -299,11 +329,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             extra = []
             if args.out:
                 extra += ["--out", args.out]
+            if args.metrics:
+                # The sink appends, so the resumed run continues the
+                # same event stream (and liveness probe).
+                extra += ["--metrics", args.metrics]
+            if args.heartbeat:
+                extra += ["--heartbeat", args.heartbeat]
             if args.quiet:
                 extra += ["--quiet"]
             sres = run_supervised(config, args.checkpoint, policy=policy,
                                   initial=initial, start_step=start_step,
-                                  say=say, resume_extra_flags=tuple(extra))
+                                  say=say, resume_extra_flags=tuple(extra),
+                                  telemetry=telemetry)
             sup_state["sres"] = sres
             if sres.result is None and not sres.interrupted:
                 # Zero steps remaining (e.g. --resume auto of a finished
@@ -311,55 +348,97 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return solve(config, initial=initial)
             return sres.result
         if args.checkpoint_every is None:
-            return solve(config, initial=initial)
+            if telemetry is None:
+                return solve(config, initial=initial)
+            # One-chunk stream: same compiled program as solve()
+            # (bitwise — SEMANTICS.md stream-boundary contract), but
+            # the run leaves its header + chunk telemetry behind.
+            from parallel_heat_tpu.solver import solve_stream
+
+            result = None
+            for result in solve_stream(config, initial=initial,
+                                       telemetry=telemetry):
+                pass
+            if result is None:  # steps == 0
+                result = solve(config, initial=initial)
+            return result
         # Periodic-checkpoint driver: chunked solve, snapshot after
         # every chunk (overwriting, so a crash resumes from the latest).
         from parallel_heat_tpu.solver import solve_stream
         from parallel_heat_tpu.utils.checkpoint import save_checkpoint
 
+        import time as _time
+
         result = None
+        n_saves = 0
         for result in solve_stream(config, initial=initial,
-                                   chunk_steps=args.checkpoint_every):
+                                   chunk_steps=args.checkpoint_every,
+                                   telemetry=telemetry):
+            t_save = _time.perf_counter()
             written = save_checkpoint(args.checkpoint, result.grid,
                                       start_step + result.steps_run, config,
                                       layout=args.checkpoint_layout)
+            n_saves += 1
+            if telemetry is not None:
+                # kept=1: this driver overwrites one snapshot (the
+                # supervisor's retained generations report their real
+                # keep count).
+                telemetry.emit("checkpoint_save",
+                               step=start_step + result.steps_run,
+                               path=str(written),
+                               wall_s=_time.perf_counter() - t_save,
+                               kept=1, generation=n_saves)
             say(f"Checkpoint at step {start_step + result.steps_run} "
                 f"-> {written}")
         if result is None:  # steps == 0
             result = solve(config, initial=initial)
         return result
 
-    from parallel_heat_tpu.supervisor import PermanentFailure
+    from parallel_heat_tpu.supervisor import (
+        EXIT_PERMANENT_FAILURE, EXIT_PREEMPTED, PermanentFailure)
 
     try:
-        if args.profile:
-            import jax
+        try:
+            if args.profile:
+                import jax
 
-            with jax.profiler.trace(args.profile):
+                with jax.profiler.trace(args.profile):
+                    result = _run()
+                say(f"Profiler trace written to {args.profile}")
+            else:
                 result = _run()
-            say(f"Profiler trace written to {args.profile}")
-        else:
-            result = _run()
-    except PermanentFailure as e:
-        # The supervisor's no-retry verdict: diagnosis on stderr, the
-        # newest verified checkpoint is still on disk for inspection.
-        print(f"error: permanent failure: {e.diagnosis}", file=sys.stderr)
-        return 4
-    except ValueError as e:
-        if not args.supervise:
-            raise
-        # Bad supervisor flag combination (e.g. a cadence that breaks
-        # the f32chunk K-alignment contract): one-line CLI error like
-        # every other argument problem, not a traceback.
-        print(f"error: {e}", file=sys.stderr)
-        return 2
+        except PermanentFailure as e:
+            # The supervisor's no-retry verdict: diagnosis on stderr,
+            # the newest verified checkpoint is still on disk for
+            # inspection (run_end telemetry was already emitted).
+            print(f"error: permanent failure: {e.diagnosis}",
+                  file=sys.stderr)
+            return EXIT_PERMANENT_FAILURE
+        except ValueError as e:
+            if not args.supervise:
+                raise
+            # Bad supervisor flag combination (e.g. a cadence that
+            # breaks the f32chunk K-alignment contract): one-line CLI
+            # error like every other argument problem, not a traceback.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
-    sres = sup_state.get("sres")
-    if sres is not None and sres.interrupted:
-        # Preemption-style exit: the supervisor flushed a checkpoint and
-        # `say` printed the resume command. Distinct exit code so
-        # restart loops can tell "preempted, resume me" from success.
-        return 3
+        sres = sup_state.get("sres")
+        if sres is not None and sres.interrupted:
+            # Preemption-style exit: the supervisor flushed a checkpoint
+            # and `say` printed the resume command. Distinct exit code
+            # so restart loops can tell "preempted, resume me" from
+            # success.
+            return EXIT_PREEMPTED
+        if telemetry is not None and sres is None:
+            # Unsupervised runs end here (the supervisor emits its own
+            # run_end, in every outcome).
+            telemetry.run_end(outcome="complete",
+                              steps_done=start_step + result.steps_run,
+                              wall_s=result.elapsed_s)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
 
     # Supervised runs report the supervisor's absolute count (a rollback
     # segment's stream restarts its own steps_run from 0).

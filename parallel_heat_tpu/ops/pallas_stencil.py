@@ -229,6 +229,7 @@ def _build_vmem_multistep(shape, dtype_name, cx, cy, k,
         ),
         scratch_shapes=[pltpu.VMEM((M, N), dtype)],
         input_output_aliases={0: 0},
+        name="heat_a_vmem_multistep",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -410,6 +411,7 @@ def _build_strip_kernel(core_shape, dtype_name, cx, cy, grid_shape,
             jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
         ),
         grid_spec=grid_spec,
+        name="heat_b_strip",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -789,6 +791,7 @@ def _build_temporal_strip(shape, dtype_name, cx, cy, k,
              else pltpu.VMEM((SCR, N), dtype)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        name="heat_e_temporal_strip",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -1001,6 +1004,7 @@ def _build_temporal_strip_uniform(shape, dtype_name, cx, cy, k,
              else pltpu.VMEM((SCR, N), dtype)),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
+        name="heat_e_uni_temporal_strip",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -1299,6 +1303,7 @@ def _build_temporal_block(block_shape, dtype_name, cx, cy, grid_shape,
             jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
         ),
         grid_spec=grid_spec,
+        name="heat_g_block_padded",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -1490,6 +1495,7 @@ def _build_temporal_block_circular(block_shape, dtype_name, cx, cy,
             pltpu.VMEM((W, Ye), dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        name="heat_g_block_circular",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -1795,6 +1801,7 @@ def _build_temporal_block_fused(block_shape, dtype_name, cx, cy,
             pltpu.VMEM((W, Ye), dtype),
             pltpu.SemaphoreType.DMA((2, 4)),
         ],
+        name="heat_g_block_fused",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -2060,6 +2067,7 @@ def _build_temporal_block_uniform(block_shape, dtype_name, cx, cy,
             pltpu.VMEM((SCR, Ye), dtype),
             pltpu.SemaphoreType.DMA((2, 4)),
         ],
+        name="heat_g_block_uniform",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -2240,6 +2248,7 @@ def _build_band_fix_2d(block_shape, dtype_name, cx, cy, grid_shape, k,
             pltpu.VMEM((SC, Ye), dtype),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
+        name="heat_g_band_fix_2d",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -2930,6 +2939,7 @@ def _build_tiled_kernel(core_shape, dtype_name, cx, cy, grid_shape,
             jax.ShapeDtypeStruct((1, 1), _ACC, **kw),
         ),
         grid_spec=grid_spec,
+        name="heat_c_tiled",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -3187,6 +3197,7 @@ def _build_tile_temporal_2d(shape, dtype_name, cx, cy, k,
              else pltpu.VMEM((SCR_R, SCR_C), dtype)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        name="heat_i_tile_temporal",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -3375,6 +3386,7 @@ def _build_tile_temporal_2d_uniform(shape, dtype_name, cx, cy, k,
              else pltpu.VMEM((SCR_R, SCR_C), dtype)),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
+        name="heat_i_uni_tile_temporal",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -3555,6 +3567,7 @@ def _build_slab_kernel_3d(shape, dtype_name, cx, cy, cz):
             pltpu.VMEM((2, SX + 4, TY + 4 * SUB, Z), dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        name="heat_d_slab_3d",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -3836,6 +3849,7 @@ def _build_xslab_3d(shape, dtype_name, cx, cy, cz, sx, k,
             pltpu.VMEM((pp_planes, Y, Z), dtype),
             pltpu.SemaphoreType.DMA((n_slots,)),
         ],
+        name="heat_f_xslab_3d",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -4305,6 +4319,7 @@ def _build_temporal_block_3d(block_shape, dtype_name, cx, cy, cz,
             pltpu.VMEM((pp_planes, Ye, Ze), dtype),
             pltpu.SemaphoreType.DMA((n_slots,)),
         ],
+        name="heat_h_block_3d",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -4640,6 +4655,7 @@ def _build_temporal_block_3d_fused(block_shape, dtype_name, cx, cy, cz,
             pltpu.VMEM((pp_planes, Ye, Ze), dtype),
             pltpu.SemaphoreType.DMA((n_slots, 5)),
         ],
+        name="heat_h_block_3d_fused",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )
@@ -4886,6 +4902,7 @@ def _build_band_fix_3d(block_shape, dtype_name, cx, cy, cz, grid_shape,
             pltpu.VMEM((SC, Ye, Ze), dtype),
             pltpu.SemaphoreType.DMA((2, 5)),
         ],
+        name="heat_h_band_fix_3d",
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )

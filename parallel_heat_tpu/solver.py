@@ -750,9 +750,13 @@ def grid_all_finite(grid) -> bool:
     cheap — a single fused reduction, O(bytes) at memory bandwidth.
     Used by :func:`solve_stream` / :func:`solve` when
     ``HeatConfig.guard_interval`` is set, and by the run supervisor
-    (``parallel_heat_tpu.supervisor``) to decide rollback.
+    (``parallel_heat_tpu.supervisor``) to decide rollback. The
+    TraceAnnotation brackets the host-side dispatch+wait, so profiler
+    timelines show the guard as a named phase (it is never part of the
+    compiled step programs).
     """
-    return bool(_all_finite(grid))
+    with jax.profiler.TraceAnnotation("heat:guard"):
+        return bool(_all_finite(grid))
 
 
 def _warn_guard_tripped(step: int) -> None:
@@ -773,7 +777,7 @@ def _warn_guard_tripped(step: int) -> None:
 
 
 def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
-                 chunk_steps: Optional[int] = None):
+                 chunk_steps: Optional[int] = None, telemetry=None):
     """Iterate the simulation in host-visible chunks; yields a
     :class:`HeatResult` after each chunk (cumulative ``steps_run``).
 
@@ -796,6 +800,13 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     points"). Converge mode needs no extra rounding there: the
     check-interval rounding already reproduces the unchunked run's
     per-``check_interval`` chunk restarts exactly.
+
+    ``telemetry`` (a :class:`utils.telemetry.Telemetry`) receives a
+    ``run_header`` event plus one ``chunk`` event per yield (steps,
+    chunk wall time, throughput, residual, guard verdict). Pure
+    host-side observation between dispatches: the compiled programs,
+    their cache keys, and the yielded results are identical with or
+    without a sink (pinned by ``tests/test_telemetry.py``).
 
     Consume each yielded grid (e.g. ``np.asarray`` / checkpoint) before
     advancing the generator: the next chunk donates that buffer to XLA.
@@ -824,6 +835,13 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
 
     import time
 
+    if telemetry is not None:
+        from parallel_heat_tpu.utils import profiling
+
+        telemetry.run_header(config)
+        cells = profiling.cell_count(config)
+        bytes_per_cell = profiling.bytes_per_cell(config)
+
     done = 0
     elapsed = 0.0
     next_guard = guard_interval if guard_interval is not None else None
@@ -833,10 +851,12 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
         runner, _ = _build_runner(ccfg)
         compiled = _compiled_for(runner, ccfg, u)
         t0 = time.perf_counter()
-        grid, k, conv, res = compiled(u)
-        jax.block_until_ready(grid)
+        with jax.profiler.TraceAnnotation("heat:chunk"):
+            grid, k, conv, res = compiled(u)
+            jax.block_until_ready(grid)
         k = int(k)
-        elapsed += time.perf_counter() - t0
+        chunk_wall = time.perf_counter() - t0
+        elapsed += chunk_wall
         done += k
         u = grid
         if config.converge:
@@ -862,6 +882,11 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                 next_guard += guard_interval
             if not finite:
                 _warn_guard_tripped(done)
+        if telemetry is not None:
+            telemetry.chunk(step=done, steps=k, wall_s=chunk_wall,
+                            cells=cells, bytes_per_cell=bytes_per_cell,
+                            residual=out_res, converged=out_conv,
+                            finite=finite)
         yield HeatResult(grid=grid, steps_run=done, converged=out_conv,
                          residual=out_res, elapsed_s=elapsed,
                          finite=finite)
@@ -901,15 +926,16 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     compiled = _compiled_for(runner, config, initial)
 
     t0 = time.perf_counter()
-    grid, steps_run, converged, residual = compiled(initial)
-    if block_until_ready:
-        # One host-visible scalar read *is* the flush: on remote-TPU
-        # transports (axon tunnel) block_until_ready returns at
-        # dispatch, so reading a device value is the only way to
-        # bracket completion. steps_run is scalar-replicated, so this
-        # is a single-element transfer, not a grid gather.
-        jax.block_until_ready(grid)
-        steps_run = int(steps_run)
+    with jax.profiler.TraceAnnotation("heat:solve"):
+        grid, steps_run, converged, residual = compiled(initial)
+        if block_until_ready:
+            # One host-visible scalar read *is* the flush: on remote-TPU
+            # transports (axon tunnel) block_until_ready returns at
+            # dispatch, so reading a device value is the only way to
+            # bracket completion. steps_run is scalar-replicated, so this
+            # is a single-element transfer, not a grid gather.
+            jax.block_until_ready(grid)
+            steps_run = int(steps_run)
     elapsed = time.perf_counter() - t0
 
     if not block_until_ready:

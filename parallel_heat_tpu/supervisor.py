@@ -55,6 +55,15 @@ from parallel_heat_tpu.solver import (
 from parallel_heat_tpu.utils import checkpoint as ckpt
 from parallel_heat_tpu.utils.faults import InjectedTransientError
 
+# Process exit codes of supervised CLI runs (one vocabulary for the
+# CLI, restart loops, and the test suite — no magic numbers):
+# EXIT_PREEMPTED: a SIGTERM/SIGINT arrived; a final checkpoint was
+# flushed and the printed resume command continues the run.
+# EXIT_PERMANENT_FAILURE: retrying cannot help (stability-bound
+# violation, exhausted retry budget); diagnosis on stderr.
+EXIT_PREEMPTED = 3
+EXIT_PERMANENT_FAILURE = 4
+
 
 class PermanentFailure(RuntimeError):
     """A failure retrying cannot fix; ``.diagnosis`` says what, where,
@@ -244,8 +253,8 @@ def run_supervised(config: HeatConfig, checkpoint,
                    policy: Optional[SupervisorPolicy] = None,
                    initial=None, start_step: int = 0,
                    faults=None, say=None,
-                   resume_extra_flags: Tuple[str, ...] = ()
-                   ) -> SupervisorResult:
+                   resume_extra_flags: Tuple[str, ...] = (),
+                   telemetry=None) -> SupervisorResult:
     """Run ``config.steps`` more steps under supervision (guard +
     retained checkpoints + retry-with-rollback + preemption-safe exit).
 
@@ -256,7 +265,11 @@ def run_supervised(config: HeatConfig, checkpoint,
     resumed invocation continues the same generation family.
     ``faults`` (a :class:`utils.faults.FaultPlan`) is the chaos-test
     hook; production runs pass None and pay only the guard reduction
-    plus checkpoint I/O.
+    plus checkpoint I/O. ``telemetry`` (a
+    :class:`utils.telemetry.Telemetry`) receives the run header, every
+    stream chunk, checkpoint save/load latencies, and each lifecycle
+    event (guard_trip / retry / rollback / signal / permanent_failure
+    / run_end) — host-side observation only, per the guard's contract.
 
     Raises :class:`PermanentFailure` for non-retryable failures; the
     last retained checkpoint still holds the newest verified-good
@@ -265,6 +278,10 @@ def run_supervised(config: HeatConfig, checkpoint,
     config = config.validate()
     policy = (policy or SupervisorPolicy()).validate()
     say = say or (lambda *a: None)
+    if telemetry is not None:
+        # Header carries the user's config (guard_interval included);
+        # idempotent, so the per-segment streams' calls are no-ops.
+        telemetry.run_header(config)
     # The supervisor owns guarding — the inner stream runs guard-free
     # (one compiled-program family shared with unsupervised runs).
     run_base = (config.replace(guard_interval=None)
@@ -322,12 +339,30 @@ def run_supervised(config: HeatConfig, checkpoint,
             resume_command=resume_cmd, signal_name=signame,
             wall_s=time.perf_counter() - t0)
 
+    def emit(event, **fields):
+        if telemetry is not None:
+            telemetry.emit(event, **fields)
+
+    def fail(diagnosis: str) -> PermanentFailure:
+        emit("permanent_failure", diagnosis=diagnosis)
+        if telemetry is not None:
+            telemetry.run_end(outcome="permanent_failure",
+                              steps_done=done, retries=retries,
+                              rollbacks=rollbacks, guard_trips=trips,
+                              checkpoints_written=n_ckpt,
+                              wall_s=time.perf_counter() - t0)
+        return PermanentFailure(diagnosis)
+
     def save(grid, step_abs):
         nonlocal n_ckpt, last_path
+        t_save = time.perf_counter()
         last_path = ckpt.save_generation(
             stem, grid, step_abs, ckpt_cfg, keep=policy.keep_checkpoints,
             layout=policy.layout, compress=policy.compress)
         n_ckpt += 1
+        emit("checkpoint_save", step=step_abs, path=str(last_path),
+             wall_s=time.perf_counter() - t_save,
+             kept=policy.keep_checkpoints, generation=n_ckpt)
         say(f"Supervisor: checkpoint at step {step_abs} -> {last_path}")
         return last_path
 
@@ -348,6 +383,13 @@ def run_supervised(config: HeatConfig, checkpoint,
                               resume_extra_flags)
         say(f"Supervisor: caught {name}; newest checkpoint "
             f"{last_path}. Resume with:\n  {cmd}")
+        emit("signal", name=name, step=done)
+        if telemetry is not None:
+            telemetry.run_end(outcome="interrupted", steps_done=done,
+                              signal=name, retries=retries,
+                              rollbacks=rollbacks, guard_trips=trips,
+                              checkpoints_written=n_ckpt,
+                              wall_s=time.perf_counter() - t0)
         return _mk(None, done, True, signame=name, resume_cmd=cmd)
 
     done = start_step
@@ -364,8 +406,13 @@ def run_supervised(config: HeatConfig, checkpoint,
         while done < total_abs and final is None:
             seg_base = done
             last_guarded = done  # guard-verified (or checkpoint-loaded)
+            if telemetry is not None:
+                # Chunk events carry absolute steps: the stream counts
+                # from its own start, each segment's base is added here.
+                telemetry.step_offset = seg_base
             stream = solve_stream(run_base.replace(steps=total_abs - done),
-                                  initial=state, chunk_steps=chunk)
+                                  initial=state, chunk_steps=chunk,
+                                  telemetry=telemetry)
             cur = state  # freshest NOT-yet-donated grid
             res = None
             try:
@@ -400,6 +447,8 @@ def run_supervised(config: HeatConfig, checkpoint,
                             trips += 1
                             trip_steps.append(step_abs)
                             trip_windows.append((last_guarded, step_abs))
+                            emit("guard_trip", step=step_abs,
+                                 window=[last_guarded, step_abs])
                             raise _GuardTrip((last_guarded, step_abs))
                         last_guarded = step_abs
                     done = step_abs
@@ -424,7 +473,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                 if isinstance(e, _GuardTrip):
                     lo, hi = e.window
                     if config.stability_margin() < 0:
-                        raise PermanentFailure(
+                        raise fail(
                             f"non-finite grid values in steps ({lo}, "
                             f"{hi}]: coefficient sum "
                             f"{sum(config.coefficients):g} exceeds the "
@@ -459,7 +508,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                                  f"({lo}, {hi}].")
                     else:
                         first = ""
-                    raise PermanentFailure(
+                    raise fail(
                         f"{kind} — fault persisted through "
                         f"{policy.max_retries} rollback retr"
                         f"{'y' if policy.max_retries == 1 else 'ies'}."
@@ -467,24 +516,36 @@ def run_supervised(config: HeatConfig, checkpoint,
                         f"{last_path}.") from None
                 delay = min(policy.backoff_max_s,
                             policy.backoff_base_s * 2 ** (retries - 1))
+                emit("retry", retry=retries,
+                     max_retries=policy.max_retries, kind=kind,
+                     backoff_s=delay)
                 say(f"Supervisor: {kind}; retry {retries}/"
                     f"{policy.max_retries} after {delay:g}s backoff")
                 if delay > 0:
                     time.sleep(delay)
                 src = ckpt.latest_checkpoint(stem)
                 if src is None:  # pragma: no cover (gen0 always exists)
-                    raise PermanentFailure(
+                    raise fail(
                         f"{kind} — and no checkpoint generation of "
                         f"{stem!r} survives to roll back to.") from None
+                t_load = time.perf_counter()
                 grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
                 rollbacks += 1
                 state, done = grid0, int(step0)
+                emit("rollback", step=done, path=str(src),
+                     load_wall_s=time.perf_counter() - t_load)
                 say(f"Supervisor: rolled back to {src} (step {done})")
                 continue
         if final is not None and done < total_abs and not final.converged:
             # Defensive stream under-run: record reality, don't loop.
             say(f"Supervisor: stream under-ran at step {done} of "
                 f"{total_abs} without converging; stopping")
+        if telemetry is not None:
+            telemetry.run_end(outcome="complete", steps_done=done,
+                              retries=retries, rollbacks=rollbacks,
+                              guard_trips=trips,
+                              checkpoints_written=n_ckpt,
+                              wall_s=time.perf_counter() - t0)
         if final is None:
             # config.steps == 0 (or resume already at/past the target):
             # nothing ran; generation zero was still written.

@@ -260,18 +260,31 @@ class StepStats:
                 f">= {self.effective_hbm_gb_s:.0f} GB/s effective")
 
 
-def step_stats(result, config) -> StepStats:
-    """Build :class:`StepStats` from a solver result + config."""
+def cell_count(config) -> int:
+    """Total grid cells of a config — the throughput denominator."""
     cells = 1
     for n in config.shape:
         cells *= n
+    return cells
+
+
+def bytes_per_cell(config) -> int:
+    """HBM traffic model: one read + one write of the storage dtype per
+    cell per step (f32chunk's f32 carry lives in VMEM, so it shares the
+    storage-dtype model). The single source for :func:`step_stats` and
+    the telemetry chunk events — they must never disagree."""
     import jax.numpy as jnp
 
+    return 2 * jnp.dtype(config.dtype).itemsize
+
+
+def step_stats(result, config) -> StepStats:
+    """Build :class:`StepStats` from a solver result + config."""
     return StepStats(
-        cells=cells,
+        cells=cell_count(config),
         steps=max(result.steps_run, 1),
         elapsed_s=result.elapsed_s,
-        bytes_per_cell=2 * jnp.dtype(config.dtype).itemsize,
+        bytes_per_cell=bytes_per_cell(config),
     )
 
 
@@ -294,7 +307,12 @@ class Timeline:
         return dt
 
     def summary(self) -> str:
+        if not self.phases:
+            return "  (no phases marked)"
         total = sum(dt for _, dt in self.phases)
-        lines = [f"  {name:<24s} {dt:9.4f}s ({dt/total*100:5.1f}%)"
+        # total == 0 (sub-resolution phases): percentages are
+        # meaningless, not a ZeroDivisionError — print them as 0.
+        denom = total if total > 0 else 1.0
+        lines = [f"  {name:<24s} {dt:9.4f}s ({dt/denom*100:5.1f}%)"
                  for name, dt in self.phases]
         return "\n".join(lines + [f"  {'total':<24s} {total:9.4f}s"])

@@ -1,0 +1,240 @@
+"""Run telemetry: a structured JSONL event stream + liveness heartbeat.
+
+The reference project's report studies its runs through Paraver traces
+(Heat.pdf §7: blocking-send phases, per-step communication cost, the
+Allreduce stall pattern) — numbers read off a screen, never machine
+artifacts. Production TPU simulation stacks (the CFD framework of
+arXiv:2108.11076, the Ising campaign driver of arXiv:1903.11714) treat
+per-step telemetry as a framework feature instead: every chunk of work
+leaves a record a tool can aggregate, and an external probe can tell a
+live run from a hung one without attaching a debugger.
+
+:class:`Telemetry` is that sink. One JSON object per line, append-only
+(a resumed run continues the same file), schema-versioned. Events share
+an envelope — ``schema``, ``event``, ``t_wall`` (unix seconds),
+``t_mono`` (monotonic seconds, robust to clock steps) — and carry:
+
+- ``run_header``: the full config, ``solver.explain``'s resolved
+  execution path, mesh/topology, jax/backend versions (one per run
+  segment; idempotent within one sink);
+- ``chunk``: per stream-chunk progress — absolute ``step``, ``steps``
+  advanced, chunk ``wall_s``, throughput (``steps_per_s``,
+  ``mcells_steps_per_s``, ``hbm_gb_s`` via
+  :class:`utils.profiling.StepStats`), ``residual``/``converged`` when
+  converge-mode checks ran, the guard verdict ``finite``;
+- ``checkpoint_save``: save latency + generation (rollback LOAD
+  latency rides the ``rollback`` event as ``load_wall_s``);
+- supervisor lifecycle: ``guard_trip``, ``retry``, ``rollback``,
+  ``signal``, ``permanent_failure``, ``run_end``.
+
+The contract matches the runtime guard's (SEMANTICS.md "Runtime guard
+and supervisor"): telemetry OBSERVES, it never participates. No event
+is computed inside a traced/compiled region, no config field changes,
+and the compiled programs a telemetry-enabled run executes are the
+same cached executables an un-instrumented run uses (pinned by
+``tests/test_telemetry.py::test_telemetry_does_not_change_compiled_
+programs``). A sink that hits an I/O error (disk full, path yanked)
+warns once and goes quiet rather than killing a week-long run.
+
+``tools/metrics_report.py`` ingests the JSONL and renders the run
+summary (throughput percentiles, outliers, retry/guard timeline,
+checkpoint overhead share).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+
+class Telemetry:
+    """Append-only JSONL event sink + optional heartbeat file.
+
+    ``path`` may be None for a heartbeat-only sink. The heartbeat file
+    is rewritten atomically (tmp + rename) at most every
+    ``heartbeat_interval_s`` seconds, on each event, so an external
+    probe can ``stat``/read it without ever seeing a torn write::
+
+        {"t_wall": ..., "t_mono": ..., "pid": ..., "step": ...,
+         "events": ..., "last_event": ...}
+
+    Use as a context manager or call :meth:`close`; either flushes and
+    closes the stream (events are flushed per line regardless, so a
+    SIGKILL loses at most the line being written).
+    """
+
+    def __init__(self, path=None, heartbeat=None,
+                 heartbeat_interval_s: float = 0.0):
+        self.path = str(path) if path is not None else None
+        self.heartbeat_path = (str(heartbeat) if heartbeat is not None
+                               else None)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        for p in (self.path, self.heartbeat_path):
+            # Parent dirs are created like the checkpoint writer's
+            # (utils/checkpoint.py): `--metrics runs/plate.jsonl` must
+            # not require a pre-existing runs/.
+            if p is not None and os.path.dirname(p):
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+        self._f = open(self.path, "a") if self.path is not None else None
+        self._dead = False
+        self._header_done = False
+        self._events = 0
+        self._last_event: Optional[str] = None
+        self._last_step: Optional[int] = None
+        self._last_heartbeat_mono: Optional[float] = None
+        # Absolute-step offset for chunk events: solve_stream counts
+        # steps from its own start, the supervisor restarts streams on
+        # rollback — it sets this to each segment's base so events
+        # carry absolute steps.
+        self.step_offset = 0
+
+    # -- core ------------------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Write one event line. Never raises: telemetry is an
+        observer, and an observer's disk-full must not kill the run —
+        the sink warns once and goes quiet instead."""
+        if self._dead:
+            return
+        rec = {"schema": SCHEMA_VERSION, "event": event,
+               "t_wall": time.time(), "t_mono": time.monotonic()}
+        rec.update(fields)
+        try:
+            if self._f is not None:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+        except (OSError, ValueError, TypeError) as e:
+            self._dead = True
+            warnings.warn(f"telemetry sink {self.path!r} disabled after "
+                          f"write failure: {e}", RuntimeWarning)
+            return
+        self._events += 1
+        self._last_event = event
+        if "step" in fields:
+            self._last_step = fields["step"]
+        self._maybe_heartbeat(rec["t_mono"])
+
+    def _maybe_heartbeat(self, t_mono: float) -> None:
+        if self.heartbeat_path is None:
+            return
+        if (self._last_heartbeat_mono is not None
+                and t_mono - self._last_heartbeat_mono
+                < self.heartbeat_interval_s):
+            return
+        self.heartbeat()
+
+    def heartbeat(self) -> None:
+        """Atomically rewrite the heartbeat file (tmp + rename — a
+        reader never sees a torn write). Safe to call directly from a
+        long host-side wait."""
+        if self.heartbeat_path is None or self._dead:
+            return
+        doc = {"t_wall": time.time(), "t_mono": time.monotonic(),
+               "pid": os.getpid(), "events": self._events,
+               "last_event": self._last_event, "step": self._last_step}
+        tmp = f"{self.heartbeat_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.heartbeat_path)
+        except OSError as e:
+            # Disable ONLY the heartbeat: the JSONL stream is an
+            # independent sink and must keep its terminal run_end even
+            # when the probe file's filesystem goes away.
+            self.heartbeat_path = None
+            warnings.warn(f"telemetry heartbeat disabled after write "
+                          f"failure: {e}", RuntimeWarning)
+            return
+        self._last_heartbeat_mono = doc["t_mono"]
+
+    # -- typed events ----------------------------------------------------
+
+    def run_header(self, config, **extra) -> None:
+        """Emit the run-header event: config, resolved execution path
+        (``solver.explain``), topology, versions. Idempotent per sink —
+        the supervisor's rollback segments re-enter ``solve_stream``
+        without duplicating headers."""
+        if self._header_done or self._dead:
+            return
+        self._header_done = True
+        import jax
+
+        doc = {"config": json.loads(config.to_json()),
+               "schema_version": SCHEMA_VERSION,
+               "jax_version": jax.__version__}
+        try:
+            import numpy as np
+
+            doc["numpy_version"] = np.__version__
+            devs = jax.devices()
+            doc["platform"] = devs[0].platform
+            doc["device_count"] = len(devs)
+            doc["process_index"] = jax.process_index()
+            doc["process_count"] = jax.process_count()
+            doc["mesh"] = (list(config.mesh_shape)
+                           if config.mesh_shape is not None else None)
+        except Exception as e:  # noqa: BLE001 — observation-only
+            doc["topology_error"] = f"{type(e).__name__}: {e}"
+        try:
+            from parallel_heat_tpu.solver import explain
+
+            ex = explain(config)
+            ex["shape"] = list(ex["shape"])
+            if ex.get("mesh"):
+                ex["mesh"] = list(ex["mesh"])
+            doc["explain"] = ex
+        except Exception as e:  # noqa: BLE001 — a config explain can't
+            # resolve must still produce a header, not kill the run
+            doc["explain_error"] = f"{type(e).__name__}: {e}"
+        doc.update(extra)
+        self.emit("run_header", **doc)
+
+    def chunk(self, *, step: int, steps: int, wall_s: float, cells: int,
+              bytes_per_cell: int, residual=None, converged=None,
+              finite=None) -> None:
+        """Emit one per-chunk progress event. ``step`` is absolute
+        (``step_offset`` already applied by the caller or applied here
+        via the offset the supervisor set); rates come from
+        :class:`utils.profiling.StepStats` and are null when the chunk
+        wall time is too small to divide by."""
+        from parallel_heat_tpu.utils.profiling import StepStats
+
+        if wall_s > 0:
+            st = StepStats(cells=cells, steps=steps, elapsed_s=wall_s,
+                           bytes_per_cell=bytes_per_cell)
+            rates = {"steps_per_s": st.steps_per_s,
+                     "mcells_steps_per_s": st.mcells_steps_per_s,
+                     "hbm_gb_s": st.effective_hbm_gb_s}
+        else:
+            rates = {"steps_per_s": None, "mcells_steps_per_s": None,
+                     "hbm_gb_s": None}
+        self.emit("chunk", step=self.step_offset + step, steps=steps,
+                  wall_s=wall_s, cells=cells,
+                  bytes_per_cell=bytes_per_cell, residual=residual,
+                  converged=converged, finite=finite, **rates)
+
+    def run_end(self, *, outcome: str, **fields) -> None:
+        """Terminal event: ``outcome`` is ``complete`` /
+        ``interrupted`` / ``permanent_failure``."""
+        self.emit("run_end", outcome=outcome, **fields)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

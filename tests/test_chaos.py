@@ -26,9 +26,12 @@ import numpy as np
 import pytest
 
 from parallel_heat_tpu import (
+    EXIT_PERMANENT_FAILURE,
+    EXIT_PREEMPTED,
     HeatConfig,
     PermanentFailure,
     SupervisorPolicy,
+    Telemetry,
     run_supervised,
     solve,
     solve_stream,
@@ -340,6 +343,28 @@ def test_cli_supervise_f32chunk_default_cadence_aligns(tmp_path):
                  "--checkpoint-every", "10", "--quiet"]) == 2
 
 
+def test_nan_guard_trip_lands_in_telemetry_within_one_interval(tmp_path):
+    # The ISSUE 3 chaos satellite: a NaN injection must surface in the
+    # telemetry EVENT STREAM (not just the SupervisorResult) within one
+    # guard_interval of the corruption step — CI asserts on the
+    # artifact, no stdout scraping.
+    import json
+
+    k = 35
+    p = tmp_path / "t.jsonl"
+    with Telemetry(p) as tel:
+        run_supervised(HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+                       policy=_policy(), telemetry=tel,
+                       faults=FaultPlan(nan_at_step=k))
+    with open(p) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    trips = [e for e in events if e["event"] == "guard_trip"]
+    assert len(trips) == 1
+    assert 0 < trips[0]["step"] - k <= 10  # one guard_interval
+    lo, hi = trips[0]["window"]
+    assert lo < k <= hi
+
+
 def test_fault_plan_determinism():
     plan = FaultPlan(transient_on_chunks=(1,))
     assert plan.before_chunk() == 0
@@ -417,8 +442,16 @@ def test_cli_permanent_failure_exit_code(tmp_path, capsys):
                    "--supervise", "--checkpoint",
                    str(tmp_path / "ck"), "--checkpoint-every", "10",
                    "--quiet"])
-    assert rc == 4
+    assert rc == EXIT_PERMANENT_FAILURE
     assert "permanent failure" in capsys.readouterr().err
+
+
+def test_exit_code_constants_are_the_documented_contract():
+    # Restart loops in the wild already branch on 3/4 (README run-book);
+    # the named constants must never drift from those values, and must
+    # stay distinct from argparse's 2.
+    assert EXIT_PREEMPTED == 3
+    assert EXIT_PERMANENT_FAILURE == 4
 
 
 def test_guard_env_does_not_change_compiled_programs():

@@ -1,0 +1,78 @@
+"""Error paths of utils/profiling.py: the refusals that keep the
+timing tools from printing garbage rates, and the Timeline's
+empty-summary behavior (ISSUE 3 satellites)."""
+
+import pytest
+
+from parallel_heat_tpu.utils import profiling as prof
+
+
+def test_chain_slope_raises_on_non_positive_slope(monkeypatch):
+    # Flat endpoints (all dispatch floor, no per-call signal): the
+    # slope is zero and chain_slope must refuse, not divide it out.
+    monkeypatch.setattr(prof, "chain_time",
+                        lambda fn, u0, reps: 0.2)
+    with pytest.raises(RuntimeError, match="non-positive chained slope"):
+        prof.chain_slope(None, None, 1, 33)
+    # Inverted endpoints (noise swamped the long batch): same refusal.
+    monkeypatch.setattr(prof, "chain_time",
+                        lambda fn, u0, reps: 0.2 - 1e-4 * reps)
+    with pytest.raises(RuntimeError, match="measurement noise"):
+        prof.chain_slope(None, None, 1, 33, batches=2)
+
+
+def test_chain_slope_happy_path(monkeypatch):
+    monkeypatch.setattr(prof, "chain_time",
+                        lambda fn, u0, reps: 0.2 + 2e-3 * reps)
+    assert prof.chain_slope(None, None, 1, 101) == pytest.approx(2e-3)
+
+
+def test_calibrated_slope_short_span_refusal(monkeypatch):
+    # max_reps cannot hold 60% of span_s of device work: refuse with
+    # the actionable message rather than report a noise-dominated rate.
+    monkeypatch.setattr(prof, "chain_time",
+                        lambda fn, u0, reps: 0.2 + 1e-3 * reps)
+    with pytest.raises(RuntimeError, match="raise max_reps"):
+        prof.calibrated_slope(None, None, span_s=10.0, max_reps=100)
+
+
+def test_step_stats_bytes_per_cell_tracks_dtype():
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.solver import HeatResult
+
+    res = HeatResult(grid=None, steps_run=4, converged=None,
+                     residual=None, elapsed_s=0.5)
+    for dtype, expect in (("float32", 8), ("bfloat16", 4),
+                          ("float64", 16)):
+        cfg = HeatConfig(nx=32, ny=32, steps=4, dtype=dtype,
+                         backend="jnp")
+        st = prof.step_stats(res, cfg)
+        assert st.bytes_per_cell == expect
+        assert st.effective_hbm_gb_s == pytest.approx(
+            1024 * expect * 4 / 0.5 / 1e9)
+    # f32chunk shares the storage-dtype traffic model (the f32 carry
+    # lives in VMEM, not HBM)
+    cfg = HeatConfig(nx=16, ny=128, steps=4, dtype="bfloat16",
+                     accumulate="f32chunk", backend="jnp")
+    assert prof.step_stats(res, cfg).bytes_per_cell == 4
+
+
+def test_timeline_empty_summary_is_friendly():
+    tl = prof.Timeline()
+    s = tl.summary()  # no phases marked: no ZeroDivisionError
+    assert "no phases" in s
+
+
+def test_timeline_zero_total_summary():
+    tl = prof.Timeline()
+    tl.phases = [("a", 0.0), ("b", 0.0)]  # sub-resolution phases
+    s = tl.summary()
+    assert "a" in s and "total" in s and "nan" not in s
+
+
+def test_timeline_normal_summary_unchanged():
+    tl = prof.Timeline()
+    tl.phases = [("init", 1.0), ("run", 3.0)]
+    s = tl.summary()
+    assert "( 25.0%)" in s and "( 75.0%)" in s
+    assert "4.0000s" in s

@@ -1,0 +1,268 @@
+"""Telemetry subsystem: JSONL event stream, heartbeat, and the
+observation-only contract (telemetry shares the un-instrumented runs'
+compiled executables, bitwise — the guard's contract extended to
+instrumentation, SEMANTICS.md)."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import (
+    HeatConfig,
+    SupervisorPolicy,
+    Telemetry,
+    run_supervised,
+    solve,
+    solve_stream,
+)
+from parallel_heat_tpu.utils.faults import FaultPlan
+from parallel_heat_tpu.utils.telemetry import SCHEMA_VERSION
+
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_stream_emits_header_and_chunk_events(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with Telemetry(p) as tel:
+        for _ in solve_stream(HeatConfig(steps=30, **_BASE),
+                              chunk_steps=10, telemetry=tel):
+            pass
+    ev = _events(p)
+    assert [e["event"] for e in ev] == ["run_header"] + ["chunk"] * 3
+    # envelope on every record
+    for e in ev:
+        assert e["schema"] == SCHEMA_VERSION
+        assert e["t_wall"] > 0 and e["t_mono"] > 0
+    hdr = ev[0]
+    assert hdr["config"]["nx"] == 16 and hdr["config"]["steps"] == 30
+    assert hdr["explain"]["backend"] == "jnp"
+    assert hdr["platform"] == "cpu" and hdr["device_count"] == 8
+    assert "jax_version" in hdr
+    chunks = ev[1:]
+    assert [c["step"] for c in chunks] == [10, 20, 30]
+    assert all(c["steps"] == 10 for c in chunks)
+    assert all(c["wall_s"] >= 0 for c in chunks)
+    assert all(c["cells"] == 256 for c in chunks)
+    # f32: one read + one write per cell per step
+    assert all(c["bytes_per_cell"] == 8 for c in chunks)
+    # rates come from StepStats (None only if the wall time was 0)
+    for c in chunks:
+        if c["wall_s"] > 0:
+            assert c["steps_per_s"] == pytest.approx(
+                c["steps"] / c["wall_s"])
+            assert c["hbm_gb_s"] > 0
+
+
+def test_stream_chunk_events_carry_residual_and_guard(tmp_path):
+    p = tmp_path / "t.jsonl"
+    cfg = HeatConfig(nx=12, ny=12, steps=10_000, converge=True,
+                     check_interval=20, guard_interval=20, backend="jnp")
+    with Telemetry(p) as tel:
+        for _ in solve_stream(cfg, chunk_steps=20, telemetry=tel):
+            pass
+    chunks = [e for e in _events(p) if e["event"] == "chunk"]
+    assert all(c["residual"] is not None for c in chunks)
+    assert all(c["finite"] is True for c in chunks)
+    assert chunks[-1]["converged"] is True
+
+
+def test_supervised_run_covers_all_event_families(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with Telemetry(p, heartbeat=tmp_path / "hb.json") as tel:
+        sres = run_supervised(
+            HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+            policy=SupervisorPolicy(checkpoint_every=20,
+                                    guard_interval=10,
+                                    backoff_base_s=0.0),
+            faults=FaultPlan(nan_at_step=35), telemetry=tel)
+    assert sres.retries == 1
+    ev = _events(p)
+    kinds = {e["event"] for e in ev}
+    assert {"run_header", "chunk", "checkpoint_save", "guard_trip",
+            "retry", "rollback", "run_end"} <= kinds
+    # exactly one header despite the rollback's second stream segment
+    assert sum(1 for e in ev if e["event"] == "run_header") == 1
+    # chunk steps are ABSOLUTE: the rollback (to the step-20 retained
+    # generation) re-walks 30..60, not 10..40 again
+    steps = [e["step"] for e in ev if e["event"] == "chunk"]
+    assert steps == [10, 20, 30, 40, 30, 40, 50, 60]
+    trip = next(e for e in ev if e["event"] == "guard_trip")
+    assert trip["step"] == 40 and trip["window"] == [30, 40]
+    saves = [e for e in ev if e["event"] == "checkpoint_save"]
+    assert all(s["wall_s"] >= 0 for s in saves)
+    assert [s["step"] for s in saves][:2] == [0, 20]
+    rb = next(e for e in ev if e["event"] == "rollback")
+    assert rb["step"] < 35 and rb["load_wall_s"] >= 0
+    end = ev[-1]
+    assert end["event"] == "run_end" and end["outcome"] == "complete"
+    assert end["steps_done"] == 60 and end["retries"] == 1
+    # heartbeat: atomic JSON doc, no torn write, current
+    hb = json.load(open(tmp_path / "hb.json"))
+    assert hb["pid"] == os.getpid()
+    assert hb["last_event"] == "run_end" and hb["events"] == len(ev)
+
+
+def test_supervised_permanent_failure_emits_run_end(tmp_path):
+    from parallel_heat_tpu import PermanentFailure
+
+    p = tmp_path / "t.jsonl"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with Telemetry(p) as tel:
+            with pytest.raises(PermanentFailure):
+                run_supervised(
+                    HeatConfig(steps=100, cx=5.0, cy=5.0, **_BASE),
+                    tmp_path / "ck",
+                    policy=SupervisorPolicy(checkpoint_every=20,
+                                            guard_interval=10,
+                                            backoff_base_s=0.0),
+                    telemetry=tel)
+    ev = _events(p)
+    pf = next(e for e in ev if e["event"] == "permanent_failure")
+    assert "stability bound" in pf["diagnosis"]
+    assert ev[-1]["event"] == "run_end"
+    assert ev[-1]["outcome"] == "permanent_failure"
+
+
+def test_supervised_interrupt_emits_signal_and_run_end(tmp_path):
+    import signal
+
+    p = tmp_path / "t.jsonl"
+    with Telemetry(p) as tel:
+        sres = run_supervised(
+            HeatConfig(steps=100, **_BASE), tmp_path / "ck",
+            policy=SupervisorPolicy(checkpoint_every=20,
+                                    backoff_base_s=0.0),
+            faults=FaultPlan(signal_at_chunk=2,
+                             signum=int(signal.SIGTERM)),
+            telemetry=tel)
+    assert sres.interrupted
+    ev = _events(p)
+    sig = next(e for e in ev if e["event"] == "signal")
+    assert sig["name"] == "SIGTERM"
+    assert ev[-1]["event"] == "run_end"
+    assert ev[-1]["outcome"] == "interrupted"
+
+
+def test_telemetry_does_not_change_compiled_programs(tmp_path):
+    # The acceptance contract: telemetry/annotation-enabled runs share
+    # (and are bitwise identical to) un-instrumented executables — the
+    # same regression the guard pins, extended to the telemetry layer.
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(steps=30, **_BASE)
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=10)]
+    misses_before = solver._build_runner.cache_info().misses
+    with Telemetry(tmp_path / "t.jsonl",
+                   heartbeat=tmp_path / "hb.json") as tel:
+        instr = [r.to_numpy()
+                 for r in solve_stream(cfg, chunk_steps=10,
+                                       telemetry=tel)]
+    assert solver._build_runner.cache_info().misses == misses_before
+    for a, b in zip(plain, instr):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_telemetry_survives_unwritable_sink(tmp_path):
+    # Observation must never kill the run: a sink whose stream dies
+    # mid-run warns once, goes quiet, and the simulation completes.
+    p = tmp_path / "t.jsonl"
+    tel = Telemetry(p)
+    tel._f.close()  # simulate the disk yanking the stream mid-run
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        results = list(solve_stream(HeatConfig(steps=20, **_BASE),
+                                    chunk_steps=10, telemetry=tel))
+    assert len(results) == 2 and results[-1].steps_run == 20
+    assert sum("telemetry sink" in str(x.message) for x in w) == 1
+    tel.emit("chunk")  # dead sink: silent no-op, no second warning
+    tel.close()
+
+
+def test_run_header_idempotent_and_append_mode(tmp_path):
+    p = tmp_path / "t.jsonl"
+    cfg = HeatConfig(steps=10, **_BASE)
+    with Telemetry(p) as tel:
+        tel.run_header(cfg)
+        tel.run_header(cfg)
+    # a NEW sink on the same path appends (resume semantics): a second
+    # segment gets its own header
+    with Telemetry(p) as tel:
+        tel.run_header(cfg)
+    ev = _events(p)
+    assert [e["event"] for e in ev] == ["run_header", "run_header"]
+
+
+def test_cli_metrics_and_heartbeat_unsupervised(tmp_path):
+    from parallel_heat_tpu.cli import main
+    from parallel_heat_tpu.utils.io import read_dat
+
+    m = tmp_path / "m.jsonl"
+    out = tmp_path / "out.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "20",
+                 "--backend", "jnp", "--metrics", str(m),
+                 "--heartbeat", str(tmp_path / "hb.json"),
+                 "--out", str(out), "--quiet"]) == 0
+    ev = _events(m)
+    assert [e["event"] for e in ev] == ["run_header", "chunk",
+                                        "run_end"]
+    assert ev[1]["step"] == 20
+    assert ev[2]["outcome"] == "complete"
+    assert (tmp_path / "hb.json").exists()
+    # the metrics path is bitwise the plain path (one-chunk stream runs
+    # the same compiled program)
+    direct = tmp_path / "direct.dat"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "20",
+                 "--backend", "jnp", "--out", str(direct),
+                 "--quiet"]) == 0
+    np.testing.assert_array_equal(read_dat(out), read_dat(direct))
+
+
+def test_cli_resumed_segment_chunks_are_absolute(tmp_path):
+    # A resumed unsupervised run appends to the same JSONL; its chunk
+    # events must continue the first segment's ABSOLUTE numbering, not
+    # restart from the segment-relative count.
+    from parallel_heat_tpu.cli import main
+
+    m = tmp_path / "m.jsonl"
+    ck = tmp_path / "ck.npz"
+    assert main(["--nx", "16", "--ny", "16", "--steps", "40",
+                 "--backend", "jnp", "--checkpoint", str(ck),
+                 "--checkpoint-every", "20", "--metrics", str(m),
+                 "--quiet"]) == 0
+    assert main(["--nx", "16", "--ny", "16", "--steps", "60",
+                 "--backend", "jnp", "--resume", str(ck),
+                 "--checkpoint", str(ck), "--checkpoint-every", "20",
+                 "--metrics", str(m), "--quiet"]) == 0
+    ev = _events(m)
+    assert sum(1 for e in ev if e["event"] == "run_header") == 2
+    assert [e["step"] for e in ev if e["event"] == "chunk"] \
+        == [20, 40, 60]
+    saves = [e["step"] for e in ev if e["event"] == "checkpoint_save"]
+    assert saves == [20, 40, 60]
+    assert [e["steps_done"] for e in ev if e["event"] == "run_end"] \
+        == [40, 60]
+
+
+def test_cli_metrics_flag_rides_resume_command(tmp_path):
+    import signal
+
+    with Telemetry(tmp_path / "t.jsonl") as tel:
+        sres = run_supervised(
+            HeatConfig(steps=100, **_BASE), tmp_path / "ck",
+            policy=SupervisorPolicy(checkpoint_every=20,
+                                    backoff_base_s=0.0),
+            faults=FaultPlan(signal_at_chunk=2,
+                             signum=int(signal.SIGTERM)),
+            resume_extra_flags=("--metrics", str(tmp_path / "t.jsonl")),
+            telemetry=tel)
+    assert "--metrics" in sres.resume_command

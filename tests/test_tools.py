@@ -88,11 +88,63 @@ def test_make_heat_smoke():
     assert run("native").returncode == 0
 
 
+def test_metrics_report_round_trip(tmp_path):
+    # CLI --metrics -> JSONL -> tools/metrics_report.py --json: the
+    # full telemetry pipeline, as `make telemetry-smoke` drives it.
+    m = tmp_path / "m.jsonl"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    run = subprocess.run(
+        [sys.executable, "-m", "parallel_heat_tpu", "--nx", "32",
+         "--ny", "32", "--steps", "60", "--backend", "jnp",
+         "--supervise", "--checkpoint", str(tmp_path / "ck"),
+         "--checkpoint-every", "20", "--guard-interval", "10",
+         "--metrics", str(m), "--quiet"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    assert run.returncode == 0, run.stderr[-2000:]
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    assert doc["header"]["config"]["nx"] == 32
+    assert doc["chunks"]["count"] == 6
+    assert doc["chunks"]["steps_total"] == 60
+    assert doc["chunks"]["steps_per_s"]["p50"] > 0
+    assert doc["checkpoints"]["saves"] == 4
+    assert 0 < doc["checkpoints"]["overhead_share"] <= 1
+    assert doc["outcome"] == "complete" and doc["anomalies"] == []
+    # the human-readable rendering works on the same stream
+    txt = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"), str(m)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert txt.returncode == 0 and "outcome: complete" in txt.stdout
+    # anomaly thresholds drive the exit code (CI contract): a
+    # checkpoint-share ceiling this tiny run must exceed -> exit 2
+    bad = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--max-ckpt-share", "0.0000001"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert bad.returncode == 2 and "ANOMALY" in bad.stdout
+    # unusable input is distinct from an anomaly -> exit 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    none = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"), str(empty)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert none.returncode == 1
+
+
 @pytest.mark.chaos
 def test_chaos_matrix_dryrun_smoke(tmp_path):
     # The fault x policy sweep must run end to end on CPU and certify
-    # its own contract (exit 0 == every bitwise/detection/halt check
-    # held); the committed chaos_r7_dryrun.json is this exact run.
+    # its own contract (exit 0 == every bitwise/detection/halt/
+    # telemetry check held); the committed chaos_r8_dryrun.json is
+    # this exact run.
     out_json = tmp_path / "chaos.json"
     out = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "chaos_matrix.py"),
@@ -109,3 +161,8 @@ def test_chaos_matrix_dryrun_smoke(tmp_path):
     assert outcomes["unstable"] == "halted"
     assert outcomes["sigterm"] == "interrupted+resumed"
     assert all(r.get("bitwise_match", True) for r in doc["rows"])
+    # every cell left a parseable event stream, and the NaN cells'
+    # guard trips are visible in it within one guard_interval
+    assert all(r["telemetry_ok"] for r in doc["rows"])
+    assert all(r.get("telemetry_detect_lag_ok", True)
+               for r in doc["rows"])

@@ -17,13 +17,18 @@ recovery policy, then classifies what happened:
 and cross-checks the contract that matters: whenever a run completes,
 its final grid is BITWISE the uninterrupted unsupervised run's
 (``bitwise_match``), and NaN injections are detected within one
-``guard_interval`` (``detect_lag_ok``).
+``guard_interval`` (``detect_lag_ok``). Every cell also runs with a
+telemetry sink (``utils/telemetry.py``) and asserts on the ARTIFACT
+rather than stdout: the event stream must carry a run_header, chunk
+events, and a terminal run_end (``telemetry_ok``), and a NaN
+injection must appear as a ``guard_trip`` event within one
+``guard_interval`` (``telemetry_detect_lag_ok``).
 
 ``--dryrun`` runs the tiny CPU matrix (16x16, 60 steps) and is the
 committed-artifact entry point:
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --dryrun \
-        --json chaos_r7_dryrun.json
+        --json chaos_r8_dryrun.json
 
 The same sweep runs unchanged on a TPU at real sizes (--size/--steps);
 the supervisor under test is host-side orchestration, so the CPU
@@ -65,8 +70,8 @@ def _faults_for(name, guard_interval, steps):
 
 def run_cell(fault, policy_kw, size, steps, workdir):
     from parallel_heat_tpu import (
-        HeatConfig, PermanentFailure, SupervisorPolicy, run_supervised,
-        solve)
+        HeatConfig, PermanentFailure, SupervisorPolicy, Telemetry,
+        run_supervised, solve)
     from parallel_heat_tpu.utils.checkpoint import (
         latest_checkpoint, load_checkpoint)
 
@@ -77,6 +82,7 @@ def run_cell(fault, policy_kw, size, steps, workdir):
                      **base)
     policy = SupervisorPolicy(backoff_base_s=0.0, **policy_kw)
     stem = os.path.join(workdir, f"ck_{fault}")
+    tel_path = os.path.join(workdir, f"telemetry_{fault}.jsonl")
     faults = _faults_for(fault, policy.guard_interval, steps)
     row = {"fault": fault, "policy": dict(policy_kw)}
     with warnings.catch_warnings():
@@ -84,14 +90,17 @@ def run_cell(fault, policy_kw, size, steps, workdir):
         clean = None if unstable else solve(HeatConfig(steps=steps,
                                                        **base))
         try:
-            sres = run_supervised(cfg, stem, policy=policy,
-                                  faults=faults)
+            with Telemetry(tel_path) as tel:
+                sres = run_supervised(cfg, stem, policy=policy,
+                                      faults=faults, telemetry=tel)
             if sres.interrupted:
                 p = latest_checkpoint(stem)
                 grid, step, _ = load_checkpoint(p, cfg)
-                sres = run_supervised(cfg.replace(steps=steps - step),
-                                      stem, policy=policy,
-                                      initial=grid, start_step=step)
+                with Telemetry(tel_path) as tel:  # resume appends
+                    sres = run_supervised(cfg.replace(steps=steps - step),
+                                          stem, policy=policy,
+                                          initial=grid, start_step=step,
+                                          telemetry=tel)
                 row["outcome"] = "interrupted+resumed"
             elif sres.retries:
                 row["outcome"] = "recovered"
@@ -116,7 +125,56 @@ def run_cell(fault, policy_kw, size, steps, workdir):
         except PermanentFailure as e:
             row["outcome"] = "halted"
             row["diagnosis"] = str(e)
+    row.update(_telemetry_summary(tel_path, faults, policy))
     return row
+
+
+def _load_events(tel_path):
+    """Tolerant per-line JSONL parse — shared with the report tool
+    (tools/metrics_report.py::load_events), imported by file path so
+    the sweep works from any cwd. A torn final line (exactly the kill
+    faults this matrix injects) degrades the counts, never the parse."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_report",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "metrics_report.py"))
+    mr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mr)
+    return mr.load_events(tel_path)
+
+
+def _telemetry_summary(tel_path, faults, policy):
+    """Per-cell telemetry cross-checks: every supervised run must leave
+    a parseable event stream with a header and a terminal run_end, and
+    a NaN injection must surface as a guard_trip event within one
+    guard_interval — asserted on the ARTIFACT, not on stdout."""
+    out = {}
+    try:
+        events, _bad = _load_events(tel_path)
+    except OSError as e:
+        out["telemetry_ok"] = False
+        out["telemetry_error"] = str(e)
+        return out
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    out["telemetry_events"] = counts
+    out["telemetry_ok"] = bool(counts.get("run_header")
+                               and counts.get("run_end")
+                               and counts.get("chunk"))
+    if faults is not None and faults.nan_at_step is not None:
+        trips = [e for e in events if e["event"] == "guard_trip"]
+        if trips:
+            lag = trips[0]["step"] - faults.nan_at_step
+            out["telemetry_guard_trip_step"] = trips[0]["step"]
+            out["telemetry_detect_lag_ok"] = bool(
+                0 <= lag <= (policy.guard_interval
+                             or policy.checkpoint_every))
+        else:
+            out["telemetry_detect_lag_ok"] = False
+    return out
 
 
 FAULTS = ("none", "nan_transient", "nan_recurring", "transient_error",
@@ -166,12 +224,13 @@ def main():
     # measurements it must have produced (a cell whose injection was
     # never observed would otherwise certify a contract vacuously).
     MUST = {
-        "none": ("bitwise_match",),
-        "nan_transient": ("bitwise_match", "detect_lag_ok"),
-        "transient_error": ("bitwise_match",),
-        "sigterm": ("bitwise_match",),
-        "nan_recurring": (),
-        "unstable": (),
+        "none": ("bitwise_match", "telemetry_ok"),
+        "nan_transient": ("bitwise_match", "detect_lag_ok",
+                          "telemetry_ok", "telemetry_detect_lag_ok"),
+        "transient_error": ("bitwise_match", "telemetry_ok"),
+        "sigterm": ("bitwise_match", "telemetry_ok"),
+        "nan_recurring": ("telemetry_ok", "telemetry_detect_lag_ok"),
+        "unstable": ("telemetry_ok",),
     }
     by_fault = {r["fault"]: r for r in rows}
     ok = (all(by_fault[f].get(k) is True

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Metrics report: summarize a telemetry JSONL stream (`--metrics`).
+
+The consumption side of ``utils/telemetry.py`` — the analog of the
+reference report's Paraver-trace tables (Heat.pdf §7), but computed
+from machine-readable events instead of read off a trace viewer:
+
+- run header(s): config, resolved execution path, topology, versions;
+- throughput: percentiles (p10/p50/p90/max) of per-chunk steps/s and
+  Mcells*steps/s, total steps and wall time;
+- chunk-time outliers: chunks slower than ``--outlier-mult`` x the
+  median chunk wall time (stragglers, GC pauses, preemption stalls);
+- lifecycle timeline: guard trips, retries, rollbacks, signals,
+  permanent failures, in event order with absolute steps;
+- checkpoint overhead share: save/load seconds as a fraction of the
+  run's accounted wall time.
+
+Exit codes (CI/chaos-matrix assert on these instead of scraping
+stdout):
+
+- 0: parsed fine, no anomaly;
+- 1: unusable input (no file, no events, no run_header);
+- 2: anomaly — an event named in ``--fail-on`` occurred (default:
+  ``permanent_failure``), outliers exceeded ``--max-outlier-frac``, or
+  checkpoint share exceeded ``--max-ckpt-share``.
+
+``--json`` prints the summary document to stdout as JSON (for piping:
+``make telemetry-smoke``).
+"""
+
+import argparse
+import json
+import sys
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1,
+            max(0, round(q / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def load_events(path):
+    """Parse a JSONL telemetry file -> (events, n_bad_lines)."""
+    events, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def summarize(events, outlier_mult=5.0):
+    """Aggregate an event list into the report document."""
+    by = {}
+    for e in events:
+        by.setdefault(e["event"], []).append(e)
+
+    doc = {"events_total": len(events),
+           "events_by_type": {k: len(v) for k, v in sorted(by.items())},
+           # schema may be absent on foreign/corrupt lines (None) —
+           # keep them visible without tripping the None/int sort
+           "schema_versions": sorted({e.get("schema") for e in events},
+                                     key=lambda s: (s is None, s))}
+
+    headers = by.get("run_header", [])
+    if headers:
+        h = headers[0]
+        doc["header"] = {
+            "config": h.get("config"),
+            "explain": h.get("explain"),
+            "platform": h.get("platform"),
+            "device_count": h.get("device_count"),
+            "jax_version": h.get("jax_version"),
+            "segments": len(headers),  # resumed runs append headers
+        }
+
+    # Defensive field access throughout: a foreign line shaped like an
+    # event must degrade the numbers, never traceback past the exit-
+    # code contract (0 clean / 1 unusable / 2 anomaly).
+    chunks = by.get("chunk", [])
+    if chunks:
+        walls = sorted(c.get("wall_s", 0.0) for c in chunks)
+        med = _percentile(walls, 50)
+        rates = sorted(c["steps_per_s"] for c in chunks
+                       if c.get("steps_per_s"))
+        mcells = sorted(c["mcells_steps_per_s"] for c in chunks
+                        if c.get("mcells_steps_per_s"))
+        outliers = [
+            {"step": c.get("step"), "wall_s": c.get("wall_s", 0.0),
+             "vs_median": (c.get("wall_s", 0.0) / med if med else None)}
+            for c in chunks
+            if med and c.get("wall_s", 0.0) > outlier_mult * med]
+        residuals = [c for c in chunks if c.get("residual") is not None]
+        doc["chunks"] = {
+            "count": len(chunks),
+            "steps_total": sum(c.get("steps", 0) for c in chunks),
+            "wall_s_total": sum(walls),
+            "wall_s_median": med,
+            "steps_per_s": {
+                "p10": _percentile(rates, 10),
+                "p50": _percentile(rates, 50),
+                "p90": _percentile(rates, 90),
+                "max": rates[-1] if rates else None,
+            },
+            "mcells_steps_per_s": {
+                "p10": _percentile(mcells, 10),
+                "p50": _percentile(mcells, 50),
+                "p90": _percentile(mcells, 90),
+                "max": mcells[-1] if mcells else None,
+            },
+            "outlier_mult": outlier_mult,
+            "outliers": outliers,
+            "outlier_frac": len(outliers) / len(chunks),
+            "last_residual": (residuals[-1]["residual"]
+                              if residuals else None),
+            "guard_checked": sum(1 for c in chunks
+                                 if c.get("finite") is not None),
+            "guard_bad": sum(1 for c in chunks
+                             if c.get("finite") is False),
+        }
+
+    saves = by.get("checkpoint_save", [])
+    loads = by.get("rollback", [])
+    ckpt_s = (sum(s.get("wall_s", 0.0) for s in saves)
+              + sum(r.get("load_wall_s", 0.0) for r in loads))
+    chunk_s = (sum(c.get("wall_s", 0.0) for c in chunks)
+               if chunks else 0.0)
+    doc["checkpoints"] = {
+        "saves": len(saves),
+        "save_s_total": sum(s.get("wall_s", 0.0) for s in saves),
+        "rollback_loads": len(loads),
+        "overhead_share": (ckpt_s / (ckpt_s + chunk_s)
+                           if ckpt_s + chunk_s > 0 else 0.0),
+    }
+
+    timeline = [
+        {"event": e["event"], "t_mono": e.get("t_mono"),
+         "step": e.get("step"),
+         "detail": {k: v for k, v in e.items()
+                    if k not in ("schema", "event", "t_wall", "t_mono")}}
+        for e in events
+        if e["event"] in ("guard_trip", "retry", "rollback", "signal",
+                          "permanent_failure", "run_end")]
+    doc["timeline"] = timeline
+
+    ends = by.get("run_end", [])
+    if ends:
+        doc["outcome"] = ends[-1].get("outcome")
+        doc["steps_done"] = ends[-1].get("steps_done")
+    return doc
+
+
+def render_text(doc):
+    out = []
+    h = doc.get("header")
+    if h:
+        cfg = h.get("config") or {}
+        shape = "x".join(str(cfg.get(k)) for k in ("nx", "ny", "nz")
+                         if cfg.get(k) is not None)
+        out.append(f"run: {shape} steps={cfg.get('steps')} "
+                   f"dtype={cfg.get('dtype')} "
+                   f"platform={h.get('platform')} "
+                   f"x{h.get('device_count')} "
+                   f"segments={h.get('segments')}")
+        ex = h.get("explain") or {}
+        if ex.get("path"):
+            out.append(f"path: {ex['path']}")
+    c = doc.get("chunks")
+    if c:
+        sp = c["steps_per_s"]
+        out.append(
+            f"chunks: {c['count']} ({c['steps_total']} steps, "
+            f"{c['wall_s_total']:.3f}s wall)  steps/s "
+            f"p10={_fmt(sp['p10'])} p50={_fmt(sp['p50'])} "
+            f"p90={_fmt(sp['p90'])} max={_fmt(sp['max'])}")
+        mc = c["mcells_steps_per_s"]
+        out.append(f"throughput: Mcells*steps/s p50={_fmt(mc['p50'])} "
+                   f"p90={_fmt(mc['p90'])}")
+        out.append(
+            f"outliers (> {c['outlier_mult']:g}x median "
+            f"{c['wall_s_median']:.4f}s): {len(c['outliers'])} "
+            f"({c['outlier_frac']:.1%})"
+            + "".join(f"\n  step {o['step']}: {o['wall_s']:.4f}s "
+                      f"({o['vs_median']:.1f}x)"
+                      for o in c["outliers"][:10]))
+        if c["guard_checked"]:
+            out.append(f"guard: {c['guard_checked']} chunk verdicts, "
+                       f"{c['guard_bad']} non-finite")
+    k = doc["checkpoints"]
+    out.append(f"checkpoints: {k['saves']} saves "
+               f"({k['save_s_total']:.3f}s), {k['rollback_loads']} "
+               f"rollback loads, overhead share "
+               f"{k['overhead_share']:.1%}")
+    if doc["timeline"]:
+        out.append("timeline:")
+        for t in doc["timeline"]:
+            step = f" step={t['step']}" if t.get("step") is not None \
+                else ""
+            out.append(f"  {t['event']}{step}")
+    if "outcome" in doc:
+        out.append(f"outcome: {doc['outcome']} "
+                   f"(steps_done={doc.get('steps_done')})")
+    return "\n".join(out)
+
+
+def _fmt(v):
+    return "-" if v is None else f"{v:,.0f}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a --metrics telemetry JSONL file")
+    ap.add_argument("metrics", help="JSONL file written by --metrics")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary document as JSON")
+    ap.add_argument("--outlier-mult", type=float, default=5.0,
+                    help="a chunk counts as an outlier when its wall "
+                         "time exceeds this multiple of the median "
+                         "(default 5)")
+    ap.add_argument("--max-outlier-frac", type=float, default=None,
+                    metavar="F",
+                    help="exit 2 when the outlier fraction exceeds F")
+    ap.add_argument("--max-ckpt-share", type=float, default=None,
+                    metavar="F",
+                    help="exit 2 when checkpoint save+load time "
+                         "exceeds fraction F of accounted wall time")
+    ap.add_argument("--fail-on", default="permanent_failure",
+                    metavar="EV[,EV]",
+                    help="exit 2 when any of these events appear "
+                         "(default: permanent_failure; e.g. add "
+                         "guard_trip for runs that must stay clean; "
+                         "'none' disables)")
+    args = ap.parse_args(argv)
+
+    try:
+        events, bad = load_events(args.metrics)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print(f"error: {args.metrics}: no telemetry events",
+              file=sys.stderr)
+        return 1
+    if not any(e["event"] == "run_header" for e in events):
+        print(f"error: {args.metrics}: no run_header event — not a "
+              f"telemetry stream (or one from a newer schema)",
+              file=sys.stderr)
+        return 1
+
+    doc = summarize(events, outlier_mult=args.outlier_mult)
+    doc["bad_lines"] = bad
+
+    anomalies = []
+    fail_on = (set() if args.fail_on == "none"
+               else {t.strip() for t in args.fail_on.split(",")})
+    for ev in sorted(fail_on & set(doc["events_by_type"])):
+        anomalies.append(f"{doc['events_by_type'][ev]} {ev} event(s)")
+    c = doc.get("chunks")
+    if (args.max_outlier_frac is not None and c
+            and c["outlier_frac"] > args.max_outlier_frac):
+        anomalies.append(
+            f"chunk outlier fraction {c['outlier_frac']:.2%} > "
+            f"{args.max_outlier_frac:.2%}")
+    share = doc["checkpoints"]["overhead_share"]
+    if args.max_ckpt_share is not None and share > args.max_ckpt_share:
+        anomalies.append(f"checkpoint overhead share {share:.2%} > "
+                         f"{args.max_ckpt_share:.2%}")
+    doc["anomalies"] = anomalies
+
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_text(doc))
+        for a in anomalies:
+            print(f"ANOMALY: {a}")
+    return 2 if anomalies else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
