@@ -27,7 +27,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test chaos telemetry-smoke bench clean
+.PHONY: all heat heat_con native test chaos telemetry-smoke \
+        monitor-smoke bench clean
 
 all: heat
 
@@ -63,6 +64,24 @@ telemetry-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
 	    .telemetry_smoke/metrics.jsonl --json
 	rm -rf .telemetry_smoke
+
+# observability pipeline smoke (CPU): a run with --metrics +
+# --heartbeat + --diag-interval, then the live monitor (--once) and the
+# report tool must both render it and exit 0
+monitor-smoke:
+	rm -rf .monitor_smoke && mkdir -p .monitor_smoke
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 32 --ny 32 \
+	    --steps 2000 --converge --eps 1e-3 --check-interval 20 \
+	    --backend jnp --diag-interval 100 \
+	    --checkpoint .monitor_smoke/ck --checkpoint-every 200 \
+	    --metrics .monitor_smoke/metrics.jsonl \
+	    --heartbeat .monitor_smoke/heartbeat.json --quiet
+	JAX_PLATFORMS=cpu $(PY) tools/monitor.py --once \
+	    --heartbeat .monitor_smoke/heartbeat.json \
+	    --metrics .monitor_smoke/metrics.jsonl
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
+	    .monitor_smoke/metrics.jsonl --json
+	rm -rf .monitor_smoke
 
 bench:
 	$(PY) bench.py

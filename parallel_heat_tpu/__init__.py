@@ -22,6 +22,7 @@ from parallel_heat_tpu.config import HeatConfig
 from parallel_heat_tpu.solver import (
     HeatResult,
     grid_all_finite,
+    grid_stats,
     make_initial_grid,
     solve,
     solve_stream,
@@ -46,6 +47,7 @@ __all__ = [
     "solve_stream",
     "make_initial_grid",
     "grid_all_finite",
+    "grid_stats",
     "run_supervised",
     "SupervisorPolicy",
     "SupervisorResult",

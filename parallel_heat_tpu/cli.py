@@ -104,6 +104,28 @@ def build_parser() -> argparse.ArgumentParser:
                          "warn on a trip; --supervise rolls back and "
                          "retries. Default: off unsupervised, every "
                          "checkpoint under --supervise")
+    ap.add_argument("--diag-interval", type=int, default=None,
+                    metavar="N",
+                    help="steps between fused on-device grid-stats "
+                         "samples (min/max/total heat content, L2/L-inf "
+                         "update residual — observation-only like the "
+                         "guard, never changes numerics). Emitted as "
+                         "'diagnostics' telemetry events when --metrics "
+                         "is set; watch live with tools/monitor.py")
+    ap.add_argument("--stall-windows", type=int, default=None,
+                    metavar="K",
+                    help="supervised converge runs: classify the run "
+                         "STALLED (permanent failure, kind 'stalled') "
+                         "after K consecutive chunk residuals without "
+                         "a new minimum — catches eps set below the "
+                         "dtype's reachable precision floor")
+    ap.add_argument("--drift-tolerance", type=float, default=None,
+                    metavar="F",
+                    help="supervised runs: trip the progress guard "
+                         "when grid min/max/heat content escapes the "
+                         "initial envelope by more than fraction F "
+                         "(maximum principle — catches finite "
+                         "corruption the NaN guard is blind to)")
     ap.add_argument("--max-retries", type=int, default=3, metavar="N",
                     help="supervisor rollback-retry budget for "
                          "transient faults (guard trips, retryable "
@@ -131,8 +153,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "run's")
     ap.add_argument("--heartbeat", default=None, metavar="FILE",
                     help="atomically rewrite FILE with a small liveness "
-                         "JSON document on every telemetry event, for "
-                         "external probes of supervised runs")
+                         "JSON document ({step, last_event, residual, "
+                         "...}) on every telemetry event, for external "
+                         "probes of supervised runs")
+    ap.add_argument("--monitor-hint", action="store_true",
+                    help="print the tools/monitor.py invocation that "
+                         "watches this run's --heartbeat/--metrics "
+                         "files (also rides the printed resume "
+                         "command of supervised runs)")
     ap.add_argument("--explain", action="store_true",
                     help="print the resolved execution path (backend, "
                          "kernel pick, mesh) and exit without running")
@@ -204,6 +232,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backend=args.backend, mesh_shape=mesh_shape,
         overlap=not args.no_overlap, halo_depth=halo_depth,
         accumulate=args.accumulate, guard_interval=args.guard_interval,
+        diag_interval=args.diag_interval,
     )
     try:
         config.validate()
@@ -238,6 +267,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.max_retries < 0:
         print(f"error: --max-retries must be >= 0, got "
               f"{args.max_retries}", file=sys.stderr)
+        return 2
+    if (args.stall_windows is not None
+            or args.drift_tolerance is not None) and not args.supervise:
+        print("error: --stall-windows/--drift-tolerance configure the "
+              "supervisor's progress guard and require --supervise",
+              file=sys.stderr)
+        return 2
+    if args.stall_windows is not None and not args.converge:
+        # The stall classifier reads chunk residuals, which only
+        # converge mode computes — accepting the flag on a fixed-step
+        # run would leave the guard silently inert.
+        print("error: --stall-windows classifies residual stalls and "
+              "requires --converge (fixed-step runs compute no "
+              "residual to classify)", file=sys.stderr)
+        return 2
+    if args.monitor_hint and not (args.metrics or args.heartbeat):
+        print("error: --monitor-hint requires --metrics and/or "
+              "--heartbeat (the files the monitor watches)",
+              file=sys.stderr)
         return 2
     if args.resume == "auto" and not args.checkpoint:
         print("error: --resume auto requires --checkpoint (the stem "
@@ -295,6 +343,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # segment's numbering (the supervisor re-sets this per rollback
         # segment itself).
         telemetry.step_offset = start_step
+        if args.monitor_hint:
+            import shlex
+
+            hint = ["python", "tools/monitor.py"]
+            if args.heartbeat:
+                # The sink may have sharded the paths (.pN suffix on
+                # multi-process runs) — point the monitor at the files
+                # actually written.
+                hint += ["--heartbeat", telemetry.heartbeat_path]
+            if args.metrics:
+                hint += ["--metrics", telemetry.path]
+            # Quote each token (paths with spaces) so the printed line
+            # survives a copy-paste, like the supervisor's resume
+            # command does. print, not say: the flag is an explicit
+            # request for this one line, and --quiet must not swallow
+            # it (scripted launches pair exactly these two flags).
+            print("Monitor with: " + " ".join(shlex.quote(t)
+                                              for t in hint))
 
     sup_state = {}
 
@@ -320,6 +386,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 guard_interval=args.guard_interval,
                 max_retries=args.max_retries,
                 layout=args.checkpoint_layout,
+                stall_windows=args.stall_windows,
+                drift_tolerance=args.drift_tolerance,
             )
             # Flags the resumed invocation must repeat to deliver what
             # this one promised. NOT --initial-out: the t=0 grid was
@@ -335,6 +403,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 extra += ["--metrics", args.metrics]
             if args.heartbeat:
                 extra += ["--heartbeat", args.heartbeat]
+            if args.monitor_hint:
+                extra += ["--monitor-hint"]
             if args.quiet:
                 extra += ["--quiet"]
             sres = run_supervised(config, args.checkpoint, policy=policy,

@@ -139,6 +139,22 @@ class HeatConfig:
     # on top of the same check.
     guard_interval: Optional[int] = None
 
+    # In-run numerics diagnostics (SEMANTICS.md "Runtime guard"): steps
+    # between fused on-device grid-stats samples (min, max, total heat
+    # content, L2/L-inf update residual — `solver.grid_stats`). None
+    # (default) = off. When set, `solve_stream` samples at the first
+    # chunk boundary at-or-after each multiple of `diag_interval` (and
+    # at the final chunk), attaches the sample to
+    # `HeatResult.diagnostics`, and emits a `diagnostics` telemetry
+    # event when a sink is attached; `solve` samples the final grid
+    # once. Observation-only, exactly like the guard: the reduction
+    # reads between dispatches, never writes, and `diag_interval` is
+    # stripped from the compiled-program cache keys, so enabled runs
+    # share (and are bitwise) the undiagnosed executables. Cost: the
+    # fused reduction per sample plus ONE retained grid copy (the
+    # previous sample, the update-residual baseline).
+    diag_interval: Optional[int] = None
+
     # --- derived helpers -------------------------------------------------
 
     @property
@@ -299,6 +315,11 @@ class HeatConfig:
             raise ValueError(
                 f"guard_interval must be >= 1 (or None to disable the "
                 f"runtime guard), got {self.guard_interval}"
+            )
+        if self.diag_interval is not None and self.diag_interval < 1:
+            raise ValueError(
+                f"diag_interval must be >= 1 (or None to disable grid "
+                f"diagnostics), got {self.diag_interval}"
             )
         if self.accumulate not in ("storage", "f32chunk"):
             raise ValueError(

@@ -65,6 +65,11 @@ class HeatResult:
     # when no check ran (guard disabled, or this stream chunk fell
     # between guard boundaries). Observation-only — see SEMANTICS.md.
     finite: Optional[bool] = None
+    # Grid-stats sample (``HeatConfig.diag_interval``): the
+    # :func:`grid_stats` dict (min/max/heat/update_l2/update_linf plus
+    # ``step``/``steps_since``) when a diagnostics sample ran on this
+    # result's grid, None otherwise. Observation-only, like ``finite``.
+    diagnostics: Optional[dict] = None
 
     def to_numpy(self) -> np.ndarray:
         """Gather the (possibly sharded) final grid to host memory."""
@@ -490,6 +495,10 @@ def explain(config: HeatConfig) -> dict:
     if config.guard_interval is not None:
         out["guard"] = (f"isfinite-all every {config.guard_interval} "
                         f"steps (observation-only)")
+    if config.diag_interval is not None:
+        out["diagnostics"] = (f"fused grid stats every "
+                              f"{config.diag_interval} steps "
+                              f"(observation-only)")
     if is_sharded:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
@@ -759,6 +768,55 @@ def grid_all_finite(grid) -> bool:
         return bool(_all_finite(grid))
 
 
+@jax.jit
+def _grid_stats_solo(u):
+    # The diagnostics reduction without an update baseline: min, max and
+    # total heat content in ONE fused pass (XLA fuses the three
+    # reductions into a single read of the grid — the same fusion shape
+    # as the guard's `_all_finite`). Sub-f32 storage accumulates the sum
+    # in f32; f32/f64 accumulate natively.
+    acc = u if jnp.dtype(u.dtype).itemsize >= 4 else u.astype(jnp.float32)
+    return jnp.min(u), jnp.max(u), jnp.sum(acc)
+
+
+@jax.jit
+def _grid_stats_delta(u, prev):
+    # Full diagnostics pass: grid extrema + heat content + L2/L-inf of
+    # the update since the previous sample, one fused read of both
+    # buffers. Like `_all_finite`, a sharded input reduces on device
+    # under GSPMD and returns replicated scalars — no gather.
+    acc = u if jnp.dtype(u.dtype).itemsize >= 4 else u.astype(jnp.float32)
+    d = (u.astype(acc.dtype) - prev.astype(acc.dtype))
+    return (jnp.min(u), jnp.max(u), jnp.sum(acc),
+            jnp.sqrt(jnp.sum(d * d)), jnp.max(jnp.abs(d)))
+
+
+def grid_stats(grid, prev=None) -> dict:
+    """Fused on-device grid diagnostics: ``min``, ``max``, ``heat``
+    (total heat content, the conserved-quantity-style observable), and
+    — when ``prev`` (an earlier grid of the same shape) is given —
+    ``update_l2``/``update_linf``, the norms of the change since
+    ``prev``.
+
+    Observation-only, exactly like :func:`grid_all_finite`: one fused
+    reduction pass, reads only (no donation, no writes), never part of
+    any compiled step program. Used by :func:`solve_stream` /
+    :func:`solve` under ``HeatConfig.diag_interval`` and by the
+    supervisor's progress guard (stall/drift classification). The
+    TraceAnnotation brackets the host-side dispatch+wait so profiler
+    timelines show diagnostics as a named phase.
+    """
+    with jax.profiler.TraceAnnotation("heat:diag"):
+        if prev is None:
+            mn, mx, heat = _grid_stats_solo(grid)
+            l2 = linf = None
+        else:
+            mn, mx, heat, l2, linf = _grid_stats_delta(grid, prev)
+            l2, linf = float(l2), float(linf)
+        return {"min": float(mn), "max": float(mx), "heat": float(heat),
+                "update_l2": l2, "update_linf": linf}
+
+
 def _warn_guard_tripped(step: int) -> None:
     """The fixed-step analog of :func:`_warn_if_diverged`: the runtime
     guard found non-finite values, so every step from the first bad one
@@ -803,22 +861,29 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
 
     ``telemetry`` (a :class:`utils.telemetry.Telemetry`) receives a
     ``run_header`` event plus one ``chunk`` event per yield (steps,
-    chunk wall time, throughput, residual, guard verdict). Pure
-    host-side observation between dispatches: the compiled programs,
-    their cache keys, and the yielded results are identical with or
-    without a sink (pinned by ``tests/test_telemetry.py``).
+    chunk wall time, throughput, residual, guard verdict), and — when
+    ``config.diag_interval`` is set — a ``diagnostics`` event per
+    sample (:func:`grid_stats` at the first chunk boundary at-or-after
+    each interval multiple, plus the final chunk; the sample also
+    rides ``HeatResult.diagnostics``). Pure host-side observation
+    between dispatches: the compiled programs, their cache keys, and
+    the yielded results are identical with or without a sink or a
+    diag interval (pinned by ``tests/test_telemetry.py`` /
+    ``tests/test_diagnostics.py``).
 
     Consume each yielded grid (e.g. ``np.asarray`` / checkpoint) before
     advancing the generator: the next chunk donates that buffer to XLA.
     """
     config = config.validate()
     guard_interval = config.guard_interval
-    if guard_interval is not None:
-        # The guard is observation-only and never part of the compiled
-        # step program: strip it so the runner/executable caches key on
-        # the guard-free config — a guarded run reuses (and can never
-        # diverge from) the unguarded run's compiled programs.
-        config = config.replace(guard_interval=None)
+    diag_interval = config.diag_interval
+    if guard_interval is not None or diag_interval is not None:
+        # The guard and diagnostics are observation-only and never part
+        # of the compiled step program: strip them so the runner/
+        # executable caches key on the observer-free config — an
+        # instrumented run reuses (and can never diverge from) the
+        # plain run's compiled programs.
+        config = config.replace(guard_interval=None, diag_interval=None)
     if chunk_steps is not None and chunk_steps < 1:
         raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
     total = config.steps
@@ -845,6 +910,14 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     done = 0
     elapsed = 0.0
     next_guard = guard_interval if guard_interval is not None else None
+    next_diag = diag_interval if diag_interval is not None else None
+    if next_diag is not None:
+        # The update-residual baseline: a COPY of the initial state (the
+        # first chunk donates `u` itself). This is the one grid-sized
+        # cost diagnostics carries; samples between boundaries pay only
+        # the fused reduction.
+        prev_diag = jnp.copy(u)
+        prev_diag_step = 0
     while done < total:
         c = min(chunk, total - done)
         ccfg = config.replace(steps=c)
@@ -882,14 +955,29 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                 next_guard += guard_interval
             if not finite:
                 _warn_guard_tripped(done)
+        diag: Optional[dict] = None
+        if next_diag is not None and (done >= next_diag or is_last):
+            # Same boundary rule as the guard: the first chunk boundary
+            # at-or-after each diag_interval multiple, plus the final
+            # chunk (a short stream must not end unsampled).
+            diag = grid_stats(grid, prev=prev_diag)
+            diag["step"] = done
+            diag["steps_since"] = done - prev_diag_step
+            prev_diag = jnp.copy(grid)  # next baseline (grid is donated)
+            prev_diag_step = done
+            while next_diag <= done:
+                next_diag += diag_interval
         if telemetry is not None:
             telemetry.chunk(step=done, steps=k, wall_s=chunk_wall,
                             cells=cells, bytes_per_cell=bytes_per_cell,
                             residual=out_res, converged=out_conv,
                             finite=finite)
+            if diag is not None:
+                telemetry.diagnostics(
+                    **{**diag, "step": done})
         yield HeatResult(grid=grid, steps_run=done, converged=out_conv,
                          residual=out_res, elapsed_s=elapsed,
-                         finite=finite)
+                         finite=finite, diagnostics=diag)
         if config.converge and out_conv:
             return
         if k < c:  # defensive: a chunk that under-ran without converging
@@ -913,17 +1001,23 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
 
     config = config.validate()
     guard_interval = config.guard_interval
-    if guard_interval is not None:
+    diag_interval = config.diag_interval
+    if guard_interval is not None or diag_interval is not None:
         # solve is ONE compiled dispatch — there is no intermediate
-        # boundary to observe, so the guard degrades to a single
-        # end-of-run check (use solve_stream or the supervisor for
-        # within-run detection). Stripped from the config so compiled
-        # programs are shared with (and bitwise identical to) unguarded
-        # runs.
-        config = config.replace(guard_interval=None)
+        # boundary to observe, so the guard and diagnostics degrade to a
+        # single end-of-run check/sample (use solve_stream or the
+        # supervisor for within-run detection). Stripped from the config
+        # so compiled programs are shared with (and bitwise identical
+        # to) uninstrumented runs.
+        config = config.replace(guard_interval=None, diag_interval=None)
     runner, _ = _build_runner(config)
     initial = _prepare_initial(config, initial)
     compiled = _compiled_for(runner, config, initial)
+    diag_baseline = None
+    if diag_interval is not None:
+        # The runner donates `initial`; keep a copy as the end-of-run
+        # update-residual baseline (initial -> final change).
+        diag_baseline = jnp.copy(initial)
 
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation("heat:solve"):
@@ -953,5 +1047,11 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
         finite = grid_all_finite(grid)
         if not finite:
             _warn_guard_tripped(steps_run)
+    diag: Optional[dict] = None
+    if diag_interval is not None:
+        diag = grid_stats(grid, prev=diag_baseline)
+        diag["step"] = steps_run
+        diag["steps_since"] = steps_run
     return HeatResult(grid=grid, steps_run=steps_run, converged=conv,
-                      residual=res, elapsed_s=elapsed, finite=finite)
+                      residual=res, elapsed_s=elapsed, finite=finite,
+                      diagnostics=diag)

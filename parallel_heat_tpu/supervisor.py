@@ -26,7 +26,18 @@ wires that shape around :func:`solver.solve_stream`:
   error rolls back to the newest retained generation and retries with
   bounded exponential backoff; deterministic failures (stability-bound
   violation) and exhausted budgets raise :class:`PermanentFailure`
-  with a diagnosis naming the first bad chunk.
+  with a diagnosis naming the first bad chunk;
+- **progress guard** (:class:`SupervisorPolicy` ``stall_windows`` /
+  ``drift_tolerance``): the failure modes the NaN guard cannot see.
+  A converge run whose residual sets no new minimum across K chunk
+  windows is classified STALLED (``PermanentFailure(kind="stalled")``
+  — replaying a deterministic plateau cannot help; the classic cause
+  is eps below the storage dtype's reachable floor). A grid whose
+  min/max/total-heat-content escapes the initial envelope (the
+  explicit scheme's maximum principle) trips a retryable ``drift``
+  rollback — finite corruption, the isfinite-invisible analog of a
+  NaN trip. Both ride :func:`solver.grid_stats`, the same fused
+  observation-only reduction ``HeatConfig.diag_interval`` samples.
 
 Everything here is observation + orchestration on the host side of
 chunk boundaries: the compiled simulation programs are bit-for-bit the
@@ -50,6 +61,7 @@ from parallel_heat_tpu.solver import (
     HeatResult,
     _prepare_initial,
     grid_all_finite,
+    grid_stats,
     solve_stream,
 )
 from parallel_heat_tpu.utils import checkpoint as ckpt
@@ -67,21 +79,29 @@ EXIT_PERMANENT_FAILURE = 4
 
 class PermanentFailure(RuntimeError):
     """A failure retrying cannot fix; ``.diagnosis`` says what, where,
-    and what to do about it."""
+    and what to do about it. ``.kind`` classifies the verdict:
+    ``"unstable"`` (stability-bound violation), ``"stalled"`` (the
+    progress guard: residual stopped improving in converge mode),
+    ``"drift"`` (heat-content drift persisted through retries),
+    ``"exhausted"`` (retry budget spent on a recurring fault)."""
 
-    def __init__(self, diagnosis: str):
+    def __init__(self, diagnosis: str, kind: str = "exhausted"):
         super().__init__(diagnosis)
         self.diagnosis = diagnosis
+        self.kind = kind
 
 
 class _GuardTrip(Exception):
-    """Internal: the non-finite guard fired. ``window`` is the
+    """Internal: a runtime guard fired. ``window`` is the
     (last_known_good_step, detected_step] chunk the corruption landed
-    in."""
+    in; ``kind`` is ``"nan"`` (the isfinite guard) or ``"drift"`` (the
+    progress guard's heat-content envelope — finite but unphysical
+    values the NaN guard is blind to)."""
 
-    def __init__(self, window: Tuple[int, int]):
-        super().__init__(f"guard tripped in steps {window}")
+    def __init__(self, window: Tuple[int, int], kind: str = "nan"):
+        super().__init__(f"{kind} guard tripped in steps {window}")
         self.window = window
+        self.kind = kind
 
 
 @dataclass
@@ -109,6 +129,27 @@ class SupervisorPolicy:
     # Checkpoint layout / compression, passed through to save_generation.
     layout: str = "auto"
     compress: bool = False
+    # Progress guard, converge mode: classify the run as STALLED (a
+    # PermanentFailure with kind="stalled" — retrying a deterministic
+    # plateau cannot help) after this many consecutive chunk residual
+    # observations without a new minimum. None = off. The classic
+    # pathology: eps set below the storage dtype's reachable floor, the
+    # iteration enters a rounding limit cycle and burns its whole step
+    # budget at a flat residual (observed: f32 plateaus at 2^-15 against
+    # eps=1e-6).
+    stall_windows: Optional[int] = None
+    # Progress guard, any mode: tolerance of the two physics bounds
+    # checked at guard boundaries with the same fused stats reduction
+    # diagnostics use — (1) grid extrema confined to the initial
+    # envelope (maximum principle: with sum(c) <= 1/2 every update is
+    # a convex combination, so values can never leave the
+    # initial+boundary range), and (2) total heat content changing no
+    # faster than the boundary-flux rate bound (region-scale
+    # corruption inside the envelope still jumps heat unphysically).
+    # A violation means corruption or a boundary bug the isfinite
+    # guard cannot see; it is a retryable guard trip with
+    # kind="drift". None = off.
+    drift_tolerance: Optional[float] = None
 
     def validate(self) -> "SupervisorPolicy":
         if self.checkpoint_every < 1:
@@ -123,6 +164,14 @@ class SupervisorPolicy:
         if self.max_retries < 0:
             raise ValueError(f"max_retries must be >= 0, got "
                              f"{self.max_retries}")
+        if self.stall_windows is not None and self.stall_windows < 1:
+            raise ValueError(f"stall_windows must be >= 1 (or None to "
+                             f"disable the stall classifier), got "
+                             f"{self.stall_windows}")
+        if self.drift_tolerance is not None and self.drift_tolerance < 0:
+            raise ValueError(f"drift_tolerance must be >= 0 (or None to "
+                             f"disable the drift guard), got "
+                             f"{self.drift_tolerance}")
         return self
 
 
@@ -151,6 +200,9 @@ class SupervisorResult:
     # Signal name when interrupted ("SIGTERM"/"SIGINT"), else None.
     signal_name: Optional[str] = None
     wall_s: float = 0.0
+    # Progress-guard trips (stall classifications + drift detections)
+    # observed by this invocation.
+    progress_trips: int = 0
 
 
 class _StopFlag:
@@ -240,6 +292,12 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
               f"--max-retries {policy.max_retries}"]
     if policy.guard_interval is not None:
         parts.append(f"--guard-interval {policy.guard_interval}")
+    if config.diag_interval is not None:
+        parts.append(f"--diag-interval {config.diag_interval}")
+    if policy.stall_windows is not None:
+        parts.append(f"--stall-windows {policy.stall_windows}")
+    if policy.drift_tolerance is not None:
+        parts.append(f"--drift-tolerance {policy.drift_tolerance:g}")
     if policy.layout != "auto":
         parts.append(f"--checkpoint-layout {policy.layout}")
     # Caller flags may carry paths ("--out", "my out.npy"): quote each
@@ -324,7 +382,7 @@ def run_supervised(config: HeatConfig, checkpoint,
     stem = ckpt.checkpoint_stem(checkpoint)
     ckpt_cfg = config.replace(steps=total_abs)  # self-describing target
 
-    retries = rollbacks = trips = n_ckpt = 0
+    retries = rollbacks = trips = n_ckpt = progress = 0
     trip_steps: list = []
     trip_windows: list = []
     last_path: Optional[str] = None
@@ -337,21 +395,21 @@ def run_supervised(config: HeatConfig, checkpoint,
             guard_trip_steps=tuple(trip_steps),
             checkpoints_written=n_ckpt, last_checkpoint=last_path,
             resume_command=resume_cmd, signal_name=signame,
-            wall_s=time.perf_counter() - t0)
+            wall_s=time.perf_counter() - t0, progress_trips=progress)
 
     def emit(event, **fields):
         if telemetry is not None:
             telemetry.emit(event, **fields)
 
-    def fail(diagnosis: str) -> PermanentFailure:
-        emit("permanent_failure", diagnosis=diagnosis)
+    def fail(diagnosis: str, kind: str = "exhausted") -> PermanentFailure:
+        emit("permanent_failure", diagnosis=diagnosis, kind=kind)
         if telemetry is not None:
-            telemetry.run_end(outcome="permanent_failure",
+            telemetry.run_end(outcome="permanent_failure", kind=kind,
                               steps_done=done, retries=retries,
                               rollbacks=rollbacks, guard_trips=trips,
                               checkpoints_written=n_ckpt,
                               wall_s=time.perf_counter() - t0)
-        return PermanentFailure(diagnosis)
+        return PermanentFailure(diagnosis, kind=kind)
 
     def save(grid, step_abs):
         nonlocal n_ckpt, last_path
@@ -401,11 +459,80 @@ def run_supervised(config: HeatConfig, checkpoint,
     stop = _StopFlag()
     final: Optional[HeatResult] = None
 
+    drift_env = None
+    if policy.drift_tolerance is not None:
+        # The drift envelope comes from the START state via the same
+        # fused stats reduction diagnostics use. Two independent
+        # physics bounds, both invisible to the isfinite guard:
+        #
+        # 1. Extrema: the explicit scheme's maximum principle
+        #    (sum(c) <= 1/2 makes every update a convex combination of
+        #    neighbors) confines all future values to the initial
+        #    range — a bit flip into a huge-but-finite float escapes.
+        # 2. Heat-content RATE: total interior heat changes only by
+        #    flux through the Dirichlet boundary; telescoping the
+        #    update sum leaves two value-differences per boundary
+        #    column/face, each bounded by the initial range, so
+        #    |d(heat)/step| <= 2 * range0 * sum_a(c_a * interior face
+        #    area normal to axis a). Region-scale corruption that
+        #    stays inside the extrema envelope (half the grid zeroed
+        #    by a buggy exchange) jumps the heat faster than any
+        #    physical boundary flux can. (A bound on heat's LEVEL
+        #    would be implied by the extrema check — the rate bound is
+        #    the one that adds information.)
+        from parallel_heat_tpu.utils import profiling
+
+        s0 = grid_stats(state)
+        cells = profiling.cell_count(config)
+        range0 = s0["max"] - s0["min"]
+        scale = max(range0, abs(s0["max"]), abs(s0["min"]), 1e-30)
+        band = policy.drift_tolerance * scale
+        interior = [max(n - 2, 0) for n in config.shape]
+        flux = 0.0
+        for a, c in enumerate(config.coefficients):
+            face = 1.0
+            for b, m in enumerate(interior):
+                if b != a:
+                    face *= m
+            flux += abs(c) * face
+        drift_env = {"min": s0["min"] - band, "max": s0["max"] + band,
+                     "flux_per_step": 2.0 * range0 * flux,
+                     # Absolute slack: f32 sum rounding + tolerance,
+                     # scaled to the grid (a zero-slack bound would
+                     # flag accumulation noise on large grids).
+                     "slack": policy.drift_tolerance * cells * scale}
+
+    def _drift_violation(st, prev_heat, steps_between) -> Optional[str]:
+        if st["min"] < drift_env["min"] or st["max"] > drift_env["max"]:
+            return (f"grid range [{st['min']:g}, {st['max']:g}] escaped "
+                    f"the initial envelope [{drift_env['min']:g}, "
+                    f"{drift_env['max']:g}] (maximum principle)")
+        if prev_heat is not None and steps_between > 0:
+            limit = (drift_env["flux_per_step"] * steps_between
+                     + drift_env["slack"])
+            moved = st["heat"] - prev_heat
+            if abs(moved) > limit:
+                return (f"total heat content moved {moved:+g} over "
+                        f"{steps_between} steps, past the boundary-flux "
+                        f"bound {limit:g} "
+                        f"({drift_env['flux_per_step']:g}/step + slack)")
+        return None
+
     with _signal_handlers(stop):
         save(state, done)
         while done < total_abs and final is None:
             seg_base = done
             last_guarded = done  # guard-verified (or checkpoint-loaded)
+            # Stall tracker, reset per segment: a rollback replays from
+            # a verified state, so the residual trajectory restarts.
+            best_res = math.inf
+            stall_run = 0
+            stall_from = seg_base
+            # Heat-rate baseline, reset per segment (a rollback reloads
+            # verified state; its heat restarts the rate window).
+            if drift_env is not None:
+                seg_heat = grid_stats(state)["heat"]
+                seg_heat_step = done
             if telemetry is not None:
                 # Chunk events carry absolute steps: the stream counts
                 # from its own start, each segment's base is added here.
@@ -450,7 +577,68 @@ def run_supervised(config: HeatConfig, checkpoint,
                             emit("guard_trip", step=step_abs,
                                  window=[last_guarded, step_abs])
                             raise _GuardTrip((last_guarded, step_abs))
+                        if drift_env is not None:
+                            # Reuse the chunk's own diagnostics sample
+                            # when it exists (cur IS res.grid whenever
+                            # no fault plan rewrote it) — no second
+                            # full-grid sweep at shared boundaries.
+                            st = (res.diagnostics
+                                  if faults is None
+                                  and res.diagnostics is not None
+                                  else grid_stats(cur))
+                            why = _drift_violation(
+                                st, seg_heat, step_abs - seg_heat_step)
+                            if why is not None:
+                                progress += 1
+                                emit("progress_trip", kind="drift",
+                                     step=step_abs,
+                                     window=[last_guarded, step_abs],
+                                     detail=why)
+                                raise _GuardTrip(
+                                    (last_guarded, step_abs),
+                                    kind="drift")
+                            seg_heat = st["heat"]
+                            seg_heat_step = step_abs
                         last_guarded = step_abs
+                    if (policy.stall_windows is not None
+                            and config.converge
+                            and res.residual is not None
+                            and not res.converged):
+                        # Progress guard, stall classifier: a new
+                        # residual minimum resets the window count; K
+                        # consecutive observations without one is a
+                        # plateau retrying cannot fix (the same program
+                        # replays the same residuals).
+                        if (math.isfinite(res.residual)
+                                and res.residual < best_res):
+                            best_res = res.residual
+                            stall_run = 0
+                            stall_from = step_abs
+                        else:
+                            stall_run += 1
+                            if stall_run >= policy.stall_windows:
+                                progress += 1
+                                emit("progress_trip", kind="stalled",
+                                     step=step_abs,
+                                     window=[stall_from, step_abs],
+                                     windows=stall_run,
+                                     residual=res.residual,
+                                     best_residual=best_res,
+                                     eps=config.eps)
+                                raise fail(
+                                    f"progress guard: residual stalled "
+                                    f"at {res.residual:g} (best "
+                                    f"{best_res:g}, eps {config.eps:g})"
+                                    f" — no new minimum across "
+                                    f"{stall_run} consecutive windows, "
+                                    f"steps ({stall_from}, {step_abs}]."
+                                    f" The iteration has hit its "
+                                    f"precision floor above eps; "
+                                    f"retrying replays the same "
+                                    f"plateau. Raise eps, use a wider "
+                                    f"dtype, or cap steps. Newest "
+                                    f"checkpoint: {last_path}.",
+                                    kind="stalled")
                     done = step_abs
                     if ckpt_due:
                         save(cur, step_abs)
@@ -472,7 +660,14 @@ def run_supervised(config: HeatConfig, checkpoint,
             except Exception as e:
                 if isinstance(e, _GuardTrip):
                     lo, hi = e.window
-                    if config.stability_margin() < 0:
+                    if e.kind == "drift":
+                        # Finite-value corruption: retryable (a flipped
+                        # bit replays clean); a boundary bug persists
+                        # and exhausts the budget into a drift-kind
+                        # PermanentFailure below.
+                        kind = (f"progress guard: heat-content drift "
+                                f"in steps ({lo}, {hi}]")
+                    elif config.stability_margin() < 0:
                         raise fail(
                             f"non-finite grid values in steps ({lo}, "
                             f"{hi}]: coefficient sum "
@@ -482,10 +677,12 @@ def run_supervised(config: HeatConfig, checkpoint,
                             f"explicit scheme diverges deterministically; "
                             f"retrying cannot help. Reduce the "
                             f"coefficients (cx/cy/cz) below a sum of "
-                            f"1/2. Last good checkpoint: step {lo}."
+                            f"1/2. Last good checkpoint: step {lo}.",
+                            kind="unstable",
                         ) from None
-                    kind = (f"guard trip: non-finite values in steps "
-                            f"({lo}, {hi}]")
+                    else:
+                        kind = (f"guard trip: non-finite values in "
+                                f"steps ({lo}, {hi}]")
                 elif _is_transient_dispatch_error(e):
                     kind = f"transient dispatch error: {e}"
                 else:
@@ -513,7 +710,10 @@ def run_supervised(config: HeatConfig, checkpoint,
                         f"{policy.max_retries} rollback retr"
                         f"{'y' if policy.max_retries == 1 else 'ies'}."
                         f"{first} Newest verified checkpoint: "
-                        f"{last_path}.") from None
+                        f"{last_path}.",
+                        kind=("drift" if isinstance(e, _GuardTrip)
+                              and e.kind == "drift" else "exhausted"),
+                    ) from None
                 delay = min(policy.backoff_max_s,
                             policy.backoff_base_s * 2 ** (retries - 1))
                 emit("retry", retry=retries,

@@ -53,6 +53,17 @@ class FaultPlan:
     # Corrupt one interior cell of the chunk output with NaN at the
     # first chunk boundary at-or-after this ABSOLUTE step count.
     nan_at_step: Optional[int] = None
+    # Corrupt with a FINITE spike value instead (the bad-HBM-read that
+    # lands on an exponent bit: huge but not NaN — invisible to the
+    # isfinite guard, caught by the progress guard's extrema envelope).
+    # Same firing rules as nan_at_step. `spike_region` > 1 corrupts a
+    # centered region x region interior block instead of one cell —
+    # the buggy-exchange model: values that stay INSIDE the extrema
+    # envelope but move total heat faster than any boundary flux can
+    # (caught by the progress guard's heat-rate bound).
+    spike_at_step: Optional[int] = None
+    spike_value: float = 1e12
+    spike_region: int = 1
     # False (default): the corruption is one-shot — a rolled-back retry
     # reruns clean (transient-fault model). True: re-fires every time
     # the step is re-reached (permanent-fault model).
@@ -68,6 +79,16 @@ class FaultPlan:
     # ordinal (once).
     signal_at_chunk: Optional[int] = None
     signum: int = int(_signal.SIGTERM)
+
+    def __post_init__(self):
+        if self.nan_at_step is not None and self.spike_at_step is not None:
+            # The two corruptions share the one-shot firing state and
+            # the injection site; allowing both would silently drop the
+            # spike (and a chaos cell would certify a drift detection
+            # that never ran). Loud, like every other plan error.
+            raise ValueError(
+                "FaultPlan: set nan_at_step or spike_at_step, not both "
+                "(they share the corruption slot; use two plans/runs)")
 
     # -- firing state (not part of the schedule) -------------------------
     _chunks_seen: int = field(default=0, repr=False)
@@ -108,8 +129,10 @@ class FaultPlan:
         detection that never happened. Deferring keeps the injection
         pending until the first boundary a guard actually inspects,
         preserving determinism: fires at the first GUARDED boundary
-        at-or-after ``nan_at_step``."""
-        if self.nan_at_step is None or step < self.nan_at_step:
+        at-or-after ``nan_at_step`` (or ``spike_at_step``)."""
+        at = (self.nan_at_step if self.nan_at_step is not None
+              else self.spike_at_step)
+        if at is None or step < at:
             return grid
         if not observed:
             return grid
@@ -119,5 +142,16 @@ class FaultPlan:
         import jax
         import jax.numpy as jnp
 
-        idx = tuple(1 for _ in grid.shape)
-        return jax.jit(lambda u: u.at[idx].set(jnp.nan))(grid)
+        value = (jnp.nan if self.nan_at_step is not None
+                 else self.spike_value)
+        if self.spike_at_step is not None and self.spike_region > 1:
+            # Centered interior block (the grid center carries the
+            # largest values, so an in-envelope overwrite there moves
+            # real heat).
+            idx = tuple(slice((n - self.spike_region) // 2,
+                              (n - self.spike_region) // 2
+                              + self.spike_region)
+                        for n in grid.shape)
+        else:
+            idx = tuple(1 for _ in grid.shape)
+        return jax.jit(lambda u: u.at[idx].set(value))(grid)

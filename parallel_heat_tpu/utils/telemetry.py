@@ -22,10 +22,18 @@ an envelope — ``schema``, ``event``, ``t_wall`` (unix seconds),
   ``mcells_steps_per_s``, ``hbm_gb_s`` via
   :class:`utils.profiling.StepStats`), ``residual``/``converged`` when
   converge-mode checks ran, the guard verdict ``finite``;
+- ``diagnostics``: fused grid-stats samples (``solver.grid_stats``
+  under ``HeatConfig.diag_interval``): ``min``/``max``/``heat``/
+  ``update_l2``/``update_linf`` + ``steps_since``;
 - ``checkpoint_save``: save latency + generation (rollback LOAD
   latency rides the ``rollback`` event as ``load_wall_s``);
-- supervisor lifecycle: ``guard_trip``, ``retry``, ``rollback``,
-  ``signal``, ``permanent_failure``, ``run_end``.
+- supervisor lifecycle: ``guard_trip``, ``progress_trip`` (residual
+  stall / heat-content drift), ``retry``, ``rollback``, ``signal``,
+  ``permanent_failure``, ``run_end``.
+
+The envelope also carries ``process_index``/``process_count``;
+multi-process runs shard the JSONL and heartbeat per process
+(:func:`shard_path`, ``.pN`` suffix) so hosts never interleave writes.
 
 The contract matches the runtime guard's (SEMANTICS.md "Runtime guard
 and supervisor"): telemetry OBSERVES, it never participates. No event
@@ -52,6 +60,50 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 
+def _process_info():
+    """(process_index, process_count) of this runtime, (0, 1) when jax
+    is unavailable or not yet set up. Deliberately side-effect-free:
+    ``jax.process_index()`` force-initializes the backend, and a sink
+    constructed before ``jax.distributed.initialize()`` must neither
+    break that later call nor lock in a single-process view it caused
+    itself — so the backend is queried only when ALREADY initialized,
+    with ``jax.distributed``'s coordination state as the pre-backend
+    source of truth."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "backends_are_initialized",
+                   lambda: False)():
+            return int(jax.process_index()), int(jax.process_count())
+        from jax._src import distributed
+
+        st = distributed.global_state
+        pi = getattr(st, "process_id", None)
+        pc = getattr(st, "num_processes", None)
+        if pi is not None and pc:
+            return int(pi), int(pc)
+    except Exception:  # noqa: BLE001 — observation-only
+        pass
+    return 0, 1
+
+
+def shard_path(path: str, process_index: int, process_count: int) -> str:
+    """Per-process sink path: ``runs/m.jsonl`` -> ``runs/m.p3.jsonl``
+    when ``process_count > 1`` (unchanged for single-process runs).
+
+    Multi-host runs must never interleave appends into one file — JSONL
+    has no record framing beyond the newline, so concurrent writers
+    from different hosts tear each other's lines. Each process writes
+    its own shard; ``tools/metrics_report.py`` accepts a glob
+    (``runs/m*.jsonl``) and merges shards by ``t_mono``.
+    """
+    if process_count <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{process_index}{ext}"
+
+
 class Telemetry:
     """Append-only JSONL event sink + optional heartbeat file.
 
@@ -69,10 +121,26 @@ class Telemetry:
     """
 
     def __init__(self, path=None, heartbeat=None,
-                 heartbeat_interval_s: float = 0.0):
-        self.path = str(path) if path is not None else None
-        self.heartbeat_path = (str(heartbeat) if heartbeat is not None
-                               else None)
+                 heartbeat_interval_s: float = 0.0,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        if process_index is None or process_count is None:
+            pi, pc = _process_info()
+            process_index = pi if process_index is None else process_index
+            process_count = pc if process_count is None else process_count
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        # Multi-process runs shard both sinks per process (JSONL appends
+        # from several hosts would tear each other's lines; concurrent
+        # heartbeat renames would flap between processes' views).
+        if path is not None:
+            path = shard_path(str(path), self.process_index,
+                              self.process_count)
+        if heartbeat is not None:
+            heartbeat = shard_path(str(heartbeat), self.process_index,
+                                   self.process_count)
+        self.path = path
+        self.heartbeat_path = heartbeat
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         for p in (self.path, self.heartbeat_path):
             # Parent dirs are created like the checkpoint writer's
@@ -86,6 +154,7 @@ class Telemetry:
         self._events = 0
         self._last_event: Optional[str] = None
         self._last_step: Optional[int] = None
+        self._last_residual: Optional[float] = None
         self._last_heartbeat_mono: Optional[float] = None
         # Absolute-step offset for chunk events: solve_stream counts
         # steps from its own start, the supervisor restarts streams on
@@ -102,7 +171,9 @@ class Telemetry:
         if self._dead:
             return
         rec = {"schema": SCHEMA_VERSION, "event": event,
-               "t_wall": time.time(), "t_mono": time.monotonic()}
+               "t_wall": time.time(), "t_mono": time.monotonic(),
+               "process_index": self.process_index,
+               "process_count": self.process_count}
         rec.update(fields)
         try:
             if self._f is not None:
@@ -117,6 +188,8 @@ class Telemetry:
         self._last_event = event
         if "step" in fields:
             self._last_step = fields["step"]
+        if fields.get("residual") is not None:
+            self._last_residual = fields["residual"]
         self._maybe_heartbeat(rec["t_mono"])
 
     def _maybe_heartbeat(self, t_mono: float) -> None:
@@ -134,9 +207,16 @@ class Telemetry:
         long host-side wait."""
         if self.heartbeat_path is None or self._dead:
             return
+        # `last_step`/`last_event`/`residual` make the heartbeat
+        # self-sufficient: an external liveness probe (or
+        # `tools/monitor.py --once`) can report progress without
+        # parsing the JSONL at all. `step` is kept as a legacy alias
+        # of `last_step`.
         doc = {"t_wall": time.time(), "t_mono": time.monotonic(),
                "pid": os.getpid(), "events": self._events,
-               "last_event": self._last_event, "step": self._last_step}
+               "last_event": self._last_event, "step": self._last_step,
+               "last_step": self._last_step,
+               "residual": self._last_residual}
         tmp = f"{self.heartbeat_path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -165,6 +245,13 @@ class Telemetry:
         import jax
 
         doc = {"config": json.loads(config.to_json()),
+               # The ABSOLUTE step target: a resumed run's config.steps
+               # counts only the REMAINING steps while chunk events
+               # carry absolute steps (step_offset was set before this
+               # header) — consumers (tools/monitor.py) must read the
+               # target from here, not from config.steps, or a resumed
+               # run's progress fraction exceeds 100%.
+               "steps_total": self.step_offset + config.steps,
                "schema_version": SCHEMA_VERSION,
                "jax_version": jax.__version__}
         try:
@@ -217,6 +304,14 @@ class Telemetry:
                   wall_s=wall_s, cells=cells,
                   bytes_per_cell=bytes_per_cell, residual=residual,
                   converged=converged, finite=finite, **rates)
+
+    def diagnostics(self, *, step: int, **stats) -> None:
+        """Emit one grid-diagnostics sample (``solver.grid_stats`` under
+        ``HeatConfig.diag_interval``): min/max/heat/update_l2/
+        update_linf plus ``steps_since`` (steps since the previous
+        sample). ``step`` is stream-relative; the supervisor's
+        ``step_offset`` is applied here, same as :meth:`chunk`."""
+        self.emit("diagnostics", step=self.step_offset + step, **stats)
 
     def run_end(self, *, outcome: str, **fields) -> None:
         """Terminal event: ``outcome`` is ``complete`` /

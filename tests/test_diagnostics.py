@@ -1,0 +1,348 @@
+"""In-run numerics diagnostics: the fused grid-stats reduction
+(`solver.grid_stats`, `HeatConfig.diag_interval`), the supervisor's
+progress guard (stall / drift classification), and the multi-process
+telemetry sharding — all under the guard's observation-only contract
+(SEMANTICS.md)."""
+
+import json
+import math
+import warnings
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import (
+    HeatConfig,
+    PermanentFailure,
+    SupervisorPolicy,
+    Telemetry,
+    grid_stats,
+    run_supervised,
+    solve,
+    solve_stream,
+)
+from parallel_heat_tpu.utils.faults import FaultPlan
+
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _stalling_initial(n=16):
+    """A hot-boundary start state whose converge run deterministically
+    stalls: the f32 iteration toward the nonzero steady state ends in a
+    rounding limit cycle with a flat residual (2^-15) above eps=1e-6 —
+    the exact 'eps below the reachable floor' pathology the progress
+    guard exists to classify."""
+    u0 = np.zeros((n, n), np.float32)
+    u0[0, :] = 1000.0
+    return u0
+
+
+_STALL_CFG = HeatConfig(steps=4000, converge=True, check_interval=10,
+                        eps=1e-6, **_BASE)
+
+
+# -- grid_stats ------------------------------------------------------------
+
+def test_grid_stats_matches_numpy():
+    rng = np.random.default_rng(7)
+    u = rng.normal(size=(24, 24)).astype(np.float32)
+    prev = rng.normal(size=(24, 24)).astype(np.float32)
+    s = grid_stats(u, prev=prev)
+    assert s["min"] == pytest.approx(u.min())
+    assert s["max"] == pytest.approx(u.max())
+    assert s["heat"] == pytest.approx(float(u.sum()), rel=1e-5)
+    d = u - prev
+    assert s["update_l2"] == pytest.approx(
+        float(np.sqrt((d * d).sum())), rel=1e-5)
+    assert s["update_linf"] == pytest.approx(float(np.abs(d).max()))
+    solo = grid_stats(u)
+    assert solo["update_l2"] is None and solo["update_linf"] is None
+    assert solo["min"] == s["min"] and solo["heat"] == s["heat"]
+
+
+def test_grid_stats_bf16_accumulates_f32():
+    # 256 cells of 1.0 in bf16: a bf16-accumulated sum would lose
+    # low-order adds (bf16 resolution at 256 is 2); the f32 accumulator
+    # must report the exact count.
+    import jax.numpy as jnp
+
+    u = jnp.ones((16, 16), jnp.bfloat16)
+    assert grid_stats(u)["heat"] == 256.0
+
+
+# -- stream sampling -------------------------------------------------------
+
+def test_stream_diag_sampling_schedule(tmp_path):
+    p = tmp_path / "t.jsonl"
+    cfg = HeatConfig(steps=50, diag_interval=20, **_BASE)
+    rs, grids = [], {}
+    with Telemetry(p) as tel:
+        for r in solve_stream(cfg, chunk_steps=10, telemetry=tel):
+            # consume each grid before advancing (the next chunk
+            # donates it)
+            grids[r.steps_run] = r.to_numpy()
+            rs.append(r)
+    # First boundary at-or-after 20, 40, plus the final chunk.
+    sampled = [r.steps_run for r in rs if r.diagnostics is not None]
+    assert sampled == [20, 40, 50]
+    diags = [e for e in _events(p) if e["event"] == "diagnostics"]
+    assert [d["step"] for d in diags] == [20, 40, 50]
+    assert [d["steps_since"] for d in diags] == [20, 20, 10]
+    # Stats agree with the yielded grids (the boundary grid IS the
+    # sampled grid), and the update norms are the diff between samples.
+    g20, g40 = grids[20], grids[40]
+    d = diags[1]
+    assert d["min"] == pytest.approx(g40.min())
+    assert d["max"] == pytest.approx(g40.max())
+    assert d["heat"] == pytest.approx(float(g40.sum()), rel=1e-5)
+    diff = g40 - g20
+    assert d["update_linf"] == pytest.approx(float(np.abs(diff).max()))
+    assert d["update_l2"] == pytest.approx(
+        float(np.sqrt((diff * diff).sum())), rel=1e-5)
+    # chunks without a sample carry None
+    assert all(r.diagnostics is None for r in rs
+               if r.steps_run not in sampled)
+
+
+def test_solve_samples_final_grid():
+    cfg = HeatConfig(steps=30, diag_interval=10, **_BASE)
+    r = solve(cfg)
+    assert r.diagnostics is not None
+    assert r.diagnostics["step"] == 30
+    g = r.to_numpy()
+    assert r.diagnostics["max"] == pytest.approx(g.max())
+    # the update baseline is the initial condition
+    assert r.diagnostics["update_linf"] > 0
+    assert solve(cfg.replace(diag_interval=None)).diagnostics is None
+
+
+def test_diag_is_observation_only():
+    # The acceptance contract: diag-enabled runs share compiled
+    # programs (no new _build_runner misses) and produce bitwise grids.
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(steps=30, **_BASE)
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=10)]
+    misses = solver._build_runner.cache_info().misses
+    diag = [r.to_numpy()
+            for r in solve_stream(cfg.replace(diag_interval=10),
+                                  chunk_steps=10)]
+    assert solver._build_runner.cache_info().misses == misses
+    for a, b in zip(plain, diag):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_explain_reports_diagnostics():
+    from parallel_heat_tpu.solver import explain
+
+    out = explain(HeatConfig(steps=10, diag_interval=25, **_BASE))
+    assert "every 25 steps" in out["diagnostics"]
+    assert "diagnostics" not in explain(HeatConfig(steps=10, **_BASE))
+
+
+def test_diag_interval_validation():
+    with pytest.raises(ValueError, match="diag_interval"):
+        HeatConfig(diag_interval=0, **_BASE).validate()
+
+
+# -- progress guard: stall -------------------------------------------------
+
+def test_supervisor_classifies_stall(tmp_path):
+    p = tmp_path / "t.jsonl"
+    policy = SupervisorPolicy(checkpoint_every=500, guard_interval=250,
+                              stall_windows=4, backoff_base_s=0.0)
+    with Telemetry(p) as tel:
+        with pytest.raises(PermanentFailure) as ei:
+            run_supervised(_STALL_CFG, tmp_path / "ck", policy=policy,
+                           initial=_stalling_initial(), telemetry=tel)
+    # Classified STALLED — not nan, not transient, no retry burned.
+    assert ei.value.kind == "stalled"
+    assert "residual stalled" in ei.value.diagnosis
+    assert "4 consecutive windows" in ei.value.diagnosis
+    ev = _events(p)
+    trip = next(e for e in ev if e["event"] == "progress_trip")
+    assert trip["kind"] == "stalled" and trip["windows"] == 4
+    lo, hi = trip["window"]
+    assert hi - lo == 4 * 250  # the stall window spans exactly K chunks
+    assert trip["residual"] == pytest.approx(2.0 ** -15)
+    assert not any(e["event"] in ("guard_trip", "retry") for e in ev)
+    end = ev[-1]
+    assert end["event"] == "run_end"
+    assert end["outcome"] == "permanent_failure"
+    assert end["kind"] == "stalled"
+
+
+def test_stall_classifier_stays_quiet_on_healthy_decay(tmp_path):
+    # A healthily converging run keeps setting new minima: the
+    # classifier must never fire, and the run must converge.
+    cfg = HeatConfig(steps=10_000, converge=True, check_interval=20,
+                     eps=1e-3, **_BASE)
+    policy = SupervisorPolicy(checkpoint_every=200, guard_interval=100,
+                              stall_windows=3, backoff_base_s=0.0)
+    sres = run_supervised(cfg, tmp_path / "ck", policy=policy)
+    assert sres.result.converged
+    assert sres.progress_trips == 0
+
+
+# -- progress guard: drift -------------------------------------------------
+
+def test_drift_trip_recovers_from_transient_spike(tmp_path):
+    p = tmp_path / "t.jsonl"
+    cfg = HeatConfig(steps=60, **_BASE)
+    policy = SupervisorPolicy(checkpoint_every=20, guard_interval=10,
+                              drift_tolerance=0.01, backoff_base_s=0.0)
+    with Telemetry(p) as tel:
+        sres = run_supervised(cfg, tmp_path / "ck", policy=policy,
+                              faults=FaultPlan(spike_at_step=35),
+                              telemetry=tel)
+    # One-shot finite corruption: the NaN guard is blind to it, the
+    # drift envelope catches it, rollback replays clean to completion.
+    assert sres.retries == 1 and sres.progress_trips == 1
+    assert sres.guard_trips == 0
+    assert sres.steps_done == 60
+    clean = solve(cfg)
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+    ev = _events(p)
+    trip = next(e for e in ev if e["event"] == "progress_trip")
+    assert trip["kind"] == "drift" and "envelope" in trip["detail"]
+    assert not any(e["event"] == "guard_trip" for e in ev)
+
+
+def test_drift_heat_rate_catches_in_envelope_corruption(tmp_path):
+    # Region-scale corruption that stays INSIDE the extrema envelope
+    # (a buggy exchange zeroing a block): invisible to both the NaN
+    # guard and the maximum-principle check, caught by the
+    # boundary-flux rate bound on total heat content.
+    p = tmp_path / "t.jsonl"
+    cfg = HeatConfig(steps=60, **_BASE)
+    policy = SupervisorPolicy(checkpoint_every=20, guard_interval=10,
+                              drift_tolerance=0.01, backoff_base_s=0.0)
+    # zero the central 13x13 block: all values remain in [min0, max0],
+    # but ~206k of heat vanishes in one 10-step window against a
+    # boundary-flux limit of ~184k
+    faults = FaultPlan(spike_at_step=35, spike_value=0.0,
+                       spike_region=13)
+    with Telemetry(p) as tel:
+        sres = run_supervised(cfg, tmp_path / "ck", policy=policy,
+                              faults=faults, telemetry=tel)
+    assert sres.progress_trips == 1 and sres.guard_trips == 0
+    assert sres.steps_done == 60
+    trip = next(e for e in _events(p)
+                if e["event"] == "progress_trip")
+    assert trip["kind"] == "drift"
+    assert "boundary-flux bound" in trip["detail"]
+
+
+def test_faultplan_rejects_nan_and_spike_together():
+    with pytest.raises(ValueError, match="not both"):
+        FaultPlan(nan_at_step=10, spike_at_step=30)
+
+
+def test_drift_recurring_halts_with_drift_kind(tmp_path):
+    cfg = HeatConfig(steps=60, **_BASE)
+    policy = SupervisorPolicy(checkpoint_every=20, guard_interval=10,
+                              drift_tolerance=0.01, max_retries=2,
+                              backoff_base_s=0.0)
+    with pytest.raises(PermanentFailure) as ei:
+        run_supervised(cfg, tmp_path / "ck", policy=policy,
+                       faults=FaultPlan(spike_at_step=35,
+                                        recurring=True))
+    assert ei.value.kind == "drift"
+    assert "heat-content drift" in ei.value.diagnosis
+
+
+def test_drift_guard_quiet_on_clean_run(tmp_path):
+    sres = run_supervised(
+        HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+        policy=SupervisorPolicy(checkpoint_every=20, guard_interval=10,
+                                drift_tolerance=0.01,
+                                backoff_base_s=0.0))
+    assert sres.progress_trips == 0 and sres.steps_done == 60
+
+
+def test_policy_validates_progress_knobs():
+    with pytest.raises(ValueError, match="stall_windows"):
+        SupervisorPolicy(stall_windows=0).validate()
+    with pytest.raises(ValueError, match="drift_tolerance"):
+        SupervisorPolicy(drift_tolerance=-0.1).validate()
+
+
+def test_cli_rejects_inert_progress_flags(tmp_path, capsys):
+    from parallel_heat_tpu.cli import main
+
+    # progress-guard flags without --supervise: loud error
+    assert main(["--nx", "16", "--ny", "16", "--steps", "10",
+                 "--stall-windows", "3"]) == 2
+    assert "--supervise" in capsys.readouterr().err
+    # --stall-windows on a fixed-step run would be silently inert
+    # (no residual to classify): loud error instead
+    assert main(["--nx", "16", "--ny", "16", "--steps", "10",
+                 "--supervise", "--checkpoint", str(tmp_path / "ck"),
+                 "--stall-windows", "3"]) == 2
+    assert "--converge" in capsys.readouterr().err
+    # --monitor-hint with nothing to monitor: loud error
+    assert main(["--nx", "16", "--ny", "16", "--steps", "10",
+                 "--monitor-hint"]) == 2
+    assert "--metrics" in capsys.readouterr().err
+
+
+def test_resume_command_carries_progress_flags(tmp_path):
+    from parallel_heat_tpu.supervisor import _resume_command
+    from parallel_heat_tpu.utils.checkpoint import checkpoint_stem
+
+    cfg = HeatConfig(steps=100, diag_interval=25, **_BASE)
+    policy = SupervisorPolicy(stall_windows=3, drift_tolerance=0.05)
+    cmd = _resume_command(cfg, checkpoint_stem(tmp_path / "ck"), 100,
+                          policy.validate())
+    assert "--diag-interval 25" in cmd
+    assert "--stall-windows 3" in cmd
+    assert "--drift-tolerance 0.05" in cmd
+
+
+# -- multi-process telemetry sharding --------------------------------------
+
+def test_telemetry_shards_per_process(tmp_path):
+    base = tmp_path / "m.jsonl"
+    hb = tmp_path / "hb.json"
+    with Telemetry(base, heartbeat=hb, process_index=1,
+                   process_count=3) as tel:
+        tel.emit("chunk", step=5)
+    shard = tmp_path / "m.p1.jsonl"
+    assert shard.exists() and not base.exists()
+    assert (tmp_path / "hb.p1.json").exists() and not hb.exists()
+    ev = _events(shard)[0]
+    assert ev["process_index"] == 1 and ev["process_count"] == 3
+
+
+def test_telemetry_single_process_path_unchanged(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with Telemetry(p) as tel:
+        tel.emit("chunk", step=5)
+    assert p.exists()
+    ev = _events(p)[0]
+    assert ev["process_index"] == 0 and ev["process_count"] == 1
+
+
+def test_heartbeat_payload_self_sufficient(tmp_path):
+    # last_step / last_event / residual ride the heartbeat so probes
+    # (and monitor --once) need not parse the JSONL at all.
+    hb = tmp_path / "hb.json"
+    cfg = HeatConfig(nx=12, ny=12, steps=200, converge=True,
+                     check_interval=20, eps=1e-12, backend="jnp")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with Telemetry(tmp_path / "m.jsonl", heartbeat=hb) as tel:
+            for _ in solve_stream(cfg, chunk_steps=100, telemetry=tel):
+                pass
+    doc = json.load(open(hb))
+    assert doc["last_step"] == 200 and doc["step"] == 200
+    assert doc["last_event"] == "chunk"
+    assert doc["residual"] is not None
+    assert math.isfinite(doc["residual"])

@@ -155,7 +155,10 @@ def test_supervised_interrupt_emits_signal_and_run_end(tmp_path):
 def test_telemetry_does_not_change_compiled_programs(tmp_path):
     # The acceptance contract: telemetry/annotation-enabled runs share
     # (and are bitwise identical to) un-instrumented executables — the
-    # same regression the guard pins, extended to the telemetry layer.
+    # same regression the guard pins, extended to the telemetry layer
+    # AND the diagnostics layer: the fully-instrumented run below adds
+    # a diag_interval on top of the sink, and must still hit only the
+    # plain run's cached runners.
     from parallel_heat_tpu import solver
 
     cfg = HeatConfig(steps=30, **_BASE)
@@ -165,11 +168,17 @@ def test_telemetry_does_not_change_compiled_programs(tmp_path):
     with Telemetry(tmp_path / "t.jsonl",
                    heartbeat=tmp_path / "hb.json") as tel:
         instr = [r.to_numpy()
-                 for r in solve_stream(cfg, chunk_steps=10,
+                 for r in solve_stream(cfg.replace(diag_interval=10),
+                                       chunk_steps=10,
                                        telemetry=tel)]
     assert solver._build_runner.cache_info().misses == misses_before
     for a, b in zip(plain, instr):
         np.testing.assert_array_equal(a, b)
+    # and the diagnostics events actually landed (the contract is not
+    # vacuous: instrumentation ran, programs still shared)
+    diags = [e for e in _events(tmp_path / "t.jsonl")
+             if e["event"] == "diagnostics"]
+    assert [d["step"] for d in diags] == [10, 20, 30]
 
 
 def test_telemetry_survives_unwritable_sink(tmp_path):

@@ -139,6 +139,128 @@ def test_metrics_report_round_trip(tmp_path):
     assert none.returncode == 1
 
 
+def test_monitor_once_round_trip(tmp_path):
+    # CLI converge run with --metrics/--heartbeat/--diag-interval ->
+    # tools/monitor.py --once must render step/throughput/residual
+    # from the real artifacts (the `make monitor-smoke` pipeline), and
+    # tools/metrics_report.py must produce the convergence section.
+    m = tmp_path / "m.jsonl"
+    hb = tmp_path / "hb.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    run = subprocess.run(
+        [sys.executable, "-m", "parallel_heat_tpu", "--nx", "32",
+         "--ny", "32", "--steps", "2000", "--converge", "--eps", "1e-3",
+         "--check-interval", "20", "--backend", "jnp",
+         "--diag-interval", "100", "--checkpoint", str(tmp_path / "ck"),
+         "--checkpoint-every", "200", "--metrics", str(m),
+         "--heartbeat", str(hb), "--monitor-hint"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "Monitor with: python tools/monitor.py" in run.stdout
+    mon = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "monitor.py"),
+         "--once", "--heartbeat", str(hb), "--metrics", str(m)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert mon.returncode == 0, mon.stderr[-2000:]
+    line = mon.stdout.strip()
+    assert "step 2000/2000" in line
+    assert "steps/s" in line
+    assert "residual" in line
+    assert "heat" in line
+    assert "outcome complete" in line
+    # heartbeat alone is enough for a liveness probe (no JSONL parse)
+    mon_hb = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "monitor.py"),
+         "--once", "--heartbeat", str(hb)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert mon_hb.returncode == 0
+    assert "step 2000" in mon_hb.stdout
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--json"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    cv = doc["convergence"]
+    assert cv["residual_last"] < cv["residual_first"]
+    assert cv["residual_slope_log10_per_kstep"] < 0  # healthy decay
+    assert cv["diag_samples"] >= 3
+    assert cv["heat_drift_max_frac"] >= 0
+    assert cv["update_linf_last"] is not None
+
+
+def _fake_stream_lines(n_chunks=4):
+    """Hand-built telemetry stream (no simulation, no jax import):
+    enough schema for metrics_report to summarize."""
+    lines = [json.dumps({
+        "schema": 1, "event": "run_header", "t_wall": 1.0, "t_mono": 1.0,
+        "config": {"nx": 16, "ny": 16, "steps": 40, "dtype": "float32"},
+        "explain": {"path": "XLA-fused jnp stencil"}})]
+    for i in range(n_chunks):
+        lines.append(json.dumps({
+            "schema": 1, "event": "chunk", "t_wall": 2.0 + i,
+            "t_mono": 2.0 + i, "step": 10 * (i + 1), "steps": 10,
+            "wall_s": 0.01, "steps_per_s": 1000.0,
+            "residual": 0.1 / (i + 1)}))
+    return lines
+
+
+def test_metrics_report_torn_final_line(tmp_path):
+    # A mid-write reader sees a torn final line: the report must skip
+    # it with a warning and summarize the intact prefix (exit 0), not
+    # fail the whole report.
+    m = tmp_path / "m.jsonl"
+    full = "\n".join(_fake_stream_lines()) + "\n"
+    torn = full + '{"schema": 1, "event": "chunk", "t_wall": 99.0, "t_m'
+    m.write_text(torn)
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert "torn final line" in rep.stderr
+    doc = json.loads(rep.stdout)
+    assert doc["torn_tail"] is True
+    assert doc["bad_lines"] == 0  # a torn tail is not a corrupt line
+    assert doc["chunks"]["count"] == 4  # the prefix summarized fully
+
+
+def test_metrics_report_merges_shard_glob(tmp_path):
+    # Multi-process runs shard per process (.pN.jsonl); a glob argument
+    # reports across them: aggregates from the primary shard only
+    # (SPMD processes emit EQUIVALENT streams — concatenating would
+    # double-count steps and fabricate stall windows), all shards
+    # listed with health flags.
+    for pi in (0, 1):
+        lines = [json.dumps({
+            "schema": 1, "event": "run_header", "t_wall": 1.0,
+            "t_mono": 1.0 + pi,
+            "config": {"nx": 16, "ny": 16, "steps": 20},
+            "process_index": pi, "process_count": 2})]
+        lines.append(json.dumps({
+            "schema": 1, "event": "chunk", "t_wall": 2.0,
+            "t_mono": 2.0 + pi, "step": 20, "steps": 20,
+            "wall_s": 0.01, "steps_per_s": 2000.0,
+            "process_index": pi, "process_count": 2}))
+        (tmp_path / f"m.p{pi}.jsonl").write_text("\n".join(lines) + "\n")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(tmp_path / "m*.jsonl"), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    assert [s["process_index"] for s in doc["shards"]] == [0, 1]
+    assert all(s["events"] == 2 and s["torn"] is False
+               for s in doc["shards"])
+    # primary-shard aggregates: no double counting across shards
+    assert doc["chunks"]["count"] == 1
+    assert doc["chunks"]["steps_total"] == 20
+    assert doc["header"]["segments"] == 1
+
+
 @pytest.mark.chaos
 def test_chaos_matrix_dryrun_smoke(tmp_path):
     # The fault x policy sweep must run end to end on CPU and certify
@@ -160,6 +282,15 @@ def test_chaos_matrix_dryrun_smoke(tmp_path):
     assert outcomes["nan_recurring"] == "halted"
     assert outcomes["unstable"] == "halted"
     assert outcomes["sigterm"] == "interrupted+resumed"
+    # the progress-guard cells: a finite spike recovers via the drift
+    # envelope (never the nan guard), and the stalled converge run is
+    # classified stalled (not nan/transient) within K windows
+    assert outcomes["spike_drift"] == "recovered"
+    assert outcomes["stalled_converge"] == "halted"
+    by_fault = {r["fault"]: r for r in doc["rows"]}
+    assert by_fault["stalled_converge"]["kind"] == "stalled"
+    assert by_fault["stalled_converge"]["telemetry_stall_ok"] is True
+    assert by_fault["spike_drift"]["telemetry_drift_ok"] is True
     assert all(r.get("bitwise_match", True) for r in doc["rows"])
     # every cell left a parseable event stream, and the NaN cells'
     # guard trips are visible in it within one guard_interval

@@ -20,15 +20,21 @@ its final grid is BITWISE the uninterrupted unsupervised run's
 ``guard_interval`` (``detect_lag_ok``). Every cell also runs with a
 telemetry sink (``utils/telemetry.py``) and asserts on the ARTIFACT
 rather than stdout: the event stream must carry a run_header, chunk
-events, and a terminal run_end (``telemetry_ok``), and a NaN
+events, and a terminal run_end (``telemetry_ok``), a NaN
 injection must appear as a ``guard_trip`` event within one
-``guard_interval`` (``telemetry_detect_lag_ok``).
+``guard_interval`` (``telemetry_detect_lag_ok``), a finite spike must
+appear as a ``progress_trip`` with kind ``drift`` — never a nan
+guard_trip — within one window (``telemetry_drift_ok``), and the
+deterministically stalled converge cell (eps below the f32-reachable
+floor) must be classified ``stalled`` within exactly
+``stall_windows`` windows (``telemetry_stall_ok``).
 
-``--dryrun`` runs the tiny CPU matrix (16x16, 60 steps) and is the
+``--dryrun`` runs the tiny CPU matrix (16x16, 60 steps; the stalled
+cell runs its own 3500-step converge schedule) and is the
 committed-artifact entry point:
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --dryrun \
-        --json chaos_r8_dryrun.json
+        --json chaos_r9_dryrun.json
 
 The same sweep runs unchanged on a TPU at real sizes (--size/--steps);
 the supervisor under test is host-side orchestration, so the CPU
@@ -65,6 +71,12 @@ def _faults_for(name, guard_interval, steps):
         return FaultPlan(signal_at_chunk=2, signum=int(signal.SIGTERM))
     if name == "unstable":
         return None  # the fault is the config itself (cx+cy > 1/2)
+    if name == "spike_drift":
+        # Finite corruption: invisible to the isfinite guard, caught by
+        # the progress guard's heat-content envelope (drift_tolerance).
+        return FaultPlan(spike_at_step=mid)
+    if name == "stalled_converge":
+        return None  # the fault is the config (eps below the f32 floor)
     raise ValueError(name)
 
 
@@ -77,9 +89,32 @@ def run_cell(fault, policy_kw, size, steps, workdir):
 
     base = dict(nx=size, ny=size, backend="jnp")
     unstable = fault == "unstable"
-    cfg = HeatConfig(steps=steps,
-                     **(dict(cx=5.0, cy=5.0) if unstable else {}),
-                     **base)
+    stalled = fault == "stalled_converge"
+    initial = None
+    if stalled:
+        # The deterministic stall: eps below the f32-reachable floor
+        # against a nonzero (hot-boundary) steady state — the iteration
+        # enters a rounding limit cycle, the residual plateaus at 2^-15
+        # forever, and only the progress guard can say so. The cell
+        # PINS its own 16x16/3500-step schedule regardless of --size:
+        # reaching the plateau takes O(N^2) diffusion steps, so the
+        # classifier contract is certified on the calibrated geometry
+        # (at --size 512 the residual would still be setting minima at
+        # any affordable step cap and the cell would falsely VIOLATE).
+        stall_n = 16
+        cfg = HeatConfig(steps=3500, converge=True, check_interval=10,
+                         eps=1e-6, nx=stall_n, ny=stall_n,
+                         backend="jnp")
+        initial = np.zeros((stall_n, stall_n), np.float32)
+        initial[0, :] = 1000.0
+        policy_kw = dict(policy_kw, checkpoint_every=500,
+                         guard_interval=250, stall_windows=3)
+    else:
+        cfg = HeatConfig(steps=steps,
+                         **(dict(cx=5.0, cy=5.0) if unstable else {}),
+                         **base)
+    if fault == "spike_drift":
+        policy_kw = dict(policy_kw, drift_tolerance=0.01)
     policy = SupervisorPolicy(backoff_base_s=0.0, **policy_kw)
     stem = os.path.join(workdir, f"ck_{fault}")
     tel_path = os.path.join(workdir, f"telemetry_{fault}.jsonl")
@@ -87,12 +122,13 @@ def run_cell(fault, policy_kw, size, steps, workdir):
     row = {"fault": fault, "policy": dict(policy_kw)}
     with warnings.catch_warnings():
         warnings.simplefilter("ignore", RuntimeWarning)
-        clean = None if unstable else solve(HeatConfig(steps=steps,
-                                                       **base))
+        clean = None if (unstable or stalled) else solve(
+            HeatConfig(steps=steps, **base))
         try:
             with Telemetry(tel_path) as tel:
                 sres = run_supervised(cfg, stem, policy=policy,
-                                      faults=faults, telemetry=tel)
+                                      initial=initial, faults=faults,
+                                      telemetry=tel)
             if sres.interrupted:
                 p = latest_checkpoint(stem)
                 grid, step, _ = load_checkpoint(p, cfg)
@@ -109,6 +145,7 @@ def run_cell(fault, policy_kw, size, steps, workdir):
             row["retries"] = sres.retries
             row["rollbacks"] = sres.rollbacks
             row["guard_trips"] = sres.guard_trips
+            row["progress_trips"] = sres.progress_trips
             row["steps_done"] = sres.steps_done
             row["checkpoints_written"] = sres.checkpoints_written
             if clean is not None and sres.result is not None:
@@ -125,6 +162,7 @@ def run_cell(fault, policy_kw, size, steps, workdir):
         except PermanentFailure as e:
             row["outcome"] = "halted"
             row["diagnosis"] = str(e)
+            row["kind"] = e.kind
     row.update(_telemetry_summary(tel_path, faults, policy))
     return row
 
@@ -152,7 +190,7 @@ def _telemetry_summary(tel_path, faults, policy):
     guard_interval — asserted on the ARTIFACT, not on stdout."""
     out = {}
     try:
-        events, _bad = _load_events(tel_path)
+        events, _bad, _torn = _load_events(tel_path)
     except OSError as e:
         out["telemetry_ok"] = False
         out["telemetry_error"] = str(e)
@@ -174,11 +212,39 @@ def _telemetry_summary(tel_path, faults, policy):
                              or policy.checkpoint_every))
         else:
             out["telemetry_detect_lag_ok"] = False
+    if policy.stall_windows is not None:
+        # The stall must surface as a progress_trip event with kind
+        # "stalled" (NOT a nan guard_trip) within exactly K windows —
+        # asserted on the artifact, like the NaN detection above.
+        trips = [e for e in events if e["event"] == "progress_trip"
+                 and e.get("kind") == "stalled"]
+        out["telemetry_stall_ok"] = bool(
+            trips and trips[0].get("windows") == policy.stall_windows
+            and not counts.get("guard_trip"))
+        if trips:
+            out["telemetry_stall_step"] = trips[0]["step"]
+            out["telemetry_stall_window"] = trips[0].get("window")
+    if policy.drift_tolerance is not None and faults is not None \
+            and faults.spike_at_step is not None:
+        trips = [e for e in events if e["event"] == "progress_trip"
+                 and e.get("kind") == "drift"]
+        if trips:
+            lag = trips[0]["step"] - faults.spike_at_step
+            out["telemetry_drift_trip_step"] = trips[0]["step"]
+            # The spike is finite: the nan guard must stay silent and
+            # the drift classifier must catch it within one guard
+            # window.
+            out["telemetry_drift_ok"] = bool(
+                0 <= lag <= (policy.guard_interval
+                             or policy.checkpoint_every)
+                and not counts.get("guard_trip"))
+        else:
+            out["telemetry_drift_ok"] = False
     return out
 
 
 FAULTS = ("none", "nan_transient", "nan_recurring", "transient_error",
-          "sigterm", "unstable")
+          "sigterm", "unstable", "spike_drift", "stalled_converge")
 
 
 def main():
@@ -231,13 +297,19 @@ def main():
         "sigterm": ("bitwise_match", "telemetry_ok"),
         "nan_recurring": ("telemetry_ok", "telemetry_detect_lag_ok"),
         "unstable": ("telemetry_ok",),
+        "spike_drift": ("bitwise_match", "telemetry_ok",
+                        "telemetry_drift_ok"),
+        "stalled_converge": ("telemetry_ok", "telemetry_stall_ok"),
     }
     by_fault = {r["fault"]: r for r in rows}
     ok = (all(by_fault[f].get(k) is True
               for f, keys in MUST.items() for k in keys)
           and by_fault["nan_recurring"]["outcome"] == "halted"
           and by_fault["unstable"]["outcome"] == "halted"
-          and by_fault["nan_transient"]["outcome"] == "recovered")
+          and by_fault["nan_transient"]["outcome"] == "recovered"
+          and by_fault["spike_drift"]["outcome"] == "recovered"
+          and by_fault["stalled_converge"]["outcome"] == "halted"
+          and by_fault["stalled_converge"].get("kind") == "stalled")
     print(f"matrix {'OK' if ok else 'VIOLATION'}: "
           f"{sum(1 for r in rows if r['outcome'] != 'halted')} "
           f"completed/recovered, "

@@ -10,10 +10,25 @@ from machine-readable events instead of read off a trace viewer:
   Mcells*steps/s, total steps and wall time;
 - chunk-time outliers: chunks slower than ``--outlier-mult`` x the
   median chunk wall time (stragglers, GC pauses, preemption stalls);
-- lifecycle timeline: guard trips, retries, rollbacks, signals,
-  permanent failures, in event order with absolute steps;
+- convergence trajectory (converge mode / ``--diag-interval`` runs):
+  first/last residual, least-squares log10-residual slope per kstep,
+  longest + trailing stall window (consecutive chunk residuals without
+  a new minimum), heat-content drift bound from the ``diagnostics``
+  samples, progress-guard trips;
+- lifecycle timeline: guard trips, progress trips, retries, rollbacks,
+  signals, permanent failures, in event order with absolute steps;
 - checkpoint overhead share: save/load seconds as a fraction of the
   run's accounted wall time.
+
+The metrics argument accepts a glob (``runs/m*.jsonl``): multi-process
+runs write one shard per process (``.pN.jsonl`` — see
+``utils/telemetry.py``). Aggregates summarize the primary (lowest
+``process_index``) shard — SPMD processes emit equivalent streams, so
+concatenating them would double-count — while every matched shard is
+listed with its event count and torn flag (a short or missing shard is
+a straggler signal). A torn final line (this reader racing a live
+appender mid-write) is skipped with a warning, never fatal — the
+stream minus its torn tail is still a valid prefix.
 
 Exit codes (CI/chaos-matrix assert on these instead of scraping
 stdout):
@@ -29,7 +44,9 @@ stdout):
 """
 
 import argparse
+import glob
 import json
+import math
 import sys
 
 
@@ -43,23 +60,68 @@ def _percentile(sorted_vals, q):
 
 
 def load_events(path):
-    """Parse a JSONL telemetry file -> (events, n_bad_lines)."""
-    events, bad = [], 0
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                bad += 1
-                continue
-            if isinstance(rec, dict) and "event" in rec:
-                events.append(rec)
+    """Parse a JSONL telemetry file -> (events, n_bad_lines, torn_tail).
+
+    ``torn_tail`` is True when the FINAL line failed to parse AND the
+    file does not end in a newline: this reader raced a live appender
+    mid-write. The torn line is skipped (not counted in
+    ``n_bad_lines``) — everything before it is a valid stream prefix.
+    """
+    events, bad, torn = [], 0, False
+    with open(path, "rb") as f:
+        text = f.read().decode("utf-8", errors="replace")
+    complete = text.endswith("\n")
+    lines = text.split("\n")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1 and not complete:
+                torn = True
             else:
                 bad += 1
-    return events, bad
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            events.append(rec)
+        else:
+            bad += 1
+    return events, bad, torn
+
+
+def load_streams(pattern):
+    """Expand a path-or-glob over per-process shards ->
+    ``(events, n_bad_lines, torn_paths, shard_rows)``.
+
+    Multi-process runs write per-process shards (``m.p0.jsonl``,
+    ``m.p1.jsonl`` …); pass ``m*.jsonl`` to report across all of them.
+    Every SPMD process runs the same host loop and emits an EQUIVALENT
+    stream, so the aggregate ``events`` come from the primary shard
+    only (lowest ``process_index`` seen): concatenating equivalents
+    would double-count steps/wall time and fabricate stall windows,
+    and ``t_mono`` epochs are not comparable across hosts. The other
+    shards contribute presence/health rows (``shard_rows``: path,
+    event count, process_index, torn flag — a missing or short shard
+    is a straggler/dead host signal). A pattern with no glob matches
+    is treated as a literal path (the single-file case, OSError if
+    missing).
+    """
+    paths = sorted(glob.glob(pattern)) or [pattern]
+    rows, bad, torn_paths = [], 0, []
+    for p in paths:
+        ev, b, torn = load_events(p)
+        bad += b
+        if torn:
+            torn_paths.append(p)
+        pis = [e["process_index"] for e in ev
+               if isinstance(e.get("process_index"), int)]
+        rows.append({"path": p, "events": ev, "torn": torn,
+                     "process_index": min(pis) if pis else 0})
+    primary = min(rows, key=lambda r: r["process_index"]) if rows \
+        else {"events": []}
+    return primary["events"], bad, torn_paths, rows
 
 
 def summarize(events, outlier_mult=5.0):
@@ -132,6 +194,64 @@ def summarize(events, outlier_mult=5.0):
                              if c.get("finite") is False),
         }
 
+    # Convergence trajectory: chunk residuals (converge mode) + the
+    # diagnostics samples (--diag-interval). Same defensive-field rule
+    # as above — foreign shapes degrade the numbers, never traceback.
+    conv = {}
+    res_pts = [(c["step"], c["residual"]) for c in chunks
+               if isinstance(c.get("residual"), (int, float))
+               and isinstance(c.get("step"), (int, float))]
+    if res_pts:
+        conv["residual_first"] = res_pts[0][1]
+        conv["residual_last"] = res_pts[-1][1]
+        pts = [(s, math.log10(r)) for s, r in res_pts
+               if r > 0 and math.isfinite(r)]
+        if len(pts) >= 2:
+            n = len(pts)
+            sx = sum(p[0] for p in pts)
+            sy = sum(p[1] for p in pts)
+            sxx = sum(p[0] * p[0] for p in pts)
+            sxy = sum(p[0] * p[1] for p in pts)
+            denom = n * sxx - sx * sx
+            if denom:
+                # Least-squares slope of log10(residual) vs step, per
+                # 1000 steps: healthy geometric decay is a steady
+                # negative number; ~0 means plateau.
+                conv["residual_slope_log10_per_kstep"] = (
+                    (n * sxy - sx * sy) / denom * 1000)
+        best, run, longest = math.inf, 0, 0
+        for _, r in res_pts:
+            if math.isfinite(r) and r < best:
+                best, run = r, 0
+            else:
+                run += 1
+                longest = max(longest, run)
+        # Stall windows: consecutive chunk residuals without a new
+        # minimum — the supervisor's stall classifier counts the same
+        # thing live (SupervisorPolicy.stall_windows).
+        conv["stall_windows_max"] = longest
+        conv["stall_windows_trailing"] = run
+    diags = by.get("diagnostics", [])
+    if diags:
+        conv["diag_samples"] = len(diags)
+        heats = [d["heat"] for d in diags
+                 if isinstance(d.get("heat"), (int, float))]
+        if heats:
+            h0 = heats[0]
+            conv["heat_first"] = h0
+            conv["heat_last"] = heats[-1]
+            conv["heat_drift_max_frac"] = (
+                max(abs(h - h0) for h in heats) / max(abs(h0), 1e-30))
+        if diags[-1].get("update_linf") is not None:
+            conv["update_linf_last"] = diags[-1]["update_linf"]
+    prog = by.get("progress_trip", [])
+    if prog:
+        conv["progress_trips"] = [
+            {"kind": e.get("kind"), "step": e.get("step"),
+             "window": e.get("window")} for e in prog]
+    if conv:
+        doc["convergence"] = conv
+
     saves = by.get("checkpoint_save", [])
     loads = by.get("rollback", [])
     ckpt_s = (sum(s.get("wall_s", 0.0) for s in saves)
@@ -152,8 +272,9 @@ def summarize(events, outlier_mult=5.0):
          "detail": {k: v for k, v in e.items()
                     if k not in ("schema", "event", "t_wall", "t_mono")}}
         for e in events
-        if e["event"] in ("guard_trip", "retry", "rollback", "signal",
-                          "permanent_failure", "run_end")]
+        if e["event"] in ("guard_trip", "progress_trip", "retry",
+                          "rollback", "signal", "permanent_failure",
+                          "run_end")]
     doc["timeline"] = timeline
 
     ends = by.get("run_end", [])
@@ -199,6 +320,29 @@ def render_text(doc):
         if c["guard_checked"]:
             out.append(f"guard: {c['guard_checked']} chunk verdicts, "
                        f"{c['guard_bad']} non-finite")
+    cv = doc.get("convergence")
+    if cv:
+        if "residual_first" in cv:
+            slope = cv.get("residual_slope_log10_per_kstep")
+            out.append(
+                f"convergence: residual {cv['residual_first']:.3e} -> "
+                f"{cv['residual_last']:.3e}"
+                + (f", slope {slope:+.3f} log10/kstep"
+                   if slope is not None else "")
+                + f", stall windows max {cv['stall_windows_max']} "
+                  f"(trailing {cv['stall_windows_trailing']})")
+        if "diag_samples" in cv:
+            drift = cv.get("heat_drift_max_frac")
+            out.append(
+                f"diagnostics: {cv['diag_samples']} samples"
+                + (f", heat {cv['heat_first']:.6g} -> "
+                   f"{cv['heat_last']:.6g} (max drift {drift:.2%})"
+                   if drift is not None else "")
+                + (f", last update_linf {cv['update_linf_last']:.3e}"
+                   if cv.get("update_linf_last") is not None else ""))
+        for t in cv.get("progress_trips", []):
+            out.append(f"  progress_trip kind={t['kind']} "
+                       f"step={t['step']} window={t['window']}")
     k = doc["checkpoints"]
     out.append(f"checkpoints: {k['saves']} saves "
                f"({k['save_s_total']:.3f}s), {k['rollback_loads']} "
@@ -223,7 +367,11 @@ def _fmt(v):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="summarize a --metrics telemetry JSONL file")
-    ap.add_argument("metrics", help="JSONL file written by --metrics")
+    ap.add_argument("metrics",
+                    help="JSONL file written by --metrics, or a glob "
+                         "over per-process shards (runs/m*.jsonl) — "
+                         "aggregates summarize the primary shard, all "
+                         "shards are listed with health/torn flags")
     ap.add_argument("--json", action="store_true",
                     help="print the summary document as JSON")
     ap.add_argument("--outlier-mult", type=float, default=5.0,
@@ -246,10 +394,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     try:
-        events, bad = load_events(args.metrics)
+        events, bad, torn_paths, shards = load_streams(args.metrics)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    for p in torn_paths:
+        print(f"warning: {p}: skipped torn final line (a live writer "
+              f"is mid-append; the stream prefix is intact)",
+              file=sys.stderr)
     if not events:
         print(f"error: {args.metrics}: no telemetry events",
               file=sys.stderr)
@@ -262,6 +414,15 @@ def main(argv=None):
 
     doc = summarize(events, outlier_mult=args.outlier_mult)
     doc["bad_lines"] = bad
+    doc["torn_tail"] = bool(torn_paths)
+    if len(shards) > 1:
+        doc["shards"] = [{"path": r["path"],
+                          "process_index": r["process_index"],
+                          "events": len(r["events"]),
+                          "torn": r["torn"]} for r in shards]
+        doc["shard_note"] = ("aggregates summarize the primary (lowest "
+                             "process_index) shard; SPMD processes "
+                             "emit equivalent streams")
 
     anomalies = []
     fail_on = (set() if args.fail_on == "none"

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Live run monitor: a single-line terminal status for a running (or
+finished) simulation, from its heartbeat + telemetry JSONL — the "is it
+actually making progress?" probe of the observability run-book.
+
+Reads only the observation artifacts (`--heartbeat` / `--metrics` files
+of `python -m parallel_heat_tpu`); never touches the run itself. Both
+sources are optional and degrade independently:
+
+- the heartbeat alone answers liveness + progress (`last_step`,
+  `last_event`, `residual` ride the payload precisely so probes need
+  not parse the JSONL at all);
+- the JSONL adds the step target (run_header config), throughput
+  (chunk events), grid diagnostics (`--diag-interval` samples), and
+  the terminal outcome. `--metrics` accepts a glob
+  (`runs/m*.jsonl`) for multi-process shards.
+
+Robust by construction: a torn final line (the writer is mid-append),
+foreign lines, or a missing/partially-renamed heartbeat are skipped,
+never fatal — a monitor must not crash because it raced a writer.
+
+Modes:
+
+- default: live tail — refresh every ``--interval`` seconds, rewrite
+  one status line on a TTY (plain changed-line prints otherwise), exit
+  0 when a ``run_end`` event lands (or on Ctrl-C);
+- ``--once``: render the current status once and exit — 0 if anything
+  was observable, 1 if neither source yielded data (for scripts/CI:
+  ``make monitor-smoke``).
+"""
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+import time
+
+
+def read_heartbeat(path):
+    """Parse the heartbeat JSON; None when missing/torn/foreign (the
+    writer renames atomically, but the monitor must also survive a
+    wrong path or a half-provisioned run directory)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+class StreamState:
+    """Incremental telemetry-tail state across poll cycles.
+
+    Tracks a byte offset per shard file so each poll parses only the
+    appended suffix; a partial (torn) tail is retained and re-parsed
+    once the writer completes the line. Fields are the latest-seen
+    values across all shards (multi-process runs interleave here by
+    arrival, which is fine for a status line).
+    """
+
+    def __init__(self, pattern):
+        self.pattern = pattern
+        self._offsets = {}
+        self._partial = {}
+        self.saw_data = False
+        self.total_steps = None
+        self.converge = None
+        self.eps = None
+        self.step = None
+        self.steps_per_s = None
+        self.residual = None
+        self.heat = None
+        self.update_linf = None
+        self.last_event = None
+        self.outcome = None
+        self.trips = 0
+
+    def poll(self):
+        # Re-glob each cycle: shards (.pN.jsonl) may appear after the
+        # monitor starts. A pattern with no matches is treated as a
+        # literal path that may appear later.
+        paths = sorted(_glob.glob(self.pattern)) or [self.pattern]
+        for p in paths:
+            self._poll_file(p)
+
+    def _poll_file(self, path):
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offsets.get(path, 0))
+                data = f.read()
+        except OSError:
+            return
+        if not data:
+            return
+        self._offsets[path] = self._offsets.get(path, 0) + len(data)
+        buf = self._partial.get(path, b"") + data
+        lines = buf.split(b"\n")
+        # The last element is either b"" (complete tail) or a torn
+        # line still being written — keep it for the next cycle.
+        self._partial[path] = lines[-1]
+        for line in lines[:-1]:
+            self._ingest(line)
+
+    def _ingest(self, line):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return  # foreign/corrupt line: skip, never crash
+        if not isinstance(rec, dict) or "event" not in rec:
+            return
+        self.saw_data = True
+        ev = rec["event"]
+        self.last_event = ev
+        if ev == "run_header":
+            cfg = rec.get("config") or {}
+            if isinstance(cfg, dict):
+                # steps_total is the ABSOLUTE target; a resumed
+                # segment's config.steps counts only remaining steps
+                # (chunk events are absolute), so prefer the former.
+                self.total_steps = rec.get(
+                    "steps_total", cfg.get("steps", self.total_steps))
+                self.converge = cfg.get("converge", self.converge)
+                self.eps = cfg.get("eps", self.eps)
+        elif ev == "chunk":
+            if rec.get("step") is not None:
+                self.step = rec["step"]
+            if rec.get("steps_per_s") is not None:
+                self.steps_per_s = rec["steps_per_s"]
+            if rec.get("residual") is not None:
+                self.residual = rec["residual"]
+        elif ev == "diagnostics":
+            if rec.get("step") is not None:
+                self.step = max(self.step or 0, rec["step"])
+            if rec.get("heat") is not None:
+                self.heat = rec["heat"]
+            if rec.get("update_linf") is not None:
+                self.update_linf = rec["update_linf"]
+        elif ev in ("guard_trip", "progress_trip"):
+            self.trips += 1
+        elif ev == "run_end":
+            self.outcome = rec.get("outcome")
+            if rec.get("steps_done") is not None:
+                self.step = rec["steps_done"]
+
+
+def render(state, hb, now=None):
+    """One status line from whatever is observable. Returns None when
+    neither source yielded anything yet."""
+    now = time.time() if now is None else now
+    parts = []
+    step = state.step if state is not None else None
+    residual = state.residual if state is not None else None
+    last_event = state.last_event if state is not None else None
+    if hb is not None:
+        if step is None:
+            step = hb.get("last_step", hb.get("step"))
+        if residual is None:
+            residual = hb.get("residual")
+        if last_event is None:
+            last_event = hb.get("last_event")
+    if step is not None:
+        total = state.total_steps if state is not None else None
+        if total:
+            frac = min(step / total, 1.0)  # defensive vs foreign streams
+            parts.append(f"step {step}/{total} ({frac:.0%})")
+        else:
+            parts.append(f"step {step}")
+    if state is not None and state.steps_per_s:
+        parts.append(f"{state.steps_per_s:,.0f} steps/s")
+    if residual is not None:
+        tgt = (f" (eps {state.eps:g})"
+               if state is not None and state.converge and state.eps
+               else "")
+        parts.append(f"residual {residual:.3e}{tgt}")
+    if state is not None and state.heat is not None:
+        parts.append(f"heat {state.heat:.6g}")
+    if state is not None and state.trips:
+        parts.append(f"trips {state.trips}")
+    if hb is not None and hb.get("t_wall"):
+        parts.append(f"hb {max(0.0, now - hb['t_wall']):.1f}s ago")
+    if state is not None and state.outcome is not None:
+        parts.append(f"outcome {state.outcome}")
+    elif last_event:
+        parts.append(f"last {last_event}")
+    return " | ".join(parts) if parts else None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="single-line live status from a run's heartbeat + "
+                    "telemetry JSONL")
+    ap.add_argument("--heartbeat", default=None, metavar="FILE",
+                    help="heartbeat file written by --heartbeat")
+    ap.add_argument("--metrics", default=None, metavar="FILE_OR_GLOB",
+                    help="telemetry JSONL written by --metrics "
+                         "(glob ok: runs/m*.jsonl for shards)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one status line and exit (0 = data "
+                         "observed, 1 = nothing readable)")
+    ap.add_argument("--interval", type=float, default=1.0, metavar="S",
+                    help="poll interval, seconds (default 1)")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    metavar="S",
+                    help="stop after S seconds even without a run_end "
+                         "(for scripts; default: watch forever)")
+    args = ap.parse_args(argv)
+    if not args.heartbeat and not args.metrics:
+        ap.error("give --heartbeat and/or --metrics")
+
+    state = StreamState(args.metrics) if args.metrics else None
+
+    def snapshot():
+        if state is not None:
+            state.poll()
+        hb = read_heartbeat(args.heartbeat) if args.heartbeat else None
+        return render(state, hb), hb
+
+    if args.once:
+        line, hb = snapshot()
+        if line is None:
+            print("no observable run (heartbeat/metrics unreadable or "
+                  "empty)", file=sys.stderr)
+            return 1
+        print(line)
+        return 0
+
+    is_tty = sys.stdout.isatty()
+    t0 = time.monotonic()
+    last_line = None
+    width = 0
+    try:
+        while True:
+            line, _hb = snapshot()
+            if line is not None and line != last_line:
+                if is_tty:
+                    # Rewrite in place; pad over the previous line's
+                    # tail so a shrinking status leaves no residue.
+                    pad = max(0, width - len(line))
+                    sys.stdout.write("\r" + line + " " * pad)
+                    sys.stdout.flush()
+                    width = len(line)
+                else:
+                    print(line, flush=True)
+                last_line = line
+            if state is not None and state.outcome is not None:
+                if is_tty:
+                    sys.stdout.write("\n")
+                return 0
+            if (args.max_seconds is not None
+                    and time.monotonic() - t0 >= args.max_seconds):
+                if is_tty:
+                    sys.stdout.write("\n")
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        if is_tty:
+            sys.stdout.write("\n")
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
