@@ -28,7 +28,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
 .PHONY: all heat heat_con native test chaos telemetry-smoke \
-        monitor-smoke bench clean
+        monitor-smoke overlap-smoke bench clean
 
 all: heat
 
@@ -82,6 +82,23 @@ monitor-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
 	    .monitor_smoke/metrics.jsonl --json
 	rm -rf .monitor_smoke
+
+# async-pipeline smoke (CPU): a supervised pipelined run (dispatch-
+# ahead stream + async checkpoints + async telemetry writer), then the
+# report tool must see the pipeline section and pass the device-busy
+# CI gate — exit 0 means the overlap machinery is live end to end
+overlap-smoke:
+	rm -rf .overlap_smoke && mkdir -p .overlap_smoke
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
+	    --steps 400 --backend jnp --pipeline-depth 2 \
+	    --guard-interval 100 --diag-interval 100 --supervise \
+	    --checkpoint .overlap_smoke/ck --checkpoint-every 100 \
+	    --metrics .overlap_smoke/metrics.jsonl \
+	    --heartbeat .overlap_smoke/heartbeat.json --quiet
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
+	    .overlap_smoke/metrics.jsonl \
+	    --fail-on 'permanent_failure,busy<0.5' --json
+	rm -rf .overlap_smoke
 
 bench:
 	$(PY) bench.py

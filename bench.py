@@ -97,6 +97,118 @@ def _bench_converge(cfg, repeats=2):
     return best - floor, res
 
 
+def _bench_stream(backend, size=512, steps=1200, chunk=100):
+    """The production-loop row (``--row stream512``): a streamed run
+    with the WHOLE observability stack enabled — guard + diagnostics
+    every chunk, telemetry JSONL + heartbeat, a retained checkpoint per
+    chunk — measured three ways against one bare stream:
+
+    - ``bare``: the uninstrumented chunk chain (the throughput the
+      kernels deliver when nothing observes them);
+    - ``sync``: pipeline_depth=1, synchronous saves, synchronous
+      telemetry I/O — every observer runs on the device's clock (the
+      pre-pipeline loop, kept measurable so the gap stays priced);
+    - ``pipelined``: pipeline_depth=2, the async checkpointer and the
+      async telemetry writer — the same instruments drained behind the
+      next chunk's compute.
+
+    The overhead fractions land in the BENCH artifact; the acceptance
+    bar is ``overhead_pipelined_frac`` within 5% while the sync gap
+    documents what pipelining hides.
+    """
+    import os
+    import tempfile
+
+    from parallel_heat_tpu import HeatConfig, Telemetry
+    from parallel_heat_tpu.solver import solve_stream
+    from parallel_heat_tpu.utils.checkpoint import (
+        AsyncCheckpointer, save_generation)
+    from parallel_heat_tpu.utils.profiling import sync
+
+    base = HeatConfig(nx=size, ny=size, steps=steps, backend=backend)
+    instr = base.replace(guard_interval=chunk, diag_interval=chunk)
+
+    def run(cfg, depth, instrumented, workdir, tag):
+        tel = saver = None
+        stem = os.path.join(workdir, f"ck_{tag}")
+        if instrumented:
+            tel = Telemetry(
+                os.path.join(workdir, f"m_{tag}.jsonl"),
+                heartbeat=os.path.join(workdir, f"hb_{tag}.json"),
+                async_io=depth > 1)
+            if depth > 1:
+                saver = AsyncCheckpointer(keep=2)
+        last = None
+        t0 = time.perf_counter()
+        try:
+            for last in solve_stream(cfg, chunk_steps=chunk,
+                                     telemetry=tel,
+                                     pipeline_depth=depth):
+                if saver is not None:
+                    # depth-2 yields are already donation-protected
+                    saver.submit(stem, last.grid, last.steps_run, cfg,
+                                 protect=False)
+                elif instrumented:
+                    save_generation(stem, last.grid, last.steps_run,
+                                    cfg, keep=2)
+            if saver is not None:
+                saver.drain()
+            sync(last.grid)  # true pipeline flush before the bracket closes
+            return time.perf_counter() - t0
+        finally:
+            if saver is not None:
+                saver.close()
+            if tel is not None:
+                tel.close()
+
+    with tempfile.TemporaryDirectory(prefix="bench_stream_") as wd:
+        # Warm every compiled program (chunk programs INCLUDING the
+        # final partial chunk's when steps is not a chunk multiple,
+        # guard/diag reductions, the donation-protecting copy) outside
+        # the brackets — a cold tail-chunk compile would otherwise
+        # land inside every measured wall.
+        warm = chunk + (steps % chunk or chunk)
+        # bare runs at AUTO depth — the uninstrumented baseline is what
+        # a plain stream actually does on this platform (2 on an
+        # accelerator, 1 on CPU); sync/pipelined pin their depths.
+        run(base.replace(steps=warm), None, False, wd, "warm_bare")
+        run(instr.replace(steps=warm), 1, True, wd, "warm_sync")
+        run(instr.replace(steps=warm), 2, True, wd, "warm_pipe")
+        variants = (("bare", base, None, False),
+                    ("sync", instr, 1, True),
+                    ("pipelined", instr, 2, True))
+        walls = {tag: [] for tag, *_ in variants}
+        # Interleave the variants per round (the paired-measurement
+        # rationale of profiling.calibrated_slope_paired): host clock/
+        # frequency drift on tens-of-seconds scales lands on every
+        # variant alike, so the min-per-variant comparison compares
+        # like with like instead of whichever phase ran on the slow
+        # stretch.
+        for i in range(3):
+            for tag, cfg, depth, instrumented in variants:
+                walls[tag].append(run(cfg, depth, instrumented, wd,
+                                      f"{tag}{i}"))
+        walls = {tag: min(ts) for tag, ts in walls.items()}
+    cells = size * size
+    return {
+        "metric": (f"{size}^2 streamed x{steps} steps, fully "
+                   f"instrumented (guard+diag+telemetry+ckpt/chunk): "
+                   f"sync vs pipelined"),
+        "chunk_steps": chunk,
+        "wall_bare_s": round(walls["bare"], 4),
+        "wall_sync_s": round(walls["sync"], 4),
+        "wall_pipelined_s": round(walls["pipelined"], 4),
+        "overhead_sync_frac": round(
+            walls["sync"] / walls["bare"] - 1, 4),
+        "overhead_pipelined_frac": round(
+            walls["pipelined"] / walls["bare"] - 1, 4),
+        "mcells_steps_per_s_bare": round(
+            cells * steps / walls["bare"] / 1e6, 1),
+        "mcells_steps_per_s_pipelined": round(
+            cells * steps / walls["pipelined"] / 1e6, 1),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
@@ -115,16 +227,32 @@ def main(argv=None):
     ap.add_argument("--budget", type=float, default=10.0,
                     help="target seconds for the chained timing batch")
     ap.add_argument("--row", default="headline",
-                    choices=("headline", "conv256"),
+                    choices=("headline", "conv256", "stream512"),
                     help="which single row the one-line stdout "
                          "contract reports: the fixed-step headline "
-                         "(default) or the 256^2-to-eps converge row "
-                         "(--row conv256 runs ONLY that row and skips "
-                         "the artifact — the tools/headline_variance.py "
-                         "protocol hook)")
+                         "(default), the 256^2-to-eps converge row "
+                         "(--row conv256; the tools/headline_variance.py "
+                         "protocol hook), or the fully-instrumented "
+                         "streamed run sync-vs-pipelined (--row "
+                         "stream512). The non-headline rows run ONLY "
+                         "that row and skip the artifact")
+    ap.add_argument("--stream-size", type=int, default=512,
+                    help="--row stream512: grid edge (default 512)")
+    ap.add_argument("--stream-steps", type=int, default=1200,
+                    help="--row stream512: total steps (default 1200)")
+    ap.add_argument("--stream-chunk", type=int, default=100,
+                    help="--row stream512: chunk_steps, also the "
+                         "guard/diag/checkpoint cadence (default 100)")
     args = ap.parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig
+
+    if args.row == "stream512":
+        print(json.dumps(_bench_stream(args.backend,
+                                       size=args.stream_size,
+                                       steps=args.stream_steps,
+                                       chunk=args.stream_chunk)))
+        return
 
     if args.row == "conv256":
         # One-shot-minus-floor timing (a converged run cannot be

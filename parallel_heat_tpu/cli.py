@@ -64,6 +64,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "kernel chunk and rounds once per chunk — "
                          "measurably lower drift at a measured "
                          "throughput cost")
+    ap.add_argument("--pipeline-depth", default="auto", metavar="D",
+                    help="stream dispatch pipelining (SEMANTICS.md "
+                         "'Pipelined stream'): keep D chunks in flight "
+                         "— chunk n+1 is dispatched before chunk n's "
+                         "observers (guard, diagnostics, telemetry, "
+                         "checkpoints) drain, so the device never "
+                         "idles through them. Dispatch-order only: "
+                         "grids, observations, compiled programs and "
+                         "checkpoint bytes are identical to a "
+                         "synchronous run. 'auto' (default) = 2 for "
+                         "fixed-step runs on an accelerator backend, "
+                         "1 otherwise (converge runs cannot dispatch "
+                         "past their convergence verdict; on CPU "
+                         "there is no idle device to keep busy); "
+                         "D > 1 with --converge is an error")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write final grid (.dat for 2D, .npy otherwise)")
     ap.add_argument("--initial-out", default=None, metavar="FILE",
@@ -135,6 +150,14 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="N",
                     help="checkpoint generations the supervisor "
                          "retains (older ones are pruned)")
+    ap.add_argument("--no-async-checkpoint", action="store_true",
+                    help="supervised runs: save checkpoints "
+                         "synchronously at the boundary instead of "
+                         "through the background writer (async is the "
+                         "default: the gather + finite-verify + atomic "
+                         "commit overlap the next chunks' compute, and "
+                         "rollback/exit barriers drain in-flight saves "
+                         "— committed bytes are identical either way)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run")
     ap.add_argument("--trace", dest="profile", metavar="DIR",
@@ -224,6 +247,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"error: --halo-depth must be an integer or 'auto', "
                   f"got {args.halo_depth!r}", file=sys.stderr)
             return 2
+    if args.pipeline_depth == "auto":
+        # Same alias pattern as --halo-depth: None lets solve_stream
+        # resolve (solver.resolved_pipeline_depth: 2 fixed-step on an
+        # accelerator, 1 otherwise).
+        pipeline_depth = None
+    else:
+        try:
+            pipeline_depth = int(args.pipeline_depth)
+        except ValueError:
+            print(f"error: --pipeline-depth must be an integer or "
+                  f"'auto', got {args.pipeline_depth!r}",
+                  file=sys.stderr)
+            return 2
     config = HeatConfig(
         nx=args.nx, ny=args.ny, nz=args.nz,
         cx=args.cx, cy=args.cy, cz=args.cz,
@@ -232,7 +268,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backend=args.backend, mesh_shape=mesh_shape,
         overlap=not args.no_overlap, halo_depth=halo_depth,
         accumulate=args.accumulate, guard_interval=args.guard_interval,
-        diag_interval=args.diag_interval,
+        diag_interval=args.diag_interval, pipeline_depth=pipeline_depth,
     )
     try:
         config.validate()
@@ -338,7 +374,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         # Append mode: a resumed invocation continues the same JSONL
         # stream (tools/metrics_report.py reads multi-segment files).
-        telemetry = Telemetry(args.metrics, heartbeat=args.heartbeat)
+        # async_io: event serialization + heartbeat renames go through
+        # the bounded-queue writer thread, so the run loop (and the
+        # device behind it) never blocks on the metrics filesystem.
+        telemetry = Telemetry(args.metrics, heartbeat=args.heartbeat,
+                              async_io=True)
         # Resumed segments report ABSOLUTE steps, continuing the first
         # segment's numbering (the supervisor re-sets this per rollback
         # segment itself).
@@ -388,6 +428,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 layout=args.checkpoint_layout,
                 stall_windows=args.stall_windows,
                 drift_tolerance=args.drift_tolerance,
+                async_checkpoint=not args.no_async_checkpoint,
             )
             # Flags the resumed invocation must repeat to deliver what
             # this one promised. NOT --initial-out: the t=0 grid was

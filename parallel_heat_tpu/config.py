@@ -155,6 +155,26 @@ class HeatConfig:
     # previous sample, the update-residual baseline).
     diag_interval: Optional[int] = None
 
+    # Stream dispatch pipelining (SEMANTICS.md "Pipelined stream"):
+    # how many chunks `solve_stream` keeps in flight on the device at
+    # once. None (default) = auto: 2 (dispatch chunk n+1 immediately
+    # after chunk n's dispatch returns, drain chunk n's observers while
+    # n+1 computes) for fixed-step runs on an accelerator backend, 1
+    # otherwise — converge runs cannot dispatch ahead of the on-device
+    # convergence verdict, and on CPU the host and "device" share
+    # cores, so there is no idle accelerator to keep busy (depth 2
+    # there is a measured ~10% pessimization — the bench stream512
+    # row prices it; same platform-aware shape as backend="auto"). Pipelining is
+    # dispatch-order only: yielded grids (donation-protected copies at
+    # depth > 1), guard/diag observations, compiled programs, and
+    # checkpoint bytes are identical to the depth-1 loop; only the
+    # per-chunk wall-clock bracket changes (drain-to-drain instead of
+    # dispatch-to-ready). Stripped from runner/executable cache keys
+    # like the guard, so every depth shares one compiled-program
+    # family. Explicit values: >= 1; > 1 with converge=True is a loud
+    # error rather than a silent fallback.
+    pipeline_depth: Optional[int] = None
+
     # --- derived helpers -------------------------------------------------
 
     @property
@@ -321,6 +341,21 @@ class HeatConfig:
                 f"diag_interval must be >= 1 (or None to disable grid "
                 f"diagnostics), got {self.diag_interval}"
             )
+        if self.pipeline_depth is not None:
+            if self.pipeline_depth < 1:
+                raise ValueError(
+                    f"pipeline_depth must be >= 1 (or None for auto), "
+                    f"got {self.pipeline_depth}"
+                )
+            if self.pipeline_depth > 1 and self.converge:
+                raise ValueError(
+                    "pipeline_depth > 1 is fixed-step only: converge "
+                    "mode must read each chunk's on-device convergence "
+                    "verdict before dispatching the next chunk, so "
+                    "dispatch-ahead would speculate past the stopping "
+                    "point (use pipeline_depth=1 or drop the flag — "
+                    "auto already resolves converge runs to 1)"
+                )
         if self.accumulate not in ("storage", "f32chunk"):
             raise ValueError(
                 f"accumulate must be 'storage' or 'f32chunk', got "

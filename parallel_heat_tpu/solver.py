@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -35,6 +36,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.utils import profiling
 from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
 from parallel_heat_tpu.ops import (
     step_2d,
@@ -499,6 +501,10 @@ def explain(config: HeatConfig) -> dict:
         out["diagnostics"] = (f"fused grid stats every "
                               f"{config.diag_interval} steps "
                               f"(observation-only)")
+    if config.pipeline_depth is not None:
+        out["pipeline"] = (f"depth {config.pipeline_depth} dispatch-"
+                           f"ahead stream (dispatch-order only; "
+                           f"observer drain overlaps the next chunk)")
     if is_sharded:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
@@ -817,6 +823,26 @@ def grid_stats(grid, prev=None) -> dict:
                 "update_l2": l2, "update_linf": linf}
 
 
+def _start_host_copies(*values) -> None:
+    """Begin non-blocking device->host transfers of observer scalars
+    (chunk step counts, guard verdicts, diagnostics reductions) so the
+    copies complete behind the next chunk's compute instead of
+    serializing at the drain. Accepts arrays, tuples of arrays, or
+    None; tolerates arrays without ``copy_to_host_async`` (older jax)
+    — the eventual host read then pays the sync itself."""
+    for v in values:
+        if v is None:
+            continue
+        items = v if isinstance(v, tuple) else (v,)
+        for a in items:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # noqa: BLE001 — observation-only
+                    pass
+
+
 def _warn_guard_tripped(step: int) -> None:
     """The fixed-step analog of :func:`_warn_if_diverged`: the runtime
     guard found non-finite values, so every step from the first bad one
@@ -834,8 +860,35 @@ def _warn_guard_tripped(step: int) -> None:
     )
 
 
+def resolved_pipeline_depth(config: HeatConfig,
+                            pipeline_depth: Optional[int] = None) -> int:
+    """The dispatch depth :func:`solve_stream` will run ``config`` at:
+    the explicit argument wins, else ``config.pipeline_depth``, else
+    auto — 2 for fixed-step runs on an accelerator backend, 1
+    otherwise. Converge runs must drain each chunk's on-device
+    convergence vote before dispatching the next, so dispatch-ahead
+    cannot apply; on CPU the host and the "device" share cores, so
+    there is no idle accelerator for depth 2 to keep busy and the
+    protection copy + in-flight buffer pressure are a measured ~10%
+    pessimization (priced by ``bench.py --row stream512``,
+    BENCH_r06_stream512_dryrun.json) — the same platform-aware shape
+    as ``backend="auto"``. Exposed so drivers that hand stream-yielded
+    grids to other consumers (the supervisor's async saver) can tell
+    whether those grids are already donation-protected copies
+    (depth > 1) without re-deriving the auto rule."""
+    depth = (pipeline_depth if pipeline_depth is not None
+             else config.pipeline_depth)
+    if depth is not None:
+        return depth
+    if config.converge:
+        return 1
+    plat = jax.devices()[0].platform
+    return 2 if plat in ("tpu", "axon", "gpu", "cuda", "rocm") else 1
+
+
 def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
-                 chunk_steps: Optional[int] = None, telemetry=None):
+                 chunk_steps: Optional[int] = None, telemetry=None,
+                 pipeline_depth: Optional[int] = None):
     """Iterate the simulation in host-visible chunks; yields a
     :class:`HeatResult` after each chunk (cumulative ``steps_run``).
 
@@ -871,19 +924,54 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     diag interval (pinned by ``tests/test_telemetry.py`` /
     ``tests/test_diagnostics.py``).
 
+    ``pipeline_depth`` (explicit argument wins over
+    ``config.pipeline_depth``; ``None`` = auto — 2 for fixed-step
+    runs on an accelerator backend, 1 otherwise; see
+    :func:`resolved_pipeline_depth`) selects the dispatch pipelining
+    of the chunk loop (SEMANTICS.md "Pipelined stream"). At depth 1 the loop
+    is fully synchronous: each chunk is dispatched, waited for, then
+    observed. At depth >= 2, chunk *n+1* is dispatched immediately
+    after chunk *n*'s dispatch returns — JAX async dispatch keeps the
+    device busy through the observer drain, telemetry, and whatever
+    the caller does between yields — and chunk *n*'s observers (guard
+    verdict, diagnostics, step scalars) are fetched afterwards via
+    non-blocking device-to-host copies. Every yielded grid at
+    depth >= 2 is a donation-protected device copy (enqueued before
+    the next dispatch donates the live buffer), so the consume-before-
+    advancing rule above is automatically satisfied; the copy costs
+    one grid read+write of HBM traffic per boundary — ~1/chunk_steps
+    of a step. Pipelining is dispatch-order only: grids, guard/diag
+    values, compiled programs (zero new runner-cache entries), and
+    checkpoint bytes are identical to the depth-1 loop; per-chunk
+    ``wall_s`` switches to drain-to-drain brackets (the depth-1
+    dispatch-to-ready bracket is kept at depth 1).
+
     Consume each yielded grid (e.g. ``np.asarray`` / checkpoint) before
-    advancing the generator: the next chunk donates that buffer to XLA.
+    advancing the generator: the next chunk donates that buffer to XLA
+    (at ``pipeline_depth >= 2`` the yielded grid is a protected copy
+    and survives advancing, but the rule keeps callers depth-agnostic).
     """
     config = config.validate()
     guard_interval = config.guard_interval
     diag_interval = config.diag_interval
-    if guard_interval is not None or diag_interval is not None:
-        # The guard and diagnostics are observation-only and never part
-        # of the compiled step program: strip them so the runner/
-        # executable caches key on the observer-free config — an
-        # instrumented run reuses (and can never diverge from) the
-        # plain run's compiled programs.
-        config = config.replace(guard_interval=None, diag_interval=None)
+    depth = resolved_pipeline_depth(config, pipeline_depth)
+    if depth < 1:
+        raise ValueError(f"pipeline_depth must be >= 1, got {depth}")
+    elif depth > 1 and config.converge:
+        raise ValueError(
+            "pipeline_depth > 1 is fixed-step only (converge mode must "
+            "read each chunk's convergence verdict before dispatching "
+            "the next chunk)")
+    if (guard_interval is not None or diag_interval is not None
+            or config.pipeline_depth is not None):
+        # The guard, diagnostics, and dispatch pipelining are
+        # observation/orchestration only and never part of the compiled
+        # step program: strip them so the runner/executable caches key
+        # on the observer-free config — an instrumented or pipelined
+        # run reuses (and can never diverge from) the plain run's
+        # compiled programs.
+        config = config.replace(guard_interval=None, diag_interval=None,
+                                pipeline_depth=None)
     if chunk_steps is not None and chunk_steps < 1:
         raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
     total = config.steps
@@ -898,12 +986,8 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
         chunk = ((chunk + sub - 1) // sub) * sub
     u = _prepare_initial(config, initial)
 
-    import time
-
     if telemetry is not None:
-        from parallel_heat_tpu.utils import profiling
-
-        telemetry.run_header(config)
+        telemetry.run_header(config, pipeline_depth=depth)
         cells = profiling.cell_count(config)
         bytes_per_cell = profiling.bytes_per_cell(config)
 
@@ -911,14 +995,175 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     elapsed = 0.0
     next_guard = guard_interval if guard_interval is not None else None
     next_diag = diag_interval if diag_interval is not None else None
+    prev_diag = None
+    prev_diag_step = 0
     if next_diag is not None:
         # The update-residual baseline: a COPY of the initial state (the
         # first chunk donates `u` itself). This is the one grid-sized
         # cost diagnostics carries; samples between boundaries pay only
         # the fused reduction.
         prev_diag = jnp.copy(u)
-        prev_diag_step = 0
+
+    if depth > 1:
+        # ------------------------------------------------------------
+        # Pipelined dispatch (fixed-step; SEMANTICS.md "Pipelined
+        # stream"): keep up to `depth` chunks in flight, drain the
+        # oldest chunk's observers while its successors compute.
+        # ------------------------------------------------------------
+        # Pre-compile every chunk program before the clock starts,
+        # like solve(): the drain-to-drain wall brackets would
+        # otherwise charge a mid-stream compile (the final partial
+        # chunk's program) to one chunk's timing.
+        sizes, rem = set(), total
+        while rem > 0:
+            c = min(chunk, rem)
+            sizes.add(c)
+            rem -= c
+        for c in sizes:
+            ccfg = config.replace(steps=c)
+            runner, _ = _build_runner(ccfg)
+            _compiled_for(runner, ccfg, u)
+
+        inflight = collections.deque()
+        disp_done = 0
+        t_mark = time.perf_counter()
+        # Device-starvation probe: set at a drain that finds EVERY
+        # dispatched chunk already complete (the device is provably
+        # idle from that instant until the next dispatch); the window
+        # is attributed to the next chunk's gap_s. A host-observable
+        # LOWER bound on idleness — it is what makes the report tool's
+        # `busy<X` CI gate meaningful for pipelined runs.
+        idle_mark = None
+
+        def _dispatch():
+            nonlocal u, disp_done, next_guard, next_diag
+            nonlocal prev_diag, prev_diag_step, idle_mark
+            c = min(chunk, total - disp_done)
+            ccfg = config.replace(steps=c)
+            runner, _ = _build_runner(ccfg)
+            compiled = _compiled_for(runner, ccfg, u)
+            td0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation("heat:chunk"):
+                grid, k, conv, res = compiled(u)
+            dispatch_s = time.perf_counter() - td0
+            gap_s = 0.0
+            if idle_mark is not None:
+                # Idle ends when the dispatch STARTS enqueuing (td0),
+                # not when the call returns — counting dispatch_s too
+                # would overstate the starvation lower bound.
+                gap_s = max(0.0, td0 - idle_mark)
+                idle_mark = None
+            disp_done += c
+            u = grid
+            end = disp_done
+            is_last = end >= total
+            if is_last:
+                keep = grid  # the final grid is never donated
+            else:
+                # Donation-protected copy, enqueued BEFORE the next
+                # dispatch donates `grid`: the observers read it and
+                # the caller receives it — bitwise the depth-1 loop's
+                # boundary grid, and safe to consume at any time.
+                keep = jnp.copy(grid)
+            fin_dev = None
+            if next_guard is not None and (end >= next_guard or is_last):
+                fin_dev = _all_finite(keep)
+                while next_guard <= end:
+                    next_guard += guard_interval
+            stats_dev = None
+            steps_since = None
+            if next_diag is not None and (end >= next_diag or is_last):
+                stats_dev = _grid_stats_delta(keep, prev_diag)
+                steps_since = end - prev_diag_step
+                prev_diag, prev_diag_step = keep, end
+                while next_diag <= end:
+                    next_diag += diag_interval
+            _start_host_copies(k, fin_dev, stats_dev)
+            inflight.append((keep, k, fin_dev, stats_dev, steps_since,
+                             c, dispatch_s, gap_s))
+
+        while True:
+            while len(inflight) < depth and disp_done < total:
+                _dispatch()
+            if not inflight:
+                return
+            (keep, k, fin_dev, stats_dev, steps_since, c,
+             dispatch_s, gap_s) = inflight.popleft()
+            tw0 = time.perf_counter()
+            k = int(k)  # blocks until this chunk's program completed
+            now = time.perf_counter()
+            drain_wait_s = now - tw0
+            chunk_wall = now - t_mark
+            t_mark = now
+            elapsed += chunk_wall
+            done += k
+            if inflight:
+                probe = getattr(inflight[-1][1], "is_ready", None)
+                if probe is not None and probe():
+                    # The NEWEST dispatched chunk (and therefore every
+                    # older one — the device queue is FIFO) already
+                    # completed: the device is idle from this instant
+                    # until the next dispatch. Mark it; _dispatch
+                    # charges the window to the next chunk's gap_s.
+                    idle_mark = now
+            underrun = k < c
+            finite: Optional[bool] = None
+            if fin_dev is not None:
+                finite = bool(fin_dev)
+            elif underrun and next_guard is not None:
+                # Defensive under-run (the fixed-step programs always
+                # run exactly c steps): mirror the sync loop's is_last
+                # rule — the stream must not END unguarded just because
+                # the dispatch-time schedule could not see this was the
+                # last chunk.
+                finite = grid_all_finite(keep)
+            if finite is False:
+                _warn_guard_tripped(done)
+            diag: Optional[dict] = None
+            if stats_dev is not None:
+                mn, mx, heat, l2, linf = stats_dev
+                diag = {"min": float(mn), "max": float(mx),
+                        "heat": float(heat), "update_l2": float(l2),
+                        "update_linf": float(linf), "step": done,
+                        "steps_since": steps_since}
+            elif (underrun and next_diag is not None
+                  and prev_diag_step <= done):
+                # The is_last mirror for diagnostics (skipped only if
+                # the dispatch-ahead already moved the baseline past
+                # this chunk — a future-state baseline would be wrong).
+                diag = grid_stats(keep, prev=prev_diag)
+                diag["step"] = done
+                diag["steps_since"] = done - prev_diag_step
+            observe_s = time.perf_counter() - now
+            if telemetry is not None:
+                telemetry.chunk(step=done, steps=k, wall_s=chunk_wall,
+                                cells=cells,
+                                bytes_per_cell=bytes_per_cell,
+                                residual=None, converged=None,
+                                finite=finite, gap_s=gap_s,
+                                dispatch_s=dispatch_s,
+                                drain_wait_s=drain_wait_s,
+                                observe_s=observe_s)
+                if diag is not None:
+                    telemetry.diagnostics(**diag)
+            yield HeatResult(grid=keep, steps_run=done, converged=None,
+                             residual=None, elapsed_s=elapsed,
+                             finite=finite, diagnostics=diag)
+            if underrun:
+                # The in-flight successors computed from a state the
+                # host never certified; abandon them (their outputs
+                # are simply dropped).
+                return
+
+    t_complete_prev = None
     while done < total:
+        t_iter = time.perf_counter()
+        # Host-side idle bracket (the observer/checkpoint/caller tax
+        # between the previous chunk's completion and this dispatch) —
+        # reported on the chunk event so tools/metrics_report.py can
+        # price exactly what pipelining hides.
+        gap_s = (t_iter - t_complete_prev
+                 if t_complete_prev is not None else 0.0)
         c = min(chunk, total - done)
         ccfg = config.replace(steps=c)
         runner, _ = _build_runner(ccfg)
@@ -929,6 +1174,7 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
             jax.block_until_ready(grid)
         k = int(k)
         chunk_wall = time.perf_counter() - t0
+        t_complete_prev = t0 + chunk_wall
         elapsed += chunk_wall
         done += k
         u = grid
@@ -968,10 +1214,12 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
             while next_diag <= done:
                 next_diag += diag_interval
         if telemetry is not None:
+            observe_s = time.perf_counter() - t_complete_prev
             telemetry.chunk(step=done, steps=k, wall_s=chunk_wall,
                             cells=cells, bytes_per_cell=bytes_per_cell,
                             residual=out_res, converged=out_conv,
-                            finite=finite)
+                            finite=finite, gap_s=gap_s,
+                            observe_s=observe_s)
             if diag is not None:
                 telemetry.diagnostics(
                     **{**diag, "step": done})
@@ -997,19 +1245,20 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     or warm, matching the reference's wall-clock brackets around
     precompiled binaries (``cuda/cuda_heat.cu:203,239``).
     """
-    import time
-
     config = config.validate()
     guard_interval = config.guard_interval
     diag_interval = config.diag_interval
-    if guard_interval is not None or diag_interval is not None:
+    if (guard_interval is not None or diag_interval is not None
+            or config.pipeline_depth is not None):
         # solve is ONE compiled dispatch — there is no intermediate
-        # boundary to observe, so the guard and diagnostics degrade to a
-        # single end-of-run check/sample (use solve_stream or the
-        # supervisor for within-run detection). Stripped from the config
-        # so compiled programs are shared with (and bitwise identical
-        # to) uninstrumented runs.
-        config = config.replace(guard_interval=None, diag_interval=None)
+        # boundary to observe (or to pipeline: pipeline_depth is inert
+        # here), so the guard and diagnostics degrade to a single
+        # end-of-run check/sample (use solve_stream or the supervisor
+        # for within-run detection). Stripped from the config so
+        # compiled programs are shared with (and bitwise identical to)
+        # uninstrumented runs.
+        config = config.replace(guard_interval=None, diag_interval=None,
+                                pipeline_depth=None)
     runner, _ = _build_runner(config)
     initial = _prepare_initial(config, initial)
     compiled = _compiled_for(runner, config, initial)

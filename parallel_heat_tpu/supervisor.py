@@ -52,6 +52,7 @@ import contextlib
 import math
 import shlex
 import signal
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -62,6 +63,7 @@ from parallel_heat_tpu.solver import (
     _prepare_initial,
     grid_all_finite,
     grid_stats,
+    resolved_pipeline_depth,
     solve_stream,
 )
 from parallel_heat_tpu.utils import checkpoint as ckpt
@@ -129,6 +131,15 @@ class SupervisorPolicy:
     # Checkpoint layout / compression, passed through to save_generation.
     layout: str = "auto"
     compress: bool = False
+    # Asynchronous checkpointing (default on): saves run through
+    # utils.checkpoint.AsyncCheckpointer — a donation-protected device
+    # copy is enqueued at the boundary, the gather + finite-verify +
+    # atomic commit happen on a worker thread while the next chunks
+    # compute, and every rollback/interrupt/exit DRAINS in-flight saves
+    # first (the barrier: a rollback can never restore an uncommitted
+    # generation). Committed bytes are identical to synchronous saves;
+    # False restores the fully synchronous save-at-the-boundary loop.
+    async_checkpoint: bool = True
     # Progress guard, converge mode: classify the run as STALLED (a
     # PermanentFailure with kind="stalled" — retrying a deterministic
     # plateau cannot help) after this many consecutive chunk residual
@@ -213,6 +224,22 @@ class _StopFlag:
 
 
 @contextlib.contextmanager
+def _saver_cleanup(saver):
+    """Close a supervisor-owned AsyncCheckpointer on every exit path
+    (worker thread + queue cleanup); pass None for caller-owned savers
+    — they are drained at barriers but never closed here. Close errors
+    are swallowed: cleanup must not mask the run's own outcome."""
+    try:
+        yield
+    finally:
+        if saver is not None:
+            try:
+                saver.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+@contextlib.contextmanager
 def _signal_handlers(flag: _StopFlag):
     """Install SIGTERM/SIGINT handlers that ONLY set a flag (the whole
     body is one attribute store — async-signal-safe; all real work
@@ -294,12 +321,16 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
         parts.append(f"--guard-interval {policy.guard_interval}")
     if config.diag_interval is not None:
         parts.append(f"--diag-interval {config.diag_interval}")
+    if config.pipeline_depth is not None:
+        parts.append(f"--pipeline-depth {config.pipeline_depth}")
     if policy.stall_windows is not None:
         parts.append(f"--stall-windows {policy.stall_windows}")
     if policy.drift_tolerance is not None:
         parts.append(f"--drift-tolerance {policy.drift_tolerance:g}")
     if policy.layout != "auto":
         parts.append(f"--checkpoint-layout {policy.layout}")
+    if not policy.async_checkpoint:
+        parts.append("--no-async-checkpoint")
     # Caller flags may carry paths ("--out", "my out.npy"): quote each
     # token so the printed line survives a shell round trip verbatim.
     parts.extend(shlex.quote(t) for t in extra_flags)
@@ -312,7 +343,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                    initial=None, start_step: int = 0,
                    faults=None, say=None,
                    resume_extra_flags: Tuple[str, ...] = (),
-                   telemetry=None) -> SupervisorResult:
+                   telemetry=None, checkpointer=None) -> SupervisorResult:
     """Run ``config.steps`` more steps under supervision (guard +
     retained checkpoints + retry-with-rollback + preemption-safe exit).
 
@@ -328,6 +359,10 @@ def run_supervised(config: HeatConfig, checkpoint,
     stream chunk, checkpoint save/load latencies, and each lifecycle
     event (guard_trip / retry / rollback / signal / permanent_failure
     / run_end) — host-side observation only, per the guard's contract.
+    ``checkpointer`` (a :class:`utils.checkpoint.AsyncCheckpointer`)
+    overrides the policy-built async saver — the chaos harness injects
+    throttled ones to widen the in-flight window; a caller-supplied
+    checkpointer is drained at every barrier but NOT closed here.
 
     Raises :class:`PermanentFailure` for non-retryable failures; the
     last retained checkpoint still holds the newest verified-good
@@ -338,8 +373,12 @@ def run_supervised(config: HeatConfig, checkpoint,
     say = say or (lambda *a: None)
     if telemetry is not None:
         # Header carries the user's config (guard_interval included);
-        # idempotent, so the per-segment streams' calls are no-ops.
-        telemetry.run_header(config)
+        # idempotent, so the per-segment streams' calls are no-ops —
+        # which is why the resolved dispatch depth must ride THIS call
+        # (the documented run_header schema), not the streams' later
+        # dropped ones.
+        telemetry.run_header(
+            config, pipeline_depth=resolved_pipeline_depth(config))
     # The supervisor owns guarding — the inner stream runs guard-free
     # (one compiled-program family shared with unsupervised runs).
     run_base = (config.replace(guard_interval=None)
@@ -388,6 +427,25 @@ def run_supervised(config: HeatConfig, checkpoint,
     last_path: Optional[str] = None
     t0 = time.perf_counter()
 
+    # Async saver: policy-built unless the caller injected one (the
+    # chaos harness passes throttled checkpointers to widen the
+    # in-flight window). None = the synchronous save path.
+    saver = checkpointer
+    own_saver = False
+    if saver is None and policy.async_checkpoint:
+        saver = ckpt.AsyncCheckpointer(keep=policy.keep_checkpoints,
+                                       layout=policy.layout,
+                                       compress=policy.compress)
+        own_saver = True
+    # Commit bookkeeping is written by the saver's worker thread and
+    # read by this loop — one lock keeps n_ckpt/last_path coherent.
+    ckpt_lock = threading.Lock()
+    # Stream yields at depth > 1 are already donation-protected copies
+    # (SEMANTICS.md "Pipelined stream"), so the async saver can
+    # snapshot them without a second device copy; depth-1 yields are
+    # live buffers the next chunk donates and still need one.
+    ckpt_protect = resolved_pipeline_depth(run_base) == 1
+
     def _mk(result, done, interrupted, signame=None, resume_cmd=None):
         return SupervisorResult(
             result=result, steps_done=done, interrupted=interrupted,
@@ -401,7 +459,21 @@ def run_supervised(config: HeatConfig, checkpoint,
         if telemetry is not None:
             telemetry.emit(event, **fields)
 
-    def fail(diagnosis: str, kind: str = "exhausted") -> PermanentFailure:
+    def fail(diagnosis: str, kind: str = "exhausted",
+             drained: bool = False) -> PermanentFailure:
+        if not drained:
+            try:
+                # Drain in-flight saves so the terminal telemetry
+                # counts (and the on-disk generation set a post-mortem
+                # inspects) are final; swallowed — a failed async save
+                # must not mask the diagnosis being raised. Callers
+                # that already ran a barrier (the stall/exhausted
+                # paths, whose diagnoses name last_path) pass
+                # drained=True so one logical drain emits one
+                # checkpoint_barrier event.
+                ckpt_barrier("failure")
+            except Exception:  # noqa: BLE001
+                pass
         emit("permanent_failure", diagnosis=diagnosis, kind=kind)
         if telemetry is not None:
             telemetry.run_end(outcome="permanent_failure", kind=kind,
@@ -411,8 +483,40 @@ def run_supervised(config: HeatConfig, checkpoint,
                               wall_s=time.perf_counter() - t0)
         return PermanentFailure(diagnosis, kind=kind)
 
+    def _committed(rec):
+        # Worker-thread hook: runs when an async generation actually
+        # landed (or was skipped by the finite-verify commit gate).
+        nonlocal n_ckpt, last_path
+        if rec.get("error") is not None:
+            return  # surfaced at the next barrier, like a sync raise
+        if rec.get("skipped"):
+            say(f"Supervisor: async checkpoint at step {rec['step']} "
+                f"skipped (non-finite snapshot); previous generation "
+                f"stays newest")
+            emit("checkpoint_skipped", step=rec["step"],
+                 reason="non_finite")
+            return
+        with ckpt_lock:
+            n_ckpt += 1
+            gen = n_ckpt
+            last_path = rec["path"]
+        emit("checkpoint_save", step=rec["step"], path=str(rec["path"]),
+             wall_s=rec["wall_s"], kept=policy.keep_checkpoints,
+             generation=gen, gather_s=rec["gather_s"],
+             **{"async": True})
+        say(f"Supervisor: checkpoint at step {rec['step']} -> "
+            f"{rec['path']}")
+
     def save(grid, step_abs):
         nonlocal n_ckpt, last_path
+        if saver is not None:
+            # Device copy now (donation-safe), gather + finite-verify +
+            # atomic commit on the worker — the next chunk dispatches
+            # while the snapshot drains. Barriers (rollback/interrupt/
+            # final) are the only places the loop waits for it.
+            saver.submit(stem, grid, step_abs, ckpt_cfg,
+                         on_done=_committed, protect=ckpt_protect)
+            return
         t_save = time.perf_counter()
         last_path = ckpt.save_generation(
             stem, grid, step_abs, ckpt_cfg, keep=policy.keep_checkpoints,
@@ -424,15 +528,32 @@ def run_supervised(config: HeatConfig, checkpoint,
         say(f"Supervisor: checkpoint at step {step_abs} -> {last_path}")
         return last_path
 
+    def ckpt_barrier(reason: str):
+        # The async-save barrier: every rollback, interrupt, failure and
+        # completion drains in-flight saves BEFORE acting on the
+        # retained-generation set, so discovery/rollback can never see
+        # (or restore) an uncommitted generation. Re-raises the first
+        # worker error — the same surface a synchronous save has.
+        if saver is None:
+            return
+        wait_s = saver.drain()
+        emit("checkpoint_barrier", reason=reason, wait_s=wait_s)
+
     def interrupted(cur, done, signum, already_saved):
         # Flush-and-exit on SIGTERM/SIGINT. The flushed state must honor
         # the retained-generations-are-good invariant: a signal landing
         # between a corruption and its guard boundary must not persist
         # garbage, so the flush itself is guard-verified (skipped — the
-        # previous generation stays newest — when non-finite).
+        # previous generation stays newest — when non-finite; the async
+        # saver's commit gate re-verifies the gathered copy either way).
+        # Both barriers matter: a SIGTERM can land with a periodic save
+        # still in flight, and the resume command below must name a
+        # COMMITTED newest generation.
+        ckpt_barrier("interrupt")
         if not already_saved:
             if grid_all_finite(cur):
                 save(cur, done)
+                ckpt_barrier("interrupt")
             else:
                 say(f"Supervisor: state at step {done} is non-finite; "
                     f"keeping previous generation instead of flushing")
@@ -518,7 +639,8 @@ def run_supervised(config: HeatConfig, checkpoint,
                         f"({drift_env['flux_per_step']:g}/step + slack)")
         return None
 
-    with _signal_handlers(stop):
+    with _signal_handlers(stop), \
+            _saver_cleanup(saver if own_saver else None):
         save(state, done)
         while done < total_abs and final is None:
             seg_base = done
@@ -618,6 +740,15 @@ def run_supervised(config: HeatConfig, checkpoint,
                             stall_run += 1
                             if stall_run >= policy.stall_windows:
                                 progress += 1
+                                # Commit in-flight saves first (the
+                                # diagnosis names the newest
+                                # checkpoint) — swallowed like fail()'s
+                                # barrier: a failed async save must not
+                                # mask the stall verdict being raised.
+                                try:
+                                    ckpt_barrier("failure")
+                                except Exception:  # noqa: BLE001
+                                    pass
                                 emit("progress_trip", kind="stalled",
                                      step=step_abs,
                                      window=[stall_from, step_abs],
@@ -638,7 +769,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                                     f"plateau. Raise eps, use a wider "
                                     f"dtype, or cap steps. Newest "
                                     f"checkpoint: {last_path}.",
-                                    kind="stalled")
+                                    kind="stalled", drained=True)
                     done = step_abs
                     if ckpt_due:
                         save(cur, step_abs)
@@ -687,6 +818,12 @@ def run_supervised(config: HeatConfig, checkpoint,
                     kind = f"transient dispatch error: {e}"
                 else:
                     raise
+                # The rollback barrier: a trip must drain in-flight
+                # saves BEFORE anything reads the generation set — the
+                # exhausted-budget diagnosis below names the newest
+                # COMMITTED checkpoint, and the rollback load can never
+                # restore a generation whose rename has not landed.
+                ckpt_barrier("rollback")
                 retries += 1
                 if retries > policy.max_retries:
                     # The window comes from the guard's own records
@@ -713,6 +850,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                         f"{last_path}.",
                         kind=("drift" if isinstance(e, _GuardTrip)
                               and e.kind == "drift" else "exhausted"),
+                        drained=True,
                     ) from None
                 delay = min(policy.backoff_max_s,
                             policy.backoff_base_s * 2 ** (retries - 1))
@@ -727,7 +865,8 @@ def run_supervised(config: HeatConfig, checkpoint,
                 if src is None:  # pragma: no cover (gen0 always exists)
                     raise fail(
                         f"{kind} — and no checkpoint generation of "
-                        f"{stem!r} survives to roll back to.") from None
+                        f"{stem!r} survives to roll back to.",
+                        drained=True) from None
                 t_load = time.perf_counter()
                 grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
                 rollbacks += 1
@@ -736,6 +875,10 @@ def run_supervised(config: HeatConfig, checkpoint,
                      load_wall_s=time.perf_counter() - t_load)
                 say(f"Supervisor: rolled back to {src} (step {done})")
                 continue
+        # Completion barrier: the final retained generation must be
+        # committed before run_end is recorded and the result's
+        # checkpoint counts are read.
+        ckpt_barrier("final")
         if final is not None and done < total_abs and not final.converged:
             # Defensive stream under-run: record reality, don't loop.
             say(f"Supervisor: stream under-ran at step {done} of "

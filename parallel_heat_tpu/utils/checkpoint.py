@@ -23,8 +23,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import re
-from typing import Tuple
+import threading
+import time
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -50,6 +53,30 @@ def _num_devices_of(grid) -> int:
         return 1
 
 
+def _wants_sharded_layout(grid, layout: str) -> bool:
+    """The ONE sharded-vs-gathered decision (``layout="auto"``'s rule),
+    shared by :func:`save_checkpoint` and the async checkpointer's
+    verify path so the two can never diverge — a split predicate would
+    let the worker gather a grid the writer then shards (or vice
+    versa), paying a second full device->host transfer per save.
+    Raises the explicit gathered+non-addressable error for both
+    callers."""
+    if layout not in ("auto", "gathered", "sharded"):
+        raise ValueError(f"unknown checkpoint layout {layout!r}")
+    fully_addressable = getattr(grid, "is_fully_addressable", True)
+    if layout == "gathered" and not fully_addressable:
+        raise ValueError(
+            "layout='gathered' cannot snapshot a grid that spans "
+            "non-addressable devices (multi-process run); use "
+            "'sharded' or 'auto'")
+    return (layout == "sharded"
+            or (layout == "auto"
+                and (not fully_addressable
+                     or (_num_devices_of(grid) > 1
+                         and grid.size * grid.dtype.itemsize
+                         >= _SHARD_THRESHOLD_BYTES))))
+
+
 def save_checkpoint(path, grid, step: int, config: HeatConfig,
                     compress: bool = False, layout: str = "auto") -> str:
     """Write a snapshot; returns the actual path written.
@@ -62,19 +89,7 @@ def save_checkpoint(path, grid, step: int, config: HeatConfig,
     that gathering hurts (>= 64 MiB). See the module docstring for the
     formats.
     """
-    if layout not in ("auto", "gathered", "sharded"):
-        raise ValueError(f"unknown checkpoint layout {layout!r}")
-    fully_addressable = getattr(grid, "is_fully_addressable", True)
-    if layout == "gathered" and not fully_addressable:
-        raise ValueError(
-            "layout='gathered' cannot snapshot a grid that spans "
-            "non-addressable devices (multi-process run); use "
-            "'sharded' or 'auto'")
-    if layout == "sharded" or (layout == "auto" and (
-            not fully_addressable
-            or (_num_devices_of(grid) > 1
-                and grid.size * grid.dtype.itemsize
-                >= _SHARD_THRESHOLD_BYTES))):
+    if _wants_sharded_layout(grid, layout):
         return _save_sharded(path, grid, step, config, compress)
     return _save_gathered(path, grid, step, config, compress)
 
@@ -589,6 +604,210 @@ def latest_checkpoint(path):
                                                         "manifest.json")):
         return p
     return None
+
+
+# ---------------------------------------------------------------------------
+# Asynchronous checkpointing (the supervisor's overlap path)
+# ---------------------------------------------------------------------------
+
+def _host_all_finite(grid) -> bool:
+    """Host-side finite verification of a (possibly sharded) snapshot,
+    shard-by-shard — peak host memory is one shard, never the grid.
+    This is the async save protocol's commit gate: a generation is only
+    published after every gathered value checked finite."""
+    shards = getattr(grid, "addressable_shards", None)
+    if shards is not None:
+        return all(bool(np.isfinite(np.asarray(s.data)).all())
+                   for s in shards)
+    return bool(np.isfinite(np.asarray(grid)).all())
+
+
+class AsyncCheckpointer:
+    """Background writer of retained checkpoint generations: the save
+    cost (device->host gather, serialization, fsync-rename, pruning)
+    moves off the run loop's critical path so the device stays busy
+    through every snapshot.
+
+    Per :meth:`submit` the protocol is:
+
+    1. **caller thread** — a donation-protected device copy of the grid
+       is enqueued (an async device op: ``submit`` returns at dispatch,
+       and the caller may immediately advance the stream, whose next
+       chunk donates the live buffer);
+    2. **worker thread** — waits for the copy, gathers it host-side
+       (overlapping the next chunks' compute), verifies every value
+       finite, and only then commits the generation through
+       :func:`save_generation` (each layout's own crash-atomic rename
+       protocol; the retained-generation set — and for the sharded
+       layout the manifest — lands strictly after the verify). A
+       non-finite snapshot is SKIPPED, leaving the previous generation
+       newest: the supervisor's retained-generations-are-good invariant
+       holds even when a corruption races an in-flight save.
+
+    Commits happen strictly in submit order (one worker, FIFO queue),
+    so generation discovery and pruning see the same monotone history a
+    synchronous saver writes — committed bytes are identical to the
+    synchronous path's (the copy and the gather are value-preserving).
+    ``max_pending`` bounds in-flight snapshots (device memory:
+    one extra grid buffer per pending save — a slow disk exerts
+    backpressure instead of accumulating copies).
+
+    :meth:`drain` blocks until everything submitted has committed or
+    been skipped and re-raises the first worker error — the
+    supervisor's rollback/exit barrier: a rollback NEVER loads while a
+    save is in flight, so it cannot restore an uncommitted generation.
+    ``throttle_s`` delays each commit (chaos/testing only: it widens
+    the in-flight window the barrier contract is certified against).
+    """
+
+    def __init__(self, keep: int = 3, layout: str = "auto",
+                 compress: bool = False, max_pending: int = 2,
+                 throttle_s: float = 0.0):
+        if keep < 0:
+            raise ValueError(f"keep must be >= 0, got {keep}")
+        self.keep = keep
+        self.layout = layout
+        self.compress = compress
+        self.throttle_s = float(throttle_s)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, max_pending))
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._records: list = []
+        self._closed = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="async-checkpointer",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- caller side -----------------------------------------------------
+
+    def submit(self, path, grid, step: int, config: HeatConfig,
+               on_done=None, protect: bool = True) -> None:
+        """Queue one generation save of ``path``'s stem. ``on_done``
+        (optional) is called on the worker thread with the commit
+        record ``{step, path, skipped, wall_s, gather_s, error}`` —
+        the supervisor's bookkeeping/telemetry hook.
+
+        ``protect=False`` certifies that ``grid``'s buffer will never
+        be donated while the save is in flight (e.g. a pipelined
+        stream's yielded grids, which are already donation-protected
+        copies — SEMANTICS.md "Pipelined stream") and skips the
+        device-side snapshot copy; the default copies, which is the
+        only safe choice for depth-1 stream yields the next chunk
+        donates."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_pending()
+        if protect:
+            import jax.numpy as jnp
+
+            # The one step that MUST happen before the caller's next
+            # dispatch: a device-side copy, enqueued in dispatch order,
+            # so the snapshot survives the live buffer's donation.
+            # Async — the copy itself overlaps whatever is already
+            # queued.
+            grid = jnp.copy(grid)
+        self._q.put({"path": path, "snap": grid, "step": int(step),
+                     "config": config, "on_done": on_done})
+
+    def drain(self) -> float:
+        """Block until every submitted save committed (or was skipped);
+        returns the seconds waited and re-raises the first worker
+        error. The rollback/exit barrier."""
+        t0 = time.perf_counter()
+        self._q.join()
+        self._raise_pending()
+        return time.perf_counter() - t0
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` (default) pending saves
+        commit first. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain:
+            try:
+                self._q.join()
+            except Exception:  # pragma: no cover — defensive
+                pass
+        self._q.put(None)
+        self._worker.join(timeout=60.0)
+
+    @property
+    def records(self) -> list:
+        """Commit records so far (testing/tooling; worker-ordered)."""
+        with self._lock:
+            return list(self._records)
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- worker side -----------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            rec = {"step": item["step"], "path": None, "skipped": False,
+                   "error": None, "wall_s": 0.0, "gather_s": 0.0}
+            try:
+                if self.throttle_s > 0:
+                    time.sleep(self.throttle_s)
+                t0 = time.perf_counter()
+                snap = item["snap"]
+                # One gather, not two: when the save will take the
+                # GATHERED layout anyway (the writer's own predicate —
+                # shared, so the two can never diverge), pull the
+                # snapshot to host once, verify that copy, and
+                # serialize FROM it — otherwise the verify pass and
+                # the writer would each pay a full device->host
+                # transfer. The sharded layout keeps the shard-by-shard
+                # verify (its writer also streams shard-by-shard; peak
+                # host memory stays one shard).
+                sharded = _wants_sharded_layout(snap, self.layout)
+                tg0 = time.perf_counter()
+                if sharded:
+                    finite = _host_all_finite(snap)
+                    payload = snap
+                else:
+                    payload = np.asarray(snap)
+                    finite = bool(np.isfinite(payload).all())
+                rec["gather_s"] = time.perf_counter() - tg0
+                if finite:
+                    rec["path"] = save_generation(
+                        item["path"], payload, item["step"],
+                        item["config"], keep=self.keep,
+                        layout=self.layout, compress=self.compress)
+                else:
+                    # Commit gate: never publish a bad generation; the
+                    # previous one stays newest and the supervisor's
+                    # guard/rollback machinery handles the corruption.
+                    rec["skipped"] = True
+                rec["wall_s"] = time.perf_counter() - t0
+            except BaseException as e:  # noqa: BLE001 — surfaced at
+                # the next submit/drain barrier, exactly where a
+                # synchronous save would have raised
+                rec["error"] = e
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+            try:
+                if item["on_done"] is not None:
+                    item["on_done"](rec)
+            except Exception as e:  # noqa: BLE001 — a bookkeeping
+                # callback bug must not wedge the writer
+                import warnings
+
+                warnings.warn(f"async checkpoint on_done callback "
+                              f"failed: {e}", RuntimeWarning)
+            with self._lock:
+                self._records.append(rec)
+            self._q.task_done()
 
 
 def load_checkpoint(path, expect_config: HeatConfig | None = None

@@ -53,11 +53,23 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
 import warnings
 from typing import Optional
 
 SCHEMA_VERSION = 1
+
+# Bounded writer queue (async_io mode): deep enough that bursts (a
+# rollback's retry/rollback/chunk cluster) never block the run loop,
+# bounded so a wedged filesystem exerts backpressure instead of
+# growing an unbounded heap of pending events.
+_ASYNC_QUEUE_DEPTH = 1024
+# Events that must reach the heartbeat immediately, throttle or not:
+# an external probe reading a terminal state must never see a stale
+# mid-run heartbeat for up to min_interval afterwards.
+_FORCE_HEARTBEAT_EVENTS = ("run_end", "permanent_failure", "signal")
 
 
 def _process_info():
@@ -109,21 +121,36 @@ class Telemetry:
 
     ``path`` may be None for a heartbeat-only sink. The heartbeat file
     is rewritten atomically (tmp + rename) at most every
-    ``heartbeat_interval_s`` seconds, on each event, so an external
-    probe can ``stat``/read it without ever seeing a torn write::
+    ``heartbeat_interval_s`` seconds (the throttle ``min_interval``,
+    default 1 s — short chunks must not pay a write+rename per
+    boundary; terminal events and :meth:`close` force a final rewrite
+    so probes never read a stale end state), so an external probe can
+    ``stat``/read it without ever seeing a torn write::
 
         {"t_wall": ..., "t_mono": ..., "pid": ..., "step": ...,
-         "events": ..., "last_event": ...}
+         "events": ..., "last_event": ..., "interval_s": ...}
 
-    Use as a context manager or call :meth:`close`; either flushes and
-    closes the stream (events are flushed per line regardless, so a
-    SIGKILL loses at most the line being written).
+    ``async_io=True`` moves all file I/O (JSONL append + heartbeat
+    rename) to a bounded-queue background writer thread: ``emit``
+    stamps the envelope on the caller's clock and returns after an
+    enqueue, so the run loop never blocks on the filesystem (a full
+    queue — a wedged disk — exerts backpressure rather than dropping
+    events). Event order is the emit order either way. The default
+    stays synchronous: same-thread writes are simpler to reason about
+    for tests and short tools; the CLI and the pipelined stream opt
+    in.
+
+    Use as a context manager or call :meth:`close`; either drains the
+    writer (async mode), rewrites a final heartbeat, and closes the
+    stream (events are flushed per line regardless, so a SIGKILL loses
+    at most the lines still queued).
     """
 
     def __init__(self, path=None, heartbeat=None,
-                 heartbeat_interval_s: float = 0.0,
+                 heartbeat_interval_s: float = 1.0,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None,
+                 async_io: bool = False):
         if process_index is None or process_count is None:
             pi, pc = _process_info()
             process_index = pi if process_index is None else process_index
@@ -156,6 +183,20 @@ class Telemetry:
         self._last_step: Optional[int] = None
         self._last_residual: Optional[float] = None
         self._last_heartbeat_mono: Optional[float] = None
+        self._events_at_heartbeat = 0
+        # One lock around the write+state path: the async checkpointer
+        # and the writer thread emit from worker threads while the run
+        # loop emits from the main thread — interleaved JSONL lines
+        # must never tear each other.
+        self._io_lock = threading.RLock()
+        self._queue: Optional[queue.Queue] = None
+        self._writer: Optional[threading.Thread] = None
+        if async_io:
+            self._queue = queue.Queue(maxsize=_ASYNC_QUEUE_DEPTH)
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="telemetry-writer",
+                daemon=True)
+            self._writer.start()
         # Absolute-step offset for chunk events: solve_stream counts
         # steps from its own start, the supervisor restarts streams on
         # rollback — it sets this to each segment's base so events
@@ -165,9 +206,10 @@ class Telemetry:
     # -- core ------------------------------------------------------------
 
     def emit(self, event: str, **fields) -> None:
-        """Write one event line. Never raises: telemetry is an
-        observer, and an observer's disk-full must not kill the run —
-        the sink warns once and goes quiet instead."""
+        """Write one event line (enqueue it in ``async_io`` mode — the
+        envelope is stamped here, on the caller's clock). Never raises:
+        telemetry is an observer, and an observer's disk-full must not
+        kill the run — the sink warns once and goes quiet instead."""
         if self._dead:
             return
         rec = {"schema": SCHEMA_VERSION, "event": event,
@@ -175,29 +217,70 @@ class Telemetry:
                "process_index": self.process_index,
                "process_count": self.process_count}
         rec.update(fields)
-        try:
-            if self._f is not None:
-                self._f.write(json.dumps(rec) + "\n")
-                self._f.flush()
-        except (OSError, ValueError, TypeError) as e:
-            self._dead = True
-            warnings.warn(f"telemetry sink {self.path!r} disabled after "
-                          f"write failure: {e}", RuntimeWarning)
+        if self._queue is not None:
+            # Blocking put: a full queue (wedged filesystem) slows the
+            # run instead of silently dropping lifecycle events the
+            # chaos matrix certifies on.
+            self._queue.put(rec)
             return
-        self._events += 1
-        self._last_event = event
-        if "step" in fields:
-            self._last_step = fields["step"]
-        if fields.get("residual") is not None:
-            self._last_residual = fields["residual"]
-        self._maybe_heartbeat(rec["t_mono"])
+        self._write_record(rec)
 
-    def _maybe_heartbeat(self, t_mono: float) -> None:
+    def _writer_loop(self) -> None:
+        q = self._queue
+        while True:
+            rec = q.get()
+            if rec is None:  # close() sentinel
+                q.task_done()
+                return
+            try:
+                self._write_record(rec)
+            except Exception as e:  # noqa: BLE001 — a writer-thread
+                # crash must never take the run down OR wedge close()
+                if not self._dead:
+                    self._dead = True
+                    warnings.warn(
+                        f"telemetry writer thread disabled after "
+                        f"unexpected error: {e}", RuntimeWarning)
+            finally:
+                q.task_done()
+
+    def _write_record(self, rec) -> None:
+        """Serialize + append one record and update the heartbeat
+        state. Runs on the writer thread in ``async_io`` mode, inline
+        otherwise; the lock also serializes direct emits from other
+        threads (the async checkpointer's commit callback)."""
+        with self._io_lock:
+            if self._dead:
+                return
+            event = rec["event"]
+            try:
+                if self._f is not None:
+                    self._f.write(json.dumps(rec) + "\n")
+                    self._f.flush()
+            except (OSError, ValueError, TypeError) as e:
+                self._dead = True
+                warnings.warn(f"telemetry sink {self.path!r} disabled "
+                              f"after write failure: {e}",
+                              RuntimeWarning)
+                return
+            self._events += 1
+            self._last_event = event
+            if rec.get("step") is not None:
+                self._last_step = rec["step"]
+            if rec.get("residual") is not None:
+                self._last_residual = rec["residual"]
+            self._maybe_heartbeat(rec["t_mono"],
+                                  force=event in _FORCE_HEARTBEAT_EVENTS)
+
+    def _maybe_heartbeat(self, t_mono: float, force: bool = False) -> None:
         if self.heartbeat_path is None:
             return
-        if (self._last_heartbeat_mono is not None
+        if (not force and self._last_heartbeat_mono is not None
                 and t_mono - self._last_heartbeat_mono
                 < self.heartbeat_interval_s):
+            # Throttled (min_interval): short chunks must not pay a
+            # write+fsync-rename per boundary; close()/terminal events
+            # still publish the final state.
             return
         self.heartbeat()
 
@@ -205,32 +288,37 @@ class Telemetry:
         """Atomically rewrite the heartbeat file (tmp + rename — a
         reader never sees a torn write). Safe to call directly from a
         long host-side wait."""
-        if self.heartbeat_path is None or self._dead:
-            return
-        # `last_step`/`last_event`/`residual` make the heartbeat
-        # self-sufficient: an external liveness probe (or
-        # `tools/monitor.py --once`) can report progress without
-        # parsing the JSONL at all. `step` is kept as a legacy alias
-        # of `last_step`.
-        doc = {"t_wall": time.time(), "t_mono": time.monotonic(),
-               "pid": os.getpid(), "events": self._events,
-               "last_event": self._last_event, "step": self._last_step,
-               "last_step": self._last_step,
-               "residual": self._last_residual}
-        tmp = f"{self.heartbeat_path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, self.heartbeat_path)
-        except OSError as e:
-            # Disable ONLY the heartbeat: the JSONL stream is an
-            # independent sink and must keep its terminal run_end even
-            # when the probe file's filesystem goes away.
-            self.heartbeat_path = None
-            warnings.warn(f"telemetry heartbeat disabled after write "
-                          f"failure: {e}", RuntimeWarning)
-            return
-        self._last_heartbeat_mono = doc["t_mono"]
+        with self._io_lock:
+            if self.heartbeat_path is None or self._dead:
+                return
+            # `last_step`/`last_event`/`residual` make the heartbeat
+            # self-sufficient: an external liveness probe (or
+            # `tools/monitor.py --once`) can report progress without
+            # parsing the JSONL at all. `step` is kept as a legacy
+            # alias of `last_step`; `interval_s` tells probes how
+            # stale a healthy heartbeat may legitimately be.
+            doc = {"t_wall": time.time(), "t_mono": time.monotonic(),
+                   "pid": os.getpid(), "events": self._events,
+                   "last_event": self._last_event,
+                   "step": self._last_step,
+                   "last_step": self._last_step,
+                   "residual": self._last_residual,
+                   "interval_s": self.heartbeat_interval_s}
+            tmp = f"{self.heartbeat_path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                os.replace(tmp, self.heartbeat_path)
+            except OSError as e:
+                # Disable ONLY the heartbeat: the JSONL stream is an
+                # independent sink and must keep its terminal run_end
+                # even when the probe file's filesystem goes away.
+                self.heartbeat_path = None
+                warnings.warn(f"telemetry heartbeat disabled after "
+                              f"write failure: {e}", RuntimeWarning)
+                return
+            self._last_heartbeat_mono = doc["t_mono"]
+            self._events_at_heartbeat = self._events
 
     # -- typed events ----------------------------------------------------
 
@@ -283,12 +371,26 @@ class Telemetry:
 
     def chunk(self, *, step: int, steps: int, wall_s: float, cells: int,
               bytes_per_cell: int, residual=None, converged=None,
-              finite=None) -> None:
+              finite=None, gap_s=None, dispatch_s=None,
+              drain_wait_s=None, observe_s=None) -> None:
         """Emit one per-chunk progress event. ``step`` is absolute
         (``step_offset`` already applied by the caller or applied here
         via the offset the supervisor set); rates come from
         :class:`utils.profiling.StepStats` and are null when the chunk
-        wall time is too small to divide by."""
+        wall time is too small to divide by.
+
+        The optional pipeline-timing fields (included only when the
+        stream measured them): ``gap_s`` — device idle charged to this
+        chunk (sync loop: host time between the previous chunk's
+        completion and this dispatch, the observer/checkpoint/caller
+        tax; pipelined loop: the measured starvation lower bound from
+        the drain-time is_ready probe); ``dispatch_s`` — host
+        time inside the async dispatch call; ``drain_wait_s`` — host
+        time blocked waiting for this chunk's first scalar (the
+        device-bound signal: ~0 everywhere means the host, not the
+        device, is the bottleneck); ``observe_s`` — host time spent on
+        this chunk's observers after completion. ``tools/
+        metrics_report.py``'s pipeline section aggregates these."""
         from parallel_heat_tpu.utils.profiling import StepStats
 
         if wall_s > 0:
@@ -300,10 +402,16 @@ class Telemetry:
         else:
             rates = {"steps_per_s": None, "mcells_steps_per_s": None,
                      "hbm_gb_s": None}
+        timing = {k: v for k, v in (("gap_s", gap_s),
+                                    ("dispatch_s", dispatch_s),
+                                    ("drain_wait_s", drain_wait_s),
+                                    ("observe_s", observe_s))
+                  if v is not None}
         self.emit("chunk", step=self.step_offset + step, steps=steps,
                   wall_s=wall_s, cells=cells,
                   bytes_per_cell=bytes_per_cell, residual=residual,
-                  converged=converged, finite=finite, **rates)
+                  converged=converged, finite=finite, **rates,
+                  **timing)
 
     def diagnostics(self, *, step: int, **stats) -> None:
         """Emit one grid-diagnostics sample (``solver.grid_stats`` under
@@ -321,6 +429,22 @@ class Telemetry:
     # -- lifecycle -------------------------------------------------------
 
     def close(self) -> None:
+        """Drain the writer (async mode), publish a final heartbeat,
+        close the stream. Idempotent."""
+        if self._writer is not None:
+            # Sentinel + join: every queued record lands before the
+            # file closes. The timeout is defensive — a wedged disk
+            # must not hang process exit forever; the warn-once dead
+            # path inside the worker normally guarantees progress.
+            self._queue.put(None)
+            self._writer.join(timeout=30.0)
+            self._writer = None
+            self._queue = None
+        if (self.heartbeat_path is not None and not self._dead
+                and self._events > self._events_at_heartbeat):
+            # Events landed since the last (throttled) rewrite: the
+            # probe file must reflect the final state.
+            self.heartbeat()
         if self._f is not None:
             try:
                 self._f.close()

@@ -365,6 +365,107 @@ def test_nan_guard_trip_lands_in_telemetry_within_one_interval(tmp_path):
     assert lo < k <= hi
 
 
+def test_sigterm_with_async_save_in_flight_resumes_bitwise(tmp_path):
+    # ISSUE 5's new failure window: SIGTERM lands while an async
+    # checkpoint is IN FLIGHT (throttled saver holds every commit
+    # open). The interrupt barrier must drain it, the flushed state
+    # must land, and the resume from the last COMMITTED generation
+    # must finish bitwise like the uninterrupted run.
+    from parallel_heat_tpu.utils.checkpoint import AsyncCheckpointer
+
+    clean = solve(HeatConfig(steps=100, **_BASE))
+    stem = tmp_path / "ck"
+    saver = AsyncCheckpointer(keep=3, throttle_s=0.05)
+    try:
+        sres = run_supervised(HeatConfig(steps=100, **_BASE), stem,
+                              policy=_policy(), checkpointer=saver,
+                              faults=FaultPlan(
+                                  signal_at_chunk=3,
+                                  signum=int(signal.SIGTERM)))
+        assert sres.interrupted and sres.signal_name == "SIGTERM"
+        p = latest_checkpoint(stem)
+        assert p is not None
+        grid, step, _ = load_checkpoint(p, HeatConfig(steps=100, **_BASE))
+        assert step == sres.steps_done  # the flush COMMITTED
+        sres2 = run_supervised(HeatConfig(steps=100 - step, **_BASE),
+                               stem, policy=_policy(), initial=grid,
+                               start_step=step, checkpointer=saver)
+    finally:
+        saver.close()
+    assert sres2.steps_done == 100
+    np.testing.assert_array_equal(sres2.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_guard_trip_racing_async_save_never_restores_uncommitted(
+        tmp_path):
+    # The rollback barrier: a NaN trip with the previous boundary's
+    # save still in flight must drain BEFORE generation discovery —
+    # the telemetry stream shows checkpoint_barrier(reason=rollback)
+    # strictly before the rollback event, and recovery is bitwise.
+    import json
+
+    from parallel_heat_tpu.utils.checkpoint import AsyncCheckpointer
+
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    p = tmp_path / "t.jsonl"
+    saver = AsyncCheckpointer(keep=3, throttle_s=0.05)
+    try:
+        with Telemetry(p) as tel:
+            sres = run_supervised(HeatConfig(steps=60, **_BASE),
+                                  tmp_path / "ck", policy=_policy(),
+                                  checkpointer=saver, telemetry=tel,
+                                  faults=FaultPlan(nan_at_step=35))
+    finally:
+        saver.close()
+    assert sres.retries == 1 and sres.rollbacks == 1
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+    with open(p) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    rb_idx = next(i for i, e in enumerate(events)
+                  if e["event"] == "rollback")
+    assert any(e["event"] == "checkpoint_barrier"
+               and e["reason"] == "rollback"
+               for e in events[:rb_idx])
+    # the rollback landed on a committed generation at-or-before the
+    # corruption step
+    rb = events[rb_idx]
+    assert rb["step"] < 35
+
+
+def test_supervised_pipelined_stream_recovers_bitwise(tmp_path):
+    # The chaos bitwise-resume contract extended to pipeline_depth=2
+    # explicitly: supervised runs over the dispatch-ahead stream (with
+    # the async saver on, the default) recover from a mid-run NaN
+    # bitwise like the depth-1 loop does.
+    cfg = HeatConfig(steps=60, pipeline_depth=2, **_BASE)
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(cfg, tmp_path / "ck", policy=_policy(),
+                          faults=FaultPlan(nan_at_step=35))
+    assert sres.retries == 1 and sres.steps_done == 60
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_supervised_pipelined_sigterm_resume_bitwise(tmp_path):
+    cfg = HeatConfig(steps=100, pipeline_depth=2, **_BASE)
+    clean = solve(HeatConfig(steps=100, **_BASE))
+    stem = tmp_path / "ck"
+    sres = run_supervised(cfg, stem, policy=_policy(),
+                          faults=FaultPlan(signal_at_chunk=3,
+                                           signum=int(signal.SIGTERM)))
+    assert sres.interrupted
+    assert "--pipeline-depth 2" in sres.resume_command
+    grid, step, _ = load_checkpoint(latest_checkpoint(stem), cfg)
+    sres2 = run_supervised(cfg.replace(steps=100 - step), stem,
+                           policy=_policy(), initial=grid,
+                           start_step=step)
+    assert sres2.steps_done == 100
+    np.testing.assert_array_equal(sres2.result.to_numpy(),
+                                  clean.to_numpy())
+
+
 def test_fault_plan_determinism():
     plan = FaultPlan(transient_on_chunks=(1,))
     assert plan.before_chunk() == 0

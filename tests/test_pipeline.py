@@ -1,0 +1,574 @@
+"""Asynchronous stream pipeline: pipelined `solve_stream` dispatch,
+the background telemetry writer, the async checkpointer, and the
+pipeline section of tools/metrics_report.py — all under the
+dispatch-order-only contract (SEMANTICS.md "Pipelined stream"):
+pipelining changes WHEN the host observes, never WHAT ran — grids,
+observations, compiled programs, and checkpoint bytes are identical
+to the synchronous loop."""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import (
+    HeatConfig,
+    SupervisorPolicy,
+    Telemetry,
+    run_supervised,
+    solve,
+    solve_stream,
+)
+from parallel_heat_tpu.utils.checkpoint import (
+    AsyncCheckpointer,
+    generation_paths,
+    load_checkpoint,
+    save_generation,
+)
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- pipelined stream: dispatch-order-only contract -------------------------
+
+def test_pipelined_stream_bitwise_matches_sync_per_chunk():
+    cfg = HeatConfig(steps=50, **_BASE)
+    # The depth-1 contract: consume each grid BEFORE advancing (the
+    # next chunk donates it). Depth 2 yields protected copies, so the
+    # results can be held and compared afterwards.
+    sync_rs = [(r.steps_run, r.to_numpy())
+               for r in solve_stream(cfg, chunk_steps=10,
+                                     pipeline_depth=1)]
+    pipe_rs = list(solve_stream(cfg, chunk_steps=10, pipeline_depth=2))
+    assert [r.steps_run for r in pipe_rs] == \
+        [s for s, _ in sync_rs] == [10, 20, 30, 40, 50]
+    for (_, a), b in zip(sync_rs, pipe_rs):
+        np.testing.assert_array_equal(a, b.to_numpy())
+
+
+def test_pipelined_shares_compiled_programs():
+    # The acceptance contract: zero new _build_runner misses — every
+    # depth runs the same compiled-program family (pipeline_depth is
+    # stripped from cache keys like the guard).
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(steps=30, **_BASE)
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=10,
+                                                pipeline_depth=1)]
+    misses = solver._build_runner.cache_info().misses
+    piped = [r.to_numpy()
+             for r in solve_stream(cfg.replace(pipeline_depth=3),
+                                   chunk_steps=10)]
+    assert solver._build_runner.cache_info().misses == misses
+    for a, b in zip(plain, piped):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pipelined_yielded_grids_survive_advancing():
+    # At depth >= 2 every yielded grid is a donation-protected copy:
+    # consuming it AFTER the generator advanced (the depth-1 contract
+    # forbids this) still reads the correct boundary values.
+    cfg = HeatConfig(steps=40, **_BASE)
+    held = list(solve_stream(cfg, chunk_steps=10, pipeline_depth=2))
+    sync = [r.to_numpy()
+            for r in solve_stream(cfg, chunk_steps=10, pipeline_depth=1)]
+    for r, want in zip(held, sync):
+        np.testing.assert_array_equal(r.to_numpy(), want)
+
+
+def test_pipelined_guard_and_diag_match_sync():
+    cfg = HeatConfig(steps=60, guard_interval=20, diag_interval=20,
+                     **_BASE)
+    sync_rs = list(solve_stream(cfg, chunk_steps=10, pipeline_depth=1))
+    pipe_rs = list(solve_stream(cfg, chunk_steps=10, pipeline_depth=2))
+    assert [r.finite for r in pipe_rs] == [r.finite for r in sync_rs] \
+        == [None, True, None, True, None, True]
+    for a, b in zip(sync_rs, pipe_rs):
+        if a.diagnostics is None:
+            assert b.diagnostics is None
+            continue
+        # Same fused reduction over bitwise-identical grids -> the
+        # observed values must be exactly equal, field by field.
+        assert a.diagnostics == b.diagnostics
+
+
+def test_pipelined_guard_detects_blowup():
+    cfg = HeatConfig(steps=60, cx=5.0, cy=5.0, guard_interval=10,
+                     pipeline_depth=2, **_BASE)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flags = [(r.steps_run, r.finite)
+                 for r in solve_stream(cfg, chunk_steps=10)]
+    assert all(f is not None for _, f in flags)
+    assert any(f is False for _, f in flags)
+    assert any("runtime guard" in str(x.message) for x in w)
+
+
+def test_resolved_pipeline_depth_auto():
+    from parallel_heat_tpu.solver import resolved_pipeline_depth
+
+    fixed = HeatConfig(steps=10, **_BASE)
+    conv = HeatConfig(steps=10, converge=True, **_BASE)
+    # The CPU test backend has no idle device for dispatch-ahead to
+    # keep busy: auto resolves to 1 (2 on tpu/gpu fixed-step runs).
+    assert resolved_pipeline_depth(fixed) == 1
+    assert resolved_pipeline_depth(conv) == 1
+    # explicit values win, argument over config field
+    assert resolved_pipeline_depth(fixed, 3) == 3
+    assert resolved_pipeline_depth(fixed.replace(pipeline_depth=2)) == 2
+
+
+def test_pipeline_depth_validation():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        HeatConfig(pipeline_depth=0, **_BASE).validate()
+    with pytest.raises(ValueError, match="fixed-step only"):
+        HeatConfig(converge=True, pipeline_depth=2, **_BASE).validate()
+    with pytest.raises(ValueError, match="fixed-step only"):
+        next(solve_stream(HeatConfig(steps=40, converge=True, **_BASE),
+                          chunk_steps=20, pipeline_depth=2))
+    # converge mode auto-resolves to depth 1 and still converges
+    cfg = HeatConfig(nx=12, ny=12, steps=10_000, converge=True,
+                     check_interval=20, backend="jnp")
+    results = list(solve_stream(cfg, chunk_steps=500))
+    assert results[-1].converged
+
+
+def test_pipelined_f32chunk_matches_one_shot():
+    # Stream boundaries stay K-aligned rounding points under f32chunk
+    # regardless of depth (SEMANTICS.md) — the pipelined stream must be
+    # bitwise the one-shot run, like the sync stream is.
+    kw = dict(nx=16, ny=128, steps=80, backend="jnp",
+              dtype="bfloat16", accumulate="f32chunk")
+    direct = solve(HeatConfig(**kw))
+    last = None
+    for last in solve_stream(HeatConfig(**kw), chunk_steps=32,
+                             pipeline_depth=2):
+        pass
+    assert last.steps_run == 80
+    np.testing.assert_array_equal(last.to_numpy(), direct.to_numpy())
+
+
+def test_pipelined_sharded_stream_matches_sync():
+    kw = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 2))
+    cfg = HeatConfig(steps=40, **kw)
+    sync_rs = [r.to_numpy()
+               for r in solve_stream(cfg, chunk_steps=10,
+                                     pipeline_depth=1)]
+    pipe_rs = [r.to_numpy()
+               for r in solve_stream(cfg, chunk_steps=10,
+                                     pipeline_depth=2)]
+    for a, b in zip(sync_rs, pipe_rs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_explain_reports_pipeline():
+    from parallel_heat_tpu.solver import explain
+
+    out = explain(HeatConfig(steps=10, pipeline_depth=2, **_BASE))
+    assert "depth 2" in out["pipeline"]
+    assert "pipeline" not in explain(HeatConfig(steps=10, **_BASE))
+
+
+def test_pipelined_chunk_events_carry_timing_fields(tmp_path):
+    p = tmp_path / "pipe.jsonl"
+    cfg = HeatConfig(steps=30, **_BASE)
+    with Telemetry(p) as tel:
+        for _ in solve_stream(cfg, chunk_steps=10, telemetry=tel,
+                              pipeline_depth=2):
+            pass
+    ev = _events(p)
+    assert ev[0]["event"] == "run_header"
+    assert ev[0]["pipeline_depth"] == 2
+    chunks = [e for e in ev if e["event"] == "chunk"]
+    assert len(chunks) == 3
+    for c in chunks:
+        # gap_s is the measured device-starvation lower bound (zero
+        # when the pipeline stayed fed, positive when every dispatched
+        # chunk finished while the host was still processing)
+        assert c["gap_s"] >= 0.0
+        assert c["dispatch_s"] >= 0
+        assert c["drain_wait_s"] >= 0
+        assert c["observe_s"] >= 0
+    # the sync loop reports its idle gap + observer cost instead
+    q = tmp_path / "sync.jsonl"
+    with Telemetry(q) as tel:
+        for _ in solve_stream(cfg, chunk_steps=10, telemetry=tel,
+                              pipeline_depth=1):
+            pass
+    sync_chunks = [e for e in _events(q) if e["event"] == "chunk"]
+    assert all("drain_wait_s" not in c for c in sync_chunks)
+    assert all(c["gap_s"] >= 0 and c["observe_s"] >= 0
+               for c in sync_chunks)
+
+
+# -- async telemetry writer --------------------------------------------------
+
+def test_async_writer_preserves_order_and_drains_on_close(tmp_path):
+    p = tmp_path / "a.jsonl"
+    with Telemetry(p, async_io=True) as tel:
+        for i in range(50):
+            tel.emit("chunk", step=i)
+        tel.run_end(outcome="complete")
+    ev = _events(p)
+    assert [e["step"] for e in ev if e["event"] == "chunk"] \
+        == list(range(50))
+    assert ev[-1]["event"] == "run_end"
+
+
+def test_async_writer_matches_sync_stream_content(tmp_path):
+    cfg = HeatConfig(steps=30, **_BASE)
+    a, b = tmp_path / "sync.jsonl", tmp_path / "async.jsonl"
+    with Telemetry(a) as tel:
+        for _ in solve_stream(cfg, chunk_steps=10, telemetry=tel,
+                              pipeline_depth=1):
+            pass
+    with Telemetry(b, async_io=True) as tel:
+        for _ in solve_stream(cfg, chunk_steps=10, telemetry=tel,
+                              pipeline_depth=1):
+            pass
+    ka = [(e["event"], e.get("step")) for e in _events(a)]
+    kb = [(e["event"], e.get("step")) for e in _events(b)]
+    assert ka == kb
+
+
+def test_async_writer_failure_warns_once_and_goes_quiet(tmp_path):
+    tel = Telemetry(tmp_path / "a.jsonl", async_io=True)
+    tel._f.close()  # yank the stream out from under the writer thread
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for i in range(5):
+            tel.emit("chunk", step=i)
+        tel.close()  # joins the writer: the warning landed by now
+    assert sum("telemetry sink" in str(x.message) for x in w) == 1
+    tel.emit("chunk", step=99)  # dead sink: silent no-op
+
+
+# -- heartbeat throttle ------------------------------------------------------
+
+def test_heartbeat_throttled_by_min_interval(tmp_path):
+    hb = tmp_path / "hb.json"
+    with Telemetry(tmp_path / "m.jsonl", heartbeat=hb,
+                   heartbeat_interval_s=3600.0) as tel:
+        tel.emit("chunk", step=1)
+        first = json.load(open(hb))
+        assert first["events"] == 1
+        assert first["interval_s"] == 3600.0
+        tel.emit("chunk", step=2)
+        tel.emit("chunk", step=3)
+        # throttled: the file still shows the first write
+        assert json.load(open(hb))["events"] == 1
+        # terminal events force a rewrite through the throttle
+        tel.emit("run_end", outcome="complete")
+        forced = json.load(open(hb))
+        assert forced["events"] == 4
+        assert forced["last_event"] == "run_end"
+        tel.emit("chunk", step=4)
+    # close() publishes the final state regardless of the interval
+    final = json.load(open(hb))
+    assert final["events"] == 5 and final["last_step"] == 4
+
+
+# -- async checkpointer ------------------------------------------------------
+
+def test_async_checkpointer_commits_in_order_and_matches_sync(tmp_path):
+    cfg = HeatConfig(steps=30, **_BASE)
+    grids = {r.steps_run: r.grid
+             for r in solve_stream(cfg, chunk_steps=10,
+                                   pipeline_depth=2)}
+    sync_stem = tmp_path / "sync_ck"
+    for step, g in grids.items():
+        save_generation(sync_stem, g, step, cfg, keep=3)
+    saver = AsyncCheckpointer(keep=3)
+    try:
+        for step, g in grids.items():
+            saver.submit(tmp_path / "async_ck", g, step, cfg)
+        saver.drain()
+    finally:
+        saver.close()
+    sync_gens = generation_paths(sync_stem)
+    async_gens = generation_paths(tmp_path / "async_ck")
+    assert [s for s, _ in async_gens] == [s for s, _ in sync_gens] \
+        == [10, 20, 30]
+    for (_, sp), (_, ap) in zip(sync_gens, async_gens):
+        gs, ss, _ = load_checkpoint(sp)
+        ga, sa, _ = load_checkpoint(ap)
+        assert ss == sa
+        np.testing.assert_array_equal(np.asarray(gs), np.asarray(ga))
+    assert all(r["path"] and not r["skipped"] for r in saver.records)
+
+
+def test_async_checkpointer_skips_non_finite_snapshot(tmp_path):
+    import jax.numpy as jnp
+
+    cfg = HeatConfig(steps=10, **_BASE)
+    good = jnp.ones((16, 16), jnp.float32)
+    bad = good.at[3, 3].set(jnp.nan)
+    saver = AsyncCheckpointer(keep=3)
+    try:
+        saver.submit(tmp_path / "ck", good, 10, cfg)
+        saver.submit(tmp_path / "ck", bad, 20, cfg)
+        saver.drain()
+    finally:
+        saver.close()
+    # the commit gate held: the bad generation never landed, the good
+    # one stays newest — rollback targets remain verified-good
+    assert [s for s, _ in generation_paths(tmp_path / "ck")] == [10]
+    recs = saver.records
+    assert recs[0]["skipped"] is False and recs[1]["skipped"] is True
+
+
+def test_async_checkpointer_surfaces_worker_error_at_drain(tmp_path):
+    import jax.numpy as jnp
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    cfg = HeatConfig(steps=10, **_BASE)
+    saver = AsyncCheckpointer(keep=3)
+    try:
+        # stem under a FILE: the worker's save must fail, and the
+        # failure must surface at the barrier — the same place a
+        # synchronous save would have raised
+        saver.submit(blocker / "sub" / "ck",
+                     jnp.ones((16, 16), jnp.float32), 10, cfg)
+        with pytest.raises(OSError):
+            saver.drain()
+    finally:
+        saver.close()
+
+
+# -- supervisor integration --------------------------------------------------
+
+def test_supervisor_async_saves_match_sync_generations(tmp_path):
+    cfg = HeatConfig(steps=60, **_BASE)
+    kw = dict(checkpoint_every=20, guard_interval=10, backoff_base_s=0.0)
+    s_sync = run_supervised(
+        cfg, tmp_path / "sync",
+        policy=SupervisorPolicy(async_checkpoint=False, **kw))
+    s_async = run_supervised(
+        cfg, tmp_path / "async",
+        policy=SupervisorPolicy(async_checkpoint=True, **kw))
+    assert s_async.checkpoints_written == s_sync.checkpoints_written
+    sg = generation_paths(tmp_path / "sync")
+    ag = generation_paths(tmp_path / "async")
+    assert [s for s, _ in ag] == [s for s, _ in sg] == [20, 40, 60]
+    for (_, sp), (_, ap) in zip(sg, ag):
+        gs, ss, _ = load_checkpoint(sp)
+        ga, sa, _ = load_checkpoint(ap)
+        assert ss == sa
+        np.testing.assert_array_equal(np.asarray(gs), np.asarray(ga))
+    np.testing.assert_array_equal(s_async.result.to_numpy(),
+                                  s_sync.result.to_numpy())
+
+
+def test_supervisor_async_final_state_drained_before_return(tmp_path):
+    # A throttled saver holds every commit open ~50 ms: the completion
+    # barrier must still deliver all generations (and accurate counts)
+    # by the time run_supervised returns.
+    saver = AsyncCheckpointer(keep=3, throttle_s=0.05)
+    try:
+        sres = run_supervised(
+            HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+            policy=SupervisorPolicy(checkpoint_every=20,
+                                    backoff_base_s=0.0),
+            checkpointer=saver)
+    finally:
+        saver.close()
+    assert sres.checkpoints_written == 4  # gen 0 + 20/40/60
+    assert [s for s, _ in generation_paths(tmp_path / "ck")] \
+        == [20, 40, 60]
+    assert str(sres.last_checkpoint).endswith(
+        ".g000000000060.npz")
+
+
+def test_stall_verdict_not_masked_by_failed_async_save(tmp_path):
+    # A worker error pending at the stall classifier's barrier must not
+    # replace the PermanentFailure(kind="stalled") verdict: both the
+    # stall-path barrier and fail()'s barrier swallow saver errors so
+    # the diagnosis (and the run_end telemetry) still land.
+    from parallel_heat_tpu import PermanentFailure
+
+    class _ExplodingSaver(AsyncCheckpointer):
+        def drain(self):
+            super().drain()
+            raise OSError("disk full (injected)")
+
+    u0 = np.zeros((16, 16), np.float32)
+    u0[0, :] = 1000.0
+    cfg = HeatConfig(steps=3500, converge=True, check_interval=10,
+                     eps=1e-6, **_BASE)
+    saver = _ExplodingSaver(keep=3)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with pytest.raises(PermanentFailure) as ei:
+                run_supervised(
+                    cfg, tmp_path / "ck",
+                    policy=SupervisorPolicy(checkpoint_every=500,
+                                            guard_interval=250,
+                                            stall_windows=3,
+                                            backoff_base_s=0.0),
+                    initial=u0, checkpointer=saver)
+    finally:
+        saver.close()
+    assert ei.value.kind == "stalled"
+    assert "residual stalled" in ei.value.diagnosis
+
+
+def test_stall_emits_single_failure_barrier(tmp_path):
+    # One logical drain -> one checkpoint_barrier event: the stall path
+    # drains before building its diagnosis and fail() must not drain
+    # (and emit) a second time.
+    from parallel_heat_tpu import PermanentFailure
+
+    u0 = np.zeros((16, 16), np.float32)
+    u0[0, :] = 1000.0
+    cfg = HeatConfig(steps=3500, converge=True, check_interval=10,
+                     eps=1e-6, **_BASE)
+    m = tmp_path / "m.jsonl"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with Telemetry(m) as tel:
+            with pytest.raises(PermanentFailure):
+                run_supervised(
+                    cfg, tmp_path / "ck",
+                    policy=SupervisorPolicy(checkpoint_every=500,
+                                            guard_interval=250,
+                                            stall_windows=3,
+                                            backoff_base_s=0.0),
+                    initial=u0, telemetry=tel)
+    barriers = [e for e in _events(m)
+                if e["event"] == "checkpoint_barrier"]
+    assert [b["reason"] for b in barriers] == ["failure"]
+
+
+def test_cli_pipeline_depth_flag(tmp_path, capsys):
+    from parallel_heat_tpu.cli import main
+    from parallel_heat_tpu.utils.io import read_dat
+
+    assert main(["--nx", "16", "--ny", "16", "--steps", "10",
+                 "--pipeline-depth", "bogus"]) == 2
+    assert "--pipeline-depth" in capsys.readouterr().err
+    assert main(["--nx", "16", "--ny", "16", "--steps", "10",
+                 "--converge", "--pipeline-depth", "2"]) == 2
+    assert "fixed-step only" in capsys.readouterr().err
+    out1, out2 = tmp_path / "d1.dat", tmp_path / "d2.dat"
+    for depth, out in (("1", out1), ("2", out2)):
+        assert main(["--nx", "16", "--ny", "16", "--steps", "40",
+                     "--backend", "jnp", "--checkpoint",
+                     str(tmp_path / f"ck{depth}"),
+                     "--checkpoint-every", "10",
+                     "--pipeline-depth", depth,
+                     "--out", str(out), "--quiet"]) == 0
+    np.testing.assert_array_equal(read_dat(out1), read_dat(out2))
+
+
+def test_resume_command_carries_pipeline_depth(tmp_path):
+    from parallel_heat_tpu.supervisor import _resume_command
+    from parallel_heat_tpu.utils.checkpoint import checkpoint_stem
+
+    cfg = HeatConfig(steps=100, pipeline_depth=2, **_BASE)
+    policy = SupervisorPolicy(async_checkpoint=False).validate()
+    cmd = _resume_command(cfg, checkpoint_stem(tmp_path / "ck"), 100,
+                          policy)
+    assert "--pipeline-depth 2" in cmd
+    assert "--no-async-checkpoint" in cmd
+
+
+# -- metrics_report pipeline section -----------------------------------------
+
+def _report(args):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(_ROOT, "tools", "metrics_report.py")] + args,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_metrics_report_pipeline_section(tmp_path):
+    # explicit depth 2: auto resolves to 1 on the CPU test backend
+    cfg = HeatConfig(steps=60, pipeline_depth=2, **_BASE)
+    m = tmp_path / "m.jsonl"
+    saver = AsyncCheckpointer(keep=3, throttle_s=0.02)
+    try:
+        with Telemetry(m, async_io=True) as tel:
+            sres = run_supervised(
+                cfg, tmp_path / "ck",
+                policy=SupervisorPolicy(checkpoint_every=20,
+                                        guard_interval=10,
+                                        backoff_base_s=0.0),
+                telemetry=tel, checkpointer=saver)
+    finally:
+        saver.close()
+    assert sres.steps_done == 60
+    rep = _report([str(m), "--json"])
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    pl = doc["pipeline"]
+    assert pl["mode"] == "pipelined"
+    assert 0 < pl["device_busy_frac"] <= 1
+    assert pl["observer_drain_s"]["p90"] >= 0
+    assert pl["device_wait_s"]["p90"] >= 0
+    ck = doc["checkpoints"]
+    assert ck["async_saves"] == ck["saves"] == 4
+    assert ck["async_overlap_share"] is not None
+    # a throttled saver makes the final barrier wait measurable
+    assert ck["barrier_wait_s"] > 0
+    # busy threshold drives the exit code: an impossible floor fails
+    bad = _report([str(m), "--fail-on", "busy<1.01"])
+    assert bad.returncode == 2 and "ANOMALY" in bad.stdout
+    ok = _report([str(m), "--fail-on", "permanent_failure,busy<0.1"])
+    assert ok.returncode == 0
+
+
+def test_metrics_report_mixed_mode_stream(tmp_path):
+    # A multi-segment stream can mix modes (a pipelined run resumed at
+    # depth 1): each chunk must contribute under its own bracket
+    # semantics — pipelined walls CONTAIN their gap, sync walls don't.
+    m = tmp_path / "mixed.jsonl"
+    lines = [json.dumps({"schema": 1, "event": "run_header",
+                         "t_wall": 1.0, "t_mono": 1.0,
+                         "config": {"nx": 16, "ny": 16, "steps": 40}})]
+    for i in range(2):  # pipelined segment: busy 1.0 of 1.0 each
+        lines.append(json.dumps({
+            "schema": 1, "event": "chunk", "t_wall": 2.0 + i,
+            "t_mono": 2.0 + i, "step": 10 * (i + 1), "steps": 10,
+            "wall_s": 1.0, "gap_s": 0.0, "dispatch_s": 0.001,
+            "drain_wait_s": 0.9, "observe_s": 0.01}))
+    for i in range(2):  # sync segment: busy 1.0 of 2.0 each
+        lines.append(json.dumps({
+            "schema": 1, "event": "chunk", "t_wall": 4.0 + i,
+            "t_mono": 4.0 + i, "step": 30 + 10 * i, "steps": 10,
+            "wall_s": 1.0, "gap_s": 1.0, "observe_s": 0.5}))
+    m.write_text("\n".join(lines) + "\n")
+    rep = _report([str(m), "--json"])
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    pl = json.loads(rep.stdout)["pipeline"]
+    assert pl["mode"] == "mixed"
+    # (1 + 1 + 1 + 1) busy over (1 + 1 + 2 + 2) available
+    assert pl["device_busy_frac"] == pytest.approx(4 / 6)
+
+
+def test_metrics_report_busy_threshold_without_timing_fields(tmp_path):
+    # A pre-pipeline stream has no gap/drain fields: asking for a busy
+    # floor on it must be an anomaly, not a silent pass.
+    m = tmp_path / "old.jsonl"
+    lines = [json.dumps({"schema": 1, "event": "run_header",
+                         "t_wall": 1.0, "t_mono": 1.0,
+                         "config": {"nx": 16, "ny": 16, "steps": 10}}),
+             json.dumps({"schema": 1, "event": "chunk", "t_wall": 2.0,
+                         "t_mono": 2.0, "step": 10, "steps": 10,
+                         "wall_s": 0.01})]
+    m.write_text("\n".join(lines) + "\n")
+    rep = _report([str(m), "--fail-on", "busy<0.5"])
+    assert rep.returncode == 2
+    assert "no per-chunk timing fields" in rep.stdout

@@ -156,9 +156,10 @@ def test_telemetry_does_not_change_compiled_programs(tmp_path):
     # The acceptance contract: telemetry/annotation-enabled runs share
     # (and are bitwise identical to) un-instrumented executables — the
     # same regression the guard pins, extended to the telemetry layer
-    # AND the diagnostics layer: the fully-instrumented run below adds
-    # a diag_interval on top of the sink, and must still hit only the
-    # plain run's cached runners.
+    # AND the diagnostics layer AND the pipelined dispatch loop: the
+    # fully-instrumented runs below add a diag_interval on top of the
+    # sink (one at pipeline_depth=1, one at pipeline_depth=2) and must
+    # still hit only the plain run's cached runners.
     from parallel_heat_tpu import solver
 
     cfg = HeatConfig(steps=30, **_BASE)
@@ -170,15 +171,24 @@ def test_telemetry_does_not_change_compiled_programs(tmp_path):
         instr = [r.to_numpy()
                  for r in solve_stream(cfg.replace(diag_interval=10),
                                        chunk_steps=10,
-                                       telemetry=tel)]
+                                       telemetry=tel,
+                                       pipeline_depth=1)]
+    with Telemetry(tmp_path / "p.jsonl", async_io=True) as tel:
+        piped = [r.to_numpy()
+                 for r in solve_stream(
+                     cfg.replace(diag_interval=10, pipeline_depth=2),
+                     chunk_steps=10, telemetry=tel)]
     assert solver._build_runner.cache_info().misses == misses_before
-    for a, b in zip(plain, instr):
+    for a, b, c in zip(plain, instr, piped):
         np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
     # and the diagnostics events actually landed (the contract is not
-    # vacuous: instrumentation ran, programs still shared)
-    diags = [e for e in _events(tmp_path / "t.jsonl")
-             if e["event"] == "diagnostics"]
-    assert [d["step"] for d in diags] == [10, 20, 30]
+    # vacuous: instrumentation ran, programs still shared) — from BOTH
+    # instrumented runs, at the same boundaries
+    for name in ("t.jsonl", "p.jsonl"):
+        diags = [e for e in _events(tmp_path / name)
+                 if e["event"] == "diagnostics"]
+        assert [d["step"] for d in diags] == [10, 20, 30]
 
 
 def test_telemetry_survives_unwritable_sink(tmp_path):

@@ -38,6 +38,25 @@ def test_bench_importable_and_baseline_set():
         sys.path.remove(_ROOT)
 
 
+def test_bench_stream_row_smoke():
+    # The --row stream512 protocol at a toy size: one JSON line with
+    # the bare/sync/pipelined walls and both overhead fractions — the
+    # numbers the BENCH artifact records at real scale.
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--row", "stream512", "--backend", "jnp",
+         "--stream-size", "64", "--stream-steps", "200",
+         "--stream-chunk", "50"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    for k in ("wall_bare_s", "wall_sync_s", "wall_pipelined_s",
+              "overhead_sync_frac", "overhead_pipelined_frac"):
+        assert isinstance(row[k], float)
+    assert row["wall_bare_s"] > 0
+
+
 def test_ab_uni_single_smoke(tmp_path):
     # The windowed-vs-uniform A/B harness must run end to end (tiny
     # grid, interpret-mode kernels) and emit its JSON artifact with
@@ -287,10 +306,16 @@ def test_chaos_matrix_dryrun_smoke(tmp_path):
     # classified stalled (not nan/transient) within K windows
     assert outcomes["spike_drift"] == "recovered"
     assert outcomes["stalled_converge"] == "halted"
+    # the async-save race cells (throttled AsyncCheckpointer): SIGTERM
+    # with a save in flight resumes bit-exactly, and a guard trip's
+    # rollback drains before generation discovery
+    assert outcomes["sigterm_async"] == "interrupted+resumed"
+    assert outcomes["nan_async_race"] == "recovered"
     by_fault = {r["fault"]: r for r in doc["rows"]}
     assert by_fault["stalled_converge"]["kind"] == "stalled"
     assert by_fault["stalled_converge"]["telemetry_stall_ok"] is True
     assert by_fault["spike_drift"]["telemetry_drift_ok"] is True
+    assert by_fault["nan_async_race"]["telemetry_barrier_ok"] is True
     assert all(r.get("bitwise_match", True) for r in doc["rows"])
     # every cell left a parseable event stream, and the NaN cells'
     # guard trips are visible in it within one guard_interval

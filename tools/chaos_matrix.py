@@ -27,14 +27,21 @@ appear as a ``progress_trip`` with kind ``drift`` — never a nan
 guard_trip — within one window (``telemetry_drift_ok``), and the
 deterministically stalled converge cell (eps below the f32-reachable
 floor) must be classified ``stalled`` within exactly
-``stall_windows`` windows (``telemetry_stall_ok``).
+``stall_windows`` windows (``telemetry_stall_ok``). The async-save
+race cells (``sigterm_async`` / ``nan_async_race``) run a THROTTLED
+``AsyncCheckpointer`` so the injected signal / guard trip lands while
+a checkpoint is in flight: the interrupt/rollback barriers must drain
+it — a resume loads the last COMMITTED generation bit-exactly and a
+rollback never restores an uncommitted one, certified by the
+``checkpoint_barrier`` event preceding the first ``rollback`` in the
+stream (``telemetry_barrier_ok``).
 
 ``--dryrun`` runs the tiny CPU matrix (16x16, 60 steps; the stalled
 cell runs its own 3500-step converge schedule) and is the
 committed-artifact entry point:
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --dryrun \
-        --json chaos_r9_dryrun.json
+        --json chaos_r10_dryrun.json
 
 The same sweep runs unchanged on a TPU at real sizes (--size/--steps);
 the supervisor under test is host-side orchestration, so the CPU
@@ -77,6 +84,18 @@ def _faults_for(name, guard_interval, steps):
         return FaultPlan(spike_at_step=mid)
     if name == "stalled_converge":
         return None  # the fault is the config (eps below the f32 floor)
+    if name == "sigterm_async":
+        # SIGTERM while an async checkpoint is IN FLIGHT (the cell runs
+        # a throttled AsyncCheckpointer to hold the save open): the
+        # interrupt barrier must drain it, and the resume must load the
+        # last COMMITTED generation bit-exactly.
+        return FaultPlan(signal_at_chunk=2, signum=int(signal.SIGTERM))
+    if name == "nan_async_race":
+        # A guard trip racing an in-flight save: the rollback barrier
+        # must drain before generation discovery, so rollback can never
+        # restore an uncommitted generation (and the run still recovers
+        # bitwise).
+        return FaultPlan(nan_at_step=mid)
     raise ValueError(name)
 
 
@@ -119,6 +138,16 @@ def run_cell(fault, policy_kw, size, steps, workdir):
     stem = os.path.join(workdir, f"ck_{fault}")
     tel_path = os.path.join(workdir, f"telemetry_{fault}.jsonl")
     faults = _faults_for(fault, policy.guard_interval, steps)
+    checkpointer = None
+    if fault in ("sigterm_async", "nan_async_race"):
+        # Throttled async saver: every commit is held open ~50 ms, so
+        # the injected signal / guard trip reliably lands while a save
+        # is IN FLIGHT — the barrier contract's race window, widened
+        # until it is deterministic.
+        from parallel_heat_tpu.utils.checkpoint import AsyncCheckpointer
+
+        checkpointer = AsyncCheckpointer(
+            keep=policy.keep_checkpoints, throttle_s=0.05)
     row = {"fault": fault, "policy": dict(policy_kw)}
     with warnings.catch_warnings():
         warnings.simplefilter("ignore", RuntimeWarning)
@@ -128,7 +157,8 @@ def run_cell(fault, policy_kw, size, steps, workdir):
             with Telemetry(tel_path) as tel:
                 sres = run_supervised(cfg, stem, policy=policy,
                                       initial=initial, faults=faults,
-                                      telemetry=tel)
+                                      telemetry=tel,
+                                      checkpointer=checkpointer)
             if sres.interrupted:
                 p = latest_checkpoint(stem)
                 grid, step, _ = load_checkpoint(p, cfg)
@@ -136,7 +166,8 @@ def run_cell(fault, policy_kw, size, steps, workdir):
                     sres = run_supervised(cfg.replace(steps=steps - step),
                                           stem, policy=policy,
                                           initial=grid, start_step=step,
-                                          telemetry=tel)
+                                          telemetry=tel,
+                                          checkpointer=checkpointer)
                 row["outcome"] = "interrupted+resumed"
             elif sres.retries:
                 row["outcome"] = "recovered"
@@ -163,6 +194,9 @@ def run_cell(fault, policy_kw, size, steps, workdir):
             row["outcome"] = "halted"
             row["diagnosis"] = str(e)
             row["kind"] = e.kind
+        finally:
+            if checkpointer is not None:
+                checkpointer.close()
     row.update(_telemetry_summary(tel_path, faults, policy))
     return row
 
@@ -224,6 +258,19 @@ def _telemetry_summary(tel_path, faults, policy):
         if trips:
             out["telemetry_stall_step"] = trips[0]["step"]
             out["telemetry_stall_window"] = trips[0].get("window")
+    if policy.async_checkpoint and any(e["event"] == "rollback"
+                                       for e in events):
+        # The async-save barrier contract: every rollback must have
+        # drained in-flight saves BEFORE loading (so an uncommitted
+        # generation can never be restored) — certified on the
+        # artifact by the checkpoint_barrier event preceding the
+        # rollback in the stream.
+        idx = next(i for i, e in enumerate(events)
+                   if e["event"] == "rollback")
+        out["telemetry_barrier_ok"] = any(
+            e["event"] == "checkpoint_barrier"
+            and e.get("reason") == "rollback"
+            for e in events[:idx])
     if policy.drift_tolerance is not None and faults is not None \
             and faults.spike_at_step is not None:
         trips = [e for e in events if e["event"] == "progress_trip"
@@ -244,7 +291,8 @@ def _telemetry_summary(tel_path, faults, policy):
 
 
 FAULTS = ("none", "nan_transient", "nan_recurring", "transient_error",
-          "sigterm", "unstable", "spike_drift", "stalled_converge")
+          "sigterm", "unstable", "spike_drift", "stalled_converge",
+          "sigterm_async", "nan_async_race")
 
 
 def main():
@@ -300,6 +348,14 @@ def main():
         "spike_drift": ("bitwise_match", "telemetry_ok",
                         "telemetry_drift_ok"),
         "stalled_converge": ("telemetry_ok", "telemetry_stall_ok"),
+        # The async-save race cells (throttled checkpointer holds every
+        # save in flight): SIGTERM drains + resumes bit-exactly; a
+        # guard trip's rollback drains BEFORE generation discovery
+        # (telemetry_barrier_ok) and still recovers bitwise.
+        "sigterm_async": ("bitwise_match", "telemetry_ok"),
+        "nan_async_race": ("bitwise_match", "detect_lag_ok",
+                           "telemetry_ok", "telemetry_detect_lag_ok",
+                           "telemetry_barrier_ok"),
     }
     by_fault = {r["fault"]: r for r in rows}
     ok = (all(by_fault[f].get(k) is True
@@ -309,7 +365,10 @@ def main():
           and by_fault["nan_transient"]["outcome"] == "recovered"
           and by_fault["spike_drift"]["outcome"] == "recovered"
           and by_fault["stalled_converge"]["outcome"] == "halted"
-          and by_fault["stalled_converge"].get("kind") == "stalled")
+          and by_fault["stalled_converge"].get("kind") == "stalled"
+          and by_fault["sigterm_async"]["outcome"]
+          == "interrupted+resumed"
+          and by_fault["nan_async_race"]["outcome"] == "recovered")
     print(f"matrix {'OK' if ok else 'VIOLATION'}: "
           f"{sum(1 for r in rows if r['outcome'] != 'halted')} "
           f"completed/recovered, "

@@ -18,7 +18,19 @@ from machine-readable events instead of read off a trace viewer:
 - lifecycle timeline: guard trips, progress trips, retries, rollbacks,
   signals, permanent failures, in event order with absolute steps;
 - checkpoint overhead share: save/load seconds as a fraction of the
-  run's accounted wall time.
+  run's accounted wall time, plus the async-save ledger (async saves,
+  barrier waits, and the overlap share — the fraction of async
+  checkpoint work that hid behind compute);
+- pipeline section (streams carrying the per-chunk timing fields):
+  the device-busy fraction — sync runs: chunk wall over wall+gap,
+  where ``gap_s`` is the host-side observer/checkpoint/caller tax the
+  device idles through; pipelined runs: the gap is structurally ~0
+  (wall brackets are drain-to-drain and already contain the host
+  overhead) and the ``drain_wait_s`` percentiles are the honest
+  device-vs-host-bound signal (~0 everywhere = the host, not the
+  device, is the bottleneck) — plus observer-drain latency
+  percentiles. ``--fail-on busy<X`` turns the busy fraction into a CI
+  threshold.
 
 The metrics argument accepts a glob (``runs/m*.jsonl``): multi-process
 runs write one shard per process (``.pN.jsonl`` — see
@@ -36,8 +48,9 @@ stdout):
 - 0: parsed fine, no anomaly;
 - 1: unusable input (no file, no events, no run_header);
 - 2: anomaly — an event named in ``--fail-on`` occurred (default:
-  ``permanent_failure``), outliers exceeded ``--max-outlier-frac``, or
-  checkpoint share exceeded ``--max-ckpt-share``.
+  ``permanent_failure``), a ``busy<X`` token's device-busy floor was
+  violated, outliers exceeded ``--max-outlier-frac``, or checkpoint
+  share exceeded ``--max-ckpt-share``.
 
 ``--json`` prints the summary document to stdout as JSON (for piping:
 ``make telemetry-smoke``).
@@ -254,6 +267,12 @@ def summarize(events, outlier_mult=5.0):
 
     saves = by.get("checkpoint_save", [])
     loads = by.get("rollback", [])
+    barriers = by.get("checkpoint_barrier", [])
+    async_saves = [s for s in saves if s.get("async")]
+    async_s = sum(s.get("wall_s", 0.0) for s in async_saves
+                  if isinstance(s.get("wall_s"), (int, float)))
+    barrier_s = sum(b.get("wait_s", 0.0) for b in barriers
+                    if isinstance(b.get("wait_s"), (int, float)))
     ckpt_s = (sum(s.get("wall_s", 0.0) for s in saves)
               + sum(r.get("load_wall_s", 0.0) for r in loads))
     chunk_s = (sum(c.get("wall_s", 0.0) for c in chunks)
@@ -262,9 +281,88 @@ def summarize(events, outlier_mult=5.0):
         "saves": len(saves),
         "save_s_total": sum(s.get("wall_s", 0.0) for s in saves),
         "rollback_loads": len(loads),
+        # NOTE: async save wall time overlaps compute by design — this
+        # share keeps its historical meaning (total checkpoint seconds
+        # over accounted seconds); the run-loop cost actually PAID is
+        # the barrier wait, priced by async_overlap_share below.
         "overhead_share": (ckpt_s / (ckpt_s + chunk_s)
                            if ckpt_s + chunk_s > 0 else 0.0),
+        "async_saves": len(async_saves),
+        "skipped": len(by.get("checkpoint_skipped", [])),
+        "barrier_wait_s": barrier_s,
+        # Fraction of async checkpoint work hidden behind compute:
+        # everything except what a rollback/exit barrier had to wait
+        # out. None when no async save ran.
+        "async_overlap_share": (max(0.0, 1.0 - barrier_s / async_s)
+                                if async_s > 0 else None),
     }
+
+    # Pipeline section: only for streams that carry the per-chunk
+    # timing fields (older streams simply have no section).
+    def _nums(key):
+        return sorted(c[key] for c in chunks
+                      if isinstance(c.get(key), (int, float)))
+
+    gaps = _nums("gap_s")
+    drains = _nums("drain_wait_s")
+    observes = _nums("observe_s")
+    dispatches = _nums("dispatch_s")
+    if gaps or drains or observes:
+        gap_total = sum(gaps)
+        # Per-chunk busy accounting — a multi-segment stream may mix
+        # modes (a pipelined run resumed at depth 1, or vice versa):
+        # sync chunks' walls are device time (dispatch-to-ready) with
+        # gap_s OUTSIDE them (the observer/checkpoint/caller tax the
+        # device idles through), while pipelined chunks' walls are
+        # drain-to-drain and CONTAIN their gap_s (the measured
+        # device-starvation lower bound from the is_ready probe). One
+        # formula applied to the merged totals would mis-attribute
+        # whichever mode it wasn't built for, so each chunk
+        # contributes under its own bracket semantics.
+        busy_s = avail_s = 0.0
+        n_pipe = 0
+        for c in chunks:
+            w = c.get("wall_s", 0.0)
+            w = w if isinstance(w, (int, float)) else 0.0
+            g = c.get("gap_s")
+            g = g if isinstance(g, (int, float)) else 0.0
+            if isinstance(c.get("drain_wait_s"), (int, float)):
+                n_pipe += 1
+                busy_s += max(0.0, w - g)
+                avail_s += w
+            else:
+                busy_s += w
+                avail_s += w + g
+        pl = {
+            "mode": ("pipelined" if n_pipe == len(chunks)
+                     else "sync" if n_pipe == 0 else "mixed"),
+            "device_busy_frac": (busy_s / avail_s
+                                 if avail_s > 0 else None),
+            "gap_s_total": gap_total,
+        }
+        if observes:
+            pl["observer_drain_s"] = {
+                "p50": _percentile(observes, 50),
+                "p90": _percentile(observes, 90),
+                "max": observes[-1]}
+        if drains:
+            pl["device_wait_s"] = {
+                "p50": _percentile(drains, 50),
+                "p90": _percentile(drains, 90),
+                "max": drains[-1]}
+            # Chunks the host barely waited for: the device finished
+            # long before the drain — everywhere-near-zero waits mean
+            # the host (not the device) paces the run.
+            med_wall = _percentile(sorted(
+                c.get("wall_s", 0.0) for c in chunks), 50)
+            thresh = 0.05 * med_wall if med_wall else 0.0
+            pl["host_bound_chunk_frac"] = (
+                sum(1 for d in drains if d <= thresh) / len(drains))
+        if dispatches:
+            pl["dispatch_s_p50"] = _percentile(dispatches, 50)
+        pl["async_ckpt_overlap_share"] = \
+            doc["checkpoints"]["async_overlap_share"]
+        doc["pipeline"] = pl
 
     timeline = [
         {"event": e["event"], "t_mono": e.get("t_mono"),
@@ -274,7 +372,7 @@ def summarize(events, outlier_mult=5.0):
         for e in events
         if e["event"] in ("guard_trip", "progress_trip", "retry",
                           "rollback", "signal", "permanent_failure",
-                          "run_end")]
+                          "checkpoint_skipped", "run_end")]
     doc["timeline"] = timeline
 
     ends = by.get("run_end", [])
@@ -343,11 +441,39 @@ def render_text(doc):
         for t in cv.get("progress_trips", []):
             out.append(f"  progress_trip kind={t['kind']} "
                        f"step={t['step']} window={t['window']}")
+    pl = doc.get("pipeline")
+    if pl:
+        busy = pl.get("device_busy_frac")
+        line = f"pipeline: {pl['mode']}"
+        if busy is not None:
+            line += f", device busy {busy:.1%}"
+        line += f" (host gap {pl['gap_s_total']:.3f}s total)"
+        out.append(line)
+        od = pl.get("observer_drain_s")
+        if od:
+            out.append(f"  observer drain p50={od['p50']*1e3:.2f}ms "
+                       f"p90={od['p90']*1e3:.2f}ms "
+                       f"max={od['max']*1e3:.2f}ms")
+        dw = pl.get("device_wait_s")
+        if dw:
+            out.append(f"  device wait p50={dw['p50']*1e3:.2f}ms "
+                       f"p90={dw['p90']*1e3:.2f}ms "
+                       f"(host-bound chunks: "
+                       f"{pl['host_bound_chunk_frac']:.0%})")
     k = doc["checkpoints"]
-    out.append(f"checkpoints: {k['saves']} saves "
+    ck_line = (f"checkpoints: {k['saves']} saves "
                f"({k['save_s_total']:.3f}s), {k['rollback_loads']} "
                f"rollback loads, overhead share "
                f"{k['overhead_share']:.1%}")
+    if k.get("async_saves"):
+        share = k.get("async_overlap_share")
+        ck_line += (f"; {k['async_saves']} async "
+                    f"(barrier wait {k['barrier_wait_s']:.3f}s"
+                    + (f", overlap {share:.1%}" if share is not None
+                       else "") + ")")
+    if k.get("skipped"):
+        ck_line += f"; {k['skipped']} skipped (non-finite)"
+    out.append(ck_line)
     if doc["timeline"]:
         out.append("timeline:")
         for t in doc["timeline"]:
@@ -390,7 +516,11 @@ def main(argv=None):
                     help="exit 2 when any of these events appear "
                          "(default: permanent_failure; e.g. add "
                          "guard_trip for runs that must stay clean; "
-                         "'none' disables)")
+                         "'none' disables). A 'busy<X' token instead "
+                         "thresholds the pipeline section's device-"
+                         "busy fraction (e.g. 'busy<0.9' fails a run "
+                         "whose device idled more than 10% — the CI "
+                         "guard for the pipelined stream)")
     args = ap.parse_args(argv)
 
     try:
@@ -425,10 +555,31 @@ def main(argv=None):
                              "emit equivalent streams")
 
     anomalies = []
-    fail_on = (set() if args.fail_on == "none"
-               else {t.strip() for t in args.fail_on.split(",")})
+    tokens = ([] if args.fail_on == "none"
+              else [t.strip() for t in args.fail_on.split(",")
+                    if t.strip()])
+    fail_on, busy_min = set(), None
+    for t in tokens:
+        if t.startswith("busy<"):
+            try:
+                busy_min = float(t[len("busy<"):])
+            except ValueError:
+                print(f"error: bad --fail-on token {t!r} (expected "
+                      f"busy<FLOAT)", file=sys.stderr)
+                return 1
+        else:
+            fail_on.add(t)
     for ev in sorted(fail_on & set(doc["events_by_type"])):
         anomalies.append(f"{doc['events_by_type'][ev]} {ev} event(s)")
+    if busy_min is not None:
+        busy = (doc.get("pipeline") or {}).get("device_busy_frac")
+        if busy is None:
+            anomalies.append(
+                f"busy<{busy_min:g} requested but the stream carries "
+                f"no per-chunk timing fields (pre-pipeline writer?)")
+        elif busy < busy_min:
+            anomalies.append(f"device-busy fraction {busy:.2%} < "
+                             f"{busy_min:.2%}")
     c = doc.get("chunks")
     if (args.max_outlier_frac is not None and c
             and c["outlier_frac"] > args.max_outlier_frac):

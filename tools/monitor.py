@@ -9,7 +9,10 @@ sources are optional and degrade independently:
 
 - the heartbeat alone answers liveness + progress (`last_step`,
   `last_event`, `residual` ride the payload precisely so probes need
-  not parse the JSONL at all);
+  not parse the JSONL at all). Heartbeat rewrites are throttled
+  (`min_interval`, default 1 s — the payload's `interval_s`), so an
+  age within a few intervals is the healthy cadence; the status line
+  only flags ages well past it as `(stale?)`;
 - the JSONL adds the step target (run_header config), throughput
   (chunk events), grid diagnostics (`--diag-interval` samples), and
   the terminal outcome. `--metrics` accepts a glob
@@ -181,7 +184,14 @@ def render(state, hb, now=None):
     if state is not None and state.trips:
         parts.append(f"trips {state.trips}")
     if hb is not None and hb.get("t_wall"):
-        parts.append(f"hb {max(0.0, now - hb['t_wall']):.1f}s ago")
+        age = max(0.0, now - hb["t_wall"])
+        # The writer throttles heartbeat rewrites (min_interval,
+        # default 1 s; the payload says which) — an age within a few
+        # intervals is a HEALTHY cadence, not a hang. Only flag ages
+        # well past it.
+        interval = hb.get("interval_s") or 1.0
+        stale = " (stale?)" if age > max(3.0 * interval, 5.0) else ""
+        parts.append(f"hb {age:.1f}s ago{stale}")
     if state is not None and state.outcome is not None:
         parts.append(f"outcome {state.outcome}")
     elif last_event:
