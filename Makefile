@@ -27,7 +27,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test chaos telemetry-smoke \
+.PHONY: all heat heat_con native test lint chaos telemetry-smoke \
         monitor-smoke overlap-smoke bench clean
 
 all: heat
@@ -47,6 +47,19 @@ native:
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# static contract verification (SEMANTICS.md "Statically verified
+# contracts"): the heatlint trace+AST layers gate on error severity;
+# intentionally-kept findings live in heatlint.baseline.json. ruff
+# (import hygiene + unused-code subset, [tool.ruff] in pyproject.toml)
+# rides the same target when installed — heatlint is the hard gate.
+lint:
+	JAX_PLATFORMS=cpu $(PY) tools/heatlint.py --fail-on error
+	@if command -v ruff >/dev/null 2>&1; then \
+	    ruff check parallel_heat_tpu tools bench.py; \
+	else \
+	    echo "ruff not installed; skipping (heatlint gate passed)"; \
+	fi
+
 # fault-injection smoke for the run supervisor (CPU only, no TPU needed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m chaos -q
@@ -55,6 +68,7 @@ chaos:
 # piped through the report tool — exit 0 means the JSONL is schema-valid
 # and anomaly-free
 telemetry-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
 	rm -rf .telemetry_smoke && mkdir -p .telemetry_smoke
 	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 32 --ny 32 \
 	    --steps 60 --backend jnp --supervise \
@@ -88,6 +102,7 @@ monitor-smoke:
 # report tool must see the pipeline section and pass the device-busy
 # CI gate — exit 0 means the overlap machinery is live end to end
 overlap-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
 	rm -rf .overlap_smoke && mkdir -p .overlap_smoke
 	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
 	    --steps 400 --backend jnp --pipeline-depth 2 \

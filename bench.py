@@ -58,10 +58,11 @@ def _bench_fixed(cfg, budget_s=10.0, batches=3):
     import jax
     import jax.numpy as jnp
 
-    from parallel_heat_tpu.solver import _build_runner, make_initial_grid
+    from parallel_heat_tpu.solver import (_build_runner, _observer_free,
+                                          make_initial_grid)
     from parallel_heat_tpu.utils.profiling import chain_slope, chain_time, sync
 
-    runner, _ = _build_runner(cfg)
+    runner, _ = _build_runner(_observer_free(cfg))
     u0 = jax.block_until_ready(make_initial_grid(cfg))
     step = lambda g: runner(g)[0]
 

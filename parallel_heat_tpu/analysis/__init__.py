@@ -1,0 +1,59 @@
+"""Static contract verification for the solver's pinned invariants.
+
+Five PRs accumulated load-bearing contracts that were only enforced
+dynamically, test by test: the observation-only cache-key partition
+(guard/diag/pipeline_depth stripped from ``_build_runner`` keys), the
+donation-safety rule in the pipelined stream, Dirichlet
+never-write-the-boundary semantics, f32chunk's once-per-chunk rounding,
+the ``name="heat_*"`` annotation on every Pallas call site, and the
+lock discipline around thread-shared observer state. Each contract is
+exactly the kind of invariant that rots when the config/kernel surface
+multiplies (ROADMAP items 2-3); this package makes them machine-checked
+before a kernel ever runs.
+
+Two layers (SEMANTICS.md "Statically verified contracts"):
+
+- :mod:`contracts` — **trace-level** verifiers (rules ``HL1xx``): they
+  trace solver programs to jaxprs (abstract evaluation — nothing
+  executes) and audit the cache-key partition functionally against the
+  real strip site.
+- :mod:`astlint` — **AST-level** lint (rules ``HL2xx``) over the
+  package source: blocking host syncs in dispatch regions, wall-clock/
+  RNG in traced code, Pallas kernel names, lock discipline, import
+  hygiene.
+
+``tools/heatlint.py`` is the CLI; ``make lint`` gates CI on
+``--fail-on error``. Intentionally-kept findings live in
+``heatlint.baseline.json`` with a one-line justification each
+(:mod:`findings`).
+"""
+
+from parallel_heat_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    load_baseline,
+    render_findings,
+)
+from parallel_heat_tpu.analysis.astlint import (  # noqa: F401
+    AST_RULES,
+    lint_paths,
+)
+from parallel_heat_tpu.analysis.contracts import (  # noqa: F401
+    CONTRACT_RULES,
+    run_contracts,
+)
+
+ALL_RULES = {**CONTRACT_RULES, **AST_RULES}
+
+
+def run_all(paths=None, baseline=None):
+    """Run both layers; returns ``(findings, stale_baseline_entries)``.
+
+    ``paths`` scopes the AST layer (defaults inside
+    :func:`astlint.lint_paths`); the contract layer always audits the
+    installed package. ``baseline`` (a parsed baseline, see
+    :func:`findings.load_baseline`) suppresses matched findings.
+    """
+    out = list(run_contracts())
+    out.extend(lint_paths(paths))
+    return apply_baseline(out, baseline)

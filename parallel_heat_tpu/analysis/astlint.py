@@ -1,0 +1,561 @@
+"""Layer 2: AST-level custom lint over the package source (``HL2xx``).
+
+Pure ``ast`` — no jax import, no tracing — so this layer runs in
+milliseconds and can ride the smoke-target chains. Each rule is a
+function ``rule(tree, src_lines, path) -> [Finding]``; the registry
+``AST_RULES`` maps rule id -> (severity, summary, fn).
+
+Rules:
+
+- **HL201 blocking-in-dispatch** — no blocking host syncs
+  (``block_until_ready``, ``device_get``, ``np.asarray``, ``.item()``,
+  ``sync(...)``, ``time.sleep``, ``float()/int()/bool()`` on
+  non-literals) inside *dispatch regions*: the async dispatch path of
+  the pipelined stream and the timed loops of the A/B harnesses. A
+  region is a function whose ``def`` line (or the line above it)
+  carries ``# heatlint: dispatch-region``, or the lines between
+  ``# heatlint: begin dispatch-region`` / ``# heatlint: end
+  dispatch-region`` markers.
+- **HL202 wallclock-in-traced** — no wall-clock or host-RNG calls
+  (``time.*``, ``datetime.now``, ``random.*``, ``np.random.*``,
+  ``uuid``, ``secrets``, ``os.urandom``) inside traced code: functions
+  decorated with / passed to ``jax.jit``, bodies handed to ``lax``
+  control flow (``fori_loop``/``while_loop``/``scan``/``cond``/
+  ``switch``), Pallas kernels (first argument of ``pallas_call``), and
+  functions passed to ``shard_map``. Such a call traces to a constant:
+  the program bakes in one arbitrary clock/RNG sample and silently
+  reuses it forever. (``jax.random`` is traced and deterministic —
+  not flagged.)
+- **HL203 pallas-name** — every ``pallas_call`` carries
+  ``name="heat_*"`` as a string literal: the profiler-trace contract
+  from PR 3 (SEMANTICS.md), previously maintained by hand across 17
+  call sites.
+- **HL204 lock-discipline** — in classes holding a ``threading.Lock``/
+  ``RLock`` attribute, any attribute the class mutates under ``with
+  self.<lock>`` somewhere is *lock-guarded*; mutating it anywhere else
+  (outside ``__init__``, where the object is not yet shared) is a
+  race. The guarded set is inferred, not declared: the code's own
+  locking IS the declaration.
+- **HL205 unused-import** — import hygiene: a module-level import
+  never referenced (by name, in ``__all__``, or via a ``# noqa``
+  waiver) in the module. ``__init__.py`` re-export surfaces are
+  skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from parallel_heat_tpu.analysis.findings import Finding
+
+_PRAGMA_FUNC = "heatlint: dispatch-region"
+_PRAGMA_BEGIN = "heatlint: begin dispatch-region"
+_PRAGMA_END = "heatlint: end dispatch-region"
+
+# Repo root, derived from this file's location — the default scan
+# scope must NOT depend on the invoker's cwd: a gate run from any
+# other directory would otherwise scan zero files and report clean.
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Default AST-layer scan scope, relative to the repo root.
+DEFAULT_PATHS = ("parallel_heat_tpu", "tools", "bench.py")
+
+
+def default_scan_paths():
+    """The default scope resolved against the repo root; raises when
+    nothing resolves (a silently-empty scan set would un-gate CI)."""
+    paths = [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        raise RuntimeError(
+            f"heatlint: none of the default scan paths {DEFAULT_PATHS} "
+            f"exist under {REPO_ROOT!r} — refusing to report a clean "
+            f"result for an empty scan")
+    return paths
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", "build")]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def _qual_name(node) -> Optional[str]:
+    """Dotted name of a call target: ``jax.block_until_ready`` ->
+    'jax.block_until_ready', bare ``sync`` -> 'sync'."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _enclosing_symbol(stack) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(names) if names else "<module>"
+
+
+class _Walker(ast.NodeVisitor):
+    """Generic visitor that tracks the def/class stack."""
+
+    def __init__(self):
+        self.stack: list = []
+
+    def generic_visit(self, node):
+        push = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))
+        if push:
+            self.stack.append(node)
+        super().generic_visit(node)
+        if push:
+            self.stack.pop()
+
+    visit_FunctionDef = generic_visit
+    visit_AsyncFunctionDef = generic_visit
+    visit_ClassDef = generic_visit
+
+
+# ---------------------------------------------------------------------------
+# HL201 blocking-in-dispatch
+# ---------------------------------------------------------------------------
+
+_BLOCKING_TAILS = ("block_until_ready", "device_get", "item")
+_BLOCKING_CALLS = ("sync", "time.sleep")
+_BLOCKING_ASARRAY = ("np.asarray", "numpy.asarray", "onp.asarray")
+_SCALAR_CASTS = ("float", "int", "bool")
+
+
+def _string_lines(tree):
+    """Lines covered by string literals (docstrings included) — a
+    marker mentioned in documentation is not a marker."""
+    lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Constant, ast.JoinedStr)) and (
+                isinstance(node, ast.JoinedStr)
+                or isinstance(node.value, str)):
+            lines.update(range(node.lineno, (node.end_lineno or
+                                             node.lineno) + 1))
+    return lines
+
+
+def _dispatch_regions(tree, src_lines, path):
+    """``(line ranges covered by a dispatch-region pragma, marker
+    findings)``. An unterminated ``begin`` marker still covers
+    begin..EOF (conservative) but is reported — a deleted ``end`` line
+    must never silently disable the rule."""
+    regions = []
+    findings = []
+    in_string = _string_lines(tree)
+    # Function-level pragma: on the def line or the line above it.
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cand = [src_lines[node.lineno - 1]]
+        if node.lineno >= 2:
+            cand.append(src_lines[node.lineno - 2])
+        if any(_PRAGMA_FUNC in c and _PRAGMA_BEGIN not in c
+               for c in cand):
+            regions.append((node.lineno, node.end_lineno))
+    # Block markers.
+    begin = None
+    for i, line in enumerate(src_lines, start=1):
+        if i in in_string:
+            continue
+        if _PRAGMA_BEGIN in line:
+            if begin is not None:
+                findings.append(Finding(
+                    "HL201", "error", path, begin, "<module>",
+                    f"'# {_PRAGMA_BEGIN}' marker at line {begin} has "
+                    f"no matching end before the next begin at line "
+                    f"{i} — add '# {_PRAGMA_END}'"))
+            begin = i
+        elif _PRAGMA_END in line and begin is not None:
+            regions.append((begin, i))
+            begin = None
+    if begin is not None:
+        findings.append(Finding(
+            "HL201", "error", path, begin, "<module>",
+            f"unterminated '# {_PRAGMA_BEGIN}' marker — no matching "
+            f"'# {_PRAGMA_END}' before end of file (scanning "
+            f"begin..EOF conservatively; terminate the region)"))
+        regions.append((begin, len(src_lines)))
+    return regions, findings
+
+
+def rule_hl201(tree, src_lines, path) -> List[Finding]:
+    regions, out0 = _dispatch_regions(tree, src_lines, path)
+    if not regions:
+        return out0
+
+    def in_region(lineno):
+        return any(lo <= lineno <= hi for lo, hi in regions)
+
+    out = out0
+
+    class V(_Walker):
+        def visit_Call(self, node):
+            if in_region(node.lineno):
+                why = None
+                q = _qual_name(node.func)
+                if q is not None:
+                    tail = q.rsplit(".", 1)[-1]
+                    if tail in _BLOCKING_TAILS:
+                        why = f"{q}() synchronizes with the device"
+                    elif q in _BLOCKING_CALLS:
+                        why = f"{q}() blocks the dispatch path"
+                    elif q in _BLOCKING_ASARRAY or q.endswith(".asarray") \
+                            and not q.startswith(("jnp", "jax")):
+                        why = (f"{q}() gathers the array to host "
+                               f"(a full device sync + transfer)")
+                    elif q in _SCALAR_CASTS and node.args and not \
+                            isinstance(node.args[0], ast.Constant):
+                        why = (f"{q}() on a possible device value reads "
+                               f"it to host (blocks on the program)")
+                if why is not None:
+                    out.append(Finding(
+                        "HL201", "error", path, node.lineno,
+                        _enclosing_symbol(self.stack),
+                        f"blocking call inside a dispatch region: {why} "
+                        f"— drain observers outside the region or use a "
+                        f"non-blocking copy (copy_to_host_async)"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL202 wallclock-in-traced
+# ---------------------------------------------------------------------------
+
+_TRACE_ENTRY_CALLS = {
+    "fori_loop", "while_loop", "scan", "cond", "switch", "pallas_call",
+    "shard_map", "_shard_map", "jit", "named_call", "checkpoint",
+    "remat", "vmap", "pmap", "grad", "value_and_grad",
+}
+_HOST_CLOCK_RNG_PREFIXES = (
+    "time.", "datetime.", "random.", "np.random.", "numpy.random.",
+    "uuid.", "secrets.",
+)
+_HOST_CLOCK_RNG_EXACT = ("os.urandom",)
+
+
+def _is_jit_decorator(dec) -> bool:
+    q = _qual_name(dec) or ""
+    if q.endswith("jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        # functools.partial(jax.jit, ...) or jax.jit(static_argnums=...)
+        fq = _qual_name(dec.func) or ""
+        if fq.endswith("jit"):
+            return True
+        if fq.endswith("partial") and dec.args:
+            aq = _qual_name(dec.args[0]) or ""
+            if aq.endswith("jit"):
+                return True
+    return False
+
+
+def rule_hl202(tree, src_lines, path) -> List[Finding]:
+    # Pass 1: collect traced roots — decorated defs, and defs/lambdas
+    # passed (by name or inline) to trace-entry calls.
+    module_defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_defs.setdefault(node.name, node)
+    traced_nodes = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced_nodes.append(node)
+        elif isinstance(node, ast.Call):
+            q = _qual_name(node.func) or ""
+            if q.rsplit(".", 1)[-1] not in _TRACE_ENTRY_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    traced_nodes.append(arg)
+                elif isinstance(arg, ast.Name) and arg.id in module_defs:
+                    traced_nodes.append(module_defs[arg.id])
+    if not traced_nodes:
+        return []
+    spans = sorted({(n.lineno, n.end_lineno) for n in traced_nodes})
+
+    def in_traced(lineno):
+        return any(lo <= lineno <= hi for lo, hi in spans)
+
+    out = []
+
+    class V(_Walker):
+        def visit_Call(self, node):
+            if in_traced(node.lineno):
+                q = _qual_name(node.func) or ""
+                if (q in _HOST_CLOCK_RNG_EXACT
+                        or any(q.startswith(p)
+                               for p in _HOST_CLOCK_RNG_PREFIXES)):
+                    out.append(Finding(
+                        "HL202", "error", path, node.lineno,
+                        _enclosing_symbol(self.stack),
+                        f"host wall-clock/RNG call {q}() inside traced "
+                        f"code: it evaluates ONCE at trace time and is "
+                        f"baked into the compiled program as a constant "
+                        f"— hoist it to the host side, or use "
+                        f"jax.random for in-program randomness"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL203 pallas-name
+# ---------------------------------------------------------------------------
+
+def rule_hl203(tree, src_lines, path) -> List[Finding]:
+    out = []
+
+    class V(_Walker):
+        def visit_Call(self, node):
+            q = _qual_name(node.func) or ""
+            if q.rsplit(".", 1)[-1] == "pallas_call":
+                name_kw = next((k.value for k in node.keywords
+                                if k.arg == "name"), None)
+                sym = _enclosing_symbol(self.stack)
+                if name_kw is None:
+                    out.append(Finding(
+                        "HL203", "error", path, node.lineno, sym,
+                        "pallas_call without a name= — every kernel "
+                        "must carry a literal name=\"heat_*\" so "
+                        "profiler traces attribute device time to the "
+                        "kernel family (SEMANTICS.md annotations "
+                        "contract)"))
+                elif not (isinstance(name_kw, ast.Constant)
+                          and isinstance(name_kw.value, str)
+                          and name_kw.value.startswith("heat_")):
+                    out.append(Finding(
+                        "HL203", "error", path, node.lineno, sym,
+                        "pallas_call name= must be a string literal "
+                        "starting with 'heat_' (got "
+                        f"{ast.dump(name_kw)[:60]})"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL204 lock-discipline
+# ---------------------------------------------------------------------------
+
+_MUTATOR_METHODS = ("append", "extend", "insert", "add", "update",
+                    "pop", "popleft", "remove", "clear", "discard",
+                    "appendleft", "setdefault", "put", "put_nowait")
+
+
+def _self_attr(node) -> Optional[str]:
+    """'x' for ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls) -> set:
+    """Attributes assigned a threading.Lock()/RLock() anywhere in the
+    class."""
+    locks = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            q = _qual_name(node.value.func) or ""
+            if q.rsplit(".", 1)[-1] in ("Lock", "RLock"):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        locks.add(attr)
+    return locks
+
+
+def _attr_mutations(node):
+    """Yield (attr_name, lineno) for ``self.X = ...``, ``self.X += ...``
+    and ``self.X.append(...)``-style mutations inside ``node``."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, n.lineno
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr in _MUTATOR_METHODS:
+                attr = _self_attr(n.func.value)
+                if attr is not None:
+                    yield attr, n.lineno
+
+
+def rule_hl204(tree, src_lines, path) -> List[Finding]:
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # Line spans inside `with self.<lock>:` blocks, per method.
+        locked_spans = []
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        expr = item.context_expr
+                        # with self._lock:  /  with self._lock, other:
+                        attr = _self_attr(expr)
+                        if attr is None and isinstance(expr, ast.Call):
+                            attr = _self_attr(expr.func)
+                        if attr in locks:
+                            locked_spans.append((n.lineno, n.end_lineno))
+                            break
+
+        def under_lock(lineno):
+            return any(lo <= lineno <= hi for lo, hi in locked_spans)
+
+        # Infer the guarded set: attrs mutated under a lock anywhere
+        # outside __init__.
+        guarded = set()
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            for attr, lineno in _attr_mutations(m):
+                if under_lock(lineno) and attr not in locks:
+                    guarded.add(attr)
+        if not guarded:
+            continue
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            for attr, lineno in _attr_mutations(m):
+                if attr in guarded and not under_lock(lineno):
+                    out.append(Finding(
+                        "HL204", "error", path, lineno,
+                        f"{cls.name}.{m.name}",
+                        f"thread-shared attribute self.{attr} is "
+                        f"mutated without holding the class lock — "
+                        f"elsewhere in {cls.name} it is only written "
+                        f"under `with self.{'/'.join(sorted(locks))}`; "
+                        f"an unlocked write races those critical "
+                        f"sections"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL205 unused-import
+# ---------------------------------------------------------------------------
+
+def rule_hl205(tree, src_lines, path) -> List[Finding]:
+    if os.path.basename(path) == "__init__.py":
+        return []  # re-export surface: unused-by-design
+    imports = {}  # binding name -> (lineno, display)
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                binding = alias.asname or alias.name.split(".")[0]
+                imports[binding] = (node.lineno, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                binding = alias.asname or alias.name
+                imports[binding] = (
+                    node.lineno,
+                    f"{'.' * node.level}{node.module or ''}.{alias.name}")
+    if not imports:
+        return []
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value,
+                                                           str):
+            # __all__ entries / docstring references by exact name are
+            # counted as use only for __all__-style short strings.
+            if node.value.isidentifier():
+                used.add(node.value)
+    out = []
+    for binding, (lineno, display) in imports.items():
+        if binding in used:
+            continue
+        if "noqa" in src_lines[lineno - 1]:
+            continue
+        out.append(Finding(
+            "HL205", "error", path, lineno, "<module>",
+            f"unused import: {display!r} (bound as {binding!r}) is "
+            f"never referenced in this module"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry / driver
+# ---------------------------------------------------------------------------
+
+AST_RULES = {
+    "HL201": ("error", "blocking host sync inside a dispatch region",
+              rule_hl201),
+    "HL202": ("error", "wall-clock/RNG call inside traced code",
+              rule_hl202),
+    "HL203": ("error", "pallas_call without a literal heat_* name",
+              rule_hl203),
+    "HL204": ("error", "lock-guarded attribute mutated without the lock",
+              rule_hl204),
+    "HL205": ("error", "unused module-level import", rule_hl205),
+}
+
+
+def lint_file(path, rules=None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("HL200", "error", path, e.lineno or 0,
+                        "<module>", f"syntax error: {e.msg}")]
+    src_lines = src.splitlines() or [""]
+    out = []
+    for rule_id, (_sev, _summary, fn) in AST_RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        out.extend(fn(tree, src_lines, path))
+    return out
+
+
+def lint_paths(paths=None, rules=None) -> List[Finding]:
+    """Run the AST rules over ``paths`` (files or directories;
+    defaults to the package + tools + bench.py, anchored to the repo
+    root so the gate works from any cwd)."""
+    if paths is None:
+        paths = default_scan_paths()
+    out = []
+    for f in _iter_py_files(paths):
+        out.extend(lint_file(f, rules=rules))
+    return out

@@ -1,0 +1,811 @@
+"""Layer 1: trace-level contract verifiers (``HL1xx``).
+
+These rules check the solver's pinned semantic contracts by tracing
+programs to jaxprs (abstract evaluation — no simulation executes) and
+by auditing the real strip/dispatch sites, not copies of them:
+
+- **HL101 cache-key-partition** — every ``HeatConfig`` field is
+  classified exactly once (``config.SEMANTIC_FIELDS`` vs
+  ``config.OBSERVATION_ONLY_FIELDS``), and every observation-only
+  field is *provably* stripped by ``solver._observer_free`` — the one
+  function standing between user configs and the
+  ``_build_runner``/executable cache keys. A new config field that is
+  classified nowhere, an observation-only field the strip site leaves
+  in place (which would silently fork compiled programs per observer
+  setting), and a semantic field the strip site erases (which would
+  silently alias *different* simulations to one executable) all fail.
+  An AST pass additionally requires every direct ``_build_runner``
+  caller to strip first.
+- **HL102 donation-safety** — in the pipelined stream's dispatch path,
+  a donated buffer is never read after the dispatch that donates it:
+  (a) the argument of a donating call (a callable obtained from
+  ``_compiled_for``) must not be read again until reassigned, and
+  (b) inside a dispatch region (``# heatlint: dispatch-region``) a
+  name bound to the raw dispatch output must not escape (``append``/
+  ``yield``/``return``) unless one of its bindings is a
+  ``jnp.copy(...)`` — the donation-protected copy of SEMANTICS.md
+  "Pipelined stream".
+- **HL103 dirichlet-write-set** — tracing representative solver
+  programs (2D/3D, fixed/converge, storage/f32chunk; jnp backend),
+  every in-place write into a grid-shaped buffer
+  (``dynamic_update_slice``/``scatter``) provably excludes the
+  Dirichlet boundary: literal start indices ≥ 1 on every axis and
+  ``start + extent ≤ dim - 1``. Non-literal start indices on a
+  grid-shaped write are reported as unprovable.
+- **HL104 f32chunk-chain** — tracing the f32chunk accumulation chunk,
+  no value is rounded to the sub-f32 storage dtype and then used in
+  further arithmetic within the same chunk (a mid-chain downcast
+  would move a rounding point — SEMANTICS.md "Sub-f32 rounding
+  points"; the single per-chunk downcast feeding the chunk output /
+  loop carry is the contract's one rounding event).
+
+All audits accept injection points (config class, field partition,
+target functions, file paths) so the test fixtures can seed violations
+without patching the real solver.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import List, Optional
+
+from parallel_heat_tpu.analysis.findings import Finding
+
+# Scan scope of the HL101 AST pass (direct _build_runner callers).
+_CALLER_SCAN = ("parallel_heat_tpu", "tools", "bench.py")
+
+
+# ---------------------------------------------------------------------------
+# HL101 cache-key partition
+# ---------------------------------------------------------------------------
+
+_SENTINELS = {int: 7919, float: 0.1239871, bool: True, str: "x-sentinel"}
+
+
+def _sentinel_for(f: dataclasses.Field, default):
+    """A value for field ``f`` that provably differs from its default."""
+    for t, v in _SENTINELS.items():
+        if isinstance(default, t) and not isinstance(default, bool):
+            return v if v != default else v * 2
+    if isinstance(default, bool):
+        return not default
+    # None / tuple / anything else: an int sentinel is fine — the strip
+    # function only compares against the default, it never validates.
+    return 7919 if default != 7919 else 7920
+
+
+def audit_cache_keys(config_cls=None, semantic=None, observation=None,
+                     strip=None, scan_paths=None) -> List[Finding]:
+    """The cache-key partition audit (rule HL101). All parameters
+    default to the real solver surface; tests inject doctored ones."""
+    if config_cls is None:
+        from parallel_heat_tpu.config import HeatConfig
+
+        config_cls = HeatConfig
+    if semantic is None or observation is None:
+        from parallel_heat_tpu import config as _cfg
+
+        semantic = _cfg.SEMANTIC_FIELDS if semantic is None else semantic
+        observation = (_cfg.OBSERVATION_ONLY_FIELDS
+                       if observation is None else observation)
+    if strip is None:
+        from parallel_heat_tpu.solver import _observer_free
+
+        strip = _observer_free
+
+    out = []
+    loc = "parallel_heat_tpu/config.py"
+    fields = {f.name: f for f in dataclasses.fields(config_cls)}
+    sem, obs = set(semantic), set(observation)
+
+    # 1. Partition: total and disjoint over the ACTUAL dataclass.
+    for name in sorted(set(fields) - sem - obs):
+        out.append(Finding(
+            "HL101", "error", loc, 0, config_cls.__name__,
+            f"config field {name!r} is classified neither SEMANTIC nor "
+            f"OBSERVATION_ONLY — an unclassified field reaches "
+            f"_build_runner cache keys unaudited and can silently fork "
+            f"compiled programs; add it to exactly one of "
+            f"config.SEMANTIC_FIELDS / config.OBSERVATION_ONLY_FIELDS"))
+    for name in sorted((sem | obs) - set(fields)):
+        out.append(Finding(
+            "HL101", "error", loc, 0, config_cls.__name__,
+            f"classified field {name!r} does not exist on "
+            f"{config_cls.__name__} — stale partition entry"))
+    for name in sorted(sem & obs):
+        out.append(Finding(
+            "HL101", "error", loc, 0, config_cls.__name__,
+            f"config field {name!r} is classified BOTH semantic and "
+            f"observation-only; the partition must be disjoint"))
+
+    # 2. Functional strip proof against the real strip site.
+    try:
+        default_cfg = config_cls()
+    except TypeError as e:
+        out.append(Finding(
+            "HL101", "error", loc, 0, config_cls.__name__,
+            f"cannot construct a default {config_cls.__name__} "
+            f"({e}) — every field needs a default for the strip "
+            f"audit"))
+        return out
+    stripped_default = strip(default_cfg)
+    if stripped_default != default_cfg:
+        out.append(Finding(
+            "HL101", "error", loc, 0, config_cls.__name__,
+            "stripping the default config changed it — the strip "
+            "site must be the identity on observer-free configs"))
+    for name in sorted(obs & set(fields)):
+        f = fields[name]
+        if f.default is dataclasses.MISSING and \
+                f.default_factory is dataclasses.MISSING:
+            out.append(Finding(
+                "HL101", "error", loc, 0, config_cls.__name__,
+                f"observation-only field {name!r} has no default — "
+                f"stripping must be able to reset it"))
+            continue
+        default = (f.default if f.default is not dataclasses.MISSING
+                   else f.default_factory())
+        cfg = dataclasses.replace(default_cfg,
+                                  **{name: _sentinel_for(f, default)})
+        if strip(cfg) != stripped_default:
+            out.append(Finding(
+                "HL101", "error", loc, 0, config_cls.__name__,
+                f"observation-only field {name!r} is NOT stripped from "
+                f"_build_runner cache keys: two configs differing only "
+                f"in {name!r} would compile (and cache) separate "
+                f"programs, breaking the observation-only contract "
+                f"(SEMANTICS.md) — strip it in solver._observer_free "
+                f"or reclassify it as semantic"))
+    for name in sorted(sem & set(fields)):
+        f = fields[name]
+        if f.default is dataclasses.MISSING and \
+                f.default_factory is dataclasses.MISSING:
+            continue
+        default = (f.default if f.default is not dataclasses.MISSING
+                   else f.default_factory())
+        cfg = dataclasses.replace(default_cfg,
+                                  **{name: _sentinel_for(f, default)})
+        if strip(cfg) == stripped_default:
+            out.append(Finding(
+                "HL101", "error", loc, 0, config_cls.__name__,
+                f"semantic field {name!r} is erased by the strip site: "
+                f"two DIFFERENT simulations would alias one compiled "
+                f"program — remove it from the strip set"))
+
+    # 3. AST pass: direct _build_runner callers must strip first.
+    out.extend(_audit_runner_callers(scan_paths))
+    return out
+
+
+def _audit_runner_callers(scan_paths=None) -> List[Finding]:
+    from parallel_heat_tpu.analysis.astlint import (REPO_ROOT,
+                                                    _iter_py_files)
+
+    if scan_paths is None:
+        scan_paths = [p for p in
+                      (os.path.join(REPO_ROOT, x) for x in _CALLER_SCAN)
+                      if os.path.exists(p)]
+    out = []
+    for path in _iter_py_files(scan_paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            continue  # astlint reports HL200 for this
+        # Every call site counts — nested defs, class methods, and
+        # module-level script lines, not just top-level functions.
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        build_calls = []
+        strip_linenos = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else getattr(
+                    node.func, "id", None)
+                if name == "_build_runner":
+                    build_calls.append(node)
+                elif name == "_observer_free":
+                    strip_linenos.append(node.lineno)
+
+        def enclosing(lineno):
+            """(innermost function name for reporting, outermost
+            enclosing function's start line for strip scoping) — a
+            strip in the outer function covers its nested dispatch
+            closures. Module scope: ``("<module>", 1)``."""
+            inner = outer = None
+            for fn in funcs:
+                if fn.lineno <= lineno <= fn.end_lineno:
+                    if inner is None or fn.lineno > inner.lineno:
+                        inner = fn
+                    if outer is None or fn.lineno < outer.lineno:
+                        outer = fn
+            if inner is not None:
+                return inner.name, outer.lineno
+            return "<module>", 1
+
+        for call in build_calls:
+            arg = call.args[0] if call.args else None
+            inline = (isinstance(arg, ast.Call) and (
+                getattr(arg.func, "id", None) == "_observer_free"
+                or getattr(arg.func, "attr", None)
+                == "_observer_free"))
+            symbol, scope_start = enclosing(call.lineno)
+            # OK when the arg is an inline strip, or a strip ran
+            # lexically earlier within the same enclosing scope.
+            if inline or any(scope_start <= ln <= call.lineno
+                             for ln in strip_linenos):
+                continue
+            out.append(Finding(
+                "HL101", "error", path, call.lineno, symbol,
+                "_build_runner called on a config that was not "
+                "passed through solver._observer_free — an "
+                "observation field left in the key forks the "
+                "compiled-program cache; call "
+                "_observer_free(config) first (it is the identity "
+                "on observer-free configs)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL102 donation safety
+# ---------------------------------------------------------------------------
+
+def audit_donation(path: Optional[str] = None) -> List[Finding]:
+    """Donation-aliasing safety over one source file (default:
+    the installed ``solver.py``)."""
+    if path is None:
+        import parallel_heat_tpu.solver as _solver
+
+        path = _solver.__file__
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        return []
+    src_lines = src.splitlines() or [""]
+    out = []
+    # Nested defs are analyzed both standalone and as part of their
+    # enclosing function (the donated names cross scopes via nonlocal);
+    # dedup identical findings by location+message.
+    seen = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for f in _donation_in_function(fn, src_lines, path):
+                k = (f.rule, f.file, f.line, f.message)
+                if k not in seen:
+                    seen.add(k)
+                    out.append(f)
+    return out
+
+
+def _assigned_names(target):
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assigned_names(elt)
+
+
+def _is_copy_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "copy")
+
+
+def _donation_in_function(fn, src_lines, path) -> List[Finding]:
+    out = []
+    # Donating callables: names assigned from _compiled_for(...).
+    donating = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = node.value.func
+            name = (callee.attr if isinstance(callee, ast.Attribute)
+                    else getattr(callee, "id", None))
+            if name == "_compiled_for":
+                for t in node.targets:
+                    donating.update(_assigned_names(t))
+    if not donating:
+        return out
+
+    # (a) read-after-donate: the donated argument name must not be
+    # loaded after the donating call until reassigned (linear
+    # source-order approximation — adequate for the straight-line
+    # dispatch paths this contract governs).
+    events = []  # (lineno, kind, name)  kind: donate | load | store
+    donate_outputs = set()  # names bound to raw dispatch results
+    copy_bound = set()      # names with at least one jnp.copy binding
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            cal = node.func
+            cname = (cal.attr if isinstance(cal, ast.Attribute)
+                     else getattr(cal, "id", None))
+            if cname in donating and node.args and isinstance(
+                    node.args[0], ast.Name):
+                # A donate spans the whole (possibly wrapped) call:
+                # the argument's own continuation line is part of the
+                # dispatch, not a read-after-donate.
+                events.append(((node.lineno, node.end_lineno),
+                               "donate", node.args[0].id))
+        elif isinstance(node, ast.Name):
+            kind = ("load" if isinstance(node.ctx, ast.Load)
+                    else "store")
+            events.append((node.lineno, kind, node.id))
+        if isinstance(node, ast.Assign):
+            val = node.value
+            if isinstance(val, ast.Call):
+                cal = val.func
+                cname = (cal.attr if isinstance(cal, ast.Attribute)
+                         else getattr(cal, "id", None))
+                if cname in donating:
+                    for t in node.targets:
+                        names = list(_assigned_names(t))
+                        if names:
+                            donate_outputs.add(names[0])  # the grid
+            if _is_copy_call(val):
+                for t in node.targets:
+                    copy_bound.update(_assigned_names(t))
+            elif isinstance(val, ast.Name):
+                # alias propagation: B = A where A is a raw output
+                if val.id in donate_outputs:
+                    for t in node.targets:
+                        donate_outputs.update(_assigned_names(t))
+    for where, kind, name in events:
+        if kind != "donate":
+            continue
+        start, end = where
+        # First load strictly after the donating call's last line, vs
+        # first store at/after its first line (a store ON the donating
+        # statement is the common `u = step(u)` rebind idiom).
+        loads = [ln for ln, k, n in events
+                 if n == name and k == "load" and ln > end]
+        stores = [ln for ln, k, n in events
+                  if n == name and k == "store" and ln >= start]
+        if loads and (not stores or min(stores) > min(loads)):
+            out.append(Finding(
+                "HL102", "error", path, min(loads), fn.name,
+                f"{name!r} is read after the dispatch at line "
+                f"{start} donated its buffer — the read observes "
+                f"freed/garbage memory; rebind the name from the "
+                f"dispatch result before any further use"))
+
+    # (b) raw-output escape from dispatch regions: fn itself or any
+    # nested def carrying the pragma (the pipelined stream's _dispatch
+    # closure is nested in solve_stream, which binds `step`).
+    from parallel_heat_tpu.analysis.astlint import _PRAGMA_FUNC
+
+    marked = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cand = [src_lines[node.lineno - 1]]
+        if node.lineno >= 2:
+            cand.append(src_lines[node.lineno - 2])
+        if any(_PRAGMA_FUNC in c for c in cand):
+            marked.append((node.lineno, node.end_lineno))
+    if not marked:
+        return out
+    raw = donate_outputs - copy_bound
+    if not raw:
+        return out
+
+    def in_marked(lineno):
+        return any(lo <= lineno <= hi for lo, hi in marked)
+
+    def names_in(node):
+        return {n.id for n in ast.walk(node)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)}
+
+    for node in ast.walk(fn):
+        expr = None
+        what = None
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr in ("append", "appendleft", "put",
+                                       "put_nowait"):
+            expr, what = node, f"{node.func.attr}()"
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                and node.value is not None:
+            expr, what = node.value, "yield"
+        elif isinstance(node, ast.Return) and node.value is not None:
+            expr, what = node.value, "return"
+        if expr is None or not in_marked(node.lineno):
+            continue
+        escaped = sorted(raw & names_in(expr))
+        if escaped:
+            out.append(Finding(
+                "HL102", "error", path, node.lineno, fn.name,
+                f"raw dispatch output {escaped} escapes this dispatch "
+                f"region via {what} without a donation-protected "
+                f"jnp.copy binding — the next dispatch donates that "
+                f"buffer, so any later consumer reads freed memory "
+                f"(SEMANTICS.md 'Pipelined stream')"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing (shared by HL103 / HL104)
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(params):
+    import jax.core as jcore
+
+    ClosedJaxpr = getattr(jcore, "ClosedJaxpr", None)
+    Jaxpr = getattr(jcore, "Jaxpr", None)
+
+    def is_jaxpr(v):
+        return (ClosedJaxpr is not None and isinstance(v, ClosedJaxpr)) \
+            or (Jaxpr is not None and isinstance(v, Jaxpr))
+
+    for v in params.values():
+        if is_jaxpr(v):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if is_jaxpr(item):
+                    yield item
+
+
+def _walk_jaxprs(closed):
+    """Yield every (sub-)jaxpr reachable from ``closed``, outermost
+    first."""
+    seen = set()
+    stack = [closed]
+    while stack:
+        j = stack.pop()
+        jaxpr = getattr(j, "jaxpr", j)
+        if id(jaxpr) in seen:
+            continue
+        seen.add(id(jaxpr))
+        yield jaxpr
+        for eqn in jaxpr.eqns:
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def _literal_val(invar):
+    import jax.core as jcore
+
+    if isinstance(invar, jcore.Literal):
+        return invar.val
+    return None
+
+
+def _fold_constants(jaxpr):
+    """Forward constant-folding over one jaxpr: var id -> concrete
+    numpy value for every value derivable from literals alone (index
+    vectors like ``concatenate(broadcast(1), broadcast(1))`` — the
+    lowering of ``u.at[1:-1, 1:-1].set``). Jaxpr invars and constvars
+    stay unknown: anything data-dependent must remain unprovable."""
+    import numpy as np
+
+    env = {}
+
+    def val_of(v):
+        lit = _literal_val(v)
+        return lit if lit is not None else env.get(id(v))
+
+    for eqn in jaxpr.eqns:
+        vals = [val_of(v) for v in eqn.invars]
+        if any(v is None for v in vals):
+            continue
+        prim, p = eqn.primitive.name, eqn.params
+        try:
+            if prim == "broadcast_in_dim":
+                op = np.asarray(vals[0])
+                shape = tuple(p["shape"])
+                newshape = [1] * len(shape)
+                for i, d in enumerate(p["broadcast_dimensions"]):
+                    newshape[d] = op.shape[i]
+                res = np.broadcast_to(op.reshape(newshape), shape)
+            elif prim == "concatenate":
+                res = np.concatenate([np.asarray(v) for v in vals],
+                                     axis=p["dimension"])
+            elif prim == "convert_element_type":
+                res = np.asarray(vals[0], dtype=p["new_dtype"])
+            elif prim == "reshape":
+                res = np.reshape(np.asarray(vals[0]), p["new_sizes"])
+            elif prim == "squeeze":
+                res = np.squeeze(np.asarray(vals[0]),
+                                 axis=tuple(p["dimensions"]))
+            elif prim == "add":
+                res = np.asarray(vals[0]) + np.asarray(vals[1])
+            elif prim == "sub":
+                res = np.asarray(vals[0]) - np.asarray(vals[1])
+            elif prim == "mul":
+                res = np.asarray(vals[0]) * np.asarray(vals[1])
+            elif prim == "max":
+                res = np.maximum(vals[0], vals[1])
+            elif prim == "min":
+                res = np.minimum(vals[0], vals[1])
+            else:
+                continue
+        except Exception:  # noqa: BLE001 — fold failure = stay unknown
+            continue
+        if len(eqn.outvars) == 1:
+            env[id(eqn.outvars[0])] = res
+    return env
+
+
+def _scatter_window(eqn, env):
+    """``[(start, extent), ...]`` per operand dim for a single-window
+    scatter with a constant index vector, or None when the write set is
+    not statically derivable (dynamic indices, multi-window scatter,
+    batched dims)."""
+    operand, indices, update = eqn.invars[:3]
+    lit = _literal_val(indices)
+    idx = lit if lit is not None else env.get(id(indices))
+    if idx is None:
+        return None
+    import numpy as np
+
+    idx = np.asarray(idx)
+    if idx.ndim != 1:  # one index vector = one window write
+        return None
+    d = eqn.params["dimension_numbers"]
+    if getattr(d, "operand_batching_dims", ()) or \
+            getattr(d, "scatter_indices_batching_dims", ()):
+        return None
+    rank = len(operand.aval.shape)
+    upd_shape = tuple(update.aval.shape)
+    window_ops = [i for i in range(rank)
+                  if i not in d.inserted_window_dims]
+    if len(d.update_window_dims) != len(window_ops):
+        return None
+    extent = {od: upd_shape[ud]
+              for od, ud in zip(window_ops, d.update_window_dims)}
+    for od in d.inserted_window_dims:
+        extent[od] = 1
+    start = {od: int(idx[k])
+             for k, od in enumerate(d.scatter_dims_to_operand_dims)}
+    return [(start.get(i, 0), extent[i]) for i in range(rank)]
+
+
+# ---------------------------------------------------------------------------
+# HL103 Dirichlet write-set
+# ---------------------------------------------------------------------------
+
+def _default_dirichlet_targets():
+    """(label, fn, example-input ShapeDtypeStruct) triples covering the
+    CPU-traceable solver programs: the jnp 2D/3D fixed and converge
+    loops and the f32chunk chunk chain."""
+    import jax
+
+    from parallel_heat_tpu.config import HeatConfig
+    from parallel_heat_tpu.solver import (_make_loop, _single_multistep)
+
+    targets = []
+    matrix = [
+        ("jnp-2d-fixed", HeatConfig(nx=16, ny=16, steps=4,
+                                    backend="jnp")),
+        ("jnp-2d-converge", HeatConfig(nx=16, ny=16, steps=40,
+                                       converge=True, check_interval=20,
+                                       backend="jnp")),
+        ("jnp-3d-fixed", HeatConfig(nx=8, ny=8, nz=8, steps=4,
+                                    backend="jnp")),
+        ("jnp-2d-f32chunk", HeatConfig(nx=16, ny=16, steps=32,
+                                       dtype="bfloat16",
+                                       accumulate="f32chunk",
+                                       backend="jnp")),
+    ]
+    for label, cfg in matrix:
+        ms, msr = _single_multistep(cfg, "jnp")
+        run = _make_loop(ms, msr, cfg)
+        sds = jax.ShapeDtypeStruct(cfg.shape, cfg.dtype)
+        targets.append((label, run, sds, cfg.shape))
+    return targets
+
+
+def audit_dirichlet(targets=None) -> List[Finding]:
+    """Write-set analysis (rule HL103): trace each target and verify
+    every grid-shaped in-place write excludes the boundary. ``targets``
+    is an iterable of ``(label, fn, example_sds, grid_shape)``."""
+    import jax
+
+    if targets is None:
+        targets = _default_dirichlet_targets()
+    out = []
+    seen = set()
+    loc = "parallel_heat_tpu/ops/stencil.py"
+
+    def report(label, message):
+        # One finding per distinct (target, message): the same write
+        # site appears once per loop iteration/sub-jaxpr otherwise.
+        if (label, message) not in seen:
+            seen.add((label, message))
+            out.append(Finding("HL103", "error", loc, 0, label, message))
+
+    def check_window(label, window, grid_shape, what):
+        for d, ((start, ext), dim) in enumerate(zip(window, grid_shape)):
+            if start < 1 or start + ext > dim - 1:
+                report(label,
+                       f"write-set touches the Dirichlet boundary: "
+                       f"{what} axis {d} writes [{start}, "
+                       f"{start + ext}) of a {dim}-cell axis — "
+                       f"interior writes must stay within [1, "
+                       f"{dim - 1}) (SEMANTICS.md 'Boundary "
+                       f"exactness': boundary cells are never "
+                       f"written)")
+                return
+
+    for label, fn, sds, grid_shape in targets:
+        try:
+            closed = jax.make_jaxpr(fn)(sds)
+        except Exception as e:  # noqa: BLE001 — an untraceable target
+            report(label, f"could not trace target for write-set "
+                          f"analysis: {type(e).__name__}: {e}")
+            continue
+        grid_shape = tuple(grid_shape)
+        for jaxpr in _walk_jaxprs(closed):
+            env = None  # fold lazily, once per jaxpr that needs it
+            for eqn in jaxpr.eqns:
+                prim = eqn.primitive.name
+                if prim == "dynamic_update_slice":
+                    operand, update, *starts = eqn.invars
+                    if tuple(operand.aval.shape) != grid_shape:
+                        continue
+                    upd_shape = tuple(update.aval.shape)
+                    vals = [_literal_val(s) for s in starts]
+                    if any(v is None for v in vals):
+                        report(label,
+                               f"grid-shaped write with non-literal "
+                               f"start indices — the Dirichlet "
+                               f"write-set cannot be proven "
+                               f"boundary-free statically (update "
+                               f"shape {upd_shape})")
+                        continue
+                    window = [(int(s), e)
+                              for s, e in zip(vals, upd_shape)]
+                    check_window(label, window, grid_shape,
+                                 "dynamic_update_slice")
+                elif prim.startswith("scatter"):
+                    operand = eqn.invars[0]
+                    if tuple(operand.aval.shape) != grid_shape:
+                        continue
+                    if env is None:
+                        env = _fold_constants(jaxpr)
+                    window = _scatter_window(eqn, env)
+                    if window is None:
+                        report(label,
+                               "grid-shaped scatter write whose index "
+                               "set is not a trace-time constant — "
+                               "the Dirichlet write-set cannot be "
+                               "proven boundary-free statically; use "
+                               "a static interior slice-assign "
+                               "(u.at[1:-1, ...].set) instead")
+                        continue
+                    check_window(label, window, grid_shape, prim)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HL104 f32chunk accumulation chain
+# ---------------------------------------------------------------------------
+
+# Primitives whose output propagates the (possibly rounded) VALUE
+# unchanged — traversal continues through them.
+_PASS_THROUGH = {
+    "convert_element_type", "dynamic_update_slice", "dynamic_slice",
+    "slice", "reshape", "broadcast_in_dim", "transpose", "squeeze",
+    "concatenate", "copy", "pad", "rev",
+}
+# Arithmetic: a rounded value feeding one of these means the chain
+# continued past a rounding point.
+_ARITHMETIC = {
+    "add", "sub", "mul", "div", "max", "min", "integer_pow", "pow",
+    "dot_general", "exp", "log", "sqrt", "rsqrt", "abs", "neg",
+    "tanh", "logistic", "atan2", "rem", "nextafter", "fma",
+}
+
+
+def _default_f32chunk_targets():
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu.ops.pallas_stencil import (
+        _sub_rows, f32chunk_jnp_multistep)
+
+    shape, dtype = (16, 16), "bfloat16"
+    sub = _sub_rows(dtype)
+    ms, msr = f32chunk_jnp_multistep(shape, dtype, 0.1, 0.1)
+    sds = jax.ShapeDtypeStruct(shape, jnp.bfloat16)
+    return [
+        ("f32chunk-multistep", lambda u: ms(u, sub), sds),
+        ("f32chunk-residual", lambda u: msr(u, sub), sds),
+        ("f32chunk-two-chunks", lambda u: ms(u, 2 * sub), sds),
+    ]
+
+
+def audit_f32chunk(targets=None) -> List[Finding]:
+    """Mid-chain downcast analysis (rule HL104). ``targets`` is an
+    iterable of ``(label, fn, example_sds)`` where each ``fn`` is one
+    f32chunk accumulation chunk (chunk boundaries — loop carries —
+    are the contract's legitimate rounding points and naturally scope
+    the per-jaxpr analysis)."""
+    import jax
+    import numpy as np
+
+    if targets is None:
+        targets = _default_f32chunk_targets()
+    out = []
+    loc = "parallel_heat_tpu/ops/pallas_stencil.py"
+    for label, fn, sds in targets:
+        try:
+            closed = jax.make_jaxpr(fn)(sds)
+        except Exception as e:  # noqa: BLE001
+            out.append(Finding(
+                "HL104", "error", loc, 0, label,
+                f"could not trace f32chunk chain: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        for jaxpr in _walk_jaxprs(closed):
+            consumers = {}
+            for eqn in jaxpr.eqns:
+                for v in eqn.invars:
+                    if _literal_val(v) is None:
+                        consumers.setdefault(id(v), []).append(eqn)
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src_dt = np.dtype(eqn.invars[0].aval.dtype)
+                dst_dt = np.dtype(eqn.outvars[0].aval.dtype)
+                if not (src_dt.itemsize >= 4 and dst_dt.itemsize < 4):
+                    continue  # not a downcast to sub-f32 storage
+                # BFS from the rounded value through value-preserving
+                # primitives; arithmetic consumption = mid-chain round.
+                frontier = [eqn.outvars[0]]
+                seen = set()
+                hit = None
+                while frontier and hit is None:
+                    var = frontier.pop()
+                    if id(var) in seen:
+                        continue
+                    seen.add(id(var))
+                    for c in consumers.get(id(var), ()):
+                        prim = c.primitive.name
+                        if prim in _ARITHMETIC:
+                            hit = prim
+                            break
+                        if prim in _PASS_THROUGH:
+                            frontier.extend(c.outvars)
+                if hit is not None:
+                    out.append(Finding(
+                        "HL104", "error", loc, 0, label,
+                        f"mid-chain downcast: a value rounded to "
+                        f"{dst_dt.name} is consumed by arithmetic "
+                        f"({hit}) within the same f32chunk chunk — "
+                        f"the chain must carry float32 and round to "
+                        f"storage exactly once, at the chunk boundary "
+                        f"(SEMANTICS.md 'Sub-f32 rounding points')"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry / driver
+# ---------------------------------------------------------------------------
+
+CONTRACT_RULES = {
+    "HL101": ("error", "cache-key partition violated or unproven",
+              audit_cache_keys),
+    "HL102": ("error", "donated buffer read/escaped after dispatch",
+              audit_donation),
+    "HL103": ("error", "kernel write-set touches the Dirichlet boundary",
+              audit_dirichlet),
+    "HL104": ("error", "f32chunk chain downcasts mid-chain",
+              audit_f32chunk),
+}
+
+
+def run_contracts(rules=None) -> List[Finding]:
+    """Run the trace-level audits against the installed package."""
+    out = []
+    for rule_id, (_sev, _summary, fn) in CONTRACT_RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        out.extend(fn())
+    return out

@@ -20,6 +20,29 @@ from typing import Optional, Tuple
 _VALID_DTYPES = ("float32", "bfloat16", "float64")
 _VALID_BACKENDS = ("auto", "jnp", "pallas")
 
+# --- cache-key partition (SEMANTICS.md "Statically verified contracts")
+#
+# Every HeatConfig field is classified exactly once, here. SEMANTIC
+# fields select what the compiled simulation programs compute; they ARE
+# the runner/executable cache key. OBSERVATION_ONLY fields configure
+# host-side observers and orchestration (the guard, diagnostics,
+# dispatch pipelining) and are provably stripped — reset to their
+# defaults by ``solver._observer_free`` — before any
+# ``solver._build_runner`` / executable-cache lookup, so enabling them
+# can never fork a compiled program. The partition is machine-checked
+# by ``parallel_heat_tpu.analysis`` rule HL101 (``tools/heatlint.py``):
+# a new field that appears in NEITHER tuple fails CI, as does an
+# observation-only field the strip site does not actually strip. Keep
+# both tuples in declaration order.
+SEMANTIC_FIELDS = (
+    "nx", "ny", "nz", "cx", "cy", "cz",
+    "steps", "converge", "eps", "check_interval",
+    "dtype", "backend", "mesh_shape", "overlap", "halo_depth",
+    "accumulate",
+)
+OBSERVATION_ONLY_FIELDS = ("guard_interval", "diag_interval",
+                           "pipeline_depth")
+
 
 def divisible_factorizations(n_devices: int, shape) -> list:
     """Ordered ``len(shape)``-factorizations of ``n_devices`` whose

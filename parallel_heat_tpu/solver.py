@@ -175,6 +175,30 @@ def _dtype_of(config: HeatConfig):
     return jnp.dtype(config.dtype)
 
 
+def _observer_free(config: HeatConfig) -> HeatConfig:
+    """THE strip site (SEMANTICS.md "Statically verified contracts"):
+    the exact config :func:`_build_runner` and the executable cache key
+    on, with every observation-only field reset to its default.
+
+    The guard, diagnostics, and dispatch pipelining are observation /
+    orchestration only and never part of the compiled step program:
+    stripping them here means an instrumented or pipelined run reuses
+    (and can never diverge from) the plain run's compiled programs.
+    The field list is ``config.OBSERVATION_ONLY_FIELDS`` — the same
+    declaration the heatlint cache-key audit (rule HL101) checks, so
+    classifying a new field as observation-only IS stripping it; a
+    field classified nowhere fails CI before it can fork a program.
+    """
+    import dataclasses
+
+    from parallel_heat_tpu.config import OBSERVATION_ONLY_FIELDS
+
+    defaults = {f.name: f.default for f in dataclasses.fields(config)}
+    kw = {name: defaults[name] for name in OBSERVATION_ONLY_FIELDS
+          if getattr(config, name) != defaults[name]}
+    return config.replace(**kw) if kw else config
+
+
 # --------------------------------------------------------------------------
 # Loop construction (shared by single-device and per-shard programs)
 # --------------------------------------------------------------------------
@@ -962,16 +986,9 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
             "pipeline_depth > 1 is fixed-step only (converge mode must "
             "read each chunk's convergence verdict before dispatching "
             "the next chunk)")
-    if (guard_interval is not None or diag_interval is not None
-            or config.pipeline_depth is not None):
-        # The guard, diagnostics, and dispatch pipelining are
-        # observation/orchestration only and never part of the compiled
-        # step program: strip them so the runner/executable caches key
-        # on the observer-free config — an instrumented or pipelined
-        # run reuses (and can never diverge from) the plain run's
-        # compiled programs.
-        config = config.replace(guard_interval=None, diag_interval=None,
-                                pipeline_depth=None)
+    # Strip observation-only fields so the runner/executable caches key
+    # on the observer-free config (see _observer_free's docstring).
+    config = _observer_free(config)
     if chunk_steps is not None and chunk_steps < 1:
         raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
     total = config.steps
@@ -1035,7 +1052,11 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
         # `busy<X` CI gate meaningful for pipelined runs.
         idle_mark = None
 
-        def _dispatch():
+        def _dispatch():  # heatlint: dispatch-region
+            # The pragma scopes heatlint rule HL201: nothing in this
+            # function may synchronize with the device (block_until_
+            # ready, device_get, np.asarray, scalar reads) — a blocking
+            # call here would serialize the pipeline it exists to fill.
             nonlocal u, disp_done, next_guard, next_diag
             nonlocal prev_diag, prev_diag_step, idle_mark
             c = min(chunk, total - disp_done)
@@ -1248,17 +1269,14 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
     config = config.validate()
     guard_interval = config.guard_interval
     diag_interval = config.diag_interval
-    if (guard_interval is not None or diag_interval is not None
-            or config.pipeline_depth is not None):
-        # solve is ONE compiled dispatch — there is no intermediate
-        # boundary to observe (or to pipeline: pipeline_depth is inert
-        # here), so the guard and diagnostics degrade to a single
-        # end-of-run check/sample (use solve_stream or the supervisor
-        # for within-run detection). Stripped from the config so
-        # compiled programs are shared with (and bitwise identical to)
-        # uninstrumented runs.
-        config = config.replace(guard_interval=None, diag_interval=None,
-                                pipeline_depth=None)
+    # solve is ONE compiled dispatch — there is no intermediate
+    # boundary to observe (or to pipeline: pipeline_depth is inert
+    # here), so the guard and diagnostics degrade to a single
+    # end-of-run check/sample (use solve_stream or the supervisor
+    # for within-run detection). Stripped from the config so compiled
+    # programs are shared with (and bitwise identical to)
+    # uninstrumented runs (see _observer_free).
+    config = _observer_free(config)
     runner, _ = _build_runner(config)
     initial = _prepare_initial(config, initial)
     compiled = _compiled_for(runner, config, initial)

@@ -236,8 +236,10 @@ class Telemetry:
                 self._write_record(rec)
             except Exception as e:  # noqa: BLE001 — a writer-thread
                 # crash must never take the run down OR wedge close()
-                if not self._dead:
+                with self._io_lock:
+                    already = self._dead
                     self._dead = True
+                if not already:
                     warnings.warn(
                         f"telemetry writer thread disabled after "
                         f"unexpected error: {e}", RuntimeWarning)

@@ -64,7 +64,7 @@ def main():
 
     from parallel_heat_tpu import HeatConfig
     from parallel_heat_tpu.models import HeatPlate2D
-    from parallel_heat_tpu.solver import _build_runner
+    from parallel_heat_tpu.solver import _build_runner, _observer_free
     from parallel_heat_tpu.utils.profiling import chain_slope
 
     K = 8
@@ -81,7 +81,7 @@ def main():
 
         # -- production path: the solver's own compiled runner, K steps
         cfg = HeatConfig(nx=nx, ny=ny, steps=K, backend="auto")
-        runner, _ = _build_runner(cfg)
+        runner, _ = _build_runner(_observer_free(cfg))
         prod = lambda g: runner(g)[0]
         # runner donates; chain_slope copies u0 first, then chains.
         per = chain_slope(prod, u0, 4, 24, batches=3)

@@ -125,6 +125,7 @@ def build_padslice(shape, k, strip_rows=128):
 
     call = pl.pallas_call(
         kernel,
+        name="heat_probe_roll_pad",
         out_shape=(
             jax.ShapeDtypeStruct((M, N), dtype),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),

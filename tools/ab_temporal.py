@@ -232,6 +232,7 @@ def build(shape, k, T, substrip, variant):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_ab_temporal",
         grid=(n_strips,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_shape=(

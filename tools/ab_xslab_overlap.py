@@ -115,6 +115,7 @@ def build_3buf(shape, sx, k, cx=0.1, cy=0.1, cz=0.1):
 
     call = pl.pallas_call(
         kernel,
+        name="heat_probe_xslab_overlap",
         grid=(n_slabs,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), dtype),

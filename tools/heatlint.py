@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""heatlint — static contract verification for parallel_heat_tpu.
+
+Two layers (see ``parallel_heat_tpu/analysis/``): the trace-level
+contract verifiers (HL1xx — cache-key partition, donation safety,
+Dirichlet write-set, f32chunk rounding chain; they trace solver
+programs to jaxprs without executing them) and the AST-level custom
+lint (HL2xx — blocking syncs in dispatch regions, wall-clock/RNG in
+traced code, Pallas kernel names, lock discipline, import hygiene).
+
+Usage::
+
+    python tools/heatlint.py                      # full run, repo scope
+    python tools/heatlint.py --fail-on error      # the CI gate (make lint)
+    python tools/heatlint.py --layer ast src/     # fast AST-only pass
+    python tools/heatlint.py --rules HL203,HL205  # rule subset
+    python tools/heatlint.py --list-rules
+    python tools/heatlint.py --json               # machine-readable
+
+Exit codes: 0 clean (below the --fail-on threshold), 1 usage/internal
+error, 2 findings at/above the threshold. Intentionally-kept findings
+live in ``heatlint.baseline.json`` (``--baseline``; format in
+docs/API.md) — every entry needs a one-line justification, and stale
+entries are reported so the ledger shrinks when the code improves.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# The trace layer imports jax; keep it off any accelerator a shell
+# might pin (tracing is platform-independent, CPU is always present).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="heatlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories for the AST layer "
+                         "(default: parallel_heat_tpu tools bench.py)")
+    ap.add_argument("--layer", choices=("all", "trace", "ast"),
+                    default="all",
+                    help="which analyzer layer(s) to run (default all; "
+                         "'ast' is jax-free and fast — the smoke-chain "
+                         "self-check)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-id subset (e.g. "
+                         "HL101,HL203)")
+    ap.add_argument("--fail-on", choices=("error", "warning", "info"),
+                    default="error", dest="fail_on",
+                    help="exit 2 when any finding is at/above this "
+                         "severity (default error)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file of justified keeps (default: "
+                         "heatlint.baseline.json when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file (show everything)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as one JSON document")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    from parallel_heat_tpu.analysis import ALL_RULES
+    from parallel_heat_tpu.analysis.astlint import lint_paths
+    from parallel_heat_tpu.analysis.contracts import run_contracts
+    from parallel_heat_tpu.analysis.findings import (
+        apply_baseline, gates, load_baseline, render_findings)
+
+    if args.list_rules:
+        for rid in sorted(ALL_RULES):
+            sev, summary, _fn = ALL_RULES[rid]
+            layer = "trace" if rid.startswith("HL1") else "ast"
+            print(f"{rid}  [{layer}/{sev}]  {summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(ALL_RULES)
+        if unknown:
+            print(f"heatlint: unknown rule id(s): {sorted(unknown)} "
+                  f"(--list-rules shows the table)", file=sys.stderr)
+            return 1
+
+    try:
+        baseline = None
+        if not args.no_baseline:
+            baseline = load_baseline(args.baseline)
+    except (ValueError, FileNotFoundError, json.JSONDecodeError) as e:
+        print(f"heatlint: bad baseline: {e}", file=sys.stderr)
+        return 1
+
+    findings = []
+    if args.layer in ("all", "trace"):
+        findings.extend(run_contracts(rules=rules))
+    if args.layer in ("all", "ast"):
+        findings.extend(lint_paths(args.paths or None, rules=rules))
+
+    active, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in active],
+            "stale_baseline": [
+                {"rule": r, "file": p, "symbol": s}
+                for r, p, s in stale],
+            "fail_on": args.fail_on,
+        }, indent=2))
+    else:
+        text = render_findings(active, stale)
+        if text:
+            print(text)
+        n_err = sum(f.severity == "error" for f in active)
+        n_warn = sum(f.severity == "warning" for f in active)
+        print(f"heatlint: {n_err} error(s), {n_warn} warning(s), "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}"
+              + (f" [{baseline.path}]"
+                 if baseline and baseline.path else ""))
+    return 2 if gates(active, args.fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

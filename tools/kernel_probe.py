@@ -73,6 +73,7 @@ def build(shape, k, variant):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_kernel",
         out_shape=jax.ShapeDtypeStruct((M, N), dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),

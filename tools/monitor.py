@@ -35,7 +35,6 @@ Modes:
 import argparse
 import glob as _glob
 import json
-import os
 import sys
 import time
 

@@ -85,6 +85,7 @@ def build_probe(M, cols_src, cols_dst, T, k, n_sems, tail=0):
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (2 if tail else 1)
     return pl.pallas_call(
         kernel,
+        name="heat_probe_gather_dma",
         grid=(n_strips,),
         in_specs=in_specs,
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),

@@ -32,7 +32,6 @@ Run: python tools/probe_split_copy.py [--size 4096]
 """
 
 import argparse
-import functools
 import sys
 
 sys.path.insert(0, ".")
@@ -184,6 +183,7 @@ def build(shape, k, split):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_split_copy",
         grid=(n_strips,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_shape=(

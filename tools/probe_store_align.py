@@ -64,6 +64,7 @@ def build(R, N, lo, rows, D, dtype=jnp.float32):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_store_align",
         out_shape=jax.ShapeDtypeStruct((R, N), dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),

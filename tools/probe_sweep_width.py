@@ -70,6 +70,7 @@ def build(R, N, rows, D, dtype=jnp.float32):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_sweep_width",
         out_shape=jax.ShapeDtypeStruct((R, N), dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),

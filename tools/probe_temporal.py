@@ -18,7 +18,6 @@ import sys
 
 sys.path.insert(0, ".")
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +145,7 @@ def build(shape, k, T, substrip, variant):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_temporal",
         grid=(n_strips,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_shape=(

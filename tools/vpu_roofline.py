@@ -94,6 +94,7 @@ def _build(kind, R, N, D, P=8, dtype=jnp.float32):
 
     return pl.pallas_call(
         kernel,
+        name="heat_probe_vpu_roofline",
         out_shape=jax.ShapeDtypeStruct((R, N), dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
