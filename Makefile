@@ -27,8 +27,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test lint chaos telemetry-smoke \
-        monitor-smoke overlap-smoke bench clean
+.PHONY: all heat heat_con native test lint lint-fast chaos \
+        telemetry-smoke monitor-smoke overlap-smoke bench clean
 
 all: heat
 
@@ -48,17 +48,26 @@ test:
 	$(PY) -m pytest tests/ -x -q
 
 # static contract verification (SEMANTICS.md "Statically verified
-# contracts"): the heatlint trace+AST layers gate on error severity;
-# intentionally-kept findings live in heatlint.baseline.json. ruff
+# contracts"): the heatlint trace+AST+spmd+kernels layers gate on
+# error severity and print a per-layer timing summary;
+# --strict-baseline makes stale ledger entries fail CI too.
+# Intentionally-kept findings live in heatlint.baseline.json. ruff
 # (import hygiene + unused-code subset, [tool.ruff] in pyproject.toml)
 # rides the same target when installed — heatlint is the hard gate.
 lint:
-	JAX_PLATFORMS=cpu $(PY) tools/heatlint.py --fail-on error
+	JAX_PLATFORMS=cpu $(PY) tools/heatlint.py --fail-on error \
+	    --strict-baseline
 	@if command -v ruff >/dev/null 2>&1; then \
 	    ruff check parallel_heat_tpu tools bench.py; \
 	else \
 	    echo "ruff not installed; skipping (heatlint gate passed)"; \
 	fi
+
+# pre-commit path: the jax-free AST layer only (a few seconds); the
+# trace/spmd/kernels proof layers run in `make lint` / CI.
+lint-fast:
+	$(PY) tools/heatlint.py --layer ast --fail-on error \
+	    --strict-baseline
 
 # fault-injection smoke for the run supervisor (CPU only, no TPU needed)
 chaos:

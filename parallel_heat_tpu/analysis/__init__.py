@@ -11,7 +11,7 @@ exactly the kind of invariant that rots when the config/kernel surface
 multiplies (ROADMAP items 2-3); this package makes them machine-checked
 before a kernel ever runs.
 
-Two layers (SEMANTICS.md "Statically verified contracts"):
+Four layers (SEMANTICS.md "Statically verified contracts"):
 
 - :mod:`contracts` — **trace-level** verifiers (rules ``HL1xx``): they
   trace solver programs to jaxprs (abstract evaluation — nothing
@@ -21,6 +21,15 @@ Two layers (SEMANTICS.md "Statically verified contracts"):
   package source: blocking host syncs in dispatch regions, wall-clock/
   RNG in traced code, Pallas kernel names, lock discipline, import
   hygiene.
+- :mod:`spmd` — **SPMD/collective** verifiers (rules ``HL3xx``): they
+  trace the real sharded programs on a simulated multi-device mesh and
+  prove the halo ``ppermute`` protocol (bijection + direction
+  symmetry), collective-sequence convergence across branches/variants,
+  and replication of every scalar that feeds host control flow.
+- :mod:`kernels` — **Pallas kernel-safety** verifiers (rules
+  ``HL4xx``): every kernel builder is traced at a representative
+  geometry and its DMA windows, VMEM footprint, semaphore discipline
+  and grid/BlockSpec tiling are checked per grid instance.
 
 ``tools/heatlint.py`` is the CLI; ``make lint`` gates CI on
 ``--fail-on error``. Intentionally-kept findings live in
@@ -42,18 +51,47 @@ from parallel_heat_tpu.analysis.contracts import (  # noqa: F401
     CONTRACT_RULES,
     run_contracts,
 )
+from parallel_heat_tpu.analysis.spmd import (  # noqa: F401
+    SPMD_RULES,
+    run_spmd,
+)
+from parallel_heat_tpu.analysis.kernels import (  # noqa: F401
+    KERNEL_RULES,
+    run_kernels,
+)
 
-ALL_RULES = {**CONTRACT_RULES, **AST_RULES}
+ALL_RULES = {**CONTRACT_RULES, **AST_RULES, **SPMD_RULES,
+             **KERNEL_RULES}
+
+# Layer name -> (rule table, runner). The CLI's --layer flag and the
+# per-layer timing summary both read this; a new analyzer layer lands
+# by adding one row.
+LAYERS = {
+    "trace": (CONTRACT_RULES, lambda rules=None: run_contracts(rules)),
+    "ast": (AST_RULES, lambda rules=None: lint_paths(None, rules=rules)),
+    "spmd": (SPMD_RULES, lambda rules=None: run_spmd(rules)),
+    "kernels": (KERNEL_RULES, lambda rules=None: run_kernels(rules)),
+}
+
+
+def layer_of(rule_id: str) -> str:
+    """The layer name a rule id belongs to (``HL1xx`` -> trace, ...)."""
+    for name, (table, _run) in LAYERS.items():
+        if rule_id in table:
+            return name
+    return "?"
 
 
 def run_all(paths=None, baseline=None):
-    """Run both layers; returns ``(findings, stale_baseline_entries)``.
+    """Run every layer; returns ``(findings, stale_baseline_entries)``.
 
     ``paths`` scopes the AST layer (defaults inside
-    :func:`astlint.lint_paths`); the contract layer always audits the
+    :func:`astlint.lint_paths`); the other layers always audit the
     installed package. ``baseline`` (a parsed baseline, see
     :func:`findings.load_baseline`) suppresses matched findings.
     """
     out = list(run_contracts())
     out.extend(lint_paths(paths))
+    out.extend(run_spmd())
+    out.extend(run_kernels())
     return apply_baseline(out, baseline)

@@ -52,6 +52,12 @@ class Finding:
     # Set when a baseline entry suppressed this finding (carried in
     # to_dict() output; suppressed findings never gate).
     justification: Optional[str] = None
+    # True for audit-soundness sentinels (exhaustion bounds, unprovable
+    # schedules, vacuous target matrices): they report that an audit
+    # could not run to completion, so a rule-subset run must surface
+    # them even when their nominal rule id was filtered out — otherwise
+    # "clean" can mean "silently skipped".
+    soundness: bool = False
 
     def key(self):
         return (self.rule, _norm(self.file), self.symbol)
@@ -62,6 +68,10 @@ class Finding:
              "symbol": self.symbol, "message": self.message}
         if self.justification is not None:
             d["justification"] = self.justification
+        if self.soundness:
+            # Machine consumers must be able to tell "the audit could
+            # not run" from an ordinary violation of the same rule id.
+            d["soundness"] = True
         return d
 
 
@@ -122,12 +132,29 @@ def load_baseline(path: Optional[str] = None) -> Baseline:
     return Baseline(entries=out, path=path)
 
 
-def apply_baseline(findings, baseline: Optional[Baseline]):
+def apply_baseline(findings, baseline: Optional[Baseline],
+                   assessed_rules=None, assessed_paths=None,
+                   path_rules=()):
     """Split findings into (active, suppressed-but-annotated) and
     report stale entries. Returns ``(active, stale)`` where ``active``
     excludes suppressed findings and ``stale`` is a list of baseline
     keys that matched nothing (each rendered as an ``HL000`` warning by
-    the CLI so the ledger shrinks when code improves)."""
+    the CLI so the ledger shrinks when code improves).
+
+    ``assessed_rules`` (a set of rule ids, default: all) scopes
+    stale-ness: an entry whose rule was NOT assessed this run — its
+    layer skipped via ``--layer``/``--rules`` — is neither matched nor
+    stale, just unassessed. Without this, any partial run
+    (``make lint-fast``) would flag every entry of the layers it
+    skipped, and ``--strict-baseline`` would turn that into a spurious
+    gate.
+
+    ``assessed_paths`` (normalized path roots, default: everything)
+    scopes stale-ness for the rules in ``path_rules`` (the AST layer):
+    an entry whose file lies outside every scanned root was never given
+    a chance to match — its violation may still be alive in the
+    unscanned file — so it is unassessed, not stale. Entries whose
+    files WERE scanned still go stale normally."""
     if baseline is None:
         baseline = Baseline()
     matched = set()
@@ -139,7 +166,17 @@ def apply_baseline(findings, baseline: Optional[Baseline]):
             f.justification = just
             continue
         active.append(f)
-    stale = [k for k in baseline.entries if k not in matched]
+
+    def _path_assessed(rule, fpath):
+        if assessed_paths is None or rule not in path_rules:
+            return True
+        return any(fpath == root or fpath.startswith(root + "/")
+                   for root in assessed_paths)
+
+    stale = [k for k in baseline.entries
+             if k not in matched
+             and (assessed_rules is None or k[0] in assessed_rules)
+             and _path_assessed(k[0], k[1])]
     return active, stale
 
 

@@ -1,0 +1,1062 @@
+"""Layer 4: Pallas kernel-safety verifiers (``HL4xx``).
+
+The kernel family (A/B/C/D/E/F/G/H/I + -uni/-fuse/-band variants, 17
+``pallas_call`` sites in ``ops/pallas_stencil.py``) hand-manages DMA
+windows, VMEM scratch, and double-buffer semaphores. Until now the only
+enforcement was dynamic: hw_validate parity runs on real hardware.
+These rules verify the same discipline *statically*: each builder is
+instantiated at a representative geometry, traced with
+``jax.make_jaxpr`` (abstract evaluation — no kernel executes), and the
+``pallas_call`` eqn's ``grid_mapping``, block specs and kernel jaxpr
+are analyzed directly:
+
+- **HL401 dma-in-bounds** — every async-copy window is proven inside
+  its source and destination refs. The kernel jaxpr's scalar index
+  arithmetic (``program_id``, clamps, ``pl.multiple_of``, prefetched
+  offsets) is evaluated concretely for EVERY grid instance, so the
+  clamped edge windows and the steady-state prefetch windows are both
+  checked exactly — including the E-uni/I-uni fixed-shape gather
+  bands, whose conditional edge branches resolve per instance. A
+  window whose start the evaluator cannot resolve is reported as
+  unprovable (the contract demands provability, not plausibility).
+- **HL402 vmem-budget** — the kernel's static VMEM footprint (grid-
+  mapped VMEM blocks double-buffered by the Mosaic pipeline, plus all
+  VMEM scratch) must fit ``TpuParams.vmem_limit_bytes``, so a
+  geometry the pickers admit can never be one XLA rejects at run
+  time with a scoped-vmem OOM.
+- **HL403 dma-discipline** — the per-instance DMA schedule (the TPU
+  grid is sequential) is simulated over counting semaphores: a wait
+  with no outstanding copy (a hang), a copy started but never waited
+  (a leak past the kernel's end), and a copy started into a window
+  overlapping an outstanding copy's destination (double-buffer slot
+  reuse while in flight) are all errors.
+- **HL404 grid-coverage** — for every grid-blocked ref, the block
+  shape divides the array shape (the same exact-tiling discipline
+  ``config.divisible_factorizations`` pins at the mesh level), the
+  index map stays in range for every grid instance, and each OUTPUT
+  ref's blocks are fully covered — an uncovered output block is
+  silently-uninitialized VMEM leaving the kernel.
+
+The default target matrix instantiates every builder; the audit then
+cross-checks coverage against the ``name="heat_*"`` literals in
+``ops/pallas_stencil.py`` (the same literals rule HL203 enforces), so
+an 18th kernel site cannot land without either an audit target or a
+justified baseline entry. All audits accept injected targets so test
+fixtures can seed violations.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import List, Optional
+
+from parallel_heat_tpu.analysis.findings import Finding
+
+_LOC = "parallel_heat_tpu/ops/pallas_stencil.py"
+
+# Refuse to "prove" anything by exhaustion past this many grid
+# instances — the audit geometries are chosen small; a blow-up here
+# means the target matrix regressed, not that the kernel is fine.
+_MAX_INSTANCES = 4096
+
+
+class _Unknown:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<unknown>"
+
+
+UNKNOWN = _Unknown()
+
+
+class _Ref:
+    """Concrete handle for a memref kernel operand."""
+
+    __slots__ = ("rid", "shape", "space", "itemsize", "value")
+
+    def __init__(self, rid, aval, value=None):
+        import numpy as np
+
+        self.rid = rid
+        self.shape = tuple(aval.shape)
+        self.space = str(getattr(aval, "memory_space", "vmem"))
+        try:
+            self.itemsize = np.dtype(aval.dtype).itemsize
+        except TypeError:
+            # Extended dtypes (semaphore refs trace as 'dma_sem' when
+            # the builder bypasses the prefetch grid-spec path) carry
+            # no numpy itemsize; they never participate in byte math.
+            self.itemsize = getattr(aval.dtype, "itemsize", 0) or 0
+        self.value = value  # concrete np array for prefetch operands
+
+
+class KernelTarget:
+    """One traceable kernel invocation: ``fn(*args_sds)`` traced with
+    ``make_jaxpr``; ``prefetch`` supplies concrete values for the
+    pallas scalar-prefetch operands (audit-chosen offsets — the DMA
+    schedule must not depend on them, and the evaluator reports any
+    window that does as unprovable unless it resolves)."""
+
+    def __init__(self, label, fn, args_sds, prefetch=None):
+        self.label = label
+        self.fn = fn
+        self.args_sds = args_sds
+        self.prefetch = prefetch
+
+
+# ---------------------------------------------------------------------------
+# Target matrix: every builder at a representative geometry
+# ---------------------------------------------------------------------------
+
+def default_kernel_targets() -> List[KernelTarget]:
+    import jax
+    import numpy as np
+
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    f32 = "float32"
+
+    def sds(shape, dt=f32):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    offs2 = np.zeros(2, np.int32)
+    offs3 = np.zeros(3, np.int32)
+    T: List[KernelTarget] = []
+
+    def add(label, fn, args, prefetch=None):
+        if fn is None:
+            raise RuntimeError(
+                f"kernel audit target {label!r} declined to build — "
+                f"the representative geometry regressed; fix "
+                f"default_kernel_targets before trusting the audit")
+        T.append(KernelTarget(label, fn, args, prefetch))
+
+    # Kernel A — VMEM-resident multi-step (no DMA engine use).
+    add("A", ps._build_vmem_multistep((24, 36), f32, 0.1, 0.1, 4),
+        [sds((24, 36))])
+
+    # Kernel B — streaming strip, unsharded (clamped windows) and
+    # sharded (extended input rows).
+    fnB, subB = ps._build_strip_kernel((64, 64), f32, 0.1, 0.1,
+                                       (64, 64), False)
+    add("B", lambda u, f=fnB: f(u, 0, 0), [sds((64, 64))], offs2)
+    fnBs, subBs = ps._build_strip_kernel((32, 64), f32, 0.1, 0.1,
+                                         (64, 128), True)
+    add("B-sharded", lambda u, f=fnBs: f(u, 0, 0),
+        [sds((32 + 2 * subBs, 64))], offs2)
+
+    # Kernel C — 2D-tiled streaming (both axes windowed).
+    fnC, _ = ps._build_tiled_kernel((32, 2048), f32, 0.1, 0.1,
+                                    (32, 2048), False)
+    add("C", lambda u, f=fnC: f(u, 0, 0), [sds((32, 2048))], offs2)
+
+    # Kernel E — temporal strip, storage and f32chunk accumulation.
+    add("E", ps._build_temporal_strip((64, 64), f32, 0.1, 0.1, 8),
+        [sds((64, 64))])
+    add("E-acc", ps._build_temporal_strip((64, 64), "bfloat16",
+                                          0.1, 0.1, 16, acc_f32=True),
+        [sds((64, 64), "bfloat16")])
+    # Kernel E-uni — uniform-window gather (>= 3 strips).
+    add("E-uni", ps._build_temporal_strip_uniform((64, 64), f32,
+                                                  0.1, 0.1, 8),
+        [sds((64, 64))])
+
+    # Kernel I / I-uni — 2D-tiled temporal.
+    add("I", ps._build_tile_temporal_2d((64, 256), f32, 0.1, 0.1, 8),
+        [sds((64, 256))])
+    add("I-uni", ps._build_tile_temporal_2d_uniform((64, 256), f32,
+                                                    0.1, 0.1, 8),
+        [sds((64, 256))])
+
+    # Kernel G family — shard-block temporal; shapes follow
+    # parallel/temporal.py's exchange assembly exactly.
+    bx, by, K = 16, 16, 8
+    gargs = ((bx, by), f32, 0.1, 0.1, (32, 32), K)
+    g = ps._build_temporal_block(*gargs)
+    add("G", lambda ext, f=g: f(ext, 0, 0),
+        [sds((bx + 2 * K, g.padded_width))], offs2)
+    gc = ps._build_temporal_block_circular(*gargs)
+    add("G-circ", lambda ext, f=gc: f(ext, 0, 0),
+        [sds((bx + 2 * K, by + gc.tail))], offs2)
+    gf = ps._build_temporal_block_fused(*gargs)
+    fuse_args = [sds((bx, by)), sds((bx, gf.tail)),
+                 sds((K, by + gf.tail)), sds((K, by + gf.tail))]
+    add("G-fuse", lambda u, t, hn, hs, f=gf: f(u, t, hn, hs, 0, 0),
+        fuse_args, offs2)
+    gu = ps._build_temporal_block_uniform(*gargs)
+    add("G-uni", lambda u, t, hn, hs, f=gu: f(u, t, hn, hs, 0, 0),
+        fuse_args, offs2)
+    gud = ps._build_temporal_block_uniform(*gargs, defer_ns=True)
+    add("G-uni-defer", lambda u, t, f=gud: f(u, t, 0, 0),
+        fuse_args[:2], offs2)
+    gb = ps._build_band_fix_2d(*gargs, ("x", "y"))
+    add("G-band", lambda u, t, hn, hs, f=gb: f(u, t, hn, hs, 0, 0),
+        fuse_args, offs2)
+
+    # Kernel D — XY-tiled 3D slab.
+    add("D", ps._build_slab_kernel_3d((16, 32, 128), f32,
+                                      0.1, 0.1, 0.1),
+        [sds((16, 32, 128))])
+    # Kernel F — X-slab temporal 3D.
+    add("F", ps._build_xslab_3d((32, 16, 128), f32, 0.1, 0.1, 0.1,
+                                8, 3),
+        [sds((32, 16, 128))])
+
+    # Kernel H family — 3D shard-block temporal; shapes follow
+    # temporal.exchange_halos_{circular,fused}_3d.
+    blocks, K3, halos = (8, 8, 8), 2, (2, 2, 2)
+    hargs = (blocks, f32, 0.1, 0.1, 0.1, (16, 16, 16), K3, halos,
+             ("x", "y", "z"))
+    h = ps._build_temporal_block_3d(*hargs)
+    bx3, by3, bz3 = blocks
+    ext3 = (bx3 + 2 * K3, by3 + h.tail_y, bz3 + h.tail_z)
+    add("H", lambda ext, f=h: f(ext, 0, 0, 0), [sds(ext3)], offs3)
+    hf = ps._build_temporal_block_3d_fused(*hargs)
+    ze, ye = bz3 + hf.tail_z, by3 + hf.tail_y
+    h_ops = [sds(blocks), sds((bx3, by3, hf.tail_z)),
+             sds((bx3, hf.tail_y, ze)), sds((K3, ye, ze)),
+             sds((K3, ye, ze))]
+    add("H-fuse",
+        lambda u, zt, yt, xl, xh, f=hf: f(u, zt, yt, xl, xh, 0, 0, 0),
+        h_ops, offs3)
+    hb = ps._build_band_fix_3d(*hargs)
+    add("H-band",
+        lambda u, zt, yt, xl, xh, f=hb: f(u, zt, yt, xl, xh, 0, 0, 0),
+        h_ops, offs3)
+    return T
+
+
+@functools.lru_cache(maxsize=1)
+def _traced_default():
+    return _trace_targets(tuple(default_kernel_targets()))
+
+
+def _trace_targets(targets):
+    import jax
+
+    traced = []
+    for t in targets:
+        closed = jax.make_jaxpr(t.fn)(*t.args_sds)
+        for eqn in _find_pallas_calls(closed):
+            traced.append((t, eqn))
+    return traced
+
+
+def _traced(targets):
+    if targets is None:
+        return _traced_default()
+    return _trace_targets(tuple(targets))
+
+
+def _find_pallas_calls(closed):
+    from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+    stack = [closed]
+    seen = set()
+    while stack:
+        item = stack.pop()
+        j = getattr(item, "jaxpr", item)
+        for eqn in j.eqns:
+            if eqn.primitive.name == "pallas_call":
+                if id(eqn) not in seen:
+                    seen.add(id(eqn))
+                    yield eqn
+            for s in _sub_jaxprs(eqn.params):
+                stack.append(s)
+
+
+def _call_name(eqn) -> str:
+    nsi = eqn.params.get("name_and_src_info")
+    return getattr(nsi, "name", None) or str(nsi)
+
+
+def _space_of(aval) -> str:
+    """Lowercased memory-space tag of a block/scratch aval. An
+    unspecified space is Mosaic's default — a grid-blocked VMEM
+    buffer — so it must count as ``vmem`` (skipping it silently
+    exempted default-space blocks from the budget and coverage
+    audits)."""
+    sp = getattr(aval, "memory_space", None)
+    if sp is None:
+        return "vmem"
+    return str(sp).lower()
+
+
+# ---------------------------------------------------------------------------
+# Concrete per-instance evaluator
+# ---------------------------------------------------------------------------
+
+def _is_ndindexer(obj) -> bool:
+    return (hasattr(obj, "indices") and hasattr(obj, "shape")
+            and type(obj).__name__ == "NDIndexer")
+
+
+def _is_slice(obj) -> bool:
+    return (hasattr(obj, "start") and hasattr(obj, "size")
+            and type(obj).__name__ == "Slice")
+
+
+class _DmaEvent:
+    __slots__ = ("kind", "sem_key", "src", "src_win", "dst", "dst_win",
+                 "where")
+
+    def __init__(self, kind, sem_key, src, src_win, dst, dst_win,
+                 where):
+        self.kind = kind
+        self.sem_key = sem_key
+        self.src = src
+        self.src_win = src_win
+        self.dst = dst
+        self.dst_win = dst_win
+        self.where = where
+
+    def descriptor(self):
+        return (self.src.rid if self.src else None, self.src_win,
+                self.dst.rid if self.dst else None, self.dst_win)
+
+
+def _has_dma(j) -> bool:
+    from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+    jaxpr = getattr(j, "jaxpr", j)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("dma_start", "dma_wait"):
+            return True
+        for s in _sub_jaxprs(eqn.params):
+            if _has_dma(s):
+                return True
+    return False
+
+
+class _KernelEval:
+    """Concretely evaluate one kernel jaxpr's scalar/index slice for a
+    single grid instance, recording DMA events."""
+
+    def __init__(self, grid, instance, report, events):
+        self.grid = tuple(grid)
+        self.instance = tuple(instance)
+        self.report = report
+        self.events = events
+
+    # -- value resolution ------------------------------------------
+
+    def _val(self, env, atom):
+        import jax.core as jcore
+
+        if isinstance(atom, jcore.Literal):
+            return atom.val
+        return env.get(id(atom), UNKNOWN)
+
+    def _resolve_index(self, env, x):
+        """An indexer leaf to a concrete int, or UNKNOWN."""
+        import numpy as np
+
+        if isinstance(x, (int, np.integer)):
+            return int(x)
+        v = self._val(env, x) if hasattr(x, "aval") else UNKNOWN
+        if isinstance(v, _Unknown):
+            return UNKNOWN
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return UNKNOWN
+
+    def _resolve_indexer(self, env, nd):
+        """NDIndexer -> list of (start, size, stride) / int entries,
+        or UNKNOWN."""
+        out = []
+        for idx in nd.indices:
+            if _is_slice(idx):
+                start = self._resolve_index(env, idx.start)
+                if isinstance(start, _Unknown):
+                    return UNKNOWN
+                out.append((start, int(idx.size), int(idx.stride)))
+            else:
+                i = self._resolve_index(env, idx)
+                if isinstance(i, _Unknown):
+                    return UNKNOWN
+                out.append(i)
+        return out
+
+    # -- the interpreter -------------------------------------------
+
+    def run(self, j, args):
+        """Evaluate open-or-closed jaxpr ``j`` with ``args`` (values,
+        _Refs, or UNKNOWN); returns outvar values."""
+        import numpy as np
+        import jax.core as jcore
+
+        jaxpr = getattr(j, "jaxpr", j)
+        env = {}
+        consts = getattr(j, "consts", ())
+        for var, c in zip(getattr(jaxpr, "constvars", ()), consts):
+            env[id(var)] = c if np.ndim(c) == 0 else UNKNOWN
+        for var in getattr(jaxpr, "constvars", ())[len(consts):]:
+            env[id(var)] = UNKNOWN
+        if len(args) != len(jaxpr.invars):
+            return [UNKNOWN] * len(jaxpr.outvars)
+        for var, a in zip(jaxpr.invars, args):
+            env[id(var)] = a
+
+        for eqn in jaxpr.eqns:
+            outs = self._eval_eqn(env, eqn)
+            for var, v in zip(eqn.outvars, outs):
+                env[id(var)] = v
+        return [self._val(env, v) for v in jaxpr.outvars]
+
+    def _eval_eqn(self, env, eqn):
+        import numpy as np
+
+        name = eqn.primitive.name
+        n_out = len(eqn.outvars)
+        unk = [UNKNOWN] * n_out
+        vals = [self._val(env, v) for v in eqn.invars]
+
+        def scalars():
+            out = []
+            for v in vals:
+                if isinstance(v, (_Unknown, _Ref)):
+                    return None
+                if np.ndim(v) != 0:
+                    return None
+                out.append(v)
+            return out
+
+        if name == "program_id":
+            return [self.instance[eqn.params["axis"]]]
+        if name == "num_programs":
+            return [self.grid[eqn.params["axis"]]]
+        if name == "multiple_of":
+            return [vals[0]]
+        if name in ("dma_start", "dma_wait"):
+            self._dma(env, eqn, name)
+            return unk
+        if name == "get":
+            return [self._get(env, eqn, vals)]
+        if name == "cond":
+            return self._cond(env, eqn, vals)
+        if name in ("pjit", "closed_call", "core_call", "named_call",
+                    "custom_jvp_call", "custom_vjp_call", "remat",
+                    "remat2", "checkpoint"):
+            return self._call(env, eqn, vals)
+        if name in ("scan", "while"):
+            from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+            for s in _sub_jaxprs(eqn.params):
+                if _has_dma(s):
+                    self.report(
+                        "HL403",
+                        f"async copy inside a {name} loop — the DMA "
+                        f"schedule is not statically enumerable (the "
+                        f"kernel family keeps copies in straight-line "
+                        f"per-instance code; extend the audit before "
+                        f"shipping a looped schedule)",
+                        soundness=True)
+            return unk
+        sc = scalars()
+        if sc is None:
+            return unk
+        return self._scalar_prim(name, eqn, sc, unk)
+
+    def _scalar_prim(self, name, eqn, sc, unk):
+        import numpy as np
+
+        try:
+            if name == "add":
+                return [sc[0] + sc[1]]
+            if name == "sub":
+                return [sc[0] - sc[1]]
+            if name == "mul":
+                return [sc[0] * sc[1]]
+            if name == "div":
+                a, b = sc
+                if isinstance(a, (int, np.integer)) and isinstance(
+                        b, (int, np.integer)):
+                    q = abs(int(a)) // abs(int(b))
+                    return [q if (a >= 0) == (b >= 0) else -q]
+                return [a / b]
+            if name == "rem":
+                a, b = int(sc[0]), int(sc[1])
+                r = abs(a) % abs(b)
+                return [r if a >= 0 else -r]
+            if name == "max":
+                return [max(sc[0], sc[1])]
+            if name == "min":
+                return [min(sc[0], sc[1])]
+            if name == "clamp":
+                lo, x, hi = sc
+                return [min(max(x, lo), hi)]
+            if name == "neg":
+                return [-sc[0]]
+            if name == "sign":
+                return [(sc[0] > 0) - (sc[0] < 0)]
+            if name == "abs":
+                return [abs(sc[0])]
+            if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+                a, b = sc
+                return [{"eq": a == b, "ne": a != b, "lt": a < b,
+                         "le": a <= b, "gt": a > b, "ge": a >= b}[name]]
+            if name in ("and", "or", "xor"):
+                # lax's and/or/xor are BITWISE; boolean shortcutting
+                # over ints would e.g. turn 2 & 1 == 0 into True and
+                # "prove" a DMA window at the wrong offset.
+                a, b = sc
+                if isinstance(a, (bool, np.bool_)) and isinstance(
+                        b, (bool, np.bool_)):
+                    return [{"and": a and b, "or": a or b,
+                             "xor": bool(a) != bool(b)}[name]]
+                if isinstance(a, (int, np.integer)) and isinstance(
+                        b, (int, np.integer)):
+                    return [{"and": int(a) & int(b),
+                             "or": int(a) | int(b),
+                             "xor": int(a) ^ int(b)}[name]]
+                return unk
+            if name == "not":
+                x = sc[0]
+                if isinstance(x, (bool, np.bool_)):
+                    return [not x]
+                if isinstance(x, (int, np.integer)):
+                    return [~int(x)]  # lax.not_ on ints is bitwise
+                return unk
+            if name == "select_n":
+                idx = int(sc[0])
+                return [sc[1 + idx]]
+            if name == "convert_element_type":
+                dt = np.dtype(eqn.params["new_dtype"])
+                if dt.kind in "iu":
+                    return [int(sc[0])]
+                if dt.kind == "b":
+                    return [bool(sc[0])]
+                if dt.kind == "f":
+                    return [float(sc[0])]
+            if name in ("broadcast_in_dim", "reshape", "squeeze",
+                        "stop_gradient", "copy"):
+                # Value-preserving only while the result stays a
+                # single element — a real broadcast is an array the
+                # scalar evaluator must not impersonate.
+                shape = eqn.params.get("shape",
+                                       eqn.params.get("new_sizes", ()))
+                n = 1
+                for d in shape or ():
+                    n *= int(d)
+                if n == 1:
+                    return [sc[0]]
+                return unk
+        except (TypeError, ValueError, ZeroDivisionError,
+                OverflowError):
+            return unk
+        return unk
+
+    def _get(self, env, eqn, vals):
+        import numpy as np
+
+        ref = vals[0]
+        if not isinstance(ref, _Ref) or ref.value is None:
+            return UNKNOWN
+        tree = eqn.params.get("tree")
+        if tree is None:
+            return UNKNOWN
+        from jax import tree_util
+
+        # get invars = [ref] + dynamic indexer leaves; the tree covers
+        # only the transforms.
+        transforms = tree_util.tree_unflatten(tree, eqn.invars[1:])
+        # transforms: a tuple of NDIndexer chains; apply to the value.
+        try:
+            val = np.asarray(ref.value)
+            for nd in transforms:
+                if not _is_ndindexer(nd):
+                    return UNKNOWN
+                resolved = self._resolve_indexer(env, nd)
+                if isinstance(resolved, _Unknown):
+                    return UNKNOWN
+                sl = tuple(
+                    (slice(r[0], r[0] + r[1] * r[2], r[2])
+                     if isinstance(r, tuple) else r)
+                    for r in resolved)
+                val = val[sl]
+            if np.ndim(val) == 0:
+                return val.item() if hasattr(val, "item") else val
+            return UNKNOWN
+        except (IndexError, TypeError, ValueError):
+            return UNKNOWN
+
+    def _cond(self, env, eqn, vals):
+        pred = vals[0]
+        branches = eqn.params["branches"]
+        n_out = len(eqn.outvars)
+        if isinstance(pred, _Unknown):
+            from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+            for s in _sub_jaxprs(eqn.params):
+                if _has_dma(s):
+                    self.report(
+                        "HL401",
+                        "async copy under a branch whose predicate "
+                        "the static evaluator cannot resolve — the "
+                        "DMA schedule is unprovable (branch "
+                        "predicates must be functions of program_id/"
+                        "num_programs/constants)",
+                        soundness=True)
+                    break
+            return [UNKNOWN] * n_out
+        idx = int(pred)
+        idx = max(0, min(len(branches) - 1, idx))
+        return self.run(branches[idx], vals[1:])
+
+    def _call(self, env, eqn, vals):
+        from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+        subs = list(_sub_jaxprs(eqn.params))
+        n_out = len(eqn.outvars)
+        if len(subs) != 1:
+            for s in subs:
+                if _has_dma(s):
+                    self.report(
+                        "HL401",
+                        f"async copy under unsupported call primitive "
+                        f"{eqn.primitive.name!r} — schedule unprovable",
+                        soundness=True)
+            return [UNKNOWN] * n_out
+        body = subs[0]
+        jaxpr = getattr(body, "jaxpr", body)
+        if len(jaxpr.invars) == len(vals):
+            return self.run(body, vals)
+        if _has_dma(body):
+            self.report(
+                "HL401",
+                f"async copy under {eqn.primitive.name!r} with "
+                f"mismatched arity — schedule unprovable",
+                soundness=True)
+        return [UNKNOWN] * n_out
+
+    def _dma(self, env, eqn, kind):
+        from jax import tree_util
+
+        tree = eqn.params["tree"]
+        st = tree_util.tree_unflatten(tree, eqn.invars)
+        # Layout (pallas mosaic primitives): (src_ref, src_transforms,
+        # dst_ref, dst_transforms, sem_ref, sem_transforms, ...remote).
+        if len(st) < 6:
+            self.report("HL401", f"{kind}: unrecognized copy "
+                                 f"descriptor layout — unprovable",
+                        soundness=True)
+            return
+        src = self._val(env, st[0])
+        dst = self._val(env, st[2])
+        sem = self._val(env, st[4])
+        if not (isinstance(src, _Ref) and isinstance(dst, _Ref)
+                and isinstance(sem, _Ref)):
+            self.report("HL401", f"{kind}: copy endpoints are not "
+                                 f"statically-known refs — unprovable",
+                        soundness=True)
+            return
+        src_win = self._window(env, st[1], src, "source")
+        dst_win = self._window(env, st[3], dst, "destination")
+        sem_idx = self._window(env, st[5], sem, "semaphore")
+        if sem_idx is None:
+            return
+        sem_key = (sem.rid, tuple(sem_idx))
+        self.events.append(_DmaEvent(
+            "start" if kind == "dma_start" else "wait",
+            sem_key, src, src_win, dst, dst_win,
+            f"instance {self.instance}"))
+
+    def _window(self, env, transforms, ref, what):
+        """Resolve one endpoint's indexer chain; bounds-check against
+        the ref shape (rule HL401). Returns the resolved entries or
+        None when unprovable (already reported)."""
+        if not isinstance(transforms, (tuple, list)):
+            transforms = (transforms,)
+        transforms = [t for t in transforms if t is not None]
+        if len(transforms) == 0:
+            return tuple((0, d, 1) for d in ref.shape)
+        if len(transforms) != 1 or not _is_ndindexer(transforms[0]):
+            self.report("HL401",
+                        f"chained/unrecognized indexer on a copy "
+                        f"{what} — window unprovable",
+                        soundness=True)
+            return None
+        nd = transforms[0]
+        resolved = self._resolve_indexer(env, nd)
+        if isinstance(resolved, _Unknown):
+            self.report(
+                "HL401",
+                f"copy {what} window start is not statically "
+                f"derivable from program_id/constants/prefetch — "
+                f"in-bounds is unprovable (ref shape {ref.shape})",
+                soundness=True)
+            return None
+        shape = tuple(nd.shape)
+        for d, (entry, dim) in enumerate(zip(resolved, shape)):
+            if isinstance(entry, tuple):
+                start, size, stride = entry
+                last = start + (size - 1) * stride
+                if start < 0 or last >= dim or size < 1:
+                    self.report(
+                        "HL401",
+                        f"copy {what} window out of bounds: axis {d} "
+                        f"reads [{start}, {last + 1}) of a {dim}-"
+                        f"extent ref (shape {shape}) at "
+                        f"{self.instance} — on hardware this DMA "
+                        f"corrupts adjacent buffers silently")
+                    return None
+            else:
+                if entry < 0 or entry >= dim:
+                    self.report(
+                        "HL401",
+                        f"copy {what} index {entry} out of bounds on "
+                        f"axis {d} of shape {shape}")
+                    return None
+        return tuple(resolved)
+
+
+# ---------------------------------------------------------------------------
+# Per-call audits
+# ---------------------------------------------------------------------------
+
+def _kernel_refs(eqn):
+    """(refs, prefetch_slots) — _Ref handles for every kernel jaxpr
+    invar, in operand order."""
+    gm = eqn.params["grid_mapping"]
+    jaxpr = eqn.params["jaxpr"]
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    refs = []
+    for i, var in enumerate(jaxpr.invars):
+        refs.append(_Ref(i, var.aval))
+    return refs, gm.num_index_operands
+
+
+def _grid_instances(grid, report) -> Optional[list]:
+    grid = tuple(int(g) for g in grid)
+    if not grid:
+        return [()]
+    total = 1
+    for g in grid:
+        total *= g
+    if total > _MAX_INSTANCES:
+        report("HL401",
+               f"grid {grid} has {total} instances, past the audit's "
+               f"{_MAX_INSTANCES}-instance exhaustion bound — pick a "
+               f"smaller representative geometry for this target",
+               soundness=True)
+        return None
+    return list(itertools.product(*(range(g) for g in grid)))
+
+
+def _audit_schedule(target, eqn, report):
+    """HL401 (in-bounds) + HL403 (semaphore discipline) for one
+    pallas_call: evaluate every grid instance, then simulate."""
+    import numpy as np
+
+    gm = eqn.params["grid_mapping"]
+    jaxpr = eqn.params["jaxpr"]
+    refs, n_prefetch = _kernel_refs(eqn)
+    if not _has_dma(jaxpr):
+        return
+    # Attach audit-chosen prefetch values.
+    if n_prefetch:
+        pf = target.prefetch
+        if pf is not None:
+            pf = np.atleast_1d(np.asarray(pf))
+            for r in refs[:n_prefetch]:
+                if pf.shape == r.shape:
+                    r.value = pf
+    instances = _grid_instances(gm.grid, report)
+    if instances is None:
+        return
+    events = []
+    for inst in instances:
+        ev = _KernelEval(gm.grid, inst, report, events)
+        ev.run(jaxpr, refs)
+    # HL403: counting-semaphore simulation over the sequential grid.
+    outstanding = {}
+    for e in events:
+        if e.kind == "start":
+            for key, lst in outstanding.items():
+                for o in lst:
+                    if (e.dst is not None and o.dst is not None
+                            and e.dst.rid == o.dst.rid
+                            and _windows_overlap(e.dst_win, o.dst_win)):
+                        report(
+                            "HL403",
+                            f"async copy started into destination "
+                            f"window {e.dst_win} ({e.where}) while an "
+                            f"un-waited copy into overlapping window "
+                            f"{o.dst_win} ({o.where}) is still in "
+                            f"flight on the same ref — double-buffer "
+                            f"slot reused before its wait; the DMA "
+                            f"engine may interleave both writes")
+            outstanding.setdefault(e.sem_key, []).append(e)
+        else:
+            lst = outstanding.get(e.sem_key, [])
+            if not lst:
+                report(
+                    "HL403",
+                    f"async-copy wait at {e.where} on semaphore "
+                    f"{e.sem_key[1]} with NO outstanding copy — the "
+                    f"kernel would block forever on hardware (wait "
+                    f"without a matching start)")
+                continue
+            match = next((i for i, o in enumerate(lst)
+                          if o.descriptor() == e.descriptor()), 0)
+            lst.pop(match)
+    leaked = [(k, o) for k, lst in outstanding.items() for o in lst]
+    for key, o in leaked:
+        report(
+            "HL403",
+            f"async copy started at {o.where} (semaphore {key[1]}, "
+            f"destination window {o.dst_win}) is never waited — the "
+            f"copy outlives the kernel and its semaphore increment "
+            f"leaks into the next kernel's waits")
+
+
+def _windows_overlap(a, b) -> bool:
+    if a is None or b is None:
+        return True  # unprovable windows: assume the worst
+    if len(a) != len(b):
+        return True
+    for ea, eb in zip(a, b):
+        sa, la = ((ea[0], ea[0] + (ea[1] - 1) * ea[2] + 1)
+                  if isinstance(ea, tuple) else (ea, ea + 1))
+        sb, lb = ((eb[0], eb[0] + (eb[1] - 1) * eb[2] + 1)
+                  if isinstance(eb, tuple) else (eb, eb + 1))
+        if la <= sb or lb <= sa:
+            return False
+    return True
+
+
+def _audit_vmem(target, eqn, report, limit_bytes):
+    """HL402: static VMEM footprint vs the generation's limit."""
+    import numpy as np
+
+    gm = eqn.params["grid_mapping"]
+    jaxpr = eqn.params["jaxpr"]
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    parts = []
+    for bm in gm.block_mappings:
+        aval = bm.transformed_block_aval
+        if "vmem" not in _space_of(aval):
+            continue
+        bytes_ = int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+        # The Mosaic pipeline double-buffers every grid-mapped block.
+        total += 2 * bytes_
+        parts.append(f"2x{tuple(aval.shape)} block")
+    n_scratch = gm.num_scratch_operands
+    if n_scratch:
+        for var in jaxpr.invars[len(jaxpr.invars) - n_scratch:]:
+            aval = var.aval
+            if "vmem" not in _space_of(aval):
+                continue
+            bytes_ = int(np.prod(aval.shape)) * \
+                np.dtype(aval.dtype).itemsize
+            total += bytes_
+            parts.append(f"{tuple(aval.shape)} scratch")
+    if total > limit_bytes:
+        report(
+            "HL402",
+            f"static VMEM footprint {total} bytes "
+            f"({' + '.join(parts)}) exceeds "
+            f"TpuParams.vmem_limit_bytes={limit_bytes} — a geometry "
+            f"the picker admits would be rejected by Mosaic with a "
+            f"scoped-vmem OOM at compile time; shrink the block/"
+            f"scratch model or fix the picker budget")
+
+
+def _audit_grid_coverage(target, eqn, report):
+    """HL404: divisibility, index-map range, output coverage."""
+    gm = eqn.params["grid_mapping"]
+    instances = _grid_instances(gm.grid, report)
+    if instances is None:
+        return
+    n_in = gm.num_inputs
+    n_out = gm.num_outputs
+    refs, n_prefetch = _kernel_refs(eqn)
+    prefetch_refs = refs[:n_prefetch]
+    import numpy as np
+
+    if n_prefetch and target.prefetch is not None:
+        pf = np.atleast_1d(np.asarray(target.prefetch))
+        for r in prefetch_refs:
+            if pf.shape == r.shape:
+                r.value = pf
+    for k, bm in enumerate(gm.block_mappings):
+        aval = bm.transformed_block_aval
+        space = _space_of(aval)
+        if "vmem" not in space and "smem" not in space:
+            continue  # ANY-space refs are not grid-blocked
+        block = tuple(1 if b is None else int(b)
+                      for b in bm.block_shape)
+        array = tuple(int(d) for d in bm.array_shape_dtype.shape)
+        role = "output" if k >= n_in else "input"
+        bad_div = [d for d, (b, a) in enumerate(zip(block, array))
+                   if b and a % b != 0]
+        if bad_div:
+            report(
+                "HL404",
+                f"{role} block {block} does not divide ref shape "
+                f"{array} on axis {bad_div[0]} — the kernel family's "
+                f"exact-tiling contract (the BlockSpec analogue of "
+                f"config.divisible_factorizations) requires whole "
+                f"blocks; a ragged edge block reads/writes padding "
+                f"Mosaic invents")
+            continue
+        nblocks = tuple(a // b if b else 1
+                        for a, b in zip(array, block))
+        seen_idx = set()
+        unprovable = False
+        for inst in instances:
+            ev = _KernelEval(gm.grid, inst, report, [])
+            idx = ev.run(bm.index_map_jaxpr,
+                         list(inst) + list(prefetch_refs))
+            if any(isinstance(i, _Unknown) for i in idx):
+                report(
+                    "HL404",
+                    f"{role} block index map is not statically "
+                    f"derivable from program_id/constants/prefetch at "
+                    f"grid instance {inst} — range and coverage are "
+                    f"unprovable (ref shape {array}, block {block})")
+                unprovable = True
+                break
+            idx = tuple(int(i) for i in idx)
+            for d, (i, nb) in enumerate(zip(idx, nblocks)):
+                if not (0 <= i < nb):
+                    report(
+                        "HL404",
+                        f"{role} index map returns block {idx} at "
+                        f"grid instance {inst}, outside the "
+                        f"{nblocks} blocks of ref shape {array} "
+                        f"(block {block}) — the window would read/"
+                        f"write past the ref")
+                    unprovable = True
+                    break
+            if unprovable:
+                break
+            seen_idx.add(idx)
+        if unprovable:
+            continue
+        if role == "output":
+            missing = [i for i in itertools.product(
+                *(range(nb) for nb in nblocks)) if i not in seen_idx]
+            if missing:
+                report(
+                    "HL404",
+                    f"output blocks {missing[:4]}"
+                    f"{'...' if len(missing) > 4 else ''} of "
+                    f"{nblocks} are never visited by the index map "
+                    f"over grid {tuple(gm.grid)} — those output "
+                    f"regions leave the kernel as uninitialized "
+                    f"VMEM")
+
+
+# ---------------------------------------------------------------------------
+# Site coverage
+# ---------------------------------------------------------------------------
+
+def _source_kernel_names() -> dict:
+    """{literal heat_* name: lineno} for every pallas_call site in
+    ops/pallas_stencil.py (parsed with ast — the same literals HL203
+    enforces)."""
+    import ast
+    import os
+
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    path = ps.__file__
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = getattr(node.func, "attr",
+                        getattr(node.func, "id", None))
+        if fname != "pallas_call":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                out[kw.value.value] = node.lineno
+    return out
+
+
+# ---------------------------------------------------------------------------
+# audit driver
+# ---------------------------------------------------------------------------
+
+def audit_kernels(targets=None, limit_bytes=None,
+                  check_coverage=None) -> List[Finding]:
+    """Run HL401-HL404 over ``targets`` (default: every builder at a
+    representative geometry, with source-site coverage enforced)."""
+    from parallel_heat_tpu.ops.tpu_params import params
+
+    if limit_bytes is None:
+        limit_bytes = params().vmem_limit_bytes
+    if check_coverage is None:
+        check_coverage = targets is None
+    traced = _traced(targets)
+    out = []
+    seen = set()
+    covered = set()
+
+    for target, eqn in traced:
+        name = _call_name(eqn)
+        covered.add(name)
+        label = f"{target.label}/{name}"
+
+        def report(rule, message, _label=label, soundness=False):
+            key = (rule, _label, message)
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(rule, "error", _LOC, 0, _label,
+                                   message, soundness=soundness))
+
+        _audit_schedule(target, eqn, report)
+        _audit_vmem(target, eqn, report, limit_bytes)
+        _audit_grid_coverage(target, eqn, report)
+
+    if check_coverage:
+        source = _source_kernel_names()
+        for name, lineno in sorted(source.items()):
+            if name not in covered:
+                out.append(Finding(
+                    "HL401", "error", _LOC, lineno, name,
+                    f"pallas_call site {name!r} is not covered by any "
+                    f"kernel-audit target — every kernel site needs a "
+                    f"representative geometry in "
+                    f"analysis.kernels.default_kernel_targets so its "
+                    f"DMA windows/VMEM budget stay proven",
+                    soundness=True))
+    return out
+
+
+def _rule_runner(rule_id):
+    def run():
+        return run_kernels({rule_id})
+
+    return run
+
+
+KERNEL_RULES = {
+    "HL401": ("error", "DMA window out of bounds or unprovable",
+              _rule_runner("HL401")),
+    "HL402": ("error", "kernel VMEM footprint exceeds the device limit",
+              _rule_runner("HL402")),
+    "HL403": ("error", "async-copy semaphore discipline violated",
+              _rule_runner("HL403")),
+    "HL404": ("error", "grid/BlockSpec tiling incomplete or ragged",
+              _rule_runner("HL404")),
+}
+
+
+def run_kernels(rules=None) -> List[Finding]:
+    """Run the kernel-safety audits against the installed package
+    (one shared trace pass serves all four rules)."""
+    wanted = set(KERNEL_RULES) if rules is None else set(rules)
+    # Soundness sentinels survive any rule filter: they mean an audit
+    # was silently skipped, so a --rules subset must not report clean.
+    return [f for f in audit_kernels()
+            if f.rule in wanted or f.soundness]

@@ -1,0 +1,683 @@
+"""Layer 3: SPMD/collective protocol verifiers (``HL3xx``).
+
+The halo-exchange protocol is the layer of this solver where the
+paper's correctness actually lives: four (2D) / six (3D) ``ppermute``
+shifts per exchange round, a ``pmax`` convergence vote, and host
+control flow steered by reduced scalars. All of it runs under
+``shard_map``, and on pre-vma jax the compat shim
+(``utils/compat.py``) runs with ``check_rep=False`` — nothing checks
+replication dynamically. These rules supply the missing *static*
+proofs by tracing the real sharded programs (``solver._build_runner``)
+on a simulated multi-device mesh — abstract evaluation only, nothing
+executes — and walking the jaxprs:
+
+- **HL301 halo-permutation-protocol** — every ``ppermute`` permutation
+  table is a complete one-hop shift consistent with the ``mesh.py``
+  topology: pairs are ``(i, i±1)`` along exactly one named axis, no
+  source or destination appears twice (a partial bijection — the
+  static analogue of matched MPI send/recv), and the table covers
+  every device that HAS the neighbor (an incomplete table silently
+  drops halo data). Within each exchange phase, shift directions come
+  in ``+1``/``-1`` pairs — the deadlock-freedom symmetry of the
+  reference's paired ``MPI_Isend``/``MPI_Irecv``
+  (``mpi/...stat.c:130-155``).
+- **HL302 collective-divergence** — collective sequences are identical
+  on both sides of every ``lax.cond`` and stable across loop exits,
+  *unless* the branch predicate is provably replicated (then every
+  device takes the same side and divergence is impossible — the
+  converge tail ``lax.cond`` is legal exactly because its predicate
+  comes out of a ``pmax``). A ``lax.while_loop`` whose body performs
+  collectives must likewise have a replicated predicate, or some
+  devices exit the loop while their neighbors still wait in a
+  collective: an SPMD hang at scale. Across the fixed / converge /
+  f32chunk program variants of one geometry, the set of exchange
+  tables must be identical — a variant that exchanges differently
+  would deadlock against the others' compiled expectations.
+- **HL303 replication-proof** — a forward varying-axes dataflow over
+  each ``shard_map`` body (the vma system re-implemented as a static
+  analysis, since the compat shim disables the dynamic checker on
+  pre-0.5 jax): every output the ``out_specs`` declare replicated
+  (``P()`` — the convergence residual, step counts, guard verdicts
+  that feed host control flow) must be *provably* invariant across
+  the mesh, i.e. its varying set — seeded by input shardings and
+  ``axis_index``, grown by ``ppermute``, erased only by all-axes
+  reductions (``pmax``/``psum``/``pmin``) — is empty. An unreplicated
+  scalar fed to host control flow desynchronizes the SPMD programs.
+
+All audits accept injected targets so the test fixtures can seed
+violations without touching the real solver.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+from parallel_heat_tpu.analysis.findings import Finding
+
+# The 2D mesh shapes the audit proves the exchange protocol over —
+# a superset of tests/test_sharded.py's MESHES (pinned by
+# tests/test_analysis.py::test_audit_meshes_cover_test_sharded), so
+# the static proof covers every topology the dynamic parity suite
+# exercises.
+AUDIT_MESHES_2D = ((1, 1), (2, 1), (1, 2), (2, 2), (2, 4), (4, 2),
+                   (8, 1), (1, 8))
+AUDIT_MESHES_3D = ((2, 2, 2), (2, 1, 2), (1, 2, 4))
+
+_LOC = "parallel_heat_tpu/parallel/halo.py"
+
+# Collectives that erase variance over their named axes.
+_REDUCING = {"pmax", "pmin", "psum", "all_gather"}
+# Call-like primitives whose single sub-jaxpr consumes the eqn invars
+# 1:1 (after the closed jaxpr's consts).
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "remat", "remat2",
+               "checkpoint", "custom_jvp_call", "custom_vjp_call",
+               "custom_vjp_call_jaxpr", "named_call"}
+
+
+def _axes_tuple(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+def _inner(j):
+    """The open Jaxpr of a possibly-closed jaxpr."""
+    return getattr(j, "jaxpr", j)
+
+
+def _consts_of(j):
+    return getattr(j, "consts", ())
+
+
+def _sub_jaxprs_of(eqn):
+    from parallel_heat_tpu.analysis.contracts import _sub_jaxprs
+
+    return list(_sub_jaxprs(eqn.params))
+
+
+# ---------------------------------------------------------------------------
+# Target matrix
+# ---------------------------------------------------------------------------
+
+class SpmdTarget:
+    """One traceable program: ``fn(sds)`` is traced with
+    ``jax.make_jaxpr``. ``family`` groups the fixed/converge/f32chunk
+    variants whose exchange tables HL302 requires identical."""
+
+    def __init__(self, label, family, variant, fn, sds):
+        self.label = label
+        self.family = family
+        self.variant = variant
+        self.fn = fn
+        self.sds = sds
+
+
+def _runner_target(cfg, family, variant):
+    import jax
+
+    from parallel_heat_tpu.solver import _build_runner, _observer_free
+
+    runner, _mesh = _build_runner(_observer_free(cfg))
+    sds = jax.ShapeDtypeStruct(cfg.shape, cfg.dtype)
+    return SpmdTarget(f"{family}/{variant}", family, variant, runner, sds)
+
+
+def default_spmd_targets():
+    """``(targets, skip_findings)`` — the real solver programs over the
+    audit mesh matrix, filtered to the devices this process has (the
+    heatlint CLI requests 8 virtual CPU devices up front; an embedder
+    with fewer gets a loud warning per skipped mesh, never a silently
+    shrunken proof)."""
+    import jax
+
+    from parallel_heat_tpu.config import HeatConfig
+
+    n_dev = len(jax.devices())
+    targets, skips = [], []
+
+    def mesh_ok(mesh):
+        n = 1
+        for d in mesh:
+            n *= d
+        return n <= n_dev
+
+    def skip(mesh, what):
+        skips.append(Finding(
+            "HL301", "warning", _LOC, 0, "default_spmd_targets",
+            f"mesh {mesh} ({what}) skipped: needs more devices than "
+            f"the {n_dev} this process has — the exchange protocol "
+            f"for that topology is UNPROVEN here (run via "
+            f"tools/heatlint.py, which requests 8 virtual CPU "
+            f"devices)", soundness=True))
+
+    for mesh in AUDIT_MESHES_2D:
+        if not mesh_ok(mesh):
+            skip(mesh, "2D")
+            continue
+        fam = f"jnp-2d-{mesh[0]}x{mesh[1]}"
+        base = dict(nx=16, ny=16, backend="jnp", mesh_shape=mesh)
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **base), fam, "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=40, converge=True, check_interval=20,
+                       **base), fam, "converge"))
+    for mesh in AUDIT_MESHES_3D:
+        if not mesh_ok(mesh):
+            skip(mesh, "3D")
+            continue
+        fam = f"jnp-3d-{'x'.join(map(str, mesh))}"
+        base = dict(nx=8, ny=8, nz=8, backend="jnp", mesh_shape=mesh)
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **base), fam, "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=24, converge=True, check_interval=8,
+                       **base), fam, "converge"))
+    # K-deep temporal exchange rounds (parallel/temporal.py), jnp and
+    # Mosaic (kernel G + deferred band; interpret mode traces the same
+    # program structure hardware runs).
+    if mesh_ok((2, 2)):
+        base = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 2),
+                    halo_depth=4)
+        targets.append(_runner_target(
+            HeatConfig(steps=8, **base), "jnp-2d-temporal", "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=40, converge=True, check_interval=8,
+                       **base), "jnp-2d-temporal", "converge"))
+        basep = dict(nx=32, ny=32, backend="pallas", mesh_shape=(2, 2),
+                     halo_depth=8)
+        targets.append(_runner_target(
+            HeatConfig(steps=16, **basep), "pallas-2d-temporal",
+            "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=32, converge=True, check_interval=8,
+                       **basep), "pallas-2d-temporal", "converge"))
+        # Per-step pallas block path (kernel B/C sharded or the jnp
+        # fallback — whatever pick_block_2d routes; the exchange
+        # protocol must be identical either way).
+        basebs = dict(nx=32, ny=32, backend="pallas", mesh_shape=(2, 2),
+                      halo_depth=1)
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **basebs), "pallas-2d-perstep",
+            "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=40, converge=True, check_interval=20,
+                       **basebs), "pallas-2d-perstep", "converge"))
+    # f32chunk variants are single-device by contract
+    # (config.validate()); their collective signature must be EMPTY —
+    # a collective appearing here would be an SPMD call outside any
+    # mesh.
+    basef = dict(nx=32, ny=32, dtype="bfloat16", accumulate="f32chunk",
+                 backend="jnp")
+    targets.append(_runner_target(
+        HeatConfig(steps=32, **basef), "f32chunk-2d", "fixed"))
+    targets.append(_runner_target(
+        HeatConfig(steps=64, converge=True, check_interval=16, **basef),
+        "f32chunk-2d", "converge"))
+    return targets, skips
+
+
+@functools.lru_cache(maxsize=1)
+def _traced_default():
+    """Trace the default target matrix once per process; the three
+    rules share it (tracing is the expensive part)."""
+    import jax
+
+    targets, skips = default_spmd_targets()
+    traced = []
+    for t in targets:
+        traced.append((t, jax.make_jaxpr(t.fn)(t.sds)))
+    return traced, skips
+
+
+def _traced(targets):
+    if targets is None:
+        return _traced_default()
+    import jax
+
+    return [(t, jax.make_jaxpr(t.fn)(t.sds)) for t in targets], []
+
+
+# ---------------------------------------------------------------------------
+# shard_map discovery
+# ---------------------------------------------------------------------------
+
+def _find_shard_maps(closed):
+    """Yield every ``shard_map`` eqn reachable from ``closed``."""
+    stack = [closed]
+    seen = set()
+    while stack:
+        j = _inner(stack.pop())
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            if eqn.primitive.name == "shard_map":
+                yield eqn
+            stack.extend(_sub_jaxprs_of(eqn))
+
+
+def _mesh_info(eqn):
+    """(axis_names tuple, {axis: size}) from a shard_map eqn."""
+    mesh = eqn.params["mesh"]
+    names = tuple(mesh.axis_names)
+    sizes = dict(mesh.shape)
+    return names, sizes
+
+
+def _names_axes(names_entry) -> frozenset:
+    """Axes mentioned by one in_names/out_names dict entry."""
+    out = set()
+    for axes in names_entry.values():
+        out.update(_axes_tuple(axes))
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# HL301 halo permutation protocol
+# ---------------------------------------------------------------------------
+
+def _check_ppermute(eqn, sizes, report, where):
+    axes = _axes_tuple(eqn.params["axis_name"])
+    perm = tuple(tuple(p) for p in eqn.params["perm"])
+    if len(axes) != 1:
+        report(f"{where}: ppermute over multiple axes {axes} — the "
+               f"halo protocol uses single-axis shifts; a multi-axis "
+               f"table cannot be checked against the mesh topology")
+        return None
+    axis = axes[0]
+    n = sizes.get(axis)
+    if n is None:
+        report(f"{where}: ppermute over unknown mesh axis {axis!r}")
+        return None
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    bad = [i for i in srcs + dsts if not (0 <= i < n)]
+    if bad:
+        report(f"{where}: ppermute index {bad[0]} out of range for "
+               f"axis {axis!r} of size {n}")
+        return None
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        report(f"{where}: ppermute table {perm} is not a partial "
+               f"bijection on axis {axis!r} — a repeated source or "
+               f"destination means one device sends twice or receives "
+               f"twice in one collective (the MPI analogue: mismatched "
+               f"send/recv counts = deadlock)")
+        return None
+    down = {(i, i + 1) for i in range(n - 1)}
+    up = {(i + 1, i) for i in range(n - 1)}
+    got = set(perm)
+    if not got:
+        # A size-1 axis has no neighbor edges; an empty table is a
+        # correct no-op exchange, not a shift in either direction
+        # (matching both reference sets would skew the pairing count).
+        return None
+    if got == down:
+        return (axis, +1)
+    if got == up:
+        return (axis, -1)
+    if any(abs(s - d) != 1 for s, d in got):
+        hop = next((s, d) for s, d in got if abs(s - d) != 1)
+        report(f"{where}: ppermute pair {hop} on axis {axis!r} is not "
+               f"a one-hop neighbor shift — the mesh.py topology only "
+               f"defines ±1 neighbors (MPI_Cart_shift), so this edge "
+               f"has no ICI route the exchange protocol covers")
+        return None
+    report(f"{where}: ppermute table {sorted(got)} on axis {axis!r} "
+           f"(size {n}) is an INCOMPLETE shift — a complete "
+           f"non-periodic ±1 shift has {n - 1} pairs covering every "
+           f"neighbor edge; devices missing from the table silently "
+           f"exchange zeros where real halo data is required")
+    return None
+
+
+def _audit_ppermutes_under(body, sizes, report):
+    """Walk ``body``; check every ppermute and the per-jaxpr direction
+    pairing. Returns the set of (axis, frozenset(perm)) tables seen."""
+    tables = set()
+    stack = [body]
+    seen = set()
+    while stack:
+        j = _inner(stack.pop())
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        directions = []
+        for eqn in j.eqns:
+            if eqn.primitive.name == "ppermute":
+                axes = _axes_tuple(eqn.params["axis_name"])
+                perm = frozenset(tuple(p) for p in eqn.params["perm"])
+                tables.add((axes, perm))
+                d = _check_ppermute(eqn, sizes, report,
+                                    f"ppermute(axis={axes})")
+                if d is not None:
+                    directions.append(d)
+            stack.extend(_sub_jaxprs_of(eqn))
+        # Direction symmetry within one jaxpr (one exchange phase
+        # lives in one jaxpr): +1 and -1 shift counts must match per
+        # axis — the paired-send/recv deadlock-freedom argument.
+        for axis in {a for a, _ in directions}:
+            n_down = sum(1 for a, d in directions
+                         if a == axis and d == +1)
+            n_up = sum(1 for a, d in directions
+                       if a == axis and d == -1)
+            if n_down != n_up:
+                report(
+                    f"unpaired shift direction on axis {axis!r}: "
+                    f"{n_down} down-shift vs {n_up} up-shift ppermute "
+                    f"tables in one exchange phase — every neighbor "
+                    f"send needs the symmetric receive "
+                    f"(mpi/...stat.c:130-155 pairs all four "
+                    f"directions)")
+    return tables
+
+
+# ---------------------------------------------------------------------------
+# Varying-axes dataflow (HL302 / HL303)
+# ---------------------------------------------------------------------------
+
+def _collective_signature(j):
+    """Deep, ordered collective signature of a jaxpr."""
+    sig = []
+    for eqn in _inner(j).eqns:
+        name = eqn.primitive.name
+        if name == "ppermute":
+            sig.append(("ppermute",
+                        _axes_tuple(eqn.params["axis_name"]),
+                        tuple(sorted(tuple(p)
+                                     for p in eqn.params["perm"]))))
+        elif name in _REDUCING:
+            axes = eqn.params.get("axes",
+                                  eqn.params.get("axis_name", ()))
+            sig.append((name, _axes_tuple(axes)))
+        else:
+            for s in _sub_jaxprs_of(eqn):
+                sig.extend(_collective_signature(s))
+    return tuple(sig)
+
+
+class _Dataflow:
+    """Forward varying-axes analysis over one shard_map body."""
+
+    def __init__(self, mesh_axes, report302):
+        self.mesh_axes = frozenset(mesh_axes)
+        self.report302 = report302
+
+    def run(self, j, in_varying):
+        """Analyze open-or-closed jaxpr ``j`` whose invars carry
+        ``in_varying``; returns the outvars' varying sets."""
+        import jax.core as jcore
+
+        jaxpr = _inner(j)
+        env = {}
+
+        def V(atom):
+            if isinstance(atom, jcore.Literal):
+                return frozenset()
+            return env.get(id(atom), frozenset())
+
+        def setv(var, v):
+            env[id(var)] = frozenset(v)
+
+        for var in getattr(jaxpr, "constvars", ()):
+            setv(var, frozenset())
+        for var, v in zip(jaxpr.invars, in_varying):
+            setv(var, v)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            invs = [V(v) for v in eqn.invars]
+            union = frozenset().union(*invs) if invs else frozenset()
+            if name == "axis_index":
+                outs = [frozenset(
+                    _axes_tuple(eqn.params["axis_name"]))]
+            elif name == "ppermute":
+                outs = [union | frozenset(
+                    _axes_tuple(eqn.params["axis_name"]))]
+            elif name in _REDUCING:
+                axes = eqn.params.get("axes",
+                                      eqn.params.get("axis_name", ()))
+                named = frozenset(a for a in _axes_tuple(axes)
+                                  if isinstance(a, str))
+                if eqn.params.get("axis_index_groups") is not None:
+                    outs = [union]  # grouped reduce: stay conservative
+                else:
+                    outs = [union - named]
+            elif name == "cond":
+                outs = self._cond(eqn, invs)
+            elif name == "while":
+                outs = self._while(eqn, invs)
+            elif name == "scan":
+                outs = self._scan(eqn, invs)
+            elif name in _CALL_PRIMS:
+                outs = self._call(eqn, invs, union)
+            else:
+                # First-order primitives and unknown higher-order ones
+                # (pallas_call, custom lowerings) alike: conservative —
+                # outputs vary wherever any input does.
+                outs = [union] * len(eqn.outvars)
+            for var, v in zip(eqn.outvars, outs):
+                setv(var, v)
+        return [V(v) for v in jaxpr.outvars]
+
+    # -- higher-order primitives ------------------------------------
+
+    def _call(self, eqn, invs, union):
+        subs = _sub_jaxprs_of(eqn)
+        if len(subs) == 1:
+            body = subs[0]
+            jaxpr = _inner(body)
+            nconsts = len(jaxpr.invars) - len(eqn.invars)
+            if nconsts == 0:
+                return self.run(body, invs)
+            if nconsts > 0 and len(_consts_of(body)) == nconsts:
+                consts = [frozenset()] * nconsts
+                return self.run(body, consts + invs)
+        return [union] * len(eqn.outvars)
+
+    def _cond(self, eqn, invs):
+        pred_v = invs[0]
+        ops = invs[1:]
+        branches = eqn.params["branches"]
+        sigs = [_collective_signature(b) for b in branches]
+        if len(set(sigs)) > 1 and pred_v:
+            self.report302(
+                f"lax.cond branches perform DIFFERENT collective "
+                f"sequences ({[len(s) for s in sigs]} collectives per "
+                f"branch) and the predicate varies across mesh axes "
+                f"{sorted(pred_v)} — devices would take different "
+                f"branches and the collectives inside would wait on "
+                f"peers that never arrive (SPMD hang); reduce the "
+                f"predicate (pmax/psum over all axes) before "
+                f"branching, or make the branches' collectives "
+                f"identical")
+        outs = None
+        for b in branches:
+            ov = self.run(b, ops)
+            outs = (ov if outs is None
+                    else [a | c for a, c in zip(outs, ov)])
+        return [o | pred_v for o in outs]
+
+    def _while(self, eqn, invs):
+        cond_j = eqn.params["cond_jaxpr"]
+        body_j = eqn.params["body_jaxpr"]
+        ncc = eqn.params["cond_nconsts"]
+        nbc = eqn.params["body_nconsts"]
+        cond_c = invs[:ncc]
+        body_c = invs[ncc:ncc + nbc]
+        carry = list(invs[ncc + nbc:])
+        # Iterate to a fixpoint: variance can flow through a CHAIN of
+        # carries (a <- axis_index, b <- a, c <- b needs one pass per
+        # link), so any iteration cap under-approximates. Union on the
+        # finite axis lattice is monotone, so this terminates.
+        while True:
+            new = self.run(body_j, body_c + carry)
+            merged = [a | b for a, b in zip(carry, new)]
+            if merged == carry:
+                break
+            carry = merged
+        pred_v = self.run(cond_j, cond_c + carry)[0]
+        body_sig = _collective_signature(body_j)
+        if body_sig and pred_v:
+            self.report302(
+                f"lax.while_loop body performs {len(body_sig)} "
+                f"collective(s) but its predicate varies across mesh "
+                f"axes {sorted(pred_v)} — devices would exit the loop "
+                f"at different iterations while neighbors still wait "
+                f"in the body's collectives (the converge loop avoids "
+                f"this by pmax-reducing the residual before the "
+                f"check)")
+        return [c | pred_v for c in carry]
+
+    def _scan(self, eqn, invs):
+        body = eqn.params["jaxpr"]
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        consts = invs[:nc]
+        carry = list(invs[nc:nc + ncar])
+        xs = invs[nc + ncar:]
+        n_out = len(eqn.outvars)
+        ys = [frozenset()] * (n_out - ncar)
+        while True:
+            out = self.run(body, consts + carry + xs)
+            new_carry = [a | b for a, b in zip(carry, out[:ncar])]
+            ys = [a | b for a, b in zip(ys, out[ncar:])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        return carry + ys
+
+
+# ---------------------------------------------------------------------------
+# audit drivers
+# ---------------------------------------------------------------------------
+
+def _audit_traced(traced, skips) -> List[Finding]:
+    out = list(skips)
+    seen = set()
+
+    def report(rule, label, message, severity="error"):
+        key = (rule, label, message)
+        if key not in seen:
+            seen.add(key)
+            out.append(Finding(rule, severity, _LOC, 0, label, message))
+
+    families = {}
+    n_shard_maps = 0
+    n_ppermutes = 0
+    for target, closed in traced:
+        tables_all = set()
+        for sm in _find_shard_maps(closed):
+            n_shard_maps += 1
+            names, sizes = _mesh_info(sm)
+            body = sm.params["jaxpr"]
+            # HL301 over every ppermute under this shard_map.
+            tables = _audit_ppermutes_under(
+                body, sizes,
+                lambda m, lb=target.label: report("HL301", lb, m))
+            n_ppermutes += len(tables)
+            tables_all |= {(a, p) for a, p in tables}
+            # HL302/HL303 via the varying-axes dataflow.
+            in_names = sm.params["in_names"]
+            out_names = sm.params["out_names"]
+            flow = _Dataflow(
+                names,
+                lambda m, lb=target.label: report("HL302", lb, m))
+            in_varying = [_names_axes(e) for e in in_names]
+            jaxpr = _inner(body)
+            if len(in_varying) != len(jaxpr.invars):
+                report("HL303", target.label,
+                       f"shard_map body arity mismatch "
+                       f"({len(in_varying)} specs vs "
+                       f"{len(jaxpr.invars)} invars) — replication "
+                       f"unprovable")
+                continue
+            out_varying = flow.run(body, in_varying)
+            for k, (spec, v) in enumerate(zip(out_names, out_varying)):
+                allowed = _names_axes(spec)
+                extra = v - allowed
+                if extra:
+                    report(
+                        "HL303", target.label,
+                        f"shard_map output {k} is declared "
+                        f"{'replicated' if not allowed else f'sharded only over {sorted(allowed)}'} "
+                        f"by its out_spec but provably varies over "
+                        f"mesh axes {sorted(extra)} — the value "
+                        f"feeds host control flow / GSPMD as if "
+                        f"identical on every device, so programs "
+                        f"desynchronize; reduce it (pmax/psum over "
+                        f"{sorted(extra)}) inside the shard_map body "
+                        f"(utils/compat.py runs check_rep=False on "
+                        f"pre-vma jax, so ONLY this static proof "
+                        f"checks it)")
+        families.setdefault(target.family, {})[target.variant] = (
+            target.label, frozenset(tables_all))
+
+    # HL302 cross-variant: the exchange-table set is a function of the
+    # geometry family, not of the stepping mode.
+    for family, variants in families.items():
+        if len(variants) < 2:
+            continue
+        ref_variant, (ref_label, ref_tables) = next(
+            iter(sorted(variants.items())))
+        for variant, (label, tables) in sorted(variants.items()):
+            if tables != ref_tables:
+                only_a = {f"{a}:{sorted(p)}" for a, p in
+                          (ref_tables - tables)}
+                only_b = {f"{a}:{sorted(p)}" for a, p in
+                          (tables - ref_tables)}
+                report(
+                    "HL302", label,
+                    f"program variant {variant!r} exchanges different "
+                    f"halo tables than variant {ref_variant!r} of the "
+                    f"same geometry family {family!r} (only in "
+                    f"{ref_variant}: {sorted(only_a) or '{}'}; only "
+                    f"in {variant}: {sorted(only_b) or '{}'}) — "
+                    f"variants must share one exchange protocol or a "
+                    f"mixed deployment hangs")
+    return out, n_shard_maps, n_ppermutes
+
+
+def audit_spmd(targets=None) -> List[Finding]:
+    """Run HL301+HL302+HL303 over ``targets`` (default: the real
+    solver programs across the audit mesh matrix). One traversal
+    serves all three rules."""
+    traced, skips = _traced(targets)
+    out, n_sm, n_pp = _audit_traced(traced, skips)
+    if targets is None and (n_sm == 0 or n_pp == 0):
+        out.append(Finding(
+            "HL301", "error", _LOC, 0, "audit_spmd",
+            f"vacuous audit: found {n_sm} shard_map(s) and {n_pp} "
+            f"ppermute table(s) in the default target matrix — the "
+            f"solver's sharded programs no longer trace the way the "
+            f"audit expects; fix the target matrix before trusting a "
+            f"clean result", soundness=True))
+    return out
+
+
+def _rule_runner(rule_id):
+    def run():
+        return run_spmd({rule_id})
+
+    return run
+
+
+SPMD_RULES = {
+    "HL301": ("error", "halo ppermute table breaks the exchange protocol",
+              _rule_runner("HL301")),
+    "HL302": ("error", "collective sequences diverge across branches/variants",
+              _rule_runner("HL302")),
+    "HL303": ("error", "shard_map output not provably replicated",
+              _rule_runner("HL303")),
+}
+
+
+def run_spmd(rules=None) -> List[Finding]:
+    """Run the SPMD-layer audits against the installed package.
+
+    Unlike ``run_contracts``, the three rules share one traced target
+    set, so this runs the audit once and filters. Soundness sentinels
+    (skipped meshes, a vacuous target matrix) survive any rule filter —
+    they mean the proof did not actually run."""
+    wanted = set(SPMD_RULES) if rules is None else set(rules)
+    return [f for f in audit_spmd() if f.rule in wanted or f.soundness]

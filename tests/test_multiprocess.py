@@ -117,6 +117,54 @@ print("WORKER-OK", pid, flush=True)
 """
 
 
+_WORKER_STATIC = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+from parallel_heat_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(4)
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.parallel.distributed import gather_to_host
+
+assert len(jax.devices()) == 8, jax.devices()
+kw = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 4))
+
+# Dynamic side: the halo exchange crosses a REAL process boundary and
+# must reproduce the single-device oracle bitwise.
+res = solve(HeatConfig(steps=12, **kw))
+oracle = solve(HeatConfig(nx=32, ny=32, backend="jnp",
+                          steps=12)).to_numpy()
+got = np.asarray(gather_to_host(res.grid))
+assert np.array_equal(got, oracle), "dynamic boundary parity failed"
+
+# Static side: HL301 (+302/303) over the SAME (2, 4) topology, traced
+# on the same 2-process global mesh — abstract evaluation only. The
+# simulated-mesh verdict (exchange protocol provably correct) and the
+# dynamic parity above are two proofs of one contract; a protocol bug
+# would fail BOTH, a tracing/topology regression would split them.
+from parallel_heat_tpu.analysis.spmd import _runner_target, audit_spmd
+
+targets = [
+    _runner_target(HeatConfig(steps=12, **kw), "mp-2x4", "fixed"),
+    _runner_target(HeatConfig(steps=40, converge=True,
+                              check_interval=10, **kw),
+                   "mp-2x4", "converge"),
+]
+findings = audit_spmd(targets=targets)
+assert findings == [], [f.message for f in findings]
+print("WORKER-STATIC-OK", pid, flush=True)
+"""
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -191,3 +239,26 @@ def test_two_process_solve_matches_single_device(tmp_path):
         _build_runner.cache_clear()
     assert np.array_equal(got, ref), \
         "kernel-H deferred-x: multi-process != single-process (bitwise)"
+
+
+def test_two_process_static_proof_matches_dynamic_parity(tmp_path):
+    """HL301's simulated-mesh verdict and the real-boundary exchange
+    agree on the same (2, 4) topology: the workers run the dynamic
+    bitwise parity AND the static SPMD audit over the identical
+    2-process global mesh — the static proof covers exactly the
+    programs the dynamic suite executes."""
+    worker = tmp_path / "worker_static.py"
+    worker.write_text(_WORKER_STATIC.format(repo=REPO))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    for attempt in range(3):
+        port = str(_free_port())
+        procs, outs = _run_workers(worker, port, env, tmp_path)
+        if attempt < 2 and any(p.returncode != 0 for p in procs) \
+                and any("already in use" in o.lower()
+                        or "address in use" in o.lower() for o in outs):
+            continue
+        break
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER-STATIC-OK {i}" in out

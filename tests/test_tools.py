@@ -280,6 +280,135 @@ def test_metrics_report_merges_shard_glob(tmp_path):
     assert doc["header"]["segments"] == 1
 
 
+def _run_heatlint(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "heatlint.py"),
+         *args],
+        capture_output=True, text=True, timeout=300,
+        cwd=cwd or _ROOT, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_heatlint_sarif_round_trip(tmp_path):
+    # Seed an AST violation, emit SARIF, and check the document is a
+    # valid SARIF 2.1.0 skeleton whose results point at the finding —
+    # the format CI uploads for PR annotation.
+    (tmp_path / "seeded.py").write_text("import os\n")
+    out = _run_heatlint("--layer", "ast", "--no-baseline",
+                        "--format", "sarif", str(tmp_path))
+    assert out.returncode == 2  # findings still gate in sarif mode
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "heatlint"
+    results = run["results"]
+    assert any(r["ruleId"] == "HL205" and r["level"] == "error"
+               for r in results)
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in results} <= rule_ids
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+    assert loc["region"]["startLine"] >= 1
+    # out-of-repo findings are self-contained absolute file URIs (a
+    # SRCROOT-relative URI would resolve against the repo root and
+    # point at nothing)
+    assert loc["artifactLocation"]["uri"].startswith("file://")
+    assert "uriBaseId" not in loc["artifactLocation"]
+    # the clean tree emits an empty (but well-formed) run, and SRCROOT
+    # names the actual repo root, not the filesystem root
+    clean = _run_heatlint("--layer", "ast", "--format", "sarif")
+    assert clean.returncode == 0
+    clean_run = json.loads(clean.stdout)["runs"][0]
+    assert clean_run["results"] == []
+    base = clean_run["originalUriBaseIds"]["SRCROOT"]["uri"]
+    assert base.startswith("file://") and base.endswith("/")
+    assert base != "file:///"
+
+
+def test_heatlint_json_schema_v2_and_timings():
+    out = _run_heatlint("--layer", "ast", "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["schema_version"] == 2
+    assert doc["layers"] == ["ast"]
+    assert doc["timings"]["ast"] >= 0
+    assert doc["strict_baseline"] is False
+    # --format json is the same document
+    out2 = _run_heatlint("--layer", "ast", "--format", "json")
+    assert json.loads(out2.stdout)["schema_version"] == 2
+    # conflicting format flags are a usage error
+    bad = _run_heatlint("--json", "--format", "sarif")
+    assert bad.returncode == 1
+
+
+def test_heatlint_strict_baseline_gates_stale(tmp_path):
+    # A stale ledger entry is a warning by default but fails the CI
+    # gate under --strict-baseline (the make lint mode). Stale-ness is
+    # only decided on a full-scope scan — the default repo scope here.
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "HL205", "file": "pkg/gone.py", "symbol": "<module>",
+         "justification": "kept: historical"}]}))
+    lax_run = _run_heatlint("--layer", "ast", "--baseline", str(bl))
+    assert lax_run.returncode == 0
+    assert "stale baseline entry" in lax_run.stdout
+    strict = _run_heatlint("--layer", "ast", "--baseline", str(bl),
+                           "--strict-baseline")
+    assert strict.returncode == 2
+    # with no stale entries, strict mode stays green
+    ok = _run_heatlint("--layer", "ast", "--strict-baseline")
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+
+def test_heatlint_path_scoped_run_leaves_baseline_unassessed(tmp_path):
+    # A path-scoped AST run never scanned the file a ledger entry
+    # excuses, so the entry is unassessed — not stale, and not a
+    # strict-mode gate. (Otherwise scanning one clean file under
+    # --strict-baseline would tell the user to delete a ledger entry
+    # whose violation is still alive elsewhere.)
+    viol = tmp_path / "viol.py"
+    viol.write_text("import os\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "HL205", "file": str(viol), "symbol": "<module>",
+         "justification": "kept: fixture"}]}))
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    scoped = _run_heatlint("--layer", "ast", "--baseline", str(bl),
+                           "--strict-baseline",
+                           str(tmp_path / "clean.py"))
+    assert scoped.returncode == 0, scoped.stdout + scoped.stderr
+    assert "stale baseline entry" not in scoped.stdout
+    # ...and the entry still matches (suppresses) on a scan that does
+    # reach the violation.
+    direct = _run_heatlint("--layer", "ast", "--baseline", str(bl),
+                           "--strict-baseline", str(viol))
+    assert direct.returncode == 0, direct.stdout + direct.stderr
+
+
+def test_heatlint_layer_selection():
+    # Timing summary names exactly the layers run; unknown layers and
+    # all+subset combinations are usage errors.
+    out = _run_heatlint("--layer", "ast")
+    assert "layer timings: ast" in out.stdout
+    assert "trace" not in out.stdout
+    bad = _run_heatlint("--layer", "nope")
+    assert bad.returncode == 1 and "unknown layer" in bad.stderr
+    bad2 = _run_heatlint("--layer", "all,ast")
+    assert bad2.returncode == 1
+    # a rules subset skips layers with no selected rule entirely
+    out = _run_heatlint("--rules", "HL205", "--json")
+    doc = json.loads(out.stdout)
+    assert doc["layers"] == ["ast"]
+
+
+def test_make_lint_fast_smoke():
+    # The pre-commit path: AST-only, jax-free, a few seconds.
+    out = subprocess.run(
+        ["make", "-C", _ROOT, "lint-fast"], capture_output=True,
+        text=True, timeout=300, env={**os.environ})
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "layer timings: ast" in out.stdout
+
+
 @pytest.mark.chaos
 def test_chaos_matrix_dryrun_smoke(tmp_path):
     # The fault x policy sweep must run end to end on CPU and certify

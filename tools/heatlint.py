@@ -1,40 +1,164 @@
 #!/usr/bin/env python
 """heatlint — static contract verification for parallel_heat_tpu.
 
-Two layers (see ``parallel_heat_tpu/analysis/``): the trace-level
+Four layers (see ``parallel_heat_tpu/analysis/``): the trace-level
 contract verifiers (HL1xx — cache-key partition, donation safety,
-Dirichlet write-set, f32chunk rounding chain; they trace solver
-programs to jaxprs without executing them) and the AST-level custom
+Dirichlet write-set, f32chunk rounding chain), the AST-level custom
 lint (HL2xx — blocking syncs in dispatch regions, wall-clock/RNG in
-traced code, Pallas kernel names, lock discipline, import hygiene).
+traced code, Pallas kernel names, lock discipline, import hygiene),
+the SPMD/collective protocol verifiers (HL3xx — halo ppermute
+bijection/symmetry, collective-sequence convergence, replication
+proofs; traced on a simulated 8-device mesh, nothing executes), and
+the Pallas kernel-safety verifiers (HL4xx — DMA in-bounds, VMEM
+budget, semaphore discipline, grid/BlockSpec tiling over all 17
+kernel sites).
 
 Usage::
 
     python tools/heatlint.py                      # full run, repo scope
     python tools/heatlint.py --fail-on error      # the CI gate (make lint)
     python tools/heatlint.py --layer ast src/     # fast AST-only pass
-    python tools/heatlint.py --rules HL203,HL205  # rule subset
+    python tools/heatlint.py --layer spmd,kernels # the new proof layers
+    python tools/heatlint.py --rules HL301,HL401  # rule subset
     python tools/heatlint.py --list-rules
-    python tools/heatlint.py --json               # machine-readable
+    python tools/heatlint.py --format json        # machine-readable
+    python tools/heatlint.py --format sarif       # CI PR annotations
 
 Exit codes: 0 clean (below the --fail-on threshold), 1 usage/internal
-error, 2 findings at/above the threshold. Intentionally-kept findings
-live in ``heatlint.baseline.json`` (``--baseline``; format in
-docs/API.md) — every entry needs a one-line justification, and stale
-entries are reported so the ledger shrinks when the code improves.
+error, 2 findings at/above the threshold (or stale baseline entries
+under --strict-baseline). Intentionally-kept findings live in
+``heatlint.baseline.json`` (``--baseline``; format in docs/API.md) —
+every entry needs a one-line justification, and stale entries are
+reported so the ledger shrinks when the code improves.
 """
 
 import argparse
 import json
 import os
+import pathlib
 import sys
+import time
 
-# The trace layer imports jax; keep it off any accelerator a shell
-# might pin (tracing is platform-independent, CPU is always present).
+# The trace/spmd/kernel layers import jax; keep it off any accelerator
+# a shell might pin (tracing is platform-independent, CPU is always
+# present).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# --format json schema. Version 2 added: schema_version itself, the
+# per-layer "timings" map, and the "layers" list actually run.
+JSON_SCHEMA_VERSION = 2
+
+# SARIF severity mapping (SARIF has no "warning"/"error"/"info" —
+# it has level: error/warning/note).
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+LAYER_ORDER = ("trace", "ast", "spmd", "kernels")
+
+
+def _parse_layers(arg: str):
+    """``--layer`` value -> ordered tuple of layer names (or an error
+    string). Accepts ``all`` or a comma-separated subset."""
+    wanted = [w.strip() for w in arg.split(",") if w.strip()]
+    if not wanted:
+        return None, f"--layer {arg!r}: no layer named"
+    if "all" in wanted:
+        if len(wanted) > 1:
+            return None, "--layer all cannot be combined with others"
+        return LAYER_ORDER, None
+    unknown = [w for w in wanted if w not in LAYER_ORDER]
+    if unknown:
+        return None, (f"unknown layer(s) {unknown} (choose from "
+                      f"{', '.join(LAYER_ORDER)} or all)")
+    # Preserve canonical order, drop duplicates.
+    return tuple(l for l in LAYER_ORDER if l in wanted), None
+
+
+def _sarif_doc(active, stale, rule_table, layer_of):
+    """Render findings as a SARIF 2.1.0 document (one run, one driver).
+
+    Suppressed (baselined) findings are omitted — SARIF suppression
+    objects confuse more CI annotators than they help; the baseline
+    ledger itself is the audit trail. Stale baseline entries surface as
+    HL000 warnings so the PR annotation shows the ledger rotting.
+    """
+    from parallel_heat_tpu.analysis.findings import _norm
+
+    rules_used = sorted({f.rule for f in active} | ({"HL000"} if stale
+                                                    else set()))
+    rule_index = {r: i for i, r in enumerate(rules_used)}
+
+    def artifact(fpath):
+        # Repo-relative paths resolve against SRCROOT (the repo root);
+        # paths outside the repo (e.g. an explicit scan target under
+        # /tmp) become self-contained absolute file URIs — a relative
+        # URI against the wrong base would point at nothing.
+        p = _norm(fpath)
+        if os.path.isabs(p):
+            return {"uri": pathlib.Path(p).as_uri()}
+        return {"uri": p.replace(os.sep, "/"), "uriBaseId": "SRCROOT"}
+
+    def rule_obj(rid):
+        if rid == "HL000":
+            return {"id": "HL000", "name": "stale-baseline-entry",
+                    "shortDescription": {
+                        "text": "baseline entry matches no finding"}}
+        sev, summary, _fn = rule_table[rid]
+        return {"id": rid, "name": f"{layer_of(rid)}-{rid}",
+                "shortDescription": {"text": summary},
+                "defaultConfiguration": {
+                    "level": _SARIF_LEVEL.get(sev, "warning")}}
+
+    def result(f):
+        region = {"startLine": max(1, f.line)}
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f"{f.symbol}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": artifact(f.file),
+                    "region": region,
+                }}],
+        }
+        if f.soundness:
+            res["properties"] = {"soundness": True}
+        return res
+
+    results = [result(f) for f in active]
+    for rule, fpath, symbol in stale:
+        results.append({
+            "ruleId": "HL000",
+            "ruleIndex": rule_index["HL000"],
+            "level": "warning",
+            "message": {"text": f"{symbol}: stale baseline entry for "
+                                f"{rule} — the finding it kept no "
+                                f"longer exists; delete it"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": artifact(fpath),
+                    "region": {"startLine": 1},
+                }}],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "heatlint",
+                "informationUri": "docs/API.md",
+                "rules": [rule_obj(r) for r in rules_used],
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": pathlib.Path(_REPO_ROOT).as_uri()
+                            + "/"}},
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -44,14 +168,15 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/directories for the AST layer "
                          "(default: parallel_heat_tpu tools bench.py)")
-    ap.add_argument("--layer", choices=("all", "trace", "ast"),
-                    default="all",
-                    help="which analyzer layer(s) to run (default all; "
+    ap.add_argument("--layer", default="all",
+                    help="comma-separated analyzer layer subset: "
+                         "trace, ast, spmd, kernels, or all (default). "
                          "'ast' is jax-free and fast — the smoke-chain "
-                         "self-check)")
+                         "self-check")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule-id subset (e.g. "
-                         "HL101,HL203)")
+                         "HL101,HL301); layers with no selected rule "
+                         "are skipped entirely")
     ap.add_argument("--fail-on", choices=("error", "warning", "info"),
                     default="error", dest="fail_on",
                     help="exit 2 when any finding is at/above this "
@@ -61,23 +186,47 @@ def main(argv=None) -> int:
                          "heatlint.baseline.json when present)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline file (show everything)")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    dest="strict_baseline",
+                    help="stale baseline entries gate like findings "
+                         "(exit 2) instead of warning — the CI ledger "
+                         "mode: the ledger can never outlive the code "
+                         "it excuses")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default=None, dest="format",
+                    help="output format (default text; sarif emits a "
+                         "SARIF 2.1.0 document for CI PR annotation)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as one JSON document")
+                    help="alias for --format json")
+    ap.add_argument("--no-timings", action="store_true",
+                    help="suppress the per-layer timing summary line")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     args = ap.parse_args(argv)
 
-    from parallel_heat_tpu.analysis import ALL_RULES
+    if args.as_json and args.format not in (None, "json"):
+        print("heatlint: --json conflicts with --format "
+              f"{args.format}", file=sys.stderr)
+        return 1
+    fmt = args.format or ("json" if args.as_json else "text")
+
+    layers, err = _parse_layers(args.layer)
+    if err:
+        print(f"heatlint: {err}", file=sys.stderr)
+        return 1
+
+    # The analysis modules import jax lazily, so reading the rule
+    # tables is cheap — only actually RUNNING a trace/spmd/kernels
+    # layer needs a jax backend.
+    from parallel_heat_tpu.analysis import ALL_RULES, LAYERS, layer_of
     from parallel_heat_tpu.analysis.astlint import lint_paths
-    from parallel_heat_tpu.analysis.contracts import run_contracts
     from parallel_heat_tpu.analysis.findings import (
         apply_baseline, gates, load_baseline, render_findings)
 
     if args.list_rules:
         for rid in sorted(ALL_RULES):
             sev, summary, _fn = ALL_RULES[rid]
-            layer = "trace" if rid.startswith("HL1") else "ast"
-            print(f"{rid}  [{layer}/{sev}]  {summary}")
+            print(f"{rid}  [{layer_of(rid)}/{sev}]  {summary}")
         return 0
 
     rules = None
@@ -89,6 +238,21 @@ def main(argv=None) -> int:
                   f"(--list-rules shows the table)", file=sys.stderr)
             return 1
 
+    # Layers that will actually run given --rules (a layer with no
+    # selected rule is skipped entirely — and must not cost the jax
+    # startup either).
+    run_layers = tuple(
+        l for l in layers
+        if rules is None or (rules & set(LAYERS[l][0])))
+
+    # The SPMD layer proves the exchange protocol over every mesh shape
+    # in its audit matrix (up to 8 devices); request the virtual
+    # devices BEFORE any layer initializes the jax backend, or the
+    # proof silently shrinks to the meshes one device can host.
+    if any(l != "ast" for l in run_layers):
+        from parallel_heat_tpu.utils.compat import request_cpu_devices
+        request_cpu_devices(8)
+
     try:
         baseline = None
         if not args.no_baseline:
@@ -98,21 +262,52 @@ def main(argv=None) -> int:
         return 1
 
     findings = []
-    if args.layer in ("all", "trace"):
-        findings.extend(run_contracts(rules=rules))
-    if args.layer in ("all", "ast"):
-        findings.extend(lint_paths(args.paths or None, rules=rules))
+    timings = {}
+    # Rules assessed this run — the stale-ness scope: a baseline entry
+    # whose rule's layer was skipped (--layer / --rules subset) was
+    # never given a chance to match, so it is unassessed, not stale —
+    # otherwise `make lint-fast` would gate on every trace/spmd/kernels
+    # ledger entry it never ran.
+    assessed = set()
+    for layer in run_layers:
+        table, run = LAYERS[layer]
+        t0 = time.perf_counter()
+        if layer == "ast":
+            findings.extend(lint_paths(args.paths or None, rules=rules))
+        else:
+            findings.extend(run(rules))
+        assessed |= (set(table) if rules is None
+                     else set(table) & rules)
+        timings[layer] = time.perf_counter() - t0
 
-    active, stale = apply_baseline(findings, baseline)
+    # An explicit path subset leaves the rest of the repo unscanned:
+    # an AST-rule ledger entry for an unscanned file may still have
+    # its violation alive there, so only entries under the scanned
+    # roots are stale-assessable.
+    from parallel_heat_tpu.analysis.findings import _norm
+    assessed_paths = (tuple(_norm(p).rstrip("/") for p in args.paths)
+                      if args.paths else None)
+    active, stale = apply_baseline(
+        findings, baseline, assessed_rules=assessed,
+        assessed_paths=assessed_paths,
+        path_rules=frozenset(LAYERS["ast"][0]))
+    timing_line = ", ".join(f"{k} {v:.2f}s" for k, v in timings.items())
 
-    if args.as_json:
+    if fmt == "json":
         print(json.dumps({
+            "schema_version": JSON_SCHEMA_VERSION,
             "findings": [f.to_dict() for f in active],
             "stale_baseline": [
                 {"rule": r, "file": p, "symbol": s}
                 for r, p, s in stale],
             "fail_on": args.fail_on,
+            "strict_baseline": args.strict_baseline,
+            "layers": list(timings),
+            "timings": {k: round(v, 3) for k, v in timings.items()},
         }, indent=2))
+    elif fmt == "sarif":
+        print(json.dumps(_sarif_doc(active, stale, ALL_RULES, layer_of),
+                         indent=2))
     else:
         text = render_findings(active, stale)
         if text:
@@ -124,7 +319,16 @@ def main(argv=None) -> int:
               f"{'y' if len(stale) == 1 else 'ies'}"
               + (f" [{baseline.path}]"
                  if baseline and baseline.path else ""))
-    return 2 if gates(active, args.fail_on) else 0
+        if timing_line and not args.no_timings:
+            print(f"heatlint: layer timings: {timing_line}")
+    if gates(active, args.fail_on):
+        return 2
+    if args.strict_baseline and stale:
+        if fmt == "text":
+            print("heatlint: --strict-baseline: stale entries gate",
+                  file=sys.stderr)
+        return 2
+    return 0
 
 
 if __name__ == "__main__":
