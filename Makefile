@@ -28,7 +28,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
 .PHONY: all heat heat_con native test lint lint-fast chaos \
-        telemetry-smoke monitor-smoke overlap-smoke bench clean
+        telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
+        bench clean
 
 all: heat
 
@@ -123,6 +124,41 @@ overlap-smoke:
 	    .overlap_smoke/metrics.jsonl \
 	    --fail-on 'permanent_failure,busy<0.5' --json
 	rm -rf .overlap_smoke
+
+# Serving run-book as a gate (README "Serving"): daemon up, 3 jobs
+# submitted (one with an injected transient the in-worker supervisor
+# must absorb), graceful drain, then the journal must show 3 terminal
+# completions with zero durability anomalies and zero quarantines.
+serve-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .serve_smoke && mkdir -p .serve_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu serve \
+	    --queue .serve_smoke/q --slots 2 --poll-interval 0.1 \
+	    --max-seconds 300 >/dev/null & \
+	DPID=$$!; trap 'kill $$DPID 2>/dev/null || true' EXIT; \
+	SUB="--queue .serve_smoke/q --nx 16 --ny 16 --steps 60 \
+	    --checkpoint-every 20 --accept-timeout 120 --wait \
+	    --timeout 180 --quiet"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id smoke-a; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id smoke-b --faults '{"transient_on_chunks": [1]}'; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id smoke-c; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu drain \
+	    --queue .serve_smoke/q; \
+	rc=0; wait $$DPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "daemon exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	JAX_PLATFORMS=cpu $(PY) tools/heatq.py .serve_smoke/q --check; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .serve_smoke/q \
+	    --fail-on 'quarantined>0,orphaned>0'; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .serve_smoke/q \
+	    --json | \
+	$(PY) -c "import json,sys; f=json.load(sys.stdin)['fleet']; \
+	assert f['completed'] == 3, f"
+	rm -rf .serve_smoke
 
 bench:
 	$(PY) bench.py

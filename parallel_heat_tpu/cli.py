@@ -218,7 +218,18 @@ def _parse_mesh(arg: Optional[str], ndim: int, grid_shape=None,
     return shape
 
 
+# Service subcommands forwarded to the heatd CLI: `python -m
+# parallel_heat_tpu serve/submit/status/cancel/drain ...` is the same
+# surface as the `heatd` console script (service/cli.py).
+_SERVICE_COMMANDS = ("serve", "submit", "status", "cancel", "drain")
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in _SERVICE_COMMANDS:
+        from parallel_heat_tpu.service.cli import main as heatd_main
+
+        return heatd_main(argv)
     args = build_parser().parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig, solve
@@ -407,19 +418,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     def _run():
         if args.supervise:
             from parallel_heat_tpu.supervisor import (
-                SupervisorPolicy, run_supervised)
+                SupervisorPolicy, default_checkpoint_every,
+                run_supervised)
 
-            every = args.checkpoint_every or max(1, config.steps // 10)
-            if config.accumulate == "f32chunk" \
-                    and args.checkpoint_every is None:
-                # The DEFAULT cadence must satisfy the supervisor's
-                # K-alignment requirement (stream boundaries are
-                # rounding points under f32chunk); explicit misaligned
-                # flags still fail loudly below.
-                from parallel_heat_tpu.config import sublane_count
-
-                sub = sublane_count(config.dtype)
-                every = ((every + sub - 1) // sub) * sub
+            # The default cadence satisfies the supervisor's f32chunk
+            # K-alignment requirement; explicit misaligned flags still
+            # fail loudly below.
+            every = (args.checkpoint_every
+                     or default_checkpoint_every(config))
             policy = SupervisorPolicy(
                 checkpoint_every=every,
                 keep_checkpoints=args.keep_checkpoints,

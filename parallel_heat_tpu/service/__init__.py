@@ -1,0 +1,50 @@
+"""Solver-as-a-service: the ``heatd`` daemon, its durable job queue,
+and the client tooling (ROADMAP item 2).
+
+Contract (SEMANTICS.md "Job durability"): an ACCEPTED job is never
+silently lost — it ends ``completed`` / ``quarantined`` / ``cancelled``
+/ ``deadline_expired``, or sits in the journal with its resume state
+(queued, or requeued after a worker death / daemon drain) for the next
+daemon to pick up. See ``service/store.py`` for the crash-safe disk
+protocol, ``service/daemon.py`` for the scheduler, and
+``service/worker.py`` for the per-attempt execution path.
+"""
+
+from parallel_heat_tpu.service.store import (
+    EXIT_CANCELLED,
+    EXIT_DEADLINE,
+    EXIT_QUARANTINED,
+    EXIT_REJECTED,
+    FAILFAST_KINDS,
+    TERMINAL_STATES,
+    JobSpec,
+    JobStore,
+    JobView,
+    Journal,
+    reduce_journal,
+)
+from parallel_heat_tpu.service.admission import (
+    admission_verdict,
+    estimate_job_hbm_bytes,
+)
+from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+from parallel_heat_tpu.service import client
+
+__all__ = [
+    "Heatd",
+    "HeatdConfig",
+    "JobSpec",
+    "JobStore",
+    "JobView",
+    "Journal",
+    "reduce_journal",
+    "admission_verdict",
+    "estimate_job_hbm_bytes",
+    "client",
+    "TERMINAL_STATES",
+    "FAILFAST_KINDS",
+    "EXIT_REJECTED",
+    "EXIT_QUARANTINED",
+    "EXIT_CANCELLED",
+    "EXIT_DEADLINE",
+]

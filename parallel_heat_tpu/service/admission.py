@@ -1,0 +1,79 @@
+"""Admission control: reject loudly at the door instead of thrashing.
+
+A serving daemon that accepts everything eventually accepts the job
+that OOMs the device or buries the queue; both failure modes look like
+"the service got slow and then fell over". The gate bounds two
+resources *at submission time*, jax-free (the daemon must admit — and
+refuse — without initializing an accelerator backend):
+
+- **queue depth**: accepted-but-not-terminal jobs (queued + running +
+  awaiting-requeue) versus ``max_queue_depth``;
+- **estimated HBM**: a static per-job device-memory estimate versus an
+  operator-set budget, summed over every admitted non-terminal job —
+  the service-level analogue of ``TpuParams.vmem_limit_bytes``'s
+  in-kernel check (heatlint HL402).
+
+A rejection is a first-class, journaled verdict carrying a
+``retry_after_s`` hint scaled by the current backlog — clients back
+off instead of hammering, and nothing is ever accepted-then-dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Storage dtype widths, mirrored from the solver's config vocabulary
+# WITHOUT importing jax/numpy (config.py is jax-free for exactly this
+# kind of consumer; the byte widths are a stable contract of the dtype
+# names themselves).
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+# Resident buffers per job: the double-buffered state pair plus one
+# snapshot/donation-protection copy (checkpoint gather source or
+# pipelined-yield copy — SEMANTICS.md "Pipelined stream"). A deliberate
+# slight over-estimate: admission must err toward refusing, not toward
+# the OOM it exists to prevent.
+_RESIDENT_BUFFERS = 3
+
+
+def estimate_job_hbm_bytes(config: dict) -> int:
+    """Static device-memory estimate for one job's grid state, from the
+    job spec's config dict (``HeatConfig`` field names). Conservative
+    by construction (see ``_RESIDENT_BUFFERS``); halo/reduction
+    scratch is second-order at the grid sizes the budget matters for."""
+    cells = int(config.get("nx", 20)) * int(config.get("ny", 20))
+    if config.get("nz") is not None:
+        cells *= int(config["nz"])
+    itemsize = _DTYPE_BYTES.get(str(config.get("dtype", "float32")), 4)
+    return cells * itemsize * _RESIDENT_BUFFERS
+
+
+def admission_verdict(config: dict, active_jobs: int,
+                      active_hbm_bytes: int, max_queue_depth: int,
+                      hbm_budget_bytes: Optional[int],
+                      retry_after_base_s: float, slots: int,
+                      draining: bool = False
+                      ) -> Tuple[bool, Optional[str], float, int]:
+    """One admission decision -> ``(accept, reason, retry_after_s,
+    est_hbm_bytes)``. Pure function of the queue state so the gate is
+    unit-testable and the daemon's journal record carries exactly what
+    was decided and why."""
+    est = estimate_job_hbm_bytes(config)
+    # Backlog-scaled hint: an empty queue says "come right back", a
+    # deep one says so honestly. Never zero — "retry immediately"
+    # would re-create the thundering herd the gate exists to absorb.
+    retry_after = retry_after_base_s * (1.0 + active_jobs
+                                        / max(1, slots))
+    if draining:
+        return (False, "daemon is draining (shutdown in progress); "
+                       "resubmit to the restarted daemon", retry_after,
+                est)
+    if active_jobs >= max_queue_depth:
+        return (False, f"queue depth {active_jobs} at the admission "
+                       f"limit ({max_queue_depth})", retry_after, est)
+    if hbm_budget_bytes is not None \
+            and active_hbm_bytes + est > hbm_budget_bytes:
+        return (False, f"estimated HBM {est} B would take the admitted "
+                       f"total to {active_hbm_bytes + est} B, past the "
+                       f"budget {hbm_budget_bytes} B", retry_after, est)
+    return True, None, 0.0, est

@@ -1,0 +1,302 @@
+"""``heatd`` — the service command line.
+
+Subcommands (also reachable as ``python -m parallel_heat_tpu serve
+...`` etc.; the solver CLI forwards them here):
+
+- ``serve``   run the daemon against a queue root (SIGTERM = graceful
+  drain, exit ``EXIT_PREEMPTED``);
+- ``submit``  enqueue one job (compact solver flags or ``--spec``
+  JSON); ``--wait`` blocks to the terminal state and maps it onto the
+  documented exit-code table;
+- ``status``  queue + daemon snapshot (``--json`` for scripts;
+  ``tools/heatq.py`` is the richer inspector);
+- ``cancel``  request cancellation of a job;
+- ``drain``   SIGTERM the daemon named in the queue's status heartbeat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from typing import Optional, Sequence
+
+from parallel_heat_tpu.service.store import (
+    EXIT_CANCELLED,
+    EXIT_DEADLINE,
+    EXIT_QUARANTINED,
+    EXIT_REJECTED,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="heatd",
+        description="fault-tolerant solver-as-a-service daemon for "
+                    "parallel_heat_tpu (durable job queue, admission "
+                    "control, orphan-job recovery)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sv = sub.add_parser("serve", help="run the daemon")
+    sv.add_argument("--queue", required=True, metavar="DIR",
+                    help="queue root (created if missing; the journal, "
+                         "job records, per-job checkpoints and "
+                         "telemetry all live here)")
+    sv.add_argument("--slots", type=int, default=2,
+                    help="concurrent worker processes (default 2)")
+    sv.add_argument("--poll-interval", type=float, default=0.25,
+                    metavar="S")
+    sv.add_argument("--worker-heartbeat", type=float, default=0.5,
+                    metavar="S", help="worker liveness beat cadence")
+    sv.add_argument("--heartbeat-timeout", type=float, default=3.0,
+                    metavar="S",
+                    help="silence past this declares a worker dead and "
+                         "its job orphaned (requeued with its "
+                         "checkpoint lineage intact)")
+    sv.add_argument("--max-queue-depth", type=int, default=16,
+                    metavar="N",
+                    help="admission gate: reject (with retry-after) "
+                         "past this many non-terminal jobs")
+    sv.add_argument("--hbm-budget-gb", type=float, default=None,
+                    metavar="F",
+                    help="admission gate: reject when admitted jobs' "
+                         "estimated device memory would exceed this "
+                         "(default: gate off)")
+    sv.add_argument("--quarantine-after", type=int, default=3,
+                    metavar="N",
+                    help="poison-job quarantine after failures on N "
+                         "distinct workers (unstable/stalled/drift/"
+                         "bad_spec "
+                         "verdicts quarantine immediately)")
+    sv.add_argument("--retry-after", type=float, default=2.0,
+                    metavar="S",
+                    help="base of the rejection retry-after hint")
+    sv.add_argument("--drain-grace", type=float, default=60.0,
+                    metavar="S",
+                    help="drain: wait this long for workers to flush "
+                         "before SIGKILL escalation")
+    sv.add_argument("--max-seconds", type=float, default=None,
+                    metavar="S",
+                    help="serve for at most S seconds then drain "
+                         "(harness/smoke use; default: until SIGTERM)")
+    sv.add_argument("--chaos-kill-after-accept", type=int, default=None,
+                    metavar="N",
+                    help="CHAOS HARNESS ONLY: SIGKILL the daemon right "
+                         "after journaling the Nth accepted job — the "
+                         "crash window the durability contract is "
+                         "certified against")
+
+    sb = sub.add_parser("submit", help="enqueue one job")
+    sb.add_argument("--queue", required=True, metavar="DIR")
+    sb.add_argument("--nx", type=int, default=20)
+    sb.add_argument("--ny", type=int, default=20)
+    sb.add_argument("--nz", type=int, default=None)
+    sb.add_argument("--steps", type=int, default=10_000)
+    sb.add_argument("--converge", action="store_true")
+    sb.add_argument("--eps", type=float, default=1e-3)
+    sb.add_argument("--check-interval", type=int, default=20)
+    sb.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16", "float64"])
+    sb.add_argument("--backend", default="auto",
+                    choices=["auto", "jnp", "pallas"])
+    sb.add_argument("--spec", default=None, metavar="FILE",
+                    help="JSON file of HeatConfig fields — overrides "
+                         "the flags above (full config surface, e.g. "
+                         "mesh_shape/accumulate)")
+    sb.add_argument("--deadline", type=float, default=None, metavar="S",
+                    help="wall-seconds from acceptance; past it the "
+                         "job is interrupted (checkpoint flushed) and "
+                         "journaled deadline_expired")
+    sb.add_argument("--max-retries", type=int, default=3,
+                    help="in-worker supervisor retry budget")
+    sb.add_argument("--checkpoint-every", type=int, default=None,
+                    metavar="N")
+    sb.add_argument("--guard-interval", type=int, default=None,
+                    metavar="N")
+    sb.add_argument("--job-id", default=None)
+    sb.add_argument("--faults", default=None, metavar="JSON",
+                    help="fault-injection plan (FaultPlan kwargs) for "
+                         "the chaos harness / smoke tests")
+    sb.add_argument("--faults-on-attempt", type=int, default=1)
+    sb.add_argument("--accept-timeout", type=float, default=15.0,
+                    metavar="S")
+    sb.add_argument("--wait", action="store_true",
+                    help="block until the job's terminal state and "
+                         "exit with the documented code (0 completed, "
+                         f"{EXIT_QUARANTINED} quarantined, "
+                         f"{EXIT_CANCELLED} cancelled, "
+                         f"{EXIT_DEADLINE} deadline)")
+    sb.add_argument("--timeout", type=float, default=None, metavar="S",
+                    help="--wait: give up (exit 1) after S seconds")
+    sb.add_argument("--quiet", action="store_true")
+
+    st = sub.add_parser("status", help="queue + daemon snapshot")
+    st.add_argument("--queue", required=True, metavar="DIR")
+    st.add_argument("--job", default=None, metavar="ID")
+    st.add_argument("--json", action="store_true")
+
+    ca = sub.add_parser("cancel", help="request job cancellation")
+    ca.add_argument("--queue", required=True, metavar="DIR")
+    ca.add_argument("job_id")
+
+    dr = sub.add_parser("drain", help="SIGTERM the serving daemon "
+                                      "(graceful drain)")
+    dr.add_argument("--queue", required=True, metavar="DIR")
+    return ap
+
+
+def _cmd_serve(args) -> int:
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    cfg = HeatdConfig(
+        root=args.queue, slots=args.slots,
+        poll_interval_s=args.poll_interval,
+        worker_heartbeat_s=args.worker_heartbeat,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        max_queue_depth=args.max_queue_depth,
+        hbm_budget_bytes=(int(args.hbm_budget_gb * 2**30)
+                          if args.hbm_budget_gb is not None else None),
+        quarantine_after=args.quarantine_after,
+        retry_after_s=args.retry_after,
+        drain_grace_s=args.drain_grace,
+        chaos_kill_after_accept=args.chaos_kill_after_accept)
+    try:
+        daemon = Heatd(cfg)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"heatd serving {args.queue} (pid {os.getpid()}, "
+          f"{cfg.slots} slot(s)); SIGTERM drains gracefully")
+    return daemon.serve(max_seconds=args.max_seconds)
+
+
+def _cmd_submit(args) -> int:
+    from parallel_heat_tpu.service import client
+
+    say = (lambda *a: None) if args.quiet else print
+    config = {"nx": args.nx, "ny": args.ny, "nz": args.nz,
+              "steps": args.steps, "converge": args.converge,
+              "eps": args.eps, "check_interval": args.check_interval,
+              "dtype": args.dtype, "backend": args.backend}
+    if args.spec:
+        try:
+            with open(args.spec) as f:
+                config.update(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read --spec {args.spec}: {e}",
+                  file=sys.stderr)
+            return 2
+    faults = None
+    if args.faults:
+        try:
+            faults = json.loads(args.faults)
+        except ValueError as e:
+            print(f"error: bad --faults JSON: {e}", file=sys.stderr)
+            return 2
+    try:
+        verdict = client.submit(
+            args.queue, config, job_id=args.job_id,
+            deadline_s=args.deadline, max_retries=args.max_retries,
+            checkpoint_every=args.checkpoint_every,
+            guard_interval=args.guard_interval, faults=faults,
+            faults_on_attempt=args.faults_on_attempt,
+            accept_timeout_s=args.accept_timeout)
+    except TimeoutError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:  # re-used --job-id
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    jid = verdict["job_id"]
+    if not verdict["accepted"]:
+        retry = verdict.get("retry_after_s")
+        print(f"rejected: {verdict.get('reason')}"
+              + (f" — retry after {retry:.1f}s" if retry else ""),
+              file=sys.stderr)
+        return EXIT_REJECTED
+    say(f"accepted {jid}")
+    if not args.wait:
+        return 0
+    try:
+        v = client.wait(args.queue, jid, timeout_s=args.timeout)
+    except TimeoutError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    say(f"{jid}: {v.state}"
+        + (f" (steps_done={v.steps_done})"
+           if v.steps_done is not None else "")
+        + (f" kind={v.kind}" if v.kind else ""))
+    return {"completed": 0, "quarantined": EXIT_QUARANTINED,
+            "cancelled": EXIT_CANCELLED,
+            "deadline_expired": EXIT_DEADLINE}.get(v.state, 1)
+
+
+def _cmd_status(args) -> int:
+    from parallel_heat_tpu.service import client
+
+    doc = client.status(args.queue, job_id=args.job)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0
+    d = doc.get("daemon")
+    if d:
+        print(f"daemon: pid {d.get('pid')} {d.get('state')} "
+              f"slots={d.get('slots')} "
+              f"running={d.get('running_workers')}")
+    else:
+        print("daemon: no status heartbeat (not running, or never "
+              "started on this root)")
+    for jid, v in doc["jobs"].items():
+        extra = ""
+        if v.get("kind"):
+            extra += f" kind={v['kind']}"
+        if v.get("steps_done") is not None:
+            extra += f" steps={v['steps_done']}"
+        print(f"  {jid}: {v['state']} attempts={v['attempts']}{extra}")
+    for a in doc["anomalies"]:
+        print(f"  ANOMALY: {a}")
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    from parallel_heat_tpu.service import client
+
+    if client.cancel(args.queue, args.job_id):
+        print(f"cancellation requested for {args.job_id}")
+        return 0
+    print(f"error: job {args.job_id!r} unknown or already terminal",
+          file=sys.stderr)
+    return 2
+
+
+def _cmd_drain(args) -> int:
+    from parallel_heat_tpu.service.store import JobStore
+
+    doc = JobStore(args.queue, create=False).read_daemon_status()
+    pid = (doc or {}).get("pid")
+    if not pid:
+        print("error: no daemon status heartbeat under this queue "
+              "root", file=sys.stderr)
+        return 2
+    try:
+        os.kill(int(pid), signal.SIGTERM)
+    except (ProcessLookupError, OSError) as e:
+        print(f"error: cannot signal daemon pid {pid}: {e}",
+              file=sys.stderr)
+        return 2
+    print(f"SIGTERM sent to heatd pid {pid} (graceful drain)")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"serve": _cmd_serve, "submit": _cmd_submit,
+            "status": _cmd_status, "cancel": _cmd_cancel,
+            "drain": _cmd_drain}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,152 @@
+"""Client side of the queue: submit / wait / status / cancel.
+
+Everything is file-based against the queue root — the transport is the
+same crash-safe store the daemon trusts, so there is no socket to
+leak, no RPC schema to version, and a submission is durable the moment
+its rename lands. The handshake:
+
+- :func:`submit` rename-commits a spool record, then polls the JOURNAL
+  for the daemon's verdict (``accepted`` or ``rejected`` + retry-after)
+  — the journal is the single response channel, so a daemon crash
+  mid-handshake can never tell the client one thing and disk another;
+- :func:`wait` polls the journal until the job's terminal state;
+- :func:`cancel` rename-creates a cancellation marker the daemon
+  honors on its next pass;
+- :func:`status` reads the journal replay + the daemon's status
+  heartbeat.
+
+A daemon that never answers is a loud ``TimeoutError`` naming the fix
+(start ``heatd serve``), not a silent hang.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from dataclasses import asdict
+from typing import Optional, Union
+
+from parallel_heat_tpu.service.store import JobSpec, JobStore, JobView
+
+_job_seq = itertools.count()
+
+
+def make_job_id(clock=time.time) -> str:
+    """Collision-free without randomness: wall-millis + pid + an
+    in-process counter (two clients on one host differ by pid; two
+    submissions from one client differ by counter)."""
+    return f"j{int(clock() * 1000):013d}-{os.getpid()}-{next(_job_seq)}"
+
+
+def _spec_config(config) -> dict:
+    if isinstance(config, dict):
+        return dict(config)
+    # A HeatConfig (or anything with its to_json contract).
+    return json.loads(config.to_json())
+
+
+def submit(root: str, config, *, job_id: Optional[str] = None,
+           deadline_s: Optional[float] = None, max_retries: int = 3,
+           checkpoint_every: Optional[int] = None,
+           guard_interval: Optional[int] = None,
+           backoff_base_s: float = 0.5,
+           faults: Optional[dict] = None, faults_on_attempt: int = 1,
+           accept_timeout_s: float = 15.0, poll_s: float = 0.1,
+           clock=time.time, sleep_fn=time.sleep) -> dict:
+    """Submit one job; block until the daemon's admission verdict.
+
+    Returns ``{"job_id", "accepted": True}`` or ``{"job_id",
+    "accepted": False, "reason", "retry_after_s"}``. Raises
+    ``TimeoutError`` when no verdict lands within
+    ``accept_timeout_s`` — the daemon is not running (or not watching
+    this root)."""
+    store = JobStore(root)
+    jid = job_id or make_job_id(clock)
+    existing, _ = store.replay()
+    if jid in existing:
+        # The daemon dedupes spool entries against the journal (crash
+        # idempotence), so a re-used id would be silently dropped and
+        # the poll below would report the OLD job's verdict as if it
+        # were this submission's. Refuse up front instead.
+        raise ValueError(
+            f"job_id {jid!r} already has journal history on this "
+            f"queue root (state: {existing[jid].state}) — job ids are "
+            f"single-use; omit --job-id for a generated one")
+    spec = JobSpec(job_id=jid, config=_spec_config(config),
+                   deadline_s=deadline_s, max_retries=max_retries,
+                   checkpoint_every=checkpoint_every,
+                   guard_interval=guard_interval,
+                   backoff_base_s=backoff_base_s,
+                   submitted_t=clock(), faults=faults,
+                   faults_on_attempt=faults_on_attempt)
+    store.spool_submit(spec)
+    deadline = clock() + accept_timeout_s
+    while True:
+        jobs, _ = store.replay()
+        v = jobs.get(jid)
+        if v is not None:
+            if v.state == "rejected":
+                return {"job_id": jid, "accepted": False,
+                        "reason": v.reason,
+                        "retry_after_s": v.retry_after_s}
+            return {"job_id": jid, "accepted": True}
+        if clock() >= deadline:
+            raise TimeoutError(
+                f"no admission verdict for {jid!r} within "
+                f"{accept_timeout_s:g}s — is `heatd serve --queue "
+                f"{root}` running? (the submission is spooled and will "
+                f"be admitted when a daemon picks it up; cancel it by "
+                f"removing {store.spool_path(jid)!r})")
+        sleep_fn(poll_s)
+
+
+def wait(root: str, job_id: str, timeout_s: Optional[float] = None,
+         poll_s: float = 0.25, clock=time.time,
+         sleep_fn=time.sleep) -> JobView:
+    """Poll until ``job_id`` reaches a terminal (or rejected) state;
+    returns its :class:`JobView`."""
+    store = JobStore(root, create=False)
+    t0 = clock()
+    while True:
+        jobs, _ = store.replay()
+        v = jobs.get(job_id)
+        if v is not None and (v.terminal or v.state == "rejected"):
+            return v
+        if timeout_s is not None and clock() - t0 >= timeout_s:
+            raise TimeoutError(
+                f"job {job_id!r} not terminal after {timeout_s:g}s "
+                f"(state: {v.state if v is not None else 'unknown'})")
+        sleep_fn(poll_s)
+
+
+def cancel(root: str, job_id: str) -> bool:
+    """Request cancellation; returns False when the job is unknown or
+    already terminal (nothing to do). The daemon journals the actual
+    ``cancelled`` transition on its next pass."""
+    store = JobStore(root, create=False)
+    jobs, _ = store.replay()
+    v = jobs.get(job_id)
+    if v is None or v.terminal or v.state == "rejected":
+        return False
+    store.request_cancel(job_id)
+    return True
+
+
+def status(root: str,
+           job_id: Optional[str] = None) -> dict:
+    """Queue snapshot: daemon heartbeat + per-job reduced views (all
+    jobs, or one). Views are plain dicts (JSON-ready for --json)."""
+    store = JobStore(root, create=False)
+    jobs, anomalies = store.replay()
+    if job_id is not None:
+        jobs = {job_id: jobs[job_id]} if job_id in jobs else {}
+    return {"daemon": store.read_daemon_status(),
+            "jobs": {jid: _view_dict(v) for jid, v in
+                     sorted(jobs.items())},
+            "anomalies": anomalies}
+
+
+def _view_dict(v: Union[JobView, dict]) -> dict:
+    return asdict(v) if isinstance(v, JobView) else dict(v)

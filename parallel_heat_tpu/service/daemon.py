@@ -1,0 +1,635 @@
+"""``heatd``: the long-lived solver-as-a-service daemon.
+
+ROADMAP item 2's serving layer, built so that every crash the chaos
+matrix can inject — worker SIGKILL mid-job, daemon SIGKILL between
+journal append and dispatch, overload bursts — lands in a state the
+journal already describes. The daemon holds **no authoritative state
+in memory**: each scheduling pass replays ``journal.jsonl`` through
+``store.reduce_journal`` and acts on the derived views, so a restarted
+daemon resumes exactly where the journal says the world is. The loop
+per :meth:`Heatd.step`:
+
+1. **reconcile** worker exits and liveness: a result record maps an
+   exited worker to its journal transition; a dead/silent worker
+   (SIGKILL, OOM — no record, stale heartbeat) has its job journaled
+   ``orphaned`` within one heartbeat timeout, checkpoint lineage
+   untouched;
+2. **cancel/deadline** enforcement: queued jobs transition directly;
+   running jobs are interrupted through the supervisor's flag-only
+   signal path (SIGTERM -> checkpoint flush -> preempted record), with
+   a SIGKILL escalation after ``kill_grace_s``;
+3. **admit** spool submissions through ``service.admission`` — journal
+   ``accepted`` (after the job spec is rename-committed) or
+   ``rejected`` with a retry-after hint; the handshake is idempotent
+   across a daemon crash at any point;
+4. **route failures**: fail-fast ``PermanentFailure`` kinds
+   (``unstable``/``stalled``/``drift``/``bad_spec``) quarantine
+   immediately;
+   everything else is re-admitted under bounded exponential backoff
+   until ``quarantine_after`` distinct workers have failed the job;
+5. **dispatch** due queued jobs to worker subprocesses (one process
+   per attempt — ``service/worker.py`` resumes from the newest
+   committed checkpoint generation, so a re-dispatched job continues
+   bit-exactly);
+6. publish the ``heatd.json`` status heartbeat for probes
+   (``tools/monitor.py --daemon``, ``heatd status``).
+
+SIGTERM/SIGINT triggers the graceful drain: stop admitting, interrupt
+in-flight workers, wait for their checkpoint flushes, journal each
+job's resume state (``requeued``), and exit ``EXIT_PREEMPTED`` — the
+restart re-dispatches from the journal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from parallel_heat_tpu.service.admission import admission_verdict
+from parallel_heat_tpu.service.store import (
+    FAILFAST_KINDS,
+    JobStore,
+    JobView,
+    reduce_journal,
+)
+from parallel_heat_tpu.supervisor import EXIT_PREEMPTED
+
+
+@dataclass
+class HeatdConfig:
+    """Daemon knobs. Time sources and the worker launcher are
+    injectable (tests drive the scheduler on a fake clock; the chaos
+    harness swaps launchers) — same pattern as
+    ``SupervisorPolicy.sleep_fn``."""
+
+    root: str
+    # Concurrent worker processes (one job each).
+    slots: int = 2
+    poll_interval_s: float = 0.25
+    # Cadence workers rewrite their liveness heartbeat at, and the
+    # staleness threshold past which a silent worker's job is declared
+    # orphaned. The timeout must cover several beats: one missed write
+    # is scheduling noise, not death.
+    worker_heartbeat_s: float = 0.5
+    heartbeat_timeout_s: float = 3.0
+    # Admission gates (service.admission).
+    max_queue_depth: int = 16
+    hbm_budget_bytes: Optional[int] = None
+    retry_after_s: float = 2.0
+    # Poison-job quarantine: after failures on this many DISTINCT
+    # workers (fail-fast PermanentFailure kinds quarantine immediately).
+    quarantine_after: int = 3
+    # Bounded exponential re-admission backoff after a non-fail-fast
+    # failure: min(max, base * 2**(failures-1)).
+    requeue_backoff_base_s: float = 0.5
+    requeue_backoff_max_s: float = 30.0
+    # Escalation: SIGTERM -> this grace -> SIGKILL (cancel/deadline/
+    # drain paths).
+    kill_grace_s: float = 5.0
+    drain_grace_s: float = 60.0
+    # Extra environment for worker subprocesses (the chaos matrix pins
+    # JAX_PLATFORMS=cpu here); inherits os.environ otherwise.
+    worker_env: Optional[dict] = None
+    clock: Callable[[], float] = field(default=time.time)
+    sleep_fn: Callable[[float], None] = field(default=time.sleep)
+    # Injectable worker launcher (tests run jobs inline): called as
+    # launcher(job_id=, worker_id=, attempt=, deadline_t=) and must
+    # return a Popen-shaped handle (poll/terminate/kill/pid). None =
+    # spawn `python -m parallel_heat_tpu.service.worker`.
+    launcher: Optional[Callable] = None
+    # CHAOS HARNESS ONLY: SIGKILL this daemon immediately after
+    # journaling the Nth `accepted` event — the exact
+    # between-append-and-dispatch crash window the durability contract
+    # is certified against (tools/chaos_matrix.py `svc_daemon_restart`).
+    chaos_kill_after_accept: Optional[int] = None
+
+    def validate(self) -> "HeatdConfig":
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{self.max_queue_depth}")
+        if self.quarantine_after < 1:
+            raise ValueError(f"quarantine_after must be >= 1, got "
+                             f"{self.quarantine_after}")
+        if self.heartbeat_timeout_s < self.worker_heartbeat_s:
+            raise ValueError(
+                f"heartbeat_timeout_s ({self.heartbeat_timeout_s}) must "
+                f"be >= worker_heartbeat_s ({self.worker_heartbeat_s}) "
+                f"— a timeout shorter than the write cadence declares "
+                f"every live worker dead")
+        return self
+
+
+class _StopFlag:
+    __slots__ = ("signum",)
+
+    def __init__(self):
+        self.signum: Optional[int] = None
+
+
+class Heatd:
+    """One daemon instance bound to one queue root. Single-threaded by
+    design: every mutation of queue state is a journal append from
+    :meth:`step`, so there is exactly one writer and no lock to get
+    wrong. Construct, then either call :meth:`serve` (the CLI path:
+    poll loop + signal-driven drain) or drive :meth:`step` directly
+    (tests and the chaos matrix)."""
+
+    def __init__(self, config: HeatdConfig):
+        self.config = config.validate()
+        self.store = JobStore(config.root)
+        self._procs: Dict[str, object] = {}  # job_id -> worker handle
+        self._term_sent: Dict[str, float] = {}  # job_id -> SIGTERM t
+        # Adopted jobs (no Popen handle) interrupted by heartbeat pid:
+        # job_id -> pid, for the SIGKILL escalation.
+        self._term_pid: Dict[str, int] = {}
+        self._accepts = 0
+        self._draining = False
+        # Incremental journal fold: byte offset consumed so far + the
+        # folded state. Equivalent to store.replay() by the reducer's
+        # fold law, but each pass parses only the appended events — a
+        # long-lived daemon must not re-read its whole history 5x per
+        # poll tick.
+        self._journal_offset = 0
+        self._jobs: Dict[str, JobView] = {}
+        self._anomalies: list = []
+        self.store.journal.append("daemon_start", pid=os.getpid(),
+                                  slots=self.config.slots)
+
+    def _replay(self):
+        """Fold journal bytes appended since the last call into the
+        cached views; returns ``(jobs, anomalies)`` — the same answer
+        ``store.replay()`` gives, O(new events) per pass. Only whole
+        lines are consumed: a torn tail (this read racing an append)
+        stays unconsumed and is re-read complete next pass."""
+        try:
+            with open(self.store.journal_path, "rb") as f:
+                f.seek(self._journal_offset)
+                data = f.read()
+        except OSError:
+            return self._jobs, self._anomalies
+        end = data.rfind(b"\n")
+        if end >= 0:
+            self._journal_offset += end + 1
+            events = []
+            for line in data[:end + 1].split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    events.append(rec)
+            reduce_journal(events, state=(self._jobs, self._anomalies))
+        return self._jobs, self._anomalies
+
+    # -- scheduling pass -------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One scheduling pass; returns a state-count summary (tests
+        and the status heartbeat read it)."""
+        cfg = self.config
+        now = cfg.clock() if now is None else now
+        self._reconcile(now)
+        self._cancels_and_deadlines(now)
+        self._admit(now)
+        self._route_failed(now)
+        if not self._draining:
+            self._dispatch(now)
+        return self._publish_status(now)
+
+    # -- phase 1: worker exits / liveness --------------------------------
+
+    def _reconcile(self, now: float) -> None:
+        jobs, _ = self._replay()
+        for jid, v in jobs.items():
+            if v.state != "running":
+                continue
+            handle = self._procs.get(jid)
+            if handle is not None:
+                rc = handle.poll()
+                if rc is None:
+                    continue  # still running
+                self._procs.pop(jid, None)
+                self._term_sent.pop(jid, None)
+                # Read the outcome record only AFTER the exit is
+                # observed: a live worker commits its record before
+                # exiting, so post-exit is the one moment the read
+                # cannot race the rename (and inline test launchers
+                # produce the record during poll() itself).
+                rec = self.store.read_result(jid, v.attempts)
+                self._classify_exit(v, rc, rec, now)
+                continue
+            rec = self.store.read_result(jid, v.attempts)
+            if rec is not None:
+                # Adopted job (daemon restarted after dispatch): the
+                # worker finished and its rename-committed record is
+                # the outcome — journal it exactly once.
+                self._term_sent.pop(jid, None)
+                self._term_pid.pop(jid, None)
+                self._classify_exit(v, None, rec, now)
+            else:
+                # Adopted job, no outcome record: judge liveness by the
+                # worker's heartbeat. A worker that has NEVER beaten
+                # gets one heartbeat timeout of grace from its
+                # dispatch stamp — a freshly-spawned worker is still
+                # importing its runtime before the first beat lands,
+                # and orphaning it would race a live process (a second
+                # worker against the stem lock). After the grace, a
+                # missing/stale beat or a dead pid is a corpse; its
+                # job is orphaned — the checkpoint lineage under
+                # ck/<job>/ is untouched, so the re-dispatched attempt
+                # resumes bit-exactly.
+                hb = self.store.read_worker_hb(v.worker or "")
+                if hb is None and v.last_dispatch_t is not None \
+                        and now - v.last_dispatch_t \
+                        <= self.config.heartbeat_timeout_s:
+                    continue
+                if not self._worker_alive(hb, now):
+                    self.store.journal.append(
+                        "orphaned", job_id=jid, worker=v.worker,
+                        attempt=v.attempts,
+                        reason=("worker heartbeat stale/dead "
+                                "(no exit record)"))
+
+    def _worker_alive(self, hb: Optional[dict], now: float) -> bool:
+        if hb is None:
+            return False
+        pid = hb.get("pid")
+        if isinstance(pid, int) and pid > 0:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return False
+            except OSError:
+                pass  # EPERM: exists
+        t = hb.get("t_wall")
+        return (isinstance(t, (int, float))
+                and now - t <= self.config.heartbeat_timeout_s)
+
+    def _classify_exit(self, v: JobView, rc, rec, now: float) -> None:
+        j = self.store.journal
+        jid = v.job_id
+        outcome = (rec or {}).get("outcome")
+        if outcome == "completed":
+            j.append("completed", job_id=jid, worker=v.worker,
+                     attempt=v.attempts,
+                     steps_done=rec.get("steps_done"),
+                     wall_s=rec.get("wall_s"))
+        elif outcome == "permanent_failure":
+            j.append("worker_failed", job_id=jid, worker=v.worker,
+                     attempt=v.attempts, exit_code=rc,
+                     kind=rec.get("kind") or "unknown",
+                     diagnosis=rec.get("diagnosis"))
+        elif outcome == "preempted":
+            reason = rec.get("reason")
+            if v.cancel_requested:
+                j.append("cancelled", job_id=jid, worker=v.worker,
+                         attempt=v.attempts,
+                         steps_done=rec.get("steps_done"))
+                self.store.clear_cancel(jid)
+            elif reason == "deadline" or (v.deadline_t is not None
+                                          and now >= v.deadline_t):
+                j.append("deadline_expired", job_id=jid,
+                         worker=v.worker, attempt=v.attempts,
+                         steps_done=rec.get("steps_done"))
+            else:
+                # Drain / external preemption: the flushed checkpoint
+                # IS the resume state — journal it so a restart
+                # re-dispatches from exactly here.
+                j.append("requeued", job_id=jid, reason="preempted",
+                         not_before=now, attempt=v.attempts,
+                         steps_done=rec.get("steps_done"))
+        else:
+            # No record (SIGKILL/OOM before the rename landed) or an
+            # unreadable one: a true orphan.
+            j.append("orphaned", job_id=jid, worker=v.worker,
+                     attempt=v.attempts,
+                     reason=f"worker exited rc={rc} without an outcome "
+                            f"record")
+
+    # -- phase 2: cancellation + deadlines -------------------------------
+
+    def _cancels_and_deadlines(self, now: float) -> None:
+        cfg = self.config
+        jobs, _ = self._replay()
+        j = self.store.journal
+        for jid in self.store.cancel_requests():
+            v = jobs.get(jid)
+            if v is None or v.terminal or v.state == "rejected":
+                self.store.clear_cancel(jid)
+                continue
+            if not v.cancel_requested:
+                j.append("cancel_requested", job_id=jid)
+                v.cancel_requested = True
+            if v.state in ("queued", "failed"):
+                j.append("cancelled", job_id=jid, attempt=v.attempts)
+                self.store.clear_cancel(jid)
+            elif v.state == "running":
+                self._interrupt_worker(jid, now, worker=v.worker)
+        for jid, v in jobs.items():
+            if v.terminal or v.deadline_t is None or now < v.deadline_t:
+                continue
+            if v.state in ("queued", "failed"):
+                j.append("deadline_expired", job_id=jid,
+                         attempt=v.attempts,
+                         reason=f"deadline passed while {v.state}")
+            elif v.state == "running":
+                # The worker's own interrupt hook normally beats this;
+                # the daemon-side SIGTERM (then SIGKILL after the
+                # grace) is the backstop for a wedged worker.
+                self._interrupt_worker(jid, now, worker=v.worker)
+        # Escalation: a worker that ignored SIGTERM past the grace gets
+        # the uncatchable one; reconcile then orphans+requeues its job.
+        for jid, t0 in list(self._term_sent.items()):
+            v = jobs.get(jid)
+            if v is None or v.state != "running":
+                self._term_sent.pop(jid, None)
+                self._term_pid.pop(jid, None)
+                continue
+            if now - t0 <= cfg.kill_grace_s:
+                continue
+            handle = self._procs.get(jid)
+            if handle is not None:
+                if handle.poll() is None:
+                    handle.kill()
+            elif jid in self._term_pid:
+                try:
+                    os.kill(self._term_pid[jid], signal.SIGKILL)
+                except OSError:
+                    pass
+
+    def _interrupt_worker(self, jid: str, now: float,
+                          worker: Optional[str] = None) -> None:
+        if jid in self._term_sent:
+            return
+        handle = self._procs.get(jid)
+        if handle is not None:
+            try:
+                handle.terminate()
+            except OSError:
+                pass
+            self._term_sent[jid] = now
+            return
+        # Adopted job (daemon restarted after dispatch): no handle,
+        # but the worker's heartbeat names its pid — cancellation and
+        # deadlines must reach it all the same.
+        hb = self.store.read_worker_hb(worker or "")
+        pid = (hb or {}).get("pid")
+        if isinstance(pid, int) and pid > 0:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                return
+            self._term_sent[jid] = now
+            self._term_pid[jid] = pid
+
+    # -- phase 3: admission ----------------------------------------------
+
+    def _admit(self, now: float) -> None:
+        cfg = self.config
+        j = self.store.journal
+        jobs, _ = self._replay()
+        for jid in self.store.iter_spool():
+            if jid in jobs:
+                # Crash between the journal append and the spool unlink
+                # on a previous pass: finish the handshake idempotently
+                # — never a second accepted/rejected line.
+                self.store.drop_spool(jid)
+                continue
+            spec = self.store.read_spool(jid)
+            if spec is None:
+                continue  # torn/foreign spool entry: leave for inspection
+            active = [v for v in jobs.values()
+                      if not v.terminal and v.state != "rejected"]
+            ok, reason, retry_after, est = admission_verdict(
+                spec.config, len(active),
+                sum(v.hbm_bytes for v in active),
+                cfg.max_queue_depth, cfg.hbm_budget_bytes,
+                cfg.retry_after_s, cfg.slots, draining=self._draining)
+            if not ok:
+                rec = j.append("rejected", job_id=jid, reason=reason,
+                               retry_after_s=retry_after)
+                # Fold by hand like the accepted branch below: a later
+                # acceptance in this same pass bumps the offset past
+                # these bytes, and an unfolded rejection would both
+                # undercount forever and let a re-used id through the
+                # `jid in jobs` dedupe.
+                self._journal_offset = os.path.getsize(
+                    self.store.journal_path)
+                reduce_journal([rec],
+                               state=(self._jobs, self._anomalies))
+                self.store.drop_spool(jid)
+                continue
+            # Durable spec FIRST, then the accepted line: a crash
+            # between the two replays the handshake from the spool copy
+            # (record rewrite is idempotent), so `accepted` in the
+            # journal always implies a loadable spec on disk.
+            self.store.commit_job_record(spec)
+            rec = j.append("accepted", job_id=jid,
+                           deadline_s=spec.deadline_s, hbm_bytes=est,
+                           submitted_t=spec.submitted_t)
+            # Fold the acceptance into the cached view by hand so the
+            # NEXT spool entry's gate sees this job as active without
+            # re-reading the journal (the incremental fold will skip
+            # these bytes — they are consumed here).
+            self._journal_offset = os.path.getsize(
+                self.store.journal_path)
+            reduce_journal([rec], state=(self._jobs, self._anomalies))
+            self._accepts += 1
+            if cfg.chaos_kill_after_accept is not None \
+                    and self._accepts >= cfg.chaos_kill_after_accept:
+                # Chaos window: die BETWEEN the journal append and the
+                # dispatch (and even before the spool unlink) — restart
+                # must recover the job from the journal alone.
+                os.kill(os.getpid(), signal.SIGKILL)
+            self.store.drop_spool(jid)
+
+    # -- phase 4: failure routing ----------------------------------------
+
+    def _route_failed(self, now: float) -> None:
+        cfg = self.config
+        jobs, _ = self._replay()
+        j = self.store.journal
+        for jid, v in jobs.items():
+            if v.state != "failed":
+                continue
+            last_kind = v.failures[-1][1] if v.failures else "unknown"
+            if last_kind in FAILFAST_KINDS:
+                # Deterministic verdicts: replaying bad physics on a
+                # different worker replays the same physics.
+                j.append("quarantined", job_id=jid, kind=last_kind,
+                         diagnosis=v.diagnosis,
+                         distinct_workers=v.distinct_failed_workers,
+                         reason=f"fail-fast permanent failure "
+                                f"(kind={last_kind})")
+            elif v.distinct_failed_workers >= cfg.quarantine_after:
+                j.append("quarantined", job_id=jid, kind=last_kind,
+                         diagnosis=v.diagnosis,
+                         distinct_workers=v.distinct_failed_workers,
+                         reason=f"failed on "
+                                f"{v.distinct_failed_workers} distinct "
+                                f"workers (poison-job threshold "
+                                f"{cfg.quarantine_after})")
+            else:
+                n = len(v.failures)
+                delay = min(cfg.requeue_backoff_max_s,
+                            cfg.requeue_backoff_base_s * 2 ** (n - 1))
+                j.append("requeued", job_id=jid, reason=last_kind,
+                         backoff_s=delay, not_before=now + delay,
+                         attempt=v.attempts)
+
+    # -- phase 5: dispatch -----------------------------------------------
+
+    def _dispatch(self, now: float) -> None:
+        cfg = self.config
+        jobs, _ = self._replay()
+        running = sum(1 for v in jobs.values() if v.state == "running")
+        due = sorted((v for v in jobs.values()
+                      if v.state == "queued" and v.not_before <= now),
+                     key=lambda v: (v.accepted_t or 0.0, v.job_id))
+        j = self.store.journal
+        for v in due:
+            if running >= cfg.slots:
+                break
+            attempt = v.attempts + 1
+            # Deterministic worker id (job + attempt): replayable after
+            # a daemon restart, and distinct per attempt so the
+            # poison-job classifier's distinct-worker count is exactly
+            # the distinct-attempt count.
+            wid = f"w-{v.job_id}-a{attempt:03d}"
+            # Journal BEFORE spawn: a crash in between leaves a
+            # `dispatched` job with no live worker — the reconcile
+            # pass orphans and requeues it. The opposite order could
+            # run a worker the journal knows nothing about (a double
+            # execution after restart).
+            j.append("dispatched", job_id=v.job_id, worker=wid,
+                     attempt=attempt)
+            try:
+                handle = self._launch(v, wid, attempt)
+            except OSError as e:
+                j.append("orphaned", job_id=v.job_id, worker=wid,
+                         attempt=attempt,
+                         reason=f"worker spawn failed: {e}")
+                continue
+            self._procs[v.job_id] = handle
+            running += 1
+
+    def _launch(self, v: JobView, worker_id: str, attempt: int):
+        cfg = self.config
+        if cfg.launcher is not None:
+            return cfg.launcher(job_id=v.job_id, worker_id=worker_id,
+                                attempt=attempt, deadline_t=v.deadline_t)
+        argv = [sys.executable, "-m", "parallel_heat_tpu.service.worker",
+                "--root", self.store.root, "--job", v.job_id,
+                "--worker", worker_id, "--attempt", str(attempt),
+                "--hb-interval", str(cfg.worker_heartbeat_s)]
+        if v.deadline_t is not None:
+            argv += ["--deadline-t", repr(v.deadline_t)]
+        env = dict(os.environ)
+        # The worker must import this package regardless of the
+        # daemon's cwd (the CLI may be launched from anywhere).
+        import parallel_heat_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(parallel_heat_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        env.update(cfg.worker_env or {})
+        log = open(self.store.worker_log_path(worker_id), "ab")
+        try:
+            return subprocess.Popen(argv, stdout=log,
+                                    stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()  # Popen holds its own duplicate
+
+    # -- phase 6: status heartbeat ---------------------------------------
+
+    def _publish_status(self, now: float) -> dict:
+        jobs, anomalies = self._replay()
+        counts: Dict[str, int] = {}
+        for v in jobs.values():
+            counts[v.state] = counts.get(v.state, 0) + 1
+        doc = {"pid": os.getpid(), "t_wall": now,
+               "state": "draining" if self._draining else "serving",
+               "slots": self.config.slots,
+               "running_workers": len(self._procs),
+               "poll_interval_s": self.config.poll_interval_s,
+               "counts": counts, "anomalies": len(anomalies)}
+        self.store.write_daemon_status(doc)
+        return doc
+
+    # -- lifecycle -------------------------------------------------------
+
+    def serve(self, max_seconds: Optional[float] = None) -> int:
+        """Poll loop until SIGTERM/SIGINT (or ``max_seconds``, for
+        harnesses), then graceful drain. Returns the process exit code
+        (``EXIT_PREEMPTED`` after a drain — restart loops treat the
+        daemon like any preempted supervised run: start it again and
+        it resumes from the journal)."""
+        cfg = self.config
+        stop = _StopFlag()
+
+        def handler(signum, frame):
+            stop.signum = signum  # flag only — drain at the loop top
+
+        prev = {}
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                prev[s] = signal.signal(s, handler)
+        except ValueError:  # not the main thread (tests)
+            prev = {}
+        t0 = cfg.clock()
+        try:
+            while stop.signum is None:
+                self.step()
+                if max_seconds is not None \
+                        and cfg.clock() - t0 >= max_seconds:
+                    break
+                cfg.sleep_fn(cfg.poll_interval_s)
+            return self.drain(
+                reason=(signal.Signals(stop.signum).name
+                        if stop.signum is not None else "max_seconds"))
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+    def drain(self, reason: str = "drain") -> int:
+        """Graceful shutdown: stop admitting (pending spool entries are
+        rejected with a retry-after), interrupt in-flight workers
+        through the supervisor's flag-only signal path, wait for their
+        checkpoint flushes, journal every in-flight job's resume state,
+        and exit ``EXIT_PREEMPTED``. Queued jobs stay queued — they are
+        already durable; the restarted daemon dispatches them."""
+        cfg = self.config
+        self._draining = True
+        self.store.journal.append("daemon_drain", reason=reason)
+        now = cfg.clock()
+        self._admit(now)  # draining=True -> loud rejections
+        for jid in list(self._procs):
+            self._interrupt_worker(jid, now)  # handles exist here
+        deadline = now + cfg.drain_grace_s
+        while self._procs and cfg.clock() < deadline:
+            self.step()
+            if self._procs:
+                cfg.sleep_fn(cfg.poll_interval_s)
+        for handle in self._procs.values():  # wedged past the grace
+            try:
+                handle.kill()
+            except OSError:
+                pass
+        self.step()  # final reconcile: orphan anything SIGKILLed above
+        self.store.journal.append("daemon_exit", outcome="drained")
+        self._publish_status(cfg.clock())
+        self.store.close()
+        return EXIT_PREEMPTED

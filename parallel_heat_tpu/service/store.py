@@ -1,0 +1,517 @@
+"""Durable job store: the crash-safe substrate under ``heatd``.
+
+The serving layer's whole contract — **no accepted job is ever
+silently lost** (SEMANTICS.md "Job durability") — reduces to two disk
+disciplines, both inherited from ``utils/checkpoint.py``'s generation
+protocol:
+
+- **atomic rename commits** for every record a reader may race
+  (job specs, spool submissions, result records, heartbeats): a file
+  either exists complete or not at all; temp names never match what
+  discovery scans for, so a SIGKILLed writer's torn file is invisible;
+- an **append-only state journal** (``journal.jsonl``) as the single
+  source of truth for job state: one fsynced JSON line per transition,
+  replayed through the pure reducer :func:`reduce_journal` to rebuild
+  the exact queue state after any crash. A torn final line (the writer
+  died mid-append) is skipped on replay — everything before it is a
+  valid prefix, exactly the torn-tail contract
+  ``tools/metrics_report.py`` reads telemetry streams with.
+
+The daemon (``service/daemon.py``) is the journal's only writer;
+workers and clients communicate through rename-committed records the
+daemon observes (spool submissions in, result records out), so "who
+may write what" is one sentence and the no-double-terminal invariant
+has a single enforcement point. State is *derived*, never cached: the
+daemon replays the journal each scheduling pass, which is what makes
+its own SIGKILL recoverable by construction — there is nothing in
+memory to lose.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.utils.checkpoint import _fsync_replace
+
+JOURNAL_SCHEMA_VERSION = 1
+
+# --- process exit codes (the service rows of the documented table;
+# supervisor.py owns 3/EXIT_PREEMPTED and 4/EXIT_PERMANENT_FAILURE,
+# argparse owns 2) ----------------------------------------------------
+# EXIT_REJECTED: the admission gate refused the submission (queue depth
+# or HBM budget); the verdict carries a retry-after hint — resubmit
+# later, nothing was enqueued.
+EXIT_REJECTED = 5
+# EXIT_QUARANTINED / EXIT_CANCELLED / EXIT_DEADLINE: `heatd submit
+# --wait` terminal-state mappings (the job itself reached a journaled
+# terminal state; its checkpoints/telemetry remain on disk).
+EXIT_QUARANTINED = 6
+EXIT_CANCELLED = 7
+EXIT_DEADLINE = 8
+
+# Terminal journal states: every ACCEPTED job ends in exactly one of
+# these (or sits durably queued/running with its resume state
+# journaled). `rejected` is terminal too but pre-acceptance — the job
+# was never owned by the service.
+TERMINAL_STATES = ("completed", "quarantined", "cancelled",
+                   "deadline_expired")
+# PermanentFailure kinds that fail FAST to quarantine: deterministic
+# verdicts (bad physics, eps below the dtype floor, persistent drift,
+# a spec the worker cannot even materialize into a HeatConfig) that
+# re-running on another worker cannot change. Everything else —
+# exhausted retry budgets, orphaned workers, spawn errors — is treated
+# as possibly-environmental and re-admitted under backoff until the
+# distinct-worker quarantine threshold says the job itself is poison.
+FAILFAST_KINDS = ("unstable", "stalled", "drift", "bad_spec")
+
+
+@dataclass
+class JobSpec:
+    """One submission: the solver config plus service-level knobs.
+
+    Committed to ``jobs/<job_id>.json`` by atomic rename at acceptance
+    (before the ``accepted`` journal line — a crash between the two
+    re-runs the idempotent handshake from the spool copy). ``config``
+    is the ``HeatConfig`` dict (``to_json`` round trip); the worker
+    materializes it with full validation."""
+
+    job_id: str
+    config: dict
+    # Wall-seconds from ACCEPTANCE to the deadline; None = none. An
+    # expired job is interrupted through the supervisor's flag-only
+    # path and journaled `deadline_expired`.
+    deadline_s: Optional[float] = None
+    # In-worker supervisor knobs (service-level requeue is the layer
+    # ABOVE this: a worker that exhausts max_retries exits with a
+    # permanent-failure record and the daemon decides requeue vs
+    # quarantine).
+    max_retries: int = 3
+    checkpoint_every: Optional[int] = None
+    guard_interval: Optional[int] = None
+    backoff_base_s: float = 0.5
+    submitted_t: float = 0.0
+    # Chaos harness: FaultPlan kwargs applied ONLY on attempt
+    # `faults_on_attempt` (a re-dispatched attempt builds a fresh plan,
+    # so an ungated one-shot fault would re-fire forever).
+    faults: Optional[dict] = None
+    faults_on_attempt: int = 1
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "JobSpec":
+        d = json.loads(s)
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class JobView:
+    """Reduced state of one job — the output of :func:`reduce_journal`,
+    never stored: always recomputed from the journal."""
+
+    job_id: str
+    state: str = "queued"
+    accepted_t: Optional[float] = None
+    deadline_t: Optional[float] = None
+    hbm_bytes: int = 0
+    attempts: int = 0
+    worker: Optional[str] = None
+    first_dispatch_t: Optional[float] = None
+    last_dispatch_t: Optional[float] = None
+    terminal_t: Optional[float] = None
+    kind: Optional[str] = None
+    diagnosis: Optional[str] = None
+    # (worker_id, kind) per failure/orphaning — the quarantine
+    # classifier counts DISTINCT workers here.
+    failures: List[Tuple[str, str]] = field(default_factory=list)
+    not_before: float = 0.0
+    cancel_requested: bool = False
+    requeues: int = 0
+    steps_done: Optional[int] = None
+    retry_after_s: Optional[float] = None
+    reason: Optional[str] = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    @property
+    def distinct_failed_workers(self) -> int:
+        return len({w for w, _ in self.failures})
+
+
+def reduce_journal(events, state=None
+                   ) -> Tuple[Dict[str, JobView], List[str]]:
+    """Pure reducer: journal events -> per-job views + anomalies.
+
+    THE durability contract lives here: a job is whatever its journal
+    prefix says it is, and a terminal state is absorbing — any further
+    terminal/dispatch event for the job is reported as an anomaly
+    (``double terminal``), which the chaos suite asserts stays empty
+    across daemon kills and restarts. Unknown events and unknown
+    fields are ignored (forward compatibility), never fatal.
+
+    The reduction is a left fold, exposed as one: pass ``state`` (a
+    previous call's ``(jobs, anomalies)``) to fold only the events
+    appended since — ``reduce(prefix) then reduce(suffix, state)``
+    equals ``reduce(prefix + suffix)``, which is how the daemon keeps
+    each scheduling pass O(new events) instead of re-parsing the whole
+    journal (pinned by ``test_reducer_incremental_fold_equivalence``).
+    """
+    jobs: Dict[str, JobView] = state[0] if state else {}
+    anomalies: List[str] = state[1] if state else []
+    for e in events:
+        jid = e.get("job_id")
+        ev = e.get("event")
+        if jid is None or ev is None:
+            continue  # daemon lifecycle / foreign line
+        t = e.get("t_wall")
+        v = jobs.get(jid)
+        if v is None:
+            v = jobs[jid] = JobView(job_id=jid)
+            if ev not in ("accepted", "rejected"):
+                anomalies.append(
+                    f"{jid}: first journal event is {ev!r} (missing "
+                    f"accepted record)")
+        if ev == "accepted":
+            if v.accepted_t is not None:
+                anomalies.append(f"{jid}: duplicate accepted event")
+                continue
+            v.state = "queued"
+            v.accepted_t = t
+            v.hbm_bytes = int(e.get("hbm_bytes") or 0)
+            if e.get("deadline_s") is not None and t is not None:
+                v.deadline_t = t + float(e["deadline_s"])
+            continue
+        if ev == "rejected":
+            v.state = "rejected"
+            v.reason = e.get("reason")
+            v.retry_after_s = e.get("retry_after_s")
+            v.terminal_t = t
+            continue
+        if ev == "cancel_requested":
+            v.cancel_requested = True
+            continue
+        if v.terminal:
+            if ev in TERMINAL_STATES or ev == "dispatched":
+                anomalies.append(
+                    f"{jid}: event {ev!r} after terminal state "
+                    f"{v.state!r} (double terminal)")
+            continue
+        if ev == "dispatched":
+            v.state = "running"
+            v.attempts = int(e.get("attempt", v.attempts + 1))
+            v.worker = e.get("worker")
+            v.last_dispatch_t = t
+            if v.first_dispatch_t is None:
+                v.first_dispatch_t = t
+        elif ev in ("worker_failed", "orphaned"):
+            v.state = "failed"
+            kind = e.get("kind") or ("orphaned" if ev == "orphaned"
+                                     else "unknown")
+            v.failures.append((e.get("worker") or "?", kind))
+            v.kind = kind
+            if e.get("diagnosis"):
+                v.diagnosis = e["diagnosis"]
+        elif ev == "requeued":
+            v.state = "queued"
+            v.requeues += 1
+            v.not_before = float(e.get("not_before") or 0.0)
+            v.reason = e.get("reason")
+            if e.get("steps_done") is not None:
+                # A drain/preemption requeue carries the flushed
+                # checkpoint's progress — the journaled resume state.
+                v.steps_done = e["steps_done"]
+        elif ev in TERMINAL_STATES:
+            v.state = ev
+            v.terminal_t = t
+            if e.get("kind"):
+                v.kind = e["kind"]
+            if e.get("diagnosis"):
+                v.diagnosis = e["diagnosis"]
+            if e.get("steps_done") is not None:
+                v.steps_done = e["steps_done"]
+            if e.get("reason"):
+                v.reason = e["reason"]
+    return jobs, anomalies
+
+
+def read_journal_file(path) -> Tuple[list, int, bool]:
+    """Tolerant journal parse -> ``(events, n_bad_lines, torn_tail)``.
+
+    Same contract as ``tools/metrics_report.py::load_events`` (which
+    cannot be imported from package code): a torn FINAL line — this
+    reader racing the appender, or the appender SIGKILLed mid-write —
+    is skipped, not counted bad; everything before it is a valid
+    prefix. Missing file = empty journal (a fresh queue)."""
+    events, bad, torn = [], 0, False
+    try:
+        with open(path, "rb") as f:
+            text = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return events, bad, torn
+    complete = text.endswith("\n")
+    lines = text.split("\n")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1 and not complete:
+                torn = True
+            else:
+                bad += 1
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            events.append(rec)
+        else:
+            bad += 1
+    return events, bad, torn
+
+
+class Journal:
+    """Append-only fsynced JSONL journal (the daemon's write handle).
+
+    Each :meth:`append` stamps the envelope (schema/event/t_wall/pid),
+    serializes to ONE line, writes it through a single ``os.write`` on
+    an ``O_APPEND`` descriptor and fsyncs — a SIGKILL between any two
+    appends loses nothing, a SIGKILL mid-append leaves at most one
+    torn tail line the replay skips. The lock serializes appends from
+    the owning process; cross-process exclusion is by design upstream
+    (one daemon per queue root — the daemon heartbeat names the owner).
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+        parent = os.path.dirname(os.path.abspath(self.path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fd = os.open(self.path,
+                           os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+
+    def append(self, event: str, **fields) -> dict:
+        rec = {"schema": JOURNAL_SCHEMA_VERSION, "event": event,
+               "t_wall": time.time(), "pid": os.getpid()}
+        rec.update(fields)
+        line = (json.dumps(rec) + "\n").encode()
+        with self._lock:
+            if self._fd < 0:
+                raise RuntimeError("journal is closed")
+            os.write(self._fd, line)
+            os.fsync(self._fd)
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd >= 0:
+                os.close(self._fd)
+                self._fd = -1
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class JobStore:
+    """On-disk layout of one queue root + the atomic-record helpers.
+
+    ::
+
+        <root>/journal.jsonl        state journal (daemon-written)
+        <root>/jobs/<id>.json       committed job specs
+        <root>/spool/<id>.json      client submissions awaiting admission
+        <root>/cancel/<id>          cancellation request markers
+        <root>/results/<id>.a<N>.json  per-attempt worker outcome records
+        <root>/hb/<worker>.json     worker liveness heartbeats
+        <root>/heatd.json           daemon status heartbeat
+        <root>/ck/<id>/ck*          per-job checkpoint generation family
+        <root>/telemetry/<id>.jsonl per-job telemetry sink (appends
+                                    across attempts — one stream per job)
+        <root>/logs/<worker>.log    worker stdout/stderr
+    """
+
+    def __init__(self, root, create: bool = True):
+        self.root = str(root)
+        self.journal_path = os.path.join(self.root, "journal.jsonl")
+        if create:
+            for d in ("jobs", "spool", "cancel", "results", "hb", "ck",
+                      "telemetry", "logs"):
+                os.makedirs(os.path.join(self.root, d), exist_ok=True)
+        self._journal: Optional[Journal] = None
+
+    # -- journal ---------------------------------------------------------
+
+    @property
+    def journal(self) -> Journal:
+        if self._journal is None:
+            self._journal = Journal(self.journal_path)
+        return self._journal
+
+    def read_journal(self) -> Tuple[list, int, bool]:
+        return read_journal_file(self.journal_path)
+
+    def replay(self) -> Tuple[Dict[str, JobView], List[str]]:
+        events, _bad, _torn = self.read_journal()
+        return reduce_journal(events)
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # -- atomic JSON records ---------------------------------------------
+
+    def write_json_atomic(self, path: str, doc: dict) -> str:
+        """Rename-committed JSON write (checkpoint.py discipline): the
+        dotted temp name can never match a ``*.json`` discovery scan,
+        and the publish is fsync + rename + dirsync."""
+        tmp = os.path.join(os.path.dirname(path),
+                           f".tmp-{os.getpid()}-{os.path.basename(path)}")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        _fsync_replace(tmp, path)
+        return path
+
+    @staticmethod
+    def read_json(path: str) -> Optional[dict]:
+        """None on missing/torn/foreign — readers race writers by
+        design and must degrade, never crash."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    # -- spool (client -> daemon submissions) ----------------------------
+
+    def spool_path(self, job_id: str) -> str:
+        return os.path.join(self.root, "spool", f"{job_id}.json")
+
+    def spool_submit(self, spec: JobSpec) -> str:
+        return self.write_json_atomic(self.spool_path(spec.job_id),
+                                      json.loads(spec.to_json()))
+
+    def iter_spool(self) -> List[str]:
+        d = os.path.join(self.root, "spool")
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        return [n[:-5] for n in names
+                if n.endswith(".json") and not n.startswith(".")]
+
+    def read_spool(self, job_id: str) -> Optional[JobSpec]:
+        doc = self.read_json(self.spool_path(job_id))
+        if doc is None:
+            return None
+        try:
+            return JobSpec.from_json(json.dumps(doc))
+        except (TypeError, ValueError):
+            return None
+
+    def drop_spool(self, job_id: str) -> None:
+        try:
+            os.unlink(self.spool_path(job_id))
+        except OSError:
+            pass
+
+    # -- committed job specs ---------------------------------------------
+
+    def job_record_path(self, job_id: str) -> str:
+        return os.path.join(self.root, "jobs", f"{job_id}.json")
+
+    def commit_job_record(self, spec: JobSpec) -> str:
+        return self.write_json_atomic(self.job_record_path(spec.job_id),
+                                      json.loads(spec.to_json()))
+
+    def load_spec(self, job_id: str) -> JobSpec:
+        doc = self.read_json(self.job_record_path(job_id))
+        if doc is None:
+            raise FileNotFoundError(
+                f"no committed job record for {job_id!r} under "
+                f"{self.root!r}")
+        return JobSpec.from_json(json.dumps(doc))
+
+    # -- cancellation markers --------------------------------------------
+
+    def request_cancel(self, job_id: str) -> None:
+        path = os.path.join(self.root, "cancel", job_id)
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY, 0o644)
+        os.close(fd)
+
+    def cancel_requests(self) -> List[str]:
+        d = os.path.join(self.root, "cancel")
+        try:
+            return sorted(n for n in os.listdir(d)
+                          if not n.startswith("."))
+        except OSError:
+            return []
+
+    def clear_cancel(self, job_id: str) -> None:
+        try:
+            os.unlink(os.path.join(self.root, "cancel", job_id))
+        except OSError:
+            pass
+
+    # -- per-job artifacts -----------------------------------------------
+
+    def checkpoint_stem(self, job_id: str) -> str:
+        d = os.path.join(self.root, "ck", job_id)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, "ck")
+
+    def telemetry_path(self, job_id: str) -> str:
+        return os.path.join(self.root, "telemetry", f"{job_id}.jsonl")
+
+    def worker_log_path(self, worker_id: str) -> str:
+        return os.path.join(self.root, "logs", f"{worker_id}.log")
+
+    def result_path(self, job_id: str, attempt: int) -> str:
+        return os.path.join(self.root, "results",
+                            f"{job_id}.a{int(attempt):04d}.json")
+
+    def write_result(self, job_id: str, attempt: int, doc: dict) -> str:
+        return self.write_json_atomic(self.result_path(job_id, attempt),
+                                      doc)
+
+    def read_result(self, job_id: str, attempt: int) -> Optional[dict]:
+        return self.read_json(self.result_path(job_id, attempt))
+
+    # -- heartbeats ------------------------------------------------------
+
+    def worker_hb_path(self, worker_id: str) -> str:
+        return os.path.join(self.root, "hb", f"{worker_id}.json")
+
+    def write_worker_hb(self, worker_id: str, doc: dict) -> None:
+        try:
+            self.write_json_atomic(self.worker_hb_path(worker_id), doc)
+        except OSError:
+            pass  # liveness probe only; never kill the worker over it
+
+    def read_worker_hb(self, worker_id: str) -> Optional[dict]:
+        return self.read_json(self.worker_hb_path(worker_id))
+
+    def daemon_status_path(self) -> str:
+        return os.path.join(self.root, "heatd.json")
+
+    def write_daemon_status(self, doc: dict) -> None:
+        try:
+            self.write_json_atomic(self.daemon_status_path(), doc)
+        except OSError:
+            pass
+
+    def read_daemon_status(self) -> Optional[dict]:
+        return self.read_json(self.daemon_status_path())

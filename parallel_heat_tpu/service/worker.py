@@ -1,0 +1,218 @@
+"""Service worker: one process, one job attempt.
+
+``heatd`` dispatches each attempt as ``python -m
+parallel_heat_tpu.service.worker`` — a real OS process, so real death
+(SIGKILL, OOM) is exactly what the daemon's orphan detection faces.
+The worker is a thin adapter around the machinery earlier PRs built:
+
+- it **resumes before it runs**: ``latest_checkpoint`` on the job's
+  checkpoint stem finds the newest COMMITTED generation (a predecessor
+  killed mid-save left only complete generations — the checkpoint
+  protocol's torn-write invisibility), so a re-dispatched attempt
+  continues the same trajectory bit-exactly;
+- the job executes under :func:`supervisor.run_supervised` — guard,
+  retained generations, in-worker retry-with-rollback, SIGTERM flush —
+  with a per-job telemetry sink that APPENDS across attempts (one
+  JSONL stream per job, absolute steps via ``step_offset``, exactly
+  like a CLI ``--resume`` continuation);
+- deadlines ride the supervisor's flag-only interrupt hook; daemon
+  SIGTERM (cancel/drain) rides its signal handler — both exit
+  ``EXIT_PREEMPTED`` with a rename-committed outcome record saying
+  which;
+- liveness is a tiny heartbeat thread atomically rewriting
+  ``hb/<worker>.json`` — self-contained (an Event and a file write, no
+  shared mutable state), so a wedged run loop stops beating and the
+  daemon's staleness threshold catches it.
+
+Exit codes are the supervisor's own vocabulary: 0 completed,
+``EXIT_PREEMPTED`` (3) interrupted-with-resume-state,
+``EXIT_PERMANENT_FAILURE`` (4) with the kind in the outcome record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.service.store import JobStore
+from parallel_heat_tpu.supervisor import (
+    EXIT_PERMANENT_FAILURE,
+    EXIT_PREEMPTED,
+    PermanentFailure,
+    SupervisorPolicy,
+    default_checkpoint_every,
+    run_supervised,
+)
+from parallel_heat_tpu.utils import checkpoint as ckpt
+from parallel_heat_tpu.utils.faults import FaultPlan
+from parallel_heat_tpu.utils.telemetry import Telemetry
+
+
+class _HeartbeatWriter(threading.Thread):
+    """Atomic liveness beats on a fixed cadence. Deliberately owns no
+    shared state beyond its stop Event: the run loop cannot block it,
+    and it cannot race the run loop."""
+
+    def __init__(self, store: JobStore, worker_id: str, job_id: str,
+                 attempt: int, interval_s: float):
+        super().__init__(name=f"heartbeat-{worker_id}", daemon=True)
+        self._store = store
+        self._worker_id = worker_id
+        self._job_id = job_id
+        self._attempt = attempt
+        self._interval_s = interval_s
+        self._stop_event = threading.Event()
+
+    def run(self) -> None:
+        while True:
+            self._store.write_worker_hb(self._worker_id, {
+                "pid": os.getpid(), "t_wall": time.time(),
+                "job_id": self._job_id, "attempt": self._attempt,
+                "interval_s": self._interval_s})
+            if self._stop_event.wait(self._interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self.join(timeout=5.0)
+
+
+def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
+                deadline_t: Optional[float] = None,
+                hb_interval_s: Optional[float] = None,
+                say=None) -> int:
+    """Run one job attempt to an exit code + outcome record. The
+    daemon's inline-launcher tests call this directly; ``main`` wraps
+    it for the subprocess path."""
+    say = say or (lambda *a: None)
+    store = JobStore(root, create=False)
+    t0 = time.perf_counter()
+
+    def record(outcome: str, **fields) -> None:
+        doc = {"outcome": outcome, "worker": worker_id,
+               "attempt": attempt, "job_id": job_id,
+               "wall_s": time.perf_counter() - t0}
+        doc.update(fields)
+        store.write_result(job_id, attempt, doc)
+
+    try:
+        spec = store.load_spec(job_id)
+        config = HeatConfig.from_json(json.dumps(spec.config)).validate()
+    except Exception as e:  # noqa: BLE001 — any unloadable spec is terminal
+        # An accepted spec the worker cannot materialize is
+        # deterministic poison: record it (so the daemon fail-fast
+        # quarantines with THIS diagnosis) instead of dying recordless
+        # and churning through orphan/requeue to a mislabeled verdict.
+        record("permanent_failure", kind="bad_spec",
+               diagnosis=f"cannot materialize job spec: {e}")
+        return EXIT_PERMANENT_FAILURE
+    stem = store.checkpoint_stem(job_id)
+    total = config.steps
+
+    hb = None
+    if hb_interval_s:
+        hb = _HeartbeatWriter(store, worker_id, job_id, attempt,
+                              hb_interval_s)
+        hb.start()
+    telemetry = Telemetry(store.telemetry_path(job_id), async_io=True)
+
+    try:
+        # Resume-before-run: the newest COMMITTED generation of this
+        # job's stem (None on attempt 1 — run_supervised writes
+        # generation zero before any step, so even a first-chunk death
+        # leaves a resume target).
+        initial = None
+        start_step = 0
+        src = ckpt.latest_checkpoint(stem)
+        if src is not None:
+            initial, start_step, _ = ckpt.load_checkpoint(src, config)
+            say(f"worker {worker_id}: resuming {job_id} from {src} "
+                f"at step {start_step}")
+        telemetry.step_offset = start_step
+        run_cfg = config.replace(steps=max(0, total - start_step))
+
+        faults = None
+        if spec.faults and attempt == int(spec.faults_on_attempt or 1):
+            d = dict(spec.faults)
+            if d.get("transient_on_chunks") is not None:
+                d["transient_on_chunks"] = tuple(d["transient_on_chunks"])
+            faults = FaultPlan(**d)
+
+        policy = SupervisorPolicy(
+            checkpoint_every=(spec.checkpoint_every
+                              or default_checkpoint_every(config)),
+            guard_interval=spec.guard_interval,
+            max_retries=spec.max_retries,
+            backoff_base_s=spec.backoff_base_s)
+        interrupt = None
+        if deadline_t is not None:
+            # The flag-only deadline: polled at chunk boundaries, the
+            # supervisor flushes a checkpoint and returns interrupted
+            # with this reason — no second signal vocabulary.
+            interrupt = (lambda: "deadline"
+                         if time.time() >= deadline_t else None)
+
+        try:
+            sres = run_supervised(run_cfg, stem, policy=policy,
+                                  initial=initial, start_step=start_step,
+                                  faults=faults, telemetry=telemetry,
+                                  interrupt=interrupt, say=say)
+        except ckpt.StemLockError as e:
+            # A predecessor the daemon believed dead still holds the
+            # stem (pid reuse / a misjudged adoption): refuse rather
+            # than race its generations. Not a fail-fast kind — the
+            # daemon requeues with backoff and the next attempt finds
+            # the lock stale or released.
+            record("permanent_failure", kind="stem_locked",
+                   diagnosis=str(e))
+            return EXIT_PERMANENT_FAILURE
+        except PermanentFailure as e:
+            record("permanent_failure", kind=e.kind,
+                   diagnosis=e.diagnosis)
+            return EXIT_PERMANENT_FAILURE
+
+        if sres.interrupted:
+            record("preempted", reason=sres.signal_name,
+                   steps_done=sres.steps_done,
+                   last_checkpoint=(str(sres.last_checkpoint)
+                                    if sres.last_checkpoint else None))
+            return EXIT_PREEMPTED
+        record("completed", steps_done=sres.steps_done,
+               retries=sres.retries,
+               last_checkpoint=(str(sres.last_checkpoint)
+                                if sres.last_checkpoint else None))
+        return 0
+    finally:
+        telemetry.close()
+        if hb is not None:
+            hb.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="parallel_heat_tpu.service.worker",
+        description="heatd worker: one process, one job attempt "
+                    "(normally launched by the daemon, not by hand)")
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--job", required=True)
+    ap.add_argument("--worker", required=True)
+    ap.add_argument("--attempt", type=int, default=1)
+    ap.add_argument("--hb-interval", type=float, default=None)
+    ap.add_argument("--deadline-t", type=float, default=None,
+                    help="absolute unix deadline (daemon-computed)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    say = print if args.verbose else None
+    return execute_job(args.root, args.job, args.worker, args.attempt,
+                       deadline_t=args.deadline_t,
+                       hb_interval_s=args.hb_interval, say=say)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

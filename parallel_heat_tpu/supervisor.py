@@ -54,8 +54,8 @@ import shlex
 import signal
 import threading
 import time
-from dataclasses import dataclass
-from typing import Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
 
 from parallel_heat_tpu.config import HeatConfig
 from parallel_heat_tpu.solver import (
@@ -77,6 +77,23 @@ from parallel_heat_tpu.utils.faults import InjectedTransientError
 # violation, exhausted retry budget); diagnosis on stderr.
 EXIT_PREEMPTED = 3
 EXIT_PERMANENT_FAILURE = 4
+
+
+def default_checkpoint_every(config) -> int:
+    """The default supervised checkpoint cadence (one tenth of the
+    run), rounded UP to the f32chunk sublane multiple when that
+    accumulate mode is active — the supervisor's K-alignment
+    requirement (stream boundaries are rounding points, SEMANTICS.md).
+    THE shared rule for every caller that supervises without an
+    explicit --checkpoint-every: the solver CLI and service workers
+    must not drift apart on it."""
+    every = max(1, config.steps // 10)
+    if config.accumulate == "f32chunk":
+        from parallel_heat_tpu.config import sublane_count
+
+        sub = sublane_count(config.dtype)
+        every = ((every + sub - 1) // sub) * sub
+    return every
 
 
 class PermanentFailure(RuntimeError):
@@ -161,6 +178,14 @@ class SupervisorPolicy:
     # guard cannot see; it is a retryable guard trip with
     # kind="drift". None = off.
     drift_tolerance: Optional[float] = None
+    # Injectable time sources. `sleep_fn` receives every backoff delay
+    # (the bounded-exponential schedule above): tests pin the schedule
+    # by recording calls instead of sleeping wall-clock, and service
+    # workers can interleave housekeeping with the wait. `clock` is the
+    # monotonic wall-second source for wall_s/latency bookkeeping
+    # (observation only — never simulation numerics).
+    sleep_fn: Callable[[float], None] = field(default=time.sleep)
+    clock: Callable[[], float] = field(default=time.perf_counter)
 
     def validate(self) -> "SupervisorPolicy":
         if self.checkpoint_every < 1:
@@ -343,7 +368,8 @@ def run_supervised(config: HeatConfig, checkpoint,
                    initial=None, start_step: int = 0,
                    faults=None, say=None,
                    resume_extra_flags: Tuple[str, ...] = (),
-                   telemetry=None, checkpointer=None) -> SupervisorResult:
+                   telemetry=None, checkpointer=None,
+                   interrupt=None) -> SupervisorResult:
     """Run ``config.steps`` more steps under supervision (guard +
     retained checkpoints + retry-with-rollback + preemption-safe exit).
 
@@ -363,11 +389,53 @@ def run_supervised(config: HeatConfig, checkpoint,
     overrides the policy-built async saver — the chaos harness injects
     throttled ones to widen the in-flight window; a caller-supplied
     checkpointer is drained at every barrier but NOT closed here.
+    ``interrupt`` (optional zero-argument callable) is the flag-only
+    interrupt hook: polled at exactly the chunk boundaries the signal
+    flag is, a truthy return (a short reason string, e.g. "deadline")
+    triggers the same checkpoint-flush-and-exit path a SIGTERM does,
+    with the reason in ``SupervisorResult.signal_name`` — how service
+    workers enforce per-job deadlines and cancellation without a
+    second signal vocabulary.
+
+    The run holds an exclusive lock on the checkpoint stem
+    (``utils.checkpoint.acquire_stem_lock``): two supervised runs
+    sharing a stem would prune and roll back to each other's
+    generations, so the second raises
+    :class:`utils.checkpoint.StemLockError` at startup instead. A
+    stale lock (the holder pid is dead — SIGKILL/OOM) is reclaimed
+    automatically; multi-process SPMD runs are one logical run and
+    process 0 holds the lock for all of them.
 
     Raises :class:`PermanentFailure` for non-retryable failures; the
     last retained checkpoint still holds the newest verified-good
     state.
     """
+    from parallel_heat_tpu.utils.telemetry import _process_info
+
+    release_stem = None
+    if _process_info()[0] == 0:
+        release_stem = ckpt.acquire_stem_lock(
+            ckpt.checkpoint_stem(checkpoint))
+    try:
+        return _run_supervised(
+            config, checkpoint, policy=policy, initial=initial,
+            start_step=start_step, faults=faults, say=say,
+            resume_extra_flags=resume_extra_flags, telemetry=telemetry,
+            checkpointer=checkpointer, interrupt=interrupt)
+    finally:
+        if release_stem is not None:
+            release_stem()
+
+
+def _run_supervised(config: HeatConfig, checkpoint,
+                    policy: Optional[SupervisorPolicy] = None,
+                    initial=None, start_step: int = 0,
+                    faults=None, say=None,
+                    resume_extra_flags: Tuple[str, ...] = (),
+                    telemetry=None, checkpointer=None,
+                    interrupt=None) -> SupervisorResult:
+    """The supervised loop proper; :func:`run_supervised` wraps it in
+    the stem lock."""
     config = config.validate()
     policy = (policy or SupervisorPolicy()).validate()
     say = say or (lambda *a: None)
@@ -425,7 +493,8 @@ def run_supervised(config: HeatConfig, checkpoint,
     trip_steps: list = []
     trip_windows: list = []
     last_path: Optional[str] = None
-    t0 = time.perf_counter()
+    clock = policy.clock  # injectable wall source (observation only)
+    t0 = clock()
 
     # Async saver: policy-built unless the caller injected one (the
     # chaos harness passes throttled checkpointers to widen the
@@ -453,7 +522,7 @@ def run_supervised(config: HeatConfig, checkpoint,
             guard_trip_steps=tuple(trip_steps),
             checkpoints_written=n_ckpt, last_checkpoint=last_path,
             resume_command=resume_cmd, signal_name=signame,
-            wall_s=time.perf_counter() - t0, progress_trips=progress)
+            wall_s=clock() - t0, progress_trips=progress)
 
     def emit(event, **fields):
         if telemetry is not None:
@@ -480,7 +549,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                               steps_done=done, retries=retries,
                               rollbacks=rollbacks, guard_trips=trips,
                               checkpoints_written=n_ckpt,
-                              wall_s=time.perf_counter() - t0)
+                              wall_s=clock() - t0)
         return PermanentFailure(diagnosis, kind=kind)
 
     def _committed(rec):
@@ -517,13 +586,13 @@ def run_supervised(config: HeatConfig, checkpoint,
             saver.submit(stem, grid, step_abs, ckpt_cfg,
                          on_done=_committed, protect=ckpt_protect)
             return
-        t_save = time.perf_counter()
+        t_save = clock()
         last_path = ckpt.save_generation(
             stem, grid, step_abs, ckpt_cfg, keep=policy.keep_checkpoints,
             layout=policy.layout, compress=policy.compress)
         n_ckpt += 1
         emit("checkpoint_save", step=step_abs, path=str(last_path),
-             wall_s=time.perf_counter() - t_save,
+             wall_s=clock() - t_save,
              kept=policy.keep_checkpoints, generation=n_ckpt)
         say(f"Supervisor: checkpoint at step {step_abs} -> {last_path}")
         return last_path
@@ -539,16 +608,19 @@ def run_supervised(config: HeatConfig, checkpoint,
         wait_s = saver.drain()
         emit("checkpoint_barrier", reason=reason, wait_s=wait_s)
 
-    def interrupted(cur, done, signum, already_saved):
-        # Flush-and-exit on SIGTERM/SIGINT. The flushed state must honor
-        # the retained-generations-are-good invariant: a signal landing
-        # between a corruption and its guard boundary must not persist
-        # garbage, so the flush itself is guard-verified (skipped — the
-        # previous generation stays newest — when non-finite; the async
-        # saver's commit gate re-verifies the gathered copy either way).
-        # Both barriers matter: a SIGTERM can land with a periodic save
-        # still in flight, and the resume command below must name a
-        # COMMITTED newest generation.
+    def interrupted(cur, done, why, already_saved):
+        # Flush-and-exit on SIGTERM/SIGINT (`why` an int signum) or on
+        # the caller's interrupt hook (`why` a reason string — service
+        # deadlines/cancellation ride the same path). The flushed state
+        # must honor the retained-generations-are-good invariant: a
+        # signal landing between a corruption and its guard boundary
+        # must not persist garbage, so the flush itself is
+        # guard-verified (skipped — the previous generation stays
+        # newest — when non-finite; the async saver's commit gate
+        # re-verifies the gathered copy either way). Both barriers
+        # matter: a SIGTERM can land with a periodic save still in
+        # flight, and the resume command below must name a COMMITTED
+        # newest generation.
         ckpt_barrier("interrupt")
         if not already_saved:
             if grid_all_finite(cur):
@@ -557,7 +629,8 @@ def run_supervised(config: HeatConfig, checkpoint,
             else:
                 say(f"Supervisor: state at step {done} is non-finite; "
                     f"keeping previous generation instead of flushing")
-        name = signal.Signals(signum).name
+        name = (signal.Signals(why).name if isinstance(why, int)
+                else str(why))
         cmd = _resume_command(ckpt_cfg, stem, total_abs, policy,
                               resume_extra_flags)
         say(f"Supervisor: caught {name}; newest checkpoint "
@@ -568,7 +641,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                               signal=name, retries=retries,
                               rollbacks=rollbacks, guard_trips=trips,
                               checkpoints_written=n_ckpt,
-                              wall_s=time.perf_counter() - t0)
+                              wall_s=clock() - t0)
         return _mk(None, done, True, signame=name, resume_cmd=cmd)
 
     done = start_step
@@ -578,6 +651,20 @@ def run_supervised(config: HeatConfig, checkpoint,
     # even for a fault in the very first chunk.
     state = _prepare_initial(run_base, initial)
     stop = _StopFlag()
+
+    def _stop_why():
+        # Preemption signals win, then the caller's flag-only interrupt
+        # hook (service deadlines/cancellation). Both are only ever
+        # observed here, at chunk boundaries — the hook must be cheap
+        # and must not raise (it is polled on the hot path).
+        if stop.signum is not None:
+            return stop.signum
+        if interrupt is not None:
+            why = interrupt()
+            if why:
+                return str(why)
+        return None
+
     final: Optional[HeatResult] = None
 
     drift_env = None
@@ -668,8 +755,9 @@ def run_supervised(config: HeatConfig, checkpoint,
                 while True:
                     if faults is not None:
                         faults.before_chunk()
-                    if stop.signum is not None:
-                        return interrupted(cur, done, stop.signum,
+                    why = _stop_why()
+                    if why is not None:
+                        return interrupted(cur, done, why,
                                            already_saved=False)
                     try:
                         res = next(stream)
@@ -776,11 +864,13 @@ def run_supervised(config: HeatConfig, checkpoint,
                     if res.converged:
                         final = res
                         break
-                    if stop.signum is not None:
-                        # Signal landed during this chunk: flush the
-                        # fresh (guard-verified above) state rather
-                        # than waiting for the pre-dispatch check.
-                        return interrupted(cur, done, stop.signum,
+                    why = _stop_why()
+                    if why is not None:
+                        # Signal/interrupt landed during this chunk:
+                        # flush the fresh (guard-verified above) state
+                        # rather than waiting for the pre-dispatch
+                        # check.
+                        return interrupted(cur, done, why,
                                            already_saved=ckpt_due)
                 if final is None:
                     # Stream exhausted: complete (done == total_abs), or
@@ -860,19 +950,19 @@ def run_supervised(config: HeatConfig, checkpoint,
                 say(f"Supervisor: {kind}; retry {retries}/"
                     f"{policy.max_retries} after {delay:g}s backoff")
                 if delay > 0:
-                    time.sleep(delay)
+                    policy.sleep_fn(delay)
                 src = ckpt.latest_checkpoint(stem)
                 if src is None:  # pragma: no cover (gen0 always exists)
                     raise fail(
                         f"{kind} — and no checkpoint generation of "
                         f"{stem!r} survives to roll back to.",
                         drained=True) from None
-                t_load = time.perf_counter()
+                t_load = clock()
                 grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
                 rollbacks += 1
                 state, done = grid0, int(step0)
                 emit("rollback", step=done, path=str(src),
-                     load_wall_s=time.perf_counter() - t_load)
+                     load_wall_s=clock() - t_load)
                 say(f"Supervisor: rolled back to {src} (step {done})")
                 continue
         # Completion barrier: the final retained generation must be
@@ -888,7 +978,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                               retries=retries, rollbacks=rollbacks,
                               guard_trips=trips,
                               checkpoints_written=n_ckpt,
-                              wall_s=time.perf_counter() - t0)
+                              wall_s=clock() - t0)
         if final is None:
             # config.steps == 0 (or resume already at/past the target):
             # nothing ran; generation zero was still written.

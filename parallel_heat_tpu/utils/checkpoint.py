@@ -607,6 +607,138 @@ def latest_checkpoint(path):
 
 
 # ---------------------------------------------------------------------------
+# Stem interlock (one writer per checkpoint generation family)
+# ---------------------------------------------------------------------------
+#
+# save_generation's pid-unique temps already make concurrent WRITES
+# crash-safe, but two supervised runs sharing one stem would still race
+# DISCOVERY: each would prune the other's generations and roll back to
+# snapshots from a different trajectory. The stem lock makes that an
+# actionable startup error instead — one lockfile per stem, held for
+# the life of the supervised run, stale locks (dead pid) reclaimed so
+# a SIGKILLed run never wedges its own resume.
+
+
+class StemLockError(RuntimeError):
+    """Another live run holds this checkpoint stem. The message names
+    the holder (pid, started-at, lockfile path) and the three ways out:
+    wait for it, pick a different stem, or remove the lockfile if the
+    holder is truly gone (e.g. alive-pid reuse on another container)."""
+
+
+def _stem_lock_path(stem: str) -> str:
+    return checkpoint_stem(stem) + ".lock"
+
+
+def _stem_lock_mutex(path):
+    """flock-held critical section for lock acquisition/reclaim. The
+    sidecar mutex file is NEVER unlinked, so there is no TOCTOU on the
+    mutex itself, and the kernel drops the flock on process death —
+    two racing starters that both judge a lock stale serialize here
+    instead of one unlinking the other's freshly-taken lock. Held only
+    across the acquire, never for the run. Returns a release callable
+    (no-op where flock is unavailable — best effort off-POSIX)."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover — non-POSIX fallback
+        return lambda: None
+    fd = os.open(path + ".mutex", os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+    except OSError:  # pragma: no cover — e.g. NFS without lockd
+        os.close(fd)
+        return lambda: None
+
+    def release(_fd=fd):
+        try:
+            import fcntl as _f
+
+            _f.flock(_fd, _f.LOCK_UN)
+        finally:
+            os.close(_fd)
+
+    return release
+
+
+def acquire_stem_lock(stem):
+    """Take the exclusive writer lock on ``stem``'s generation family;
+    returns a zero-argument release callable. O_CREAT|O_EXCL makes the
+    take atomic; a lockfile whose recorded pid no longer exists is
+    stale (the holder was SIGKILLed — exactly the crash the supervisor
+    exists to survive) and is reclaimed, with the reclaim serialized
+    by an flock sidecar so two racing starters cannot both "reclaim"
+    and end up co-holding the stem. Raises :class:`StemLockError`
+    when a LIVE process holds it."""
+    path = _stem_lock_path(stem)
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    unlock = _stem_lock_mutex(path)
+    try:
+        return _acquire_stem_lock_locked(path)
+    finally:
+        unlock()
+
+
+def _acquire_stem_lock_locked(path):
+    for _ in range(2):  # second pass: retake after reclaiming a stale lock
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                holder = int(doc.get("pid", -1))
+            except (OSError, ValueError):
+                holder = -1  # torn/foreign lockfile: treat as stale
+            alive = False
+            if holder > 0:
+                try:
+                    os.kill(holder, 0)
+                    alive = True
+                except ProcessLookupError:
+                    alive = False
+                except OSError:
+                    alive = True  # EPERM: exists but not ours
+            if alive:
+                # Our own pid counts as live too: two supervised runs
+                # in ONE process (threads) sharing a stem are the same
+                # discovery race as two processes.
+                raise StemLockError(
+                    f"checkpoint stem {path[:-len('.lock')]!r} is held "
+                    f"by a live supervised run (pid {holder}, started "
+                    f"{doc.get('t_wall', '?')}) — two runs sharing a "
+                    f"stem would prune and roll back to each other's "
+                    f"generations. Wait for it, use a different "
+                    f"--checkpoint stem, or remove {path!r} if that "
+                    f"run is truly gone.") from None
+            # Stale (dead holder / our own pid after exec / torn file):
+            # reclaim and retake atomically on the next pass.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        try:
+            os.write(fd, json.dumps(
+                {"pid": os.getpid(), "t_wall": time.time()}).encode())
+        finally:
+            os.close(fd)
+
+        def release(_path=path):
+            try:
+                os.unlink(_path)
+            except OSError:
+                pass
+
+        return release
+    raise StemLockError(  # pragma: no cover — needs a perfectly-timed
+        # re-take race; the message still names the remedy
+        f"could not acquire checkpoint stem lock {path!r} (another "
+        f"writer kept re-taking it); use a different stem")
+
+
+# ---------------------------------------------------------------------------
 # Asynchronous checkpointing (the supervisor's overlap path)
 # ---------------------------------------------------------------------------
 

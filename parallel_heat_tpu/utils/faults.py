@@ -16,7 +16,12 @@ reproducible point:
   absorb);
 - **preemption**: a real OS signal (default ``SIGTERM``) delivered to
   this process before dispatching chunk ordinal ``n`` (models the
-  maintenance-event kill; drives the flush-checkpoint-and-exit path).
+  maintenance-event kill; drives the flush-checkpoint-and-exit path);
+- **process death**: ``kill_worker_at_chunk`` SIGKILLs this process —
+  uncatchable, no flush — before dispatching chunk ordinal ``n``
+  (models the OOM kill / hard preemption; run inside a service WORKER
+  so the daemon's orphan-detect/requeue/resume path faces a true
+  corpse). Mutually exclusive with every in-process fault kind.
 
 Faults fire at supervisor hook points — ``before_chunk`` pre-dispatch,
 ``corrupt`` on each chunk's output — never inside compiled programs,
@@ -80,6 +85,15 @@ class FaultPlan:
     signal_at_chunk: Optional[int] = None
     signum: int = int(_signal.SIGTERM)
 
+    # SIGKILL this process before dispatching this chunk ordinal — REAL
+    # process death (uncatchable, no cleanup, no flush), the thing an
+    # OOM kill or a preemption hard-stop actually does. The service
+    # chaos cells run this inside a child WORKER process so the daemon
+    # sees a true mid-job corpse: orphan detection, requeue, and
+    # checkpoint-lineage resume are exercised against genuine process
+    # death rather than a polite in-process exception.
+    kill_worker_at_chunk: Optional[int] = None
+
     def __post_init__(self):
         if self.nan_at_step is not None and self.spike_at_step is not None:
             # The two corruptions share the one-shot firing state and
@@ -89,6 +103,21 @@ class FaultPlan:
             raise ValueError(
                 "FaultPlan: set nan_at_step or spike_at_step, not both "
                 "(they share the corruption slot; use two plans/runs)")
+        if self.kill_worker_at_chunk is not None and (
+                self.nan_at_step is not None
+                or self.spike_at_step is not None
+                or self.transient_on_chunks
+                or self.signal_at_chunk is not None):
+            # SIGKILL ends the process: any in-process fault scheduled
+            # alongside it either fires first (masking the death the
+            # cell certifies) or never fires at all (certifying a
+            # detection that never ran). Loud, like nan+spike.
+            raise ValueError(
+                "FaultPlan: kill_worker_at_chunk models true process "
+                "death (SIGKILL) and cannot be combined with in-process "
+                "fault kinds (nan_at_step/spike_at_step/"
+                "transient_on_chunks/signal_at_chunk) — use separate "
+                "plans/runs")
 
     # -- firing state (not part of the schedule) -------------------------
     _chunks_seen: int = field(default=0, repr=False)
@@ -102,6 +131,12 @@ class FaultPlan:
         per the plan."""
         i = self._chunks_seen
         self._chunks_seen += 1
+        if self.kill_worker_at_chunk == i:
+            # No fired-flag: SIGKILL is uncatchable and ends the
+            # process here — a retried schedule only re-reaches this
+            # ordinal in a NEW process (the service re-dispatch), where
+            # the plan is attempt-gated by the caller.
+            os.kill(os.getpid(), int(_signal.SIGKILL))
         if self.signal_at_chunk == i and not self._signal_fired:
             self._signal_fired = True
             # A real signal through the real delivery path: the

@@ -1334,3 +1334,29 @@ def test_cli_works_from_any_cwd(tmp_path):
                    cwd=str(tmp_path))
     assert out.returncode == 0, out.stdout + out.stderr
     assert "heatlint.baseline.json" in out.stdout  # repo ledger found
+
+
+# ---------------------------------------------------------------------------
+# Service-layer coverage (ISSUE 8): heatd rides the HL2xx gate
+# ---------------------------------------------------------------------------
+
+def test_ast_scan_covers_service_package():
+    """`parallel_heat_tpu/service/` must be inside the default AST
+    scan scope — the queue daemon's lock/journal discipline (notably
+    HL204 on thread-shared state) is gated, not just reviewed — and
+    the tree must be clean with the baseline ledger empty."""
+    from parallel_heat_tpu.analysis.astlint import (
+        REPO_ROOT,
+        _iter_py_files,
+        default_scan_paths,
+        lint_paths,
+    )
+
+    scanned = set(_iter_py_files(default_scan_paths()))
+    svc = os.path.join(REPO_ROOT, "parallel_heat_tpu", "service")
+    for mod in ("store.py", "daemon.py", "worker.py", "admission.py",
+                "client.py", "cli.py"):
+        assert os.path.join(svc, mod) in scanned, mod
+    assert os.path.join(REPO_ROOT, "tools", "heatq.py") in scanned
+    findings = lint_paths([svc])
+    assert [f for f in findings if f.severity == "error"] == []

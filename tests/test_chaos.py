@@ -20,6 +20,7 @@ contract, from ISSUE 2:
 
 import os
 import signal
+import sys
 import warnings
 
 import numpy as np
@@ -566,3 +567,402 @@ def test_guard_env_does_not_change_compiled_programs():
     misses_before = solver._build_runner.cache_info().misses
     solve(cfg.replace(guard_interval=5))
     assert solver._build_runner.cache_info().misses == misses_before
+
+
+# ---------------------------------------------------------------------------
+# Injectable backoff clock (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_pinned_via_sleep_fn(tmp_path):
+    # The bounded-exponential retry schedule, deterministic: sleep_fn
+    # records every backoff delay instead of sleeping wall-clock —
+    # min(backoff_max_s, backoff_base_s * 2**(retry-1)).
+    delays = []
+    policy = _policy(backoff_base_s=0.5, backoff_max_s=1.0,
+                     max_retries=3, sleep_fn=delays.append)
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(HeatConfig(steps=60, **_BASE),
+                          tmp_path / "ck", policy=policy,
+                          faults=FaultPlan(transient_on_chunks=(0, 1,
+                                                                2)))
+    assert sres.retries == 3
+    assert delays == [0.5, 1.0, 1.0]  # 2**2*0.5 clamped to the bound
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+def test_backoff_zero_base_never_calls_sleep(tmp_path):
+    # delay == 0 skips the sleep call entirely (tests and the chaos
+    # matrix run with base 0 — they must not depend on sleep_fn(0)).
+    delays = []
+    sres = run_supervised(
+        HeatConfig(steps=60, **_BASE), tmp_path / "ck",
+        policy=_policy(sleep_fn=delays.append),
+        faults=FaultPlan(transient_on_chunks=(1,)))
+    assert sres.retries == 1 and delays == []
+
+
+def test_policy_clock_injectable_for_wall_bookkeeping(tmp_path):
+    # `clock` feeds wall_s bookkeeping only (observation, never
+    # numerics): a fake clock yields exact wall arithmetic while the
+    # grid stays bitwise the real-clock run's.
+    t = {"now": 100.0}
+
+    def clock():
+        t["now"] += 0.125
+        return t["now"]
+
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    sres = run_supervised(HeatConfig(steps=60, **_BASE),
+                          tmp_path / "ck",
+                          policy=_policy(clock=clock))
+    assert sres.wall_s > 0 and sres.wall_s % 0.125 == 0
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint stem interlock (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+def test_stem_lock_refuses_concurrent_supervised_runs(tmp_path):
+    from parallel_heat_tpu.utils.checkpoint import (
+        StemLockError,
+        acquire_stem_lock,
+        checkpoint_stem,
+    )
+
+    stem = tmp_path / "ck"
+    release = acquire_stem_lock(checkpoint_stem(stem))
+    # A second supervised run on the same stem fails actionably at
+    # startup — before it can prune or roll back to the holder's
+    # generations.
+    with pytest.raises(StemLockError) as ei:
+        run_supervised(HeatConfig(steps=20, **_BASE), stem,
+                       policy=_policy())
+    msg = str(ei.value)
+    assert str(os.getpid()) in msg  # names the live holder
+    assert "different" in msg and ".lock" in msg  # names the way out
+    assert latest_checkpoint(stem) is None  # wrote nothing
+    release()
+    sres = run_supervised(HeatConfig(steps=20, **_BASE), stem,
+                          policy=_policy())
+    assert sres.steps_done == 20
+
+
+def test_stem_lock_stale_holder_reclaimed(tmp_path):
+    import json as _json
+
+    from parallel_heat_tpu.utils.checkpoint import _stem_lock_path
+
+    stem = tmp_path / "ck"
+    os.makedirs(tmp_path, exist_ok=True)
+    # A SIGKILLed predecessor left its lockfile; its pid is dead.
+    with open(_stem_lock_path(str(stem)), "w") as f:
+        _json.dump({"pid": 2 ** 22 + 1, "t_wall": 0.0}, f)
+    sres = run_supervised(HeatConfig(steps=20, **_BASE), stem,
+                          policy=_policy())
+    assert sres.steps_done == 20  # reclaimed, ran, and...
+    assert not os.path.exists(_stem_lock_path(str(stem)))  # released
+
+
+def test_stem_lock_released_after_failure(tmp_path):
+    from parallel_heat_tpu.utils.checkpoint import _stem_lock_path
+
+    stem = tmp_path / "ck"
+    with pytest.raises(PermanentFailure):
+        run_supervised(HeatConfig(steps=60, **_BASE), stem,
+                       policy=_policy(max_retries=1),
+                       faults=FaultPlan(nan_at_step=35, recurring=True))
+    # the lock must not outlive the run — a crash-halt that wedged the
+    # stem would block its own `--resume auto`
+    assert not os.path.exists(_stem_lock_path(str(stem)))
+    sres = run_supervised(HeatConfig(steps=20, **_BASE), stem,
+                          policy=_policy())
+    assert sres.steps_done > 0
+
+
+def test_stem_lock_torn_lockfile_treated_stale(tmp_path):
+    from parallel_heat_tpu.utils.checkpoint import (
+        _stem_lock_path,
+        acquire_stem_lock,
+        checkpoint_stem,
+    )
+
+    stem = str(tmp_path / "ck")
+    with open(_stem_lock_path(stem), "w") as f:
+        f.write('{"pid": 12')  # writer died mid-write
+    release = acquire_stem_lock(checkpoint_stem(stem))
+    release()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan.kill_worker_at_chunk (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+def test_faultplan_kill_worker_rejects_in_process_kinds():
+    # SIGKILL ends the process: combining it with any in-process fault
+    # either masks the death or certifies a detection that never ran —
+    # loud, like nan+spike.
+    FaultPlan(kill_worker_at_chunk=2)  # alone: fine
+    for bad in (dict(nan_at_step=3), dict(spike_at_step=3),
+                dict(transient_on_chunks=(1,)),
+                dict(signal_at_chunk=1)):
+        with pytest.raises(ValueError, match="kill_worker_at_chunk"):
+            FaultPlan(kill_worker_at_chunk=2, **bad)
+
+
+# ---------------------------------------------------------------------------
+# Service-level chaos: the heatd durability contract (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def _service_daemon(root, **kw):
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    kw.setdefault("slots", 1)
+    kw.setdefault("worker_heartbeat_s", 0.25)
+    kw.setdefault("heartbeat_timeout_s", 1.0)
+    kw.setdefault("requeue_backoff_base_s", 0.0)
+    kw.setdefault("worker_env", {"JAX_PLATFORMS": "cpu"})
+    return Heatd(HeatdConfig(root=str(root), **kw))
+
+
+def _service_spec(job_id, **kw):
+    from parallel_heat_tpu.service.store import JobSpec
+
+    kw.setdefault("checkpoint_every", 10)
+    kw.setdefault("guard_interval", 5)
+    kw.setdefault("backoff_base_s", 0.0)
+    return JobSpec(job_id=job_id,
+                   config={"nx": 16, "ny": 16, "steps": 60,
+                           "backend": "jnp"}, **kw)
+
+
+def _drive_daemon(daemon, done, timeout_s=240.0):
+    import time as _time
+
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < timeout_s:
+        daemon.step()
+        jobs, anomalies = daemon.store.replay()
+        if done(jobs):
+            return jobs, anomalies
+        _time.sleep(0.03)
+    raise TimeoutError("daemon did not reach the expected state")
+
+
+def test_service_worker_sigkill_orphaned_requeued_bitwise(tmp_path):
+    # THE durability proof: a real worker subprocess SIGKILLs itself
+    # mid-job (no flush, no record). The job must be detected orphaned
+    # within one heartbeat timeout, requeued with its checkpoint
+    # lineage intact, and the re-dispatched attempt must complete with
+    # a grid bitwise identical to an uninterrupted run.
+    import time as _time
+
+    root = tmp_path / "q"
+    hb_timeout = 1.0
+    d1 = _service_daemon(root, heartbeat_timeout_s=hb_timeout)
+    d1.store.spool_submit(_service_spec(
+        "j1", faults={"kill_worker_at_chunk": 4}, faults_on_attempt=1))
+    jobs, _ = _drive_daemon(d1, lambda j: "j1" in j
+                            and j["j1"].state == "running")
+    # Reap the corpse via d1's handle (init's role for a real daemon's
+    # orphans) without journaling anything — detection must come from
+    # the restarted daemon's heartbeat/pid judgment alone.
+    handle = d1._procs["j1"]
+    t0 = _time.monotonic()
+    while handle.poll() is None and _time.monotonic() - t0 < 180:
+        _time.sleep(0.05)
+    assert handle.poll() == -signal.SIGKILL  # true process death
+    d1.store.close()
+
+    d2 = _service_daemon(root, heartbeat_timeout_s=hb_timeout)
+    jobs, anomalies = _drive_daemon(d2, lambda j: j["j1"].terminal)
+    assert anomalies == []  # no double terminal, nothing lost
+    assert jobs["j1"].state == "completed"
+    assert jobs["j1"].attempts == 2
+    events, _, _ = d2.store.read_journal()
+    orphaned = [e for e in events if e.get("event") == "orphaned"]
+    assert len(orphaned) == 1
+    # detected within one heartbeat timeout of the last proven beat
+    hb = d2.store.read_worker_hb(orphaned[0]["worker"])
+    lag = orphaned[0]["t_wall"] - hb["t_wall"]
+    assert lag <= hb_timeout + 1.0  # + scheduling slack
+    assert any(e.get("event") == "requeued" for e in events)
+    # bitwise: the resumed trajectory IS the uninterrupted one
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint as _latest,
+        load_checkpoint as _load,
+    )
+
+    cfg = HeatConfig(steps=60, **_BASE)
+    grid, step, _ = _load(_latest(d2.store.checkpoint_stem("j1")), cfg)
+    assert step == 60
+    np.testing.assert_array_equal(np.asarray(grid),
+                                  solve(cfg).to_numpy())
+    d2.store.close()
+
+
+def test_service_daemon_sigkill_between_accept_and_dispatch(tmp_path):
+    # The daemon itself dies (SIGKILL — no drain, no cleanup) right
+    # after journaling `accepted`, before dispatch and before the
+    # spool unlink. Restart must recover the job from the journal
+    # alone: exactly one terminal state, no loss, no re-accept.
+    import subprocess as _sp
+
+    from parallel_heat_tpu.service import client as svc_client
+
+    root = str(tmp_path / "q")
+    import parallel_heat_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    daemon = _sp.Popen(
+        [sys.executable, "-m", "parallel_heat_tpu.cli", "serve",
+         "--queue", root, "--slots", "1", "--poll-interval", "0.1",
+         "--chaos-kill-after-accept", "1"],
+        env=env, stdout=_sp.DEVNULL, stderr=_sp.STDOUT)
+    try:
+        v = svc_client.submit(root, {"nx": 16, "ny": 16, "steps": 60,
+                                     "backend": "jnp"},
+                              job_id="j1", checkpoint_every=10,
+                              backoff_base_s=0.0, accept_timeout_s=120)
+        assert v["accepted"] is True
+        daemon.wait(timeout=60)
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+    assert daemon.returncode == -signal.SIGKILL
+
+    d2 = _service_daemon(root)
+    jobs, anomalies = _drive_daemon(d2, lambda j: j["j1"].terminal)
+    assert anomalies == []
+    assert jobs["j1"].state == "completed"
+    events, _, _ = d2.store.read_journal()
+    accepts = [e for e in events if e.get("event") == "accepted"]
+    assert len(accepts) == 1  # idempotent handshake, no re-accept
+    assert d2.store.iter_spool() == []
+    d2.store.close()
+
+
+def test_service_overload_rejects_never_drops(tmp_path):
+    # Overload burst past the admission gate: rejected with a
+    # retry-after hint, never accepted-then-dropped; the admitted jobs
+    # complete bitwise through real (inline) execution.
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint as _latest,
+        load_checkpoint as _load,
+    )
+
+    root = str(tmp_path / "q")
+
+    class DeferredInline:
+        # Stays 'running' for several polls before executing —
+        # deterministic queue occupancy, so the burst actually finds
+        # the gate closed (instant inline completion would drain it).
+        def __init__(self, run, defer=10):
+            self._run = run
+            self._defer = defer
+            self._polls = 0
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            self._polls += 1
+            if self._polls < self._defer:
+                return None
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return DeferredInline(lambda: svc_worker.execute_job(
+            root, job_id, worker_id, attempt, deadline_t=deadline_t))
+
+    d = _service_daemon(root, launcher=launcher, max_queue_depth=2,
+                        worker_env=None)
+    for i in range(5):
+        d.store.spool_submit(_service_spec(f"j{i}"))
+        d.step()
+    jobs, _ = d.store.replay()
+    rejected = {j for j, v in jobs.items() if v.state == "rejected"}
+    admitted = [j for j, v in jobs.items() if v.state != "rejected"]
+    assert len(rejected) == 3 and len(admitted) == 2
+    assert all(jobs[j].retry_after_s > 0 for j in rejected)
+    jobs, anomalies = _drive_daemon(
+        d, lambda j: all(j[a].terminal for a in admitted))
+    assert anomalies == []
+    assert all(jobs[a].state == "completed" for a in admitted)
+    # a rejected job never acquires execution state
+    events, _, _ = d.store.read_journal()
+    assert not any(e.get("job_id") in rejected
+                   and e.get("event") != "rejected" for e in events)
+    cfg = HeatConfig(steps=60, **_BASE)
+    clean = solve(cfg).to_numpy()
+    for a in admitted:
+        grid, _, _ = _load(_latest(d.store.checkpoint_stem(a)), cfg)
+        np.testing.assert_array_equal(np.asarray(grid), clean)
+    d.store.close()
+
+
+def test_service_deadline_interrupts_through_supervisor(tmp_path):
+    # A deadline that expires mid-run interrupts through the
+    # supervisor's flag-only path: checkpoint flushed, preempted
+    # record with reason "deadline", journaled deadline_expired —
+    # with the partial progress durable.
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint as _latest,
+    )
+
+    root = str(tmp_path / "q")
+
+    class InlineHandle:
+        def __init__(self, run):
+            self._run = run
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return InlineHandle(lambda: svc_worker.execute_job(
+            root, job_id, worker_id, attempt, deadline_t=deadline_t))
+
+    d = _service_daemon(root, launcher=launcher, worker_env=None)
+    # deadline passes before the worker's first boundary poll: the
+    # supervisor flushes generation 0+ and exits preempted(deadline)
+    d.store.spool_submit(_service_spec("j1", deadline_s=0.05))
+    import time as _time
+
+    _time.sleep(0.1)
+    jobs, anomalies = _drive_daemon(d, lambda j: "j1" in j
+                                    and j["j1"].terminal)
+    assert anomalies == []
+    assert jobs["j1"].state == "deadline_expired"
+    rec = d.store.read_result("j1", 1)
+    if rec is not None:  # expired while running (not while queued)
+        assert rec["outcome"] == "preempted"
+        assert rec["reason"] == "deadline"
+    # the flushed checkpoint lineage is durable either way
+    assert _latest(d.store.checkpoint_stem("j1")) is not None or \
+        rec is None
+    d.store.close()

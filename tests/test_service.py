@@ -1,0 +1,1049 @@
+"""The heatd service layer: durable store, admission, scheduler.
+
+Everything here is fast and deterministic — the daemon is driven
+step-by-step on injected clocks with fake worker handles (the journal
+and the scheduling decisions are what's under test; real process death
+and real subprocess workers live in ``tests/test_chaos.py`` and the
+``tools/chaos_matrix.py`` service cells). The contract pinned
+(SEMANTICS.md "Job durability"): an ACCEPTED job is never silently
+lost — it reaches exactly one terminal state or sits in the journal
+with its resume state; rejections are loud, first-class, and carry a
+retry-after hint.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.service.admission import (
+    admission_verdict,
+    estimate_job_hbm_bytes,
+)
+from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+from parallel_heat_tpu.service.store import (
+    JobSpec,
+    JobStore,
+    read_journal_file,
+    reduce_journal,
+)
+from parallel_heat_tpu.service import client
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Test doubles
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    """Deterministic daemon time source."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeHandle:
+    """Popen-shaped worker handle whose exit the test scripts."""
+
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = os.getpid()
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+
+class ScriptedLauncher:
+    """Collects dispatches; each returns a :class:`FakeHandle` the
+    test later finishes by setting ``rc`` + writing a result record."""
+
+    def __init__(self):
+        self.dispatches = []
+
+    def __call__(self, job_id, worker_id, attempt, deadline_t):
+        h = FakeHandle()
+        self.dispatches.append(
+            {"job_id": job_id, "worker_id": worker_id,
+             "attempt": attempt, "deadline_t": deadline_t,
+             "handle": h})
+        return h
+
+    def last(self, job_id):
+        for d in reversed(self.dispatches):
+            if d["job_id"] == job_id:
+                return d
+        raise KeyError(job_id)
+
+
+def _daemon(root, clock=None, launcher=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("requeue_backoff_base_s", 0.0)
+    cfg = HeatdConfig(root=str(root),
+                      clock=clock or FakeClock(),
+                      sleep_fn=lambda s: None,
+                      launcher=launcher or ScriptedLauncher(), **kw)
+    return Heatd(cfg)
+
+
+def _spec(job_id, nx=16, steps=60, **kw):
+    return JobSpec(job_id=job_id,
+                   config={"nx": nx, "ny": nx, "steps": steps,
+                           "backend": "jnp"}, **kw)
+
+
+def _finish(store, d, outcome, rc=0, **fields):
+    """Land a worker outcome: rename-commit the result record, then
+    let the next reconcile observe the exit."""
+    doc = {"outcome": outcome, "worker": d["worker_id"],
+           "attempt": d["attempt"], "job_id": d["job_id"]}
+    doc.update(fields)
+    store.write_result(d["job_id"], d["attempt"], doc)
+    d["handle"].rc = rc
+
+
+def _events(store, job_id=None, event=None):
+    evs, _, _ = store.read_journal()
+    return [e for e in evs
+            if (job_id is None or e.get("job_id") == job_id)
+            and (event is None or e.get("event") == event)]
+
+
+# ---------------------------------------------------------------------------
+# Journal + reducer (the durability substrate)
+# ---------------------------------------------------------------------------
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    store = JobStore(tmp_path / "q")
+    store.journal.append("accepted", job_id="a", hbm_bytes=7)
+    store.journal.append("dispatched", job_id="a", worker="w1",
+                         attempt=1)
+    store.journal.append("completed", job_id="a", steps_done=60)
+    jobs, anomalies = store.replay()
+    assert anomalies == []
+    v = jobs["a"]
+    assert v.state == "completed" and v.terminal
+    assert v.attempts == 1 and v.worker == "w1"
+    assert v.hbm_bytes == 7 and v.steps_done == 60
+    store.close()
+
+
+def test_journal_torn_tail_skipped(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    lines = [json.dumps({"event": "accepted", "job_id": "a"}),
+             json.dumps({"event": "completed", "job_id": "a"})]
+    path.write_text("\n".join(lines) + "\n"
+                    + '{"event": "dispatched", "job_id"')  # torn append
+    events, bad, torn = read_journal_file(path)
+    assert torn is True and bad == 0
+    assert [e["event"] for e in events] == ["accepted", "completed"]
+    jobs, anomalies = reduce_journal(events)
+    assert jobs["a"].state == "completed" and anomalies == []
+
+
+def test_journal_interior_garbage_counted_not_fatal(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    path.write_text(json.dumps({"event": "accepted", "job_id": "a"})
+                    + "\nnot json at all\n"
+                    + json.dumps({"event": "completed", "job_id": "a"})
+                    + "\n")
+    events, bad, torn = read_journal_file(path)
+    assert bad == 1 and torn is False and len(events) == 2
+
+
+def test_reducer_terminal_state_is_absorbing():
+    events = [{"event": "accepted", "job_id": "a", "t_wall": 1.0},
+              {"event": "dispatched", "job_id": "a", "worker": "w1",
+               "attempt": 1, "t_wall": 2.0},
+              {"event": "completed", "job_id": "a", "t_wall": 3.0},
+              {"event": "completed", "job_id": "a", "t_wall": 4.0}]
+    jobs, anomalies = reduce_journal(events)
+    assert jobs["a"].state == "completed"
+    assert jobs["a"].terminal_t == 3.0  # the first terminal wins
+    assert any("double terminal" in a for a in anomalies)
+
+
+def test_reducer_dispatch_after_terminal_is_anomalous():
+    events = [{"event": "accepted", "job_id": "a", "t_wall": 1.0},
+              {"event": "cancelled", "job_id": "a", "t_wall": 2.0},
+              {"event": "dispatched", "job_id": "a", "worker": "w9",
+               "attempt": 1, "t_wall": 3.0}]
+    jobs, anomalies = reduce_journal(events)
+    assert jobs["a"].state == "cancelled"
+    assert anomalies
+
+
+def test_reducer_missing_accepted_is_anomalous():
+    jobs, anomalies = reduce_journal(
+        [{"event": "completed", "job_id": "ghost", "t_wall": 1.0}])
+    assert "ghost" in jobs
+    assert any("missing" in a for a in anomalies)
+
+
+def test_reducer_ignores_foreign_and_daemon_lines():
+    events = [{"event": "daemon_start", "pid": 1, "t_wall": 0.0},
+              {"event": "accepted", "job_id": "a", "t_wall": 1.0},
+              {"not_an_event": True},
+              {"event": "totally_unknown", "job_id": "a"}]
+    jobs, anomalies = reduce_journal(events)
+    assert jobs["a"].state == "queued" and anomalies == []
+
+
+def test_jobspec_roundtrip_ignores_unknown_fields():
+    spec = _spec("j1", deadline_s=5.0, max_retries=7)
+    doc = json.loads(spec.to_json())
+    doc["from_the_future"] = {"x": 1}
+    back = JobSpec.from_json(json.dumps(doc))
+    assert back == spec
+
+
+def test_atomic_record_temp_invisible_to_discovery(tmp_path):
+    store = JobStore(tmp_path / "q")
+    # A writer died mid-write: its dotted temp must not be discovered.
+    spool = os.path.join(str(tmp_path / "q"), "spool")
+    with open(os.path.join(spool, ".tmp-999-torn.json"), "w") as f:
+        f.write('{"job_id": "torn"')
+    store.spool_submit(_spec("real"))
+    assert store.iter_spool() == ["real"]
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+def test_estimate_hbm_scales_with_grid_and_dtype():
+    b2d = estimate_job_hbm_bytes({"nx": 100, "ny": 50,
+                                  "dtype": "float32"})
+    assert b2d == 100 * 50 * 4 * 3
+    b3d = estimate_job_hbm_bytes({"nx": 10, "ny": 10, "nz": 10,
+                                  "dtype": "bfloat16"})
+    assert b3d == 1000 * 2 * 3
+
+
+def test_admission_depth_gate():
+    ok, reason, retry, _ = admission_verdict(
+        {"nx": 16, "ny": 16}, active_jobs=4, active_hbm_bytes=0,
+        max_queue_depth=4, hbm_budget_bytes=None,
+        retry_after_base_s=2.0, slots=2)
+    assert not ok and "queue depth" in reason and retry > 0
+
+
+def test_admission_hbm_gate():
+    est = estimate_job_hbm_bytes({"nx": 256, "ny": 256})
+    ok, reason, retry, got_est = admission_verdict(
+        {"nx": 256, "ny": 256}, active_jobs=1,
+        active_hbm_bytes=100, max_queue_depth=16,
+        hbm_budget_bytes=est + 50, retry_after_base_s=1.0, slots=1)
+    assert not ok and "HBM" in reason and got_est == est
+
+
+def test_admission_draining_rejects():
+    ok, reason, retry, _ = admission_verdict(
+        {"nx": 16, "ny": 16}, 0, 0, 16, None, 1.0, 2, draining=True)
+    assert not ok and "draining" in reason and retry > 0
+
+
+def test_admission_retry_after_scales_with_backlog():
+    def retry(active):
+        return admission_verdict({"nx": 16, "ny": 16}, active, 0,
+                                 1, None, 2.0, slots=2)[2]
+    assert retry(8) > retry(2) > 0
+
+
+def test_admission_accepts_within_budget():
+    ok, reason, retry, est = admission_verdict(
+        {"nx": 16, "ny": 16}, 0, 0, 16, 2**30, 1.0, 2)
+    assert ok and reason is None and retry == 0.0 and est > 0
+
+
+# ---------------------------------------------------------------------------
+# Daemon scheduling (fake clock + scripted workers)
+# ---------------------------------------------------------------------------
+
+def test_accept_dispatch_complete_lifecycle(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    jobs, _ = d.store.replay()
+    assert jobs["j1"].state == "running" and jobs["j1"].attempts == 1
+    assert d.store.iter_spool() == []  # spool drained post-accept
+    assert d.store.load_spec("j1").job_id == "j1"  # durable record
+    _finish(d.store, launcher.last("j1"), "completed", steps_done=60)
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["j1"].state == "completed" and anomalies == []
+    # exactly one of each lifecycle line
+    for ev in ("accepted", "dispatched", "completed"):
+        assert len(_events(d.store, "j1", ev)) == 1, ev
+    d.store.close()
+
+
+def test_admission_handshake_idempotent_after_crash(tmp_path):
+    # Crash window: journal says accepted but the spool entry
+    # survived (daemon died before the unlink). The restarted daemon
+    # must finish the handshake without a second accepted line.
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    d.store.spool_submit(_spec("j1"))  # resurrect the spool copy
+    d2 = _daemon(tmp_path / "q", launcher=launcher)
+    d2.step()
+    assert len(_events(d2.store, "j1", "accepted")) == 1
+    assert d2.store.iter_spool() == []
+    _, anomalies = d2.store.replay()
+    assert anomalies == []
+    d.store.close()
+    d2.store.close()
+
+
+def test_reject_past_queue_depth_with_retry_after(tmp_path):
+    d = _daemon(tmp_path / "q", max_queue_depth=1)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    d.store.spool_submit(_spec("j2"))
+    d.step()
+    jobs, _ = d.store.replay()
+    assert jobs["j2"].state == "rejected"
+    assert jobs["j2"].retry_after_s > 0
+    assert "queue depth" in jobs["j2"].reason
+    # a rejected job never acquires execution state
+    assert _events(d.store, "j2", "dispatched") == []
+    d.store.close()
+
+
+def test_failfast_kind_quarantines_immediately(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher, quarantine_after=3)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    _finish(d.store, launcher.last("j1"), "permanent_failure", rc=4,
+            kind="unstable", diagnosis="eps too large")
+    d.step()
+    jobs, _ = d.store.replay()
+    assert jobs["j1"].state == "quarantined"
+    assert jobs["j1"].kind == "unstable"
+    assert jobs["j1"].diagnosis == "eps too large"
+    assert jobs["j1"].distinct_failed_workers == 1  # no retry burn
+    d.store.close()
+
+
+def test_transient_requeues_with_bounded_backoff_then_quarantines(
+        tmp_path):
+    clock = FakeClock(0.0)
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", clock=clock, launcher=launcher,
+                quarantine_after=3, requeue_backoff_base_s=0.5,
+                requeue_backoff_max_s=0.75)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    for n in (1, 2):
+        _finish(d.store, launcher.last("j1"), "permanent_failure",
+                rc=4, kind="exhausted")
+        d.step()  # classify + requeue with backoff
+        req = _events(d.store, "j1", "requeued")[-1]
+        # bounded exponential: min(max, base * 2**(n-1))
+        assert req["backoff_s"] == min(0.75, 0.5 * 2 ** (n - 1))
+        jobs, _ = d.store.replay()
+        assert jobs["j1"].state == "queued"
+        d.step()  # backoff not yet elapsed: must NOT redispatch
+        jobs, _ = d.store.replay()
+        assert jobs["j1"].state == "queued"
+        clock.advance(1.0)
+        d.step()  # due now
+        jobs, _ = d.store.replay()
+        assert jobs["j1"].state == "running"
+        assert jobs["j1"].attempts == n + 1
+    _finish(d.store, launcher.last("j1"), "permanent_failure", rc=4,
+            kind="exhausted")
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["j1"].state == "quarantined"  # 3 distinct workers
+    assert jobs["j1"].distinct_failed_workers == 3
+    assert anomalies == []
+    q = _events(d.store, "j1", "quarantined")[0]
+    assert "distinct" in q["reason"]
+    d.store.close()
+
+
+def test_worker_death_without_record_is_orphaned_and_requeued(
+        tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    # SIGKILL: the process exits with no outcome record at all.
+    launcher.last("j1")["handle"].rc = -signal.SIGKILL
+    d.step()
+    orphan = _events(d.store, "j1", "orphaned")
+    assert len(orphan) == 1 and "without an outcome" in orphan[0][
+        "reason"]
+    jobs, _ = d.store.replay()
+    assert jobs["j1"].state == "running"  # already requeued+redispatched
+    assert jobs["j1"].attempts == 2
+    assert _events(d.store, "j1", "requeued")
+    d.store.close()
+
+
+def test_adopted_job_with_result_record_is_journaled_once(tmp_path):
+    # Daemon restarted after dispatch; the worker finished meanwhile.
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    dsp = launcher.last("j1")
+    d.store.write_result("j1", 1, {"outcome": "completed",
+                                   "worker": dsp["worker_id"],
+                                   "attempt": 1, "steps_done": 60})
+    d.store.close()
+    d2 = _daemon(tmp_path / "q")  # fresh: no Popen handles
+    d2.step()
+    jobs, anomalies = d2.store.replay()
+    assert jobs["j1"].state == "completed" and anomalies == []
+    assert len(_events(d2.store, "j1", "completed")) == 1
+    d2.store.close()
+
+
+def test_adopted_job_stale_heartbeat_orphans_within_timeout(tmp_path):
+    clock = FakeClock(1000.0)
+    launcher = ScriptedLauncher()
+    timeout = 3.0
+    d = _daemon(tmp_path / "q", clock=clock, launcher=launcher,
+                worker_heartbeat_s=0.5, heartbeat_timeout_s=timeout)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    wid = launcher.last("j1")["worker_id"]
+    d.store.close()
+    d2 = _daemon(tmp_path / "q", clock=clock, worker_heartbeat_s=0.5,
+                 heartbeat_timeout_s=timeout)
+    # Live pid + fresh beat: NOT orphaned.
+    d2.store.write_worker_hb(wid, {"pid": os.getpid(),
+                                   "t_wall": clock.t})
+    d2.step()
+    assert _events(d2.store, "j1", "orphaned") == []
+    # Beat goes stale past the timeout: orphaned on the next pass,
+    # even though the recorded pid (this test) is alive — a wedged
+    # worker that stopped beating is as dead as a SIGKILLed one.
+    clock.advance(timeout + 0.1)
+    d2.step()
+    assert len(_events(d2.store, "j1", "orphaned")) == 1
+    d2.store.close()
+
+
+def test_cancel_queued_job(tmp_path):
+    clock = FakeClock()
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", clock=clock, launcher=launcher,
+                slots=1)
+    d.store.spool_submit(_spec("j1"))
+    d.store.spool_submit(_spec("j2"))  # queued behind j1 (1 slot)
+    d.step()
+    assert client.cancel(str(tmp_path / "q"), "j2") is True
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["j2"].state == "cancelled" and anomalies == []
+    assert d.store.cancel_requests() == []  # marker cleared
+    # unknown/terminal jobs: nothing to do
+    assert client.cancel(str(tmp_path / "q"), "j2") is False
+    assert client.cancel(str(tmp_path / "q"), "nope") is False
+    d.store.close()
+
+
+def test_cancel_running_job_interrupts_then_journals_cancelled(
+        tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    dsp = launcher.last("j1")
+    client.cancel(str(tmp_path / "q"), "j1")
+    d.step()
+    assert dsp["handle"].terminated  # flag-only SIGTERM path
+    # The worker flushes its checkpoint and records "preempted"; with
+    # the cancel marker set, that maps to the cancelled terminal.
+    _finish(d.store, dsp, "preempted", rc=3, reason="SIGTERM",
+            steps_done=20)
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["j1"].state == "cancelled" and anomalies == []
+    assert jobs["j1"].steps_done == 20
+    d.store.close()
+
+
+def test_sigterm_escalates_to_sigkill_past_grace(tmp_path):
+    clock = FakeClock()
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", clock=clock, launcher=launcher,
+                kill_grace_s=5.0)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    dsp = launcher.last("j1")
+    client.cancel(str(tmp_path / "q"), "j1")
+    d.step()
+    assert dsp["handle"].terminated and not dsp["handle"].killed
+    clock.advance(6.0)  # wedged past the grace
+    d.step()
+    assert dsp["handle"].killed
+    d.store.close()
+
+
+def test_deadline_expired_while_queued(tmp_path):
+    # deadline_s=0: expired the moment it is accepted. Real clock —
+    # deadline_t derives from the journal's wall stamps, so a fake
+    # daemon clock would never reach it.
+    import time
+
+    d = _daemon(tmp_path / "q", clock=time.time, slots=1,
+                launcher=ScriptedLauncher())
+    d.store.spool_submit(_spec("j1"))
+    d.store.spool_submit(_spec("j2", deadline_s=0.0))
+    d.step()
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["j2"].state == "deadline_expired" and anomalies == []
+    d.store.close()
+
+
+def test_deadline_passed_to_worker_launcher(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1", deadline_s=3600.0))
+    d.step()
+    dsp = launcher.last("j1")
+    jobs, _ = d.store.replay()
+    assert dsp["deadline_t"] == pytest.approx(jobs["j1"].deadline_t)
+    assert dsp["deadline_t"] is not None
+    d.store.close()
+
+
+def test_dispatch_respects_slots_and_fifo_order(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher, slots=2)
+    for i in range(4):
+        d.store.spool_submit(_spec(f"j{i}"))
+        d.step()
+    assert [x["job_id"] for x in launcher.dispatches] == ["j0", "j1"]
+    _finish(d.store, launcher.last("j0"), "completed")
+    d.step()
+    assert [x["job_id"] for x in launcher.dispatches][-1] == "j2"
+    d.store.close()
+
+
+def test_drain_keeps_queued_jobs_and_rejects_spool(tmp_path):
+    from parallel_heat_tpu.supervisor import EXIT_PREEMPTED
+
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher, slots=1,
+                drain_grace_s=0.0)
+    d.store.spool_submit(_spec("j1"))
+    d.store.spool_submit(_spec("j2"))  # queued behind j1
+    d.step()
+    dsp = launcher.last("j1")
+    d.store.spool_submit(_spec("late"))  # arrives as the drain starts
+
+    # The in-flight worker flushes on SIGTERM like a real one would.
+    real_terminate = dsp["handle"].terminate
+
+    def terminate_and_flush():
+        real_terminate()
+        _finish(d.store, dsp, "preempted", rc=3, reason="SIGTERM",
+                steps_done=30)
+    dsp["handle"].terminate = terminate_and_flush
+
+    rc = d.drain(reason="test")
+    assert rc == EXIT_PREEMPTED
+    jobs, anomalies = d.store.replay()
+    assert anomalies == []
+    assert jobs["late"].state == "rejected"
+    assert "draining" in jobs["late"].reason
+    assert jobs["j2"].state == "queued"  # durable, restart dispatches
+    assert jobs["j1"].state == "queued"  # journaled resume state
+    assert jobs["j1"].steps_done == 30
+    evs = [e["event"] for e in _events(d.store)]
+    assert "daemon_drain" in evs and "daemon_exit" in evs
+    # the restarted daemon picks both up
+    launcher2 = ScriptedLauncher()
+    d2 = _daemon(tmp_path / "q", launcher=launcher2, slots=2)
+    d2.step()
+    assert {x["job_id"] for x in launcher2.dispatches} == {"j1", "j2"}
+    assert launcher2.last("j1")["attempt"] == 2
+    d2.store.close()
+
+
+def test_heatd_config_validation_errors(tmp_path):
+    with pytest.raises(ValueError, match="slots"):
+        HeatdConfig(root=str(tmp_path), slots=0).validate()
+    with pytest.raises(ValueError, match="heartbeat_timeout_s"):
+        HeatdConfig(root=str(tmp_path), worker_heartbeat_s=2.0,
+                    heartbeat_timeout_s=1.0).validate()
+    with pytest.raises(ValueError, match="quarantine_after"):
+        HeatdConfig(root=str(tmp_path), quarantine_after=0).validate()
+
+
+def test_status_heartbeat_published(tmp_path):
+    d = _daemon(tmp_path / "q")
+    d.store.spool_submit(_spec("j1"))
+    summary = d.step()
+    assert summary["state"] == "serving"
+    doc = d.store.read_daemon_status()
+    assert doc["pid"] == os.getpid()
+    assert doc["counts"] == {"running": 1}
+    d.store.close()
+
+
+# ---------------------------------------------------------------------------
+# Client + end-to-end inline execution
+# ---------------------------------------------------------------------------
+
+def test_client_submit_times_out_actionably(tmp_path):
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(s):
+        t["now"] += s
+
+    with pytest.raises(TimeoutError, match="heatd serve"):
+        client.submit(str(tmp_path / "q"), {"nx": 16, "ny": 16},
+                      accept_timeout_s=5.0, clock=clock, sleep_fn=sleep)
+
+
+def test_client_submit_sees_rejection(tmp_path):
+    d = _daemon(tmp_path / "q", max_queue_depth=1)
+    d.store.spool_submit(_spec("occupant"))
+    d.step()
+    t = {"now": 0.0}
+
+    def sleep(s):
+        t["now"] += s
+        d.step()
+
+    verdict = client.submit(str(tmp_path / "q"), {"nx": 16, "ny": 16},
+                            job_id="j2", accept_timeout_s=30.0,
+                            clock=lambda: t["now"], sleep_fn=sleep)
+    assert verdict == {"job_id": "j2", "accepted": False,
+                       "reason": verdict["reason"],
+                       "retry_after_s": verdict["retry_after_s"]}
+    assert verdict["retry_after_s"] > 0
+    d.store.close()
+
+
+def test_make_job_id_unique():
+    ids = {client.make_job_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_inline_job_executes_and_matches_unsupervised_solve(tmp_path):
+    # One REAL solve through the whole service path (inline worker —
+    # subprocess workers are the chaos suite's job): accepted,
+    # dispatched, supervised with per-job checkpoint dir + telemetry
+    # sink, completed; final checkpoint bitwise the plain solve().
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    root = str(tmp_path / "q")
+
+    class InlineHandle:
+        def __init__(self, run):
+            self._run = run
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return InlineHandle(lambda: svc_worker.execute_job(
+            root, job_id, worker_id, attempt, deadline_t=deadline_t))
+
+    d = _daemon(root, launcher=launcher)
+    d.store.spool_submit(_spec("j1", checkpoint_every=20,
+                               guard_interval=10))
+    for _ in range(4):
+        d.step()
+        jobs, _ = d.store.replay()
+        if jobs["j1"].terminal:
+            break
+    jobs, anomalies = d.store.replay()
+    assert jobs["j1"].state == "completed" and anomalies == []
+    assert jobs["j1"].steps_done == 60
+
+    cfg = HeatConfig(nx=16, ny=16, steps=60, backend="jnp")
+    src = latest_checkpoint(d.store.checkpoint_stem("j1"))
+    grid, step, _ = load_checkpoint(src, cfg)
+    assert step == 60
+    np.testing.assert_array_equal(np.asarray(grid),
+                                  solve(cfg).to_numpy())
+    # the per-job telemetry sink recorded the run
+    assert os.path.getsize(d.store.telemetry_path("j1")) > 0
+    # result record round trip
+    rec = d.store.read_result("j1", 1)
+    assert rec["outcome"] == "completed" and rec["steps_done"] == 60
+    d.store.close()
+
+
+# ---------------------------------------------------------------------------
+# heatd CLI surface
+# ---------------------------------------------------------------------------
+
+def test_heatd_cli_status_and_cancel_errors(tmp_path, capsys):
+    from parallel_heat_tpu.service.cli import main as heatd_main
+
+    root = str(tmp_path / "q")
+    d = _daemon(root, max_queue_depth=1)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    d.store.close()
+    assert heatd_main(["status", "--queue", root, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["jobs"]["j1"]["state"] == "running"
+    assert doc["anomalies"] == []
+    assert heatd_main(["cancel", "--queue", root, "nope"]) == 2
+    assert heatd_main(["cancel", "--queue", root, "j1"]) == 0
+
+
+def test_solver_cli_forwards_service_commands(tmp_path, capsys):
+    # `python -m parallel_heat_tpu status --queue ...` is the same
+    # surface as the heatd console script.
+    from parallel_heat_tpu.cli import main as solver_main
+
+    root = str(tmp_path / "q")
+    d = _daemon(root)
+    d.step()
+    d.store.close()
+    assert solver_main(["status", "--queue", root, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["daemon"]["state"] == "serving"
+
+
+def test_heatd_cli_drain_without_daemon(tmp_path, capsys):
+    from parallel_heat_tpu.service.cli import main as heatd_main
+
+    os.makedirs(tmp_path / "q", exist_ok=True)
+    assert heatd_main(["drain", "--queue", str(tmp_path / "q")]) == 2
+
+
+def test_worker_default_checkpoint_cadence_f32chunk_aligned():
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.supervisor import default_checkpoint_every
+
+    plain = HeatConfig(nx=16, ny=16, steps=100, backend="jnp")
+    assert default_checkpoint_every(plain) == 10
+    chunked = HeatConfig(nx=16, ny=16, steps=100, backend="jnp",
+                         dtype="bfloat16", accumulate="f32chunk")
+    # bf16 sublane multiple is 16: 10 rounds up to 16
+    assert default_checkpoint_every(chunked) == 16
+
+
+def test_heatq_inspector_check_gate(tmp_path):
+    # tools/heatq.py: --check exits 2 exactly when the journal replay
+    # reports a durability anomaly.
+    root = tmp_path / "q"
+    store = JobStore(root)
+    store.journal.append("accepted", job_id="a")
+    store.journal.append("dispatched", job_id="a", worker="w1",
+                         attempt=1)
+    store.journal.append("completed", job_id="a", steps_done=60)
+    store.close()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    heatq = os.path.join(_ROOT, "tools", "heatq.py")
+    out = subprocess.run(
+        [sys.executable, heatq, str(root), "--json", "--check"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["counts"] == {"completed": 1}
+    assert doc["jobs"][0]["attempts"] == 1
+    assert doc["anomalies"] == []
+    # now break the invariant: a second terminal state
+    store2 = JobStore(root)
+    store2.journal.append("completed", job_id="a")
+    store2.close()
+    bad = subprocess.run(
+        [sys.executable, heatq, str(root), "--check"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert bad.returncode == 2
+    assert "ANOMALY" in bad.stdout
+
+
+# ---------------------------------------------------------------------------
+# Review-fix regressions
+# ---------------------------------------------------------------------------
+
+def test_reducer_incremental_fold_equivalence(tmp_path):
+    # reduce(prefix) then reduce(suffix, state) == reduce(prefix +
+    # suffix): the fold law the daemon's O(new events) incremental
+    # replay rests on.
+    events = [
+        {"event": "accepted", "job_id": "a", "t_wall": 1.0,
+         "hbm_bytes": 5},
+        {"event": "dispatched", "job_id": "a", "worker": "w1",
+         "attempt": 1, "t_wall": 2.0},
+        {"event": "orphaned", "job_id": "a", "worker": "w1",
+         "attempt": 1, "t_wall": 3.0},
+        {"event": "requeued", "job_id": "a", "reason": "orphaned",
+         "not_before": 3.5, "t_wall": 3.5},
+        {"event": "dispatched", "job_id": "a", "worker": "w2",
+         "attempt": 2, "t_wall": 4.0},
+        {"event": "completed", "job_id": "a", "steps_done": 60,
+         "t_wall": 9.0},
+        {"event": "rejected", "job_id": "b", "reason": "depth",
+         "retry_after_s": 1.0, "t_wall": 2.0},
+        {"event": "completed", "job_id": "a", "t_wall": 10.0},  # anomaly
+    ]
+    for cut in range(len(events) + 1):
+        full = reduce_journal(events)
+        state = reduce_journal(events[:cut])
+        inc = reduce_journal(events[cut:], state=state)
+        assert inc == full, cut
+
+
+def test_daemon_incremental_replay_matches_store_replay(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.store.spool_submit(_spec("j2"))
+    d.step()
+    _finish(d.store, launcher.last("j1"), "permanent_failure", rc=4,
+            kind="exhausted")
+    d.step()
+    _finish(d.store, launcher.last("j2"), "completed", steps_done=60)
+    d.step()
+    assert d._replay() == d.store.replay()
+    d.store.close()
+
+
+def test_adopted_worker_gets_dispatch_grace_before_orphaning(tmp_path):
+    import time
+
+    # Restarted daemon adopts a running job whose worker has not
+    # written its FIRST heartbeat yet (still importing its runtime):
+    # within one heartbeat timeout of the dispatch stamp it must NOT
+    # be orphaned — orphaning would spawn a second live worker.
+    root = tmp_path / "q"
+    store = JobStore(root)
+    store.commit_job_record(_spec("j1"))
+    store.journal.append("accepted", job_id="j1")
+    store.journal.append("dispatched", job_id="j1", worker="w1",
+                         attempt=1)
+    store.close()
+    d = _daemon(root, clock=time.time, launcher=ScriptedLauncher(),
+                worker_heartbeat_s=0.1, heartbeat_timeout_s=0.3)
+    d.step()
+    assert _events(d.store, "j1", "orphaned") == []  # grace
+    time.sleep(0.35)  # past the timeout, still no first beat: corpse
+    d.step()
+    assert len(_events(d.store, "j1", "orphaned")) == 1
+    d.store.close()
+
+
+def test_bad_spec_records_failfast_quarantine(tmp_path):
+    # An accepted spec the worker cannot materialize must produce a
+    # rename-committed bad_spec record (fail-fast quarantine with THE
+    # diagnosis), not a recordless death churning through
+    # orphan/requeue to a mislabeled verdict.
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.supervisor import EXIT_PERMANENT_FAILURE
+
+    root = str(tmp_path / "q")
+
+    class InlineHandle:
+        def __init__(self, run):
+            self._run = run
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return InlineHandle(lambda: svc_worker.execute_job(
+            root, job_id, worker_id, attempt, deadline_t=deadline_t))
+
+    d = _daemon(root, launcher=launcher)
+    d.store.spool_submit(JobSpec(
+        job_id="jbad", config={"nx": 2, "ny": 2, "steps": 60}))  # < 3
+    d.step()
+    d.step()
+    jobs, anomalies = d.store.replay()
+    assert jobs["jbad"].state == "quarantined" and anomalies == []
+    assert jobs["jbad"].kind == "bad_spec"
+    assert jobs["jbad"].attempts == 1  # fail-fast: no retry burn
+    rec = d.store.read_result("jbad", 1)
+    assert rec["outcome"] == "permanent_failure"
+    assert "cannot materialize" in rec["diagnosis"]
+    d.store.close()
+
+
+def test_cancel_reaches_adopted_worker_via_heartbeat_pid(tmp_path):
+    import time
+
+    # Daemon restarted while a job runs: no Popen handle, but the
+    # worker heartbeat names its pid — cancellation must still
+    # interrupt it (SIGTERM through the same flag-only contract).
+    root = tmp_path / "q"
+    victim = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+    try:
+        store = JobStore(root)
+        store.commit_job_record(_spec("j1"))
+        store.journal.append("accepted", job_id="j1")
+        store.journal.append("dispatched", job_id="j1", worker="w1",
+                             attempt=1)
+        store.write_worker_hb("w1", {"pid": victim.pid,
+                                     "t_wall": time.time()})
+        store.close()
+        d = _daemon(root, clock=time.time,
+                    launcher=ScriptedLauncher())
+        assert client.cancel(str(root), "j1") is True
+        d.step()
+        assert victim.wait(timeout=30) == -signal.SIGTERM
+        # the dead worker's job then resolves through reconcile: the
+        # cancel marker maps the eventual orphaning to `cancelled`
+        time.sleep(0.1)
+        for _ in range(60):
+            d.step()
+            jobs, _ = d.store.replay()
+            if jobs["j1"].terminal:
+                break
+            time.sleep(0.1)
+        jobs, anomalies = d.store.replay()
+        assert jobs["j1"].state == "cancelled" and anomalies == []
+        d.store.close()
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+            victim.wait()
+
+
+def test_client_rejects_reused_job_id(tmp_path):
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher)
+    d.store.spool_submit(_spec("j1"))
+    d.step()
+    _finish(d.store, launcher.last("j1"), "completed")
+    d.step()
+    with pytest.raises(ValueError, match="single-use"):
+        client.submit(str(tmp_path / "q"), {"nx": 16, "ny": 16},
+                      job_id="j1", accept_timeout_s=1.0)
+    # CLI spelling: exit 2, loud
+    from parallel_heat_tpu.service.cli import main as heatd_main
+
+    assert heatd_main(["submit", "--queue", str(tmp_path / "q"),
+                       "--job-id", "j1", "--nx", "16", "--ny",
+                       "16"]) == 2
+    d.store.close()
+
+
+def test_stem_lock_concurrent_stale_reclaim_single_winner(tmp_path):
+    # TOCTOU regression: many threads racing to reclaim the same
+    # STALE lock must produce exactly one holder (the flock sidecar
+    # serializes the judge-unlink-retake sequence; without it a loser
+    # could unlink the winner's fresh lock and co-hold the stem).
+    import json as _json
+    import threading
+
+    from parallel_heat_tpu.utils.checkpoint import (
+        StemLockError,
+        _stem_lock_path,
+        acquire_stem_lock,
+    )
+
+    stem = str(tmp_path / "ck")
+    os.makedirs(tmp_path, exist_ok=True)
+    with open(_stem_lock_path(stem), "w") as f:
+        _json.dump({"pid": 2 ** 22 + 3, "t_wall": 0.0}, f)  # dead pid
+    wins, errs = [], []
+    barrier = threading.Barrier(8)
+
+    def racer():
+        barrier.wait()
+        try:
+            wins.append(acquire_stem_lock(stem))
+        except StemLockError:
+            errs.append(1)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1 and len(errs) == 7
+    assert os.path.exists(_stem_lock_path(stem))
+    wins[0]()
+    assert not os.path.exists(_stem_lock_path(stem))
+
+
+def test_rejection_then_acceptance_same_pass_keeps_fold_consistent(
+        tmp_path):
+    # A rejection and a later acceptance land in ONE _admit pass: the
+    # acceptance's offset bump must not skip the rejection's journal
+    # bytes — the cached fold has to keep matching a full replay (a
+    # skipped rejection would undercount forever AND let a re-used id
+    # through the idempotent-handshake dedupe).
+    launcher = ScriptedLauncher()
+    d = _daemon(tmp_path / "q", launcher=launcher, max_queue_depth=1,
+                slots=1)
+    d.store.spool_submit(_spec("occupant"))
+    d.step()
+    # spool iterates sorted: "a-rejected" (depth gate: occupant is
+    # active) then "b-also-rejected"; on the next pass after occupant
+    # completes, "c-accepted" goes through — interleaving verdicts.
+    d.store.spool_submit(_spec("a-rejected"))
+    d.store.spool_submit(_spec("b-also-rejected"))
+    d.step()
+    _finish(d.store, launcher.last("occupant"), "completed")
+    d.store.spool_submit(_spec("c-accepted"))
+    d.step()
+    assert d._replay() == d.store.replay()
+    jobs, _ = d.store.replay()
+    assert jobs["a-rejected"].state == "rejected"
+    assert jobs["b-also-rejected"].state == "rejected"
+    assert jobs["c-accepted"].state == "running"
+    # the daemon's status heartbeat counts the rejections (folded)
+    doc = d.store.read_daemon_status()
+    assert doc["counts"].get("rejected") == 2
+    # and a re-used rejected id is still deduped, not re-answered
+    d.store.spool_submit(_spec("a-rejected"))
+    d.step()
+    assert len(_events(d.store, "a-rejected", "rejected")) == 1
+    d.store.close()

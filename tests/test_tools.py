@@ -446,8 +446,171 @@ def test_chaos_matrix_dryrun_smoke(tmp_path):
     assert by_fault["spike_drift"]["telemetry_drift_ok"] is True
     assert by_fault["nan_async_race"]["telemetry_barrier_ok"] is True
     assert all(r.get("bitwise_match", True) for r in doc["rows"])
-    # every cell left a parseable event stream, and the NaN cells'
-    # guard trips are visible in it within one guard_interval
-    assert all(r["telemetry_ok"] for r in doc["rows"])
+    # every solver cell left a parseable event stream, and the NaN
+    # cells' guard trips are visible in it within one guard_interval
+    # (service cells certify the journal instead)
+    assert all(r.get("telemetry_ok", True) for r in doc["rows"])
     assert all(r.get("telemetry_detect_lag_ok", True)
                for r in doc["rows"])
+    # the heatd durability cells: true worker death recovered bitwise
+    # within one heartbeat timeout, daemon SIGKILL in the accept->
+    # dispatch window loses nothing, overload rejects loudly
+    assert outcomes["svc_worker_sigkill"] == "recovered"
+    assert by_fault["svc_worker_sigkill"]["attempts"] == 2
+    assert by_fault["svc_worker_sigkill"]["orphan_detect_ok"] is True
+    assert outcomes["svc_daemon_restart"] == "recovered"
+    assert outcomes["svc_overload"] == "rejected+served"
+    assert by_fault["svc_overload"]["never_dropped_ok"] is True
+    assert all(r.get("single_terminal_ok", True) for r in doc["rows"])
+
+
+# ---------------------------------------------------------------------------
+# heatd service tooling (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def _mk_queue_root(tmp_path):
+    """Hand-built queue root with a controlled journal: jc completed
+    first try, jr completed after an orphaning/requeue, jq quarantined,
+    jx rejected — timestamps pinned for the percentile math."""
+    sys.path.insert(0, _ROOT)
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = tmp_path / "q"
+    store = JobStore(root)
+    j = store.journal
+    t = 1000.0
+    j.append("daemon_start", t_wall=t, slots=2)
+    j.append("accepted", job_id="jc", t_wall=t, hbm_bytes=100)
+    j.append("dispatched", job_id="jc", worker="w1", attempt=1,
+             t_wall=t + 1.0)
+    j.append("completed", job_id="jc", steps_done=60, t_wall=t + 5.0)
+    j.append("accepted", job_id="jr", t_wall=t, hbm_bytes=100)
+    j.append("dispatched", job_id="jr", worker="w2", attempt=1,
+             t_wall=t + 3.0)
+    j.append("orphaned", job_id="jr", worker="w2", attempt=1,
+             t_wall=t + 4.0)
+    j.append("requeued", job_id="jr", reason="orphaned",
+             not_before=t + 4.0, t_wall=t + 4.0)
+    j.append("dispatched", job_id="jr", worker="w3", attempt=2,
+             t_wall=t + 5.0)
+    j.append("completed", job_id="jr", steps_done=60, t_wall=t + 9.0)
+    j.append("accepted", job_id="jq", t_wall=t, hbm_bytes=100)
+    j.append("dispatched", job_id="jq", worker="w4", attempt=1,
+             t_wall=t + 2.0)
+    j.append("worker_failed", job_id="jq", worker="w4", attempt=1,
+             kind="unstable", diagnosis="dt too large",
+             t_wall=t + 3.0)
+    j.append("quarantined", job_id="jq", kind="unstable",
+             reason="fail-fast permanent failure (kind=unstable)",
+             t_wall=t + 3.0)
+    j.append("rejected", job_id="jx", reason="queue depth 3 at the "
+             "admission limit (3)", retry_after_s=2.5, t_wall=t)
+    store.write_daemon_status({"pid": 4242, "t_wall": t + 9.0,
+                               "state": "serving", "slots": 2,
+                               "running_workers": 0, "counts": {},
+                               "anomalies": 0})
+    store.close()
+    return root
+
+
+def test_metrics_report_fleet_mode(tmp_path):
+    root = _mk_queue_root(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    mr = os.path.join(_ROOT, "tools", "metrics_report.py")
+    rep = subprocess.run(
+        [sys.executable, mr, str(root), "--json"],
+        capture_output=True, text=True, timeout=120, env=env)
+    # quarantined>0 in the fixture is informational here (no --fail-on
+    # threshold): exit 0, the document carries the story
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    f = doc["fleet"]
+    assert f["jobs_accepted"] == 3 and f["jobs_rejected"] == 1
+    assert f["completed"] == 2 and f["quarantined"] == 1
+    assert f["retried"] == 1 and f["orphaned"] == 1
+    assert f["attempts_total"] == 4
+    # queue waits: 1.0 (jc), 3.0 (jr), 2.0 (jq)
+    assert f["queue_wait_s"]["p50"] == _approx(2.0)
+    assert f["queue_wait_s"]["max"] == _approx(3.0)
+    # job walls: 5.0, 9.0, 3.0
+    assert f["job_wall_s"]["max"] == _approx(9.0)
+    assert f["quarantined_jobs"][0]["job_id"] == "jq"
+    assert doc["anomalies_journal"] == []
+    # human rendering names the quarantined job
+    txt = subprocess.run([sys.executable, mr, str(root)],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert txt.returncode == 0 and "quarantined jq" in txt.stdout
+    # the CI gate: --fail-on quarantined>0 -> exit 2
+    gate = subprocess.run(
+        [sys.executable, mr, str(root), "--fail-on", "quarantined>0"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert gate.returncode == 2 and "ANOMALY" in gate.stdout
+    # thresholds compose; a satisfied one passes
+    ok = subprocess.run(
+        [sys.executable, mr, str(root),
+         "--fail-on", "quarantined>1,orphaned>1"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert ok.returncode == 0
+    # unknown counters are loud errors, not silent passes
+    bad = subprocess.run(
+        [sys.executable, mr, str(root), "--fail-on", "nonsense>0"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert bad.returncode == 1 and "not a fleet counter" in bad.stderr
+    # a directory that is not a queue root is unusable input
+    notq = subprocess.run(
+        [sys.executable, mr, str(tmp_path)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert notq.returncode == 1
+
+
+def _approx(x):
+    return pytest.approx(x, abs=1e-6)
+
+
+def test_metrics_report_fleet_anomaly_gate(tmp_path):
+    # a journal whose replay reports a durability anomaly (double
+    # terminal) must exit 2 even with no --fail-on
+    sys.path.insert(0, _ROOT)
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = tmp_path / "q"
+    store = JobStore(root)
+    store.journal.append("accepted", job_id="a")
+    store.journal.append("completed", job_id="a")
+    store.journal.append("cancelled", job_id="a")  # double terminal
+    store.close()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"), str(root)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert rep.returncode == 2
+    assert "durability" in rep.stdout
+
+
+def test_monitor_daemon_view_once(tmp_path):
+    root = _mk_queue_root(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    mon = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "monitor.py"),
+         "--once", "--daemon", str(root)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert mon.returncode == 0, mon.stderr[-2000:]
+    line = mon.stdout.strip()
+    assert "heatd pid 4242" in line or "serving" in line
+    assert "completed=2" in line
+    assert "quarantined=1" in line
+    assert "rejected=1" in line
+    # after a drain, the view says so (and live mode would exit)
+    sys.path.insert(0, _ROOT)
+    from parallel_heat_tpu.service.store import JobStore
+
+    store = JobStore(root, create=False)
+    store.journal.append("daemon_exit", outcome="drained")
+    store.close()
+    mon2 = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "monitor.py"),
+         "--once", "--daemon", str(root)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert "daemon exited (drained)" in mon2.stdout

@@ -36,6 +36,28 @@ rollback never restores an uncommitted one, certified by the
 ``checkpoint_barrier`` event preceding the first ``rollback`` in the
 stream (``telemetry_barrier_ok``).
 
+**Service cells** (the heatd durability contract, SEMANTICS.md "Job
+durability" — each drives a real queue root through
+``parallel_heat_tpu/service``):
+
+- ``svc_worker_sigkill`` — a worker SIGKILLs itself mid-job
+  (``FaultPlan.kill_worker_at_chunk``, attempt-gated); a RESTARTED
+  daemon must detect the job orphaned from the worker's heartbeat/pid
+  alone within one heartbeat timeout (``orphan_detect_ok``), requeue
+  it with its checkpoint lineage intact, and the re-dispatched attempt
+  completes with a grid BITWISE the uninterrupted run's;
+- ``svc_daemon_restart`` — the daemon itself is SIGKILLed between the
+  ``accepted`` journal append and dispatch
+  (``--chaos-kill-after-accept``); a restart must recover every
+  accepted job to exactly one terminal state (``no_loss_ok`` +
+  ``single_terminal_ok`` — the journal reducer's anomaly list stays
+  empty);
+- ``svc_overload`` — submissions past the admission gates (queue
+  depth, estimated-HBM budget) are REJECTED with a retry-after hint
+  (``rejected_with_retry_after_ok``) and never acquire journal state
+  beyond the rejection (``never_dropped_ok`` — no
+  accepted-then-dropped), while the admitted jobs complete bitwise.
+
 ``--dryrun`` runs the tiny CPU matrix (16x16, 60 steps; the stalled
 cell runs its own 3500-step converge schedule) and is the
 committed-artifact entry point:
@@ -294,6 +316,266 @@ FAULTS = ("none", "nan_transient", "nan_recurring", "transient_error",
           "sigterm", "unstable", "spike_drift", "stalled_converge",
           "sigterm_async", "nan_async_race")
 
+SERVICE_FAULTS = ("svc_worker_sigkill", "svc_daemon_restart",
+                  "svc_overload")
+
+
+# ---------------------------------------------------------------------------
+# Service cells (heatd durability contract)
+# ---------------------------------------------------------------------------
+
+def _drive(daemon, done, timeout_s=180.0, poll_s=0.03):
+    """Step the daemon until ``done(jobs)`` or timeout; returns the
+    final replay."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < timeout_s:
+        daemon.step()
+        jobs, anomalies = daemon.store.replay()
+        if done(jobs):
+            return jobs, anomalies
+        _time.sleep(poll_s)
+    raise TimeoutError("service cell did not converge within "
+                       f"{timeout_s:g}s")
+
+
+def _svc_spec(job_id, steps=60, faults=None, faults_on_attempt=1,
+              nx=16):
+    from parallel_heat_tpu.service.store import JobSpec
+
+    return JobSpec(job_id=job_id,
+                   config={"nx": nx, "ny": nx, "steps": steps,
+                           "backend": "jnp"},
+                   checkpoint_every=10, guard_interval=5,
+                   backoff_base_s=0.0, faults=faults,
+                   faults_on_attempt=faults_on_attempt)
+
+
+def _svc_bitwise(store, job_id, steps=60, nx=16):
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint, load_checkpoint)
+
+    cfg = HeatConfig(nx=nx, ny=nx, steps=steps, backend="jnp")
+    src = latest_checkpoint(store.checkpoint_stem(job_id))
+    if src is None:
+        return False
+    grid, _step, _ = load_checkpoint(src, cfg)
+    return bool((np.asarray(grid) == solve(cfg).to_numpy()).all())
+
+
+def run_service_cell(fault, workdir):
+    if fault == "svc_worker_sigkill":
+        return _svc_worker_sigkill(os.path.join(workdir, fault))
+    if fault == "svc_daemon_restart":
+        return _svc_daemon_restart(os.path.join(workdir, fault))
+    if fault == "svc_overload":
+        return _svc_overload(os.path.join(workdir, fault))
+    raise ValueError(fault)
+
+
+def _svc_worker_sigkill(root):
+    import time as _time
+
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    row = {"fault": "svc_worker_sigkill"}
+    hb_s, timeout_s = 0.25, 1.0
+    mk = lambda: Heatd(HeatdConfig(  # noqa: E731 — two daemon "boots"
+        root=root, slots=1, worker_heartbeat_s=hb_s,
+        heartbeat_timeout_s=timeout_s, requeue_backoff_base_s=0.0,
+        worker_env={"JAX_PLATFORMS": "cpu"}))
+    d1 = mk()
+    jid = "job-sigkill"
+    d1.store.spool_submit(_svc_spec(
+        jid, faults={"kill_worker_at_chunk": 4}, faults_on_attempt=1))
+    jobs, _ = _drive(d1, lambda j: jid in j
+                     and j[jid].state == "running")
+    # Let the worker run to its self-SIGKILL, reaping via d1's Popen
+    # handle (the role init plays for a real daemon's orphans — a
+    # zombie child of THIS harness process would otherwise pass pid
+    # liveness probes forever) but journaling NOTHING: detection must
+    # come from the restarted daemon's heartbeat/pid judgment.
+    wid = jobs[jid].worker
+    handle = d1._procs[jid]
+    t0 = _time.monotonic()
+    rc = None
+    while _time.monotonic() - t0 < 120:
+        rc = handle.poll()
+        if rc is not None:
+            break
+        _time.sleep(0.05)
+    row["worker_died"] = rc == -signal.SIGKILL
+    d1.store.close()
+
+    d2 = mk()  # the restarted daemon: no Popen handles, journal only
+    t_detect0 = _time.time()
+    jobs, anomalies = _drive(d2, lambda j: j[jid].terminal)
+    events, _, _ = d2.store.read_journal()
+    orphaned = [e for e in events if e.get("event") == "orphaned"
+                and e.get("job_id") == jid]
+    hb = d2.store.read_worker_hb(wid) or {}
+    row["outcome"] = ("recovered" if jobs[jid].state == "completed"
+                      and jobs[jid].attempts == 2 else jobs[jid].state)
+    row["attempts"] = jobs[jid].attempts
+    row["orphaned_ok"] = bool(orphaned)
+    if orphaned and hb.get("t_wall"):
+        # Detection lag vs the dead worker's LAST heartbeat: must be
+        # within one heartbeat timeout (+ scheduling slack) of the
+        # moment liveness was last proven.
+        lag = orphaned[0]["t_wall"] - hb["t_wall"]
+        row["orphan_detect_lag_s"] = lag
+        row["orphan_detect_ok"] = bool(
+            -hb_s <= lag <= timeout_s + 1.0
+            or orphaned[0]["t_wall"] - t_detect0 <= timeout_s + 1.0)
+    row["requeued_ok"] = any(e.get("event") == "requeued"
+                             and e.get("job_id") == jid for e in events)
+    row["single_terminal_ok"] = not anomalies
+    row["bitwise_match"] = _svc_bitwise(d2.store, jid)
+    d2.store.close()
+    return row
+
+
+def _svc_daemon_restart(root):
+    import subprocess
+
+    from parallel_heat_tpu.service import client
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    row = {"fault": "svc_daemon_restart"}
+    import parallel_heat_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "parallel_heat_tpu.cli", "serve",
+         "--queue", root, "--slots", "1", "--poll-interval", "0.1",
+         "--chaos-kill-after-accept", "2"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    jids = []
+    try:
+        for i in range(2):
+            v = client.submit(
+                root, {"nx": 16, "ny": 16, "steps": 60,
+                       "backend": "jnp"},
+                job_id=f"job-restart-{i}", checkpoint_every=10,
+                guard_interval=5, backoff_base_s=0.0,
+                accept_timeout_s=60)
+            jids.append(v["job_id"])
+            row[f"accepted_{i}"] = v["accepted"]
+        daemon.wait(timeout=30)
+    finally:
+        if daemon.poll() is None:  # pragma: no cover — cleanup only
+            daemon.kill()
+            daemon.wait()
+    row["daemon_killed_ok"] = daemon.returncode == -signal.SIGKILL
+
+    d2 = Heatd(HeatdConfig(root=root, slots=2, worker_heartbeat_s=0.25,
+                           heartbeat_timeout_s=1.0,
+                           requeue_backoff_base_s=0.0,
+                           worker_env={"JAX_PLATFORMS": "cpu"}))
+    jobs, anomalies = _drive(
+        d2, lambda j: all(jid in j and j[jid].terminal for jid in jids))
+    row["no_loss_ok"] = all(jobs[jid].state == "completed"
+                            for jid in jids)
+    row["single_terminal_ok"] = not anomalies
+    row["bitwise_match"] = all(_svc_bitwise(d2.store, jid)
+                               for jid in jids)
+    row["outcome"] = ("recovered" if row["no_loss_ok"]
+                      else "lost_jobs")
+    d2.store.close()
+    return row
+
+
+def _svc_overload(root):
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    row = {"fault": "svc_overload"}
+
+    class DeferredInline:
+        """Inline worker handle that stays 'running' for a few polls
+        before executing — deterministic occupancy without real
+        subprocesses, so the admission gate sees a busy queue."""
+
+        def __init__(self, run, defer=4):
+            self._run = run
+            self._defer = defer
+            self._polls = 0
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            self._polls += 1
+            if self._polls < self._defer:
+                return None
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return DeferredInline(
+            lambda: svc_worker.execute_job(root, job_id, worker_id,
+                                           attempt,
+                                           deadline_t=deadline_t))
+
+    d = Heatd(HeatdConfig(root=root, slots=1, max_queue_depth=2,
+                          hbm_budget_bytes=64 * 2**20,
+                          retry_after_s=1.0, launcher=launcher))
+    # Burst: two admitted (slots=1 -> one runs, one queues), then the
+    # depth gate closes on the rest of the burst.
+    for i in range(4):
+        d.store.spool_submit(_svc_spec(f"job-ovl-{i}"))
+        d.step()
+    jobs, _ = d.store.replay()
+    depth_rejected = {j: v for j, v in jobs.items()
+                      if v.state == "rejected"}
+    admitted = [j for j, v in jobs.items() if v.state != "rejected"]
+    jobs, anomalies = _drive(
+        d, lambda j: all(j[a].terminal for a in admitted))
+    # With the queue drained, an oversized grid must still be refused —
+    # by the estimated-HBM budget, the gate depth can't reach.
+    d.store.spool_submit(_svc_spec("job-ovl-hbm", nx=4096, steps=60))
+    d.step()
+    jobs, anomalies = d.store.replay()
+    rejected = {j: v for j, v in jobs.items() if v.state == "rejected"}
+    row["rejected_count"] = len(rejected)
+    row["rejected_with_retry_after_ok"] = (
+        len(depth_rejected) == 2
+        and all(isinstance(v.retry_after_s, (int, float))
+                and v.retry_after_s > 0 for v in rejected.values()))
+    row["hbm_gate_ok"] = ("job-ovl-hbm" in rejected
+                          and "HBM" in (rejected["job-ovl-hbm"].reason
+                                        or ""))
+    row["accepted_completed_ok"] = all(
+        jobs[a].state == "completed" for a in admitted)
+    row["bitwise_match"] = all(_svc_bitwise(d.store, a)
+                               for a in admitted)
+    # Accepted-then-dropped would show as a rejected job acquiring
+    # dispatch/terminal journal state; the reducer leaves rejections
+    # terminal-at-rejection, so any such event is an anomaly AND a
+    # state change we check directly.
+    events, _, _ = d.store.read_journal()
+    row["never_dropped_ok"] = not any(
+        e.get("job_id") in rejected
+        and e.get("event") in ("dispatched", "completed", "orphaned")
+        for e in events)
+    row["single_terminal_ok"] = not anomalies
+    row["outcome"] = ("rejected+served"
+                      if row["rejected_with_retry_after_ok"]
+                      and row["accepted_completed_ok"] else "violation")
+    d.store.close()
+    return row
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -331,6 +613,13 @@ def main():
                 f"  detect_lag={row['detect_lag_steps']}"
             print(f"{fault:16s} -> {row['outcome']:20s}"
                   f"  retries={row.get('retries', '-')}{bits}{lag}")
+        for fault in SERVICE_FAULTS:
+            row = run_service_cell(fault, workdir)
+            rows.append(row)
+            lag = "" if "orphan_detect_lag_s" not in row else \
+                f"  orphan_lag={row['orphan_detect_lag_s']:.2f}s"
+            print(f"{fault:16s} -> {row['outcome']:20s}"
+                  f"  bitwise={row.get('bitwise_match', '-')}{lag}")
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -356,6 +645,20 @@ def main():
         "nan_async_race": ("bitwise_match", "detect_lag_ok",
                            "telemetry_ok", "telemetry_detect_lag_ok",
                            "telemetry_barrier_ok"),
+        # The heatd durability contract (SEMANTICS.md "Job
+        # durability"): true worker death is detected, requeued, and
+        # resumed bit-exactly; a daemon SIGKILL in the accept->dispatch
+        # window loses nothing and double-terminals nothing; overload
+        # rejects loudly instead of accepting-then-dropping.
+        "svc_worker_sigkill": ("worker_died", "orphaned_ok",
+                               "orphan_detect_ok", "requeued_ok",
+                               "single_terminal_ok", "bitwise_match"),
+        "svc_daemon_restart": ("daemon_killed_ok", "accepted_0",
+                               "accepted_1", "no_loss_ok",
+                               "single_terminal_ok", "bitwise_match"),
+        "svc_overload": ("rejected_with_retry_after_ok", "hbm_gate_ok",
+                         "accepted_completed_ok", "never_dropped_ok",
+                         "single_terminal_ok", "bitwise_match"),
     }
     by_fault = {r["fault"]: r for r in rows}
     ok = (all(by_fault[f].get(k) is True
@@ -368,7 +671,11 @@ def main():
           and by_fault["stalled_converge"].get("kind") == "stalled"
           and by_fault["sigterm_async"]["outcome"]
           == "interrupted+resumed"
-          and by_fault["nan_async_race"]["outcome"] == "recovered")
+          and by_fault["nan_async_race"]["outcome"] == "recovered"
+          and by_fault["svc_worker_sigkill"]["outcome"] == "recovered"
+          and by_fault["svc_worker_sigkill"]["attempts"] == 2
+          and by_fault["svc_daemon_restart"]["outcome"] == "recovered"
+          and by_fault["svc_overload"]["outcome"] == "rejected+served")
     print(f"matrix {'OK' if ok else 'VIOLATION'}: "
           f"{sum(1 for r in rows if r['outcome'] != 'halted')} "
           f"completed/recovered, "

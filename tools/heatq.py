@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""heatq: the queue inspector — a post-mortem-grade view of one heatd
+queue root, straight from the durable artifacts.
+
+Where ``heatd status`` is the quick live snapshot, this renders the
+full story the journal tells: per-job state, attempts, failure
+history, queue-wait and wall times, the daemon's lifecycle events, and
+— critically for the durability contract — the reducer's anomaly list
+(a double terminal state or a dispatch-after-terminal would surface
+here; the chaos suite asserts it stays empty through every injected
+crash).
+
+Exit codes: 0 readable (even if empty), 1 unreadable root, 2 when
+``--check`` is set and the journal replay reports anomalies — the CI
+spelling of "the durability invariants held".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from parallel_heat_tpu.service.store import (  # noqa: E402
+    JobStore,
+    reduce_journal,
+)
+
+
+def inspect(root):
+    store = JobStore(root, create=False)
+    events, bad, torn = store.read_journal()
+    jobs, anomalies = reduce_journal(events)
+    rows = []
+    for jid, v in sorted(jobs.items()):
+        wait_s = (v.first_dispatch_t - v.accepted_t
+                  if v.first_dispatch_t is not None
+                  and v.accepted_t is not None else None)
+        wall_s = (v.terminal_t - v.accepted_t
+                  if v.terminal_t is not None
+                  and v.accepted_t is not None else None)
+        rows.append({
+            "job_id": jid, "state": v.state, "attempts": v.attempts,
+            "requeues": v.requeues,
+            "failures": [{"worker": w, "kind": k} for w, k in v.failures],
+            "queue_wait_s": wait_s, "wall_s": wall_s,
+            "steps_done": v.steps_done, "kind": v.kind,
+            "reason": v.reason, "diagnosis": v.diagnosis,
+        })
+    daemon_events = [e for e in events
+                     if e.get("event", "").startswith("daemon_")]
+    return {
+        "root": str(root),
+        "events_total": len(events), "bad_lines": bad,
+        "torn_tail": torn,
+        "daemon": store.read_daemon_status(),
+        "daemon_events": [{"event": e["event"],
+                           "t_wall": e.get("t_wall"),
+                           "pid": e.get("pid"),
+                           "reason": e.get("reason")}
+                          for e in daemon_events],
+        "jobs": rows,
+        "counts": _counts(rows),
+        "anomalies": anomalies,
+    }
+
+
+def _counts(rows):
+    out = {}
+    for r in rows:
+        out[r["state"]] = out.get(r["state"], 0) + 1
+    return out
+
+
+def render_text(doc):
+    out = [f"queue {doc['root']}: {doc['events_total']} journal "
+           f"events, {len(doc['jobs'])} job(s) "
+           f"{json.dumps(doc['counts'])}"]
+    d = doc.get("daemon")
+    if d:
+        out.append(f"daemon: pid {d.get('pid')} {d.get('state')} "
+                   f"slots={d.get('slots')} "
+                   f"running={d.get('running_workers')}")
+    for r in doc["jobs"]:
+        line = (f"  {r['job_id']:28s} {r['state']:16s} "
+                f"attempts={r['attempts']}")
+        if r["queue_wait_s"] is not None:
+            line += f" wait={r['queue_wait_s']:.2f}s"
+        if r["wall_s"] is not None:
+            line += f" wall={r['wall_s']:.2f}s"
+        if r["steps_done"] is not None:
+            line += f" steps={r['steps_done']}"
+        if r["failures"]:
+            line += " failures=" + ",".join(
+                f"{f['worker']}:{f['kind']}" for f in r["failures"])
+        out.append(line)
+    if doc["torn_tail"]:
+        out.append("note: torn final journal line skipped (writer "
+                   "died/racing mid-append; prefix intact)")
+    for a in doc["anomalies"]:
+        out.append(f"ANOMALY: {a}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="inspect a heatd queue root (journal replay + "
+                    "daemon status)")
+    ap.add_argument("root", help="queue root directory")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 when the journal replay reports "
+                         "anomalies (CI: the durability invariants "
+                         "held)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"error: {args.root}: not a queue root directory",
+              file=sys.stderr)
+        return 1
+    doc = inspect(args.root)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_text(doc))
+    return 2 if (args.check and doc["anomalies"]) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

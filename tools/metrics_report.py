@@ -52,14 +52,26 @@ stdout):
   violated, outliers exceeded ``--max-outlier-frac``, or checkpoint
   share exceeded ``--max-ckpt-share``.
 
+**Fleet mode**: pass a heatd QUEUE ROOT directory (the thing `heatd
+serve --queue` writes — `journal.jsonl` + per-job telemetry sinks)
+instead of a JSONL file, and the report aggregates the whole fleet:
+jobs completed/retried/quarantined/rejected, requeues and orphanings,
+p50/p99/max queue wait and job wall from the journal timestamps, and
+the journal reducer's anomaly list (a non-empty list means the
+durability contract broke — the chaos suite asserts on it).
+``--fail-on`` accepts counter thresholds in this mode —
+``quarantined>0`` is the CI gate that no job was poisoned, tokens
+compose (``--fail-on 'quarantined>0,orphaned>2'``).
+
 ``--json`` prints the summary document to stdout as JSON (for piping:
-``make telemetry-smoke``).
+``make telemetry-smoke`` / ``make serve-smoke``).
 """
 
 import argparse
 import glob
 import json
 import math
+import os
 import sys
 
 
@@ -382,6 +394,101 @@ def summarize(events, outlier_mult=5.0):
     return doc
 
 
+def summarize_fleet(root):
+    """Aggregate a heatd queue root into the fleet summary document.
+
+    Imported lazily (and with the repo root on sys.path) because the
+    journal reducer lives in the package — single-file telemetry mode
+    stays stdlib-only and fast."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from parallel_heat_tpu.service.store import (
+        JobStore, reduce_journal)
+
+    store = JobStore(root, create=False)
+    events, bad, torn = store.read_journal()
+    jobs, anomalies = reduce_journal(events)
+    counts = {}
+    for v in jobs.values():
+        counts[v.state] = counts.get(v.state, 0) + 1
+    ev_counts = {}
+    for e in events:
+        ev_counts[e.get("event")] = ev_counts.get(e.get("event"), 0) + 1
+    waits = sorted(v.first_dispatch_t - v.accepted_t
+                   for v in jobs.values()
+                   if v.first_dispatch_t is not None
+                   and v.accepted_t is not None)
+    walls = sorted(v.terminal_t - v.accepted_t for v in jobs.values()
+                   if v.terminal_t is not None
+                   and v.accepted_t is not None
+                   and v.state != "rejected")
+    accepted = [v for v in jobs.values() if v.state != "rejected"]
+    doc = {
+        "fleet": {
+            "root": str(root),
+            "jobs_accepted": len(accepted),
+            "jobs_rejected": counts.get("rejected", 0),
+            "completed": counts.get("completed", 0),
+            "quarantined": counts.get("quarantined", 0),
+            "cancelled": counts.get("cancelled", 0),
+            "deadline_expired": counts.get("deadline_expired", 0),
+            "queued": counts.get("queued", 0),
+            "running": counts.get("running", 0),
+            "failed": counts.get("failed", 0),
+            # Jobs that needed more than one dispatch: the service-
+            # level retry count (in-worker supervisor retries live in
+            # each job's telemetry sink, not here).
+            "retried": sum(1 for v in accepted if v.attempts > 1),
+            "attempts_total": sum(v.attempts for v in accepted),
+            "requeues": ev_counts.get("requeued", 0),
+            "orphaned": ev_counts.get("orphaned", 0),
+            # End-to-end: acceptance -> terminal state (requeue
+            # backoffs included — that IS the user-visible latency).
+            "queue_wait_s": {"p50": _percentile(waits, 50),
+                             "p99": _percentile(waits, 99),
+                             "max": waits[-1] if waits else None},
+            "job_wall_s": {"p50": _percentile(walls, 50),
+                           "p99": _percentile(walls, 99),
+                           "max": walls[-1] if walls else None},
+            "quarantined_jobs": [
+                {"job_id": v.job_id, "kind": v.kind,
+                 "reason": v.reason, "diagnosis": v.diagnosis}
+                for v in jobs.values() if v.state == "quarantined"],
+        },
+        "events_total": len(events),
+        "bad_lines": bad,
+        "torn_tail": torn,
+        "anomalies_journal": anomalies,
+    }
+    return doc
+
+
+def render_fleet_text(doc):
+    f = doc["fleet"]
+    out = [f"fleet {f['root']}: {f['jobs_accepted']} accepted "
+           f"({f['completed']} completed, {f['quarantined']} "
+           f"quarantined, {f['cancelled']} cancelled, "
+           f"{f['deadline_expired']} deadline-expired, "
+           f"{f['queued']} queued, {f['running']} running), "
+           f"{f['jobs_rejected']} rejected"]
+    out.append(f"retries: {f['retried']} job(s) re-dispatched, "
+               f"{f['requeues']} requeue(s), {f['orphaned']} "
+               f"orphaning(s), {f['attempts_total']} attempt(s) total")
+    qw, jw = f["queue_wait_s"], f["job_wall_s"]
+    if qw["p50"] is not None:
+        out.append(f"queue wait p50={qw['p50']:.2f}s "
+                   f"p99={qw['p99']:.2f}s max={qw['max']:.2f}s")
+    if jw["p50"] is not None:
+        out.append(f"job wall  p50={jw['p50']:.2f}s "
+                   f"p99={jw['p99']:.2f}s max={jw['max']:.2f}s")
+    for q in f["quarantined_jobs"]:
+        out.append(f"  quarantined {q['job_id']}: kind={q['kind']} "
+                   f"({q['reason']})")
+    for a in doc["anomalies_journal"]:
+        out.append(f"JOURNAL ANOMALY: {a}")
+    return "\n".join(out)
+
+
 def render_text(doc):
     out = []
     h = doc.get("header")
@@ -490,14 +597,70 @@ def _fmt(v):
     return "-" if v is None else f"{v:,.0f}"
 
 
+def _fleet_main(args):
+    """Directory input: fleet mode over a heatd queue root."""
+    journal = os.path.join(args.metrics, "journal.jsonl")
+    if not os.path.isfile(journal):
+        print(f"error: {args.metrics}: a directory was given but it "
+              f"has no journal.jsonl — not a heatd queue root",
+              file=sys.stderr)
+        return 1
+    doc = summarize_fleet(args.metrics)
+    anomalies = []
+    fleet = doc["fleet"]
+    tokens = ([] if args.fail_on == "none"
+              else [t.strip() for t in args.fail_on.split(",")
+                    if t.strip()])
+    for t in tokens:
+        if ">" not in t:
+            # Plain event tokens are the stream-mode vocabulary (the
+            # default 'permanent_failure'); in fleet mode only counter
+            # thresholds gate — unknown plain tokens pass silently so
+            # the shared default stays usable for both modes.
+            continue
+        name, _, num = t.partition(">")
+        name = name.strip()
+        try:
+            thr = int(num)
+        except ValueError:
+            print(f"error: bad --fail-on token {t!r} (expected "
+                  f"NAME>INT, e.g. quarantined>0)", file=sys.stderr)
+            return 1
+        val = fleet.get(name)
+        if not isinstance(val, (int, float)):
+            print(f"error: --fail-on counter {name!r} is not a fleet "
+                  f"counter (have: "
+                  f"{', '.join(k for k, v in fleet.items() if isinstance(v, (int, float)))})",
+                  file=sys.stderr)
+            return 1
+        if val > thr:
+            anomalies.append(f"{name} = {val} > {thr}")
+    if doc["anomalies_journal"]:
+        anomalies.append(
+            f"{len(doc['anomalies_journal'])} journal anomaly(ies) — "
+            f"the durability invariants did not hold")
+    doc["anomalies"] = anomalies
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_fleet_text(doc))
+        for a in anomalies:
+            print(f"ANOMALY: {a}")
+    return 2 if anomalies else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="summarize a --metrics telemetry JSONL file")
+        description="summarize a --metrics telemetry JSONL file, or a "
+                    "heatd queue root (fleet mode)")
     ap.add_argument("metrics",
                     help="JSONL file written by --metrics, or a glob "
                          "over per-process shards (runs/m*.jsonl) — "
                          "aggregates summarize the primary shard, all "
-                         "shards are listed with health/torn flags")
+                         "shards are listed with health/torn flags — "
+                         "or a heatd QUEUE ROOT directory (fleet "
+                         "summary from its journal)")
     ap.add_argument("--json", action="store_true",
                     help="print the summary document as JSON")
     ap.add_argument("--outlier-mult", type=float, default=5.0,
@@ -520,8 +683,14 @@ def main(argv=None):
                          "thresholds the pipeline section's device-"
                          "busy fraction (e.g. 'busy<0.9' fails a run "
                          "whose device idled more than 10% — the CI "
-                         "guard for the pipelined stream)")
+                         "guard for the pipelined stream). 'NAME>N' "
+                         "tokens threshold counts: event counts on a "
+                         "stream, fleet counters on a queue root "
+                         "('quarantined>0' is the serving CI gate)")
     args = ap.parse_args(argv)
+
+    if os.path.isdir(args.metrics):
+        return _fleet_main(args)
 
     try:
         events, bad, torn_paths, shards = load_streams(args.metrics)
@@ -558,7 +727,7 @@ def main(argv=None):
     tokens = ([] if args.fail_on == "none"
               else [t.strip() for t in args.fail_on.split(",")
                     if t.strip()])
-    fail_on, busy_min = set(), None
+    fail_on, busy_min, thresholds = set(), None, []
     for t in tokens:
         if t.startswith("busy<"):
             try:
@@ -567,10 +736,24 @@ def main(argv=None):
                 print(f"error: bad --fail-on token {t!r} (expected "
                       f"busy<FLOAT)", file=sys.stderr)
                 return 1
+        elif ">" in t:
+            # Count threshold (the fleet-mode vocabulary, accepted on
+            # event streams too: `guard_trip>2` fails only past two).
+            name, _, num = t.partition(">")
+            try:
+                thresholds.append((name.strip(), int(num)))
+            except ValueError:
+                print(f"error: bad --fail-on token {t!r} (expected "
+                      f"NAME>INT)", file=sys.stderr)
+                return 1
         else:
             fail_on.add(t)
     for ev in sorted(fail_on & set(doc["events_by_type"])):
         anomalies.append(f"{doc['events_by_type'][ev]} {ev} event(s)")
+    for name, thr in thresholds:
+        n = doc["events_by_type"].get(name, 0)
+        if n > thr:
+            anomalies.append(f"{n} {name} event(s) > {thr}")
     if busy_min is not None:
         busy = (doc.get("pipeline") or {}).get("device_busy_frac")
         if busy is None:

@@ -22,6 +22,13 @@ Robust by construction: a torn final line (the writer is mid-append),
 foreign lines, or a missing/partially-renamed heartbeat are skipped,
 never fatal — a monitor must not crash because it raced a writer.
 
+``--daemon QUEUE_ROOT`` adds the heatd service view: the daemon's
+status heartbeat (``heatd.json``) plus a lightweight fold of the job
+journal into per-state counts — same artifact-only discipline (the
+authoritative reducer lives in ``parallel_heat_tpu/service/store.py``;
+this is the probe-side count, deliberately jax-import-free). Live mode
+exits when the journal records ``daemon_exit``.
+
 Modes:
 
 - default: live tail — refresh every ``--interval`` seconds, rewrite
@@ -35,6 +42,7 @@ Modes:
 import argparse
 import glob as _glob
 import json
+import os
 import sys
 import time
 
@@ -149,6 +157,107 @@ class StreamState:
                 self.step = rec["steps_done"]
 
 
+class DaemonState:
+    """Incremental fold of a heatd queue journal into per-state counts
+    (event names per service/store.py's journal vocabulary; this is a
+    liveness probe, not the authoritative reducer). Byte-offset
+    incremental like :class:`StreamState`; torn/foreign lines skipped.
+    """
+
+    _TERMINAL = ("completed", "quarantined", "cancelled",
+                 "deadline_expired")
+
+    def __init__(self, root):
+        self.root = root
+        self._offset = 0
+        self._partial = b""
+        self.states = {}
+        self.rejected = 0
+        self.saw_data = False
+        self.exited = False
+
+    def poll(self):
+        path = os.path.join(self.root, "journal.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return
+        if data:
+            self._offset += len(data)
+            buf = self._partial + data
+            lines = buf.split(b"\n")
+            self._partial = lines[-1]
+            for line in lines[:-1]:
+                self._ingest(line)
+
+    def _ingest(self, line):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return
+        if not isinstance(rec, dict) or "event" not in rec:
+            return
+        self.saw_data = True
+        ev = rec["event"]
+        if ev == "daemon_exit":
+            self.exited = True
+        jid = rec.get("job_id")
+        if jid is None:
+            return
+        if ev == "accepted":
+            self.states[jid] = "queued"
+        elif ev == "rejected":
+            self.rejected += 1
+            self.states.pop(jid, None)
+        elif ev == "dispatched":
+            self.states[jid] = "running"
+        elif ev in ("worker_failed", "orphaned"):
+            self.states[jid] = "failed"
+        elif ev == "requeued":
+            self.states[jid] = "queued"
+        elif ev in self._TERMINAL:
+            self.states[jid] = ev
+
+    def counts(self):
+        out = {}
+        for s in self.states.values():
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    def render(self, now=None):
+        now = time.time() if now is None else now
+        hb = read_heartbeat(os.path.join(self.root, "heatd.json"))
+        parts = []
+        if hb is not None:
+            parts.append(f"heatd pid {hb.get('pid')} "
+                         f"{hb.get('state', '?')}")
+            busy = hb.get("running_workers")
+            slots = hb.get("slots")
+            if slots is not None:
+                parts.append(f"slots {busy}/{slots}")
+            if hb.get("t_wall"):
+                age = max(0.0, now - hb["t_wall"])
+                iv = hb.get("poll_interval_s") or 1.0
+                stale = " (stale?)" if age > max(5.0 * iv, 5.0) else ""
+                parts.append(f"hb {age:.1f}s ago{stale}")
+        elif self.saw_data:
+            parts.append("heatd: no status heartbeat")
+        c = self.counts()
+        if c or self.rejected:
+            parts.append(" ".join(f"{k}={v}"
+                                  for k, v in sorted(c.items()))
+                         + (f" rejected={self.rejected}"
+                            if self.rejected else ""))
+        if self.exited:
+            parts.append("daemon exited (drained)")
+        return " | ".join(parts) if parts else None
+
+
 def render(state, hb, now=None):
     """One status line from whatever is observable. Returns None when
     neither source yielded anything yet."""
@@ -207,6 +316,10 @@ def main(argv=None):
     ap.add_argument("--metrics", default=None, metavar="FILE_OR_GLOB",
                     help="telemetry JSONL written by --metrics "
                          "(glob ok: runs/m*.jsonl for shards)")
+    ap.add_argument("--daemon", default=None, metavar="QUEUE_ROOT",
+                    help="heatd queue root: show the daemon heartbeat "
+                         "+ per-state job counts (live mode exits on "
+                         "daemon_exit)")
     ap.add_argument("--once", action="store_true",
                     help="render one status line and exit (0 = data "
                          "observed, 1 = nothing readable)")
@@ -217,16 +330,24 @@ def main(argv=None):
                     help="stop after S seconds even without a run_end "
                          "(for scripts; default: watch forever)")
     args = ap.parse_args(argv)
-    if not args.heartbeat and not args.metrics:
-        ap.error("give --heartbeat and/or --metrics")
+    if not args.heartbeat and not args.metrics and not args.daemon:
+        ap.error("give --heartbeat, --metrics and/or --daemon")
 
     state = StreamState(args.metrics) if args.metrics else None
+    daemon = DaemonState(args.daemon) if args.daemon else None
 
     def snapshot():
         if state is not None:
             state.poll()
+        if daemon is not None:
+            daemon.poll()
         hb = read_heartbeat(args.heartbeat) if args.heartbeat else None
-        return render(state, hb), hb
+        line = render(state, hb)
+        if daemon is not None:
+            dline = daemon.render()
+            if dline is not None:
+                line = dline if line is None else f"{dline} || {line}"
+        return line, hb
 
     if args.once:
         line, hb = snapshot()
@@ -255,7 +376,10 @@ def main(argv=None):
                 else:
                     print(line, flush=True)
                 last_line = line
-            if state is not None and state.outcome is not None:
+            # Exit when the watched thing finished: a drained daemon
+            # ends the service view; a run_end ends the run view.
+            if ((state is not None and state.outcome is not None)
+                    or (daemon is not None and daemon.exited)):
                 if is_tty:
                     sys.stdout.write("\n")
                 return 0
