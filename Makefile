@@ -29,7 +29,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        bench clean
+        ensemble-smoke bench clean
 
 all: heat
 
@@ -159,6 +159,49 @@ serve-smoke:
 	$(PY) -c "import json,sys; f=json.load(sys.stdin)['fleet']; \
 	assert f['completed'] == 3, f"
 	rm -rf .serve_smoke
+
+# Ensemble packing run-book as a gate (README "Ensemble"): daemon up
+# with --pack, 3 compatible jobs submitted WITHOUT --wait (so they
+# coalesce under the --pack-wait dwell), daemon packs >= 2 of them
+# into one batched dispatch, all 3 reach terminal completion with
+# zero durability anomalies; per-member results fanned back to the
+# individual job records (bitwise the solo runs — tests/test_ensemble
+# pins the parity; this gate certifies the wiring end to end).
+ensemble-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .ensemble_smoke && mkdir -p .ensemble_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu serve \
+	    --queue .ensemble_smoke/q --slots 1 --poll-interval 0.1 \
+	    --pack --pack-max 8 --pack-wait 15 \
+	    --max-seconds 300 >/dev/null & \
+	DPID=$$!; trap 'kill $$DPID 2>/dev/null || true' EXIT; \
+	SUB="--queue .ensemble_smoke/q --nx 16 --ny 16 --steps 60 \
+	    --checkpoint-every 20 --accept-timeout 120 --quiet"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id ens-a; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id ens-b; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id ens-c; \
+	$(PY) -c "from parallel_heat_tpu.service import client; \
+	[client.wait('.ensemble_smoke/q', j, timeout_s=180) \
+	 for j in ('ens-a', 'ens-b', 'ens-c')]"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu drain \
+	    --queue .ensemble_smoke/q; \
+	rc=0; wait $$DPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "daemon exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	JAX_PLATFORMS=cpu $(PY) tools/heatq.py .ensemble_smoke/q --check; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .ensemble_smoke/q \
+	    --fail-on 'quarantined>0,orphaned>0'; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .ensemble_smoke/q \
+	    --json | \
+	$(PY) -c "import json,sys; f=json.load(sys.stdin)['fleet']; \
+	assert f['completed'] == 3, f; \
+	assert f['packed_jobs'] >= 2, f; \
+	assert f['pack_dispatches'] >= 1, f"
+	rm -rf .ensemble_smoke
 
 bench:
 	$(PY) bench.py

@@ -210,6 +210,96 @@ def _bench_stream(backend, size=512, steps=1200, chunk=100):
     }
 
 
+def _bench_ensemble(backend, size=512, steps=400, batches=(1, 8, 64)):
+    """The aggregate-throughput row (``--row ensemble512``): B
+    independent members of one fixed-step config run as ONE batched
+    ensemble dispatch (``ensemble.engine.EnsembleSolver``) vs the same
+    B specs run as sequential single ``solve()`` calls. The figure of
+    merit is aggregate Mcells*steps/s — the ROADMAP item-1 metric the
+    TPU Ising work (arXiv 1903.11714) gets from lattice batching —
+    and the acceptance shape is that the ensemble aggregate SCALES
+    with B while the sequential baseline stays flat (per-dispatch
+    overhead is paid B times there, once here).
+
+    Protocol: batched and sequential variants both warmed (compile +
+    first dispatch) outside the brackets, then min-of-3 walls per B,
+    interleaved like the stream row. On this CPU dryrun the numbers
+    bound dispatch-overhead amortization only; the TPU re-run protocol
+    is recorded in the row (same flags on a TPU host — kernel M's
+    VMEM-residence is what the chip actually buys).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.ensemble.engine import EnsembleSolver
+    from parallel_heat_tpu.solver import (_build_runner, _observer_free,
+                                          make_initial_grid)
+    from parallel_heat_tpu.utils.profiling import sync
+
+    cfg = HeatConfig(nx=size, ny=size, steps=steps, backend=backend)
+    cells = size * size
+    u0 = jax.block_until_ready(make_initial_grid(cfg))
+    runner, _ = _build_runner(_observer_free(cfg))
+    sync(runner(jnp.copy(u0))[0])  # compile + warm the solo program
+
+    rows = []
+    for B in batches:
+        es = EnsembleSolver(cfg, B)
+        sync(es.solve().grids)  # compile + warm the batched program
+        ens_walls, seq_walls = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = es.solve()
+            sync(r.grids)
+            ens_walls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            last = None
+            for _i in range(B):
+                last = solve(cfg, initial=u0)
+            sync(last.grid)
+            seq_walls.append(time.perf_counter() - t0)
+        ens_w, seq_w = min(ens_walls), min(seq_walls)
+        rows.append({
+            "B": B,
+            "ensemble_wall_s": round(ens_w, 4),
+            "sequential_wall_s": round(seq_w, 4),
+            "ensemble_mcells_steps_per_s": round(
+                B * cells * steps / ens_w / 1e6, 1),
+            "sequential_mcells_steps_per_s": round(
+                B * cells * steps / seq_w / 1e6, 1),
+            "speedup_vs_sequential": round(seq_w / ens_w, 3),
+        })
+    import jax as _jax
+
+    platform = _jax.devices()[0].platform
+    note = None
+    if platform not in ("tpu", "axon"):
+        note = ("CPU dryrun: the batched path shares host cores with "
+                "the sequential baseline (no idle accelerator to "
+                "fill), so beating the sequential walls is not the "
+                "acceptance shape here — the row certifies that the "
+                "batched AGGREGATE Mcells*steps/s scales with B "
+                "(dispatch amortization) and records the TPU re-run "
+                "protocol; kernel M's VMEM-residence is what the "
+                "chip buys")
+    return {
+        "metric": (f"{size}^2 x{steps} fixed steps: batched ensemble "
+                   f"vs B sequential solves, aggregate Mcells*steps/s"),
+        "device": str(getattr(_jax.devices()[0], "device_kind",
+                              platform)),
+        **({"platform_note": note} if note else {}),
+        "ensemble_path": EnsembleSolver(cfg, max(batches)).path,
+        "rows": rows,
+        "tpu_rerun_protocol": (
+            "python bench.py --row ensemble512 --backend auto on a "
+            "TPU host (defaults: size 512, steps 400, B in {1,8,64}; "
+            "kernel M requires the member grid to fit VMEM — at "
+            "512^2 f32 the picker reports the path via "
+            "solver.explain(cfg, ensemble=B))"),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
@@ -228,7 +318,8 @@ def main(argv=None):
     ap.add_argument("--budget", type=float, default=10.0,
                     help="target seconds for the chained timing batch")
     ap.add_argument("--row", default="headline",
-                    choices=("headline", "conv256", "stream512"),
+                    choices=("headline", "conv256", "stream512",
+                             "ensemble512"),
                     help="which single row the one-line stdout "
                          "contract reports: the fixed-step headline "
                          "(default), the 256^2-to-eps converge row "
@@ -244,9 +335,26 @@ def main(argv=None):
     ap.add_argument("--stream-chunk", type=int, default=100,
                     help="--row stream512: chunk_steps, also the "
                          "guard/diag/checkpoint cadence (default 100)")
+    ap.add_argument("--ensemble-size", type=int, default=512,
+                    help="--row ensemble512: member grid edge "
+                         "(default 512)")
+    ap.add_argument("--ensemble-steps", type=int, default=400,
+                    help="--row ensemble512: fixed steps (default 400)")
+    ap.add_argument("--ensemble-batches", default="1,8,64",
+                    help="--row ensemble512: comma list of member "
+                         "counts B (default 1,8,64)")
     args = ap.parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig
+
+    if args.row == "ensemble512":
+        batches = tuple(int(b) for b in
+                        args.ensemble_batches.split(",") if b)
+        print(json.dumps(_bench_ensemble(args.backend,
+                                         size=args.ensemble_size,
+                                         steps=args.ensemble_steps,
+                                         batches=batches)))
+        return
 
     if args.row == "stream512":
         print(json.dumps(_bench_stream(args.backend,

@@ -18,7 +18,7 @@ A ground-up JAX/XLA/Pallas re-design of the capabilities of
 - Golden-file compatible ``.dat`` I/O (``mpi/...stat.c:326-341``).
 """
 
-from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.config import EnsembleConfig, HeatConfig
 from parallel_heat_tpu.solver import (
     HeatResult,
     grid_all_finite,
@@ -40,8 +40,28 @@ from parallel_heat_tpu.utils.telemetry import Telemetry
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy ensemble surface: the engine pulls in the solver's kernel
+    # machinery, which jax-free consumers of this package's config
+    # vocabulary (the service admission gate) must not pay for.
+    if name in ("EnsembleSolver", "EnsembleResult"):
+        from parallel_heat_tpu.ensemble import engine
+
+        return getattr(engine, name)
+    if name == "run_ensemble_supervised":
+        from parallel_heat_tpu.ensemble import supervised
+
+        return supervised.run_ensemble_supervised
+    raise AttributeError(name)
+
+
 __all__ = [
     "HeatConfig",
+    "EnsembleConfig",
+    "EnsembleSolver",
+    "EnsembleResult",
+    "run_ensemble_supervised",
     "HeatResult",
     "solve",
     "solve_stream",

@@ -179,6 +179,32 @@ def audit_cache_keys(config_cls=None, semantic=None, observation=None,
     return out
 
 
+def audit_cache_keys_all() -> List[Finding]:
+    """Rule HL101, both partitions: the ``HeatConfig`` semantic /
+    observation-only split against ``solver._observer_free`` (plus the
+    ``_build_runner`` caller scan), and the ``EnsembleConfig``
+    semantic / orchestration split against
+    ``EnsembleConfig.orchestration_free`` — the ensemble engine's
+    runner caches key on the orchestration-free config, so an
+    unstripped orchestration field would fork batched programs per
+    compaction/window setting exactly like an unstripped observer
+    field forks solo programs."""
+    out = list(audit_cache_keys())
+    from parallel_heat_tpu.config import (
+        ENSEMBLE_ORCHESTRATION_FIELDS,
+        ENSEMBLE_SEMANTIC_FIELDS,
+        EnsembleConfig,
+    )
+
+    out.extend(audit_cache_keys(
+        config_cls=EnsembleConfig,
+        semantic=ENSEMBLE_SEMANTIC_FIELDS,
+        observation=ENSEMBLE_ORCHESTRATION_FIELDS,
+        strip=lambda c: c.orchestration_free(),
+        scan_paths=[]))  # the caller scan already ran above
+    return out
+
+
 def _audit_runner_callers(scan_paths=None) -> List[Finding]:
     from parallel_heat_tpu.analysis.astlint import (REPO_ROOT,
                                                     _iter_py_files)
@@ -791,7 +817,7 @@ def audit_f32chunk(targets=None) -> List[Finding]:
 
 CONTRACT_RULES = {
     "HL101": ("error", "cache-key partition violated or unproven",
-              audit_cache_keys),
+              audit_cache_keys_all),
     "HL102": ("error", "donated buffer read/escaped after dispatch",
               audit_donation),
     "HL103": ("error", "kernel write-set touches the Dirichlet boundary",

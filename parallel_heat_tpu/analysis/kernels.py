@@ -137,6 +137,14 @@ def default_kernel_targets() -> List[KernelTarget]:
     add("A", ps._build_vmem_multistep((24, 36), f32, 0.1, 0.1, 4),
         [sds((24, 36))])
 
+    # Kernel M — member-batched VMEM-resident multi-step (the ensemble
+    # engine's hot path; grid iterates the member axis).
+    from parallel_heat_tpu.ops import batched as bt
+
+    add("M", bt._build_ensemble_vmem_multistep(3, (24, 36), f32,
+                                               0.1, 0.1, 4),
+        [sds((3, 24, 36))])
+
     # Kernel B — streaming strip, unsharded (clamped windows) and
     # sharded (extended input rows).
     fnB, subB = ps._build_strip_kernel((64, 64), f32, 0.1, 0.1,
@@ -957,29 +965,35 @@ def _audit_grid_coverage(target, eqn, report):
 # ---------------------------------------------------------------------------
 
 def _source_kernel_names() -> dict:
-    """{literal heat_* name: lineno} for every pallas_call site in
-    ops/pallas_stencil.py (parsed with ast — the same literals HL203
-    enforces)."""
+    """{literal heat_* name: lineno} for every pallas_call site in the
+    kernel modules — ops/pallas_stencil.py AND ops/batched.py (the
+    member-batched ensemble kernels) — parsed with ast (the same
+    literals HL203 enforces). A new kernel module must be added HERE
+    for its sites to join the coverage cross-check; the pinning test
+    (test_analysis.test_kernel_coverage_site_count) counts the total,
+    so an uncounted 19th site fails CI either way."""
     import ast
-    import os
 
+    from parallel_heat_tpu.ops import batched as bt
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
-    path = ps.__file__
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
     out = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fname = getattr(node.func, "attr",
-                        getattr(node.func, "id", None))
-        if fname != "pallas_call":
-            continue
-        for kw in node.keywords:
-            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
-                    and isinstance(kw.value.value, str):
-                out[kw.value.value] = node.lineno
+    for mod in (ps, bt):
+        path = mod.__file__
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = getattr(node.func, "attr",
+                            getattr(node.func, "id", None))
+            if fname != "pallas_call":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "name" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out[kw.value.value] = node.lineno
     return out
 
 

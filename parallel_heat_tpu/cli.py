@@ -79,6 +79,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "past their convergence verdict; on CPU "
                          "there is no idle device to keep busy); "
                          "D > 1 with --converge is an error")
+    ap.add_argument("--ensemble", type=int, default=None, metavar="B",
+                    help="run B independent members of this config as "
+                         "ONE batched ensemble program (SEMANTICS.md "
+                         "'Ensemble'): per-member epsilon verdicts in "
+                         "converge mode, finished members frozen and "
+                         "compacted away, per-member results bitwise "
+                         "the solo runs. Composes with --supervise "
+                         "(ensemble generations + rollback), --metrics "
+                         "and --explain; excludes --mesh/--resume")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write final grid (.dat for 2D, .npy otherwise)")
     ap.add_argument("--initial-out", default=None, metavar="FILE",
@@ -289,7 +298,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.explain:
         from parallel_heat_tpu.solver import explain
 
-        for key, val in explain(config).items():
+        for key, val in explain(config, ensemble=args.ensemble).items():
             print(f"{key}: {val}")
         return 0
     if args.checkpoint_every is not None:
@@ -338,6 +347,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("error: --resume auto requires --checkpoint (the stem "
               "whose newest generation to resume)", file=sys.stderr)
         return 2
+    if args.ensemble is not None:
+        return _run_ensemble(args, config)
 
     say = (lambda *a: None) if args.quiet else print
     mesh = config.mesh_or_unit()
@@ -581,6 +592,99 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                   total_steps, config,
                                   layout=args.checkpoint_layout)
         say(f"Checkpoint written to {written}")
+    return 0
+
+
+def _run_ensemble(args, config) -> int:
+    """The --ensemble B path: one batched program, per-member results.
+    Ensemble generations (not solo checkpoints) back --supervise, so
+    the whole ensemble rolls back / resumes bit-exactly per member."""
+    from parallel_heat_tpu.supervisor import (
+        EXIT_PERMANENT_FAILURE, EXIT_PREEMPTED, PermanentFailure)
+
+    say = (lambda *a: None) if args.quiet else print
+    if args.ensemble < 1:
+        print(f"error: --ensemble must be >= 1, got {args.ensemble}",
+              file=sys.stderr)
+        return 2
+    if args.mesh:
+        print("error: --ensemble is single-device per member "
+              "(--mesh runs solo)", file=sys.stderr)
+        return 2
+    if args.resume or args.initial_out:
+        print("error: --ensemble does not take --resume/--initial-out "
+              "(a supervised ensemble resumes from its own ensemble "
+              "generations automatically)", file=sys.stderr)
+        return 2
+    telemetry = None
+    if args.metrics or args.heartbeat:
+        from parallel_heat_tpu.utils.telemetry import Telemetry
+
+        telemetry = Telemetry(args.metrics, heartbeat=args.heartbeat,
+                              async_io=True)
+    say(f"Starting parallel_heat_tpu ensemble: {args.ensemble} "
+        f"member(s) of {'x'.join(map(str, config.shape))}, "
+        + (f"converge eps={config.eps:g}" if config.converge
+           else f"{config.steps} steps"))
+    try:
+        try:
+            if args.supervise:
+                from parallel_heat_tpu.ensemble.supervised import (
+                    run_ensemble_supervised)
+                from parallel_heat_tpu.supervisor import (
+                    SupervisorPolicy, default_checkpoint_every)
+
+                policy = SupervisorPolicy(
+                    checkpoint_every=(args.checkpoint_every
+                                      or default_checkpoint_every(config)),
+                    keep_checkpoints=args.keep_checkpoints,
+                    guard_interval=args.guard_interval,
+                    max_retries=args.max_retries)
+                sres = run_ensemble_supervised(
+                    config, args.ensemble, args.checkpoint,
+                    policy=policy, telemetry=telemetry, say=say)
+                if sres.interrupted:
+                    return EXIT_PREEMPTED
+                result = sres.result
+            else:
+                from parallel_heat_tpu.ensemble.engine import (
+                    EnsembleSolver)
+
+                result = EnsembleSolver(config, args.ensemble).solve(
+                    telemetry=telemetry)
+                if telemetry is not None:
+                    telemetry.run_end(
+                        outcome="complete",
+                        steps_done=int(result.steps_run.max()),
+                        wall_s=result.elapsed_s)
+        except PermanentFailure as e:
+            print(f"error: permanent failure: {e.diagnosis}",
+                  file=sys.stderr)
+            return EXIT_PERMANENT_FAILURE
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    for i in range(result.members):
+        line = f"member {i}: {int(result.steps_run[i])} steps"
+        if result.converged is not None:
+            line += (f", converged={bool(result.converged[i])}, "
+                     f"residual={float(result.residual[i]):g}")
+        say(line)
+    if result.compactions:
+        say("compactions: " + ", ".join(
+            f"step {k}: {a}->{b}" for k, a, b in result.compactions))
+    say(f"Elapsed time {result.elapsed_s:.6f} secs")
+    if args.out:
+        import numpy as np
+
+        path = args.out
+        if not path.endswith(".npy"):
+            path += ".npy"
+        np.save(path, np.asarray(result.grids))
+        say(f"Stacked member grids written to {path}")
     return 0
 
 

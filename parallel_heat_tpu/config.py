@@ -43,6 +43,90 @@ SEMANTIC_FIELDS = (
 OBSERVATION_ONLY_FIELDS = ("guard_interval", "diag_interval",
                            "pipeline_depth")
 
+# --- ensemble cache-key partition (SEMANTICS.md "Ensemble") -----------
+#
+# Same discipline as the HeatConfig partition above, for
+# :class:`EnsembleConfig`: SEMANTIC fields select what the batched
+# member programs compute and key the ensemble runner/executable
+# caches; ORCHESTRATION fields shape only the host-side dispatch
+# schedule (how many convergence windows run per dispatch, when the
+# live batch is compacted) and are provably incapable of moving a
+# member's trajectory — the compaction-invariance contract — so
+# :meth:`EnsembleConfig.orchestration_free` resets them before any
+# runner-cache lookup. Machine-checked by the same heatlint rule HL101
+# (``analysis/contracts.py`` audits BOTH partitions): an unclassified
+# EnsembleConfig field fails CI exactly like an unclassified
+# HeatConfig field.
+ENSEMBLE_SEMANTIC_FIELDS = ("members",)
+ENSEMBLE_ORCHESTRATION_FIELDS = ("compact_threshold", "window_rounds")
+
+
+@dataclass(frozen=True)
+class EnsembleConfig:
+    """Configuration of one batched ensemble run (``ensemble/``).
+
+    ``members`` is B, the leading member-axis extent: B independent
+    grids sharing one semantic :class:`HeatConfig` run in one compiled
+    program. The other knobs are orchestration-only (see the partition
+    comment above): they change dispatch boundaries and compaction
+    points, never a member's arithmetic.
+    """
+
+    # The member-axis extent B (semantic: batched programs are shaped
+    # by it and the runner cache keys on it).
+    members: int = 1
+
+    # Converge-mode compaction: when the live fraction of the CURRENT
+    # batch drops strictly below this threshold at a window boundary,
+    # finished members are parked and the live ones are compacted into
+    # a smaller batch so stragglers stop paying for finished work.
+    # None = never compact. At the default 0.5 each compaction at
+    # least halves the batch, so a run recompiles at most O(log B)
+    # batch sizes. Orchestration-only: member trajectories are
+    # invariant to when (or whether) compaction happens — pinned by
+    # tests/test_ensemble.py.
+    compact_threshold: Optional[float] = 0.5
+
+    # Converge-mode host-inspection cadence: how many check_interval
+    # windows one dispatch advances before the host reads the
+    # per-member verdicts (and may compact). Orchestration-only: a
+    # member freezes at ITS convergence window regardless of how many
+    # windows share a dispatch.
+    window_rounds: int = 4
+
+    def validate(self) -> "EnsembleConfig":
+        if self.members < 1:
+            raise ValueError(
+                f"ensemble members must be >= 1, got {self.members}")
+        if self.compact_threshold is not None and not (
+                0.0 < self.compact_threshold <= 1.0):
+            raise ValueError(
+                f"compact_threshold must be in (0, 1] (or None to "
+                f"disable compaction), got {self.compact_threshold}")
+        if self.window_rounds < 1:
+            raise ValueError(
+                f"window_rounds must be >= 1, got {self.window_rounds}")
+        return self
+
+    def orchestration_free(self) -> "EnsembleConfig":
+        """THE ensemble strip site (heatlint HL101, second audit):
+        every orchestration-only field reset to its default — the
+        config the batched runner caches key on."""
+        defaults = {f.name: f.default for f in dataclasses.fields(self)}
+        kw = {name: defaults[name] for name in ENSEMBLE_ORCHESTRATION_FIELDS
+              if getattr(self, name) != defaults[name]}
+        return self.replace(**kw) if kw else self
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "EnsembleConfig":
+        return cls(**json.loads(s)).validate()
+
+    def replace(self, **kw) -> "EnsembleConfig":
+        return dataclasses.replace(self, **kw)
+
 
 def divisible_factorizations(n_devices: int, shape) -> list:
     """Ordered ``len(shape)``-factorizations of ``n_devices`` whose

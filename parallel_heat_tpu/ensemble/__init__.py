@@ -1,0 +1,47 @@
+"""Batched ensemble engine: many independent grids per chip.
+
+ROADMAP item 1's throughput lever: B independent member grids sharing
+one semantic :class:`~parallel_heat_tpu.config.HeatConfig` are stacked
+on a leading member axis and advanced by ONE compiled program per
+dispatch — vmap over the solver's jnp multistep family on the general
+path, the member-batched Pallas kernel M (``ops/batched.py``) on the
+hot single-chip path. Converge mode computes per-member epsilon
+verdicts with a fused batched reduction, freezes finished members by
+masked update, and compacts the live batch when the live fraction
+drops below the configured threshold (``EnsembleConfig``), so
+stragglers stop paying for finished work.
+
+Contracts (SEMANTICS.md "Ensemble"):
+
+- **member independence / parity** — a member of a batched run is
+  bitwise the single-grid ``solve()`` of the same spec on the same
+  resolved path (pinned by ``tests/test_ensemble.py``);
+- **compaction invariance** — a member's trajectory does not depend on
+  when (or whether) other members finish;
+- **observation-only batched diagnostics** — per-member guard verdicts
+  and grid stats read between dispatches and never join the compiled
+  programs (the solver's guard contract, member-axis extended).
+
+``ensemble/checkpoint.py`` persists per-member manifests under one
+generation; ``ensemble/supervised.py`` wraps the engine in the
+checkpoint/guard/rollback loop; ``service/`` packs compatible queued
+jobs into one ensemble dispatch (``heatd serve --pack``).
+"""
+
+from parallel_heat_tpu.ensemble.engine import (  # noqa: F401
+    EnsembleResult,
+    EnsembleSolver,
+    ensemble_all_finite,
+    ensemble_grid_stats,
+    ensemble_path,
+    packable,
+)
+from parallel_heat_tpu.ensemble.checkpoint import (  # noqa: F401
+    latest_ensemble_checkpoint,
+    load_ensemble_checkpoint,
+    save_ensemble_generation,
+)
+from parallel_heat_tpu.ensemble.supervised import (  # noqa: F401
+    EnsembleSupervisorResult,
+    run_ensemble_supervised,
+)

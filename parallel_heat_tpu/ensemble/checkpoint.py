@@ -1,0 +1,152 @@
+"""Ensemble checkpoint generations: per-member manifests, one commit.
+
+One ensemble generation is ONE rename-committed ``.npz`` holding the
+full-order member state at a global step boundary — the stacked
+``(B, *shape)`` grids plus the per-member manifest (steps, converged,
+residual for every member), the solver config and the ensemble config.
+The write discipline is ``utils/checkpoint.py``'s exactly: pid-unique
+dotted temp names that discovery can never match, fsync + rename +
+dirsync publish, so a SIGKILL at any point leaves either the previous
+complete generation or the new complete one — never a torn file.
+
+Because a generation stores the FULL-ORDER state (parked members
+included, bit-exact), ``ensemble/supervised.py`` can roll back or
+resume the whole ensemble from any retained generation and every
+member continues its trajectory bit-exactly, regardless of the
+compaction history at save time (SEMANTICS.md "Ensemble").
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+from parallel_heat_tpu.config import EnsembleConfig, HeatConfig
+from parallel_heat_tpu.utils.checkpoint import _fsync_replace
+
+_FORMAT_VERSION = 1
+# stem.eg<step>.npz — 12 digits zero-padded so lexicographic order is
+# numeric order (the same trick utils/checkpoint's generations use).
+_GEN_RE = re.compile(r"\.eg(\d{12})\.npz$")
+
+
+def _gen_path(stem: str, k: int) -> str:
+    return f"{stem}.eg{int(k):012d}.npz"
+
+
+def ensemble_generation_paths(stem: str) -> list:
+    """Committed generation files of ``stem``, oldest first. Temps
+    (dotted names) never match the pattern — a SIGKILLed writer's
+    debris is invisible here."""
+    out = []
+    for p in glob.glob(f"{stem}.eg*.npz"):
+        m = _GEN_RE.search(os.path.basename(p))
+        if m and not os.path.basename(p).startswith("."):
+            out.append((int(m.group(1)), p))
+    return [p for _k, p in sorted(out)]
+
+
+def latest_ensemble_checkpoint(stem: str) -> Optional[str]:
+    """Newest committed generation of ``stem``, or None."""
+    paths = ensemble_generation_paths(stem)
+    return paths[-1] if paths else None
+
+
+def save_ensemble_generation(stem: str, state: dict,
+                             config: HeatConfig,
+                             ensemble: EnsembleConfig,
+                             keep: int = 3) -> str:
+    """Commit one generation from an assembled engine state
+    (``{"k", "grids", "done", "res", "steps"}`` — the
+    ``EnsembleBoundary.assemble()`` payload) and prune generations
+    beyond the newest ``keep``. Returns the committed path."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    stem = str(stem)
+    parent = os.path.dirname(os.path.abspath(stem))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    k = int(state["k"])
+    grids = np.asarray(state["grids"])
+    steps = np.asarray(state["steps"], np.int64)
+    done = np.asarray(state["done"], bool)
+    res = np.asarray(state["res"], np.float64)
+    manifest = [{"member": i, "steps": int(steps[i]),
+                 "converged": bool(done[i]),
+                 "residual": (None if not np.isfinite(res[i])
+                              else float(res[i]))}
+                for i in range(grids.shape[0])]
+    path = _gen_path(stem, k)
+    tmp = os.path.join(parent or ".",
+                       f".tmp-{os.getpid()}-{os.path.basename(path)}")
+    try:
+        np.savez(
+            tmp,
+            grids=grids,
+            member_steps=steps,
+            member_done=done,
+            member_residual=res,
+            k=np.int64(k),
+            manifest=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8),
+            config=np.frombuffer(
+                config.to_json().encode(), dtype=np.uint8),
+            ensemble=np.frombuffer(
+                ensemble.to_json().encode(), dtype=np.uint8),
+            version=np.int64(_FORMAT_VERSION),
+        )
+        # np.savez appends .npz to names without it; the dotted tmp
+        # already ends in .npz via the basename.
+        _fsync_replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    for old in ensemble_generation_paths(stem)[:-keep]:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
+    return path
+
+
+def load_ensemble_checkpoint(path: str,
+                             expect_config: Optional[HeatConfig] = None
+                             ) -> Tuple[dict, HeatConfig,
+                                        EnsembleConfig, list]:
+    """Load one generation -> ``(state, config, ensemble, manifest)``
+    with ``state`` in the engine's resumable shape. When
+    ``expect_config`` is given, the SEMANTIC fields of the saved
+    config must match (the same self-description check the solver
+    checkpoints make — resuming a different simulation is an error,
+    not a silent reinterpretation)."""
+    with np.load(path) as z:
+        grids = z["grids"]
+        state = {"k": int(z["k"]),
+                 "grids": grids,
+                 "done": np.asarray(z["member_done"], bool),
+                 "res": np.asarray(z["member_residual"], np.float64),
+                 "steps": np.asarray(z["member_steps"], np.int64)}
+        config = HeatConfig.from_json(bytes(z["config"]).decode())
+        ensemble = EnsembleConfig.from_json(bytes(z["ensemble"]).decode())
+        manifest = json.loads(bytes(z["manifest"]).decode())
+    if grids.shape[0] != ensemble.members:
+        raise ValueError(
+            f"ensemble checkpoint {path!r} holds {grids.shape[0]} "
+            f"members but its manifest says {ensemble.members}")
+    if expect_config is not None:
+        from parallel_heat_tpu.config import SEMANTIC_FIELDS
+
+        for f in SEMANTIC_FIELDS:
+            if f == "steps":
+                continue  # the target may legitimately differ on resume
+            if getattr(config, f) != getattr(expect_config, f):
+                raise ValueError(
+                    f"ensemble checkpoint {path!r} was written for "
+                    f"{f}={getattr(config, f)!r}, the resuming config "
+                    f"has {f}={getattr(expect_config, f)!r}")
+    return state, config, ensemble, manifest

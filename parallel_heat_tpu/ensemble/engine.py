@@ -1,0 +1,686 @@
+"""The batched ensemble engine proper (see the package docstring).
+
+Batched runner construction mirrors ``solver._build_runner``'s
+discipline: runners are lru_cached on the OBSERVER-FREE solver config
+(``solver._observer_free``) plus the ORCHESTRATION-FREE ensemble
+extent (``EnsembleConfig.orchestration_free`` — in practice just B),
+so telemetry, guard/diag intervals, compaction thresholds and window
+cadences can never fork a compiled batched program (heatlint HL101
+audits both partitions).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from parallel_heat_tpu.config import EnsembleConfig, HeatConfig
+from parallel_heat_tpu.solver import (
+    _observer_free,
+    _resolve_backend,
+    _single_multistep,
+    make_initial_grid,
+)
+
+
+class EnsembleInterrupted(Exception):
+    """Raised by an ``on_boundary`` callback to stop the run at a
+    consistent boundary; carries the assembled full-order state so the
+    caller (the supervised loop) can flush it. ``reason`` is the
+    interrupt vocabulary of the solo supervisor (a signal name or a
+    flag-hook string such as ``"deadline"``)."""
+
+    def __init__(self, reason: str, state: dict):
+        super().__init__(reason)
+        self.reason = reason
+        self.state = state
+
+
+# ---------------------------------------------------------------------------
+# Batched observation reductions (member-axis analogues of
+# solver.grid_all_finite / solver.grid_stats — observation-only)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _ens_all_finite(u):
+    # One fused reduction pass, per member: (B, ...) -> (B,) bools.
+    return jnp.isfinite(u).reshape(u.shape[0], -1).all(axis=1)
+
+
+def ensemble_all_finite(grids) -> np.ndarray:
+    """Per-member non-finite guard: ``(B,)`` bools, one fused pass.
+    Observation-only, exactly like :func:`solver.grid_all_finite`."""
+    with jax.profiler.TraceAnnotation("heat:ens_guard"):
+        return np.asarray(_ens_all_finite(grids))
+
+
+@jax.jit
+def _ens_stats_solo(u):
+    B = u.shape[0]
+    flat = u.reshape(B, -1)
+    acc = (flat if jnp.dtype(u.dtype).itemsize >= 4
+           else flat.astype(jnp.float32))
+    return (jnp.min(flat, axis=1), jnp.max(flat, axis=1),
+            jnp.sum(acc, axis=1))
+
+
+@jax.jit
+def _ens_stats_delta(u, prev):
+    B = u.shape[0]
+    flat = u.reshape(B, -1)
+    acc = (flat if jnp.dtype(u.dtype).itemsize >= 4
+           else flat.astype(jnp.float32))
+    d = flat.astype(acc.dtype) - prev.reshape(B, -1).astype(acc.dtype)
+    return (jnp.min(flat, axis=1), jnp.max(flat, axis=1),
+            jnp.sum(acc, axis=1),
+            jnp.sqrt(jnp.sum(d * d, axis=1)),
+            jnp.max(jnp.abs(d), axis=1))
+
+
+def ensemble_grid_stats(grids, prev=None) -> List[dict]:
+    """Per-member fused grid diagnostics: a list of B dicts with the
+    :func:`solver.grid_stats` keys. Observation-only; note the batched
+    ``heat`` sums may differ in rounding from a solo ``grid_stats``
+    (reduction order) — diagnostics are observational floats, never
+    part of the bitwise member contract (SEMANTICS.md "Ensemble")."""
+    with jax.profiler.TraceAnnotation("heat:ens_diag"):
+        if prev is None:
+            mn, mx, heat = _ens_stats_solo(grids)
+            l2 = linf = None
+        else:
+            mn, mx, heat, l2, linf = _ens_stats_delta(grids, prev)
+        out = []
+        for i in range(int(grids.shape[0])):
+            out.append({"min": float(mn[i]), "max": float(mx[i]),
+                        "heat": float(heat[i]),
+                        "update_l2": (float(l2[i]) if l2 is not None
+                                      else None),
+                        "update_linf": (float(linf[i])
+                                        if linf is not None else None)})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Path selection
+# ---------------------------------------------------------------------------
+
+def ensemble_path(config: HeatConfig) -> str:
+    """``"M"`` (member-batched Pallas kernel) or ``"vmap"`` (vmap over
+    the jnp multistep family) for ``config``'s resolved backend. The
+    ONE decision site — the runner builder executes it and
+    ``solver.explain(..., ensemble=B)`` reports it."""
+    backend = _resolve_backend(config)
+    if backend == "pallas" and config.ndim == 2:
+        from parallel_heat_tpu.ops import batched
+
+        return batched.pick_ensemble_2d(config.shape, config.dtype,
+                                        config.accumulate)
+    return "vmap"
+
+
+def packable(config: HeatConfig):
+    """``(ok, reason)`` — may ``heatd`` coalesce jobs of this config
+    into one ensemble dispatch under the bitwise member-parity
+    contract? True exactly when the batched path computes the same
+    kernel the solo ``solve()`` would: the jnp backend (vmap is
+    member-bitwise by construction), or the Pallas backend where the
+    solo picker chooses the VMEM-resident kernel A (kernel M mirrors
+    it operation for operation). Everything else — sharded meshes,
+    streaming Pallas kernels with no batched twin — runs solo."""
+    try:
+        config = config.validate()
+    except ValueError as e:
+        return False, f"invalid config: {e}"
+    if any(d > 1 for d in config.mesh_or_unit()):
+        return False, "sharded configs run solo (no member axis across a mesh)"
+    backend = _resolve_backend(config)
+    if backend == "jnp":
+        return True, "vmap over the jnp multistep family (member-bitwise)"
+    path = ensemble_path(config)
+    if path == "M":
+        return True, "member-batched kernel M (bitwise the solo kernel A)"
+    return False, ("solo Pallas path has no member-bitwise batched "
+                   "twin (streaming kernel, or kernel M's tighter "
+                   "VMEM budget declined the geometry)")
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_multistep(config: HeatConfig, batch: int):
+    """(multi_step(u, k), multi_step_residual(u, k)) on a member-
+    batched ``(B, *shape)`` state, plus the path label. ``config``
+    must be observer-free and validated (the cache keys on it)."""
+    path = ensemble_path(config)
+    if path == "M":
+        from parallel_heat_tpu.ops import batched
+
+        ms, msr = batched.ensemble_multistep(
+            batch, config.shape, config.dtype, config.cx, config.cy)
+        return ms, msr, "M"
+    ms1, msr1 = _single_multistep(config, "jnp")
+
+    def ms(u, k):
+        return jax.vmap(lambda uu: ms1(uu, k))(u)
+
+    def msr(u, k):
+        return jax.vmap(lambda uu: msr1(uu, k))(u)
+
+    return ms, msr, "vmap"
+
+
+# ---------------------------------------------------------------------------
+# Runner builders (cached per observer-free config + member extent)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=128)
+def _build_fixed_runner(config: HeatConfig, batch: int, steps: int):
+    """jitted ``run(u) -> u`` advancing every member ``steps`` steps
+    (one donated dispatch — the member-axis analogue of the solver's
+    fixed-mode runner)."""
+    ms, _, _ = _batched_multistep(config, batch)
+
+    def run(u):
+        return ms(u, steps) if steps > 0 else u
+
+    return jax.jit(run, donate_argnums=0)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_converge_runner(config: HeatConfig, batch: int, windows: int):
+    """jitted ``run(u, done, res, steps_at, k) -> same`` advancing up
+    to ``windows`` check windows with per-member freeze.
+
+    Per window: ``multi_step_residual`` over the live batch, one fused
+    per-member residual vector, members whose residual drops below eps
+    latch their (residual, step) verdict and freeze (masked update —
+    their grid bits never change again). The loop exits early when
+    every member in the batch is done, so a fully-converged batch does
+    not burn its remaining windows. ``k`` is the absolute step count
+    the live members share (they advance in lockstep).
+    """
+    ms, msr, _ = _batched_multistep(config, batch)
+    ci = config.check_interval
+    eps = config.eps
+    mask_shape = (batch,) + (1,) * config.ndim
+
+    def cond(c):
+        _u, done, _res, _steps_at, _k, w = c
+        return jnp.logical_not(done.all()) & (w < windows)
+
+    def body(c):
+        u, done, res, steps_at, k, w = c
+        u_new, r = msr(u, ci)
+        k2 = k + ci
+        keep = done.reshape(mask_shape)
+        u = jnp.where(keep, u, u_new)       # frozen members keep their bits
+        res = jnp.where(done, res, r)       # latch at the converging window
+        steps_at = jnp.where(done, steps_at, k2)
+        done = done | (r < eps)
+        return u, done, res, steps_at, k2, w + 1
+
+    def run(u, done, res, steps_at, k):
+        u, done, res, steps_at, k, _ = lax.while_loop(
+            cond, body, (u, done, res, steps_at, k, jnp.int32(0)))
+        return u, done, res, steps_at, k
+
+    return jax.jit(run, donate_argnums=0)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_masked_tail_runner(config: HeatConfig, batch: int, rem: int):
+    """jitted masked tail: members not yet done run the ``rem``
+    leftover steps past the last full check window (the solo loop's
+    uninspected tail), frozen members pass through untouched."""
+    ms, _, _ = _batched_multistep(config, batch)
+    mask_shape = (batch,) + (1,) * config.ndim
+
+    def run(u, done):
+        u_new = ms(u, rem)
+        return jnp.where(done.reshape(mask_shape), u, u_new)
+
+    return jax.jit(run, donate_argnums=0)
+
+
+# ---------------------------------------------------------------------------
+# Results
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EnsembleResult:
+    """Outcome of one ensemble run, in ORIGINAL member order (member i
+    of the result is member i of the input, regardless of compaction
+    history)."""
+
+    grids: jax.Array                 # (B, *shape)
+    steps_run: np.ndarray            # (B,) int64
+    converged: Optional[np.ndarray]  # (B,) bool, converge mode only
+    residual: Optional[np.ndarray]   # (B,) float, converge mode only
+    elapsed_s: float
+    # Per-member guard verdicts / diagnostics samples (observation-
+    # only; None when the respective interval is unset).
+    finite: Optional[np.ndarray] = None
+    diagnostics: Optional[List[dict]] = None
+    # (step, from_members, to_members) per compaction event.
+    compactions: List[tuple] = field(default_factory=list)
+
+    @property
+    def members(self) -> int:
+        return int(self.grids.shape[0])
+
+    def member(self, i: int):
+        """Member ``i``'s view as a solver :class:`HeatResult` — how
+        the service fans packed results back to individual jobs."""
+        from parallel_heat_tpu.solver import HeatResult
+
+        return HeatResult(
+            grid=self.grids[i], steps_run=int(self.steps_run[i]),
+            converged=(bool(self.converged[i])
+                       if self.converged is not None else None),
+            residual=(float(self.residual[i])
+                      if self.residual is not None else None),
+            elapsed_s=self.elapsed_s,
+            finite=(bool(self.finite[i])
+                    if self.finite is not None else None),
+            diagnostics=(self.diagnostics[i]
+                         if self.diagnostics is not None else None))
+
+
+@dataclass
+class EnsembleBoundary:
+    """What an ``on_boundary`` callback sees after each dispatch:
+    global progress plus an ``assemble()`` hook producing the
+    full-order resumable state (the supervised loop checkpoints it)."""
+
+    step: int          # absolute steps the live members have run
+    batch: int         # current (possibly compacted) batch extent
+    live: int          # members still advancing
+    done_total: int    # members finished (parked or frozen in-batch)
+    live_grids: jax.Array  # the current (batch, *shape) state
+    assemble: Callable[[], dict]  # full-order {"k","grids","done","res","steps"}
+    # ORIGINAL member index of each position of the current batch —
+    # after a compaction, position i is NOT member i; anything that
+    # names members to a human (guard trips, diagnoses) must map
+    # positions through this.
+    order: tuple = ()
+
+
+# ---------------------------------------------------------------------------
+# The solver
+# ---------------------------------------------------------------------------
+
+class EnsembleSolver:
+    """B independent members of one semantic config, one compiled
+    program per dispatch. See the package docstring for the contracts
+    and ``solver.explain(config, ensemble=B)`` for the resolved path.
+    """
+
+    def __init__(self, config: HeatConfig,
+                 ensemble: Union[EnsembleConfig, int, None] = None):
+        if ensemble is None:
+            ensemble = EnsembleConfig()
+        elif isinstance(ensemble, int):
+            ensemble = EnsembleConfig(members=ensemble)
+        self.config = config.validate()
+        self.ensemble = ensemble.validate()
+        if any(d > 1 for d in self.config.mesh_or_unit()):
+            raise ValueError(
+                "EnsembleSolver is single-device per member: sharded "
+                "mesh_shape configs run solo (the member axis does not "
+                "span a mesh)")
+        # The observer-free config every runner cache keys on (HL101's
+        # contract, member-axis edition).
+        self._run_cfg = _observer_free(self.config)
+        self.batch = self.ensemble.members
+
+    # -- introspection ---------------------------------------------------
+
+    def explain(self) -> dict:
+        from parallel_heat_tpu.solver import explain
+
+        return explain(self.config, ensemble=self.ensemble.members)
+
+    @property
+    def path(self) -> str:
+        return ensemble_path(self._run_cfg)
+
+    # -- state construction ----------------------------------------------
+
+    def initial_grids(self, initials=None) -> jax.Array:
+        """The stacked ``(B, *shape)`` start state. ``initials`` may be
+        None (every member gets the model's initial condition), a
+        single grid (broadcast to every member), or a stacked
+        ``(B, *shape)`` array of per-member grids. Caller arrays are
+        copied (runners donate their input)."""
+        B = self.batch
+        shape = self.config.shape
+        dtype = jnp.dtype(self.config.dtype)
+        if initials is None:
+            one = make_initial_grid(self._run_cfg)
+            return jax.block_until_ready(jnp.copy(
+                jnp.broadcast_to(one.astype(dtype), (B,) + shape)))
+        arr = initials
+        if not isinstance(arr, jax.Array):
+            arr = np.asarray(arr)
+        if tuple(arr.shape) == shape:
+            return jax.block_until_ready(jnp.copy(jnp.broadcast_to(
+                jnp.asarray(arr).astype(dtype), (B,) + shape)))
+        if tuple(arr.shape) != (B,) + shape:
+            raise ValueError(
+                f"initials shape {tuple(arr.shape)} matches neither the "
+                f"member shape {shape} nor the stacked shape "
+                f"{(B,) + shape}")
+        return jax.block_until_ready(
+            jnp.copy(jnp.asarray(arr).astype(dtype)))
+
+    # -- the run ---------------------------------------------------------
+
+    def solve(self, initials=None, telemetry=None,
+              chunk_steps: Optional[int] = None,
+              on_boundary: Optional[Callable] = None,
+              state: Optional[dict] = None) -> EnsembleResult:
+        """Run every member to completion; returns an
+        :class:`EnsembleResult` in original member order.
+
+        Fixed mode runs ONE dispatch (the whole step budget fused)
+        unless ``chunk_steps`` is given, in which case the loop runs
+        host-visible chunks with ``on_boundary`` called after each —
+        the supervised loop's checkpoint/guard hook. Converge mode
+        always runs host windows (``EnsembleConfig.window_rounds``
+        check windows per dispatch): per-member verdicts are read at
+        each boundary, finished members freeze, and the batch compacts
+        when the live fraction drops below
+        ``EnsembleConfig.compact_threshold``.
+
+        ``state`` resumes from an assembled boundary state (the
+        ensemble checkpoint's payload): ``config.steps`` is the
+        ABSOLUTE step target and ``state["k"]`` the absolute step the
+        grids correspond to. ``on_boundary`` may raise
+        :class:`EnsembleInterrupted` (via its own logic) to stop at a
+        consistent boundary.
+        """
+        config = self.config
+        run_cfg = self._run_cfg
+        B = self.batch
+        guard_interval = config.guard_interval
+        diag_interval = config.diag_interval
+
+        if state is not None:
+            u = self.initial_grids(state["grids"])
+            k0 = int(state["k"])
+        else:
+            u = self.initial_grids(initials)
+            k0 = 0
+        total = config.steps
+
+        if telemetry is not None:
+            telemetry.run_header(
+                config, ensemble={"members": B, "path": self.path,
+                                  "window_rounds":
+                                      self.ensemble.window_rounds,
+                                  "compact_threshold":
+                                      self.ensemble.compact_threshold})
+
+        diag_prev = jnp.copy(u) if diag_interval is not None else None
+
+        t0 = time.perf_counter()
+        if not config.converge:
+            out = self._solve_fixed(run_cfg, u, k0, total, chunk_steps,
+                                    telemetry, on_boundary)
+        else:
+            out = self._solve_converge(run_cfg, u, k0, total, state,
+                                       telemetry, on_boundary)
+        grids, steps_run, converged, residual, compactions = out
+        elapsed = time.perf_counter() - t0
+
+        finite = None
+        if guard_interval is not None:
+            finite = ensemble_all_finite(grids)
+            if not finite.all():
+                import warnings
+
+                bad = [int(i) for i in np.where(~finite)[0]]
+                warnings.warn(
+                    f"runtime guard: non-finite grid values in ensemble "
+                    f"member(s) {bad} (coefficient sum past the "
+                    f"stability bound? see HeatConfig.stability_margin)",
+                    RuntimeWarning)
+        diagnostics = None
+        if diag_interval is not None:
+            diagnostics = ensemble_grid_stats(grids, prev=diag_prev)
+            for i, d in enumerate(diagnostics):
+                d["step"] = int(steps_run[i])
+                d["steps_since"] = int(steps_run[i]) - k0
+                if telemetry is not None:
+                    telemetry.diagnostics(member=i, **d)
+        if telemetry is not None:
+            for i in range(B):
+                telemetry.emit(
+                    "member_end", member=i, step=int(steps_run[i]),
+                    steps=int(steps_run[i]) - k0,
+                    converged=(bool(converged[i])
+                               if converged is not None else None),
+                    residual=(float(residual[i])
+                              if residual is not None else None),
+                    finite=(bool(finite[i]) if finite is not None
+                            else None))
+        return EnsembleResult(
+            grids=grids, steps_run=steps_run, converged=converged,
+            residual=residual, elapsed_s=elapsed, finite=finite,
+            diagnostics=diagnostics, compactions=compactions)
+
+    # -- fixed mode ------------------------------------------------------
+
+    def _solve_fixed(self, run_cfg, u, k0, total, chunk_steps,
+                     telemetry, on_boundary):
+        B = self.batch
+        remaining = total - k0
+        if remaining < 0:
+            raise ValueError(
+                f"resume state at step {k0} is past the target {total}")
+        chunk = chunk_steps if chunk_steps else max(1, remaining)
+        if run_cfg.accumulate == "f32chunk" and chunk_steps:
+            from parallel_heat_tpu.config import sublane_count
+
+            sub = sublane_count(run_cfg.dtype)
+            # Stream boundaries are rounding points (SEMANTICS.md):
+            # same round-up rule as solve_stream.
+            chunk = ((chunk + sub - 1) // sub) * sub
+        k = k0
+        while k < total:
+            c = min(chunk, total - k)
+            runner = _build_fixed_runner(run_cfg, B, c)
+            with jax.profiler.TraceAnnotation("heat:ens_chunk"):
+                u = runner(u)
+            k += c
+            if telemetry is not None:
+                telemetry.emit("ensemble_window", step=k, batch=B,
+                               live=(B if k < total else 0),
+                               done=(0 if k < total else B))
+            if on_boundary is not None:
+                uu = u
+
+                def assemble(_u=uu, _k=k):
+                    return {"k": _k, "grids": _u,
+                            "done": np.zeros(B, bool),
+                            "res": np.full(B, np.inf, np.float64),
+                            "steps": np.full(B, _k, np.int64)}
+
+                on_boundary(EnsembleBoundary(
+                    step=k, batch=B, live=B if k < total else 0,
+                    done_total=0 if k < total else B, live_grids=u,
+                    assemble=assemble, order=tuple(range(B))))
+        steps_run = np.full(B, total, np.int64)
+        return u, steps_run, None, None, []
+
+    # -- converge mode ---------------------------------------------------
+
+    def _solve_converge(self, run_cfg, u, k0, total, state,
+                        telemetry, on_boundary):
+        B = self.batch
+        ci = run_cfg.check_interval
+        eps = run_cfg.eps
+        n_full = total // ci
+        rem = total % ci
+        full_steps = n_full * ci
+        W = self.ensemble.window_rounds
+        thresh = self.ensemble.compact_threshold
+
+        # Original-order member bookkeeping. `order[pos]` is the
+        # original index of position `pos` of the current batch;
+        # parked members live outside the batch entirely.
+        order = list(range(B))
+        parked: dict = {}  # orig idx -> (grid, steps, res, converged)
+        compactions: List[tuple] = []
+
+        if state is not None:
+            done_h = np.asarray(state["done"], bool).copy()
+            res_h = np.asarray(state["res"], np.float64).copy()
+            steps_h = np.asarray(state["steps"], np.int64).copy()
+        else:
+            done_h = np.zeros(B, bool)
+            res_h = np.full(B, np.inf, np.float64)
+            steps_h = np.full(B, k0, np.int64)
+        # Members already done on entry are parked immediately (a
+        # resumed ensemble must not re-dispatch finished members).
+        if done_h.any():
+            for i in np.where(done_h)[0]:
+                parked[int(i)] = (u[int(i)], int(steps_h[i]),
+                                  float(res_h[i]), True)
+            live0 = [int(i) for i in np.where(~done_h)[0]]
+            order = live0
+            if live0:
+                u = jnp.take(u, jnp.asarray(live0), axis=0)
+
+        k = k0
+
+        def assemble_state(u_cur, done_cur, res_cur, steps_cur, k_cur,
+                           order_cur):
+            """Full-order resumable snapshot (host-side)."""
+            slices = {}
+            for pos, orig in enumerate(order_cur):
+                slices[orig] = (
+                    u_cur[pos], int(steps_cur[pos]),
+                    float(res_cur[pos]), bool(done_cur[pos]))
+            slices.update(parked)
+            grids = jnp.stack([slices[i][0] for i in range(B)])
+            return {"k": k_cur,
+                    "grids": grids,
+                    "done": np.array([slices[i][3] for i in range(B)]),
+                    "res": np.array([slices[i][2] for i in range(B)],
+                                    np.float64),
+                    "steps": np.array([slices[i][1] for i in range(B)],
+                                      np.int64)}
+
+        # In-batch per-member verdict state (device). Frozen members
+        # ride along (masked update) until a compaction parks them.
+        done_d = jnp.asarray(np.zeros(len(order), bool))
+        res_d = jnp.asarray(
+            np.array([res_h[i] for i in order], np.float32))
+        steps_d = jnp.asarray(
+            np.array([steps_h[i] for i in order], np.int32))
+
+        while order and k < full_steps:
+            cur_B = len(order)
+            w = min(W, (full_steps - k) // ci)
+            if w <= 0:
+                break
+            runner = _build_converge_runner(run_cfg, cur_B, w)
+            with jax.profiler.TraceAnnotation("heat:ens_chunk"):
+                u, done_d, res_d, steps_d, k_d = runner(
+                    u, done_d, res_d, steps_d, jnp.int32(k))
+            k = int(k_d)
+            done = np.asarray(done_d)
+            res_w = np.asarray(res_d, np.float64)
+            steps_w = np.asarray(steps_d, np.int64)
+            newly = [pos for pos in range(cur_B)
+                     if done[pos] and not done_h[order[pos]]]
+            for pos, orig in enumerate(order):
+                res_h[orig] = res_w[pos]
+                steps_h[orig] = steps_w[pos]
+                done_h[orig] = done[pos]
+            live = int((~done).sum())
+            if telemetry is not None:
+                telemetry.emit("ensemble_window", step=k, batch=cur_B,
+                               live=live, done=B - live)
+                for pos in newly:
+                    telemetry.emit("member_converged",
+                                   member=order[pos],
+                                   step=int(steps_w[pos]),
+                                   residual=float(res_w[pos]))
+            if on_boundary is not None:
+                on_boundary(EnsembleBoundary(
+                    step=k, batch=cur_B, live=live,
+                    done_total=B - live, live_grids=u,
+                    assemble=functools.partial(
+                        assemble_state, u, done, res_w, steps_w, k,
+                        list(order)),
+                    order=tuple(order)))
+            if live == 0:
+                break
+            if thresh is not None and live < cur_B and \
+                    live / cur_B < thresh:
+                # Compaction: park finished members, keep the live ones
+                # in a smaller batch. Member trajectories are invariant
+                # to this (masked freeze vs physical removal — pinned
+                # by tests/test_ensemble.py). At the default threshold
+                # 0.5 each compaction at least halves the batch, so a
+                # run compiles at most O(log B) batch extents.
+                live_pos = [int(p) for p in np.where(~done)[0]]
+                for pos in np.where(done)[0]:
+                    orig = order[int(pos)]
+                    parked[orig] = (u[int(pos)], int(steps_w[pos]),
+                                    float(res_w[pos]), True)
+                u = jnp.take(u, jnp.asarray(live_pos), axis=0)
+                new_order = [order[p] for p in live_pos]
+                compactions.append((k, cur_B, len(new_order)))
+                if telemetry is not None:
+                    telemetry.emit("ensemble_compaction", step=k,
+                                   from_members=cur_B,
+                                   to_members=len(new_order))
+                order = new_order
+                done_d = jnp.asarray(np.zeros(len(order), bool))
+                res_d = jnp.asarray(
+                    np.array([res_h[i] for i in order], np.float32))
+                steps_d = jnp.asarray(
+                    np.array([steps_h[i] for i in order], np.int32))
+
+        # Drain the batch: converged members park with their latched
+        # verdicts; the rest run the rem leftover steps past the last
+        # full window (solo's uninspected tail) and park unconverged.
+        if order:
+            done = np.array([done_h[i] for i in order])
+            # The tail only applies to members that ran out of full
+            # windows without converging, and only when this invocation
+            # actually reached the end of the window budget (a resumed
+            # already-complete state must not re-run it).
+            if rem > 0 and k < total and not done.all():
+                cur_B = len(order)
+                runner = _build_masked_tail_runner(run_cfg, cur_B, rem)
+                u = runner(u, jnp.asarray(done))
+                for orig in (o for pos, o in enumerate(order)
+                             if not done[pos]):
+                    steps_h[orig] = full_steps + rem
+            for pos, orig in enumerate(order):
+                parked[orig] = (u[pos], int(steps_h[orig]),
+                                float(res_h[orig]), bool(done_h[orig]))
+            order = []
+
+        grids = jnp.stack([parked[i][0] for i in range(B)])
+        steps_run = np.array([parked[i][1] for i in range(B)], np.int64)
+        residual = np.array([parked[i][2] for i in range(B)], np.float64)
+        converged = np.array([parked[i][3] for i in range(B)], bool)
+        if np.any(~np.isfinite(residual) & (steps_run >= ci)):
+            import warnings
+
+            warnings.warn(
+                "simulation diverged: non-finite residual in at least "
+                "one ensemble member (coefficient sum past the "
+                "stability bound? see HeatConfig.stability_margin)",
+                RuntimeWarning)
+        return grids, steps_run, converged, residual, compactions

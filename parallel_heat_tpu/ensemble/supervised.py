@@ -1,0 +1,353 @@
+"""Supervised ensemble runs: guard + retained generations + rollback.
+
+The member-axis edition of ``supervisor.run_supervised``, reusing its
+vocabulary wholesale — :class:`supervisor.SupervisorPolicy` for the
+cadences/budgets, :class:`supervisor.PermanentFailure` for terminal
+verdicts, the checkpoint stem lock, the flag-only SIGTERM/interrupt
+discipline — around :class:`ensemble.engine.EnsembleSolver`:
+
+- every ``checkpoint_every`` boundary commits one ensemble generation
+  (the FULL-ORDER member state — ``ensemble/checkpoint.py``), keeping
+  the newest ``keep_checkpoints``;
+- every guard boundary runs the fused per-member isfinite reduction
+  over the live batch; a trip rolls the WHOLE ensemble back to the
+  newest retained generation and retries under the policy's bounded
+  exponential backoff (member independence makes per-member rollback
+  unnecessary: a clean member's replayed trajectory is bitwise the
+  one it already ran — pinned by tests/test_ensemble.py);
+- SIGTERM/SIGINT (or the caller's flag-only ``interrupt`` hook, the
+  service deadline path) flushes a final generation at the boundary
+  and returns an interrupted result; resume continues every member
+  bit-exactly.
+
+``member_stems`` additionally flushes each member's state as a
+REGULAR per-member solver generation (``utils.checkpoint.
+save_generation``) at every checkpoint boundary — how the packed
+``heatd`` worker keeps every job solo-resumable: an orphaned pack's
+members requeue and continue as ordinary solo jobs from their own
+checkpoint lineage, bit-exactly (the parity contract makes the two
+paths interchangeable).
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from parallel_heat_tpu.config import EnsembleConfig, HeatConfig
+from parallel_heat_tpu.ensemble import checkpoint as ens_ckpt
+from parallel_heat_tpu.ensemble.engine import (
+    EnsembleInterrupted,
+    EnsembleResult,
+    EnsembleSolver,
+    ensemble_all_finite,
+)
+from parallel_heat_tpu.supervisor import (
+    PermanentFailure,
+    SupervisorPolicy,
+    _signal_handlers,
+    _StopFlag,
+)
+from parallel_heat_tpu.utils import checkpoint as ckpt
+
+
+class _EnsGuardTrip(Exception):
+    def __init__(self, step: int, bad: List[int]):
+        super().__init__(f"non-finite members {bad} at step {step}")
+        self.step = step
+        self.bad = bad
+
+
+@dataclass
+class EnsembleSupervisorResult:
+    """Outcome of one supervised ensemble invocation."""
+
+    result: Optional[EnsembleResult]
+    steps_done: int            # global boundary step of the newest state
+    interrupted: bool
+    retries: int
+    rollbacks: int
+    guard_trips: int
+    checkpoints_written: int
+    last_checkpoint: Optional[str]
+    signal_name: Optional[str] = None
+    wall_s: float = 0.0
+    # Per-member absolute steps of the newest flushed state (filled on
+    # both completion and interruption — the packed worker fans these
+    # into per-job result records).
+    member_steps: Optional[np.ndarray] = None
+
+
+def run_ensemble_supervised(config: HeatConfig,
+                            ensemble,
+                            stem,
+                            policy: Optional[SupervisorPolicy] = None,
+                            initials=None,
+                            telemetry=None,
+                            interrupt: Optional[Callable] = None,
+                            member_stems: Optional[Sequence[str]] = None,
+                            say=None) -> EnsembleSupervisorResult:
+    """Run the ensemble to completion under supervision; resumes from
+    the newest committed ensemble generation of ``stem`` when one
+    exists (``initials`` is then ignored — the checkpoint is the
+    authoritative state). ``ensemble`` is an
+    :class:`EnsembleConfig` or an int member count."""
+    if isinstance(ensemble, int):
+        ensemble = EnsembleConfig(members=ensemble)
+    config = config.validate()
+    ensemble = ensemble.validate()
+    policy = (policy or SupervisorPolicy()).validate()
+    say = say or (lambda *a: None)
+    stem = ckpt.checkpoint_stem(stem)
+    if member_stems is not None and len(member_stems) != ensemble.members:
+        raise ValueError(
+            f"member_stems has {len(member_stems)} entries for "
+            f"{ensemble.members} members")
+
+    release = ckpt.acquire_stem_lock(stem)
+    try:
+        return _run(config, ensemble, stem, policy, initials, telemetry,
+                    interrupt, member_stems, say)
+    finally:
+        release()
+
+
+def _run(config, ensemble, stem, policy, initials, telemetry,
+         interrupt, member_stems, say):
+    solver = EnsembleSolver(config, ensemble)
+    total = config.steps
+    guard_iv = policy.guard_interval or config.guard_interval
+    every = policy.checkpoint_every
+    # Checkpoint/guard boundaries must land on engine boundaries. In
+    # converge mode the engine's boundary grain is a dispatch window
+    # (window_rounds * check_interval steps); in fixed mode the chunk
+    # is chosen here, exactly like the solo supervisor's gcd rule.
+    chunk = math.gcd(every, guard_iv) if guard_iv else every
+    if config.accumulate == "f32chunk":
+        from parallel_heat_tpu.config import sublane_count
+
+        sub = sublane_count(config.dtype)
+        if every % sub or (guard_iv or sub) % sub:
+            # Same loud rule as the solo supervisor: stream boundaries
+            # are rounding points under f32chunk (SEMANTICS.md).
+            raise ValueError(
+                f"accumulate='f32chunk' requires checkpoint_every and "
+                f"guard_interval to be multiples of the chunk depth "
+                f"K={sub} (stream boundaries are rounding points)")
+
+    retries = rollbacks = trips = n_ckpt = 0
+    last_path: Optional[str] = None
+    clock = policy.clock
+    t0 = clock()
+    stop = _StopFlag()
+
+    state = None
+    src = ens_ckpt.latest_ensemble_checkpoint(stem)
+    if src is not None:
+        state, saved_cfg, saved_ens, _m = ens_ckpt.load_ensemble_checkpoint(
+            src, expect_config=config)
+        if saved_ens.members != ensemble.members:
+            raise ValueError(
+                f"ensemble checkpoint {src!r} holds {saved_ens.members} "
+                f"members; this run has {ensemble.members}")
+        say(f"Ensemble supervisor: resuming from {src} at step "
+            f"{state['k']}")
+
+    def emit(event, **fields):
+        if telemetry is not None:
+            telemetry.emit(event, **fields)
+
+    def save(st: dict) -> str:
+        nonlocal n_ckpt, last_path
+        t_save = clock()
+        last_path = ens_ckpt.save_ensemble_generation(
+            stem, st, config.replace(steps=total), ensemble,
+            keep=policy.keep_checkpoints)
+        n_ckpt += 1
+        emit("checkpoint_save", step=st["k"], path=str(last_path),
+             wall_s=clock() - t_save, kept=policy.keep_checkpoints,
+             generation=n_ckpt, ensemble=True)
+        say(f"Ensemble supervisor: generation at step {st['k']} -> "
+            f"{last_path}")
+        if member_stems is not None:
+            # Per-member solo-resumable generations (the packed-worker
+            # path): each member's grid is a perfectly ordinary solver
+            # checkpoint of its own job, stamped with ITS step.
+            for i, mstem in enumerate(member_stems):
+                ckpt.save_generation(
+                    mstem, st["grids"][i], int(st["steps"][i]),
+                    config.replace(steps=total),
+                    keep=policy.keep_checkpoints)
+        return last_path
+
+    next_ckpt = [0]  # next boundary at-or-after which to checkpoint
+    next_guard = [0]
+
+    def on_boundary(b):
+        # Interrupt first (flag-only; the flushed state must be the
+        # boundary state), then guard, then the periodic checkpoint.
+        why = None
+        if stop.signum is not None:
+            why = signal.Signals(stop.signum).name
+        elif interrupt is not None:
+            w = interrupt()
+            if w:
+                why = str(w)
+        if why is not None:
+            raise EnsembleInterrupted(why, b.assemble())
+        if guard_iv is not None and b.step >= next_guard[0]:
+            fin = ensemble_all_finite(b.live_grids)
+            while next_guard[0] <= b.step:
+                next_guard[0] += guard_iv
+            if not fin.all():
+                # Map batch positions to ORIGINAL member ids: after a
+                # compaction position i is not member i, and the trip
+                # telemetry / quarantine diagnosis name members to a
+                # human.
+                order = b.order or tuple(range(len(fin)))
+                bad = [int(order[p]) for p in np.where(~fin)[0]]
+                raise _EnsGuardTrip(b.step, bad)
+        if b.step >= next_ckpt[0] or b.live == 0:
+            save(b.assemble())
+            while next_ckpt[0] <= b.step:
+                next_ckpt[0] += every
+
+    def _interrupted(why: str, st: dict) -> EnsembleSupervisorResult:
+        save(st)
+        emit("signal", name=why, step=st["k"], ensemble=True)
+        if telemetry is not None:
+            telemetry.run_end(outcome="interrupted", steps_done=st["k"],
+                              signal=why, retries=retries,
+                              rollbacks=rollbacks, guard_trips=trips,
+                              checkpoints_written=n_ckpt,
+                              wall_s=clock() - t0)
+        say(f"Ensemble supervisor: caught {why}; newest generation "
+            f"{last_path}")
+        return EnsembleSupervisorResult(
+            result=None, steps_done=st["k"], interrupted=True,
+            retries=retries, rollbacks=rollbacks, guard_trips=trips,
+            checkpoints_written=n_ckpt, last_checkpoint=last_path,
+            signal_name=why, wall_s=clock() - t0,
+            member_steps=np.asarray(st["steps"], np.int64))
+
+    with _signal_handlers(stop):
+        # Generation zero before any step: rollback always has a
+        # target, even for a first-chunk fault (solo discipline).
+        if state is None:
+            u0 = solver.initial_grids(initials)
+            B = ensemble.members
+            state = {"k": 0, "grids": u0,
+                     "done": np.zeros(B, bool),
+                     "res": np.full(B, np.inf, np.float64),
+                     "steps": np.zeros(B, np.int64)}
+            save(state)
+            next_ckpt[0] = every
+        else:
+            next_ckpt[0] = (state["k"] // every + 1) * every
+        if guard_iv is not None:
+            next_guard[0] = (state["k"] // guard_iv + 1) * guard_iv
+
+        while True:
+            try:
+                result = solver.solve(
+                    telemetry=telemetry,
+                    chunk_steps=None if config.converge else chunk,
+                    on_boundary=on_boundary,
+                    state=state)
+                break
+            except EnsembleInterrupted as e:
+                return _interrupted(e.reason, e.state)
+            except _EnsGuardTrip as e:
+                trips += 1
+                emit("guard_trip", step=e.step, members=e.bad,
+                     ensemble=True)
+                if config.stability_margin() < 0:
+                    raise _fail(
+                        telemetry, clock, t0, retries, rollbacks, trips,
+                        n_ckpt,
+                        f"non-finite ensemble members {e.bad} at step "
+                        f"{e.step}: coefficient sum "
+                        f"{sum(config.coefficients):g} exceeds the "
+                        f"stability bound 1/2 — deterministic "
+                        f"divergence; retrying cannot help.",
+                        kind="unstable") from None
+                retries += 1
+                if retries > policy.max_retries:
+                    raise _fail(
+                        telemetry, clock, t0, retries, rollbacks, trips,
+                        n_ckpt,
+                        f"ensemble guard trip (members {e.bad}, step "
+                        f"{e.step}) persisted through "
+                        f"{policy.max_retries} rollback retries. "
+                        f"Newest verified generation: {last_path}.",
+                        kind="exhausted") from None
+                delay = min(policy.backoff_max_s,
+                            policy.backoff_base_s * 2 ** (retries - 1))
+                emit("retry", retry=retries,
+                     max_retries=policy.max_retries,
+                     kind=f"ensemble guard trip at step {e.step}",
+                     backoff_s=delay, ensemble=True)
+                say(f"Ensemble supervisor: guard trip (members "
+                    f"{e.bad}); retry {retries}/{policy.max_retries} "
+                    f"after {delay:g}s")
+                if delay > 0:
+                    policy.sleep_fn(delay)
+                src = ens_ckpt.latest_ensemble_checkpoint(stem)
+                if src is None:  # pragma: no cover (gen0 always exists)
+                    raise _fail(
+                        telemetry, clock, t0, retries, rollbacks, trips,
+                        n_ckpt,
+                        f"no ensemble generation of {stem!r} survives "
+                        f"to roll back to.") from None
+                state, _c, _e, _m = ens_ckpt.load_ensemble_checkpoint(
+                    src, expect_config=config)
+                rollbacks += 1
+                emit("rollback", step=state["k"], path=str(src),
+                     ensemble=True)
+                say(f"Ensemble supervisor: rolled back to {src} "
+                    f"(step {state['k']})")
+                if guard_iv is not None:
+                    next_guard[0] = (state["k"] // guard_iv + 1) * guard_iv
+                next_ckpt[0] = (state["k"] // every + 1) * every
+                continue
+
+        # Final generation: the completed full-order state, stamped
+        # with the furthest member step (converge runs may finish the
+        # whole ensemble well before the step budget).
+        k_final = int(result.steps_run.max()) if ensemble.members else 0
+        final_state = {
+            "k": k_final, "grids": result.grids,
+            "done": (result.converged if result.converged is not None
+                     else np.ones(ensemble.members, bool)),
+            "res": (result.residual if result.residual is not None
+                    else np.full(ensemble.members, np.inf, np.float64)),
+            "steps": result.steps_run}
+        save(final_state)
+        if telemetry is not None:
+            telemetry.run_end(outcome="complete", steps_done=k_final,
+                              retries=retries, rollbacks=rollbacks,
+                              guard_trips=trips,
+                              checkpoints_written=n_ckpt,
+                              wall_s=clock() - t0)
+        return EnsembleSupervisorResult(
+            result=result, steps_done=k_final, interrupted=False,
+            retries=retries, rollbacks=rollbacks, guard_trips=trips,
+            checkpoints_written=n_ckpt, last_checkpoint=last_path,
+            wall_s=clock() - t0,
+            member_steps=np.asarray(result.steps_run, np.int64))
+
+
+def _fail(telemetry, clock, t0, retries, rollbacks, trips, n_ckpt,
+          diagnosis: str, kind: str = "exhausted") -> PermanentFailure:
+    if telemetry is not None:
+        telemetry.emit("permanent_failure", diagnosis=diagnosis,
+                       kind=kind, ensemble=True)
+        telemetry.run_end(outcome="permanent_failure", kind=kind,
+                          retries=retries, rollbacks=rollbacks,
+                          guard_trips=trips, checkpoints_written=n_ckpt,
+                          wall_s=clock() - t0)
+    return PermanentFailure(diagnosis, kind=kind)

@@ -1,0 +1,212 @@
+"""Member-batched Pallas kernel (kernel M) for the ensemble engine.
+
+The ensemble hot path on a single chip: B independent small grids
+stacked on a leading member axis, advanced K steps per invocation by
+ONE ``pallas_call`` whose Mosaic grid iterates the member axis — each
+grid instance runs the whole VMEM-resident multi-step of kernel A
+(``ops/pallas_stencil._build_vmem_multistep``) on its member's block.
+Amortizing the per-dispatch latency over hundreds of members is
+exactly how the TPU Ising-model work (PAPERS.md: arXiv 1903.11714)
+turns small lattices into aggregate throughput.
+
+Parity contract (SEMANTICS.md "Ensemble"): kernel M's per-member
+arithmetic mirrors kernel A's strip schedule operation for operation —
+same strip decomposition, same coefficient-vector boundary pinning,
+same ping-pong order, same fused last-step residual — so a member of a
+batched run is bitwise the single-grid kernel-A run of the same
+config. ``pick_ensemble_2d`` admits exactly where ``pick_single_2d``
+would pick "A" (the VMEM-residence test), which is what makes the
+parity provable: the batched and solo paths compute the same kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_heat_tpu.ops.pallas_stencil import (
+    _ACC,
+    _compiler_params,
+    _interpret,
+    fits_vmem,
+)
+from parallel_heat_tpu.ops.tpu_params import params as _params
+
+
+def fits_vmem_batched(shape: Tuple[int, int], dtype) -> bool:
+    """Kernel M's OWN VMEM admission test — NOT kernel A's
+    ``fits_vmem``: the batched kernel's per-instance footprint is ~3x
+    kernel A's. With a Mosaic grid the in and out member blocks are
+    each double-buffered by the pipeline (4 grid-sized buffers — no
+    input/output aliasing across grid instances) plus the two
+    full-grid ping-pong scratch buffers, against kernel A's two
+    aliased buffers. Admitting on the solo test would pick geometries
+    Mosaic rejects with a scoped-vmem OOM near the limit — exactly
+    the HL402 contract ("a geometry the picker admits is one Mosaic
+    accepts") this tighter bound preserves."""
+    cells = shape[0] * shape[1]
+    temps = 4 * (128 + 2) * shape[1] * 4  # fits_vmem's strip-temp model
+    return (6 * cells * jnp.dtype(dtype).itemsize + temps
+            <= _params().resident_budget_bytes)
+
+
+def pick_ensemble_2d(shape: Tuple[int, int], dtype,
+                     accumulate: str = "storage"):
+    """The batched-kernel decision: ``"M"`` when the member-batched
+    VMEM-resident kernel admits (2D, storage accumulation, one member
+    grid inside kernel M's VMEM budget — a strict subset of the solo
+    picker's kernel-A admission, so the batched path is bitwise the
+    solo path wherever it runs), ``"vmap"`` otherwise (the general
+    path: vmap over the jnp multistep family). One decision site,
+    shared by the ensemble engine and ``solver.explain`` — the same
+    never-desynchronize rule as ``pick_single_2d``."""
+    if accumulate != "storage":
+        return "vmap"
+    if len(shape) != 2:
+        return "vmap"
+    return ("M" if fits_vmem(shape, dtype)
+            and fits_vmem_batched(shape, dtype) else "vmap")
+
+
+@functools.lru_cache(maxsize=32)
+def _build_ensemble_vmem_multistep(batch, shape, dtype_name, cx, cy, k,
+                                   strip_rows=128):
+    """K steps fully in VMEM for each of ``batch`` members; returns
+    ``fn(u) -> (u', residual)`` with ``u`` of shape ``(B, M, N)`` and
+    ``residual`` of shape ``(B,)`` (each member's interior max-norm of
+    the last step's update — the per-member convergence quantity).
+
+    The kernel body is kernel A's (`_build_vmem_multistep`) applied to
+    one member block per grid instance; see the module docstring for
+    the bitwise-parity contract that mirroring buys.
+    """
+    B = batch
+    M, N = shape
+    dtype = jnp.dtype(dtype_name)
+    assert k >= 1 and B >= 1
+
+    R = strip_rows
+    strips = []
+    r0 = 1
+    while r0 < M - 1:
+        h = min(R, M - 1 - r0)
+        strips.append((r0, h))
+        r0 += h
+
+    def kernel(u_ref, out_ref, res_ref, a_ref, b_ref):
+        # Identical arithmetic to kernel A, on this grid instance's
+        # (1, M, N) member block. The ping-pong pair is (a_ref, b_ref)
+        # scratch: with a Mosaic grid the output block is pipelined, so
+        # it cannot double as a loop buffer the way kernel A's aliased
+        # output does — the final state is copied into out_ref once.
+        cols = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        interior_c = (cols >= 1) & (cols <= N - 2)
+        a0 = 1.0 - 2.0 * cx - 2.0 * cy
+        a0v = jnp.where(interior_c, jnp.float32(a0), 1.0)
+        cxv = jnp.where(interior_c, jnp.float32(cx), 0.0)
+        cyv = jnp.where(interior_c, jnp.float32(cy), 0.0)
+
+        west = u_ref[0, :, 0:1]
+        east = u_ref[0, :, N - 1:N]
+        a_ref[:, :] = u_ref[0, :, :]
+
+        def strip_new(src, r, h):
+            blk = src[r - 1:r + h + 1, :].astype(_ACC)  # (h+2, N)
+            C = blk[1:-1]
+            U = blk[:-2]
+            D = blk[2:]
+            L = jnp.roll(C, 1, axis=1)
+            Rt = jnp.roll(C, -1, axis=1)
+            new = a0v * C + cxv * (U + D) + cyv * (L + Rt)
+            return new, C
+
+        def step_into(src, dst):
+            dst[0:1, :] = src[0:1, :]          # Dirichlet boundary rows
+            dst[M - 1:M, :] = src[M - 1:M, :]
+            for r, h in strips:
+                new, _ = strip_new(src, r, h)
+                dst[r:r + h, :] = new.astype(dtype)
+
+        m = k - 1  # plain steps; the last step also computes the residual
+
+        def double_step(_, carry):
+            del carry
+            step_into(a_ref, b_ref)
+            step_into(b_ref, a_ref)
+            return 0
+
+        lax.fori_loop(0, m // 2, double_step, 0)
+        if m % 2 == 1:
+            step_into(a_ref, b_ref)
+            src_ref, dst_ref = b_ref, a_ref
+        else:
+            src_ref, dst_ref = a_ref, b_ref
+
+        # Final step with fused residual, strip by strip.
+        dst_ref[0:1, :] = src_ref[0:1, :]
+        dst_ref[M - 1:M, :] = src_ref[M - 1:M, :]
+        r_acc = jnp.float32(0.0)
+        for r, h in strips:
+            new, C = strip_new(src_ref, r, h)
+            dst_ref[r:r + h, :] = new.astype(dtype)
+            r_acc = jnp.maximum(
+                r_acc,
+                # boundary columns contribute |C - C| = 0 by the vector
+                # coefficients, so no mask is needed here
+                jnp.max(jnp.abs(new - C)),
+            )
+        res_ref[0, 0] = r_acc
+        out_ref[0, :, :] = dst_ref[:, :]
+        out_ref[0, :, 0:1] = west
+        out_ref[0, :, N - 1:N] = east
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(B,),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, M, N), dtype),
+            jax.ShapeDtypeStruct((B, 1), _ACC),
+        ),
+        in_specs=[pl.BlockSpec((1, M, N), lambda b: (b, 0, 0))],
+        out_specs=(
+            pl.BlockSpec((1, M, N), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((M, N), dtype),
+                        pltpu.VMEM((M, N), dtype)],
+        name="heat_m_ens_vmem_multistep",
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )
+
+    def fn(u):
+        out, res = call(u)
+        return out, res[:, 0]
+
+    return fn
+
+
+def ensemble_multistep(batch: int, shape, dtype, cx, cy):
+    """``(multi_step(u, k), multi_step_residual(u, k))`` over a
+    ``(B, M, N)`` member-batched state via kernel M. The residual
+    variant returns a ``(B,)`` per-member residual vector."""
+    cx, cy = float(cx), float(cy)
+
+    def multi_step(u, k):
+        fn = _build_ensemble_vmem_multistep(batch, tuple(shape),
+                                            str(dtype), cx, cy, k)
+        return fn(u)[0]
+
+    def multi_step_residual(u, k):
+        fn = _build_ensemble_vmem_multistep(batch, tuple(shape),
+                                            str(dtype), cx, cy, k)
+        return fn(u)
+
+    return multi_step, multi_step_residual

@@ -48,6 +48,19 @@ def estimate_job_hbm_bytes(config: dict) -> int:
     return cells * itemsize * _RESIDENT_BUFFERS
 
 
+def estimate_pack_hbm_bytes(configs) -> int:
+    """Device-memory estimate of one PACKED ensemble dispatch: the sum
+    of the members' individual estimates. The batched engine's
+    resident set is linear in B (stacked double-buffer pair plus the
+    donation-protection/checkpoint copy per member — the same
+    ``_RESIDENT_BUFFERS`` model), so a pack of individually-admitted
+    jobs is automatically inside whatever ``hbm_budget_bytes`` the
+    admission gate already enforced member by member: packing changes
+    WHEN the memory is resident (one dispatch instead of ``slots``
+    staggered ones), never HOW MUCH the service committed to."""
+    return sum(estimate_job_hbm_bytes(c) for c in configs)
+
+
 def admission_verdict(config: dict, active_jobs: int,
                       active_hbm_bytes: int, max_queue_depth: int,
                       hbm_budget_bytes: Optional[int],

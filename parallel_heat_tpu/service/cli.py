@@ -81,6 +81,20 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="S",
                     help="serve for at most S seconds then drain "
                          "(harness/smoke use; default: until SIGTERM)")
+    sv.add_argument("--pack", action="store_true",
+                    help="ensemble packing: coalesce compatible fresh "
+                         "queued jobs (identical config + supervisor "
+                         "knobs, no deadline/faults) into one batched "
+                         "ensemble dispatch — per-member results fan "
+                         "back to the individual job records, bitwise "
+                         "the solo runs (SEMANTICS.md 'Ensemble')")
+    sv.add_argument("--pack-max", type=int, default=16, metavar="B",
+                    help="max members per packed dispatch (default 16)")
+    sv.add_argument("--pack-wait", type=float, default=0.0, metavar="S",
+                    help="coalescing dwell: hold a lone packable job "
+                         "this long before dispatching it solo, so "
+                         "bursts of compatible submissions pack "
+                         "together (default 0: greedy)")
     sv.add_argument("--chaos-kill-after-accept", type=int, default=None,
                     metavar="N",
                     help="CHAOS HARNESS ONLY: SIGKILL the daemon right "
@@ -161,6 +175,8 @@ def _cmd_serve(args) -> int:
         quarantine_after=args.quarantine_after,
         retry_after_s=args.retry_after,
         drain_grace_s=args.drain_grace,
+        pack_jobs=args.pack, pack_max=args.pack_max,
+        pack_wait_s=args.pack_wait,
         chaos_kill_after_accept=args.chaos_kill_after_accept)
     try:
         daemon = Heatd(cfg)

@@ -93,6 +93,26 @@ class HeatdConfig:
     # drain paths).
     kill_grace_s: float = 5.0
     drain_grace_s: float = 60.0
+    # Ensemble packing (SEMANTICS.md "Ensemble"): coalesce compatible
+    # due FRESH jobs (identical semantic config + supervisor knobs, no
+    # deadline, no fault plan, attempt 0, never requeued) into ONE
+    # packed worker running them as a batched ensemble program, up to
+    # pack_max members per dispatch. The pack consumes one slot. Each
+    # member's HBM was already counted by the admission gate at
+    # acceptance, so a pack can never exceed what admission allowed.
+    # The worker itself re-verifies runtime packability (the bitwise
+    # member-parity contract needs the resolved execution path, which
+    # requires the accelerator runtime the daemon deliberately never
+    # initializes) and demotes the whole pack to solo requeues when it
+    # does not hold — packing is a fast path, never a semantic change.
+    pack_jobs: bool = False
+    pack_max: int = 16
+    # Coalescing dwell: a packable job with no companion yet is held
+    # back from solo dispatch until it has been queued this long, so a
+    # burst of compatible submissions lands in one packed dispatch
+    # instead of the first arrival stealing a slot solo. 0 = dispatch
+    # greedily (packing still coalesces whatever is queued together).
+    pack_wait_s: float = 0.0
     # Extra environment for worker subprocesses (the chaos matrix pins
     # JAX_PLATFORMS=cpu here); inherits os.environ otherwise.
     worker_env: Optional[dict] = None
@@ -124,6 +144,9 @@ class HeatdConfig:
                 f"be >= worker_heartbeat_s ({self.worker_heartbeat_s}) "
                 f"— a timeout shorter than the write cadence declares "
                 f"every live worker dead")
+        if self.pack_max < 2:
+            raise ValueError(f"pack_max must be >= 2, got "
+                             f"{self.pack_max}")
         return self
 
 
@@ -152,6 +175,8 @@ class Heatd:
         self._term_pid: Dict[str, int] = {}
         self._accepts = 0
         self._draining = False
+        # job_id -> spec-derived pack key (see _spec_pack_key).
+        self._pack_key_cache: Dict[str, object] = {}
         # Incremental journal fold: byte offset consumed so far + the
         # folded state. Equivalent to store.replay() by the reducer's
         # fold law, but each pass parses only the appended events — a
@@ -490,15 +515,105 @@ class Heatd:
 
     # -- phase 5: dispatch -----------------------------------------------
 
+    def _spec_pack_key(self, job_id: str):
+        """The SPEC-derived half of the pack key (or None for a spec
+        that can never pack), cached per job id — committed specs are
+        immutable, and _dispatch consults the key for every queued job
+        on every poll tick, so re-reading the record each time would
+        turn a dwelling burst into O(jobs) disk reads per tick."""
+        if job_id in self._pack_key_cache:
+            return self._pack_key_cache[job_id]
+        try:
+            spec = self.store.load_spec(job_id)
+        except (OSError, ValueError):
+            return None  # not cached: the record may still be landing
+        if spec.faults is not None or spec.deadline_s is not None:
+            key = None
+        else:
+            # Every knob worker.execute_pack builds the SHARED
+            # SupervisorPolicy from must be in the key — a member
+            # running under another job's settings would be a silent
+            # semantics change.
+            key = (json.dumps(spec.config, sort_keys=True),
+                   spec.checkpoint_every, spec.guard_interval,
+                   spec.max_retries, spec.backoff_base_s)
+        self._pack_key_cache[job_id] = key
+        if len(self._pack_key_cache) > 4096:  # bound a long daemon's map
+            self._pack_key_cache.pop(next(iter(self._pack_key_cache)))
+        return key
+
+    def _pack_key(self, v: JobView):
+        """Compatibility key for ensemble packing, or None when this
+        job must run solo. FRESH jobs only (attempt 0, never requeued):
+        a member with history has checkpoint lineage or per-attempt
+        state the batched fresh-start program would ignore. The key is
+        the full semantic config (byte-equal JSON) plus the supervisor
+        knobs the packed run shares; deadlines and fault plans are
+        per-job machinery the pack deliberately refuses."""
+        if v.attempts > 0 or v.requeues > 0 or v.cancel_requested \
+                or v.deadline_t is not None:
+            return None
+        return self._spec_pack_key(v.job_id)
+
     def _dispatch(self, now: float) -> None:
         cfg = self.config
         jobs, _ = self._replay()
-        running = sum(1 for v in jobs.values() if v.state == "running")
+        # Slot accounting counts WORKERS, not jobs: a packed dispatch
+        # runs many jobs in one process and consumes one slot.
+        running = len({v.worker for v in jobs.values()
+                       if v.state == "running" and v.worker})
         due = sorted((v for v in jobs.values()
                       if v.state == "queued" and v.not_before <= now),
                      key=lambda v: (v.accepted_t or 0.0, v.job_id))
         j = self.store.journal
+        packed: set = set()
+        if cfg.pack_jobs and len(due) > 1:
+            groups: Dict[object, list] = {}
+            for v in due:
+                key = self._pack_key(v)
+                if key is not None:
+                    groups.setdefault(key, []).append(v)
+            for key in sorted(groups, key=str):
+                members = groups[key]
+                while len(members) >= 2 and running < cfg.slots:
+                    batch = members[:cfg.pack_max]
+                    members = members[len(batch):]
+                    if len(batch) < 2:
+                        break
+                    leader = batch[0]
+                    wid = f"w-{leader.job_id}-a001-p{len(batch):03d}"
+                    # Journal every member BEFORE spawn (the solo
+                    # ordering rule): a crash in between leaves
+                    # dispatched jobs with no live worker — reconcile
+                    # orphans and requeues them, and requeued members
+                    # are no longer fresh, so the retry runs solo.
+                    for v in batch:
+                        j.append("dispatched", job_id=v.job_id,
+                                 worker=wid, attempt=v.attempts + 1,
+                                 pack=leader.job_id,
+                                 pack_size=len(batch))
+                    try:
+                        handle = self._launch_pack(batch, wid)
+                    except OSError as e:
+                        for v in batch:
+                            j.append("orphaned", job_id=v.job_id,
+                                     worker=wid, attempt=v.attempts + 1,
+                                     reason=f"worker spawn failed: {e}")
+                        continue
+                    for v in batch:
+                        self._procs[v.job_id] = handle
+                        packed.add(v.job_id)
+                    running += 1
         for v in due:
+            if v.job_id in packed:
+                continue
+            if cfg.pack_jobs and cfg.pack_wait_s > 0 \
+                    and v.accepted_t is not None \
+                    and now - v.accepted_t < cfg.pack_wait_s \
+                    and self._pack_key(v) is not None:
+                # Coalescing dwell: hold a lone packable job briefly —
+                # a compatible companion may be right behind it.
+                continue
             if running >= cfg.slots:
                 break
             attempt = v.attempts + 1
@@ -524,17 +639,17 @@ class Heatd:
             self._procs[v.job_id] = handle
             running += 1
 
-    def _launch(self, v: JobView, worker_id: str, attempt: int):
+    def _spawn_worker(self, job_args, worker_id: str):
+        """Shared subprocess plumbing for solo AND packed dispatches
+        (one site to evolve env/log handling): spawn
+        ``python -m parallel_heat_tpu.service.worker`` with
+        ``job_args`` + the common flags, stdout/stderr to the worker
+        log."""
         cfg = self.config
-        if cfg.launcher is not None:
-            return cfg.launcher(job_id=v.job_id, worker_id=worker_id,
-                                attempt=attempt, deadline_t=v.deadline_t)
         argv = [sys.executable, "-m", "parallel_heat_tpu.service.worker",
-                "--root", self.store.root, "--job", v.job_id,
-                "--worker", worker_id, "--attempt", str(attempt),
+                "--root", self.store.root, *job_args,
+                "--worker", worker_id,
                 "--hb-interval", str(cfg.worker_heartbeat_s)]
-        if v.deadline_t is not None:
-            argv += ["--deadline-t", repr(v.deadline_t)]
         env = dict(os.environ)
         # The worker must import this package regardless of the
         # daemon's cwd (the CLI may be launched from anywhere).
@@ -553,6 +668,31 @@ class Heatd:
         finally:
             log.close()  # Popen holds its own duplicate
 
+    def _launch(self, v: JobView, worker_id: str, attempt: int):
+        cfg = self.config
+        if cfg.launcher is not None:
+            return cfg.launcher(job_id=v.job_id, worker_id=worker_id,
+                                attempt=attempt, deadline_t=v.deadline_t)
+        job_args = ["--job", v.job_id, "--attempt", str(attempt)]
+        if v.deadline_t is not None:
+            job_args += ["--deadline-t", repr(v.deadline_t)]
+        return self._spawn_worker(job_args, worker_id)
+
+    def _launch_pack(self, batch, worker_id: str):
+        """Spawn ONE worker process running ``batch`` as a packed
+        ensemble dispatch (``service/worker.py --jobs``). Injectable
+        like the solo launcher: a configured ``launcher`` receives the
+        extra ``job_ids`` keyword (inline test harnesses run
+        ``worker.execute_pack`` directly)."""
+        cfg = self.config
+        job_ids = [v.job_id for v in batch]
+        if cfg.launcher is not None:
+            return cfg.launcher(job_id=job_ids[0], worker_id=worker_id,
+                                attempt=1, deadline_t=None,
+                                job_ids=job_ids)
+        return self._spawn_worker(["--jobs", ",".join(job_ids)],
+                                  worker_id)
+
     # -- phase 6: status heartbeat ---------------------------------------
 
     def _publish_status(self, now: float) -> dict:
@@ -563,7 +703,10 @@ class Heatd:
         doc = {"pid": os.getpid(), "t_wall": now,
                "state": "draining" if self._draining else "serving",
                "slots": self.config.slots,
-               "running_workers": len(self._procs),
+               # Distinct processes: a packed dispatch maps several
+               # jobs onto one worker handle.
+               "running_workers": len({id(h)
+                                       for h in self._procs.values()}),
                "poll_interval_s": self.config.poll_interval_s,
                "counts": counts, "anomalies": len(anomalies)}
         self.store.write_daemon_status(doc)

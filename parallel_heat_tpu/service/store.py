@@ -473,6 +473,15 @@ class JobStore:
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, "ck")
 
+    def pack_stem(self, worker_id: str) -> str:
+        """Ensemble-generation stem of one packed dispatch (rollback
+        targets while the pack runs). Distinct from every per-job
+        ``checkpoint_stem`` so a member's later SOLO resume can never
+        confuse the two generation families."""
+        d = os.path.join(self.root, "ck", f"pack-{worker_id}")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, "ens")
+
     def telemetry_path(self, job_id: str) -> str:
         return os.path.join(self.root, "telemetry", f"{job_id}.jsonl")
 

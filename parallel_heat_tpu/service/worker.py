@@ -194,13 +194,147 @@ def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
             hb.stop()
 
 
+def execute_pack(root: str, job_ids, worker_id: str,
+                 hb_interval_s: Optional[float] = None,
+                 say=None) -> int:
+    """Run one PACKED dispatch: N compatible fresh jobs as one
+    ensemble program (``heatd serve --pack``). The contract that makes
+    this safe is bitwise member parity (SEMANTICS.md "Ensemble"): a
+    member's results — grids, checkpoints, verdicts — are exactly what
+    its solo run would produce, so per-member results fan back to the
+    individual job records and any member can later resume SOLO from
+    its own per-job checkpoint lineage (flushed every boundary via
+    ``member_stems``). Anything that breaks the pack's assumptions —
+    mismatched specs, an unpackable resolved path, a member with
+    pre-existing checkpoints — demotes gracefully: every member gets a
+    ``preempted`` record, the daemon requeues, and the non-fresh retry
+    dispatches solo."""
+    say = say or (lambda *a: None)
+    store = JobStore(root, create=False)
+    job_ids = list(job_ids)
+    t0 = time.perf_counter()
+
+    def record_all(outcome: str, per_member=None, **fields) -> None:
+        for i, jid in enumerate(job_ids):
+            doc = {"outcome": outcome, "worker": worker_id,
+                   "attempt": 1, "job_id": jid, "pack": job_ids[0],
+                   "pack_size": len(job_ids),
+                   "wall_s": time.perf_counter() - t0}
+            doc.update(fields)
+            if per_member is not None:
+                doc.update(per_member[i])
+            store.write_result(jid, 1, doc)
+
+    def demote(why: str) -> int:
+        # Not a failure: the members are fine, the PACK was wrong.
+        # Preempted records requeue every member; non-fresh members
+        # never pack again, so the retry runs the proven solo path.
+        say(f"pack {worker_id}: demoting to solo — {why}")
+        record_all("preempted", reason=f"unpackable: {why}",
+                   steps_done=0)
+        return EXIT_PREEMPTED
+
+    hb = None
+    if hb_interval_s:
+        hb = _HeartbeatWriter(store, worker_id, job_ids[0], 1,
+                              hb_interval_s)
+        hb.start()
+    telemetry = Telemetry(store.telemetry_path(f"pack-{worker_id}"),
+                          async_io=True)
+    try:
+        try:
+            specs = [store.load_spec(jid) for jid in job_ids]
+            config = HeatConfig.from_json(
+                json.dumps(specs[0].config)).validate()
+        except Exception as e:  # noqa: BLE001 — any unloadable spec
+            record_all("permanent_failure", kind="bad_spec",
+                       diagnosis=f"cannot materialize pack spec: {e}")
+            return EXIT_PERMANENT_FAILURE
+        key0 = json.dumps(specs[0].config, sort_keys=True)
+        for s in specs[1:]:
+            # Everything the shared SupervisorPolicy below is built
+            # from must match — a member silently running under the
+            # leader's knobs would be a semantics change, not a fast
+            # path.
+            if json.dumps(s.config, sort_keys=True) != key0 \
+                    or s.checkpoint_every != specs[0].checkpoint_every \
+                    or s.guard_interval != specs[0].guard_interval \
+                    or s.max_retries != specs[0].max_retries \
+                    or s.backoff_base_s != specs[0].backoff_base_s:
+                return demote("member specs diverged after dispatch")
+        from parallel_heat_tpu.ensemble.engine import packable
+
+        ok, reason = packable(config)
+        if not ok:
+            return demote(reason)
+        from parallel_heat_tpu.service.admission import (
+            estimate_pack_hbm_bytes)
+
+        telemetry.emit("pack_header", pack=job_ids[0],
+                       members=len(job_ids), job_ids=job_ids,
+                       est_hbm_bytes=estimate_pack_hbm_bytes(
+                           [s.config for s in specs]))
+        member_stems = [store.checkpoint_stem(jid) for jid in job_ids]
+        if any(ckpt.latest_checkpoint(st) is not None
+               for st in member_stems):
+            return demote("a member already has solo checkpoint lineage")
+
+        policy = SupervisorPolicy(
+            checkpoint_every=(specs[0].checkpoint_every
+                              or default_checkpoint_every(config)),
+            guard_interval=specs[0].guard_interval,
+            max_retries=specs[0].max_retries,
+            backoff_base_s=specs[0].backoff_base_s)
+        from parallel_heat_tpu.ensemble.supervised import (
+            run_ensemble_supervised)
+
+        try:
+            sres = run_ensemble_supervised(
+                config, len(job_ids), store.pack_stem(worker_id),
+                policy=policy, telemetry=telemetry,
+                member_stems=member_stems, say=say)
+        except ckpt.StemLockError as e:
+            record_all("permanent_failure", kind="stem_locked",
+                       diagnosis=str(e))
+            return EXIT_PERMANENT_FAILURE
+        except PermanentFailure as e:
+            record_all("permanent_failure", kind=e.kind,
+                       diagnosis=e.diagnosis)
+            return EXIT_PERMANENT_FAILURE
+
+        steps = sres.member_steps
+        if sres.interrupted:
+            record_all("preempted", reason=sres.signal_name,
+                       per_member=[{"steps_done": int(steps[i])}
+                                   for i in range(len(job_ids))],
+                       last_checkpoint=(str(sres.last_checkpoint)
+                                        if sres.last_checkpoint
+                                        else None))
+            return EXIT_PREEMPTED
+        per = []
+        for i in range(len(job_ids)):
+            m = sres.result.member(i)
+            per.append({"steps_done": int(steps[i]),
+                        "converged": m.converged,
+                        "residual": m.residual})
+        record_all("completed", per_member=per, retries=sres.retries)
+        return 0
+    finally:
+        telemetry.close()
+        if hb is not None:
+            hb.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="parallel_heat_tpu.service.worker",
         description="heatd worker: one process, one job attempt "
                     "(normally launched by the daemon, not by hand)")
     ap.add_argument("--root", required=True)
-    ap.add_argument("--job", required=True)
+    ap.add_argument("--job", default=None)
+    ap.add_argument("--jobs", default=None, metavar="ID,ID,...",
+                    help="packed dispatch: run these compatible jobs "
+                         "as one ensemble program")
     ap.add_argument("--worker", required=True)
     ap.add_argument("--attempt", type=int, default=1)
     ap.add_argument("--hb-interval", type=float, default=None)
@@ -209,6 +343,13 @@ def main(argv=None) -> int:
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     say = print if args.verbose else None
+    if args.jobs:
+        return execute_pack(args.root,
+                            [j for j in args.jobs.split(",") if j],
+                            args.worker, hb_interval_s=args.hb_interval,
+                            say=say)
+    if not args.job:
+        ap.error("one of --job / --jobs is required")
     return execute_job(args.root, args.job, args.worker, args.attempt,
                        deadline_t=args.deadline_t,
                        hb_interval_s=args.hb_interval, say=say)

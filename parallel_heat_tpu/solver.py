@@ -492,7 +492,7 @@ def _prepare_initial(config: HeatConfig,
     return jax.block_until_ready(out)
 
 
-def explain(config: HeatConfig) -> dict:
+def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
     """Resolve — without running anything — which execution path a
     config takes: backend, mesh, and the exact kernel/pick the solver's
     factories would choose. Surfaced by the CLI as ``--explain``;
@@ -506,6 +506,11 @@ def explain(config: HeatConfig) -> dict:
     mirroring once desynchronized exactly the decline cases --explain
     exists for (the kernel-C omission, see test_explain_sharded_tiled_
     fallback). Only the label formatting lives here.
+
+    ``ensemble`` (a member count B) additionally reports the batched
+    ensemble engine's resolved path for this config — the same
+    ``ensemble.engine.ensemble_path`` decision the engine executes —
+    plus the daemon-packing verdict (``ensemble.engine.packable``).
     """
     config = config.validate()
     config, backend, auto_depth = _resolved(config)
@@ -518,6 +523,23 @@ def explain(config: HeatConfig) -> dict:
         "mesh": mesh_shape if is_sharded else None,
         "mode": "converge" if config.converge else "fixed",
     }
+    if ensemble is not None:
+        from parallel_heat_tpu.ensemble.engine import (
+            ensemble_path, packable)
+
+        path = (None if is_sharded
+                else ensemble_path(_observer_free(config)))
+        ok, reason = packable(config)
+        out["ensemble"] = {
+            "members": int(ensemble),
+            "path": ("kernel M (member-batched VMEM-resident "
+                     "multi-step)" if path == "M"
+                     else "vmap over the jnp multistep family"
+                     if path == "vmap"
+                     else "unsupported (sharded members run solo)"),
+            "packable": ok,
+            "packable_reason": reason,
+        }
     if config.guard_interval is not None:
         out["guard"] = (f"isfinite-all every {config.guard_interval} "
                         f"steps (observation-only)")

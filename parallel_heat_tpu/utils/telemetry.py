@@ -29,7 +29,19 @@ an envelope — ``schema``, ``event``, ``t_wall`` (unix seconds),
   latency rides the ``rollback`` event as ``load_wall_s``);
 - supervisor lifecycle: ``guard_trip``, ``progress_trip`` (residual
   stall / heat-content drift), ``retry``, ``rollback``, ``signal``,
-  ``permanent_failure``, ``run_end``.
+  ``permanent_failure``, ``run_end``;
+- ensemble events (the batched engine, SEMANTICS.md "Ensemble" —
+  member-scoped events carry a ``member`` field, the member-axis
+  extension of this schema): ``ensemble_window`` (per dispatch window:
+  ``step``/``batch``/``live``/``done``), ``member_converged`` (a
+  member's epsilon verdict latched: ``member``/``step``/``residual``),
+  ``member_end`` (per-member terminal row: ``member``/``step``/
+  ``converged``/``residual``/``finite``), ``ensemble_compaction``
+  (``step``/``from_members``/``to_members``), ``pack_header`` (a
+  packed heatd dispatch: ``pack``/``members``/``job_ids``/
+  ``est_hbm_bytes``); per-member ``diagnostics`` samples likewise
+  carry ``member``. ``tools/metrics_report.py``'s ensemble section
+  aggregates these.
 
 The envelope also carries ``process_index``/``process_count``;
 multi-process runs shard the JSONL and heartbeat per process

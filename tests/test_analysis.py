@@ -168,6 +168,51 @@ def test_hl101_real_partition_is_clean():
     assert audit_cache_keys(scan_paths=[]) == []
 
 
+def test_hl101_ensemble_partition_is_clean():
+    """The second HL101 audit (PR 9): the EnsembleConfig semantic /
+    orchestration partition against its own strip site, run by the
+    registered rule alongside the HeatConfig audit."""
+    from parallel_heat_tpu.analysis.contracts import audit_cache_keys_all
+    from parallel_heat_tpu.config import (
+        ENSEMBLE_ORCHESTRATION_FIELDS,
+        ENSEMBLE_SEMANTIC_FIELDS,
+        EnsembleConfig,
+    )
+
+    out = audit_cache_keys(
+        config_cls=EnsembleConfig,
+        semantic=ENSEMBLE_SEMANTIC_FIELDS,
+        observation=ENSEMBLE_ORCHESTRATION_FIELDS,
+        strip=lambda c: c.orchestration_free(), scan_paths=[])
+    assert out == []
+    # The registered rule runs BOTH partitions and stays clean.
+    assert [f for f in audit_cache_keys_all()
+            if f.severity == "error"] == []
+
+
+def test_hl101_new_ensemble_field_regression():
+    """A new EnsembleConfig field added without classification must
+    fail the registered audit — the member-axis edition of the
+    new-HeatConfig-field regression."""
+    from parallel_heat_tpu.config import (
+        ENSEMBLE_ORCHESTRATION_FIELDS,
+        ENSEMBLE_SEMANTIC_FIELDS,
+        EnsembleConfig,
+    )
+
+    doctored = dataclasses.make_dataclass(
+        "DoctoredEnsemble",
+        [("pack_hint", int, dataclasses.field(default=0))],
+        bases=(EnsembleConfig,), frozen=True)
+    out = audit_cache_keys(
+        config_cls=doctored,
+        semantic=ENSEMBLE_SEMANTIC_FIELDS,
+        observation=ENSEMBLE_ORCHESTRATION_FIELDS,
+        strip=lambda c: c.orchestration_free(), scan_paths=[])
+    assert any("'pack_hint'" in f.message and f.severity == "error"
+               for f in out), out
+
+
 def test_hl101_unstripped_build_runner_caller(tmp_path):
     bad = _fixture(tmp_path, "bad_caller.py", """
         from parallel_heat_tpu.solver import _build_runner
@@ -1006,9 +1051,14 @@ def test_hl401_data_dependent_window_unprovable():
 def test_hl4xx_real_kernels_clean_and_all_sites_covered():
     """The acceptance gate for the kernel layer: every builder passes
     at its representative geometry, and the audit's coverage
-    cross-check pins all 17 pallas_call sites in pallas_stencil.py."""
+    cross-check pins all 18 pallas_call sites across
+    pallas_stencil.py and the member-batched ops/batched.py (kernel M
+    joined in PR 9 — a 19th site fails this count AND the uncovered-
+    site cross-check until it gets an audit target)."""
     assert audit_kernels() == []
-    assert len(_source_kernel_names()) == 17
+    names = _source_kernel_names()
+    assert len(names) == 18
+    assert "heat_m_ens_vmem_multistep" in names
 
 
 def test_hl401_uncovered_site_mechanism():
@@ -1359,4 +1409,26 @@ def test_ast_scan_covers_service_package():
         assert os.path.join(svc, mod) in scanned, mod
     assert os.path.join(REPO_ROOT, "tools", "heatq.py") in scanned
     findings = lint_paths([svc])
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_ast_scan_covers_ensemble_package():
+    """`parallel_heat_tpu/ensemble/` (+ the batched kernel module)
+    rides the HL2xx gate like the service layer — and the tree stays
+    clean with the baseline ledger empty (ISSUE 9)."""
+    from parallel_heat_tpu.analysis.astlint import (
+        REPO_ROOT,
+        _iter_py_files,
+        default_scan_paths,
+        lint_paths,
+    )
+
+    scanned = set(_iter_py_files(default_scan_paths()))
+    ens = os.path.join(REPO_ROOT, "parallel_heat_tpu", "ensemble")
+    for mod in ("engine.py", "checkpoint.py", "supervised.py"):
+        assert os.path.join(ens, mod) in scanned, mod
+    batched = os.path.join(REPO_ROOT, "parallel_heat_tpu", "ops",
+                           "batched.py")
+    assert batched in scanned
+    findings = lint_paths([ens, batched])
     assert [f for f in findings if f.severity == "error"] == []

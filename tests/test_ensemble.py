@@ -1,0 +1,803 @@
+"""Ensemble engine tests (SEMANTICS.md "Ensemble").
+
+The load-bearing pins, in contract order:
+
+- **member parity**: every member of a batched run is bitwise the
+  single-grid ``solve()`` of the same spec — fixed, converge and
+  f32chunk modes, on the vmap path and the member-batched Pallas
+  kernel M (interpret mode);
+- **compaction invariance**: a member's trajectory does not depend on
+  when (or whether) other members finish;
+- **checkpoint/resume**: ensemble generations are crash-atomic, prune
+  correctly, and a supervised interrupt + resume (and a guard-trip
+  rollback) reproduce the uninterrupted run bit-exactly per member
+  (the chaos cell);
+- **packing**: the heatd scheduler coalesces compatible fresh jobs
+  into one dispatch, fans per-member results back to the individual
+  job records bitwise the solo runs, and demotes incompatible or
+  interrupted packs to the proven solo path.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import EnsembleConfig, HeatConfig, solve
+from parallel_heat_tpu.ensemble import checkpoint as ens_ckpt
+from parallel_heat_tpu.ensemble.engine import (
+    EnsembleSolver,
+    ensemble_all_finite,
+    ensemble_grid_stats,
+    ensemble_path,
+    packable,
+)
+from parallel_heat_tpu.ensemble.supervised import run_ensemble_supervised
+from parallel_heat_tpu.supervisor import PermanentFailure, SupervisorPolicy
+from parallel_heat_tpu.utils import checkpoint as ckpt
+
+
+def _inits(n, shape, scale=5.0, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.rand(*shape).astype(np.float32) * scale
+                     for _ in range(n)])
+
+
+def _bits(a):
+    a = np.asarray(a)
+    if a.dtype == np.float32:
+        return a.view(np.uint32)
+    if a.dtype.itemsize == 2:
+        return a.view(np.uint16)
+    return a.view(np.uint64)
+
+
+def assert_member_bitwise(ens_grid, solo_grid, label=""):
+    __tracebackhide__ = True
+    assert np.array_equal(_bits(ens_grid), _bits(solo_grid)), label
+
+
+# ---------------------------------------------------------------------------
+# Member parity: batched == solo, bitwise
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_fixed_jnp_bitwise(self):
+        cfg = HeatConfig(nx=18, ny=22, steps=37, backend="jnp")
+        inits = _inits(4, (18, 22))
+        r = EnsembleSolver(cfg, 4).solve(initials=inits)
+        assert r.converged is None and r.residual is None
+        for i in range(4):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+            assert int(r.steps_run[i]) == solo.steps_run == 37
+
+    def test_converge_jnp_bitwise_per_member_verdicts(self):
+        cfg = HeatConfig(nx=18, ny=22, steps=4000, converge=True,
+                         eps=1e-3, check_interval=20, backend="jnp")
+        base = _inits(1, (18, 22))[0]
+        inits = np.stack([base * s for s in (0.1, 1.0, 10.0, 40.0)])
+        r = EnsembleSolver(cfg, EnsembleConfig(
+            members=4, window_rounds=2)).solve(initials=inits)
+        # Different members converge at different steps...
+        assert len(set(r.steps_run.tolist())) > 1
+        for i in range(4):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+            assert int(r.steps_run[i]) == solo.steps_run, i
+            assert bool(r.converged[i]) == bool(solo.converged), i
+            assert float(r.residual[i]) == float(solo.residual), i
+
+    def test_converge_nonconverged_tail_bitwise(self):
+        # A step budget that is NOT a multiple of check_interval and
+        # too small to converge: the rem tail must run exactly like
+        # solo's uninspected tail.
+        cfg = HeatConfig(nx=16, ny=16, steps=53, converge=True,
+                         eps=1e-12, check_interval=20, backend="jnp")
+        inits = _inits(3, (16, 16))
+        r = EnsembleSolver(cfg, 3).solve(initials=inits)
+        for i in range(3):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+            assert int(r.steps_run[i]) == solo.steps_run == 53
+            assert not r.converged[i]
+
+    def test_f32chunk_bitwise(self):
+        import ml_dtypes
+
+        cfg = HeatConfig(nx=16, ny=20, steps=48, dtype="bfloat16",
+                         accumulate="f32chunk", backend="jnp")
+        inits = _inits(3, (16, 20)).astype(ml_dtypes.bfloat16)
+        r = EnsembleSolver(cfg, 3).solve(initials=inits)
+        for i in range(3):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+
+    def test_pallas_kernel_m_fixed_bitwise(self):
+        cfg = HeatConfig(nx=16, ny=20, steps=23, backend="pallas")
+        es = EnsembleSolver(cfg, 3)
+        assert es.path == "M"
+        inits = _inits(3, (16, 20))
+        r = es.solve(initials=inits)
+        for i in range(3):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+
+    def test_pallas_kernel_m_converge_bitwise(self):
+        cfg = HeatConfig(nx=16, ny=20, steps=3000, converge=True,
+                         eps=1e-3, check_interval=20, backend="pallas")
+        base = _inits(1, (16, 20))[0]
+        inits = np.stack([base * s for s in (0.2, 1.0, 5.0)])
+        r = EnsembleSolver(cfg, 3).solve(initials=inits)
+        assert len(set(r.steps_run.tolist())) > 1
+        for i in range(3):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+            assert int(r.steps_run[i]) == solo.steps_run, i
+
+    def test_3d_fixed_bitwise(self):
+        cfg = HeatConfig(nx=10, ny=12, nz=8, steps=11, backend="jnp")
+        rng = np.random.RandomState(3)
+        inits = rng.rand(2, 10, 12, 8).astype(np.float32)
+        r = EnsembleSolver(cfg, 2).solve(initials=inits)
+        for i in range(2):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+
+    def test_single_initial_broadcasts(self):
+        cfg = HeatConfig(nx=16, ny=16, steps=9, backend="jnp")
+        one = _inits(1, (16, 16))[0]
+        r = EnsembleSolver(cfg, 3).solve(initials=one)
+        solo = solve(cfg, initial=one)
+        for i in range(3):
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+
+
+# ---------------------------------------------------------------------------
+# Compaction
+# ---------------------------------------------------------------------------
+
+class TestCompaction:
+    def _cfg(self):
+        return HeatConfig(nx=18, ny=22, steps=4000, converge=True,
+                          eps=1e-3, check_interval=20, backend="jnp")
+
+    def _spread_inits(self):
+        base = _inits(1, (18, 22))[0]
+        return np.stack([base * s for s in
+                         (0.05, 0.1, 0.5, 1.0, 10.0, 40.0)])
+
+    def test_compaction_triggers_and_is_invariant(self):
+        cfg = self._cfg()
+        inits = self._spread_inits()
+        compacting = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, compact_threshold=0.75, window_rounds=1))
+        r1 = compacting.solve(initials=inits)
+        assert r1.compactions, "expected at least one compaction"
+        never = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, compact_threshold=None, window_rounds=1))
+        r2 = never.solve(initials=inits)
+        assert not r2.compactions
+        # A member's trajectory is invariant to when others finish.
+        for i in range(6):
+            assert_member_bitwise(r1.grids[i], r2.grids[i], i)
+            assert int(r1.steps_run[i]) == int(r2.steps_run[i])
+            assert float(r1.residual[i]) == float(r2.residual[i])
+
+    def test_compaction_members_match_solo(self):
+        cfg = self._cfg()
+        inits = self._spread_inits()
+        r = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, compact_threshold=0.75, window_rounds=1)
+        ).solve(initials=inits)
+        for i in range(6):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(r.grids[i], solo.grid, i)
+            assert int(r.steps_run[i]) == solo.steps_run
+
+    def test_window_rounds_orchestration_only(self):
+        cfg = self._cfg()
+        inits = self._spread_inits()
+        a = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, window_rounds=1)).solve(initials=inits)
+        b = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, window_rounds=7)).solve(initials=inits)
+        for i in range(6):
+            assert_member_bitwise(a.grids[i], b.grids[i], i)
+            assert int(a.steps_run[i]) == int(b.steps_run[i])
+
+    def test_compaction_halves_batch_at_default_threshold(self):
+        cfg = self._cfg()
+        inits = self._spread_inits()
+        r = EnsembleSolver(cfg, EnsembleConfig(
+            members=6, compact_threshold=0.5, window_rounds=1)
+        ).solve(initials=inits)
+        for _step, frm, to in r.compactions:
+            assert to < frm / 2 + 1
+
+
+# ---------------------------------------------------------------------------
+# Config + explain surface
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="members"):
+            EnsembleConfig(members=0).validate()
+        with pytest.raises(ValueError, match="compact_threshold"):
+            EnsembleConfig(compact_threshold=1.5).validate()
+        with pytest.raises(ValueError, match="window_rounds"):
+            EnsembleConfig(window_rounds=0).validate()
+        EnsembleConfig(members=8, compact_threshold=None).validate()
+
+    def test_orchestration_free_strips_only_orchestration(self):
+        e = EnsembleConfig(members=8, compact_threshold=0.9,
+                           window_rounds=11)
+        s = e.orchestration_free()
+        assert s.members == 8
+        assert s.compact_threshold == EnsembleConfig().compact_threshold
+        assert s.window_rounds == EnsembleConfig().window_rounds
+
+    def test_json_round_trip(self):
+        e = EnsembleConfig(members=5, compact_threshold=0.25)
+        assert EnsembleConfig.from_json(e.to_json()) == e
+
+    def test_sharded_config_refused(self):
+        cfg = HeatConfig(nx=16, ny=16, mesh_shape=(2, 2))
+        with pytest.raises(ValueError, match="single-device"):
+            EnsembleSolver(cfg, 2)
+
+    def test_explain_reports_path_and_packability(self):
+        from parallel_heat_tpu.solver import explain
+
+        doc = explain(HeatConfig(nx=16, ny=16, backend="jnp"),
+                      ensemble=4)
+        assert doc["ensemble"]["members"] == 4
+        assert "vmap" in doc["ensemble"]["path"]
+        assert doc["ensemble"]["packable"] is True
+        doc = explain(HeatConfig(nx=16, ny=16, backend="pallas"),
+                      ensemble=4)
+        assert "kernel M" in doc["ensemble"]["path"]
+
+    def test_kernel_m_vmem_budget_tighter_than_kernel_a(self):
+        # Kernel M's per-instance footprint is ~3x kernel A's (no
+        # in/out aliasing under a Mosaic grid + two scratch buffers):
+        # a geometry near the solo VMEM limit must decline to vmap
+        # rather than pick a kernel Mosaic would OOM (HL402's "picker
+        # admits => Mosaic accepts" contract).
+        from parallel_heat_tpu.ops.batched import (
+            fits_vmem_batched, pick_ensemble_2d)
+        from parallel_heat_tpu.ops.pallas_stencil import fits_vmem
+        from parallel_heat_tpu.ops.tpu_params import params
+
+        budget = params().resident_budget_bytes
+        # A square f32 grid sized between the two bounds: fits kernel
+        # A (2 buffers) but not kernel M (6 buffers).
+        import math
+
+        n = int(math.isqrt(budget // (4 * 4)))  # ~4 buffers' worth
+        shape = (n, n)
+        assert fits_vmem(shape, "float32")
+        assert not fits_vmem_batched(shape, "float32")
+        assert pick_ensemble_2d(shape, "float32") == "vmap"
+        # Small grids admit on both tests.
+        assert pick_ensemble_2d((64, 64), "float32") == "M"
+
+    def test_packable_verdicts(self):
+        ok, _ = packable(HeatConfig(nx=16, ny=16, backend="jnp"))
+        assert ok
+        ok, why = packable(HeatConfig(nx=64, ny=64,
+                                      mesh_shape=(2, 2)))
+        assert not ok and "solo" in why
+        # Pallas where the solo pick is a streaming kernel: no
+        # member-bitwise twin.
+        big = HeatConfig(nx=4096, ny=4096, backend="pallas")
+        path = ensemble_path(big)
+        ok, _ = packable(big)
+        assert (path == "M") == ok
+
+    def test_batched_observers(self):
+        cfg = HeatConfig(nx=16, ny=16, steps=5, backend="jnp")
+        r = EnsembleSolver(cfg, 3).solve(initials=_inits(3, (16, 16)))
+        fin = ensemble_all_finite(r.grids)
+        assert fin.shape == (3,) and fin.all()
+        stats = ensemble_grid_stats(r.grids)
+        assert len(stats) == 3
+        assert all(np.isfinite(s["heat"]) for s in stats)
+
+    def test_guard_and_diag_ride_result(self):
+        cfg = HeatConfig(nx=16, ny=16, steps=10, backend="jnp",
+                         guard_interval=5, diag_interval=5)
+        r = EnsembleSolver(cfg, 2).solve(initials=_inits(2, (16, 16)))
+        assert r.finite is not None and r.finite.all()
+        assert r.diagnostics is not None and len(r.diagnostics) == 2
+        assert r.diagnostics[0]["step"] == 10
+
+    def test_observation_fields_do_not_fork_batched_programs(self):
+        # The member-axis edition of the HL101 contract: enabling
+        # guard/diag on the ensemble must reuse the plain run's
+        # compiled batched programs.
+        from parallel_heat_tpu.ensemble import engine
+
+        cfg = HeatConfig(nx=16, ny=16, steps=10, backend="jnp")
+        inits = _inits(2, (16, 16))
+        EnsembleSolver(cfg, 2).solve(initials=inits)
+        before = engine._build_fixed_runner.cache_info()
+        instrumented = cfg.replace(guard_interval=5, diag_interval=5)
+        r = EnsembleSolver(instrumented, 2).solve(initials=inits)
+        after = engine._build_fixed_runner.cache_info()
+        assert after.misses == before.misses
+        plain = EnsembleSolver(cfg, 2).solve(initials=inits)
+        for i in range(2):
+            assert_member_bitwise(r.grids[i], plain.grids[i], i)
+
+
+# ---------------------------------------------------------------------------
+# Ensemble checkpoints
+# ---------------------------------------------------------------------------
+
+class TestCheckpoint:
+    def _state(self, b=3, shape=(8, 10), k=40):
+        rng = np.random.RandomState(7)
+        return {"k": k,
+                "grids": rng.rand(b, *shape).astype(np.float32),
+                "done": np.array([True, False, False][:b]),
+                "res": np.array([1e-4, np.inf, 0.5][:b]),
+                "steps": np.array([20, 40, 40][:b], np.int64)}
+
+    def test_round_trip_bit_exact(self, tmp_path):
+        stem = str(tmp_path / "ck" / "ens")
+        cfg = HeatConfig(nx=8, ny=10, steps=100)
+        ens = EnsembleConfig(members=3)
+        st = self._state()
+        path = ens_ckpt.save_ensemble_generation(stem, st, cfg, ens)
+        assert ens_ckpt.latest_ensemble_checkpoint(stem) == path
+        loaded, lcfg, lens, manifest = \
+            ens_ckpt.load_ensemble_checkpoint(path, expect_config=cfg)
+        assert np.array_equal(_bits(loaded["grids"]), _bits(st["grids"]))
+        assert loaded["k"] == 40
+        assert np.array_equal(loaded["done"], st["done"])
+        assert np.array_equal(loaded["steps"], st["steps"])
+        assert lens.members == 3
+        assert [m["member"] for m in manifest] == [0, 1, 2]
+        assert manifest[0]["converged"] is True
+        assert manifest[1]["residual"] is None  # inf -> null in JSON
+
+    def test_prune_keeps_newest(self, tmp_path):
+        stem = str(tmp_path / "ens")
+        cfg = HeatConfig(nx=8, ny=10, steps=100)
+        ens = EnsembleConfig(members=3)
+        for k in (10, 20, 30, 40):
+            st = self._state(k=k)
+            ens_ckpt.save_ensemble_generation(stem, st, cfg, ens, keep=2)
+        paths = ens_ckpt.ensemble_generation_paths(stem)
+        assert len(paths) == 2
+        assert paths[-1].endswith(f".eg{40:012d}.npz")
+
+    def test_torn_temp_invisible(self, tmp_path):
+        stem = str(tmp_path / "ens")
+        cfg = HeatConfig(nx=8, ny=10, steps=100)
+        ens = EnsembleConfig(members=3)
+        ens_ckpt.save_ensemble_generation(stem, self._state(k=10), cfg,
+                                          ens)
+        # A SIGKILLed writer's torn temp must never be discovered.
+        torn = tmp_path / f".tmp-999-{os.path.basename(stem)}.eg" \
+                          f"{20:012d}.npz"
+        torn.write_bytes(b"torn")
+        paths = ens_ckpt.ensemble_generation_paths(stem)
+        assert len(paths) == 1 and paths[0].endswith(".eg" +
+                                                     f"{10:012d}.npz")
+
+    def test_config_mismatch_refused(self, tmp_path):
+        stem = str(tmp_path / "ens")
+        cfg = HeatConfig(nx=8, ny=10, steps=100)
+        path = ens_ckpt.save_ensemble_generation(
+            stem, self._state(), cfg, EnsembleConfig(members=3))
+        with pytest.raises(ValueError, match="nx"):
+            ens_ckpt.load_ensemble_checkpoint(
+                path, expect_config=cfg.replace(nx=16, ny=10))
+
+
+# ---------------------------------------------------------------------------
+# Supervised ensemble: the chaos cells
+# ---------------------------------------------------------------------------
+
+def _policy(every=50, **kw):
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("sleep_fn", lambda s: None)
+    return SupervisorPolicy(checkpoint_every=every, **kw)
+
+
+class TestSupervised:
+    def test_complete_matches_plain_solve(self, tmp_path):
+        cfg = HeatConfig(nx=16, ny=20, steps=200, backend="jnp")
+        inits = _inits(3, (16, 20))
+        sres = run_ensemble_supervised(cfg, 3, tmp_path / "ck",
+                                       policy=_policy(),
+                                       initials=inits)
+        assert not sres.interrupted and sres.steps_done == 200
+        for i in range(3):
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(sres.result.grids[i], solo.grid, i)
+        assert sres.checkpoints_written >= 4  # gen0 + cadence + final
+
+    @pytest.mark.chaos
+    def test_interrupt_resume_bit_exact_per_member(self, tmp_path):
+        cfg = HeatConfig(nx=16, ny=20, steps=200, backend="jnp",
+                         guard_interval=50)
+        inits = _inits(3, (16, 20))
+        full = run_ensemble_supervised(cfg, 3, tmp_path / "full" / "ck",
+                                       policy=_policy(),
+                                       initials=inits)
+        calls = [0]
+
+        def interrupt():
+            calls[0] += 1
+            return "deadline" if calls[0] == 2 else None
+
+        s1 = run_ensemble_supervised(cfg, 3, tmp_path / "res" / "ck",
+                                     policy=_policy(), initials=inits,
+                                     interrupt=interrupt)
+        assert s1.interrupted and s1.signal_name == "deadline"
+        assert 0 < s1.steps_done < 200
+        s2 = run_ensemble_supervised(cfg, 3, tmp_path / "res" / "ck",
+                                     policy=_policy())
+        assert not s2.interrupted and s2.steps_done == 200
+        for i in range(3):
+            assert_member_bitwise(s2.result.grids[i],
+                                  full.result.grids[i], i)
+
+    @pytest.mark.chaos
+    def test_converge_interrupt_resume_bit_exact(self, tmp_path):
+        cfg = HeatConfig(nx=18, ny=22, steps=4000, converge=True,
+                         eps=1e-3, check_interval=20, backend="jnp")
+        base = _inits(1, (18, 22))[0]
+        inits = np.stack([base * s for s in (0.1, 1.0, 40.0)])
+        full = run_ensemble_supervised(cfg, 3, tmp_path / "full" / "ck",
+                                       policy=_policy(every=100),
+                                       initials=inits)
+        calls = [0]
+
+        def interrupt():
+            calls[0] += 1
+            return "SIGTERM" if calls[0] == 3 else None
+
+        s1 = run_ensemble_supervised(cfg, 3, tmp_path / "res" / "ck",
+                                     policy=_policy(every=100),
+                                     initials=inits,
+                                     interrupt=interrupt)
+        assert s1.interrupted
+        s2 = run_ensemble_supervised(cfg, 3, tmp_path / "res" / "ck",
+                                     policy=_policy(every=100))
+        assert not s2.interrupted
+        for i in range(3):
+            assert_member_bitwise(s2.result.grids[i],
+                                  full.result.grids[i], i)
+            assert int(s2.result.steps_run[i]) == \
+                int(full.result.steps_run[i])
+            assert bool(s2.result.converged[i]) == \
+                bool(full.result.converged[i])
+
+    @pytest.mark.chaos
+    def test_guard_trip_rollback_recovers_bitwise(self, tmp_path,
+                                                  monkeypatch):
+        cfg = HeatConfig(nx=16, ny=20, steps=200, backend="jnp",
+                         guard_interval=50)
+        inits = _inits(3, (16, 20))
+        clean = run_ensemble_supervised(cfg, 3,
+                                        tmp_path / "clean" / "ck",
+                                        policy=_policy(),
+                                        initials=inits)
+        # One transient false guard verdict: the supervisor must roll
+        # back to the newest generation, replay, and land bitwise.
+        from parallel_heat_tpu.ensemble import supervised as sup
+
+        real = sup.ensemble_all_finite
+        fired = [False]
+
+        def flaky(grids):
+            out = real(grids)
+            if not fired[0]:
+                fired[0] = True
+                return np.zeros_like(out)
+            return out
+
+        monkeypatch.setattr(sup, "ensemble_all_finite", flaky)
+        sres = run_ensemble_supervised(cfg, 3, tmp_path / "r" / "ck",
+                                       policy=_policy(max_retries=2),
+                                       initials=inits)
+        assert sres.guard_trips == 1 and sres.rollbacks == 1
+        for i in range(3):
+            assert_member_bitwise(sres.result.grids[i],
+                                  clean.result.grids[i], i)
+
+    def test_unstable_config_fails_fast(self, tmp_path):
+        cfg = HeatConfig(nx=16, ny=16, steps=400, cx=0.4, cy=0.4,
+                         backend="jnp", guard_interval=50)
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(PermanentFailure) as ei:
+                run_ensemble_supervised(cfg, 2, tmp_path / "ck",
+                                        policy=_policy())
+        assert ei.value.kind == "unstable"
+
+    def test_stem_lock_held(self, tmp_path):
+        cfg = HeatConfig(nx=16, ny=16, steps=100, backend="jnp")
+        stem = ckpt.checkpoint_stem(str(tmp_path / "ck"))
+        release = ckpt.acquire_stem_lock(stem)
+        try:
+            with pytest.raises(ckpt.StemLockError):
+                run_ensemble_supervised(cfg, 2, stem, policy=_policy())
+        finally:
+            release()
+
+    def test_member_stems_flush_solo_resumable(self, tmp_path):
+        cfg = HeatConfig(nx=16, ny=20, steps=100, backend="jnp")
+        inits = _inits(2, (16, 20))
+        stems = [str(tmp_path / f"m{i}" / "ck") for i in range(2)]
+        run_ensemble_supervised(cfg, 2, tmp_path / "ens" / "ck",
+                                policy=_policy(), initials=inits,
+                                member_stems=stems)
+        for i, stem in enumerate(stems):
+            src = ckpt.latest_checkpoint(stem)
+            assert src is not None
+            grid, step, _ = ckpt.load_checkpoint(src, cfg)
+            assert step == 100
+            solo = solve(cfg, initial=inits[i])
+            assert_member_bitwise(grid, solo.grid, i)
+
+
+# ---------------------------------------------------------------------------
+# heatd packing
+# ---------------------------------------------------------------------------
+
+class _DoneHandle:
+    def __init__(self, rc):
+        self.rc = rc
+        self.pid = os.getpid()
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+
+@pytest.fixture
+def packing_daemon(tmp_path):
+    from parallel_heat_tpu.service import worker
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    q = str(tmp_path / "q")
+    record = {"packs": [], "solos": []}
+
+    def launcher(job_id=None, worker_id=None, attempt=1,
+                 deadline_t=None, job_ids=None):
+        if job_ids is not None:
+            record["packs"].append(list(job_ids))
+            rc = worker.execute_pack(q, job_ids, worker_id)
+        else:
+            record["solos"].append(job_id)
+            rc = worker.execute_job(q, job_id, worker_id, attempt,
+                                    deadline_t=deadline_t)
+        return _DoneHandle(rc)
+
+    t = [0.0]
+    cfg = HeatdConfig(root=q, slots=1, pack_jobs=True, pack_max=8,
+                      launcher=launcher, clock=lambda: t[0],
+                      sleep_fn=lambda s: None)
+    daemon = Heatd(cfg)
+    yield daemon, t, record
+    daemon.store.close()
+
+
+def _spool(daemon, job_id, config, **kw):
+    from parallel_heat_tpu.service.store import JobSpec
+
+    daemon.store.spool_submit(JobSpec(job_id=job_id,
+                                      config=dict(config), **kw))
+
+
+_PACK_CONFIG = {"nx": 16, "ny": 16, "steps": 60, "backend": "jnp"}
+
+
+class TestPacking:
+    def _drive(self, daemon, t, n=6):
+        for _ in range(n):
+            t[0] += 1.0
+            daemon.step(t[0])
+
+    def test_compatible_jobs_pack_and_fan_out_bitwise(
+            self, packing_daemon):
+        daemon, t, record = packing_daemon
+        jids = [f"job-{i}" for i in range(3)]
+        for j in jids:
+            _spool(daemon, j, _PACK_CONFIG, checkpoint_every=20)
+        self._drive(daemon, t)
+        jobs, anomalies = daemon.store.replay()
+        assert not anomalies
+        assert all(jobs[j].state == "completed" for j in jids)
+        assert record["packs"] == [jids] and not record["solos"]
+        # One worker id across the pack; per-member records committed.
+        assert len({jobs[j].worker for j in jids}) == 1
+        solo = solve(HeatConfig(**_PACK_CONFIG))
+        for j in jids:
+            rec = daemon.store.read_result(j, 1)
+            assert rec["outcome"] == "completed"
+            assert rec["pack"] == "job-0" and rec["pack_size"] == 3
+            assert rec["steps_done"] == 60
+            src = ckpt.latest_checkpoint(daemon.store.checkpoint_stem(j))
+            grid, step, _ = ckpt.load_checkpoint(src)
+            assert step == 60
+            assert_member_bitwise(grid, solo.grid, j)
+
+    def test_incompatible_specs_do_not_pack(self, packing_daemon):
+        daemon, t, record = packing_daemon
+        _spool(daemon, "a", _PACK_CONFIG)
+        _spool(daemon, "b", dict(_PACK_CONFIG, nx=20))
+        self._drive(daemon, t, n=8)
+        jobs, anomalies = daemon.store.replay()
+        assert not anomalies
+        assert jobs["a"].state == jobs["b"].state == "completed"
+        assert not record["packs"]
+        assert sorted(record["solos"]) == ["a", "b"]
+
+    def test_faulted_and_deadline_jobs_run_solo(self, packing_daemon):
+        daemon, t, record = packing_daemon
+        _spool(daemon, "a", _PACK_CONFIG,
+               faults={"transient_on_chunks": [1]})
+        _spool(daemon, "b", _PACK_CONFIG, deadline_s=9999.0)
+        _spool(daemon, "c", _PACK_CONFIG)
+        self._drive(daemon, t, n=10)
+        jobs, anomalies = daemon.store.replay()
+        assert not anomalies
+        assert all(v.state == "completed" for v in jobs.values())
+        assert not record["packs"]  # no two compatible fresh jobs
+
+    def test_pack_max_splits_batches(self, packing_daemon):
+        daemon, t, record = packing_daemon
+        daemon.config.pack_max = 2
+        jids = [f"j{i}" for i in range(5)]
+        for j in jids:
+            _spool(daemon, j, _PACK_CONFIG)
+        self._drive(daemon, t, n=12)
+        jobs, anomalies = daemon.store.replay()
+        assert not anomalies
+        assert all(jobs[j].state == "completed" for j in jids)
+        assert all(len(p) == 2 for p in record["packs"])
+        assert len(record["packs"]) == 2 and len(record["solos"]) == 1
+
+    def test_pack_wait_holds_lone_job_then_releases(self, tmp_path):
+        from parallel_heat_tpu.service import worker
+        from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+        from parallel_heat_tpu.service.store import JobSpec
+
+        q = str(tmp_path / "qw")
+        t = [1000.0]
+
+        def launcher(job_id=None, worker_id=None, attempt=1,
+                     deadline_t=None, job_ids=None):
+            if job_ids is not None:
+                return _DoneHandle(
+                    worker.execute_pack(q, job_ids, worker_id))
+            return _DoneHandle(
+                worker.execute_job(q, job_id, worker_id, attempt))
+
+        daemon = Heatd(HeatdConfig(
+            root=q, slots=2, pack_jobs=True, pack_wait_s=5.0,
+            launcher=launcher, clock=lambda: t[0],
+            sleep_fn=lambda s: None))
+        daemon.store.spool_submit(JobSpec(job_id="solo-hold",
+                                          config=dict(_PACK_CONFIG)))
+        daemon.step(t[0])
+        # Journal stamps accepted_t with the real wall clock; fetch it
+        # and probe the dwell window relative to that stamp.
+        jobs, _ = daemon.store.replay()
+        acc = jobs["solo-hold"].accepted_t
+        t[0] = acc + 1.0
+        daemon.step(t[0])
+        jobs, _ = daemon.store.replay()
+        assert jobs["solo-hold"].state == "queued"  # held by the dwell
+        t[0] = acc + 6.0
+        daemon.step(t[0])
+        t[0] += 1.0
+        daemon.step(t[0])
+        jobs, _ = daemon.store.replay()
+        assert jobs["solo-hold"].state == "completed"
+        daemon.store.close()
+
+    def test_unpackable_path_demotes_to_solo(self, packing_daemon,
+                                             monkeypatch):
+        # The worker's runtime packability re-check: force a refusal
+        # and prove the members demote to solo requeues, then finish.
+        daemon, t, record = packing_daemon
+        from parallel_heat_tpu.ensemble import engine
+
+        monkeypatch.setattr(engine, "packable",
+                            lambda cfg: (False, "forced for test"))
+        for j in ("x", "y"):
+            _spool(daemon, j, _PACK_CONFIG)
+        self._drive(daemon, t, n=10)
+        jobs, anomalies = daemon.store.replay()
+        assert not anomalies
+        assert jobs["x"].state == jobs["y"].state == "completed"
+        assert record["packs"] == [["x", "y"]]
+        assert sorted(record["solos"]) == ["x", "y"]
+        # The demoted attempt journaled a requeue, not a failure.
+        assert jobs["x"].requeues == 1 and not jobs["x"].failures
+
+
+# ---------------------------------------------------------------------------
+# Telemetry + report tooling
+# ---------------------------------------------------------------------------
+
+class TestTelemetryReport:
+    def test_ensemble_events_and_report_section(self, tmp_path):
+        import importlib.util
+
+        from parallel_heat_tpu.utils.telemetry import Telemetry
+
+        cfg = HeatConfig(nx=18, ny=22, steps=4000, converge=True,
+                         eps=1e-3, check_interval=20, backend="jnp")
+        base = _inits(1, (18, 22))[0]
+        inits = np.stack([base * s for s in (0.1, 1.0, 10.0, 40.0)])
+        path = tmp_path / "m.jsonl"
+        with Telemetry(str(path)) as tel:
+            EnsembleSolver(cfg, EnsembleConfig(
+                members=4, compact_threshold=0.75, window_rounds=1)
+            ).solve(initials=inits, telemetry=tel)
+        events = [json.loads(line) for line in
+                  path.read_text().splitlines()]
+        kinds = {e["event"] for e in events}
+        assert {"run_header", "ensemble_window", "member_converged",
+                "member_end", "ensemble_compaction"} <= kinds
+        header = next(e for e in events if e["event"] == "run_header")
+        assert header["ensemble"]["members"] == 4
+        ends = [e for e in events if e["event"] == "member_end"]
+        assert sorted(e["member"] for e in ends) == [0, 1, 2, 3]
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_report", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "tools", "metrics_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        doc = mod.summarize(events)
+        ens = doc["ensemble"]
+        assert ens["members"] == 4 and ens["converged_members"] == 4
+        assert ens["compactions"]
+        assert ens["live_trajectory"][0]["batch"] == 4
+        assert ens["converge_steps"]["min"] < \
+            ens["converge_steps"]["max"]
+        assert sum(b["count"] for b in
+                   ens["converge_steps"]["histogram"]) == 4
+        # The text renderer must include the section without crashing.
+        assert "ensemble:" in mod.render_text(doc)
+
+    def test_fleet_packing_counters(self, packing_daemon):
+        import importlib.util
+
+        daemon, t, record = packing_daemon
+        for j in ("p0", "p1", "p2"):
+            _spool(daemon, j, _PACK_CONFIG)
+        for _ in range(6):
+            t[0] += 1.0
+            daemon.step(t[0])
+        spec = importlib.util.spec_from_file_location(
+            "metrics_report", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "tools", "metrics_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        doc = mod.summarize_fleet(daemon.store.root)
+        f = doc["fleet"]
+        assert f["completed"] == 3
+        assert f["packed_jobs"] == 3
+        assert f["pack_dispatches"] == 1
+        assert f["jobs_per_dispatch"] == 3.0
+        assert "packing" in mod.render_fleet_text(doc)

@@ -376,6 +376,57 @@ def summarize(events, outlier_mult=5.0):
             doc["checkpoints"]["async_overlap_share"]
         doc["pipeline"] = pl
 
+    # Ensemble section: streams written by the batched engine carry
+    # per-window live counts, per-member convergence latches and
+    # compaction transitions (SEMANTICS.md "Ensemble").
+    windows = by.get("ensemble_window", [])
+    member_ends = by.get("member_end", [])
+    compactions = by.get("ensemble_compaction", [])
+    if windows or member_ends or compactions:
+        ens = {}
+        if windows:
+            ens["windows"] = len(windows)
+            ens["live_trajectory"] = [
+                {"step": w.get("step"), "batch": w.get("batch"),
+                 "live": w.get("live"), "done": w.get("done")}
+                for w in windows]
+            batches = [w.get("batch") for w in windows
+                       if isinstance(w.get("batch"), int)]
+            if batches:
+                ens["batch_initial"] = batches[0]
+                ens["batch_final"] = batches[-1]
+        if member_ends:
+            conv = [m for m in member_ends if m.get("converged")]
+            # The histogram is of CONVERGE steps: only members that
+            # actually converged contribute (a fixed-mode or
+            # unconverged member's step is just the budget, and would
+            # render a misleading "converge steps" distribution).
+            steps = sorted(m.get("step") for m in conv
+                           if isinstance(m.get("step"), (int, float)))
+            ens["members"] = len(member_ends)
+            ens["converged_members"] = len(conv)
+            if steps:
+                lo, hi = steps[0], steps[-1]
+                nbins = min(8, max(1, len(set(steps))))
+                width = max(1, (hi - lo + nbins) // nbins)
+                hist = {}
+                for s in steps:
+                    b = lo + ((s - lo) // width) * width
+                    hist[b] = hist.get(b, 0) + 1
+                ens["converge_steps"] = {
+                    "min": lo, "p50": _percentile(steps, 50),
+                    "max": hi,
+                    "histogram": [{"from": b, "to": b + width - 1,
+                                   "count": hist[b]}
+                                  for b in sorted(hist)]}
+        if compactions:
+            ens["compactions"] = [
+                {"step": c.get("step"),
+                 "from_members": c.get("from_members"),
+                 "to_members": c.get("to_members")}
+                for c in compactions]
+        doc["ensemble"] = ens
+
     timeline = [
         {"event": e["event"], "t_mono": e.get("t_mono"),
          "step": e.get("step"),
@@ -384,7 +435,8 @@ def summarize(events, outlier_mult=5.0):
         for e in events
         if e["event"] in ("guard_trip", "progress_trip", "retry",
                           "rollback", "signal", "permanent_failure",
-                          "checkpoint_skipped", "run_end")]
+                          "checkpoint_skipped", "ensemble_compaction",
+                          "run_end")]
     doc["timeline"] = timeline
 
     ends = by.get("run_end", [])
@@ -414,6 +466,16 @@ def summarize_fleet(root):
     ev_counts = {}
     for e in events:
         ev_counts[e.get("event")] = ev_counts.get(e.get("event"), 0) + 1
+    # Ensemble packing efficiency: `dispatched` journal lines carry a
+    # `pack` field when the job rode a packed ensemble dispatch; a
+    # dispatch is one distinct worker id. jobs-per-dispatch > 1 means
+    # the packer is earning its keep.
+    disp = [e for e in events if e.get("event") == "dispatched"]
+    disp_workers = {e.get("worker") for e in disp if e.get("worker")}
+    packed_jobs = sum(1 for e in disp if e.get("pack") is not None)
+    pack_dispatches = len({e.get("worker") for e in disp
+                           if e.get("pack") is not None
+                           and e.get("worker")})
     waits = sorted(v.first_dispatch_t - v.accepted_t
                    for v in jobs.values()
                    if v.first_dispatch_t is not None
@@ -442,6 +504,13 @@ def summarize_fleet(root):
             "attempts_total": sum(v.attempts for v in accepted),
             "requeues": ev_counts.get("requeued", 0),
             "orphaned": ev_counts.get("orphaned", 0),
+            "dispatches": len(disp_workers),
+            "packed_jobs": packed_jobs,
+            "pack_dispatches": pack_dispatches,
+            # Jobs per worker dispatch (1.0 = no packing): the fleet-
+            # level packing-efficiency figure.
+            "jobs_per_dispatch": (round(len(disp) / len(disp_workers), 3)
+                                  if disp_workers else None),
             # End-to-end: acceptance -> terminal state (requeue
             # backoffs included — that IS the user-visible latency).
             "queue_wait_s": {"p50": _percentile(waits, 50),
@@ -474,6 +543,11 @@ def render_fleet_text(doc):
     out.append(f"retries: {f['retried']} job(s) re-dispatched, "
                f"{f['requeues']} requeue(s), {f['orphaned']} "
                f"orphaning(s), {f['attempts_total']} attempt(s) total")
+    if f.get("packed_jobs"):
+        out.append(f"packing: {f['packed_jobs']} job(s) in "
+                   f"{f['pack_dispatches']} packed dispatch(es), "
+                   f"{f['jobs_per_dispatch']} jobs/dispatch over "
+                   f"{f['dispatches']} dispatch(es)")
     qw, jw = f["queue_wait_s"], f["job_wall_s"]
     if qw["p50"] is not None:
         out.append(f"queue wait p50={qw['p50']:.2f}s "
@@ -548,6 +622,32 @@ def render_text(doc):
         for t in cv.get("progress_trips", []):
             out.append(f"  progress_trip kind={t['kind']} "
                        f"step={t['step']} window={t['window']}")
+    ens = doc.get("ensemble")
+    if ens:
+        line = "ensemble:"
+        if "members" in ens:
+            line += (f" {ens['members']} member(s), "
+                     f"{ens['converged_members']} converged")
+        if "batch_initial" in ens:
+            line += (f", batch {ens['batch_initial']} -> "
+                     f"{ens['batch_final']}")
+        out.append(line)
+        cs = ens.get("converge_steps")
+        if cs:
+            out.append(f"  converge steps min={cs['min']} "
+                       f"p50={cs['p50']} max={cs['max']}")
+            for b in cs["histogram"]:
+                out.append(f"    [{b['from']}, {b['to']}]: "
+                           f"{'#' * min(40, b['count'])} {b['count']}")
+        for cmp_ in ens.get("compactions", []):
+            out.append(f"  compaction at step {cmp_['step']}: "
+                       f"{cmp_['from_members']} -> "
+                       f"{cmp_['to_members']} members")
+        traj = ens.get("live_trajectory") or []
+        if traj:
+            tail = traj if len(traj) <= 6 else traj[:3] + traj[-3:]
+            out.append("  live fraction: " + " ".join(
+                f"{w['step']}:{w['live']}/{w['batch']}" for w in tail))
     pl = doc.get("pipeline")
     if pl:
         busy = pl.get("device_busy_frac")
