@@ -27,7 +27,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
       --check-interval $(STEP) --dtype $(DTYPE) --accumulate $(ACC) \
       $(BACKEND_FLAG) $(MESH_FLAG)
 
-.PHONY: all heat heat_con native test lint lint-fast chaos \
+.PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
         ensemble-smoke bench clean
 
@@ -73,6 +73,17 @@ lint-fast:
 # fault-injection smoke for the run supervisor (CPU only, no TPU needed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m chaos -q
+
+# distributed-supervision smoke: the two multi-process chaos cells on
+# a REAL 2-process gloo boundary (mp_split_brain: a single-rank NaN
+# rolls BOTH ranks back to the same generation bitwise; mp_peer_lost:
+# a real rank SIGKILL is detected within one barrier timeout and the
+# printed elastic resume command completes bit-exactly on the
+# surviving mesh). Exit 0 = the SEMANTICS.md "Distributed
+# supervision" contract held across a true process boundary.
+mp-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_matrix.py --mp-only
 
 # telemetry pipeline smoke (CPU): a small supervised run with --metrics,
 # piped through the report tool — exit 0 means the JSONL is schema-valid

@@ -28,6 +28,7 @@ from parallel_heat_tpu.solver import (
     solve_stream,
 )
 from parallel_heat_tpu.models import HeatPlate2D, HeatPlate3D
+from parallel_heat_tpu.parallel.coordinator import PeerLostError
 from parallel_heat_tpu.supervisor import (
     EXIT_PERMANENT_FAILURE,
     EXIT_PREEMPTED,
@@ -72,6 +73,7 @@ __all__ = [
     "SupervisorPolicy",
     "SupervisorResult",
     "PermanentFailure",
+    "PeerLostError",
     "EXIT_PREEMPTED",
     "EXIT_PERMANENT_FAILURE",
     "Telemetry",

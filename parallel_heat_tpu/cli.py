@@ -155,6 +155,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "transient faults (guard trips, retryable "
                          "dispatch errors); exceeding it halts with a "
                          "permanent-failure diagnosis")
+    ap.add_argument("--barrier-timeout", type=float, default=60.0,
+                    metavar="S",
+                    help="multi-process supervised runs: seconds a "
+                         "chunk-boundary consensus exchange waits on a "
+                         "peer whose heartbeat has gone static before "
+                         "declaring it lost (peer_lost preemption with "
+                         "an elastic resume command); single-process "
+                         "runs ignore it")
     ap.add_argument("--keep-checkpoints", type=int, default=3,
                     metavar="N",
                     help="checkpoint generations the supervisor "
@@ -324,6 +332,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: --max-retries must be >= 0, got "
               f"{args.max_retries}", file=sys.stderr)
         return 2
+    if args.barrier_timeout <= 0:
+        print(f"error: --barrier-timeout must be > 0 seconds, got "
+              f"{args.barrier_timeout}", file=sys.stderr)
+        return 2
     if (args.stall_windows is not None
             or args.drift_tolerance is not None) and not args.supervise:
         print("error: --stall-windows/--drift-tolerance configure the "
@@ -446,6 +458,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 stall_windows=args.stall_windows,
                 drift_tolerance=args.drift_tolerance,
                 async_checkpoint=not args.no_async_checkpoint,
+                barrier_timeout_s=args.barrier_timeout,
             )
             # Flags the resumed invocation must repeat to deliver what
             # this one promised. NOT --initial-out: the t=0 grid was

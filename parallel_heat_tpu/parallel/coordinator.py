@@ -1,0 +1,473 @@
+"""Coordinated multi-host supervision: the consensus layer under the
+run supervisor.
+
+Every fault-tolerance mechanism of the supervisor family (guard trips,
+retry-with-rollback, retained checkpoint generations, SIGTERM flush)
+decides per-process. On a multi-process ``shard_map`` run
+(``parallel/distributed.py``) that is a split-brain hazard: one process
+rolling back while its peers dispatch the next chunk wedges the whole
+pod inside a collective — and MTBF shrinks linearly with host count
+(PAPERS.md: the wafer-scale stencil study of arXiv 2605.07954 and the
+TPU-cluster Ising campaign of arXiv 1903.11714 both scale exactly this
+failure surface up). This module makes the supervisor's contract hold
+for N processes (SEMANTICS.md "Distributed supervision"):
+
+- **consensus verdicts** — each chunk-boundary observation (stop flag,
+  injected/transient fault, local finite verdict, drift stats) is
+  exchanged over the ``jax.distributed`` key-value store (host-side
+  state — never a device collective, so a verdict can be formed even
+  when a peer is gone) and merged by the pure, rank-order-deterministic
+  :func:`merge_boundary`; every process then takes the *identical*
+  action at the *identical* boundary;
+- **two-phase checkpoint commit** — ``utils.checkpoint.
+  save_generation_coordinated`` runs its shard-report / global-commit
+  phases through :meth:`Coordinator.exchange`, so a generation exists
+  globally or not at all;
+- **dead-peer detection** — a per-process heartbeat (a KV key beaten by
+  a background thread, plus a probe file in the telemetry heartbeat
+  format next to the checkpoint stem) bounds every exchange: a peer
+  whose heartbeat stops changing for ``barrier_timeout_s`` is declared
+  lost (:class:`PeerLostError`) instead of wedging the exchange
+  forever. Staleness is judged by *content change observed on the
+  local clock*, never by comparing wall clocks across hosts — clock
+  skew cannot fake a death or hide one;
+- **elastic-degrade resume** — :func:`surviving_mesh_shape` picks a
+  viable mesh over the surviving device set so the supervisor's printed
+  resume command targets a run the remaining hosts can actually start,
+  resuming bit-exactly through the checkpoint reshard-on-load path.
+
+The single-process :class:`Coordinator` is the identity: ``exchange``
+returns ``[payload]``, every merge of one verdict is that verdict, and
+the supervisor's behavior (and compiled programs) are bitwise the
+pre-coordinator ones — pinned by the chaos suite's parity tests.
+:class:`InMemoryKV` mirrors the ``jax.distributed`` client surface so
+the consensus protocol is testable with thread-simulated ranks, no
+real process boundary required.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+from parallel_heat_tpu.utils.faults import InjectedTransientError
+
+
+class PeerLostError(RuntimeError):
+    """A peer process stopped participating: its boundary payload never
+    arrived and its heartbeat stopped changing for the barrier timeout.
+    The supervisor converts this into a clean ``peer_lost`` preemption
+    (journal event + elastic resume command) instead of hanging in a
+    collective forever."""
+
+    def __init__(self, message: str, lost: Tuple[int, ...] = (),
+                 waited_s: float = 0.0, timeout_s: float = 0.0):
+        super().__init__(message)
+        self.lost = tuple(lost)
+        self.waited_s = waited_s
+        self.timeout_s = timeout_s
+
+
+class PeerTransientError(InjectedTransientError):
+    """A peer reported a transient dispatch fault at a chunk boundary.
+    Subclassing the injected-transient marker routes it through the
+    supervisor's existing retry classifier: the consensus makes every
+    rank roll back together even though only one rank saw the fault."""
+
+
+# ---------------------------------------------------------------------------
+# Consensus merges (pure; identical on every rank by construction)
+# ---------------------------------------------------------------------------
+
+def merge_boundary(verdicts: Sequence[dict]) -> dict:
+    """Merge per-rank chunk-boundary observations into THE consensus
+    verdict — a pure function of the rank-ordered list, so every rank
+    computes the identical result from the identical exchange.
+
+    Field-wise worst-case-wins, first-reporting-rank (lowest index)
+    supplying the detail string:
+
+    - ``stop``: any rank's preemption/interrupt reason stops everyone;
+    - ``fault`` / ``err``: any rank's transient fault rolls everyone
+      back (the message names the reporting rank);
+    - ``finite``: all ranks' local verdicts must hold (``None`` when no
+      guard ran this boundary — deterministic, so all ranks agree on
+      that too).
+
+    The supervisor applies its ordinary precedence to the merged fields
+    afterwards (drift is judged from :func:`merge_stats`-merged
+    partials, not merged here), so single-process behavior (a merge of
+    one verdict) is bit-identical by construction.
+    """
+    out = {"stop": None, "fault": None, "err": None, "finite": None}
+    for rank, v in enumerate(verdicts):
+        for key in ("stop", "fault", "err"):
+            if out[key] is None and v.get(key) is not None:
+                detail = v[key]
+                if key in ("fault", "err") and len(verdicts) > 1:
+                    detail = f"[rank {rank}] {detail}"
+                out[key] = detail
+    finites = [v.get("finite") for v in verdicts]
+    if any(f is not None for f in finites):
+        out["finite"] = all(f is not False for f in finites)
+    return out
+
+
+def merge_stats(parts: Sequence[dict]) -> dict:
+    """Merge per-rank partial grid statistics (host-side reductions over
+    each rank's addressable shards) into the global stats the drift
+    guard compares against its envelope: min of mins, max of maxes, sum
+    of heats. Rank-order-deterministic like :func:`merge_boundary`."""
+    return {"min": min(p["min"] for p in parts),
+            "max": max(p["max"] for p in parts),
+            "heat": sum(p["heat"] for p in parts)}
+
+
+def surviving_mesh_shape(grid_shape, n_devices: int
+                         ) -> Optional[Tuple[int, ...]]:
+    """The elastic-degrade mesh: a viable factorization of the
+    SURVIVING device count for ``grid_shape``, for the resume command a
+    peer-lost exit prints. ``pick_mesh_shape`` when its balanced pick
+    divides the grid, else the best divisible factorization, else
+    ``None`` (resume single-device — always legal)."""
+    if n_devices <= 1:
+        return None
+    from parallel_heat_tpu.parallel.mesh import (_balanced_divisible,
+                                                 pick_mesh_shape)
+
+    grid_shape = tuple(grid_shape)
+    m = pick_mesh_shape(n_devices, len(grid_shape))
+    if all(n % d == 0 for n, d in zip(grid_shape, m)):
+        return m
+    return _balanced_divisible(n_devices, grid_shape)
+
+
+# ---------------------------------------------------------------------------
+# Coordinators
+# ---------------------------------------------------------------------------
+
+class Coordinator:
+    """The single-process identity coordinator: one rank, every
+    exchange returns its own payload, nothing waits on anything. The
+    supervisor routes ALL boundary decisions through this interface so
+    the single- and multi-process loops are one code path; with this
+    class the consensus layer provably adds nothing (merge of one
+    verdict = that verdict), keeping the single-process supervisor
+    bitwise the pre-coordinator one."""
+
+    process_index: int = 0
+    process_count: int = 1
+    #: True when exchanges actually cross a process boundary — the
+    #: supervisor's gate for host-side local observations (guard/stats)
+    #: versus the single-process device reductions.
+    distributed: bool = False
+
+    def exchange(self, kind: str, payload: dict) -> list:
+        """All-gather one host-side payload per rank at a boundary;
+        returns the rank-ordered list (``out[r]`` is rank r's payload).
+        Bounded: a peer that stops heartbeating raises
+        :class:`PeerLostError` instead of blocking forever."""
+        return [dict(payload)]
+
+    def exchange_timed(self, kind: str, payload: dict):
+        """:meth:`exchange` plus the seconds spent waiting on peers —
+        returned per call (never through shared mutable state: the
+        supervisor's main loop and the async checkpointer's worker
+        exchange concurrently, and telemetry's per-boundary
+        ``barrier_wait`` must report THIS call's wait)."""
+        return self.exchange(kind, payload), 0.0
+
+    def set_heartbeat_path(self, path: Optional[str]) -> None:
+        """Enable (or move) the heartbeat probe file. The supervisor
+        calls this only AFTER the stem lock is held: the probe files
+        feed the lock's stale-reclaim judgment, and a restarting run
+        writing its own ``<stem>.hb.pN.json`` before taking the lock
+        would block reclaim of its predecessor's stale lock forever
+        (the file names are identical across runs). No-op here."""
+
+    def close(self) -> None:
+        """Stop background liveness machinery; idempotent."""
+
+
+class InMemoryKV:
+    """In-process stand-in for the ``jax.distributed`` KV client
+    (``DistributedRuntimeClient``): the same three-method surface the
+    coordinator uses, backed by a dict + condition variable. Lets the
+    whole consensus protocol run with thread-simulated ranks in one
+    process — the chaos suite's split-brain cells need no real process
+    boundary to certify the merge/commit logic."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._data = {}
+
+    def key_value_set(self, key: str, value: str) -> None:
+        with self._cv:
+            self._data[key] = str(value)
+            self._cv.notify_all()
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"InMemoryKV: key {key!r} not set within "
+                        f"{timeout_ms} ms")
+                self._cv.wait(remaining)
+            return self._data[key]
+
+    def key_value_delete(self, key: str) -> None:
+        with self._cv:
+            self._data.pop(key, None)
+
+
+class KVCoordinator(Coordinator):
+    """Consensus over a key-value store: the multi-process coordinator.
+
+    ``kv`` is any object with the ``jax.distributed`` client's
+    ``key_value_set`` / ``blocking_key_value_get`` surface — the real
+    ``DistributedRuntimeClient`` on a pod, :class:`InMemoryKV` under
+    thread-simulated ranks. Exchanges are namespaced per supervised run
+    (``namespace`` — stem + start step, so a resumed run can never read
+    a previous segment's stale keys) and per ``kind``, with a monotone
+    round counter per kind: ranks whose post-consensus control flow is
+    identical (the whole point) perform the identical exchange sequence,
+    so round numbers align without negotiation.
+
+    Liveness: a daemon thread beats ``hb/p<rank>`` every
+    ``heartbeat_interval_s`` (and atomically rewrites
+    ``heartbeat_path`` in the telemetry heartbeat-file format when
+    given — external probes and the checkpoint stem lock read it). A
+    peer is declared lost only when its exchange payload is missing AND
+    its heartbeat value has not *changed* for ``barrier_timeout_s`` of
+    the local monotonic clock — a slow-but-alive peer extends the wait
+    (it is not dead), a SIGKILLed one is detected within one timeout.
+    """
+
+    def __init__(self, kv, process_index: int, process_count: int,
+                 namespace: str = "heat",
+                 barrier_timeout_s: float = 60.0,
+                 heartbeat_interval_s: float = 0.5,
+                 heartbeat_path: Optional[str] = None):
+        if process_count < 1:
+            raise ValueError(f"process_count must be >= 1, got "
+                             f"{process_count}")
+        if not 0 <= process_index < process_count:
+            raise ValueError(f"process_index {process_index} outside "
+                             f"[0, {process_count})")
+        if barrier_timeout_s <= 0:
+            raise ValueError(f"barrier_timeout_s must be > 0, got "
+                             f"{barrier_timeout_s}")
+        self.kv = kv
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.distributed = self.process_count > 1
+        self.namespace = namespace
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_path = heartbeat_path
+        self._lock = threading.Lock()
+        self._rounds: dict = {}
+        self._beats = 0
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if self.distributed:
+            self._beat()  # liveness provable before the first exchange
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="coordinator-heartbeat",
+                daemon=True)
+            self._hb_thread.start()
+
+    # -- keys ------------------------------------------------------------
+
+    def _key(self, kind: str, rnd: int, rank: int) -> str:
+        return f"{self.namespace}/{kind}/{rnd}/p{rank}"
+
+    def _hb_key(self, rank: int) -> str:
+        return f"{self.namespace}/hb/p{rank}"
+
+    # -- heartbeat -------------------------------------------------------
+
+    def _beat(self) -> None:
+        with self._lock:
+            self._beats += 1
+            n = self._beats
+        doc = {"t_wall": time.time(), "t_mono": time.monotonic(),
+               "pid": os.getpid(), "events": n,
+               "last_event": "coordinator_heartbeat",
+               "interval_s": self.heartbeat_interval_s,
+               "process_index": self.process_index}
+        try:
+            self.kv.key_value_set(self._hb_key(self.process_index),
+                                  json.dumps(doc))
+        except Exception:  # noqa: BLE001 — a dying runtime must not
+            # crash the beat thread; peers will see the staleness.
+            pass
+        if self.heartbeat_path is not None:
+            # Telemetry heartbeat-file format, atomically rewritten
+            # (tmp + rename, like utils/telemetry.py): external
+            # liveness probes and the stem lock's reclaim judgment
+            # read this without ever seeing a torn write.
+            tmp = f"{self.heartbeat_path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                os.replace(tmp, self.heartbeat_path)
+            except OSError:
+                self.heartbeat_path = None  # probe file only; KV stays
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            self._beat()
+
+    def set_heartbeat_path(self, path: Optional[str]) -> None:
+        """Enable (or move) the probe file and publish a beat to it
+        immediately. Called by the supervisor only AFTER the stem lock
+        is held — writing ``<stem>.hb.pN.json`` before taking the lock
+        would make a restarting run's OWN heartbeat block the
+        stale-reclaim of its predecessor's lock (the file names are
+        identical across runs)."""
+        self.heartbeat_path = path
+        if path is not None and self.distributed:
+            self._beat()
+
+    def _hb_snapshot(self, rank: int) -> Optional[str]:
+        try:
+            return self.kv.blocking_key_value_get(self._hb_key(rank), 50)
+        except Exception:  # noqa: BLE001 — absent key / timeout
+            return None
+
+    # -- exchange --------------------------------------------------------
+
+    def exchange(self, kind: str, payload: dict) -> list:
+        return self.exchange_timed(kind, payload)[0]
+
+    def exchange_timed(self, kind: str, payload: dict):
+        with self._lock:
+            rnd = self._rounds.get(kind, 0)
+            self._rounds[kind] = rnd + 1
+        if rnd >= 2:
+            # Bounded KV footprint: by the time this rank STARTS round
+            # r of a kind, every rank has finished round r-2 of it (a
+            # rank sets its r-1 key only after its own r-2 exchange
+            # returned, i.e. after reading everyone's r-2 keys), so
+            # this rank's r-2 key has been read by all and is safe to
+            # drop. At most two rounds of keys per kind stay live —
+            # without this, a week-long run would grow the
+            # coordination service's store by one key set per chunk
+            # boundary forever.
+            try:
+                self.kv.key_value_delete(
+                    self._key(kind, rnd - 2, self.process_index))
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        self.kv.key_value_set(self._key(kind, rnd, self.process_index),
+                              json.dumps(payload))
+        t0 = time.monotonic()
+        out = []
+        for rank in range(self.process_count):
+            if rank == self.process_index:
+                out.append(dict(payload))
+            else:
+                out.append(self._await(kind, rnd, rank))
+        return out, time.monotonic() - t0
+
+    def _await(self, kind: str, rnd: int, rank: int) -> dict:
+        """Wait for one peer's payload, bounded by heartbeat liveness:
+        the wait extends as long as the peer's heartbeat keeps CHANGING
+        (observed on the local clock — no cross-host wall-clock
+        comparison), and raises :class:`PeerLostError` once it has been
+        static for ``barrier_timeout_s``."""
+        key = self._key(kind, rnd, rank)
+        slice_ms = max(50, int(min(250.0,
+                                   self.barrier_timeout_s * 250)))
+        t0 = time.monotonic()
+        hb_prev = self._hb_snapshot(rank)
+        last_change = t0
+        while True:
+            try:
+                return json.loads(
+                    self.kv.blocking_key_value_get(key, slice_ms))
+            except Exception:  # noqa: BLE001 — timeout slice elapsed
+                pass
+            now = time.monotonic()
+            hb = self._hb_snapshot(rank)
+            if hb is not None and hb != hb_prev:
+                hb_prev = hb
+                last_change = now
+            if now - last_change >= self.barrier_timeout_s:
+                waited = now - t0
+                raise PeerLostError(
+                    f"peer process {rank} lost at exchange "
+                    f"{kind!r} round {rnd}: no payload and a static "
+                    f"heartbeat for {now - last_change:.1f}s (barrier "
+                    f"timeout {self.barrier_timeout_s:g}s; waited "
+                    f"{waited:.1f}s total)",
+                    lost=(rank,), waited_s=waited,
+                    timeout_s=self.barrier_timeout_s)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the heartbeat thread and remove this rank's probe file
+        (a clean exit must read as 'gone', not 'freshly alive', to the
+        stem lock's reclaim judgment). Idempotent."""
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
+        if self.heartbeat_path is not None:
+            try:
+                os.unlink(self.heartbeat_path)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "KVCoordinator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def heartbeat_path_for(stem: str, process_index: int) -> str:
+    """The per-process coordinator heartbeat probe file for a
+    checkpoint stem: ``<stem>.hb.p<rank>.json``. One naming rule shared
+    by the coordinator (writer), the stem lock's reclaim judgment
+    (reader — a dead-pid lock with any FRESH peer heartbeat under
+    ``<stem>.hb.p*.json`` is NOT stale) and external probes."""
+    return f"{stem}.hb.p{process_index}.json"
+
+
+def distributed_coordinator(namespace: str,
+                            barrier_timeout_s: float = 60.0,
+                            heartbeat_interval_s: float = 0.5,
+                            heartbeat_stem: Optional[str] = None
+                            ) -> Coordinator:
+    """The supervisor's default coordinator: a :class:`KVCoordinator`
+    over the live ``jax.distributed`` client when this runtime is part
+    of a multi-process job, else the single-process identity
+    :class:`Coordinator`. ``heartbeat_stem`` (the checkpoint stem)
+    places the per-rank probe file via :func:`heartbeat_path_for`.
+    Never initializes the backend itself (the same discipline as
+    ``telemetry._process_info``)."""
+    from parallel_heat_tpu.utils.telemetry import _process_info
+
+    pi, pc = _process_info()
+    if pc <= 1:
+        return Coordinator()
+    from jax._src import distributed as _jax_dist
+
+    client = _jax_dist.global_state.client
+    if client is None:  # pragma: no cover — pc > 1 implies a client
+        return Coordinator()
+    hb_path = (heartbeat_path_for(heartbeat_stem, pi)
+               if heartbeat_stem is not None else None)
+    return KVCoordinator(client, pi, pc, namespace=namespace,
+                         barrier_timeout_s=barrier_timeout_s,
+                         heartbeat_interval_s=heartbeat_interval_s,
+                         heartbeat_path=hb_path)

@@ -39,6 +39,21 @@ wires that shape around :func:`solver.solve_stream`:
   NaN trip. Both ride :func:`solver.grid_stats`, the same fused
   observation-only reduction ``HeatConfig.diag_interval`` samples.
 
+- **distributed supervision** (``parallel/coordinator.py``,
+  SEMANTICS.md "Distributed supervision"): on a multi-process
+  ``shard_map`` run every boundary verdict above — guard, drift, stop
+  flags, transient faults — is exchanged over the ``jax.distributed``
+  KV store and merged deterministically, so every process takes the
+  identical action at the identical chunk boundary (one rank rolling
+  back alone would wedge the pod inside a collective); checkpoint
+  generations commit through the two-phase
+  ``save_generation_coordinated`` protocol; and a dead peer is
+  detected by its static heartbeat within one bounded barrier timeout
+  — the survivors exit ``EXIT_PREEMPTED`` with an ELASTIC resume
+  command for the surviving mesh instead of hanging in ``ppermute``
+  forever. Single-process, the coordinator is the identity and this
+  module's behavior is bitwise the pre-coordinator one.
+
 Everything here is observation + orchestration on the host side of
 chunk boundaries: the compiled simulation programs are bit-for-bit the
 ones an unsupervised run uses (SEMANTICS.md "Runtime guard and
@@ -50,6 +65,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
 import shlex
 import signal
 import threading
@@ -58,6 +74,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple
 
 from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.parallel import coordinator as coordination
 from parallel_heat_tpu.solver import (
     HeatResult,
     _prepare_initial,
@@ -178,6 +195,18 @@ class SupervisorPolicy:
     # guard cannot see; it is a retryable guard trip with
     # kind="drift". None = off.
     drift_tolerance: Optional[float] = None
+    # Multi-process (SPMD) supervision — parallel/coordinator.py.
+    # barrier_timeout_s bounds every chunk-boundary consensus exchange:
+    # a peer whose heartbeat stops CHANGING for this long is declared
+    # lost (PeerLostError -> a clean peer_lost preemption with an
+    # elastic resume command) instead of wedging the pod inside a
+    # collective. peer_heartbeat_s is the background beat cadence (KV
+    # key + the <stem>.hb.pN.json probe file the stem lock's reclaim
+    # judgment reads); it must be well under barrier_timeout_s so a
+    # slow-but-alive peer keeps proving liveness. Single-process runs
+    # never touch either.
+    barrier_timeout_s: float = 60.0
+    peer_heartbeat_s: float = 0.5
     # Injectable time sources. `sleep_fn` receives every backoff delay
     # (the bounded-exponential schedule above): tests pin the schedule
     # by recording calls instead of sleeping wall-clock, and service
@@ -208,6 +237,15 @@ class SupervisorPolicy:
             raise ValueError(f"drift_tolerance must be >= 0 (or None to "
                              f"disable the drift guard), got "
                              f"{self.drift_tolerance}")
+        if self.barrier_timeout_s <= 0:
+            raise ValueError(f"barrier_timeout_s must be > 0, got "
+                             f"{self.barrier_timeout_s}")
+        if not 0 < self.peer_heartbeat_s <= self.barrier_timeout_s:
+            raise ValueError(
+                f"peer_heartbeat_s must be in (0, barrier_timeout_s="
+                f"{self.barrier_timeout_s:g}], got "
+                f"{self.peer_heartbeat_s} — a beat slower than the "
+                f"barrier timeout would declare live peers dead")
         return self
 
 
@@ -304,14 +342,22 @@ def _is_transient_dispatch_error(e: BaseException) -> bool:
                 "connection reset"))
 
 
+_KEEP_MESH = object()  # _resume_command sentinel: keep config.mesh_shape
+
+
 def _resume_command(config: HeatConfig, stem: str, total_abs: int,
                     policy: SupervisorPolicy,
-                    extra_flags: Tuple[str, ...] = ()) -> str:
+                    extra_flags: Tuple[str, ...] = (),
+                    mesh_override=_KEEP_MESH) -> str:
     """The exact CLI line that continues this run from its newest
     checkpoint (printed on preemption; also in SupervisorResult).
     ``extra_flags`` carries caller flags the config doesn't know about
     (the CLI's --out/--initial-out etc.) so the resumed run still
-    delivers everything the original invocation asked for."""
+    delivers everything the original invocation asked for.
+    ``mesh_override`` (a tuple or None) replaces the config's mesh in
+    the printed line — the elastic-degrade path: a peer-lost exit
+    prints a mesh the SURVIVING hosts can actually build, resuming
+    through the checkpoint reshard-on-load path."""
     parts = ["python -m parallel_heat_tpu",
              f"--nx {config.nx}", f"--ny {config.ny}"]
     if config.nz is not None:
@@ -330,8 +376,10 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
         parts.append(f"--dtype {config.dtype}")
     if config.backend != "auto":
         parts.append(f"--backend {config.backend}")
-    if config.mesh_shape is not None:
-        parts.append("--mesh " + ",".join(map(str, config.mesh_shape)))
+    mesh = (config.mesh_shape if mesh_override is _KEEP_MESH
+            else mesh_override)
+    if mesh is not None:
+        parts.append("--mesh " + ",".join(map(str, mesh)))
     if config.halo_depth is not None:
         parts.append(f"--halo-depth {config.halo_depth}")
     if not config.overlap:
@@ -344,6 +392,8 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
               f"--max-retries {policy.max_retries}"]
     if policy.guard_interval is not None:
         parts.append(f"--guard-interval {policy.guard_interval}")
+    if policy.barrier_timeout_s != 60.0:
+        parts.append(f"--barrier-timeout {policy.barrier_timeout_s:g}")
     if config.diag_interval is not None:
         parts.append(f"--diag-interval {config.diag_interval}")
     if config.pipeline_depth is not None:
@@ -369,7 +419,7 @@ def run_supervised(config: HeatConfig, checkpoint,
                    faults=None, say=None,
                    resume_extra_flags: Tuple[str, ...] = (),
                    telemetry=None, checkpointer=None,
-                   interrupt=None) -> SupervisorResult:
+                   interrupt=None, coordinator=None) -> SupervisorResult:
     """Run ``config.steps`` more steps under supervision (guard +
     retained checkpoints + retry-with-rollback + preemption-safe exit).
 
@@ -397,34 +447,135 @@ def run_supervised(config: HeatConfig, checkpoint,
     workers enforce per-job deadlines and cancellation without a
     second signal vocabulary.
 
+    ``coordinator`` (a :class:`parallel_heat_tpu.parallel.coordinator.
+    Coordinator`) is the multi-process consensus layer; by default one
+    is built automatically — the identity coordinator single-process
+    (behavior bitwise the pre-coordinator supervisor), a KV-store
+    coordinator when this runtime is part of a ``jax.distributed``
+    job. With a distributed coordinator every chunk-boundary verdict
+    (guard/drift/stop/transient) and the retry/rollback/halt decision
+    is a CONSENSUS, checkpoint generations commit through the
+    two-phase protocol, and a dead peer surfaces as a bounded
+    ``peer_lost`` preemption carrying an elastic resume command
+    (SEMANTICS.md "Distributed supervision"). Tests inject
+    thread-simulated coordinators here; a caller-supplied coordinator
+    is never closed by the supervisor.
+
     The run holds an exclusive lock on the checkpoint stem
     (``utils.checkpoint.acquire_stem_lock``): two supervised runs
     sharing a stem would prune and roll back to each other's
     generations, so the second raises
     :class:`utils.checkpoint.StemLockError` at startup instead. A
     stale lock (the holder pid is dead — SIGKILL/OOM) is reclaimed
-    automatically; multi-process SPMD runs are one logical run and
-    process 0 holds the lock for all of them.
+    automatically; multi-process SPMD runs are one logical run whose
+    lock is held by process 0 FOR all ranks, with reclaim additionally
+    gated on the run's per-rank coordinator heartbeats — a crashed
+    process 0 with live peers keeps the stem locked until those peers'
+    own peer-lost exit stops their beats.
 
     Raises :class:`PermanentFailure` for non-retryable failures; the
     last retained checkpoint still holds the newest verified-good
     state.
     """
-    from parallel_heat_tpu.utils.telemetry import _process_info
-
+    policy = (policy or SupervisorPolicy()).validate()
+    stem = ckpt.checkpoint_stem(checkpoint)
+    coord = coordinator
+    own_coord = False
+    if coord is None:
+        # NOTE: no heartbeat probe file yet — it is enabled only after
+        # the stem lock is held. The probe files feed the lock's
+        # stale-reclaim judgment, and a restarting run writing its own
+        # <stem>.hb.pN.json first would block reclaim of its
+        # predecessor's stale lock forever (identical file names
+        # across runs).
+        coord = coordination.distributed_coordinator(
+            namespace=f"heatsup:{os.path.basename(stem)}:{start_step}",
+            barrier_timeout_s=policy.barrier_timeout_s,
+            heartbeat_interval_s=policy.peer_heartbeat_s)
+        own_coord = True
     release_stem = None
-    if _process_info()[0] == 0:
-        release_stem = ckpt.acquire_stem_lock(
-            ckpt.checkpoint_stem(checkpoint))
     try:
+        lock_err = None
+        if coord.process_index == 0:
+            try:
+                release_stem = ckpt.acquire_stem_lock(
+                    stem,
+                    heartbeat_glob=(f"{stem}.hb.p*.json"
+                                    if coord.distributed else None),
+                    heartbeat_timeout_s=(3 * policy.barrier_timeout_s
+                                         if coord.distributed
+                                         else None))
+            except ckpt.StemLockError as e:
+                lock_err = str(e)
+                if not coord.distributed:
+                    raise
+        if coord.distributed:
+            # Startup consensus: every rank must learn rank 0's lock
+            # verdict — a rank proceeding while rank 0 bailed would
+            # wait a whole barrier timeout to find out the hard way.
+            verdicts = coord.exchange("startup", {"lock": lock_err})
+            if verdicts[0].get("lock") is not None:
+                raise ckpt.StemLockError(verdicts[0]["lock"])
+            # Lock held (by rank 0, for everyone): NOW the per-rank
+            # probe files may exist — they extend the lock's life past
+            # a dead rank 0, never block a fresh acquisition.
+            if getattr(coord, "heartbeat_path", None) is None:
+                coord.set_heartbeat_path(
+                    coordination.heartbeat_path_for(
+                        stem, coord.process_index))
         return _run_supervised(
             config, checkpoint, policy=policy, initial=initial,
             start_step=start_step, faults=faults, say=say,
             resume_extra_flags=resume_extra_flags, telemetry=telemetry,
-            checkpointer=checkpointer, interrupt=interrupt)
+            checkpointer=checkpointer, interrupt=interrupt,
+            coordinator=coord)
     finally:
         if release_stem is not None:
             release_stem()
+        if own_coord:
+            coord.close()
+
+
+def _local_shard_stats(grid) -> dict:
+    """Host-side partial grid stats over THIS process's addressable
+    shards (min/max/heat) — the distributed drift guard's input to
+    ``coordinator.merge_stats``. Never a device collective: a verdict
+    must be formable even when a peer is gone. f64 host accumulation
+    (the drift bounds carry slack; exactness is not required,
+    determinism is — numpy reductions are)."""
+    import numpy as np
+
+    shards = getattr(grid, "addressable_shards", None)
+    if shards is None:
+        arrs = [np.asarray(grid)]
+    else:
+        arrs = [np.asarray(s.data) for s in shards]
+    return {"min": float(min(a.min() for a in arrs)),
+            "max": float(max(a.max() for a in arrs)),
+            "heat": float(sum(a.sum(dtype=np.float64) for a in arrs))}
+
+
+def _local_finite(coord, grid) -> bool:
+    """The guard observation: single-process keeps the fused on-device
+    reduction (bitwise the pre-coordinator supervisor); a distributed
+    coordinator switches to the host-side check of THIS process's
+    addressable shards — process-local, so (a) a rank-local corruption
+    produces a rank-local verdict (the split-brain the consensus merge
+    exists to resolve) and (b) no guard can wedge on a dead peer."""
+    if coord.distributed:
+        return ckpt._host_all_finite(grid)
+    return grid_all_finite(grid)
+
+
+def _global_stats(coord, grid) -> dict:
+    """Grid stats for the drift guard: the fused device reduction
+    single-process; host-side partials merged over the coordinator
+    when distributed (same no-collective rationale as
+    :func:`_local_finite`)."""
+    if not coord.distributed:
+        return grid_stats(grid)
+    parts = coord.exchange("stats", _local_shard_stats(grid))
+    return coordination.merge_stats(parts)
 
 
 def _run_supervised(config: HeatConfig, checkpoint,
@@ -433,11 +584,21 @@ def _run_supervised(config: HeatConfig, checkpoint,
                     faults=None, say=None,
                     resume_extra_flags: Tuple[str, ...] = (),
                     telemetry=None, checkpointer=None,
-                    interrupt=None) -> SupervisorResult:
+                    interrupt=None,
+                    coordinator=None) -> SupervisorResult:
     """The supervised loop proper; :func:`run_supervised` wraps it in
-    the stem lock."""
+    the stem lock and the coordinator lifecycle."""
     config = config.validate()
     policy = (policy or SupervisorPolicy()).validate()
+    coord = coordinator if coordinator is not None \
+        else coordination.Coordinator()
+    if faults is not None:
+        bind = getattr(faults, "bind_process", None)
+        if bind is not None:
+            # Rank-scoped plans (FaultPlan.only_process) judge against
+            # the COORDINATOR rank: thread-simulated ranks share one
+            # OS process, so the runtime's process index would lie.
+            bind(coord.process_index)
     say = say or (lambda *a: None)
     if telemetry is not None:
         # Header carries the user's config (guard_interval included);
@@ -528,6 +689,18 @@ def _run_supervised(config: HeatConfig, checkpoint,
         if telemetry is not None:
             telemetry.emit(event, **fields)
 
+    def emit_consensus(action, step, merged):
+        # One event per boundary whose MERGED verdict demands an
+        # action (trip/rollback/interrupt/transient): the artifact
+        # every rank's shard carries, so cross-rank agreement is
+        # auditable (the mp chaos cells assert the same action at the
+        # same step on every shard). Distributed only — single-process
+        # streams stay byte-compatible with the pre-coordinator ones.
+        if coord.distributed:
+            emit("consensus_verdict", step=step, action=action,
+                 verdict={k: v for k, v in merged.items()
+                          if v is not None})
+
     def fail(diagnosis: str, kind: str = "exhausted",
              drained: bool = False) -> PermanentFailure:
         if not drained:
@@ -582,14 +755,34 @@ def _run_supervised(config: HeatConfig, checkpoint,
             # Device copy now (donation-safe), gather + finite-verify +
             # atomic commit on the worker — the next chunk dispatches
             # while the snapshot drains. Barriers (rollback/interrupt/
-            # final) are the only places the loop waits for it.
+            # final) are the only places the loop waits for it. Under
+            # a distributed coordinator the worker runs the two-phase
+            # commit (save_generation_coordinated): its KV exchanges
+            # live on the worker thread, host-side only.
             saver.submit(stem, grid, step_abs, ckpt_cfg,
-                         on_done=_committed, protect=ckpt_protect)
+                         on_done=_committed, protect=ckpt_protect,
+                         coordinator=(coord if coord.distributed
+                                      else None))
             return
         t_save = clock()
-        last_path = ckpt.save_generation(
-            stem, grid, step_abs, ckpt_cfg, keep=policy.keep_checkpoints,
-            layout=policy.layout, compress=policy.compress)
+        if coord.distributed:
+            path, skipped = ckpt.save_generation_coordinated(
+                stem, grid, step_abs, ckpt_cfg, coord,
+                keep=policy.keep_checkpoints, layout=policy.layout,
+                compress=policy.compress)
+            if skipped:
+                emit("checkpoint_skipped", step=step_abs,
+                     reason="non_finite_consensus")
+                say(f"Supervisor: checkpoint at step {step_abs} "
+                    f"skipped by consensus (a rank reported non-finite "
+                    f"shards); previous generation stays newest")
+                return
+            last_path = path
+        else:
+            last_path = ckpt.save_generation(
+                stem, grid, step_abs, ckpt_cfg,
+                keep=policy.keep_checkpoints,
+                layout=policy.layout, compress=policy.compress)
         n_ckpt += 1
         emit("checkpoint_save", step=step_abs, path=str(last_path),
              wall_s=clock() - t_save,
@@ -623,7 +816,14 @@ def _run_supervised(config: HeatConfig, checkpoint,
         # newest generation.
         ckpt_barrier("interrupt")
         if not already_saved:
-            if grid_all_finite(cur):
+            if coord.distributed:
+                # The coordinated save embeds the guard: the two-phase
+                # commit gate skips the generation GLOBALLY when any
+                # rank's shards are non-finite, so the flush needs no
+                # separate (collective) verdict here.
+                save(cur, done)
+                ckpt_barrier("interrupt")
+            elif grid_all_finite(cur):
                 save(cur, done)
                 ckpt_barrier("interrupt")
             else:
@@ -690,7 +890,7 @@ def _run_supervised(config: HeatConfig, checkpoint,
         #    the one that adds information.)
         from parallel_heat_tpu.utils import profiling
 
-        s0 = grid_stats(state)
+        s0 = _global_stats(coord, state)
         cells = profiling.cell_count(config)
         range0 = s0["max"] - s0["min"]
         scale = max(range0, abs(s0["max"]), abs(s0["min"]), 1e-30)
@@ -726,261 +926,392 @@ def _run_supervised(config: HeatConfig, checkpoint,
                         f"({drift_env['flux_per_step']:g}/step + slack)")
         return None
 
-    with _signal_handlers(stop), \
-            _saver_cleanup(saver if own_saver else None):
-        save(state, done)
-        while done < total_abs and final is None:
-            seg_base = done
-            last_guarded = done  # guard-verified (or checkpoint-loaded)
-            # Stall tracker, reset per segment: a rollback replays from
-            # a verified state, so the residual trajectory restarts.
-            best_res = math.inf
-            stall_run = 0
-            stall_from = seg_base
-            # Heat-rate baseline, reset per segment (a rollback reloads
-            # verified state; its heat restarts the rate window).
-            if drift_env is not None:
-                seg_heat = grid_stats(state)["heat"]
-                seg_heat_step = done
-            if telemetry is not None:
-                # Chunk events carry absolute steps: the stream counts
-                # from its own start, each segment's base is added here.
-                telemetry.step_offset = seg_base
-            stream = solve_stream(run_base.replace(steps=total_abs - done),
-                                  initial=state, chunk_steps=chunk,
-                                  telemetry=telemetry)
-            cur = state  # freshest NOT-yet-donated grid
-            res = None
-            try:
-                while True:
-                    if faults is not None:
-                        faults.before_chunk()
-                    why = _stop_why()
-                    if why is not None:
-                        return interrupted(cur, done, why,
-                                           already_saved=False)
-                    try:
-                        res = next(stream)
-                    except StopIteration:
-                        break
-                    cur = res.grid
-                    step_abs = seg_base + res.steps_run
-                    ckpt_due = step_abs >= (
-                        (done // every + 1) * every) or step_abs >= total_abs
-                    guard_due = ckpt_due or step_abs >= (
-                        (done // guard_iv + 1) * guard_iv)
-                    if res.converged:
-                        ckpt_due = guard_due = True
-                    if faults is not None:
-                        # observed=guard_due: an injection landing on a
-                        # boundary the guard never inspects would be
-                        # silently dropped with the next chunk's
-                        # `cur = res.grid` — the plan defers it to the
-                        # first guarded boundary instead.
-                        cur = faults.corrupt(cur, step_abs,
-                                             observed=guard_due)
-                    if guard_due:
-                        if not grid_all_finite(cur):
-                            trips += 1
-                            trip_steps.append(step_abs)
-                            trip_windows.append((last_guarded, step_abs))
-                            emit("guard_trip", step=step_abs,
-                                 window=[last_guarded, step_abs])
-                            raise _GuardTrip((last_guarded, step_abs))
-                        if drift_env is not None:
-                            # Reuse the chunk's own diagnostics sample
-                            # when it exists (cur IS res.grid whenever
-                            # no fault plan rewrote it) — no second
-                            # full-grid sweep at shared boundaries.
-                            st = (res.diagnostics
-                                  if faults is None
-                                  and res.diagnostics is not None
-                                  else grid_stats(cur))
-                            why = _drift_violation(
-                                st, seg_heat, step_abs - seg_heat_step)
-                            if why is not None:
-                                progress += 1
-                                emit("progress_trip", kind="drift",
-                                     step=step_abs,
-                                     window=[last_guarded, step_abs],
-                                     detail=why)
-                                raise _GuardTrip(
-                                    (last_guarded, step_abs),
-                                    kind="drift")
-                            seg_heat = st["heat"]
-                            seg_heat_step = step_abs
-                        last_guarded = step_abs
-                    if (policy.stall_windows is not None
-                            and config.converge
-                            and res.residual is not None
-                            and not res.converged):
-                        # Progress guard, stall classifier: a new
-                        # residual minimum resets the window count; K
-                        # consecutive observations without one is a
-                        # plateau retrying cannot fix (the same program
-                        # replays the same residuals).
-                        if (math.isfinite(res.residual)
-                                and res.residual < best_res):
-                            best_res = res.residual
-                            stall_run = 0
-                            stall_from = step_abs
+    try:
+        with _signal_handlers(stop), \
+                _saver_cleanup(saver if own_saver else None):
+            save(state, done)
+            while done < total_abs and final is None:
+                seg_base = done
+                last_guarded = done  # guard-verified (or checkpoint-loaded)
+                # Stall tracker, reset per segment: a rollback replays from
+                # a verified state, so the residual trajectory restarts.
+                best_res = math.inf
+                stall_run = 0
+                stall_from = seg_base
+                # Heat-rate baseline, reset per segment (a rollback reloads
+                # verified state; its heat restarts the rate window).
+                if drift_env is not None:
+                    seg_heat = _global_stats(coord, state)["heat"]
+                    seg_heat_step = done
+                if telemetry is not None:
+                    # Chunk events carry absolute steps: the stream counts
+                    # from its own start, each segment's base is added here.
+                    telemetry.step_offset = seg_base
+                stream = solve_stream(run_base.replace(steps=total_abs - done),
+                                      initial=state, chunk_steps=chunk,
+                                      telemetry=telemetry)
+                cur = state  # freshest NOT-yet-donated grid
+                res = None
+                try:
+                    while True:
+                        local_fault = None
+                        if faults is not None:
+                            try:
+                                faults.before_chunk()
+                            except InjectedTransientError as fe:
+                                # Deferred into the boundary consensus: on
+                                # a single-rank injection every OTHER rank
+                                # must also roll back (instead of
+                                # dispatching into a wedged collective).
+                                local_fault = str(fe)
+                        # Pre-dispatch consensus: stop flags (signals, the
+                        # caller's interrupt hook) and pre-dispatch faults.
+                        # Single-process this is the identity — the merged
+                        # verdict IS the local one, bitwise the old loop.
+                        pre_verdicts, pre_wait = coord.exchange_timed(
+                            "pre", {"stop": _stop_why(),
+                                    "fault": local_fault})
+                        pre = coordination.merge_boundary(pre_verdicts)
+                        if pre["fault"] is not None:
+                            emit_consensus("transient", done, pre)
+                            raise InjectedTransientError(pre["fault"])
+                        if pre["stop"] is not None:
+                            if coord.distributed:
+                                emit_consensus("interrupt", done, pre)
+                            return interrupted(cur, done, pre["stop"],
+                                               already_saved=False)
+                        local_err = None
+                        try:
+                            # (a raise leaves `res` holding the
+                            # previous chunk's result — the stream-
+                            # exhausted `break` relies on that,
+                            # exactly as before)
+                            res = next(stream)
+                        except StopIteration:
+                            break
+                        except Exception as e:
+                            if coord.distributed \
+                                    and _is_transient_dispatch_error(e):
+                                # Hold the local transient for the boundary
+                                # consensus below so every rank leaves this
+                                # chunk through the same rollback; non-
+                                # transient errors crash this rank and the
+                                # peers detect the corpse by heartbeat.
+                                local_err = e
+                            else:
+                                raise
+                        if local_err is None:
+                            cur = res.grid
+                            step_abs = seg_base + res.steps_run
+                            ckpt_due = step_abs >= (
+                                (done // every + 1) * every) \
+                                or step_abs >= total_abs
+                            guard_due = ckpt_due or step_abs >= (
+                                (done // guard_iv + 1) * guard_iv)
+                            if res.converged:
+                                ckpt_due = guard_due = True
+                            if faults is not None:
+                                # observed=guard_due: an injection landing
+                                # on a boundary the guard never inspects
+                                # would be silently dropped with the next
+                                # chunk's `cur = res.grid` — the plan
+                                # defers it to the first guarded boundary
+                                # instead.
+                                cur = faults.corrupt(cur, step_abs,
+                                                     observed=guard_due)
+                            local = {"err": None, "stop": _stop_why()}
+                            if guard_due:
+                                local["finite"] = _local_finite(coord, cur)
+                                if (drift_env is not None
+                                        and coord.distributed
+                                        and local["finite"]):
+                                    # Ride the drift partials (3
+                                    # floats) on the post payload —
+                                    # a second blocking exchange per
+                                    # guarded boundary would double
+                                    # the straggler-amplified
+                                    # consensus latency for nothing.
+                                    local["stats"] = \
+                                        _local_shard_stats(cur)
                         else:
-                            stall_run += 1
-                            if stall_run >= policy.stall_windows:
-                                progress += 1
-                                # Commit in-flight saves first (the
-                                # diagnosis names the newest
-                                # checkpoint) — swallowed like fail()'s
-                                # barrier: a failed async save must not
-                                # mask the stall verdict being raised.
-                                try:
-                                    ckpt_barrier("failure")
-                                except Exception:  # noqa: BLE001
-                                    pass
-                                emit("progress_trip", kind="stalled",
-                                     step=step_abs,
-                                     window=[stall_from, step_abs],
-                                     windows=stall_run,
-                                     residual=res.residual,
-                                     best_residual=best_res,
-                                     eps=config.eps)
-                                raise fail(
-                                    f"progress guard: residual stalled "
-                                    f"at {res.residual:g} (best "
-                                    f"{best_res:g}, eps {config.eps:g})"
-                                    f" — no new minimum across "
-                                    f"{stall_run} consecutive windows, "
-                                    f"steps ({stall_from}, {step_abs}]."
-                                    f" The iteration has hit its "
-                                    f"precision floor above eps; "
-                                    f"retrying replays the same "
-                                    f"plateau. Raise eps, use a wider "
-                                    f"dtype, or cap steps. Newest "
-                                    f"checkpoint: {last_path}.",
-                                    kind="stalled", drained=True)
-                    done = step_abs
-                    if ckpt_due:
-                        save(cur, step_abs)
-                    if res.converged:
+                            step_abs = done
+                            ckpt_due = guard_due = False
+                            local = {"err": str(local_err),
+                                     "stop": _stop_why()}
+                        # Post-chunk consensus: the guard verdict (each
+                        # rank's LOCAL observation under a distributed
+                        # coordinator), mid-chunk transients, stop flags.
+                        post_verdicts, post_wait = coord.exchange_timed(
+                            "post", local)
+                        post = coordination.merge_boundary(post_verdicts)
+                        if coord.distributed:
+                            emit("barrier_wait", step=step_abs,
+                                 wait_s=pre_wait + post_wait)
+                        if post["err"] is not None:
+                            emit_consensus("transient", step_abs, post)
+                            if local_err is not None:
+                                raise local_err
+                            raise coordination.PeerTransientError(
+                                post["err"])
+                        if guard_due:
+                            if post["finite"] is False:
+                                trips += 1
+                                trip_steps.append(step_abs)
+                                trip_windows.append((last_guarded, step_abs))
+                                emit("guard_trip", step=step_abs,
+                                     window=[last_guarded, step_abs])
+                                emit_consensus("nan", step_abs, post)
+                                raise _GuardTrip((last_guarded, step_abs))
+                            if drift_env is not None:
+                                # Reuse the chunk's own diagnostics sample
+                                # when it exists (cur IS res.grid whenever
+                                # no fault plan rewrote it) — no second
+                                # full-grid sweep at shared boundaries.
+                                # Distributed: host partials rode the
+                                # post payload (never a collective, and
+                                # no second exchange) — the merged
+                                # finite==True consensus above implies
+                                # every rank included its stats.
+                                if coord.distributed:
+                                    st = coordination.merge_stats(
+                                        [v["stats"]
+                                         for v in post_verdicts
+                                         if "stats" in v])
+                                else:
+                                    st = (res.diagnostics
+                                          if faults is None
+                                          and res.diagnostics is not None
+                                          else grid_stats(cur))
+                                why = _drift_violation(
+                                    st, seg_heat, step_abs - seg_heat_step)
+                                if why is not None:
+                                    progress += 1
+                                    emit("progress_trip", kind="drift",
+                                         step=step_abs,
+                                         window=[last_guarded, step_abs],
+                                         detail=why)
+                                    emit_consensus("drift", step_abs, post)
+                                    raise _GuardTrip(
+                                        (last_guarded, step_abs),
+                                        kind="drift")
+                                seg_heat = st["heat"]
+                                seg_heat_step = step_abs
+                            last_guarded = step_abs
+                        if (policy.stall_windows is not None
+                                and config.converge
+                                and res.residual is not None
+                                and not res.converged):
+                            # Progress guard, stall classifier: a new
+                            # residual minimum resets the window count; K
+                            # consecutive observations without one is a
+                            # plateau retrying cannot fix (the same program
+                            # replays the same residuals).
+                            if (math.isfinite(res.residual)
+                                    and res.residual < best_res):
+                                best_res = res.residual
+                                stall_run = 0
+                                stall_from = step_abs
+                            else:
+                                stall_run += 1
+                                if stall_run >= policy.stall_windows:
+                                    progress += 1
+                                    # Commit in-flight saves first (the
+                                    # diagnosis names the newest
+                                    # checkpoint) — swallowed like fail()'s
+                                    # barrier: a failed async save must not
+                                    # mask the stall verdict being raised.
+                                    try:
+                                        ckpt_barrier("failure")
+                                    except Exception:  # noqa: BLE001
+                                        pass
+                                    emit("progress_trip", kind="stalled",
+                                         step=step_abs,
+                                         window=[stall_from, step_abs],
+                                         windows=stall_run,
+                                         residual=res.residual,
+                                         best_residual=best_res,
+                                         eps=config.eps)
+                                    raise fail(
+                                        f"progress guard: residual stalled "
+                                        f"at {res.residual:g} (best "
+                                        f"{best_res:g}, eps {config.eps:g})"
+                                        f" — no new minimum across "
+                                        f"{stall_run} consecutive windows, "
+                                        f"steps ({stall_from}, {step_abs}]."
+                                        f" The iteration has hit its "
+                                        f"precision floor above eps; "
+                                        f"retrying replays the same "
+                                        f"plateau. Raise eps, use a wider "
+                                        f"dtype, or cap steps. Newest "
+                                        f"checkpoint: {last_path}.",
+                                        kind="stalled", drained=True)
+                        done = step_abs
+                        if ckpt_due:
+                            save(cur, step_abs)
+                        if res.converged:
+                            final = res
+                            break
+                        if post["stop"] is not None:
+                            # Signal/interrupt landed during this chunk
+                            # (sampled into the post consensus, so every
+                            # rank flushes together): flush the fresh
+                            # (guard-verified above) state rather than
+                            # waiting for the pre-dispatch check.
+                            if coord.distributed:
+                                emit_consensus("interrupt", done, post)
+                            return interrupted(cur, done, post["stop"],
+                                               already_saved=ckpt_due)
+                    if final is None:
+                        # Stream exhausted: complete (done == total_abs), or
+                        # a defensive under-run — either way `res` is the
+                        # last verified chunk (None only when steps == 0,
+                        # which never enters this loop).
                         final = res
-                        break
-                    why = _stop_why()
-                    if why is not None:
-                        # Signal/interrupt landed during this chunk:
-                        # flush the fresh (guard-verified above) state
-                        # rather than waiting for the pre-dispatch
-                        # check.
-                        return interrupted(cur, done, why,
-                                           already_saved=ckpt_due)
-                if final is None:
-                    # Stream exhausted: complete (done == total_abs), or
-                    # a defensive under-run — either way `res` is the
-                    # last verified chunk (None only when steps == 0,
-                    # which never enters this loop).
-                    final = res
-            except Exception as e:
-                if isinstance(e, _GuardTrip):
-                    lo, hi = e.window
-                    if e.kind == "drift":
-                        # Finite-value corruption: retryable (a flipped
-                        # bit replays clean); a boundary bug persists
-                        # and exhausts the budget into a drift-kind
-                        # PermanentFailure below.
-                        kind = (f"progress guard: heat-content drift "
-                                f"in steps ({lo}, {hi}]")
-                    elif config.stability_margin() < 0:
-                        raise fail(
-                            f"non-finite grid values in steps ({lo}, "
-                            f"{hi}]: coefficient sum "
-                            f"{sum(config.coefficients):g} exceeds the "
-                            f"stability bound 1/2 (margin "
-                            f"{config.stability_margin():g}) — the "
-                            f"explicit scheme diverges deterministically; "
-                            f"retrying cannot help. Reduce the "
-                            f"coefficients (cx/cy/cz) below a sum of "
-                            f"1/2. Last good checkpoint: step {lo}.",
-                            kind="unstable",
-                        ) from None
-                    else:
-                        kind = (f"guard trip: non-finite values in "
-                                f"steps ({lo}, {hi}]")
-                elif _is_transient_dispatch_error(e):
-                    kind = f"transient dispatch error: {e}"
-                else:
-                    raise
-                # The rollback barrier: a trip must drain in-flight
-                # saves BEFORE anything reads the generation set — the
-                # exhausted-budget diagnosis below names the newest
-                # COMMITTED checkpoint, and the rollback load can never
-                # restore a generation whose rename has not landed.
-                ckpt_barrier("rollback")
-                retries += 1
-                if retries > policy.max_retries:
-                    # The window comes from the guard's own records
-                    # (the (last-verified, detected] span), never
-                    # reconstructed from the chunk size: the current
-                    # trip's window when this failure IS a trip, else
-                    # the first recorded one (labelled as such, since a
-                    # dispatch-error exhaustion may follow an earlier
-                    # recovered trip).
+                except Exception as e:
                     if isinstance(e, _GuardTrip):
                         lo, hi = e.window
-                        first = f" First bad chunk: steps ({lo}, {hi}]."
-                    elif trip_windows:
-                        lo, hi = trip_windows[0]
-                        first = (f" Earlier guard trip window: steps "
-                                 f"({lo}, {hi}].")
+                        if e.kind == "drift":
+                            # Finite-value corruption: retryable (a flipped
+                            # bit replays clean); a boundary bug persists
+                            # and exhausts the budget into a drift-kind
+                            # PermanentFailure below.
+                            kind = (f"progress guard: heat-content drift "
+                                    f"in steps ({lo}, {hi}]")
+                        elif config.stability_margin() < 0:
+                            raise fail(
+                                f"non-finite grid values in steps ({lo}, "
+                                f"{hi}]: coefficient sum "
+                                f"{sum(config.coefficients):g} exceeds the "
+                                f"stability bound 1/2 (margin "
+                                f"{config.stability_margin():g}) — the "
+                                f"explicit scheme diverges deterministically; "
+                                f"retrying cannot help. Reduce the "
+                                f"coefficients (cx/cy/cz) below a sum of "
+                                f"1/2. Last good checkpoint: step {lo}.",
+                                kind="unstable",
+                            ) from None
+                        else:
+                            kind = (f"guard trip: non-finite values in "
+                                    f"steps ({lo}, {hi}]")
+                    elif _is_transient_dispatch_error(e):
+                        kind = f"transient dispatch error: {e}"
                     else:
-                        first = ""
-                    raise fail(
-                        f"{kind} — fault persisted through "
-                        f"{policy.max_retries} rollback retr"
-                        f"{'y' if policy.max_retries == 1 else 'ies'}."
-                        f"{first} Newest verified checkpoint: "
-                        f"{last_path}.",
-                        kind=("drift" if isinstance(e, _GuardTrip)
-                              and e.kind == "drift" else "exhausted"),
-                        drained=True,
-                    ) from None
-                delay = min(policy.backoff_max_s,
-                            policy.backoff_base_s * 2 ** (retries - 1))
-                emit("retry", retry=retries,
-                     max_retries=policy.max_retries, kind=kind,
-                     backoff_s=delay)
-                say(f"Supervisor: {kind}; retry {retries}/"
-                    f"{policy.max_retries} after {delay:g}s backoff")
-                if delay > 0:
-                    policy.sleep_fn(delay)
-                src = ckpt.latest_checkpoint(stem)
-                if src is None:  # pragma: no cover (gen0 always exists)
-                    raise fail(
-                        f"{kind} — and no checkpoint generation of "
-                        f"{stem!r} survives to roll back to.",
-                        drained=True) from None
-                t_load = clock()
-                grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
-                rollbacks += 1
-                state, done = grid0, int(step0)
-                emit("rollback", step=done, path=str(src),
-                     load_wall_s=clock() - t_load)
-                say(f"Supervisor: rolled back to {src} (step {done})")
-                continue
-        # Completion barrier: the final retained generation must be
-        # committed before run_end is recorded and the result's
-        # checkpoint counts are read.
-        ckpt_barrier("final")
-        if final is not None and done < total_abs and not final.converged:
-            # Defensive stream under-run: record reality, don't loop.
-            say(f"Supervisor: stream under-ran at step {done} of "
-                f"{total_abs} without converging; stopping")
+                        raise
+                    # The rollback barrier: a trip must drain in-flight
+                    # saves BEFORE anything reads the generation set — the
+                    # exhausted-budget diagnosis below names the newest
+                    # COMMITTED checkpoint, and the rollback load can never
+                    # restore a generation whose rename has not landed.
+                    ckpt_barrier("rollback")
+                    retries += 1
+                    if retries > policy.max_retries:
+                        # The window comes from the guard's own records
+                        # (the (last-verified, detected] span), never
+                        # reconstructed from the chunk size: the current
+                        # trip's window when this failure IS a trip, else
+                        # the first recorded one (labelled as such, since a
+                        # dispatch-error exhaustion may follow an earlier
+                        # recovered trip).
+                        if isinstance(e, _GuardTrip):
+                            lo, hi = e.window
+                            first = f" First bad chunk: steps ({lo}, {hi}]."
+                        elif trip_windows:
+                            lo, hi = trip_windows[0]
+                            first = (f" Earlier guard trip window: steps "
+                                     f"({lo}, {hi}].")
+                        else:
+                            first = ""
+                        raise fail(
+                            f"{kind} — fault persisted through "
+                            f"{policy.max_retries} rollback retr"
+                            f"{'y' if policy.max_retries == 1 else 'ies'}."
+                            f"{first} Newest verified checkpoint: "
+                            f"{last_path}.",
+                            kind=("drift" if isinstance(e, _GuardTrip)
+                                  and e.kind == "drift" else "exhausted"),
+                            drained=True,
+                        ) from None
+                    delay = min(policy.backoff_max_s,
+                                policy.backoff_base_s * 2 ** (retries - 1))
+                    emit("retry", retry=retries,
+                         max_retries=policy.max_retries, kind=kind,
+                         backoff_s=delay)
+                    say(f"Supervisor: {kind}; retry {retries}/"
+                        f"{policy.max_retries} after {delay:g}s backoff")
+                    if delay > 0:
+                        policy.sleep_fn(delay)
+                    src = ckpt.latest_checkpoint(stem)
+                    if coord.distributed:
+                        # Rollback-target consensus: rank 0's discovery is
+                        # authoritative, so every rank loads the SAME
+                        # generation even if a shared-filesystem view is
+                        # momentarily inconsistent — the mp chaos cells
+                        # assert the per-rank rollback events name one
+                        # path.
+                        picked = coord.exchange(
+                            "rollback",
+                            {"path": str(src) if src is not None else None})
+                        src = picked[0]["path"]
+                    if src is None:  # pragma: no cover (gen0 always exists)
+                        raise fail(
+                            f"{kind} — and no checkpoint generation of "
+                            f"{stem!r} survives to roll back to.",
+                            drained=True) from None
+                    t_load = clock()
+                    grid0, step0, _ = ckpt.load_checkpoint(src, ckpt_cfg)
+                    rollbacks += 1
+                    state, done = grid0, int(step0)
+                    emit("rollback", step=done, path=str(src),
+                         load_wall_s=clock() - t_load)
+                    say(f"Supervisor: rolled back to {src} (step {done})")
+                    continue
+            # Completion barrier: the final retained generation must be
+            # committed before run_end is recorded and the result's
+            # checkpoint counts are read.
+            ckpt_barrier("final")
+            if final is not None and done < total_abs and not final.converged:
+                # Defensive stream under-run: record reality, don't loop.
+                say(f"Supervisor: stream under-ran at step {done} of "
+                    f"{total_abs} without converging; stopping")
+            if telemetry is not None:
+                telemetry.run_end(outcome="complete", steps_done=done,
+                                  retries=retries, rollbacks=rollbacks,
+                                  guard_trips=trips,
+                                  checkpoints_written=n_ckpt,
+                                  wall_s=clock() - t0)
+            if final is None:
+                # config.steps == 0 (or resume already at/past the target):
+                # nothing ran; generation zero was still written.
+                return _mk(None, done, False)
+            return _mk(final, done, False)
+    except coordination.PeerLostError as e:
+        # A peer process died (SIGKILL/OOM/host loss): the bounded
+        # barrier detected it instead of wedging inside a collective.
+        # Exit preempted with an ELASTIC resume command — a mesh the
+        # surviving hosts can actually build, resuming bit-exactly
+        # through the checkpoint reshard-on-load path (the newest
+        # COMMITTED generation; the two-phase protocol guarantees no
+        # partially-committed one is discoverable).
+        import jax
+
+        survivors = coord.process_count - len(e.lost)
+        n_dev = jax.local_device_count() * survivors
+        emit("peer_lost", step=done, lost=list(e.lost),
+             survivors=survivors, waited_s=e.waited_s,
+             timeout_s=e.timeout_s)
+        mesh = coordination.surviving_mesh_shape(config.shape, n_dev)
+        cmd = _resume_command(ckpt_cfg, stem, total_abs, policy,
+                              resume_extra_flags, mesh_override=mesh)
+        say(f"Supervisor: peer process(es) {sorted(e.lost)} lost "
+            f"(heartbeat static past the {e.timeout_s:g}s barrier "
+            f"timeout); newest committed checkpoint "
+            f"{ckpt.latest_checkpoint(stem)}. Resume on the "
+            f"{survivors} surviving host(s) with:\n  {cmd}")
         if telemetry is not None:
-            telemetry.run_end(outcome="complete", steps_done=done,
-                              retries=retries, rollbacks=rollbacks,
-                              guard_trips=trips,
+            telemetry.run_end(outcome="interrupted", signal="peer_lost",
+                              steps_done=done, retries=retries,
+                              rollbacks=rollbacks, guard_trips=trips,
                               checkpoints_written=n_ckpt,
                               wall_s=clock() - t0)
-        if final is None:
-            # config.steps == 0 (or resume already at/past the target):
-            # nothing ran; generation zero was still written.
-            return _mk(None, done, False)
-        return _mk(final, done, False)
+        return _mk(None, done, True, signame="peer_lost",
+                   resume_cmd=cmd)

@@ -38,6 +38,10 @@ _MANIFEST_VERSION = 2
 # Shard files are generation-named; loaders and the pruner match this
 # EXACT pattern so orphaned temp files can never be mistaken for data.
 _SHARD_RE_TMPL = r"shards_{gen}_p\d{{5}}\.npz"
+# Per-process LOCAL manifests of the coordinated two-phase commit
+# (save_generation_coordinated): rename-committed alongside the shard
+# file, pruned with the same generation discipline.
+_LOCAL_MANIFEST_RE_TMPL = r"local_{gen}_p\d{{5}}\.json"
 # Auto layout: shard when the grid is device-sharded and big enough
 # that a host gather hurts; below this, one gathered file is simpler.
 _SHARD_THRESHOLD_BYTES = 64 * 1024 * 1024
@@ -216,6 +220,100 @@ def _ckpt_dir_of(path: str) -> str:
     return path + ".ckpt"
 
 
+def _write_shard_file(d: str, grid, gen: str, proc: int,
+                      compress: bool = False,
+                      verify_finite: bool = False):
+    """Write one process's shard ``.npz`` (rename-committed). Streams
+    one zip member per shard — each device->host copy is released
+    before the next is made, so peak host memory is one shard, never
+    the grid. With ``verify_finite`` every gathered shard is checked
+    finite on the SAME host copy the writer serializes (no second
+    transfer); a non-finite shard aborts the write (no file lands) and
+    returns ``(None, False)``. Returns ``(fname, finite)``."""
+    import zipfile
+
+    shards = sorted(grid.addressable_shards, key=lambda s: s.device.id)
+    fname = f"shards_{gen}_p{proc:05d}.npz"
+    # Leading dot: temp names must never match the shard-file pattern a
+    # loader or pruner scans for (a crash can orphan them).
+    tmp = os.path.join(d, f".tmp-{os.getpid()}-{fname}")
+    try:
+        mode = zipfile.ZIP_DEFLATED if compress else zipfile.ZIP_STORED
+        with zipfile.ZipFile(tmp, "w", mode) as zf:
+            for sh in shards:
+                host = np.asarray(sh.data)
+                if verify_finite and not bool(np.isfinite(host).all()):
+                    return None, False
+                with zf.open(f"d{sh.device.id}.npy", "w",
+                             force_zip64=True) as fh:
+                    np.lib.format.write_array(fh, host,
+                                              allow_pickle=False)
+        _fsync_replace(tmp, os.path.join(d, fname))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return fname, True
+
+
+def _manifest_doc(grid, gen: str, step: int, config: HeatConfig,
+                  process_count: int) -> dict:
+    """The global generation manifest: device id -> block index for
+    every process, computable on p0 without communication."""
+    index_map = grid.sharding.devices_indices_map(grid.shape)
+    devices = {}
+    for dev, idx in index_map.items():
+        devices[str(dev.id)] = {
+            "process": dev.process_index,
+            "index": [[sl.start or 0,
+                       sl.stop if sl.stop is not None else n]
+                      for sl, n in zip(idx, grid.shape)],
+        }
+    return {
+        "version": _MANIFEST_VERSION,
+        "generation": gen,
+        "step": int(step),
+        "config": config.to_json(),
+        "shape": list(grid.shape),
+        "dtype": str(grid.dtype),
+        "mesh_shape": list(config.mesh_or_unit()),
+        "process_count": process_count,
+        "devices": devices,
+    }
+
+
+def _commit_manifest_and_prune(d: str, manifest: dict) -> None:
+    """Atomically publish ``manifest.json`` (THE commit point of a
+    sharded generation) and prune stale shard files, orphaned temps and
+    foreign-generation local manifests — run only on process 0, only
+    after every live process's shard file is known committed."""
+    gen = manifest["generation"]
+    mtmp = os.path.join(d, f".tmp-{os.getpid()}-manifest")
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+    _fsync_replace(mtmp, os.path.join(d, "manifest.json"))
+    live = _SHARD_RE_TMPL.format(gen=gen)
+    live_local = _LOCAL_MANIFEST_RE_TMPL.format(gen=gen)
+    for old in os.listdir(d):
+        if old == "manifest.json":
+            continue
+        if re.fullmatch(live, old) or re.fullmatch(live_local, old):
+            continue
+        if old.startswith((".tmp-", "shards_", "local_")):
+            try:
+                os.unlink(os.path.join(d, old))
+            except OSError:
+                pass
+    # A stale gathered .npz from an earlier, smaller run of the
+    # same name must not shadow this directory at load time
+    # (load_checkpoint prefers an existing file).
+    stem_npz = d[:-5] + ".npz"
+    if os.path.exists(stem_npz):
+        try:
+            os.unlink(stem_npz)
+        except OSError:
+            pass
+
+
 def _save_sharded(path, grid, step: int, config: HeatConfig,
                   compress: bool = False) -> str:
     """Per-process shard directory; returns the ``.ckpt`` dir written.
@@ -228,13 +326,17 @@ def _save_sharded(path, grid, step: int, config: HeatConfig,
     always see a consistent (old or new) set and a crash between the
     shard writes and the manifest write leaves the previous snapshot
     live. Stale generations are pruned after the manifest lands.
+
+    Multi-process runs under a supervisor coordinator should go through
+    :func:`save_generation_coordinated` instead: it replaces the
+    device-collective barriers below with bounded KV-store exchanges
+    and gates the manifest commit on every process's finite verdict.
     """
     import jax
 
     d = _ckpt_dir_of(path)
     os.makedirs(d, exist_ok=True)
     proc = jax.process_index()
-    shards = sorted(grid.addressable_shards, key=lambda s: s.device.id)
     # The generation id must agree across processes without
     # communication; the step count (monotone within a run) is exactly
     # that, with the process count folded in so a re-save of the same
@@ -244,27 +346,7 @@ def _save_sharded(path, grid, step: int, config: HeatConfig,
     # generation instead. A same-step same-topology re-save still
     # overwrites file-atomically.
     gen = f"s{int(step):012d}c{jax.process_count():04d}"
-    fname = f"shards_{gen}_p{proc:05d}.npz"
-    # Leading dot: temp names must never match the shard-file pattern a
-    # loader or pruner scans for (a crash can orphan them).
-    tmp = os.path.join(d, f".tmp-{os.getpid()}-{fname}")
-    import zipfile
-
-    try:
-        # Stream one zip member per shard (an .npz IS a zip of .npy
-        # members): each device->host copy is released before the next
-        # is made, so peak host memory is one shard, never the grid.
-        mode = zipfile.ZIP_DEFLATED if compress else zipfile.ZIP_STORED
-        with zipfile.ZipFile(tmp, "w", mode) as zf:
-            for sh in shards:
-                with zf.open(f"d{sh.device.id}.npy", "w",
-                             force_zip64=True) as fh:
-                    np.lib.format.write_array(fh, np.asarray(sh.data),
-                                              allow_pickle=False)
-        _fsync_replace(tmp, os.path.join(d, fname))
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    _write_shard_file(d, grid, gen, proc, compress)
 
     if jax.process_count() > 1:  # pragma: no cover (multi-host barrier)
         from jax.experimental import multihost_utils
@@ -272,55 +354,9 @@ def _save_sharded(path, grid, step: int, config: HeatConfig,
         multihost_utils.sync_global_devices("heat_ckpt_shards_written")
 
     if proc == 0:
-        # Global shard map: device id -> index, computable on p0 for
-        # every process without communication.
-        index_map = grid.sharding.devices_indices_map(grid.shape)
-        devices = {}
-        for dev, idx in index_map.items():
-            devices[str(dev.id)] = {
-                "process": dev.process_index,
-                "index": [[sl.start or 0,
-                           sl.stop if sl.stop is not None else n]
-                          for sl, n in zip(idx, grid.shape)],
-            }
-        manifest = {
-            "version": _MANIFEST_VERSION,
-            "generation": gen,
-            "step": int(step),
-            "config": config.to_json(),
-            "shape": list(grid.shape),
-            "dtype": str(grid.dtype),
-            "mesh_shape": list(config.mesh_or_unit()),
-            "process_count": jax.process_count(),
-            "devices": devices,
-        }
-        mtmp = os.path.join(d, f".tmp-{os.getpid()}-manifest")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f)
-        _fsync_replace(mtmp, os.path.join(d, "manifest.json"))
-        # Prune stale generations AND orphaned temps (every live
-        # process has published its shard file before the barrier
-        # above, so any .tmp-* here is from a crashed earlier run).
-        live = _SHARD_RE_TMPL.format(gen=gen)
-        for old in os.listdir(d):
-            if old == "manifest.json":
-                continue
-            if re.fullmatch(live, old):
-                continue
-            if old.startswith((".tmp-", "shards_")):
-                try:
-                    os.unlink(os.path.join(d, old))
-                except OSError:
-                    pass
-        # A stale gathered .npz from an earlier, smaller run of the
-        # same name must not shadow this directory at load time
-        # (load_checkpoint prefers an existing file).
-        stem_npz = d[:-5] + ".npz"
-        if os.path.exists(stem_npz):
-            try:
-                os.unlink(stem_npz)
-            except OSError:
-                pass
+        _commit_manifest_and_prune(
+            d, _manifest_doc(grid, gen, step, config,
+                             jax.process_count()))
     if jax.process_count() > 1:  # pragma: no cover (multi-host barrier)
         # Make save a proper collective: no process returns (and e.g.
         # immediately resumes) before the manifest is live.
@@ -416,19 +452,16 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
                 shape, sharding, arrays)
             return grid, step, saved
 
-    if jax.process_count() > 1:  # pragma: no cover
-        raise ValueError(
-            f"cannot resume sharded checkpoint {d}: saved topology "
-            f"(mesh {mesh_shape}, saved from {man['process_count']} "
-            f"process(es), generation {gen}) does not match the current "
-            f"one ({jax.process_count()} process(es), "
-            f"{len(jax.devices())} device(s)), or a per-process shard "
-            f"file is missing/mismatched. Multi-process resume needs "
-            f"the same process count as the save; to reshard instead, "
-            f"load on ONE process with every shard file visible (the "
-            f"host-assembly path reassembles and re-places the grid).")
-    # Single-process host assembly (topology changed): read every shard
-    # file and place each block into a full host grid.
+    # Host assembly (topology changed): read every shard file and place
+    # each block into a full host grid. Single-process operational
+    # resume, AND the elastic-degrade path for a SMALLER multi-process
+    # set: when every shard file of the saved (larger) run is visible
+    # on this filesystem, each surviving process assembles the full
+    # grid identically and `_replace_on_mesh` re-places it for the
+    # resuming mesh via `_prepare_initial`'s per-shard slice transfers
+    # — a 4-process checkpoint resumes on 2 processes (or 1) bit-
+    # exactly, which is what a peer-lost exit's printed resume command
+    # relies on (SEMANTICS.md "Distributed supervision").
     full = np.empty(shape, dtype=np.dtype(man["dtype"]))
     placed = 0
     pat = _SHARD_RE_TMPL.format(gen=re.escape(gen))
@@ -451,7 +484,8 @@ def _load_sharded(d: str, expect_config: HeatConfig | None):
             f"{man['process_count']} process(es), loading on "
             f"{jax.process_count()}). Each process of the saving run "
             f"wrote its own shard file — if the save was multi-process, "
-            f"copy every shards_{gen}_p*.npz onto one filesystem before "
+            f"copy every shards_{gen}_p*.npz onto one filesystem "
+            f"(every resuming host must see all of them) before "
             f"resuming here.")
     return _replace_on_mesh(full, step, saved, expect_config)
 
@@ -563,21 +597,118 @@ def save_generation(path, grid, step: int, config: HeatConfig,
     written = save_checkpoint(f"{stem}.g{int(step):012d}", grid, step,
                               config, compress=compress, layout=layout)
     if keep:
-        gens = generation_paths(stem)
-        keep_steps = set(sorted({s for s, _ in gens})[-keep:])
-        for s, p in gens:
-            if s in keep_steps:
-                continue
-            try:
-                if os.path.isdir(p):
-                    import shutil
-
-                    shutil.rmtree(p, ignore_errors=True)
-                else:
-                    os.unlink(p)
-            except OSError:
-                pass
+        _prune_generations(stem, keep)
     return written
+
+
+def _prune_generations(stem: str, keep: int) -> None:
+    """Drop complete generations beyond the newest ``keep`` steps —
+    runs only AFTER a new generation is complete, so a crash anywhere
+    leaves at least the previously retained set intact."""
+    gens = generation_paths(stem)
+    keep_steps = set(sorted({s for s, _ in gens})[-keep:])
+    for s, p in gens:
+        if s in keep_steps:
+            continue
+        try:
+            if os.path.isdir(p):
+                import shutil
+
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                os.unlink(p)
+        except OSError:
+            pass
+
+
+def save_generation_coordinated(path, grid, step: int,
+                                config: HeatConfig, coordinator,
+                                keep: int = 3, layout: str = "auto",
+                                compress: bool = False):
+    """Two-phase commit of one checkpoint generation across a
+    coordinator's process set; returns ``(path_or_None, skipped)``.
+
+    The distributed extension of the AsyncCheckpointer commit gate
+    (SEMANTICS.md "Distributed supervision"): a generation must never
+    be discoverable while any host's shard is missing or non-finite.
+
+    Phase 1 — every process verifies its ADDRESSABLE shards finite on
+    the host copy it serializes, rename-commits its shard file plus a
+    per-process local manifest, then reports ``{finite}`` over the
+    coordinator (the jax.distributed KV store — host-side only, so no
+    device collective can wedge on a dead peer; a SIGKILLed host
+    surfaces as a bounded :class:`~parallel_heat_tpu.parallel.
+    coordinator.PeerLostError` instead).
+
+    Phase 2 — only when EVERY process reported finite does process 0
+    commit the global generation manifest (the atomic rename
+    ``latest_checkpoint`` discovery keys on) and prune old
+    generations; a final exchange keeps save a proper barrier (no
+    process returns before the manifest is live). Any non-finite
+    report skips the generation GLOBALLY — the previous generation
+    stays newest on every host — and a crash between a local commit
+    and the global one leaves no manifest, so the previous generation
+    remains authoritative (chaos-certified).
+
+    Fully-addressable grids (single-process shardings under
+    thread-simulated ranks, replicated single-device SPMD runs) take
+    the same two phases with rank 0 as the only writer.
+    """
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    stem = checkpoint_stem(path)
+    name = f"{stem}.g{int(step):012d}"
+    rank, nproc = coordinator.process_index, coordinator.process_count
+    if _wants_sharded_layout(grid, layout) \
+            and not getattr(grid, "is_fully_addressable", True):
+        d = _ckpt_dir_of(name)
+        os.makedirs(d, exist_ok=True)
+        gen = f"s{int(step):012d}c{nproc:04d}"
+        fname, finite = _write_shard_file(d, grid, gen, rank, compress,
+                                          verify_finite=True)
+        if finite:
+            # Local manifest: which shards this process verified and
+            # committed, rename-published next to the shard file — the
+            # post-mortem record p0's global commit is conditioned on.
+            lname = f"local_{gen}_p{rank:05d}.json"
+            ltmp = os.path.join(d, f".tmp-{os.getpid()}-{lname}")
+            doc = {"generation": gen, "step": int(step),
+                   "process_index": rank, "finite": True,
+                   "shard_file": fname, "t_wall": time.time()}
+            with open(ltmp, "w") as f:
+                json.dump(doc, f)
+            _fsync_replace(ltmp, os.path.join(d, lname))
+        reports = coordinator.exchange(
+            "ckpt", {"step": int(step), "finite": bool(finite)})
+        ok = all(r.get("finite") for r in reports)
+        if ok and rank == 0:
+            _commit_manifest_and_prune(
+                d, _manifest_doc(grid, gen, step, config, nproc))
+            if keep:
+                _prune_generations(stem, keep)
+        # Commit barrier: nobody returns (or rolls back into
+        # discovery) before the manifest rename has landed on p0.
+        coordinator.exchange("ckpt", {"committed": ok})
+        return (d, False) if ok else (None, True)
+
+    # Fully-addressable: rank 0 is the only writer; every rank still
+    # contributes a finite verdict and waits for the commit.
+    finite = _host_all_finite(grid)
+    reports = coordinator.exchange(
+        "ckpt", {"step": int(step), "finite": bool(finite)})
+    ok = all(r.get("finite") for r in reports)
+    written = None
+    if ok and rank == 0:
+        written = save_generation(name, grid, step, config, keep=keep,
+                                  layout=layout, compress=compress)
+    done = coordinator.exchange(
+        "ckpt", {"committed": ok,
+                 "path": str(written) if written else None})
+    if ok:
+        written = written or next(
+            (v["path"] for v in done if v.get("path")), None)
+        return written, False
+    return None, True
 
 
 def latest_checkpoint(path):
@@ -660,7 +791,8 @@ def _stem_lock_mutex(path):
     return release
 
 
-def acquire_stem_lock(stem):
+def acquire_stem_lock(stem, heartbeat_glob=None,
+                      heartbeat_timeout_s=None):
     """Take the exclusive writer lock on ``stem``'s generation family;
     returns a zero-argument release callable. O_CREAT|O_EXCL makes the
     take atomic; a lockfile whose recorded pid no longer exists is
@@ -668,19 +800,51 @@ def acquire_stem_lock(stem):
     exists to survive) and is reclaimed, with the reclaim serialized
     by an flock sidecar so two racing starters cannot both "reclaim"
     and end up co-holding the stem. Raises :class:`StemLockError`
-    when a LIVE process holds it."""
+    when a LIVE process holds it.
+
+    Multi-process SPMD runs are one logical run whose lock is held by
+    PROCESS 0 — a dead holder pid alone cannot prove the run over
+    (process 0 can crash while ranks >= 1 still stream into the same
+    generation family). ``heartbeat_glob`` closes that gap: the lock
+    records the pattern of the run's per-rank coordinator heartbeat
+    probe files (``<stem>.hb.p*.json`` — the telemetry heartbeat-file
+    format ``parallel/coordinator.py`` rewrites), and a reclaimer
+    treats the lock as live while ANY matching file is fresher than
+    the recorded ``heartbeat_timeout_s``. Surviving ranks stop beating
+    within one barrier timeout of losing process 0 (their own
+    peer-lost exit), so the lock becomes reclaimable exactly when the
+    run is actually gone."""
     path = _stem_lock_path(stem)
     parent = os.path.dirname(os.path.abspath(path))
     if parent:
         os.makedirs(parent, exist_ok=True)
     unlock = _stem_lock_mutex(path)
     try:
-        return _acquire_stem_lock_locked(path)
+        return _acquire_stem_lock_locked(path, heartbeat_glob,
+                                         heartbeat_timeout_s)
     finally:
         unlock()
 
 
-def _acquire_stem_lock_locked(path):
+def _fresh_heartbeats(hb_glob: str, timeout_s: float) -> list:
+    """Heartbeat probe files under ``hb_glob`` whose mtime is within
+    ``timeout_s`` of now — evidence of live peers of a multi-process
+    run whose lock-holding process 0 died."""
+    import glob as _glob
+
+    fresh = []
+    now = time.time()
+    for p in _glob.glob(hb_glob):
+        try:
+            if now - os.path.getmtime(p) < timeout_s:
+                fresh.append(p)
+        except OSError:
+            continue
+    return fresh
+
+
+def _acquire_stem_lock_locked(path, heartbeat_glob=None,
+                              heartbeat_timeout_s=None):
     for _ in range(2):  # second pass: retake after reclaiming a stale lock
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -690,6 +854,7 @@ def _acquire_stem_lock_locked(path):
                     doc = json.load(f)
                 holder = int(doc.get("pid", -1))
             except (OSError, ValueError):
+                doc = {}
                 holder = -1  # torn/foreign lockfile: treat as stale
             alive = False
             if holder > 0:
@@ -700,6 +865,24 @@ def _acquire_stem_lock_locked(path):
                     alive = False
                 except OSError:
                     alive = True  # EPERM: exists but not ours
+            if not alive and doc.get("hb_glob"):
+                # Dead holder pid, but the lock belongs to a
+                # multi-process run: ranks >= 1 may still be streaming
+                # into this generation family. Any FRESH peer
+                # heartbeat probe file keeps the lock live.
+                fresh = _fresh_heartbeats(
+                    doc["hb_glob"], float(doc.get("hb_timeout_s", 60.0)))
+                if fresh:
+                    raise StemLockError(
+                        f"checkpoint stem {path[:-len('.lock')]!r} is "
+                        f"held by a multi-process run whose lock holder "
+                        f"(pid {holder}) died but whose peer ranks are "
+                        f"still alive (fresh heartbeats: {fresh}) — "
+                        f"reclaiming now would race their checkpoint "
+                        f"generations. Wait for their peer-lost exit "
+                        f"(bounded by the run's barrier timeout), or "
+                        f"remove {path!r} if every rank is truly "
+                        f"gone.") from None
             if alive:
                 # Our own pid counts as live too: two supervised runs
                 # in ONE process (threads) sharing a stem are the same
@@ -720,8 +903,13 @@ def _acquire_stem_lock_locked(path):
                 pass
             continue
         try:
-            os.write(fd, json.dumps(
-                {"pid": os.getpid(), "t_wall": time.time()}).encode())
+            lock_doc = {"pid": os.getpid(), "t_wall": time.time()}
+            if heartbeat_glob:
+                lock_doc["hb_glob"] = heartbeat_glob
+                lock_doc["hb_timeout_s"] = float(
+                    heartbeat_timeout_s if heartbeat_timeout_s
+                    is not None else 60.0)
+            os.write(fd, json.dumps(lock_doc).encode())
         finally:
             os.close(fd)
 
@@ -814,7 +1002,8 @@ class AsyncCheckpointer:
     # -- caller side -----------------------------------------------------
 
     def submit(self, path, grid, step: int, config: HeatConfig,
-               on_done=None, protect: bool = True) -> None:
+               on_done=None, protect: bool = True,
+               coordinator=None) -> None:
         """Queue one generation save of ``path``'s stem. ``on_done``
         (optional) is called on the worker thread with the commit
         record ``{step, path, skipped, wall_s, gather_s, error}`` —
@@ -826,7 +1015,18 @@ class AsyncCheckpointer:
         copies — SEMANTICS.md "Pipelined stream") and skips the
         device-side snapshot copy; the default copies, which is the
         only safe choice for depth-1 stream yields the next chunk
-        donates."""
+        donates.
+
+        ``coordinator`` (a distributed
+        :class:`~parallel_heat_tpu.parallel.coordinator.Coordinator`)
+        routes the commit through
+        :func:`save_generation_coordinated`'s two-phase protocol: the
+        worker's own finite gate is superseded by the GLOBAL gate (any
+        rank's non-finite shard skips the generation everywhere), and
+        the KV exchanges run on this worker thread — host-side only,
+        so an in-flight save can never wedge a device collective, and
+        a dead peer surfaces at the next drain barrier as a bounded
+        error instead of a hang."""
         if self._closed:
             raise RuntimeError("AsyncCheckpointer is closed")
         self._raise_pending()
@@ -840,7 +1040,8 @@ class AsyncCheckpointer:
             # queued.
             grid = jnp.copy(grid)
         self._q.put({"path": path, "snap": grid, "step": int(step),
-                     "config": config, "on_done": on_done})
+                     "config": config, "on_done": on_done,
+                     "coordinator": coordinator})
 
     def drain(self) -> float:
         """Block until every submitted save committed (or was skipped);
@@ -892,34 +1093,52 @@ class AsyncCheckpointer:
                     time.sleep(self.throttle_s)
                 t0 = time.perf_counter()
                 snap = item["snap"]
-                # One gather, not two: when the save will take the
-                # GATHERED layout anyway (the writer's own predicate —
-                # shared, so the two can never diverge), pull the
-                # snapshot to host once, verify that copy, and
-                # serialize FROM it — otherwise the verify pass and
-                # the writer would each pay a full device->host
-                # transfer. The sharded layout keeps the shard-by-shard
-                # verify (its writer also streams shard-by-shard; peak
-                # host memory stays one shard).
-                sharded = _wants_sharded_layout(snap, self.layout)
-                tg0 = time.perf_counter()
-                if sharded:
-                    finite = _host_all_finite(snap)
-                    payload = snap
-                else:
-                    payload = np.asarray(snap)
-                    finite = bool(np.isfinite(payload).all())
-                rec["gather_s"] = time.perf_counter() - tg0
-                if finite:
-                    rec["path"] = save_generation(
-                        item["path"], payload, item["step"],
-                        item["config"], keep=self.keep,
+                coordinator = item.get("coordinator")
+                if coordinator is not None:
+                    # Distributed two-phase commit: the global gate
+                    # (every rank's shard finite) supersedes this
+                    # worker's local one, and the KV exchanges run
+                    # HERE — host-side only, so an in-flight save can
+                    # never wedge a device collective.
+                    tg0 = time.perf_counter()
+                    path, skipped = save_generation_coordinated(
+                        item["path"], snap, item["step"],
+                        item["config"], coordinator, keep=self.keep,
                         layout=self.layout, compress=self.compress)
+                    rec["gather_s"] = time.perf_counter() - tg0
+                    rec["path"] = path
+                    rec["skipped"] = skipped
                 else:
-                    # Commit gate: never publish a bad generation; the
-                    # previous one stays newest and the supervisor's
-                    # guard/rollback machinery handles the corruption.
-                    rec["skipped"] = True
+                    # One gather, not two: when the save will take the
+                    # GATHERED layout anyway (the writer's own
+                    # predicate — shared, so the two can never
+                    # diverge), pull the snapshot to host once, verify
+                    # that copy, and serialize FROM it — otherwise the
+                    # verify pass and the writer would each pay a full
+                    # device->host transfer. The sharded layout keeps
+                    # the shard-by-shard verify (its writer also
+                    # streams shard-by-shard; peak host memory stays
+                    # one shard).
+                    sharded = _wants_sharded_layout(snap, self.layout)
+                    tg0 = time.perf_counter()
+                    if sharded:
+                        finite = _host_all_finite(snap)
+                        payload = snap
+                    else:
+                        payload = np.asarray(snap)
+                        finite = bool(np.isfinite(payload).all())
+                    rec["gather_s"] = time.perf_counter() - tg0
+                    if finite:
+                        rec["path"] = save_generation(
+                            item["path"], payload, item["step"],
+                            item["config"], keep=self.keep,
+                            layout=self.layout, compress=self.compress)
+                    else:
+                        # Commit gate: never publish a bad generation;
+                        # the previous one stays newest and the
+                        # supervisor's guard/rollback machinery
+                        # handles the corruption.
+                        rec["skipped"] = True
                 rec["wall_s"] = time.perf_counter() - t0
             except BaseException as e:  # noqa: BLE001 — surfaced at
                 # the next submit/drain barrier, exactly where a

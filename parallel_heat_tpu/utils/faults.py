@@ -22,6 +22,25 @@ reproducible point:
   (models the OOM kill / hard preemption; run inside a service WORKER
   so the daemon's orphan-detect/requeue/resume path faces a true
   corpse). Mutually exclusive with every in-process fault kind.
+- **single-host death in an SPMD run**: ``kill_process_at_chunk``
+  likewise SIGKILLs, but is meant to be rank-scoped (below) so exactly
+  one rank of a real multi-process run dies — the surviving ranks'
+  dead-peer detection (``parallel/coordinator.py``) is what the
+  ``mp_peer_lost`` chaos cell certifies.
+
+**Per-rank scoping** (``only_process=``): on a multi-process SPMD run
+every rank constructs the same plan, but a real fault lands on ONE
+host — ``only_process=1`` makes every firing hook a no-op on the other
+ranks (ordinals still count, so the schedule stays aligned). The
+supervisor binds its coordinator rank via :meth:`FaultPlan.
+bind_process`; unbound plans resolve the runtime's process index
+lazily. Rank-scoped corruption of a grid that spans non-addressable
+devices rewrites only THIS rank's addressable shards (host round trip
++ ``jax.make_array_from_single_device_arrays`` — a process-local
+construction, no collective), which is exactly the split-brain
+injection: the corrupt rank's local guard verdict trips while its
+peers' stay clean, and only the consensus layer can make them act
+together.
 
 Faults fire at supervisor hook points — ``before_chunk`` pre-dispatch,
 ``corrupt`` on each chunk's output — never inside compiled programs,
@@ -39,6 +58,8 @@ import os
 import signal as _signal
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
+
+import numpy as np
 
 
 class InjectedTransientError(RuntimeError):
@@ -94,6 +115,19 @@ class FaultPlan:
     # death rather than a polite in-process exception.
     kill_worker_at_chunk: Optional[int] = None
 
+    # SIGKILL this process before dispatching this chunk ordinal, like
+    # kill_worker_at_chunk, but intended for SPMD rank scoping: with
+    # only_process=r, rank r of a real multi-process run dies mid-run
+    # while its peers live — the mp_peer_lost chaos cell's injection
+    # (the surviving ranks must detect the corpse within one barrier
+    # timeout and exit preempted with an elastic resume command).
+    kill_process_at_chunk: Optional[int] = None
+
+    # Fire every fault of this plan ONLY on this process index (None:
+    # every process). Ordinals still advance on non-matching ranks so
+    # the firing schedule reads the same everywhere.
+    only_process: Optional[int] = None
+
     def __post_init__(self):
         if self.nan_at_step is not None and self.spike_at_step is not None:
             # The two corruptions share the one-shot firing state and
@@ -103,27 +137,54 @@ class FaultPlan:
             raise ValueError(
                 "FaultPlan: set nan_at_step or spike_at_step, not both "
                 "(they share the corruption slot; use two plans/runs)")
-        if self.kill_worker_at_chunk is not None and (
-                self.nan_at_step is not None
-                or self.spike_at_step is not None
-                or self.transient_on_chunks
-                or self.signal_at_chunk is not None):
+        kills = [k for k in (self.kill_worker_at_chunk,
+                             self.kill_process_at_chunk)
+                 if k is not None]
+        if len(kills) > 1:
+            raise ValueError(
+                "FaultPlan: set kill_worker_at_chunk or "
+                "kill_process_at_chunk, not both (one SIGKILL per "
+                "plan — the second could never fire)")
+        if kills and (self.nan_at_step is not None
+                      or self.spike_at_step is not None
+                      or self.transient_on_chunks
+                      or self.signal_at_chunk is not None):
             # SIGKILL ends the process: any in-process fault scheduled
             # alongside it either fires first (masking the death the
             # cell certifies) or never fires at all (certifying a
             # detection that never ran). Loud, like nan+spike.
             raise ValueError(
-                "FaultPlan: kill_worker_at_chunk models true process "
-                "death (SIGKILL) and cannot be combined with in-process "
-                "fault kinds (nan_at_step/spike_at_step/"
-                "transient_on_chunks/signal_at_chunk) — use separate "
-                "plans/runs")
+                "FaultPlan: kill_worker_at_chunk/kill_process_at_chunk "
+                "model true process death (SIGKILL) and cannot be "
+                "combined with in-process fault kinds (nan_at_step/"
+                "spike_at_step/transient_on_chunks/signal_at_chunk) — "
+                "use separate plans/runs")
 
     # -- firing state (not part of the schedule) -------------------------
     _chunks_seen: int = field(default=0, repr=False)
     _nan_fired: bool = field(default=False, repr=False)
     _transients_fired: set = field(default_factory=set, repr=False)
     _signal_fired: bool = field(default=False, repr=False)
+    _bound_process: Optional[int] = field(default=None, repr=False)
+
+    def bind_process(self, process_index: int) -> "FaultPlan":
+        """Pin the rank ``only_process`` is judged against (the
+        supervisor binds its coordinator rank — thread-simulated ranks
+        share one OS process, so the runtime's own process index would
+        be wrong there). Unbound plans resolve it lazily from the
+        runtime."""
+        self._bound_process = int(process_index)
+        return self
+
+    def _on_scoped_process(self) -> bool:
+        if self.only_process is None:
+            return True
+        rank = self._bound_process
+        if rank is None:
+            from parallel_heat_tpu.utils.telemetry import _process_info
+
+            rank = _process_info()[0]
+        return rank == self.only_process
 
     def before_chunk(self) -> int:
         """Pre-dispatch hook; returns this dispatch's global ordinal.
@@ -131,7 +192,9 @@ class FaultPlan:
         per the plan."""
         i = self._chunks_seen
         self._chunks_seen += 1
-        if self.kill_worker_at_chunk == i:
+        if not self._on_scoped_process():
+            return i
+        if self.kill_worker_at_chunk == i or self.kill_process_at_chunk == i:
             # No fired-flag: SIGKILL is uncatchable and ends the
             # process here — a retried schedule only re-reaches this
             # ordinal in a NEW process (the service re-dispatch), where
@@ -169,7 +232,7 @@ class FaultPlan:
               else self.spike_at_step)
         if at is None or step < at:
             return grid
-        if not observed:
+        if not observed or not self._on_scoped_process():
             return grid
         if self._nan_fired and not self.recurring:
             return grid
@@ -179,6 +242,22 @@ class FaultPlan:
 
         value = (jnp.nan if self.nan_at_step is not None
                  else self.spike_value)
+        if not getattr(grid, "is_fully_addressable", True):
+            # Rank-scoped corruption of a multi-process grid: rewrite
+            # only THIS rank's addressable shards (host round trip +
+            # make_array_from_single_device_arrays — process-local, no
+            # collective). The peers' local views stay clean: the
+            # split-brain injection the consensus layer exists for.
+            shards = sorted(grid.addressable_shards,
+                            key=lambda s: s.device.id)
+            locals_ = []
+            for n, sh in enumerate(shards):
+                a = np.asarray(sh.data).copy()
+                if n == 0:
+                    a[tuple(1 for _ in a.shape)] = float(value)
+                locals_.append(jax.device_put(a, sh.device))
+            return jax.make_array_from_single_device_arrays(
+                grid.shape, grid.sharding, locals_)
         if self.spike_at_step is not None and self.spike_region > 1:
             # Centered interior block (the grid center carries the
             # largest values, so an in-envelope overwrite there moves

@@ -30,6 +30,17 @@ an envelope — ``schema``, ``event``, ``t_wall`` (unix seconds),
 - supervisor lifecycle: ``guard_trip``, ``progress_trip`` (residual
   stall / heat-content drift), ``retry``, ``rollback``, ``signal``,
   ``permanent_failure``, ``run_end``;
+- distributed supervision (``parallel/coordinator.py``, SEMANTICS.md
+  "Distributed supervision" — multi-process runs only, each with the
+  emitting rank in the envelope's ``process_index``):
+  ``barrier_wait`` (per chunk boundary: seconds this rank spent in
+  the consensus exchanges — the per-rank straggler signal
+  ``tools/metrics_report.py``'s shard-glob mode renders as p50/p99
+  rows), ``consensus_verdict`` (a boundary whose MERGED verdict
+  demanded an action: ``action`` nan/drift/transient/interrupt plus
+  the merged fields — every rank's shard must carry the identical
+  action at the identical step), ``peer_lost`` (a dead peer detected:
+  ``lost`` ranks, ``survivors``, ``waited_s`` vs ``timeout_s``);
 - ensemble events (the batched engine, SEMANTICS.md "Ensemble" —
   member-scoped events carry a ``member`` field, the member-axis
   extension of this schema): ``ensemble_window`` (per dispatch window:

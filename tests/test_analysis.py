@@ -1432,3 +1432,22 @@ def test_ast_scan_covers_ensemble_package():
     assert batched in scanned
     findings = lint_paths([ens, batched])
     assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_ast_scan_covers_coordinator_module():
+    """`parallel/coordinator.py` (the distributed-supervision
+    consensus layer, ISSUE 10) rides the HL2xx gate — notably HL204 on
+    its heartbeat-thread-shared state — and the tree stays clean with
+    the baseline ledger empty."""
+    from parallel_heat_tpu.analysis.astlint import (
+        REPO_ROOT,
+        _iter_py_files,
+        default_scan_paths,
+        lint_paths,
+    )
+
+    coord = os.path.join(REPO_ROOT, "parallel_heat_tpu", "parallel",
+                         "coordinator.py")
+    assert coord in set(_iter_py_files(default_scan_paths()))
+    findings = lint_paths([coord])
+    assert [f for f in findings if f.severity == "error"] == []

@@ -522,6 +522,74 @@ def test_sharded_reshard_on_load_replaces_for_expected_mesh(tmp_path):
     assert isinstance(grid2, np.ndarray)
 
 
+def _rewrite_as_foreign_process_ckpt(d, process_count):
+    """Re-label a single-process sharded save as one written by
+    ``process_count`` processes: split the one shard file into
+    per-process files (contiguous device ranges) and patch the
+    manifest — the elastic reshard-on-load path trusts only the
+    manifest's block indices, which is exactly what this exercises."""
+    import json
+    import os
+    import re
+    import zipfile
+
+    mpath = os.path.join(d, "manifest.json")
+    man = json.load(open(mpath))
+    old = next(f for f in os.listdir(d) if f.startswith("shards_"))
+    gen = re.match(r"shards_(.*)_p\d{5}\.npz", old).group(1)
+    new_gen = gen[:-4] + f"{process_count:04d}"
+    with np.load(os.path.join(d, old)) as z:
+        blocks = {k: z[k] for k in z.files}
+    os.unlink(os.path.join(d, old))
+    ids = sorted(blocks, key=lambda k: int(k[1:]))
+    per = len(ids) // process_count
+    for proc in range(process_count):
+        fname = os.path.join(d, f"shards_{new_gen}_p{proc:05d}.npz")
+        with zipfile.ZipFile(fname, "w") as zf:
+            for k in ids[proc * per:(proc + 1) * per]:
+                with zf.open(f"{k}.npy", "w") as fh:
+                    np.lib.format.write_array(fh, blocks[k],
+                                              allow_pickle=False)
+    man["generation"] = new_gen
+    man["process_count"] = process_count
+    for n, k in enumerate(ids):
+        man["devices"][k[1:]]["process"] = n // per
+    json.dump(man, open(mpath, "w"))
+
+
+def test_elastic_resume_four_process_checkpoint_on_one(tmp_path):
+    # ISSUE 10 satellite: resuming a 4-process checkpoint on FEWER
+    # processes must be bitwise the uninterrupted run. Here the
+    # one-process end of the elastic-degrade path (the 4 -> 2 case
+    # rides the real 2-process mp_split_brain chaos cell): a sharded
+    # save re-labelled as 4-process loads via host assembly of ALL
+    # four shard files, re-places for the resuming mesh, and the
+    # continued solve matches bit for bit — on a smaller mesh AND on a
+    # single device.
+    import jax
+
+    kw = dict(nx=32, ny=32, backend="jnp")
+    full = solve(HeatConfig(steps=60, **kw))
+    half = solve(HeatConfig(steps=30, **kw, mesh_shape=(2, 4)))
+    cfg = HeatConfig(steps=30, **kw, mesh_shape=(2, 4))
+    d = save_checkpoint(tmp_path / "ck", half.grid, 30, cfg,
+                        layout="sharded")
+    _rewrite_as_foreign_process_ckpt(d, 4)
+    # smaller mesh (the peer-lost resume command's shape)
+    want = HeatConfig(steps=60, **kw, mesh_shape=(2, 2))
+    grid, step, _ = load_checkpoint(d, want)
+    assert step == 30
+    assert isinstance(grid, jax.Array)
+    assert len(grid.sharding.device_set) == 4
+    rest = solve(want.replace(steps=30), initial=grid)
+    np.testing.assert_array_equal(rest.to_numpy(), full.to_numpy())
+    # single device (no mesh in the resuming config)
+    grid1, step1, _ = load_checkpoint(d, HeatConfig(steps=60, **kw))
+    assert step1 == 30
+    rest1 = solve(HeatConfig(steps=30, **kw), initial=np.asarray(grid1))
+    np.testing.assert_array_equal(rest1.to_numpy(), full.to_numpy())
+
+
 def test_sharded_incomplete_error_names_process_counts(tmp_path):
     # Satellite: the multi-process mismatch error must be actionable —
     # name the saved vs current process counts and say where the

@@ -1,0 +1,580 @@
+"""The distributed-supervision consensus layer, without process
+boundaries: unit tests of the merges/KV/liveness machinery plus
+THREAD-SIMULATED ranks driving the full supervised loop through a
+shared :class:`InMemoryKV` — the split-brain, two-phase-commit and
+peer-lost contracts are certified here cheaply; the real 2-process
+gloo certification lives in the ``mp_split_brain`` / ``mp_peer_lost``
+chaos cells (tools/chaos_matrix.py, ``make mp-smoke``)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import (
+    HeatConfig,
+    SupervisorPolicy,
+    Telemetry,
+    run_supervised,
+    solve,
+)
+from parallel_heat_tpu.parallel.coordinator import (
+    Coordinator,
+    InMemoryKV,
+    KVCoordinator,
+    PeerLostError,
+    PeerTransientError,
+    heartbeat_path_for,
+    merge_boundary,
+    merge_stats,
+    surviving_mesh_shape,
+)
+from parallel_heat_tpu.utils.checkpoint import (
+    StemLockError,
+    acquire_stem_lock,
+    generation_paths,
+    latest_checkpoint,
+    load_checkpoint,
+    save_generation_coordinated,
+)
+from parallel_heat_tpu.utils.faults import FaultPlan, InjectedTransientError
+
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+# ---------------------------------------------------------------------------
+# Pure merges
+# ---------------------------------------------------------------------------
+
+def test_merge_boundary_identity_for_single_rank():
+    # THE single-process parity property: a merge of one verdict is
+    # that verdict, field for field.
+    v = {"stop": 15, "fault": None, "err": None, "finite": True}
+    assert merge_boundary([v]) == v
+    assert merge_boundary([{}]) == {"stop": None, "fault": None,
+                                    "err": None, "finite": None}
+
+
+def test_merge_boundary_worst_case_wins_deterministically():
+    clean = {"finite": True}
+    assert merge_boundary([clean, {"finite": False}])["finite"] is False
+    assert merge_boundary([clean, clean])["finite"] is True
+    # any rank's stop stops everyone; lowest rank's detail wins
+    m = merge_boundary([{"stop": None}, {"stop": "deadline"}])
+    assert m["stop"] == "deadline"
+    m = merge_boundary([{"stop": 15}, {"stop": "deadline"}])
+    assert m["stop"] == 15
+    # faults/errs name the reporting rank
+    m = merge_boundary([{}, {"err": "boom"}])
+    assert m["err"] == "[rank 1] boom"
+    # finite None (no guard this boundary) stays None
+    assert merge_boundary([{}, {}])["finite"] is None
+
+
+def test_merge_stats_partials():
+    out = merge_stats([{"min": 0.0, "max": 2.0, "heat": 10.0},
+                       {"min": -1.0, "max": 1.0, "heat": 5.0}])
+    assert out == {"min": -1.0, "max": 2.0, "heat": 15.0}
+
+
+def test_surviving_mesh_shape_divisibility():
+    assert surviving_mesh_shape((32, 32), 4) == (2, 2)
+    assert surviving_mesh_shape((32, 32), 1) is None
+    # balanced pick (3, 1) divides 33x11? 33 % 3 == 0 -> fine
+    assert surviving_mesh_shape((33, 11), 3) == (3, 1)
+    # nothing divides a prime x prime grid except 1-ish factors
+    assert surviving_mesh_shape((13, 7), 6) is None
+
+
+# ---------------------------------------------------------------------------
+# InMemoryKV + KVCoordinator liveness
+# ---------------------------------------------------------------------------
+
+def test_inmemory_kv_blocking_get_timeout():
+    kv = InMemoryKV()
+    with pytest.raises(TimeoutError):
+        kv.blocking_key_value_get("missing", 50)
+    kv.key_value_set("k", "v")
+    assert kv.blocking_key_value_get("k", 50) == "v"
+    kv.key_value_delete("k")
+    with pytest.raises(TimeoutError):
+        kv.blocking_key_value_get("k", 10)
+
+
+def _pair(kv, **kw):
+    kw.setdefault("barrier_timeout_s", 5.0)
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    return (KVCoordinator(kv, 0, 2, **kw),
+            KVCoordinator(kv, 1, 2, **kw))
+
+
+def test_kv_exchange_rank_ordered_roundtrip():
+    kv = InMemoryKV()
+    c0, c1 = _pair(kv)
+    out = {}
+
+    def rank(c, payload):
+        out[c.process_index] = c.exchange("verdict", payload)
+
+    t = threading.Thread(target=rank, args=(c1, {"r": 1}))
+    t.start()
+    rank(c0, {"r": 0})
+    t.join()
+    c0.close(), c1.close()
+    # both ranks see the identical rank-ordered list
+    assert out[0] == out[1] == [{"r": 0}, {"r": 1}]
+
+
+def test_kv_exchange_detects_dead_peer_within_timeout():
+    kv = InMemoryKV()
+    c0, c1 = _pair(kv, barrier_timeout_s=0.4)
+    c1.close()  # rank 1 "dies": heartbeat stops changing
+    t0 = time.monotonic()
+    with pytest.raises(PeerLostError) as ei:
+        c0.exchange("verdict", {"r": 0})
+    waited = time.monotonic() - t0
+    c0.close()
+    assert ei.value.lost == (1,)
+    assert waited < 5.0  # bounded, not a hang
+    assert ei.value.timeout_s == 0.4
+
+
+def test_kv_exchange_waits_for_slow_but_alive_peer():
+    # A peer whose heartbeat keeps CHANGING extends the wait past the
+    # barrier timeout — slow is not dead.
+    kv = InMemoryKV()
+    c0, c1 = _pair(kv, barrier_timeout_s=0.3,
+                   heartbeat_interval_s=0.05)
+
+    def late():
+        time.sleep(0.9)  # 3x the barrier timeout, but heartbeating
+        c1.exchange("verdict", {"r": 1})
+
+    t = threading.Thread(target=late)
+    t.start()
+    out = c0.exchange("verdict", {"r": 0})
+    t.join()
+    c0.close(), c1.close()
+    assert out == [{"r": 0}, {"r": 1}]
+
+
+def test_kv_coordinator_heartbeat_file_format(tmp_path):
+    # The probe file rides the telemetry heartbeat-file format and is
+    # removed on clean close (a clean exit must read as gone, not as
+    # freshly alive, to the stem lock's reclaim judgment).
+    hb = str(tmp_path / "stem.hb.p0.json")
+    kv = InMemoryKV()
+    c = KVCoordinator(kv, 0, 2, heartbeat_interval_s=0.05,
+                      heartbeat_path=hb)
+    time.sleep(0.15)
+    doc = json.load(open(hb))
+    for key in ("t_wall", "t_mono", "pid", "events", "last_event",
+                "interval_s", "process_index"):
+        assert key in doc, key
+    assert doc["pid"] == os.getpid() and doc["process_index"] == 0
+    c.close()
+    assert not os.path.exists(hb)
+    assert heartbeat_path_for(str(tmp_path / "stem"), 1) \
+        == str(tmp_path / "stem") + ".hb.p1.json"
+
+
+# ---------------------------------------------------------------------------
+# Stem lock: reclaim tied to peer heartbeats
+# ---------------------------------------------------------------------------
+
+def test_stem_lock_dead_holder_with_fresh_peer_heartbeat_not_reclaimed(
+        tmp_path):
+    # The multi-process gap (ISSUE 10 satellite): process 0 holds the
+    # lock for the whole SPMD run; if it crashes while ranks >= 1 are
+    # still streaming, the dead pid alone must NOT make the lock
+    # reclaimable — a fresh peer heartbeat file keeps it held.
+    stem = str(tmp_path / "ck")
+    lock = tmp_path / "ck.lock"
+    hb_glob = f"{stem}.hb.p*.json"
+    lock.write_text(json.dumps(
+        {"pid": 2 ** 30, "t_wall": 0.0,  # dead holder
+         "hb_glob": hb_glob, "hb_timeout_s": 60.0}))
+    with open(f"{stem}.hb.p1.json", "w") as f:  # fresh peer heartbeat
+        json.dump({"t_wall": time.time(), "pid": os.getpid()}, f)
+    with pytest.raises(StemLockError, match="peer ranks are still"):
+        acquire_stem_lock(stem)
+    # once the peer's beat goes stale, the lock is reclaimable
+    old = time.time() - 3600
+    os.utime(f"{stem}.hb.p1.json", (old, old))
+    release = acquire_stem_lock(stem)
+    release()
+
+
+@pytest.mark.chaos
+def test_restart_after_whole_pod_death_reclaims_stale_lock(tmp_path):
+    # Regression (review finding): the restarting run must take the
+    # dead predecessor's lock BEFORE its own coordinator heartbeat
+    # probe files exist — the file names are identical across runs, so
+    # writing <stem>.hb.pN.json first would make the new run's OWN
+    # beat block reclaim forever. Simulate the whole-pod-death
+    # aftermath (dead-pid lock recording an hb_glob, stale probe
+    # files) and run a full thread-simulated supervised restart over
+    # the same stem: it must reclaim, run, and complete.
+    stem = str(tmp_path / "ck")
+    hb_glob = f"{stem}.hb.p*.json"
+    (tmp_path / "ck.lock").write_text(json.dumps(
+        {"pid": 2 ** 30, "t_wall": 0.0,
+         "hb_glob": hb_glob, "hb_timeout_s": 60.0}))
+    old = time.time() - 3600
+    for i in range(2):
+        p = f"{stem}.hb.p{i}.json"
+        with open(p, "w") as f:
+            json.dump({"t_wall": old, "pid": 2 ** 30}, f)
+        os.utime(p, (old, old))
+    r0, r1 = _sim_run(tmp_path, lambda i: None)
+    assert r0.steps_done == r1.steps_done == 60
+    assert not r0.interrupted and not r1.interrupted
+    # and the new run's own probe files were live during the run
+    # (enabled after the lock was held), then removed on clean close
+    assert not os.path.exists(f"{stem}.hb.p0.json") \
+        or json.load(open(f"{stem}.hb.p0.json"))["t_wall"] > old
+
+
+def test_stem_lock_records_heartbeat_glob(tmp_path):
+    stem = str(tmp_path / "ck")
+    release = acquire_stem_lock(stem, heartbeat_glob=f"{stem}.hb.p*.json",
+                                heartbeat_timeout_s=12.0)
+    doc = json.load(open(f"{stem}.lock"))
+    assert doc["hb_glob"] == f"{stem}.hb.p*.json"
+    assert doc["hb_timeout_s"] == 12.0
+    release()
+
+
+# ---------------------------------------------------------------------------
+# Two-phase checkpoint commit (thread-simulated ranks)
+# ---------------------------------------------------------------------------
+
+def _run_ranks(fn, n=2):
+    """Run fn(rank) on n threads; returns per-rank results, re-raising
+    the first failure."""
+    out = [None] * n
+    errs = [None] * n
+
+    def worker(i):
+        try:
+            out[i] = fn(i)
+        except BaseException as e:  # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+def test_two_phase_commit_skips_generation_globally(tmp_path):
+    # Any rank's non-finite verdict must skip the generation on EVERY
+    # rank (no global manifest/commit), leaving the previous
+    # generation authoritative everywhere.
+    cfg = HeatConfig(steps=4, **_BASE)
+    good = solve(cfg).grid
+    bad = np.asarray(good).copy()
+    bad[3, 3] = np.nan
+    kv = InMemoryKV()
+    stem = str(tmp_path / "ck")
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=10.0)
+        try:
+            first = save_generation_coordinated(
+                stem, good, 4, cfg, coord, keep=3)
+            second = save_generation_coordinated(
+                stem, bad if i == 1 else good, 8, cfg, coord, keep=3)
+            return first, second
+        finally:
+            coord.close()
+
+    (f0, s0), (f1, s1) = _run_ranks(rank)
+    assert f0 == f1 and not f0[0] is None and f0[1] is False
+    # the poisoned generation skipped globally, on both ranks
+    assert s0 == s1 == (None, True)
+    steps = [s for s, _ in generation_paths(stem)]
+    assert steps == [4]  # generation 8 never committed
+    grid, step, _ = load_checkpoint(latest_checkpoint(stem), cfg)
+    assert step == 4
+
+
+def test_two_phase_commit_rank0_writes_all_ranks_see_path(tmp_path):
+    cfg = HeatConfig(steps=2, **_BASE)
+    grid = solve(cfg).grid
+    kv = InMemoryKV()
+    stem = str(tmp_path / "ck")
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=10.0)
+        try:
+            return save_generation_coordinated(stem, grid, 2, cfg,
+                                               coord, keep=3)
+        finally:
+            coord.close()
+
+    (p0, sk0), (p1, sk1) = _run_ranks(rank)
+    assert not sk0 and not sk1
+    assert str(p0) == str(p1) and os.path.exists(str(p0))
+
+
+# ---------------------------------------------------------------------------
+# Thread-simulated SPMD supervision: the consensus contracts
+# ---------------------------------------------------------------------------
+
+def _sim_policy(**kw):
+    kw.setdefault("checkpoint_every", 20)
+    kw.setdefault("guard_interval", 10)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("barrier_timeout_s", 20.0)
+    kw.setdefault("peer_heartbeat_s", 0.05)
+    return SupervisorPolicy(**kw)
+
+
+def _sim_run(tmp_path, rank_fault, tel=False, policy=None):
+    """Two thread-ranks run the FULL supervised loop over one shared
+    stem and a shared InMemoryKV; returns the per-rank
+    SupervisorResults (plus telemetry paths when requested)."""
+    kv = InMemoryKV()
+    stem = tmp_path / "ck"
+    cfg = HeatConfig(steps=60, **_BASE)
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=20.0,
+                              heartbeat_interval_s=0.05)
+        telemetry = None
+        if tel:
+            # shard_path suffixes .pN per rank: m.jsonl -> m.p0.jsonl
+            telemetry = Telemetry(str(tmp_path / "m.jsonl"),
+                                  process_index=i, process_count=2)
+        try:
+            return run_supervised(cfg, stem,
+                                  policy=policy or _sim_policy(),
+                                  faults=rank_fault(i),
+                                  telemetry=telemetry,
+                                  coordinator=coord)
+        finally:
+            if telemetry is not None:
+                telemetry.close()
+            coord.close()
+
+    return _run_ranks(rank)
+
+
+@pytest.mark.chaos
+def test_consensus_single_rank_nan_rolls_back_both_ranks_bitwise(
+        tmp_path):
+    # THE split-brain cell, thread-simulated: the NaN lands on rank 1
+    # only (only_process=1) — without consensus rank 1 would roll back
+    # while rank 0 streams ahead. With it, both ranks trip at the SAME
+    # boundary, roll back to the SAME generation, and recover BITWISE.
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    r0, r1 = _sim_run(
+        tmp_path, lambda i: FaultPlan(nan_at_step=35, only_process=1),
+        tel=True)
+    for sres in (r0, r1):
+        assert sres.retries == 1 and sres.rollbacks == 1
+        assert sres.guard_trips == 1
+        assert sres.steps_done == 60
+        np.testing.assert_array_equal(sres.result.to_numpy(),
+                                      clean.to_numpy())
+    assert r0.guard_trip_steps == r1.guard_trip_steps == (40,)
+    # the artifacts agree: same consensus verdict, same rollback target
+    per_rank = []
+    for i in range(2):
+        ev = [json.loads(l) for l in
+              open(tmp_path / f"m.p{i}.jsonl")]
+        cons = [e for e in ev if e["event"] == "consensus_verdict"]
+        rbs = [e for e in ev if e["event"] == "rollback"]
+        waits = [e for e in ev if e["event"] == "barrier_wait"]
+        assert cons and cons[0]["action"] == "nan"
+        # envelope carries the rank (run_header's own process_index
+        # field reports jax's view, which thread-sim cannot fake)
+        assert all(e["process_index"] == i for e in ev
+                   if e["event"] != "run_header")
+        assert waits and all(w["wait_s"] >= 0 for w in waits)
+        per_rank.append((cons[0]["step"], [r["path"] for r in rbs]))
+    assert per_rank[0] == per_rank[1]
+
+
+@pytest.mark.chaos
+def test_consensus_single_rank_transient_rolls_back_both(tmp_path):
+    # An injected pre-dispatch transient on rank 0 only: consensus
+    # converts it into the identical rollback on rank 1 (as a
+    # PeerTransientError under the same retry classifier).
+    clean = solve(HeatConfig(steps=60, **_BASE))
+    r0, r1 = _sim_run(
+        tmp_path,
+        lambda i: FaultPlan(transient_on_chunks=(2,), only_process=0))
+    for sres in (r0, r1):
+        assert sres.retries == 1 and sres.guard_trips == 0
+        np.testing.assert_array_equal(sres.result.to_numpy(),
+                                      clean.to_numpy())
+
+
+@pytest.mark.chaos
+def test_consensus_single_rank_interrupt_stops_both(tmp_path):
+    # The caller's flag-only interrupt hook fires on rank 1 only; the
+    # consensus stops BOTH ranks at the same boundary with the same
+    # flushed state.
+    kv = InMemoryKV()
+    stem = tmp_path / "ck"
+    cfg = HeatConfig(steps=60, **_BASE)
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=20.0,
+                              heartbeat_interval_s=0.05)
+        fired = {"n": 0}
+
+        def interrupt():
+            if i == 1:
+                fired["n"] += 1
+                if fired["n"] >= 3:
+                    return "deadline"
+            return None
+
+        try:
+            return run_supervised(cfg, stem, policy=_sim_policy(),
+                                  interrupt=interrupt,
+                                  coordinator=coord)
+        finally:
+            coord.close()
+
+    r0, r1 = _run_ranks(rank)
+    assert r0.interrupted and r1.interrupted
+    assert r0.signal_name == r1.signal_name == "deadline"
+    assert r0.steps_done == r1.steps_done > 0
+    # the flushed checkpoint resumes bit-exactly (single-process now)
+    clean = solve(cfg)
+    grid, step, _ = load_checkpoint(latest_checkpoint(stem), cfg)
+    sres = run_supervised(cfg.replace(steps=60 - step), stem,
+                          policy=_sim_policy(), initial=grid,
+                          start_step=step)
+    np.testing.assert_array_equal(sres.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+@pytest.mark.chaos
+def test_peer_crash_yields_bounded_peer_lost_and_elastic_resume(
+        tmp_path):
+    # Rank 1 dies hard (an unexpected error tears down its supervised
+    # run and its coordinator — heartbeats stop). Rank 0 must exit
+    # preempted within the barrier timeout with signal "peer_lost" and
+    # an ELASTIC resume command, and that resume must complete
+    # bit-exactly.
+    kv = InMemoryKV()
+    stem = tmp_path / "ck"
+    cfg = HeatConfig(steps=60, **_BASE)
+    clean = solve(cfg)
+
+    class CrashPlan:
+        """before_chunk raises a NON-transient error at ordinal 2 —
+        the supervised run (and with it the coordinator's heartbeat)
+        dies exactly like a host loss, minus the SIGKILL the real
+        mp_peer_lost chaos cell delivers."""
+
+        def __init__(self):
+            self.n = 0
+
+        def before_chunk(self):
+            self.n += 1
+            if self.n >= 3:
+                raise RuntimeError("simulated host loss")
+
+        def corrupt(self, grid, step, observed=True):
+            return grid
+
+    out = [None, None]
+    crash = [None]
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=1.0,
+                              heartbeat_interval_s=0.05)
+        try:
+            out[i] = run_supervised(
+                cfg, stem,
+                policy=_sim_policy(barrier_timeout_s=1.0),
+                faults=CrashPlan() if i == 1 else None,
+                coordinator=coord)
+        except RuntimeError as e:
+            crash[0] = e  # rank 1's host loss — expected
+        finally:
+            coord.close()
+
+    t1 = threading.Thread(target=rank, args=(1,))
+    t1.start()
+    t0 = time.monotonic()
+    rank(0)
+    elapsed = time.monotonic() - t0
+    t1.join()
+    assert "simulated host loss" in str(crash[0])
+    sres = out[0]
+    assert sres.interrupted and sres.signal_name == "peer_lost"
+    assert "--resume auto" in sres.resume_command
+    assert "--mesh" in sres.resume_command  # elastic: a surviving mesh
+    assert elapsed < 30.0  # bounded, not a wedge
+    # elastic resume on the "surviving host" (single-process):
+    grid, step, _ = load_checkpoint(latest_checkpoint(stem), cfg)
+    res = run_supervised(cfg.replace(steps=60 - step), stem,
+                         policy=_sim_policy(), initial=grid,
+                         start_step=step)
+    np.testing.assert_array_equal(res.result.to_numpy(),
+                                  clean.to_numpy())
+
+
+@pytest.mark.chaos
+def test_single_process_kv_coordinator_is_bitwise_local(tmp_path):
+    # A KV coordinator with process_count == 1 must behave exactly
+    # like the identity coordinator: same result bitwise, same
+    # generation layout — the consensus layer provably adds nothing.
+    cfg = HeatConfig(steps=60, **_BASE)
+    a = run_supervised(cfg, tmp_path / "a", policy=_sim_policy())
+    coord = KVCoordinator(InMemoryKV(), 0, 1)
+    try:
+        b = run_supervised(cfg, tmp_path / "b", policy=_sim_policy(),
+                           coordinator=coord)
+    finally:
+        coord.close()
+    np.testing.assert_array_equal(a.result.to_numpy(),
+                                  b.result.to_numpy())
+    assert [s for s, _ in generation_paths(tmp_path / "a")] \
+        == [s for s, _ in generation_paths(tmp_path / "b")]
+
+
+def test_fault_plan_rank_scoping_and_kill_exclusivity():
+    plan = FaultPlan(nan_at_step=5, only_process=1).bind_process(0)
+    # non-matching rank: hooks are no-ops but ordinals still advance
+    assert plan.before_chunk() == 0 and plan.before_chunk() == 1
+    grid = np.ones((4, 4), np.float32)
+    out = plan.corrupt(grid, 10)
+    assert np.isfinite(np.asarray(out)).all()
+    plan.bind_process(1)
+    out = plan.corrupt(grid, 10)
+    assert not np.isfinite(np.asarray(out)).all()
+    with pytest.raises(ValueError, match="not both"):
+        FaultPlan(kill_worker_at_chunk=1, kill_process_at_chunk=2)
+    with pytest.raises(ValueError, match="true process death"):
+        FaultPlan(kill_process_at_chunk=1, nan_at_step=5)
+
+
+def test_peer_transient_error_is_retry_classified():
+    from parallel_heat_tpu.supervisor import _is_transient_dispatch_error
+
+    assert isinstance(PeerTransientError("x"), InjectedTransientError)
+    assert _is_transient_dispatch_error(PeerTransientError("x"))
+
+
+def test_local_coordinator_identity():
+    c = Coordinator()
+    assert not c.distributed
+    assert c.exchange("anything", {"a": 1}) == [{"a": 1}]
+    c.close()  # no-op

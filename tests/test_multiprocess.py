@@ -17,6 +17,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -239,6 +240,51 @@ def test_two_process_solve_matches_single_device(tmp_path):
         _build_runner.cache_clear()
     assert np.array_equal(got, ref), \
         "kernel-H deferred-x: multi-process != single-process (bitwise)"
+
+
+def _chaos_matrix():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_matrix", os.path.join(REPO, "tools", "chaos_matrix.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_mp_split_brain_consensus_rollback_bitwise(tmp_path):
+    """The mp_split_brain chaos cell as a pytest case (also the `make
+    mp-smoke` / CI gate): a single-rank NaN injected across a REAL
+    2-process gloo boundary makes BOTH ranks trip at the same chunk
+    boundary, roll back to the SAME generation, and recover bitwise —
+    plus the 4-process-checkpoint -> 2-process elastic reshard-on-load
+    resumed mid-cell. Marked slow: two jax.distributed runtimes cost
+    tens of seconds, which the tier-1 870s budget cannot absorb; CI
+    runs it in the mp-smoke job."""
+    cm = _chaos_matrix()
+    row = cm.run_mp_cell("mp_split_brain", str(tmp_path))
+    assert row["outcome"] == "recovered", row
+    assert row["consensus_trip_ok"] and row["bitwise_match"]
+    assert row["same_rollback_generation_ok"]
+    assert row["consensus_events_ok"] and row["elastic_4to2_ok"]
+
+
+@pytest.mark.slow
+def test_mp_peer_lost_bounded_detection_elastic_resume(tmp_path):
+    """The mp_peer_lost chaos cell as a pytest case: rank 1 REALLY
+    SIGKILLs itself mid-run; rank 0 must detect the corpse within one
+    barrier timeout (no wedged ppermute), journal peer_lost, exit
+    preempted with an elastic resume command targeting the surviving
+    mesh — and executing that printed command verbatim completes the
+    run bit-exactly. Slow-marked like the split-brain cell."""
+    cm = _chaos_matrix()
+    row = cm.run_mp_cell("mp_peer_lost", str(tmp_path))
+    assert row["outcome"] == "recovered", row
+    assert row["rank1_sigkilled_ok"] and row["rank0_ok"]
+    assert row["detect_bounded_ok"] and row["peer_lost_event_ok"]
+    assert row["elastic_cmd_ok"] and row["resume_exit_ok"]
+    assert row["bitwise_match"] and row["resumed_steps"] == 60
 
 
 def test_two_process_static_proof_matches_dynamic_parity(tmp_path):

@@ -280,6 +280,58 @@ def test_metrics_report_merges_shard_glob(tmp_path):
     assert doc["header"]["segments"] == 1
 
 
+def test_metrics_report_per_rank_barrier_wait_row(tmp_path):
+    # The distributed-supervision consensus exchanges (ISSUE 10) emit
+    # per-boundary barrier_wait events; the shard-glob report must
+    # render PER-RANK percentiles — unlike the SPMD-equivalent chunk
+    # events, barrier waits differ by rank, and the rank that never
+    # waits is the straggler everyone else waits for. peer_lost events
+    # surface on the shard row too.
+    for pi in (0, 1):
+        lines = [json.dumps({
+            "schema": 1, "event": "run_header", "t_wall": 1.0,
+            "t_mono": 1.0, "config": {"nx": 16, "ny": 16, "steps": 30},
+            "process_index": pi, "process_count": 2})]
+        for k in range(3):
+            lines.append(json.dumps({
+                "schema": 1, "event": "chunk", "t_wall": 2.0 + k,
+                "t_mono": 2.0 + k, "step": 10 * (k + 1), "steps": 10,
+                "wall_s": 0.01, "process_index": pi,
+                "process_count": 2}))
+            lines.append(json.dumps({
+                "schema": 1, "event": "barrier_wait",
+                "t_wall": 2.1 + k, "t_mono": 2.1 + k,
+                "step": 10 * (k + 1),
+                "wait_s": 0.002 * (pi + 1) * (k + 1),
+                "process_index": pi, "process_count": 2}))
+        if pi == 0:
+            lines.append(json.dumps({
+                "schema": 1, "event": "peer_lost", "t_wall": 9.0,
+                "t_mono": 9.0, "step": 30, "lost": [1],
+                "survivors": 1, "waited_s": 1.2, "timeout_s": 5.0,
+                "process_index": 0, "process_count": 2}))
+        (tmp_path / f"m.p{pi}.jsonl").write_text("\n".join(lines) + "\n")
+    run = lambda *a: subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(tmp_path / "m*.jsonl"), *a],
+        capture_output=True, text=True, timeout=60)
+    rep = run("--json")
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    shards = json.loads(rep.stdout)["shards"]
+    bw = {s["process_index"]: s["barrier_wait"] for s in shards}
+    assert bw[0]["n"] == bw[1]["n"] == 3
+    assert bw[0]["p50_s"] == pytest.approx(0.004)
+    assert bw[1]["p50_s"] == pytest.approx(0.008)
+    assert bw[1]["max_s"] == pytest.approx(0.012)
+    assert {s["process_index"]: s["peer_lost"]
+            for s in shards} == {0: 1, 1: 0}
+    text = run()
+    assert text.returncode == 0
+    assert "barrier-wait p50=4.0ms" in text.stdout
+    assert "PEER_LOST x1" in text.stdout
+
+
 def _run_heatlint(*args, cwd=None):
     return subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "heatlint.py"),

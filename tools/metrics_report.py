@@ -142,8 +142,25 @@ def load_streams(pattern):
             torn_paths.append(p)
         pis = [e["process_index"] for e in ev
                if isinstance(e.get("process_index"), int)]
+        # Per-rank barrier-wait percentiles (the consensus exchanges a
+        # distributed supervisor runs at every chunk boundary —
+        # parallel/coordinator.py): unlike the SPMD-equivalent chunk
+        # events, barrier waits are the one PER-RANK signal — the rank
+        # that never waits is the straggler every other rank waits FOR.
+        waits = sorted(e["wait_s"] for e in ev
+                       if e.get("event") == "barrier_wait"
+                       and isinstance(e.get("wait_s"), (int, float)))
+        bw = None
+        if waits:
+            bw = {"n": len(waits),
+                  "p50_s": _percentile(waits, 50),
+                  "p99_s": _percentile(waits, 99),
+                  "max_s": waits[-1]}
         rows.append({"path": p, "events": ev, "torn": torn,
-                     "process_index": min(pis) if pis else 0})
+                     "process_index": min(pis) if pis else 0,
+                     "barrier_wait": bw,
+                     "peer_lost": sum(1 for e in ev
+                                      if e.get("event") == "peer_lost")})
     primary = min(rows, key=lambda r: r["process_index"]) if rows \
         else {"events": []}
     return primary["events"], bad, torn_paths, rows
@@ -563,6 +580,28 @@ def render_fleet_text(doc):
     return "\n".join(out)
 
 
+def _render_shards(doc, out):
+    """Per-rank shard health + barrier-wait percentiles (straggler
+    visibility: the rank that never waits at the consensus boundary is
+    the one every other rank waits FOR)."""
+    shards = doc.get("shards")
+    if not shards:
+        return
+    out.append(f"shards: {len(shards)} per-process streams "
+               f"(aggregates above = primary shard)")
+    for r in shards:
+        line = (f"  p{r['process_index']}: {r['events']} events"
+                + ("  TORN" if r.get("torn") else ""))
+        bw = r.get("barrier_wait")
+        if bw:
+            line += (f"  barrier-wait p50={bw['p50_s']*1e3:.1f}ms "
+                     f"p99={bw['p99_s']*1e3:.1f}ms "
+                     f"max={bw['max_s']*1e3:.1f}ms (n={bw['n']})")
+        if r.get("peer_lost"):
+            line += f"  PEER_LOST x{r['peer_lost']}"
+        out.append(line)
+
+
 def render_text(doc):
     out = []
     h = doc.get("header")
@@ -690,6 +729,7 @@ def render_text(doc):
     if "outcome" in doc:
         out.append(f"outcome: {doc['outcome']} "
                    f"(steps_done={doc.get('steps_done')})")
+    _render_shards(doc, out)
     return "\n".join(out)
 
 
@@ -818,10 +858,15 @@ def main(argv=None):
         doc["shards"] = [{"path": r["path"],
                           "process_index": r["process_index"],
                           "events": len(r["events"]),
-                          "torn": r["torn"]} for r in shards]
+                          "torn": r["torn"],
+                          "barrier_wait": r.get("barrier_wait"),
+                          "peer_lost": r.get("peer_lost", 0)}
+                         for r in shards]
         doc["shard_note"] = ("aggregates summarize the primary (lowest "
                              "process_index) shard; SPMD processes "
-                             "emit equivalent streams")
+                             "emit equivalent streams — except "
+                             "barrier_wait, which is per-rank "
+                             "(straggler visibility)")
 
     anomalies = []
     tokens = ([] if args.fail_on == "none"
