@@ -29,7 +29,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        ensemble-smoke bench clean
+        ensemble-smoke trace-smoke bench clean
 
 all: heat
 
@@ -213,6 +213,46 @@ ensemble-smoke:
 	assert f['packed_jobs'] >= 2, f; \
 	assert f['pack_dispatches'] >= 1, f"
 	rm -rf .ensemble_smoke
+
+# Observability plane as a gate (docs/OBSERVABILITY.md): a served
+# 2-job artifact -> heattrace export (valid Chrome trace JSON with the
+# submit->dispatch->worker->chunk chain linked) -> slo_gate over the
+# queue root + per-job streams (exit 0 = every SLO held; the stream
+# tokens use metrics_report's --fail-on grammar, spelled once).
+trace-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .trace_smoke && mkdir -p .trace_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu serve \
+	    --queue .trace_smoke/q --slots 2 --poll-interval 0.1 \
+	    --max-seconds 300 >/dev/null & \
+	DPID=$$!; trap 'kill $$DPID 2>/dev/null || true' EXIT; \
+	SUB="--queue .trace_smoke/q --nx 16 --ny 16 --steps 60 \
+	    --checkpoint-every 20 --accept-timeout 120 --wait \
+	    --timeout 180 --quiet"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id trace-a; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --job-id trace-b; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu drain \
+	    --queue .trace_smoke/q; \
+	rc=0; wait $$DPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "daemon exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	JAX_PLATFORMS=cpu $(PY) tools/heattrace.py \
+	    --queue .trace_smoke/q --out .trace_smoke/trace.json --json | \
+	$(PY) -c "import json,sys; s=json.load(sys.stdin); \
+	assert s['journal']['jobs'] == 2, s; \
+	assert s['linked_workers'] >= 2, s"; \
+	$(PY) -c "import json; d=json.load(open('.trace_smoke/trace.json')); \
+	evs=[e for e in d['traceEvents'] if e['ph']=='X']; \
+	assert any(e['name'].startswith('chunk') for e in evs), evs; \
+	assert any(e['name']=='queue wait' for e in evs), evs"; \
+	JAX_PLATFORMS=cpu $(PY) tools/slo_gate.py \
+	    --fleet 'quarantined>0,orphaned>0,queue_wait_s.p99>60' \
+	    --stream 'permanent_failure,guard_trip' \
+	    .trace_smoke/q '.trace_smoke/q/telemetry/*.jsonl'
+	rm -rf .trace_smoke
 
 bench:
 	$(PY) bench.py

@@ -29,6 +29,11 @@ from dataclasses import asdict
 from typing import Optional, Union
 
 from parallel_heat_tpu.service.store import JobSpec, JobStore, JobView
+from parallel_heat_tpu.utils.tracing import (
+    TraceContext,
+    new_trace_id,
+    submit_span_id,
+)
 
 _job_seq = itertools.count()
 
@@ -57,8 +62,10 @@ def submit(root: str, config, *, job_id: Optional[str] = None,
            clock=time.time, sleep_fn=time.sleep) -> dict:
     """Submit one job; block until the daemon's admission verdict.
 
-    Returns ``{"job_id", "accepted": True}`` or ``{"job_id",
-    "accepted": False, "reason", "retry_after_s"}``. Raises
+    Returns ``{"job_id", "accepted": True, "trace_id"}`` or
+    ``{"job_id", "accepted": False, "reason", "retry_after_s",
+    "trace_id"}`` (``trace_id`` is the causal trace born here —
+    ``tools/heattrace.py`` renders its end-to-end timeline). Raises
     ``TimeoutError`` when no verdict lands within
     ``accept_timeout_s`` — the daemon is not running (or not watching
     this root)."""
@@ -74,13 +81,19 @@ def submit(root: str, config, *, job_id: Optional[str] = None,
             f"job_id {jid!r} already has journal history on this "
             f"queue root (state: {existing[jid].state}) — job ids are "
             f"single-use; omit --job-id for a generated one")
+    # The trace is born HERE: the submit span is the causal root every
+    # later hop (accept, dispatch, worker, chunk, barrier) hangs off.
+    # Deterministic span id, entropy only in the trace id — heattrace
+    # reconstructs the whole chain from artifacts alone.
+    trace = TraceContext(new_trace_id(clock), submit_span_id(jid))
     spec = JobSpec(job_id=jid, config=_spec_config(config),
                    deadline_s=deadline_s, max_retries=max_retries,
                    checkpoint_every=checkpoint_every,
                    guard_interval=guard_interval,
                    backoff_base_s=backoff_base_s,
                    submitted_t=clock(), faults=faults,
-                   faults_on_attempt=faults_on_attempt)
+                   faults_on_attempt=faults_on_attempt,
+                   trace=trace.to_dict())
     store.spool_submit(spec)
     deadline = clock() + accept_timeout_s
     while True:
@@ -90,8 +103,10 @@ def submit(root: str, config, *, job_id: Optional[str] = None,
             if v.state == "rejected":
                 return {"job_id": jid, "accepted": False,
                         "reason": v.reason,
-                        "retry_after_s": v.retry_after_s}
-            return {"job_id": jid, "accepted": True}
+                        "retry_after_s": v.retry_after_s,
+                        "trace_id": trace.trace_id}
+            return {"job_id": jid, "accepted": True,
+                    "trace_id": trace.trace_id}
         if clock() >= deadline:
             raise TimeoutError(
                 f"no admission verdict for {jid!r} within "

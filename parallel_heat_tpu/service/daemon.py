@@ -59,6 +59,14 @@ from parallel_heat_tpu.service.store import (
     reduce_journal,
 )
 from parallel_heat_tpu.supervisor import EXIT_PREEMPTED
+from parallel_heat_tpu.utils.tracing import (
+    ENV_PARENT_SPAN_ID,
+    ENV_SPAN_ID,
+    ENV_TRACE_ID,
+    TraceContext,
+    dispatch_span_id,
+    submit_span_id,
+)
 
 
 @dataclass
@@ -462,7 +470,8 @@ class Heatd:
             self.store.commit_job_record(spec)
             rec = j.append("accepted", job_id=jid,
                            deadline_s=spec.deadline_s, hbm_bytes=est,
-                           submitted_t=spec.submitted_t)
+                           submitted_t=spec.submitted_t,
+                           trace_id=(spec.trace or {}).get("trace_id"))
             # Fold the acceptance into the cached view by hand so the
             # NEXT spool entry's gate sees this job as active without
             # re-reading the journal (the incremental fold will skip
@@ -591,7 +600,8 @@ class Heatd:
                         j.append("dispatched", job_id=v.job_id,
                                  worker=wid, attempt=v.attempts + 1,
                                  pack=leader.job_id,
-                                 pack_size=len(batch))
+                                 pack_size=len(batch),
+                                 trace_id=v.trace_id)
                     try:
                         handle = self._launch_pack(batch, wid)
                     except OSError as e:
@@ -628,7 +638,7 @@ class Heatd:
             # run a worker the journal knows nothing about (a double
             # execution after restart).
             j.append("dispatched", job_id=v.job_id, worker=wid,
-                     attempt=attempt)
+                     attempt=attempt, trace_id=v.trace_id)
             try:
                 handle = self._launch(v, wid, attempt)
             except OSError as e:
@@ -639,18 +649,29 @@ class Heatd:
             self._procs[v.job_id] = handle
             running += 1
 
-    def _spawn_worker(self, job_args, worker_id: str):
+    def _spawn_worker(self, job_args, worker_id: str,
+                      trace: Optional[TraceContext] = None):
         """Shared subprocess plumbing for solo AND packed dispatches
         (one site to evolve env/log handling): spawn
         ``python -m parallel_heat_tpu.service.worker`` with
         ``job_args`` + the common flags, stdout/stderr to the worker
-        log."""
+        log. ``trace`` (the dispatch span context) rides the
+        environment — the worker's telemetry sink inherits it, so the
+        run's envelope joins the submit's trace without a flag."""
         cfg = self.config
         argv = [sys.executable, "-m", "parallel_heat_tpu.service.worker",
                 "--root", self.store.root, *job_args,
                 "--worker", worker_id,
                 "--hb-interval", str(cfg.worker_heartbeat_s)]
         env = dict(os.environ)
+        # Always set or CLEAR the trace variables: the daemon's own
+        # environment may carry foreign HEATTRACE_* values (started by
+        # a traced harness), and an untraced job's worker inheriting
+        # them would stamp its whole stream into an unrelated trace.
+        for k in (ENV_TRACE_ID, ENV_SPAN_ID, ENV_PARENT_SPAN_ID):
+            env.pop(k, None)
+        if trace is not None:
+            env.update(trace.to_env())
         # The worker must import this package regardless of the
         # daemon's cwd (the CLI may be launched from anywhere).
         import parallel_heat_tpu
@@ -668,6 +689,18 @@ class Heatd:
         finally:
             log.close()  # Popen holds its own duplicate
 
+    def _trace_for(self, v: JobView, attempt: int
+                   ) -> Optional[TraceContext]:
+        """The dispatch span context this attempt inherits: the job's
+        journaled trace id with the deterministic dispatch span as the
+        current hop (parent = the client's submit span). None for
+        untraced (pre-trace) jobs."""
+        if v.trace_id is None:
+            return None
+        return TraceContext(v.trace_id,
+                            dispatch_span_id(v.job_id, attempt),
+                            submit_span_id(v.job_id))
+
     def _launch(self, v: JobView, worker_id: str, attempt: int):
         cfg = self.config
         if cfg.launcher is not None:
@@ -676,7 +709,8 @@ class Heatd:
         job_args = ["--job", v.job_id, "--attempt", str(attempt)]
         if v.deadline_t is not None:
             job_args += ["--deadline-t", repr(v.deadline_t)]
-        return self._spawn_worker(job_args, worker_id)
+        return self._spawn_worker(job_args, worker_id,
+                                  trace=self._trace_for(v, attempt))
 
     def _launch_pack(self, batch, worker_id: str):
         """Spawn ONE worker process running ``batch`` as a packed
@@ -690,8 +724,13 @@ class Heatd:
             return cfg.launcher(job_id=job_ids[0], worker_id=worker_id,
                                 attempt=1, deadline_t=None,
                                 job_ids=job_ids)
+        # One env can carry one context: the pack's shared stream
+        # traces under the LEADER's trace (per-member journal lines
+        # keep each member's own trace_id; heattrace renders member
+        # lanes from the stream's `member` fields).
         return self._spawn_worker(["--jobs", ",".join(job_ids)],
-                                  worker_id)
+                                  worker_id,
+                                  trace=self._trace_for(batch[0], 1))
 
     # -- phase 6: status heartbeat ---------------------------------------
 

@@ -100,6 +100,13 @@ class JobSpec:
     # so an ungated one-shot fault would re-fire forever).
     faults: Optional[dict] = None
     faults_on_attempt: int = 1
+    # Causal trace context born at client.submit (utils/tracing.py:
+    # {"trace_id", "span_id"} — the root submit span). Rides the
+    # rename-committed job record; the daemon stamps the trace_id on
+    # every journal line for the job and hands the context to the
+    # worker via env, so the worker's telemetry envelope joins the
+    # same trace. None = an untraced submission (older clients).
+    trace: Optional[dict] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -137,6 +144,9 @@ class JobView:
     steps_done: Optional[int] = None
     retry_after_s: Optional[float] = None
     reason: Optional[str] = None
+    # Trace id from the `accepted` journal line (heattrace joins the
+    # journal's queue spans to the worker telemetry by this id).
+    trace_id: Optional[str] = None
 
     @property
     def terminal(self) -> bool:
@@ -187,6 +197,8 @@ def reduce_journal(events, state=None
             v.state = "queued"
             v.accepted_t = t
             v.hbm_bytes = int(e.get("hbm_bytes") or 0)
+            if isinstance(e.get("trace_id"), str):
+                v.trace_id = e["trace_id"]
             if e.get("deadline_s") is not None and t is not None:
                 v.deadline_t = t + float(e["deadline_s"])
             continue

@@ -52,6 +52,11 @@ from parallel_heat_tpu.supervisor import (
 from parallel_heat_tpu.utils import checkpoint as ckpt
 from parallel_heat_tpu.utils.faults import FaultPlan
 from parallel_heat_tpu.utils.telemetry import Telemetry
+from parallel_heat_tpu.utils.tracing import (
+    TraceContext,
+    dispatch_span_id,
+    worker_span_id,
+)
 
 
 class _HeartbeatWriter(threading.Thread):
@@ -81,6 +86,25 @@ class _HeartbeatWriter(threading.Thread):
     def stop(self) -> None:
         self._stop_event.set()
         self.join(timeout=5.0)
+
+
+def _worker_trace(spec, job_id: str, attempt: int
+                  ) -> Optional[TraceContext]:
+    """This attempt's span context: the daemon's env inheritance when
+    spawned as a subprocess, else the spec's committed trace (inline
+    launchers in tests call execute_job directly — no env crossing).
+    The worker runs as a CHILD span of the dispatch span, so the chain
+    reads submit -> dispatch -> worker -> run/chunk in heattrace."""
+    parent = TraceContext.from_env()
+    if parent is None and getattr(spec, "trace", None):
+        root = TraceContext.from_dict(spec.trace)
+        if root is not None:
+            parent = TraceContext(root.trace_id,
+                                  dispatch_span_id(job_id, attempt),
+                                  root.span_id)
+    if parent is None:
+        return None
+    return parent.child(worker_span_id(job_id, attempt))
 
 
 def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
@@ -120,7 +144,12 @@ def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
         hb = _HeartbeatWriter(store, worker_id, job_id, attempt,
                               hb_interval_s)
         hb.start()
-    telemetry = Telemetry(store.telemetry_path(job_id), async_io=True)
+    # job_id + trace ride the envelope: fleet aggregation joins a run
+    # to its job by content (not path convention), and heattrace joins
+    # it to the submit's causal chain.
+    telemetry = Telemetry(store.telemetry_path(job_id), async_io=True,
+                          job_id=job_id,
+                          trace=_worker_trace(spec, job_id, attempt))
 
     try:
         # Resume-before-run: the newest COMMITTED generation of this
@@ -239,8 +268,19 @@ def execute_pack(root: str, job_ids, worker_id: str,
         hb = _HeartbeatWriter(store, worker_id, job_ids[0], 1,
                               hb_interval_s)
         hb.start()
+    # The pack's shared stream traces under the LEADER's context (the
+    # daemon's env carries exactly one); `job_id` is the leader, which
+    # matches the `pack` field on every member's dispatched journal
+    # line — heattrace renders per-member lanes from the stream's
+    # `member` fields and keeps each member's own trace in the journal.
+    # The spec-trace fallback is wired after the specs load below
+    # (inline launchers cross no env boundary, same as execute_job).
+    pack_trace = TraceContext.from_env()
     telemetry = Telemetry(store.telemetry_path(f"pack-{worker_id}"),
-                          async_io=True)
+                          async_io=True, job_id=job_ids[0],
+                          trace=(pack_trace.child(
+                              worker_span_id(job_ids[0], 1))
+                              if pack_trace else None))
     try:
         try:
             specs = [store.load_spec(jid) for jid in job_ids]
@@ -250,6 +290,12 @@ def execute_pack(root: str, job_ids, worker_id: str,
             record_all("permanent_failure", kind="bad_spec",
                        diagnosis=f"cannot materialize pack spec: {e}")
             return EXIT_PERMANENT_FAILURE
+        if telemetry.trace is None:
+            # No env crossing (inline launcher): the leader's
+            # committed spec trace, exactly execute_job's fallback —
+            # nothing has been emitted yet, so the whole stream still
+            # joins the chain.
+            telemetry.trace = _worker_trace(specs[0], job_ids[0], 1)
         key0 = json.dumps(specs[0].config, sort_keys=True)
         for s in specs[1:]:
             # Everything the shared SupervisorPolicy below is built

@@ -12,7 +12,11 @@ live run from a hung one without attaching a debugger.
 :class:`Telemetry` is that sink. One JSON object per line, append-only
 (a resumed run continues the same file), schema-versioned. Events share
 an envelope — ``schema``, ``event``, ``t_wall`` (unix seconds),
-``t_mono`` (monotonic seconds, robust to clock steps) — and carry:
+``t_mono`` (monotonic seconds, robust to clock steps), ``hostname``,
+plus ``job_id`` and the causal trace triple ``trace_id`` / ``span_id``
+/ ``parent_span_id`` when set (heatd workers stamp both, so a run
+joins its job and its submit's trace by content — ``utils/tracing.py``
+and ``tools/heattrace.py`` are the consumers) — and carry:
 
 - ``run_header``: the full config, ``solver.explain``'s resolved
   execution path, mesh/topology, jax/backend versions (one per run
@@ -77,12 +81,22 @@ from __future__ import annotations
 import json
 import os
 import queue
+import socket
 import threading
 import time
 import warnings
 from typing import Optional
 
-SCHEMA_VERSION = 1
+from parallel_heat_tpu.utils.tracing import TraceContext
+
+# Schema 2 (heattrace): the envelope gained `hostname` (fleet joins —
+# a rank is a host, and straggler attribution must name one) and, when
+# set, `job_id` (heatd workers stamp it so a run joins its job by
+# content, not path convention) and the causal trace triple
+# `trace_id`/`span_id`/`parent_span_id` (utils/tracing.py). Consumers
+# ignore unknown envelope fields by contract, so v1 readers keep
+# working.
+SCHEMA_VERSION = 2
 
 # Bounded writer queue (async_io mode): deep enough that bursts (a
 # rollback's retry/rollback/chunk cluster) never block the run loop,
@@ -173,7 +187,21 @@ class Telemetry:
                  heartbeat_interval_s: float = 1.0,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 async_io: bool = False):
+                 async_io: bool = False,
+                 trace: Optional[TraceContext] = None,
+                 job_id: Optional[str] = None):
+        # Causal trace context (utils/tracing.py): explicit argument,
+        # else inherited from the environment — a daemon-spawned
+        # worker's sink joins its submit's trace without the worker
+        # threading a context through every call site. None = the
+        # envelope simply carries no trace triple.
+        self.trace = trace if trace is not None \
+            else TraceContext.from_env()
+        self.job_id = job_id
+        try:
+            self.hostname = socket.gethostname()
+        except OSError:  # pragma: no cover — observation-only
+            self.hostname = None
         if process_index is None or process_count is None:
             pi, pc = _process_info()
             process_index = pi if process_index is None else process_index
@@ -238,7 +266,12 @@ class Telemetry:
         rec = {"schema": SCHEMA_VERSION, "event": event,
                "t_wall": time.time(), "t_mono": time.monotonic(),
                "process_index": self.process_index,
-               "process_count": self.process_count}
+               "process_count": self.process_count,
+               "hostname": self.hostname}
+        if self.job_id is not None:
+            rec["job_id"] = self.job_id
+        if self.trace is not None:
+            rec.update(self.trace.to_dict())
         rec.update(fields)
         if self._queue is not None:
             # Blocking put: a full queue (wedged filesystem) slows the
@@ -374,8 +407,13 @@ class Telemetry:
             devs = jax.devices()
             doc["platform"] = devs[0].platform
             doc["device_count"] = len(devs)
-            doc["process_index"] = jax.process_index()
-            doc["process_count"] = jax.process_count()
+            # Schema 2: the ENVELOPE's process_index/process_count are
+            # authoritative for rank identity (thread-simulated ranks
+            # set them explicitly; heattrace lanes key off them). The
+            # runtime's own view stays available under distinct names
+            # instead of clobbering the envelope on this one event.
+            doc["runtime_process_index"] = jax.process_index()
+            doc["runtime_process_count"] = jax.process_count()
             doc["mesh"] = (list(config.mesh_shape)
                            if config.mesh_shape is not None else None)
         except Exception as e:  # noqa: BLE001 — observation-only

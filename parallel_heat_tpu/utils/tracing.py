@@ -1,0 +1,589 @@
+"""heattrace: causal trace contexts + the span model over telemetry.
+
+The reference project's whole performance story was told through
+Paraver timelines (Heat.pdf §trace analysis: compute/comm overlap,
+imbalance read off a trace viewer). Our stack already emits the raw
+material — the journal (``service/store.py``) records every queue
+transition, the telemetry JSONL (``utils/telemetry.py``) records every
+chunk, checkpoint, consensus barrier and lifecycle event — but nothing
+connects a client submit to the chunk that ran three processes and two
+rollbacks later. This module is that thread:
+
+- :class:`TraceContext` (``trace_id`` / ``span_id`` /
+  ``parent_span_id``) is born at ``service/client.py`` submit,
+  rename-committed into the job record, carried on every journal line
+  (``trace_id``), inherited by the spawned worker via environment
+  variables (``service/daemon.py`` → ``service/worker.py``) and
+  stamped on every telemetry envelope. Span ids are DETERMINISTIC
+  (``submit_span_id`` / ``dispatch_span_id`` / ``worker_span_id``):
+  any consumer can reconstruct the parentage chain from the ids alone,
+  a daemon restart re-derives identical ids, and no RNG is involved;
+
+- the span model (:func:`spans_from_stream` /
+  :func:`spans_from_journal`) derives causal spans from the event
+  streams we ALREADY emit — queue wait (accepted→dispatched), worker
+  attempts, per-rank run segments, chunks, checkpoint saves, the
+  two-phase commit gate (``checkpoint_barrier``), per-rank consensus
+  ``barrier_wait``, rollback loads + replay segments, ensemble member
+  lanes — nothing new is measured, the run pays zero extra cost;
+
+- :func:`chrome_trace` renders the merged spans as Chrome
+  trace-event JSON (the ``traceEvents`` array format) that opens
+  directly in Perfetto / ``chrome://tracing`` — the modern analogue of
+  the report's Paraver analysis. ``tools/heattrace.py`` is the CLI.
+
+Timeline alignment: within one shard, span times are ``t_mono``
+anchored at the nearest preceding ``run_header`` (offset =
+``header.t_wall - header.t_mono`` — monotonic robustness inside a
+segment, wall alignment across segments and processes). Cross-host
+offsets therefore reduce to wall-clock agreement at the run headers,
+which the coordinator KV handshake brackets to well under a chunk
+width; ``barrier_wait`` spans make any residual skew visible rather
+than hiding it.
+
+Everything here is observation-only (SEMANTICS.md "Runtime guard and
+supervisor", extended to tracing): no config field, no compiled
+program, no grid byte changes when a trace context is attached —
+pinned by the extended
+``test_telemetry_does_not_change_compiled_programs``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Environment inheritance (daemon -> worker subprocess). The variables
+# carry the PARENT context: the spawned process derives its own child
+# span under it (TraceContext.from_env(...).child(...)).
+ENV_TRACE_ID = "HEATTRACE_TRACE_ID"
+ENV_SPAN_ID = "HEATTRACE_SPAN_ID"
+ENV_PARENT_SPAN_ID = "HEATTRACE_PARENT_SPAN_ID"
+
+_trace_seq = itertools.count()
+
+
+def new_trace_id(clock=time.time) -> str:
+    """Collision-free without randomness, like ``client.make_job_id``:
+    wall-millis + pid + an in-process counter. Deterministic-entropy
+    ids keep the plumbing replayable and test-friendly (and keep RNG
+    out of anything a traced region could ever inhale)."""
+    return (f"t{int(clock() * 1000):013d}-{os.getpid()}"
+            f"-{next(_trace_seq)}")
+
+
+# -- deterministic span ids --------------------------------------------------
+# One naming rule shared by the writers (client/daemon/worker) and the
+# reader (the span model): ids derive from stable coordinates, so the
+# parentage chain reconstructs from artifacts alone — a journal line
+# needs only the trace_id, never a span table.
+
+def submit_span_id(job_id: str) -> str:
+    return f"s-submit-{job_id}"
+
+
+def dispatch_span_id(job_id: str, attempt: int) -> str:
+    return f"s-dispatch-{job_id}-a{int(attempt):03d}"
+
+
+def worker_span_id(job_id: str, attempt: int) -> str:
+    return f"s-worker-{job_id}-a{int(attempt):03d}"
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One node of the causal chain: ``span_id`` is THIS span,
+    ``parent_span_id`` links upward, ``trace_id`` names the whole
+    tree. Immutable; :meth:`child` derives the next hop."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+
+    def child(self, span_id: str) -> "TraceContext":
+        return TraceContext(self.trace_id, span_id, self.span_id)
+
+    # -- dict round trip (JobSpec.trace, telemetry envelope) -------------
+
+    def to_dict(self) -> dict:
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_span_id is not None:
+            d["parent_span_id"] = self.parent_span_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d) -> Optional["TraceContext"]:
+        """None on anything that is not a well-formed context — specs
+        and envelopes from older writers simply have no trace."""
+        if not isinstance(d, dict):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not (isinstance(tid, str) and isinstance(sid, str)):
+            return None
+        par = d.get("parent_span_id")
+        return cls(tid, sid, par if isinstance(par, str) else None)
+
+    # -- env round trip (daemon -> worker subprocess) --------------------
+
+    def to_env(self) -> Dict[str, str]:
+        env = {ENV_TRACE_ID: self.trace_id, ENV_SPAN_ID: self.span_id}
+        if self.parent_span_id is not None:
+            env[ENV_PARENT_SPAN_ID] = self.parent_span_id
+        return env
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["TraceContext"]:
+        environ = os.environ if environ is None else environ
+        tid = environ.get(ENV_TRACE_ID)
+        sid = environ.get(ENV_SPAN_ID)
+        if not tid or not sid:
+            return None
+        return cls(tid, sid, environ.get(ENV_PARENT_SPAN_ID) or None)
+
+
+# ---------------------------------------------------------------------------
+# Span model: derive causal spans from the streams we already emit
+# ---------------------------------------------------------------------------
+#
+# A span is a plain dict (JSON-ready):
+#   {"name", "cat", "t0", "t1",            # wall-aligned seconds
+#    "trace_id", "span_id", "parent_span_id",
+#    "pid", "tid",                          # display lanes (strings)
+#    "args": {...}}
+# An instant drops "t1". `chrome_trace` maps lanes onto numeric
+# pids/tids with metadata naming events.
+
+_UNTRACED = "untraced"
+
+# Lifecycle events rendered as instants (zero-duration markers).
+_INSTANT_EVENTS = ("guard_trip", "progress_trip", "retry", "signal",
+                   "peer_lost", "consensus_verdict", "checkpoint_skipped",
+                   "member_converged", "ensemble_compaction")
+
+
+def merge_spans(spans: Sequence[dict]) -> List[dict]:
+    """Coalesce spans sharing one ``span_id`` — the same LOGICAL span
+    observed from several artifacts (the envelope's worker span
+    appears in every rank's shard; a queue root's journal and streams
+    are parsed independently). Interval = union, parent/args = first
+    non-null; order-preserving, first occurrence wins the lane."""
+    out: List[dict] = []
+    by_id: Dict[str, dict] = {}
+    for s in spans:
+        prev = by_id.get(s["span_id"])
+        if prev is None:
+            by_id[s["span_id"]] = s
+            out.append(s)
+            continue
+        prev["t0"] = min(prev["t0"], s["t0"])
+        prev["t1"] = max(prev["t1"], s["t1"])
+        if prev.get("parent_span_id") is None:
+            prev["parent_span_id"] = s.get("parent_span_id")
+        for k, v in (s.get("args") or {}).items():
+            prev["args"].setdefault(k, v)
+    return out
+
+
+def spans_from_stream(events: Sequence[dict],
+                      pid_label: Optional[str] = None,
+                      stream_key: Optional[str] = None
+                      ) -> Tuple[List[dict], List[dict]]:
+    """Derive ``(spans, instants)`` from one telemetry stream (one
+    shard or several pre-merged ones — rank lanes come from each
+    event's ``process_index``).
+
+    Lanes key on EACH event's own envelope context, not a per-stream
+    one: heatd appends every attempt of a job to the same per-job
+    sink, and attempt 2's envelopes (``s-worker-<job>-a002``) must
+    hang off attempt 2's dispatch span, never attempt 1's. Per lane: a
+    synthetic ``worker`` span covering the lane (the envelope's own
+    span when traced — the chain's hop below the journal's dispatch
+    span), run segments under it (one per ``run_header``,
+    t_mono-anchored there), chunks / checkpoint saves / commit gates /
+    barrier waits / rollback loads + replay segments under the run
+    segment, and ensemble members as per-member lanes.
+
+    ``stream_key`` disambiguates UNTRACED streams (no envelope
+    context): it seeds their synthetic span ids, so two untraced runs
+    fed to one export cannot collide and merge (callers pass the file
+    path; None keeps the legacy single-stream ids). Foreign or torn-in
+    lines are skipped — a trace must degrade, never crash (the
+    metrics_report discipline).
+    """
+    untraced_base = (f"stream-{stream_key}" if stream_key is not None
+                     else "run")
+    spans: List[dict] = []
+    instants: List[dict] = []
+    # Lane state per (envelope span, rank): wall offset, open run
+    # segment, counters.
+    ranks: Dict[Tuple[str, int], dict] = {}
+
+    def lane(e, rank):
+        ctx = TraceContext.from_dict(e)
+        base = ctx.span_id if ctx else untraced_base
+        st = ranks.get((base, rank))
+        if st is None:
+            job_id = e.get("job_id")
+            job_id = job_id if isinstance(job_id, str) else None
+            st = ranks[(base, rank)] = {
+                "offset": None, "seg": 0, "seq": 0,
+                "run_span": None, "open_segment": None,
+                "pack_t0": None,
+                "trace_id": ctx.trace_id if ctx else _UNTRACED,
+                "pid": pid_label or (f"job {job_id}" if job_id
+                                     else "run"),
+                "worker": {
+                    "name": "worker", "cat": "worker",
+                    "t0": None, "t1": None,
+                    "trace_id": ctx.trace_id if ctx else _UNTRACED,
+                    "span_id": (ctx.span_id if ctx
+                                else f"{base}#w{rank}"),
+                    "parent_span_id": (ctx.parent_span_id if ctx
+                                       else None),
+                    "pid": pid_label or (f"job {job_id}" if job_id
+                                         else "run"),
+                    "tid": f"rank {rank}",
+                    "args": ({"job_id": job_id} if job_id else {})},
+            }
+            spans.append(st["worker"])
+        return st
+
+    def close_segment(st, t):
+        seg = st.pop("open_segment", None)
+        if seg is not None:
+            seg["t1"] = t
+        st["open_segment"] = None
+
+    def t_of(st, e):
+        """Wall-aligned time: t_mono + the segment's run_header offset
+        (monotonic inside a segment, wall-aligned across segments and
+        hosts); plain t_wall before any header."""
+        tm, tw = e.get("t_mono"), e.get("t_wall")
+        if st["offset"] is not None and isinstance(tm, (int, float)):
+            return tm + st["offset"]
+        return tw if isinstance(tw, (int, float)) else 0.0
+
+    for e in events:
+        if not isinstance(e, dict) or "event" not in e:
+            continue
+        ev = e["event"]
+        rank = e.get("process_index")
+        rank = rank if isinstance(rank, int) else 0
+        st = lane(e, rank)
+        if ev == "run_header":
+            tm, tw = e.get("t_mono"), e.get("t_wall")
+            if isinstance(tm, (int, float)) and isinstance(tw,
+                                                           (int, float)):
+                st["offset"] = tw - tm
+        t = t_of(st, e)
+        if st["worker"]["t0"] is None:
+            st["worker"]["t0"] = t
+        st["worker"]["t1"] = t
+        run = st["run_span"]
+
+        def child(name, cat, t0, t1, args=None, tid=None,
+                  parent=None):
+            st["seq"] += 1
+            s = {"name": name, "cat": cat, "t0": t0, "t1": t1,
+                 "trace_id": st["trace_id"],
+                 "span_id": f"{st['worker']['span_id']}"
+                            f"/p{rank}.{st['seq']}",
+                 "parent_span_id": (parent or
+                                    (run["span_id"] if run
+                                     else st["worker"]["span_id"])),
+                 "pid": st["pid"], "tid": tid or f"rank {rank}",
+                 "args": args or {}}
+            spans.append(s)
+            return s
+
+        if ev == "run_header":
+            st["seg"] += 1
+            close_segment(st, t)
+            run = st["run_span"] = {
+                "name": f"run segment {st['seg']}", "cat": "run",
+                "t0": t, "t1": t, "trace_id": st["trace_id"],
+                "span_id": f"{st['worker']['span_id']}"
+                           f"/p{rank}/seg{st['seg']}",
+                "parent_span_id": st["worker"]["span_id"],
+                "pid": st["pid"], "tid": f"rank {rank}",
+                "args": {"process_index": rank,
+                         "hostname": e.get("hostname"),
+                         "platform": e.get("platform"),
+                         "steps_total": e.get("steps_total")}}
+            spans.append(run)
+            continue
+        if run is not None:
+            run["t1"] = max(run["t1"], t)
+        if ev == "chunk":
+            w = e.get("wall_s")
+            w = w if isinstance(w, (int, float)) else 0.0
+            child(f"chunk @{e.get('step')}", "chunk", t - w, t,
+                  args={k: e.get(k) for k in
+                        ("step", "steps", "steps_per_s",
+                         "mcells_steps_per_s", "residual", "finite",
+                         "gap_s", "observe_s", "drain_wait_s")
+                        if e.get(k) is not None})
+        elif ev == "checkpoint_save":
+            w = e.get("wall_s")
+            w = w if isinstance(w, (int, float)) else 0.0
+            child(f"checkpoint_save g{e.get('generation')}",
+                  "checkpoint", t - w, t,
+                  args={k: e.get(k) for k in
+                        ("step", "generation", "async", "path")
+                        if e.get(k) is not None})
+        elif ev == "checkpoint_barrier":
+            w = e.get("wait_s")
+            w = w if isinstance(w, (int, float)) else 0.0
+            child(f"commit gate ({e.get('reason')})", "checkpoint",
+                  t - w, t, args={"reason": e.get("reason"),
+                                  "wait_s": e.get("wait_s")})
+        elif ev == "barrier_wait":
+            w = e.get("wait_s")
+            w = w if isinstance(w, (int, float)) else 0.0
+            child(f"barrier_wait @{e.get('step')}", "consensus",
+                  t - w, t, args={"step": e.get("step"),
+                                  "wait_s": e.get("wait_s")})
+        elif ev == "rollback":
+            w = e.get("load_wall_s")
+            w = w if isinstance(w, (int, float)) else 0.0
+            child(f"rollback load -> step {e.get('step')}",
+                  "rollback", t - w, t,
+                  args={"step": e.get("step"), "path": e.get("path")})
+            st["open_segment"] = child(
+                f"replay from step {e.get('step')}", "rollback",
+                t, t, args={"from_step": e.get("step")})
+        elif ev == "pack_header":
+            st["pack_t0"] = t
+            if run is None:
+                # Packed worker streams open with pack_header before
+                # the engine's run_header: give the members a parent.
+                run = st["run_span"] = child(
+                    f"pack {e.get('pack')}", "pack", t, t,
+                    args={"members": e.get("members"),
+                          "job_ids": e.get("job_ids")})
+        elif ev == "member_end":
+            m = e.get("member")
+            t0 = st["pack_t0"]
+            t0 = t0 if t0 is not None else (run["t0"] if run else t)
+            child(f"member {m}", "member", t0, t,
+                  tid=f"rank {rank} member {m}",
+                  args={k: e.get(k) for k in
+                        ("member", "step", "converged", "residual",
+                         "finite") if e.get(k) is not None})
+        elif ev == "run_end":
+            close_segment(st, t)
+            if run is not None:
+                run["t1"] = t
+                run["args"]["outcome"] = e.get("outcome")
+            st["run_span"] = None
+        elif ev in _INSTANT_EVENTS:
+            st["seq"] += 1
+            instants.append({
+                "name": ev, "cat": "lifecycle", "t0": t,
+                "trace_id": st["trace_id"],
+                "span_id": f"{st['worker']['span_id']}"
+                           f"/p{rank}.i{st['seq']}",
+                "parent_span_id": (run["span_id"] if run
+                                   else st["worker"]["span_id"]),
+                "pid": st["pid"],
+                "tid": (f"rank {rank} member {e['member']}"
+                        if e.get("member") is not None
+                        else f"rank {rank}"),
+                "args": {k: v for k, v in e.items()
+                         if k not in ("schema", "event", "t_wall",
+                                      "t_mono")}})
+        # close any segment still open at stream end
+    for st in ranks.values():
+        close_segment(st, st["worker"]["t1"])
+        if st["worker"]["t0"] is None:
+            spans.remove(st["worker"])
+    # Ranks of one traced stream share the envelope's worker span —
+    # coalesce the per-lane observations of it into one.
+    return merge_spans(spans), instants
+
+
+def spans_from_journal(events: Sequence[dict]
+                       ) -> Tuple[List[dict], List[dict]]:
+    """Derive fleet-side spans from a heatd journal: per job a ``job``
+    span (accepted → terminal), ``queue wait`` spans (accepted →
+    dispatched, and requeued → re-dispatched — the live metric
+    ``tools/monitor.py --daemon`` and the queue-wait SLO watch),
+    and per-attempt ``dispatch`` spans whose ids the worker's
+    telemetry envelope points at (``dispatch_span_id``). Instants for
+    orphanings, requeues, failures and terminal verdicts."""
+    spans: List[dict] = []
+    instants: List[dict] = []
+    jobs: Dict[str, dict] = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        jid, ev, t = e.get("job_id"), e.get("event"), e.get("t_wall")
+        if jid is None or ev is None or not isinstance(t, (int, float)):
+            continue
+        j = jobs.get(jid)
+        if j is None:
+            j = jobs[jid] = {"trace_id": _UNTRACED, "span": None,
+                             "wait_from": None, "open_attempt": None,
+                             "n": 0}
+        if isinstance(e.get("trace_id"), str):
+            j["trace_id"] = e["trace_id"]
+        pid = f"job {jid}"
+
+        def mark(name, args=None):
+            j["n"] += 1
+            instants.append({
+                "name": name, "cat": "queue", "t0": t,
+                "trace_id": j["trace_id"],
+                "span_id": f"{submit_span_id(jid)}.i{j['n']}",
+                "parent_span_id": submit_span_id(jid),
+                "pid": pid, "tid": "queue",
+                "args": args or {}})
+
+        if ev == "accepted":
+            j["span"] = {"name": f"job {jid}", "cat": "job",
+                         "t0": t, "t1": t, "trace_id": j["trace_id"],
+                         "span_id": submit_span_id(jid),
+                         "parent_span_id": None,
+                         "pid": pid, "tid": "queue",
+                         "args": {"job_id": jid}}
+            spans.append(j["span"])
+            j["wait_from"] = t
+            continue
+        if j["span"] is None:
+            continue  # rejected / pre-acceptance noise
+        j["span"]["t1"] = max(j["span"]["t1"], t)
+        j["span"]["trace_id"] = j["trace_id"]
+        if ev == "dispatched":
+            if j["wait_from"] is not None:
+                j["n"] += 1
+                spans.append({
+                    "name": "queue wait", "cat": "queue",
+                    "t0": j["wait_from"], "t1": t,
+                    "trace_id": j["trace_id"],
+                    "span_id": f"{submit_span_id(jid)}.q{j['n']}",
+                    "parent_span_id": submit_span_id(jid),
+                    "pid": pid, "tid": "queue",
+                    "args": {"wait_s": t - j["wait_from"]}})
+                j["wait_from"] = None
+            att = int(e.get("attempt") or 1)
+            a = {"name": f"attempt a{att:03d} ({e.get('worker')})",
+                 "cat": "dispatch", "t0": t, "t1": t,
+                 "trace_id": j["trace_id"],
+                 "span_id": dispatch_span_id(jid, att),
+                 "parent_span_id": submit_span_id(jid),
+                 "pid": pid, "tid": "queue",
+                 "args": {"worker": e.get("worker"),
+                          "attempt": att, "pack": e.get("pack")}}
+            spans.append(a)
+            j["open_attempt"] = a
+        else:
+            a = j.get("open_attempt")
+            if a is not None:
+                a["t1"] = max(a["t1"], t)
+            if ev == "requeued":
+                j["wait_from"] = float(e.get("not_before") or t)
+                j["open_attempt"] = None
+                mark("requeued", {"reason": e.get("reason")})
+            elif ev in ("orphaned", "worker_failed", "cancel_requested"):
+                j["open_attempt"] = None
+                mark(ev, {"reason": e.get("reason"),
+                          "kind": e.get("kind")})
+            elif ev in ("completed", "quarantined", "cancelled",
+                        "deadline_expired"):
+                j["open_attempt"] = None
+                mark(ev, {"kind": e.get("kind"),
+                          "steps_done": e.get("steps_done")})
+    return spans, instants
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def chrome_trace(spans: Sequence[dict],
+                 instants: Sequence[dict] = ()) -> dict:
+    """Render spans + instants as a Chrome trace-event document
+    (``{"traceEvents": [...], "displayTimeUnit": "ms"}``) that opens
+    in Perfetto / ``chrome://tracing``. Lanes (string ``pid``/``tid``)
+    map onto stable numeric ids with ``process_name`` /
+    ``thread_name`` metadata events; the causal ids ride each event's
+    ``args`` (``trace_id`` / ``span_id`` / ``parent_span_id``) so the
+    parentage survives the export."""
+    pids: Dict[str, int] = {}
+    tids: Dict[Tuple[str, str], int] = {}
+    meta: List[dict] = []
+    out: List[dict] = []
+
+    def ids(span):
+        p = pids.get(span["pid"])
+        if p is None:
+            p = pids[span["pid"]] = len(pids) + 1
+            meta.append({"name": "process_name", "ph": "M", "pid": p,
+                         "tid": 0,
+                         "args": {"name": span["pid"]}})
+        key = (span["pid"], span["tid"])
+        t = tids.get(key)
+        if t is None:
+            t = tids[key] = len(tids) + 1
+            meta.append({"name": "thread_name", "ph": "M", "pid": p,
+                         "tid": t, "args": {"name": span["tid"]}})
+        return p, t
+
+    t_min = min((s["t0"] for s in list(spans) + list(instants)),
+                default=0.0)
+    for s in spans:
+        p, t = ids(s)
+        args = dict(s.get("args") or {})
+        args.update({"trace_id": s["trace_id"],
+                     "span_id": s["span_id"],
+                     "parent_span_id": s.get("parent_span_id")})
+        out.append({"name": s["name"], "cat": s.get("cat", "span"),
+                    "ph": "X",
+                    "ts": (s["t0"] - t_min) * 1e6,
+                    "dur": max(0.0, (s["t1"] - s["t0"]) * 1e6),
+                    "pid": p, "tid": t, "args": args})
+    for s in instants:
+        p, t = ids(s)
+        args = dict(s.get("args") or {})
+        args.update({"trace_id": s["trace_id"],
+                     "span_id": s["span_id"],
+                     "parent_span_id": s.get("parent_span_id")})
+        out.append({"name": s["name"], "cat": s.get("cat", "mark"),
+                    "ph": "i", "s": "t",
+                    "ts": (s["t0"] - t_min) * 1e6,
+                    "pid": p, "tid": t, "args": args})
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms",
+            "otherData": {"t_min_wall": t_min,
+                          "generator": "parallel_heat_tpu heattrace"}}
+
+
+def link_streams_to_journal(stream_spans: Sequence[dict],
+                            journal_spans: Sequence[dict]) -> int:
+    """Stitch the two halves of the chain: a stream's synthetic
+    ``worker`` span whose envelope carried no parent (an older writer,
+    or a stream read without its spec) is re-parented onto the
+    journal's matching dispatch span by deterministic id; worker spans
+    that already point at a journal span are left alone. Returns the
+    number of spans linked."""
+    by_id = {s["span_id"] for s in journal_spans}
+    linked = 0
+    for s in stream_spans:
+        if s.get("cat") != "worker":
+            continue
+        if s.get("parent_span_id") in by_id:
+            linked += 1
+            continue
+        jid = (s.get("args") or {}).get("job_id")
+        if not jid:
+            continue
+        # Newest attempt whose dispatch span exists: attempts count up.
+        for att in range(999, 0, -1):
+            did = dispatch_span_id(jid, att)
+            if did in by_id:
+                s["parent_span_id"] = did
+                linked += 1
+                break
+    return linked

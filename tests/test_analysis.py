@@ -1451,3 +1451,25 @@ def test_ast_scan_covers_coordinator_module():
     assert coord in set(_iter_py_files(default_scan_paths()))
     findings = lint_paths([coord])
     assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_ast_scan_covers_tracing_and_slo_tools():
+    """The heattrace plane (ISSUE 12) rides the HL2xx gate like every
+    other subsystem: `utils/tracing.py` and the new tools are inside
+    the default scan set and lint clean with the ledger empty."""
+    from parallel_heat_tpu.analysis.astlint import (
+        REPO_ROOT,
+        _iter_py_files,
+        default_scan_paths,
+        lint_paths,
+    )
+
+    mods = [os.path.join(REPO_ROOT, "parallel_heat_tpu", "utils",
+                         "tracing.py"),
+            os.path.join(REPO_ROOT, "tools", "heattrace.py"),
+            os.path.join(REPO_ROOT, "tools", "slo_gate.py")]
+    scanned = set(_iter_py_files(default_scan_paths()))
+    for m in mods:
+        assert m in scanned, m
+    findings = lint_paths(mods)
+    assert [f for f in findings if f.severity == "error"] == []

@@ -397,10 +397,12 @@ def test_consensus_single_rank_nan_rolls_back_both_ranks_bitwise(
         rbs = [e for e in ev if e["event"] == "rollback"]
         waits = [e for e in ev if e["event"] == "barrier_wait"]
         assert cons and cons[0]["action"] == "nan"
-        # envelope carries the rank (run_header's own process_index
-        # field reports jax's view, which thread-sim cannot fake)
-        assert all(e["process_index"] == i for e in ev
-                   if e["event"] != "run_header")
+        # the envelope's rank is authoritative on EVERY event (schema
+        # 2: run_header keeps jax's own view under runtime_process_*
+        # instead of clobbering the envelope — thread-sim cannot fake
+        # the runtime view, but the envelope it CAN set is what
+        # heattrace lanes and the shard reports key off)
+        assert all(e["process_index"] == i for e in ev)
         assert waits and all(w["wait_s"] >= 0 for w in waits)
         per_rank.append((cons[0]["step"], [r["path"] for r in rbs]))
     assert per_rank[0] == per_rank[1]

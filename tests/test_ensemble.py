@@ -801,3 +801,45 @@ class TestTelemetryReport:
         assert f["pack_dispatches"] == 1
         assert f["jobs_per_dispatch"] == 3.0
         assert "packing" in mod.render_fleet_text(doc)
+
+
+def test_inline_pack_stream_falls_back_to_spec_trace(packing_daemon):
+    # Review regression (ISSUE 12): an inline-launched pack crosses no
+    # env boundary, so the pack's shared telemetry stream must inherit
+    # the LEADER's committed spec trace (execute_job's fallback,
+    # applied to packs) — otherwise heattrace cannot join the stream
+    # to its submits.
+    import glob
+    import json as _json
+
+    from parallel_heat_tpu.utils.tracing import (
+        dispatch_span_id,
+        worker_span_id,
+    )
+
+    daemon, t, record = packing_daemon
+    jids = ["tp-0", "tp-1"]
+    for i, j in enumerate(jids):
+        _spool(daemon, j, _PACK_CONFIG, checkpoint_every=20,
+               trace={"trace_id": f"trace-{i}",
+                      "span_id": f"s-submit-{j}"})
+    for _ in range(6):
+        t[0] += 1.0
+        daemon.step(t[0])
+    jobs, anomalies = daemon.store.replay()
+    assert not anomalies
+    assert all(jobs[j].state == "completed" for j in jids)
+    assert record["packs"] == [jids]
+    # the reducer carried each member's own trace off its journal line
+    assert [jobs[j].trace_id for j in jids] == ["trace-0", "trace-1"]
+    (stream,) = glob.glob(os.path.join(
+        daemon.store.root, "telemetry", "pack-*.jsonl"))
+    with open(stream) as f:
+        ev = [_json.loads(ln) for ln in f if ln.strip()]
+    # the shared stream traces under the LEADER's spec trace, as a
+    # worker child of the leader's dispatch span
+    assert all(e["trace_id"] == "trace-0" for e in ev)
+    assert all(e["span_id"] == worker_span_id("tp-0", 1) for e in ev)
+    assert all(e["parent_span_id"] == dispatch_span_id("tp-0", 1)
+               for e in ev)
+    assert all(e["job_id"] == "tp-0" for e in ev)

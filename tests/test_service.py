@@ -644,8 +644,12 @@ def test_client_submit_sees_rejection(tmp_path):
                             clock=lambda: t["now"], sleep_fn=sleep)
     assert verdict == {"job_id": "j2", "accepted": False,
                        "reason": verdict["reason"],
-                       "retry_after_s": verdict["retry_after_s"]}
+                       "retry_after_s": verdict["retry_after_s"],
+                       "trace_id": verdict["trace_id"]}
     assert verdict["retry_after_s"] > 0
+    # the trace is born at submit even for a rejected submission (the
+    # rejection is part of the causal story)
+    assert verdict["trace_id"].startswith("t")
     d.store.close()
 
 

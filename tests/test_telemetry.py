@@ -156,18 +156,23 @@ def test_telemetry_does_not_change_compiled_programs(tmp_path):
     # The acceptance contract: telemetry/annotation-enabled runs share
     # (and are bitwise identical to) un-instrumented executables — the
     # same regression the guard pins, extended to the telemetry layer
-    # AND the diagnostics layer AND the pipelined dispatch loop: the
-    # fully-instrumented runs below add a diag_interval on top of the
-    # sink (one at pipeline_depth=1, one at pipeline_depth=2) and must
-    # still hit only the plain run's cached runners.
+    # AND the diagnostics layer AND the pipelined dispatch loop AND
+    # the heattrace plumbing: the fully-instrumented runs below add a
+    # diag_interval on top of the sink (one at pipeline_depth=1 with a
+    # trace context + job_id stamped on every envelope, one at
+    # pipeline_depth=2) and must still hit only the plain run's cached
+    # runners.
     from parallel_heat_tpu import solver
+    from parallel_heat_tpu.utils.tracing import TraceContext
 
     cfg = HeatConfig(steps=30, **_BASE)
     solver._build_runner.cache_clear()
     plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=10)]
     misses_before = solver._build_runner.cache_info().misses
     with Telemetry(tmp_path / "t.jsonl",
-                   heartbeat=tmp_path / "hb.json") as tel:
+                   heartbeat=tmp_path / "hb.json",
+                   trace=TraceContext("tT", "sT", "pT"),
+                   job_id="jT") as tel:
         instr = [r.to_numpy()
                  for r in solve_stream(cfg.replace(diag_interval=10),
                                        chunk_steps=10,
@@ -189,6 +194,47 @@ def test_telemetry_does_not_change_compiled_programs(tmp_path):
         diags = [e for e in _events(tmp_path / name)
                  if e["event"] == "diagnostics"]
         assert [d["step"] for d in diags] == [10, 20, 30]
+    # and the trace triple actually rode the traced sink's envelope
+    # (the contract is not vacuous for the heattrace layer either)
+    traced = _events(tmp_path / "t.jsonl")
+    assert all(e["trace_id"] == "tT" and e["span_id"] == "sT"
+               and e["parent_span_id"] == "pT" and e["job_id"] == "jT"
+               for e in traced)
+
+
+def test_envelope_hostname_and_optional_trace_fields(tmp_path):
+    import socket
+
+    # hostname rides every envelope (schema 2: fleet joins and
+    # straggler attribution need the host); job_id/trace only when set
+    with Telemetry(tmp_path / "a.jsonl") as tel:
+        tel.emit("chunk", step=1)
+    ev = _events(tmp_path / "a.jsonl")
+    assert ev[0]["schema"] == SCHEMA_VERSION == 2
+    assert ev[0]["hostname"] == socket.gethostname()
+    assert "job_id" not in ev[0] and "trace_id" not in ev[0]
+
+
+def test_trace_context_inherited_from_environment(tmp_path, monkeypatch):
+    # The daemon->worker inheritance path: a sink built with no
+    # explicit context picks the HEATTRACE_* variables up, so a
+    # spawned worker's stream joins the submit's trace with no flag.
+    from parallel_heat_tpu.utils import tracing
+
+    monkeypatch.setenv(tracing.ENV_TRACE_ID, "tE")
+    monkeypatch.setenv(tracing.ENV_SPAN_ID, "sE")
+    monkeypatch.setenv(tracing.ENV_PARENT_SPAN_ID, "pE")
+    with Telemetry(tmp_path / "e.jsonl") as tel:
+        tel.emit("chunk", step=1)
+    ev = _events(tmp_path / "e.jsonl")
+    assert ev[0]["trace_id"] == "tE"
+    assert ev[0]["span_id"] == "sE"
+    assert ev[0]["parent_span_id"] == "pE"
+    # an explicit context wins over the environment
+    with Telemetry(tmp_path / "x.jsonl",
+                   trace=tracing.TraceContext("tX", "sX")) as tel:
+        tel.emit("chunk", step=1)
+    assert _events(tmp_path / "x.jsonl")[0]["trace_id"] == "tX"
 
 
 def test_telemetry_survives_unwritable_sink(tmp_path):

@@ -1,0 +1,912 @@
+"""heattrace: trace contexts, the span model, the Chrome trace
+export, and the SLO gate (SEMANTICS.md extends the observation-only
+contract to tracing — the plumbing observes existing artifacts and
+never changes a run).
+
+Fast cells run on synthetic event streams shaped exactly like the
+writers' output (envelope schema 2). The heavy cells — a real 2-rank
+thread-simulated supervised run with a split-brain fault (the
+per-rank streams behind the ``chaos_r15_dryrun.json`` artifact) — are
+marked ``slow`` (tier-1 already runs near its wall budget); CI's
+``make trace-smoke`` covers the subprocess path end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from parallel_heat_tpu.utils import tracing
+from parallel_heat_tpu.utils.tracing import (
+    TraceContext,
+    chrome_trace,
+    dispatch_span_id,
+    link_streams_to_journal,
+    new_trace_id,
+    spans_from_journal,
+    spans_from_stream,
+    submit_span_id,
+    worker_span_id,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HEATTRACE = os.path.join(_ROOT, "tools", "heattrace.py")
+_SLO_GATE = os.path.join(_ROOT, "tools", "slo_gate.py")
+
+
+# ---------------------------------------------------------------------------
+# TraceContext
+# ---------------------------------------------------------------------------
+
+def test_trace_context_dict_round_trip():
+    ctx = TraceContext("t1", "s1", "p1")
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+    root = TraceContext("t1", "s1")
+    d = root.to_dict()
+    assert "parent_span_id" not in d
+    assert TraceContext.from_dict(d) == root
+    # malformed inputs are None, never a crash (older specs/envelopes)
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"trace_id": "t"}) is None
+    assert TraceContext.from_dict({"trace_id": 3, "span_id": "s"}) \
+        is None
+
+
+def test_trace_context_env_round_trip():
+    ctx = TraceContext("t1", "s1", "p1")
+    env = ctx.to_env()
+    assert TraceContext.from_env(env) == ctx
+    assert TraceContext.from_env({}) is None
+    # a child hop: same trace, parent = the old span
+    child = ctx.child("s2")
+    assert child.trace_id == "t1" and child.parent_span_id == "s1"
+
+
+def test_deterministic_span_ids_and_trace_id_entropy():
+    assert submit_span_id("j1") == "s-submit-j1"
+    assert dispatch_span_id("j1", 2) == "s-dispatch-j1-a002"
+    assert worker_span_id("j1", 2) == "s-worker-j1-a002"
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64  # collision-free without randomness
+
+
+# ---------------------------------------------------------------------------
+# Synthetic streams (envelope schema 2, the writers' exact shape)
+# ---------------------------------------------------------------------------
+
+def _env(event, t_mono, rank=0, trace=None, job_id=None, **fields):
+    rec = {"schema": 2, "event": event, "t_wall": 1000.0 + t_mono,
+           "t_mono": t_mono, "process_index": rank,
+           "process_count": 2 if rank else 1, "hostname": f"host{rank}"}
+    if trace is not None:
+        rec.update(trace.to_dict())
+    if job_id is not None:
+        rec["job_id"] = job_id
+    rec.update(fields)
+    return rec
+
+
+def _rank_events(rank, trace=None, job_id=None):
+    """One rank's telemetry for a supervised run with a rollback —
+    the event families the chaos cells certify, in their real order."""
+    ev = [
+        _env("run_header", 10.0, rank, trace, job_id,
+             config={"nx": 16, "ny": 16, "steps": 60},
+             steps_total=60),
+        _env("checkpoint_save", 10.5, rank, trace, job_id, step=0,
+             wall_s=0.2, generation=1),
+        _env("chunk", 11.0, rank, trace, job_id, step=20, steps=20,
+             wall_s=0.4, cells=256, bytes_per_cell=8),
+        _env("barrier_wait", 11.05, rank, trace, job_id, step=20,
+             wait_s=0.01 + 0.04 * rank),
+        _env("guard_trip", 11.2, rank, trace, job_id, step=40,
+             window=[20, 40]),
+        _env("retry", 11.3, rank, trace, job_id, retry=1,
+             max_retries=3, kind="guard trip", backoff_s=0.0),
+        _env("rollback", 11.6, rank, trace, job_id, step=20,
+             path="/ck/g20", load_wall_s=0.1),
+        _env("chunk", 12.2, rank, trace, job_id, step=40, steps=20,
+             wall_s=0.4, cells=256, bytes_per_cell=8),
+        _env("barrier_wait", 12.25, rank, trace, job_id, step=40,
+             wait_s=0.01 + 0.04 * rank),
+        _env("chunk", 12.8, rank, trace, job_id, step=60, steps=20,
+             wall_s=0.4, cells=256, bytes_per_cell=8),
+        _env("checkpoint_barrier", 12.9, rank, trace, job_id,
+             reason="final", wait_s=0.02),
+        _env("run_end", 13.0, rank, trace, job_id, outcome="complete",
+             steps_done=60),
+    ]
+    return ev
+
+
+def _by_id(spans):
+    return {s["span_id"]: s for s in spans}
+
+
+def test_stream_spans_single_rank_structure():
+    trace = TraceContext("tX", "s-worker-j1-a001", "s-dispatch-j1-a001")
+    spans, instants = spans_from_stream(
+        _rank_events(0, trace, job_id="j1"))
+    ids = _by_id(spans)
+    # the synthetic worker span IS the envelope span (the causal hop
+    # below the journal's dispatch span)
+    worker = ids["s-worker-j1-a001"]
+    assert worker["parent_span_id"] == "s-dispatch-j1-a001"
+    assert worker["args"]["job_id"] == "j1"
+    runs = [s for s in spans if s["cat"] == "run"]
+    assert len(runs) == 1
+    assert runs[0]["parent_span_id"] == "s-worker-j1-a001"
+    chunks = [s for s in spans if s["cat"] == "chunk"]
+    assert [c["args"]["step"] for c in chunks] == [20, 40, 60]
+    for c in chunks:
+        # queue->worker->chunk parentage + interval nesting inside
+        # the run segment
+        assert c["parent_span_id"] == runs[0]["span_id"]
+        assert runs[0]["t0"] <= c["t0"] <= c["t1"] <= runs[0]["t1"]
+        assert c["t1"] - c["t0"] == pytest.approx(0.4)
+    # every span resolves upward within the trace
+    for s in spans:
+        par = s["parent_span_id"]
+        assert par is None or par in ids \
+            or par == "s-dispatch-j1-a001"
+        assert s["trace_id"] == "tX"
+    # rollback load + the replay segment span
+    cats = {s["cat"] for s in spans}
+    assert {"rollback", "consensus", "checkpoint"} <= cats
+    # lifecycle instants (guard_trip/retry) are marks, not spans
+    assert {i["name"] for i in instants} >= {"guard_trip", "retry"}
+    # t_mono anchored at run_header: wall-aligned absolute times
+    assert runs[0]["t0"] == pytest.approx(1010.0)
+
+
+def test_stream_spans_two_ranks_merge_onto_one_timeline():
+    trace = TraceContext("tX", "s-worker-j1-a001", "s-dispatch-j1-a001")
+    merged = _rank_events(0, trace, "j1") + _rank_events(1, trace, "j1")
+    spans, _ = spans_from_stream(merged)
+    runs = {s["args"]["process_index"]: s for s in spans
+            if s["cat"] == "run"}
+    assert set(runs) == {0, 1}
+    # rank lanes are distinct, times share one wall-aligned axis
+    assert runs[0]["tid"] != runs[1]["tid"]
+    assert runs[0]["t0"] == pytest.approx(runs[1]["t0"])
+    # per-rank barrier_wait spans carry each rank's own wait
+    waits = sorted((s["tid"], round(s["t1"] - s["t0"], 3))
+                   for s in spans if s["cat"] == "consensus")
+    assert waits == [("rank 0", 0.01), ("rank 0", 0.01),
+                     ("rank 1", 0.05), ("rank 1", 0.05)]
+    # chunk parentage holds on BOTH ranks
+    for s in spans:
+        if s["cat"] == "chunk":
+            rank = int(s["tid"].split()[1])
+            assert s["parent_span_id"] == runs[rank]["span_id"]
+
+
+def test_stream_spans_untraced_and_foreign_lines_degrade():
+    ev = _rank_events(0)  # no trace context, no job_id
+    ev.insert(3, {"foreign": "line"})  # shaped wrong
+    ev.insert(5, {"event": "chunk"})  # no timestamps at all
+    spans, _ = spans_from_stream(ev)
+    assert any(s["cat"] == "chunk" for s in spans)
+    assert all(s["trace_id"] == "untraced" for s in spans)
+
+
+def test_ensemble_member_lanes():
+    tr = TraceContext("tP", "s-worker-p1-a001")
+    ev = [
+        _env("pack_header", 5.0, 0, tr, "p1", pack="p1", members=2,
+             job_ids=["p1", "p2"]),
+        _env("run_header", 5.1, 0, tr, "p1",
+             config={"nx": 16}, steps_total=60),
+        _env("member_converged", 6.0, 0, tr, "p1", member=1, step=40,
+             residual=1e-4),
+        _env("member_end", 6.5, 0, tr, "p1", member=0, step=60,
+             converged=False, residual=2e-3),
+        _env("member_end", 6.5, 0, tr, "p1", member=1, step=40,
+             converged=True, residual=1e-4),
+        _env("run_end", 6.6, 0, tr, "p1", outcome="complete"),
+    ]
+    spans, instants = spans_from_stream(ev)
+    members = [s for s in spans if s["cat"] == "member"]
+    assert {s["tid"] for s in members} \
+        == {"rank 0 member 0", "rank 0 member 1"}
+    conv = next(i for i in instants if i["name"] == "member_converged")
+    assert conv["tid"] == "rank 0 member 1"
+
+
+# ---------------------------------------------------------------------------
+# Journal spans
+# ---------------------------------------------------------------------------
+
+def _journal(jid="j1", trace_id="tX", requeue=True):
+    ev = [{"event": "accepted", "job_id": jid, "t_wall": 100.0,
+           "trace_id": trace_id},
+          {"event": "dispatched", "job_id": jid, "t_wall": 101.5,
+           "worker": f"w-{jid}-a001", "attempt": 1,
+           "trace_id": trace_id}]
+    if requeue:
+        ev += [{"event": "orphaned", "job_id": jid, "t_wall": 103.0,
+                "worker": f"w-{jid}-a001", "attempt": 1},
+               {"event": "requeued", "job_id": jid, "t_wall": 103.0,
+                "not_before": 103.5, "reason": "orphaned"},
+               {"event": "dispatched", "job_id": jid, "t_wall": 104.0,
+                "worker": f"w-{jid}-a002", "attempt": 2,
+                "trace_id": trace_id}]
+    ev.append({"event": "completed", "job_id": jid, "t_wall": 106.0,
+               "attempt": 2 if requeue else 1, "steps_done": 60})
+    return ev
+
+
+def test_journal_spans_queue_wait_and_attempts():
+    spans, instants = spans_from_journal(_journal())
+    ids = _by_id(spans)
+    job = ids[submit_span_id("j1")]
+    assert job["t0"] == 100.0 and job["t1"] == 106.0
+    assert job["trace_id"] == "tX"
+    waits = [s for s in spans if s["name"] == "queue wait"]
+    # accepted->dispatch AND requeued->re-dispatch both count: the
+    # queue-wait SLO is about every wait, not just the first
+    assert [round(s["t1"] - s["t0"], 3) for s in waits] == [1.5, 0.5]
+    atts = [s for s in spans if s["cat"] == "dispatch"]
+    assert [s["span_id"] for s in atts] \
+        == [dispatch_span_id("j1", 1), dispatch_span_id("j1", 2)]
+    for s in waits + atts:
+        assert s["parent_span_id"] == job["span_id"]
+        assert job["t0"] <= s["t0"] <= s["t1"] <= job["t1"]
+    assert {i["name"] for i in instants} \
+        >= {"orphaned", "requeued", "completed"}
+
+
+def test_link_streams_to_journal_by_deterministic_ids():
+    jspans, _ = spans_from_journal(_journal(requeue=False))
+    # an UNTRACED stream (older writer): linked by job_id + attempt
+    sspans, _ = spans_from_stream(_rank_events(0, job_id="j1"))
+    n = link_streams_to_journal(sspans, jspans)
+    assert n == 1
+    worker = next(s for s in sspans if s["cat"] == "worker")
+    assert worker["parent_span_id"] == dispatch_span_id("j1", 1)
+
+
+# ---------------------------------------------------------------------------
+# Chrome export
+# ---------------------------------------------------------------------------
+
+def _validate_chrome(doc):
+    """The Chrome trace-event contract the export must satisfy."""
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["name"], str)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+            assert "span_id" in e["args"]
+    # span ids are unique; every parent resolves or is explicitly
+    # outside the document (an env-inherited dispatch parent)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ids = [e["args"]["span_id"] for e in xs]
+    assert len(ids) == len(set(ids))
+    return xs
+
+
+def test_chrome_trace_round_trip_and_nesting():
+    trace = TraceContext("tX", "s-worker-j1-a001", "s-dispatch-j1-a001")
+    spans, instants = spans_from_stream(
+        _rank_events(0, trace, "j1") + _rank_events(1, trace, "j1"))
+    jspans, jinst = spans_from_journal(_journal(requeue=False))
+    link_streams_to_journal(spans, jspans)
+    doc = chrome_trace(jspans + spans, jinst + instants)
+    doc = json.loads(json.dumps(doc))  # byte-level JSON validity
+    xs = _validate_chrome(doc)
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    # the full causal chain: submit -> dispatch -> worker -> run ->
+    # chunk, across BOTH ranks
+    chunk_parents = set()
+    for e in xs:
+        if e["name"].startswith("chunk"):
+            run = by_id[e["args"]["parent_span_id"]]
+            worker = by_id[run["args"]["parent_span_id"]]
+            dispatch = by_id[worker["args"]["parent_span_id"]]
+            job = by_id[dispatch["args"]["parent_span_id"]]
+            assert job["args"]["span_id"] == submit_span_id("j1")
+            chunk_parents.add(run["args"]["span_id"])
+    assert len(chunk_parents) == 2  # one run lane per rank
+
+
+# ---------------------------------------------------------------------------
+# CLI round trips (subprocess: the tools must not rot)
+# ---------------------------------------------------------------------------
+
+def _write_stream(path, events, torn=False, garbage=False):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        if garbage:
+            f.write("not json at all\n")
+        if torn:
+            f.write('{"event": "chunk", "t_')  # mid-append tear
+
+
+def test_heattrace_cli_round_trip(tmp_path):
+    trace = TraceContext("tX", "s-worker-j1-a001", "s-dispatch-j1-a001")
+    _write_stream(tmp_path / "m.p0.jsonl", _rank_events(0, trace, "j1"))
+    _write_stream(tmp_path / "m.p1.jsonl", _rank_events(1, trace, "j1"),
+                  torn=True, garbage=True)
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, _HEATTRACE, str(tmp_path / "m.p*.jsonl"),
+         "--out", str(out), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout)
+    assert len(summary["streams"]) == 2
+    assert summary["streams"][1]["torn_tail"] is True
+    doc = json.load(open(out))
+    xs = _validate_chrome(doc)
+    assert sum(1 for e in xs if e["name"].startswith("chunk")) == 6
+    # thread lanes name both ranks
+    names = {e["args"]["name"]
+             for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert {"rank 0", "rank 1"} <= names
+
+
+def test_heattrace_cli_unusable_input(tmp_path):
+    empty = tmp_path / "nothing.jsonl"
+    empty.write_text("")
+    r = subprocess.run(
+        [sys.executable, _HEATTRACE, str(empty),
+         "--out", str(tmp_path / "t.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "no spans derivable" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# slo_gate
+# ---------------------------------------------------------------------------
+
+def _busy_chunk(t, step, rank=0, gap=0.01):
+    return _env("chunk", t, rank, step=step, steps=20, wall_s=0.4,
+                cells=256, bytes_per_cell=8, gap_s=gap,
+                observe_s=0.002)
+
+
+def _slo(tmp_path, spec):
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(spec))
+    return str(p)
+
+
+def test_slo_gate_stream_clean_and_violated(tmp_path):
+    ev = [_env("run_header", 1.0, config={"nx": 16}, steps_total=60),
+          _busy_chunk(2.0, 20), _busy_chunk(3.0, 40),
+          _busy_chunk(4.0, 60),
+          _env("run_end", 5.0, outcome="complete", steps_done=60)]
+    _write_stream(tmp_path / "m.jsonl", ev)
+    spec = _slo(tmp_path, {"stream": ["permanent_failure",
+                                      "busy<0.5"]})
+    clean = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "m.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    assert "all SLOs held" in clean.stdout
+    # doctor the artifact: a permanent_failure event + an idle device
+    bad = ev[:-1] + [
+        _busy_chunk(6.0, 80, gap=9.0),
+        _env("permanent_failure", 7.0, diagnosis="doctored",
+             kind="exhausted"),
+        _env("run_end", 8.0, outcome="permanent_failure")]
+    _write_stream(tmp_path / "bad.jsonl", bad)
+    v = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "bad.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert v.returncode == 2
+    assert "permanent_failure" in v.stdout
+    assert "device-busy fraction" in v.stdout
+
+
+def test_slo_gate_barrier_wait_straggler_attribution(tmp_path):
+    # rank 1 waits long at every consensus boundary; rank 0 never
+    # does — rank 0 is the dominant straggler (the one rank 1 waits
+    # FOR), and the violation must say so by rank and host.
+    def shard(rank, wait):
+        return ([_env("run_header", 1.0, rank, config={"nx": 16})]
+                + [_env("barrier_wait", 2.0 + i, rank, step=20 * i,
+                        wait_s=wait) for i in range(5)]
+                + [_env("run_end", 9.0, rank, outcome="complete")])
+
+    _write_stream(tmp_path / "m.p0.jsonl", shard(0, 0.001))
+    _write_stream(tmp_path / "m.p1.jsonl", shard(1, 0.8))
+    spec = _slo(tmp_path, {"stream": ["barrier_wait_p99>0.5"]})
+    r = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "m.p*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    line = next(ln for ln in r.stdout.splitlines()
+                if "barrier-wait p99" in ln)
+    assert "rank 1 on host1" in line  # the violating rank
+    assert "dominant straggler: rank 0 on host0" in line
+
+
+def test_slo_gate_fleet_root_and_heartbeat_freshness(tmp_path):
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = tmp_path / "q"
+    store = JobStore(str(root))
+    j = store.journal
+    j.append("accepted", job_id="j1", trace_id="tX")
+    j.append("dispatched", job_id="j1", worker="w1", attempt=1)
+    j.append("completed", job_id="j1", attempt=1, steps_done=60)
+    store.write_daemon_status({"pid": 1, "t_wall": 1000.0,
+                               "state": "serving", "slots": 2})
+    store.close()
+    spec = _slo(tmp_path, {"fleet": ["quarantined>0", "orphaned>0",
+                                     "queue_wait_s.p99>30"],
+                           "heartbeat_max_age_s": 60})
+    clean = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec, str(root),
+         "--now", "1010"],
+        capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    # a stale heartbeat while claiming to serve violates freshness
+    stale = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec, str(root),
+         "--now", "5000"],
+        capture_output=True, text=True, timeout=120)
+    assert stale.returncode == 2 and "heartbeat" in stale.stdout
+    # doctor the journal: a quarantined job trips the fleet SLO
+    store2 = JobStore(str(root))
+    store2.journal.append("accepted", job_id="j2")
+    store2.journal.append("quarantined", job_id="j2", kind="unstable")
+    store2.close()
+    v = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec, str(root),
+         "--now", "1010"],
+        capture_output=True, text=True, timeout=120)
+    assert v.returncode == 2 and "quarantined" in v.stdout
+
+
+def test_slo_gate_empty_gate_is_an_error(tmp_path):
+    _write_stream(tmp_path / "m.jsonl",
+                  [_env("run_header", 1.0, config={})])
+    r = subprocess.run(
+        [sys.executable, _SLO_GATE, str(tmp_path / "m.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "gates nothing" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the real service path (inline worker), then the chaos
+# artifact path (2 real thread-simulated ranks) — the latter slow.
+# ---------------------------------------------------------------------------
+
+def test_trace_context_threads_queue_to_telemetry(tmp_path):
+    # client.submit births the trace; the spec commits it; the daemon
+    # journals it; the inline worker (no env crossing) falls back to
+    # the spec and stamps the envelope: the WHOLE chain is joined by
+    # ids, no path conventions.
+    from parallel_heat_tpu.service import client
+    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    root = str(tmp_path / "q")
+
+    class InlineHandle:
+        def __init__(self, run):
+            self._run = run
+            self._rc = None
+            self.pid = os.getpid()
+
+        def poll(self):
+            if self._rc is None:
+                self._rc = self._run()
+            return self._rc
+
+        def terminate(self):
+            pass
+
+        kill = terminate
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        return InlineHandle(lambda: svc_worker.execute_job(
+            root, job_id, worker_id, attempt, deadline_t=deadline_t))
+
+    d = Heatd(HeatdConfig(root=root, launcher=launcher,
+                          worker_heartbeat_s=0.05,
+                          heartbeat_timeout_s=10.0))
+    t = {"now": 0.0}
+
+    def sleep(s):
+        t["now"] += s
+        d.step()
+
+    verdict = client.submit(root, {"nx": 16, "ny": 16, "steps": 40,
+                                   "backend": "jnp"},
+                            job_id="jt", accept_timeout_s=60.0,
+                            clock=lambda: t["now"], sleep_fn=sleep)
+    assert verdict["accepted"] and verdict["trace_id"]
+    tid = verdict["trace_id"]
+    for _ in range(6):
+        d.step()
+        jobs, _ = d.store.replay()
+        if jobs["jt"].terminal:
+            break
+    jobs, anomalies = d.store.replay()
+    assert anomalies == [] and jobs["jt"].state == "completed"
+    # the reducer carries the trace id off the accepted line
+    assert jobs["jt"].trace_id == tid
+    # journal lines carry it raw too (heattrace reads them directly)
+    events, _, _ = d.store.read_journal()
+    for ev in ("accepted", "dispatched"):
+        line = next(e for e in events if e.get("event") == ev
+                    and e.get("job_id") == "jt")
+        assert line["trace_id"] == tid
+    # the worker's telemetry envelope joined the same trace, as a
+    # child of the dispatch span, with job_id + hostname stamped
+    with open(d.store.telemetry_path("jt")) as f:
+        tev = [json.loads(ln) for ln in f if ln.strip()]
+    hdr = next(e for e in tev if e["event"] == "run_header")
+    assert hdr["trace_id"] == tid
+    assert hdr["span_id"] == worker_span_id("jt", 1)
+    assert hdr["parent_span_id"] == dispatch_span_id("jt", 1)
+    assert hdr["job_id"] == "jt" and hdr["hostname"]
+    d.store.close()
+
+    # and heattrace renders the whole chain from the artifacts alone
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, _HEATTRACE, "--queue", root,
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    xs = _validate_chrome(json.load(open(out)))
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    chunk = next(e for e in xs if e["name"].startswith("chunk"))
+    run = by_id[chunk["args"]["parent_span_id"]]
+    worker = by_id[run["args"]["parent_span_id"]]
+    dispatch = by_id[worker["args"]["parent_span_id"]]
+    job = by_id[dispatch["args"]["parent_span_id"]]
+    assert job["args"]["span_id"] == submit_span_id("jt")
+    assert {e["args"]["trace_id"] for e in (chunk, run, worker,
+                                            dispatch, job)} == {tid}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_heattrace_on_two_rank_split_brain_artifact(tmp_path):
+    # The chaos-artifact cell (the per-rank streams behind
+    # chaos_r15_dryrun.json's mp rows): a REAL 2-rank thread-simulated
+    # supervised run with a rank-1 NaN injection writes per-rank
+    # telemetry shards; heattrace must merge both onto one timeline
+    # with queue->worker->chunk->barrier parentage on BOTH ranks and
+    # the rollback visible.
+    from parallel_heat_tpu import (
+        HeatConfig,
+        SupervisorPolicy,
+        Telemetry,
+        run_supervised,
+    )
+    from parallel_heat_tpu.parallel.coordinator import (
+        InMemoryKV,
+        KVCoordinator,
+    )
+    from parallel_heat_tpu.utils.faults import FaultPlan
+
+    kv = InMemoryKV()
+    cfg = HeatConfig(nx=16, ny=16, steps=60, backend="jnp")
+    trace = TraceContext("t2rank", worker_span_id("jmp", 1),
+                         dispatch_span_id("jmp", 1))
+    results = [None, None]
+    errs = [None, None]
+
+    def rank(i):
+        coord = KVCoordinator(kv, i, 2, barrier_timeout_s=20.0,
+                              heartbeat_interval_s=0.05)
+        tel = Telemetry(str(tmp_path / "m.jsonl"), process_index=i,
+                        process_count=2, trace=trace, job_id="jmp")
+        try:
+            results[i] = run_supervised(
+                cfg, tmp_path / "ck",
+                policy=SupervisorPolicy(checkpoint_every=20,
+                                        guard_interval=10,
+                                        backoff_base_s=0.0,
+                                        barrier_timeout_s=20.0,
+                                        async_checkpoint=False),
+                faults=(FaultPlan(nan_at_step=35, only_process=1)
+                        if i == 1 else None),
+                telemetry=tel, coordinator=coord)
+        except BaseException as e:  # noqa: BLE001
+            errs[i] = e
+        finally:
+            tel.close()
+            coord.close()
+
+    threads = [threading.Thread(target=rank, args=(i,))
+               for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=240)
+    assert errs == [None, None]
+    assert all(r.steps_done == 60 for r in results)
+    assert all(r.rollbacks == 1 for r in results)
+
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, _HEATTRACE, str(tmp_path / "m.p*.jsonl"),
+         "--out", str(out), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    xs = _validate_chrome(json.load(open(out)))
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    runs = [e for e in xs if e["name"].startswith("run segment")]
+    assert len(runs) == 2  # one lane per rank
+    # chunk->run->worker parentage on both ranks; barrier_wait spans
+    # present per rank (the consensus exchanges of the mp cells)
+    barrier_lanes, chunk_lanes = set(), set()
+    for e in xs:
+        if e["name"].startswith("barrier_wait"):
+            barrier_lanes.add(e["tid"])
+        if e["name"].startswith("chunk"):
+            chunk_lanes.add(e["tid"])
+            run = by_id[e["args"]["parent_span_id"]]
+            assert by_id[run["args"]["parent_span_id"]]["args"][
+                "span_id"] == worker_span_id("jmp", 1)
+    assert len(barrier_lanes) == 2 and len(chunk_lanes) == 2
+    # the split-brain rollback is on the timeline (both ranks rolled
+    # back together — the consensus contract)
+    assert sum(1 for e in xs
+               if e["name"].startswith("rollback load")) == 2
+    # both ranks' consensus_verdict instants agree on the action
+    verdicts = [e for e in json.load(open(out))["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "consensus_verdict"]
+    assert {v["args"]["action"] for v in verdicts} == {"nan"}
+
+    # the doctored-vs-clean SLO verdict on the same artifact
+    spec = tmp_path / "slo.json"
+    spec.write_text(json.dumps(
+        {"stream": ["permanent_failure", "barrier_wait_p99>30"]}))
+    clean = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", str(spec),
+         str(tmp_path / "m.p*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    tight = tmp_path / "tight.json"
+    tight.write_text(json.dumps(
+        {"stream": ["barrier_wait_p99>0.0000001", "guard_trip"]}))
+    v = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", str(tight),
+         str(tmp_path / "m.p*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert v.returncode == 2
+    assert "dominant straggler" in v.stdout
+
+
+def test_tracing_module_has_no_jax_dependency():
+    # tracing must stay importable by jax-free consumers (the service
+    # store/daemon import it at module scope).
+    src = open(os.path.join(_ROOT, "parallel_heat_tpu", "utils",
+                            "tracing.py")).read()
+    assert "import jax" not in src
+
+
+# ---------------------------------------------------------------------------
+# Review regressions
+# ---------------------------------------------------------------------------
+
+def test_untraced_streams_from_different_runs_do_not_merge(tmp_path):
+    # Regression (review finding): two UNTRACED runs (plain --metrics,
+    # no trace context) exported together must keep their spans apart
+    # — synthetic span ids seed off the stream key, so merge_spans can
+    # never fuse unrelated runs into one garbled timeline.
+    def run_events(t0):
+        return ([_env("run_header", t0, config={"nx": 16},
+                      steps_total=60)]
+                + [_env("chunk", t0 + i, step=20 * i, steps=20,
+                        wall_s=0.4, cells=256, bytes_per_cell=8)
+                   for i in range(1, 4)]
+                + [_env("run_end", t0 + 4, outcome="complete")])
+
+    _write_stream(tmp_path / "runA.jsonl", run_events(10.0))
+    _write_stream(tmp_path / "runB.jsonl", run_events(5000.0))
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, _HEATTRACE, str(tmp_path / "runA.jsonl"),
+         str(tmp_path / "runB.jsonl"), "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    xs = _validate_chrome(json.load(open(out)))
+    chunks = [e for e in xs if e["name"].startswith("chunk")]
+    assert len(chunks) == 6  # three per run, none fused
+    # and no chunk span stretches across both runs' epochs
+    assert all(e["dur"] < 10e6 for e in chunks)
+
+
+def test_multi_attempt_stream_parents_each_attempt_correctly():
+    # Regression (review finding): heatd appends every attempt to the
+    # same per-job sink; attempt 2's envelopes carry their own span
+    # context and must hang off attempt 2's dispatch span, never
+    # attempt 1's.
+    tr1 = TraceContext("tX", worker_span_id("j1", 1),
+                       dispatch_span_id("j1", 1))
+    tr2 = TraceContext("tX", worker_span_id("j1", 2),
+                       dispatch_span_id("j1", 2))
+    a1 = [_env("run_header", 10.0, 0, tr1, "j1",
+               config={"nx": 16}, steps_total=60),
+          _env("chunk", 11.0, 0, tr1, "j1", step=20, steps=20,
+               wall_s=0.4, cells=256, bytes_per_cell=8)]
+    a2 = [_env("run_header", 50.0, 0, tr2, "j1",
+               config={"nx": 16}, steps_total=60),
+          _env("chunk", 51.0, 0, tr2, "j1", step=40, steps=20,
+               wall_s=0.4, cells=256, bytes_per_cell=8),
+          _env("run_end", 52.0, 0, tr2, "j1", outcome="complete")]
+    spans, _ = spans_from_stream(a1 + a2)
+    ids = _by_id(spans)
+    w1 = ids[worker_span_id("j1", 1)]
+    w2 = ids[worker_span_id("j1", 2)]
+    assert w1["parent_span_id"] == dispatch_span_id("j1", 1)
+    assert w2["parent_span_id"] == dispatch_span_id("j1", 2)
+    for s in spans:
+        if s["cat"] == "chunk":
+            run = ids[s["parent_span_id"]]
+            expect = (worker_span_id("j1", 1)
+                      if s["args"]["step"] == 20
+                      else worker_span_id("j1", 2))
+            assert run["parent_span_id"] == expect
+
+
+def test_fleet_fail_on_tolerates_stream_floor_tokens(tmp_path):
+    # Regression (review finding): one --fail-on string must stay
+    # usable across modes — the documented stream default
+    # 'permanent_failure,busy<0.95' on a queue root skips the floor
+    # it cannot resolve instead of hard-erroring.
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = tmp_path / "q"
+    store = JobStore(str(root))
+    store.journal.append("accepted", job_id="j1")
+    store.journal.append("dispatched", job_id="j1", worker="w1",
+                         attempt=1)
+    store.journal.append("completed", job_id="j1", attempt=1)
+    store.close()
+    mr = os.path.join(_ROOT, "tools", "metrics_report.py")
+    r = subprocess.run(
+        [sys.executable, mr, str(root),
+         "--fail-on", "permanent_failure,busy<0.95"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (r.stdout, r.stderr[-1000:])
+
+
+def test_slo_gate_gates_every_stream_in_a_glob(tmp_path):
+    # Review regression: a glob over INDEPENDENT per-job sinks (the
+    # trace-smoke / CI pattern) must gate every stream — a violation
+    # in the second file must not hide behind the first file's
+    # primary-shard aggregate. Shard families (.pN of one stem) still
+    # gate as one run.
+    clean = [_env("run_header", 1.0, config={"nx": 16}),
+             _env("run_end", 2.0, outcome="complete")]
+    bad = [_env("run_header", 1.0, config={"nx": 16}),
+           _env("guard_trip", 1.5, step=20, window=[0, 20]),
+           _env("permanent_failure", 2.0, diagnosis="doctored",
+                kind="exhausted"),
+           _env("run_end", 2.5, outcome="permanent_failure")]
+    _write_stream(tmp_path / "job-a.jsonl", clean)
+    _write_stream(tmp_path / "job-b.jsonl", bad)
+    spec = _slo(tmp_path, {"stream": ["permanent_failure",
+                                      "guard_trip"]})
+    r = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "job-*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2, (r.stdout, r.stderr[-1000:])
+    assert "job-b.jsonl" in r.stdout
+    assert "permanent_failure" in r.stdout and "guard_trip" in r.stdout
+    # an empty sink among live ones is skipped with a warning, not a
+    # hard error; a target with NO gateable stream is unusable
+    (tmp_path / "job-c.jsonl").write_text("")
+    r2 = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "job-*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 2 and "job-c" in r2.stderr
+    r3 = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec,
+         str(tmp_path / "job-c.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r3.returncode == 1
+
+
+def test_unmeasured_fleet_percentile_passes_misspelled_errors(tmp_path):
+    # Review regression: a young queue (accepted, never dispatched)
+    # has queue_wait_s.p99 = None — a threshold on it must PASS (it is
+    # unmeasured, not violated, and certainly not a misspelled
+    # counter), while a genuinely unknown name stays a loud error.
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = tmp_path / "q"
+    store = JobStore(str(root))
+    store.journal.append("accepted", job_id="j1")
+    store.close()
+    spec = _slo(tmp_path, {"fleet": ["queue_wait_s.p99>60",
+                                     "quarantined>0"]})
+    r = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec", spec, str(root)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1000:])
+    mr = os.path.join(_ROOT, "tools", "metrics_report.py")
+    r2 = subprocess.run(
+        [sys.executable, mr, str(root),
+         "--fail-on", "queue_wait_s.p99>60"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r2.returncode == 0, (r2.stdout, r2.stderr[-1000:])
+    bad = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec",
+         _slo(tmp_path, {"fleet": ["nonsense.p99>1"]}), str(root)],
+        capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 1 and "not a fleet counter" in bad.stderr
+
+
+def test_peer_lost_gates_only_when_spec_names_it(tmp_path):
+    # Review regression: peer_lost is spec-driven like every other
+    # event token (a fleet that intentionally rides the
+    # elastic-degrade path must be able to pass), but evaluates per
+    # shard when named — only survivors' shards carry it.
+    survivors = [_env("run_header", 1.0, config={"nx": 16}),
+                 _env("peer_lost", 2.0, step=20, lost=[1],
+                      survivors=1, waited_s=3.0, timeout_s=3.0),
+                 _env("run_end", 2.5, outcome="interrupted")]
+    _write_stream(tmp_path / "m.jsonl", survivors)
+    without = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec",
+         _slo(tmp_path, {"stream": ["permanent_failure"]}),
+         str(tmp_path / "m.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert without.returncode == 0, without.stdout
+    named = subprocess.run(
+        [sys.executable, _SLO_GATE, "--spec",
+         _slo(tmp_path, {"stream": ["peer_lost"]}),
+         str(tmp_path / "m.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert named.returncode == 2 and "PEER_LOST" in named.stdout
+
+
+def test_spawn_worker_clears_stale_trace_env(tmp_path, monkeypatch):
+    # Review regression: a daemon started from a traced environment
+    # must not leak foreign HEATTRACE_* variables into an UNTRACED
+    # job's worker (its stream would join an unrelated causal chain).
+    from parallel_heat_tpu.service import daemon as svc_daemon
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    captured = {}
+
+    class _P:
+        pid = 1
+
+        def __init__(self, argv, **kw):
+            captured["env"] = kw["env"]
+
+        def poll(self):
+            return 0
+
+    monkeypatch.setattr(svc_daemon.subprocess, "Popen", _P)
+    monkeypatch.setenv(tracing.ENV_TRACE_ID, "stale-trace")
+    monkeypatch.setenv(tracing.ENV_SPAN_ID, "stale-span")
+    d = Heatd(HeatdConfig(root=str(tmp_path / "q")))
+    d._spawn_worker(["--job", "x"], "w-x")
+    assert tracing.ENV_TRACE_ID not in captured["env"]
+    assert tracing.ENV_SPAN_ID not in captured["env"]
+    d._spawn_worker(["--job", "x"], "w-x",
+                    trace=TraceContext("tF", "sF"))
+    assert captured["env"][tracing.ENV_TRACE_ID] == "tF"
+    assert captured["env"][tracing.ENV_SPAN_ID] == "sF"
+    d.store.close()

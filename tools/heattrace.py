@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""heattrace: merge journal + per-rank telemetry onto ONE causal
+timeline and export Chrome trace-event JSON (opens in Perfetto /
+``chrome://tracing``) — the modern analogue of the reference report's
+Paraver analysis, computed from the artifacts the stack already
+writes.
+
+Inputs (combine freely):
+
+- positional STREAMS: telemetry JSONL paths or globs (``runs/m*.jsonl``
+  — multi-process runs shard per rank; every shard becomes its own
+  lane on the shared timeline, t_mono anchored at each shard's
+  ``run_header``);
+- ``--queue ROOT``: a heatd queue root — the journal contributes the
+  fleet half of the chain (job spans, queue-wait spans, per-attempt
+  dispatch spans, orphan/requeue marks), and when no STREAMS are given
+  every per-job sink under ``ROOT/telemetry/`` is pulled in
+  automatically.
+
+The two halves join by the deterministic span ids of
+``parallel_heat_tpu/utils/tracing.py``: the worker's telemetry
+envelope names its dispatch span as parent (env-inherited from the
+daemon), so the exported spans read submit -> queue wait -> dispatch
+-> worker -> run segment (per rank) -> chunk / checkpoint / commit
+gate / barrier_wait / rollback, with ensemble members as child lanes.
+
+Outputs: ``--out trace.json`` (the Chrome trace document; default
+``heattrace.json``) and a one-paragraph stdout summary (``--json`` for
+the machine form). Torn/foreign lines are skipped per the
+metrics_report contract — a trace degrades, never crashes.
+
+Exit codes: 0 trace written; 1 unusable input (nothing derivable).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from parallel_heat_tpu.utils import tracing  # noqa: E402
+
+# ONE tolerant-JSONL parser across the observability tools (the
+# torn-tail contract lives in metrics_report; slo_gate imports it the
+# same way).
+from metrics_report import load_events  # noqa: E402
+
+
+def expand_streams(patterns, queue_root=None):
+    """Positional paths/globs, plus every per-job sink under a queue
+    root when no explicit streams were given."""
+    paths = []
+    for pat in patterns:
+        paths.extend(sorted(glob.glob(pat)) or [pat])
+    if queue_root is not None and not patterns:
+        paths.extend(sorted(
+            glob.glob(os.path.join(queue_root, "telemetry", "*.jsonl"))))
+    seen, out = set(), []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def build_trace(stream_paths, queue_root=None):
+    """Derive the merged span set; returns ``(doc, summary)`` where
+    ``doc`` is the Chrome trace document and ``summary`` the stdout
+    report."""
+    instants = []
+    journal_spans = []
+    summary = {"streams": [], "journal": None, "linked_workers": 0}
+    if queue_root is not None:
+        jpath = os.path.join(queue_root, "journal.jsonl")
+        events, bad, torn = load_events(jpath) \
+            if os.path.isfile(jpath) else ([], 0, False)
+        if not events and not os.path.isfile(jpath):
+            print(f"warning: {queue_root}: no journal.jsonl — not a "
+                  f"heatd queue root?", file=sys.stderr)
+        js, ji = tracing.spans_from_journal(events)
+        journal_spans = js
+        instants.extend(ji)
+        summary["journal"] = {"path": jpath, "events": len(events),
+                              "bad_lines": bad, "torn_tail": torn,
+                              "jobs": sum(1 for s in js
+                                          if s["cat"] == "job")}
+    stream_spans = []
+    for p in stream_paths:
+        try:
+            events, bad, torn = load_events(p)
+        except OSError as e:
+            print(f"warning: {p}: {e}", file=sys.stderr)
+            continue
+        # stream_key: untraced streams (no envelope context) must not
+        # collide across files — their synthetic span ids seed off the
+        # path, so merge_spans can never fuse two unrelated runs.
+        ss, si = tracing.spans_from_stream(events, stream_key=p)
+        stream_spans.extend(ss)
+        instants.extend(si)
+        ranks = sorted({e.get("process_index") for e in events
+                        if isinstance(e.get("process_index"), int)})
+        summary["streams"].append(
+            {"path": p, "events": len(events), "bad_lines": bad,
+             "torn_tail": torn, "ranks": ranks,
+             "spans": len(ss), "instants": len(si)})
+    # Shards of one run parsed as separate files re-observe the same
+    # logical spans (the envelope's worker span): coalesce by id
+    # before linking, so the chain has one node per span.
+    stream_spans = tracing.merge_spans(stream_spans)
+    summary["linked_workers"] = tracing.link_streams_to_journal(
+        stream_spans, journal_spans)
+    spans = journal_spans + stream_spans
+    if not spans and not instants:
+        return None, summary
+    doc = tracing.chrome_trace(spans, instants)
+    by_cat = {}
+    for s in spans:
+        by_cat[s["cat"]] = by_cat.get(s["cat"], 0) + 1
+    summary["spans_by_cat"] = dict(sorted(by_cat.items()))
+    summary["instants"] = len(instants)
+    summary["traces"] = sorted({s["trace_id"] for s in spans})
+    return doc, summary
+
+
+def render_summary(summary, out_path):
+    lines = [f"heattrace: wrote {out_path}"]
+    j = summary.get("journal")
+    if j:
+        lines.append(f"journal: {j['jobs']} job(s) from {j['events']} "
+                     f"event(s) ({j['path']})"
+                     + ("  TORN" if j["torn_tail"] else ""))
+    for s in summary["streams"]:
+        lines.append(
+            f"stream {s['path']}: {s['events']} events -> "
+            f"{s['spans']} spans, ranks {s['ranks'] or [0]}"
+            + ("  TORN" if s["torn_tail"] else ""))
+    if "spans_by_cat" in summary:
+        lines.append("spans: " + ", ".join(
+            f"{k}={v}" for k, v in summary["spans_by_cat"].items()))
+    n_tr = len([t for t in summary.get("traces", [])
+                if t != "untraced"])
+    lines.append(f"traces: {n_tr} traced chain(s)"
+                 + (", plus untraced spans"
+                    if "untraced" in summary.get("traces", [])
+                    else "")
+                 + f"; {summary['linked_workers']} worker span(s) "
+                   f"linked to journal dispatches")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge heatd journal + per-rank telemetry JSONL "
+                    "into Chrome trace-event JSON (Perfetto / "
+                    "chrome://tracing)")
+    ap.add_argument("streams", nargs="*", metavar="JSONL_OR_GLOB",
+                    help="telemetry streams (globs ok: runs/m*.jsonl "
+                         "pulls every per-rank shard onto one "
+                         "timeline)")
+    ap.add_argument("--queue", default=None, metavar="ROOT",
+                    help="heatd queue root: adds journal spans (job / "
+                         "queue wait / dispatch); without positional "
+                         "streams, also pulls every per-job sink "
+                         "under ROOT/telemetry/")
+    ap.add_argument("--out", default="heattrace.json", metavar="FILE",
+                    help="Chrome trace JSON output (default "
+                         "heattrace.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+    if not args.streams and args.queue is None:
+        ap.error("give telemetry streams and/or --queue ROOT")
+
+    paths = expand_streams(args.streams, args.queue)
+    doc, summary = build_trace(paths, args.queue)
+    if doc is None:
+        print("error: no spans derivable from the given inputs (no "
+              "readable journal events or telemetry streams)",
+              file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    summary["out"] = args.out
+    summary["trace_events"] = len(doc["traceEvents"])
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_summary(summary, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

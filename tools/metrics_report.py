@@ -84,6 +84,77 @@ def _percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
+# ---------------------------------------------------------------------------
+# The threshold grammar — THE one way thresholds are spelled across
+# the observability tools (this CLI's --fail-on and tools/slo_gate.py
+# import these, so an SLO is written identically in CI gates and SLO
+# specs):
+#   NAME        an event whose mere presence is an anomaly
+#               (stream vocabulary: 'permanent_failure', 'guard_trip')
+#   NAME>NUM    a ceiling: violated when the value exceeds NUM
+#               (event counts on a stream, fleet counters — dotted
+#               paths reach nested numbers: 'queue_wait_s.p99>5')
+#   NAME<NUM    a floor: violated when the value is below NUM
+#               ('busy<0.9' is the pipeline device-busy floor)
+# Tokens compose with commas; 'none' disables.
+# ---------------------------------------------------------------------------
+
+def parse_fail_on(spec):
+    """Parse a token string -> ``(events, ceilings, floors)`` where
+    ``events`` is a set of names and ceilings/floors are
+    ``(name, number)`` lists. Raises ``ValueError`` naming the bad
+    token."""
+    tokens = ([] if spec == "none"
+              else [t.strip() for t in str(spec).split(",")
+                    if t.strip()])
+    events, ceilings, floors = set(), [], []
+    for t in tokens:
+        if "<" in t:
+            name, _, num = t.partition("<")
+            try:
+                floors.append((name.strip(), float(num)))
+            except ValueError:
+                raise ValueError(f"bad threshold token {t!r} "
+                                 f"(expected NAME<NUMBER)") from None
+        elif ">" in t:
+            name, _, num = t.partition(">")
+            try:
+                ceilings.append((name.strip(), float(num)))
+            except ValueError:
+                raise ValueError(f"bad threshold token {t!r} "
+                                 f"(expected NAME>NUMBER)") from None
+        else:
+            events.add(t)
+    return events, ceilings, floors
+
+
+def resolve_metric(doc, name):
+    """Dotted-path lookup -> ``(exists, value)``, distinguishing an
+    ABSENT path (a misspelled counter — callers should be loud) from a
+    present-but-None metric (legitimately unmeasured yet — e.g. a
+    queue-wait percentile before the first dispatch; a threshold on it
+    passes). Booleans and other non-numbers count as absent."""
+    cur = doc
+    for part in name.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return False, None
+        cur = cur[part]
+    if cur is None:
+        return True, None
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return False, None
+    return True, cur
+
+
+def lookup_metric(doc, name):
+    """Resolve a dotted-path metric name against a summary document
+    (``'queue_wait_s.p99'`` -> ``doc['queue_wait_s']['p99']``).
+    Returns the numeric value, or None when the path is absent,
+    unmeasured, or non-numeric (booleans are not metrics)."""
+    _exists, val = resolve_metric(doc, name)
+    return val
+
+
 def load_events(path):
     """Parse a JSONL telemetry file -> (events, n_bad_lines, torn_tail).
 
@@ -748,33 +819,34 @@ def _fleet_main(args):
     doc = summarize_fleet(args.metrics)
     anomalies = []
     fleet = doc["fleet"]
-    tokens = ([] if args.fail_on == "none"
-              else [t.strip() for t in args.fail_on.split(",")
-                    if t.strip()])
-    for t in tokens:
-        if ">" not in t:
-            # Plain event tokens are the stream-mode vocabulary (the
-            # default 'permanent_failure'); in fleet mode only counter
-            # thresholds gate — unknown plain tokens pass silently so
-            # the shared default stays usable for both modes.
-            continue
-        name, _, num = t.partition(">")
-        name = name.strip()
-        try:
-            thr = int(num)
-        except ValueError:
-            print(f"error: bad --fail-on token {t!r} (expected "
-                  f"NAME>INT, e.g. quarantined>0)", file=sys.stderr)
-            return 1
-        val = fleet.get(name)
-        if not isinstance(val, (int, float)):
+    try:
+        # Plain event tokens and floors are the stream-mode vocabulary
+        # (the default 'permanent_failure'; 'busy<0.95'); in fleet
+        # mode an unresolvable one passes silently so one --fail-on
+        # string stays usable for both modes. Unknown CEILINGS remain
+        # loud errors — 'quarantined>0' misspelled must not silently
+        # gate nothing.
+        _events, ceilings, floors = parse_fail_on(args.fail_on)
+    except ValueError as e:
+        print(f"error: --fail-on: {e}", file=sys.stderr)
+        return 1
+    for name, thr in ceilings:
+        exists, val = resolve_metric(fleet, name)
+        if not exists:
             print(f"error: --fail-on counter {name!r} is not a fleet "
                   f"counter (have: "
-                  f"{', '.join(k for k, v in fleet.items() if isinstance(v, (int, float)))})",
+                  f"{', '.join(k for k, v in fleet.items() if isinstance(v, (int, float)))}, "
+                  f"plus dotted paths like queue_wait_s.p99)",
                   file=sys.stderr)
             return 1
-        if val > thr:
-            anomalies.append(f"{name} = {val} > {thr}")
+        # exists-but-None = legitimately unmeasured (a queue-wait
+        # percentile before the first dispatch): nothing to gate yet.
+        if val is not None and val > thr:
+            anomalies.append(f"{name} = {val:g} > {thr:g}")
+    for name, thr in floors:
+        val = lookup_metric(fleet, name)
+        if val is not None and val < thr:
+            anomalies.append(f"{name} = {val:g} < {thr:g}")
     if doc["anomalies_journal"]:
         anomalies.append(
             f"{len(doc['anomalies_journal'])} journal anomaly(ies) — "
@@ -869,45 +941,42 @@ def main(argv=None):
                              "(straggler visibility)")
 
     anomalies = []
-    tokens = ([] if args.fail_on == "none"
-              else [t.strip() for t in args.fail_on.split(",")
-                    if t.strip()])
-    fail_on, busy_min, thresholds = set(), None, []
-    for t in tokens:
-        if t.startswith("busy<"):
-            try:
-                busy_min = float(t[len("busy<"):])
-            except ValueError:
-                print(f"error: bad --fail-on token {t!r} (expected "
-                      f"busy<FLOAT)", file=sys.stderr)
-                return 1
-        elif ">" in t:
-            # Count threshold (the fleet-mode vocabulary, accepted on
-            # event streams too: `guard_trip>2` fails only past two).
-            name, _, num = t.partition(">")
-            try:
-                thresholds.append((name.strip(), int(num)))
-            except ValueError:
-                print(f"error: bad --fail-on token {t!r} (expected "
-                      f"NAME>INT)", file=sys.stderr)
-                return 1
-        else:
-            fail_on.add(t)
+    try:
+        fail_on, ceilings, floors = parse_fail_on(args.fail_on)
+    except ValueError as e:
+        print(f"error: --fail-on: {e}", file=sys.stderr)
+        return 1
     for ev in sorted(fail_on & set(doc["events_by_type"])):
         anomalies.append(f"{doc['events_by_type'][ev]} {ev} event(s)")
-    for name, thr in thresholds:
-        n = doc["events_by_type"].get(name, 0)
-        if n > thr:
-            anomalies.append(f"{n} {name} event(s) > {thr}")
-    if busy_min is not None:
-        busy = (doc.get("pipeline") or {}).get("device_busy_frac")
-        if busy is None:
+    for name, thr in ceilings:
+        # Count threshold (the fleet-mode vocabulary, accepted on
+        # event streams too: `guard_trip>2` fails only past two);
+        # dotted paths reach summary metrics ('chunks.outlier_frac').
+        if name in doc["events_by_type"]:
+            n = doc["events_by_type"][name]
+            if n > thr:
+                anomalies.append(f"{n} {name} event(s) > {thr:g}")
+            continue
+        val = lookup_metric(doc, name)
+        if val is not None and val > thr:
+            anomalies.append(f"{name} = {val:g} > {thr:g}")
+    for name, thr in floors:
+        # 'busy' is the historical alias for the pipeline section's
+        # device-busy fraction; any other floor is a dotted path, and
+        # a floor on an ABSENT metric is itself an anomaly (an SLO
+        # floor must not silently pass because nothing was measured).
+        if name == "busy":
+            name = "pipeline.device_busy_frac"
+        val = lookup_metric(doc, name)
+        if val is None:
             anomalies.append(
-                f"busy<{busy_min:g} requested but the stream carries "
-                f"no per-chunk timing fields (pre-pipeline writer?)")
-        elif busy < busy_min:
-            anomalies.append(f"device-busy fraction {busy:.2%} < "
-                             f"{busy_min:.2%}")
+                f"{name}<{thr:g} requested but the stream carries no "
+                f"such metric"
+                + (" (no per-chunk timing fields — pre-pipeline "
+                   "writer?)"
+                   if name == "pipeline.device_busy_frac" else ""))
+        elif val < thr:
+            anomalies.append(f"{name} = {val:.4g} < {thr:g}")
     c = doc.get("chunks")
     if (args.max_outlier_frac is not None and c
             and c["outlier_frac"] > args.max_outlier_frac):

@@ -24,7 +24,9 @@ never fatal — a monitor must not crash because it raced a writer.
 
 ``--daemon QUEUE_ROOT`` adds the heatd service view: the daemon's
 status heartbeat (``heatd.json``) plus a lightweight fold of the job
-journal into per-state counts — same artifact-only discipline (the
+journal into per-state counts, queue depth and the oldest-accepted
+age (the live leading indicator of the queue-wait SLO
+``tools/slo_gate.py`` gates post-hoc) — same artifact-only discipline (the
 authoritative reducer lives in ``parallel_heat_tpu/service/store.py``;
 this is the probe-side count, deliberately jax-import-free). Live mode
 exits when the journal records ``daemon_exit``.
@@ -172,6 +174,11 @@ class DaemonState:
         self._offset = 0
         self._partial = b""
         self.states = {}
+        # job_id -> wall time it (re)entered the queue: the live view
+        # of the queue-wait SLO (slo_gate's queue_wait_s.p99 is the
+        # post-hoc percentile; oldest-accepted age is its leading
+        # indicator — a growing age means dispatch has stalled).
+        self.queued_since = {}
         self.rejected = 0
         self.saw_data = False
         self.exited = False
@@ -209,19 +216,26 @@ class DaemonState:
         jid = rec.get("job_id")
         if jid is None:
             return
+        t = rec.get("t_wall")
         if ev == "accepted":
             self.states[jid] = "queued"
+            if isinstance(t, (int, float)):
+                self.queued_since[jid] = t
         elif ev == "rejected":
             self.rejected += 1
             self.states.pop(jid, None)
         elif ev == "dispatched":
             self.states[jid] = "running"
+            self.queued_since.pop(jid, None)
         elif ev in ("worker_failed", "orphaned"):
             self.states[jid] = "failed"
         elif ev == "requeued":
             self.states[jid] = "queued"
+            if isinstance(t, (int, float)):
+                self.queued_since[jid] = t
         elif ev in self._TERMINAL:
             self.states[jid] = ev
+            self.queued_since.pop(jid, None)
 
     def counts(self):
         out = {}
@@ -253,6 +267,17 @@ class DaemonState:
                                   for k, v in sorted(c.items()))
                          + (f" rejected={self.rejected}"
                             if self.rejected else ""))
+        # Queue depth (the admission gate's view: every non-terminal
+        # job) + oldest-accepted age — the live queue-wait SLO signal.
+        depth = sum(1 for s in self.states.values()
+                    if s not in self._TERMINAL)
+        if depth:
+            line = f"depth {depth}"
+            waits = [t for jid, t in self.queued_since.items()
+                     if self.states.get(jid) == "queued"]
+            if waits:
+                line += f" (oldest queued {max(0.0, now - min(waits)):.1f}s)"
+            parts.append(line)
         if self.exited:
             parts.append("daemon exited (drained)")
         return " | ".join(parts) if parts else None

@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""slo_gate: evaluate a declarative fleet SLO spec — the CI face of
+the heattrace observability plane (ROADMAP item 1's "global SLO
+gates": queue wait p99, per-host busy fraction, per-rank barrier-wait
+p99 with straggler attribution, checkpoint overhead share, heartbeat
+freshness).
+
+Targets (combine freely; every target must satisfy the spec):
+
+- heatd QUEUE ROOTS (directories): the journal's fleet counters and
+  latency percentiles (``metrics_report.summarize_fleet``) gate under
+  the spec's ``fleet`` tokens; journal durability anomalies always
+  violate; the daemon status heartbeat's age gates under
+  ``heartbeat_max_age_s`` while the daemon claims to be serving;
+- telemetry STREAMS (files/globs, per-rank shards welcome): the
+  summary document (``metrics_report.summarize``) gates under the
+  spec's ``stream`` tokens, evaluated PER SHARD where the metric is
+  per-rank — ``busy`` (device-busy floor, violation names the worst
+  rank/host: the per-host busy fraction SLO) and ``barrier_wait_p99``
+  (consensus-wait ceiling, violation names the slow rank AND
+  attributes the dominant straggler: the rank with the LOWEST wait is
+  the one every other rank waits for).
+
+The spec is JSON and its tokens are the ONE threshold grammar the
+observability tools share (``metrics_report.parse_fail_on`` — the
+``--fail-on`` vocabulary: ``NAME`` event presence, ``NAME>NUM``
+ceiling, ``NAME<NUM`` floor, dotted paths into the summary docs)::
+
+    {
+      "fleet":  ["quarantined>0", "orphaned>0", "queue_wait_s.p99>5"],
+      "stream": ["permanent_failure", "busy<0.25",
+                 "barrier_wait_p99>0.25",
+                 "checkpoints.overhead_share>0.5"],
+      "heartbeat_max_age_s": 120
+    }
+
+Exit codes: 0 every SLO held; 1 unusable input (bad spec, unreadable
+target); 2 at least one SLO violated (violations on stdout, one per
+line, prefixed ``SLO VIOLATION``).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import metrics_report as mr  # noqa: E402  (the shared grammar + summaries)
+
+# Per-process shard naming (utils/telemetry.py shard_path):
+# m.jsonl -> m.p0.jsonl / m.p1.jsonl ...
+_SHARD_RE = re.compile(r"^(?P<stem>.+)\.p(?P<rank>\d+)(?P<ext>\.[^.]+)$")
+
+
+def expand_stream_targets(pattern):
+    """Expand a path/glob into RUN groups: ``.pN`` shards of one stem
+    gate together (SPMD ranks of one run emit equivalent streams — the
+    primary-shard aggregate is the run), while every other matched
+    file is its own run. A glob over independent per-job heatd sinks
+    must gate EVERY stream, not whichever happens to sort first."""
+    paths = sorted(glob.glob(pattern)) or [pattern]
+    groups = {}
+    for p in paths:
+        m = _SHARD_RE.match(p)
+        key = (m.group("stem") + m.group("ext")) if m else p
+        groups.setdefault(key, []).append(p)
+    return groups
+
+
+def _shard_doc(row, need_busy):
+    """Per-shard view: rank, hostname, barrier-wait percentiles
+    (already folded by load_streams) and — only when a busy floor will
+    read it (a full summarize per shard is not free) — the shard's own
+    device-busy fraction. Per-rank metrics must not hide behind the
+    primary-shard aggregate."""
+    ev = row["events"]
+    host = next((e.get("hostname") for e in ev
+                 if isinstance(e.get("hostname"), str)), None)
+    busy = None
+    if need_busy and ev:
+        busy = (mr.summarize(ev).get("pipeline")
+                or {}).get("device_busy_frac")
+    return {"rank": row["process_index"], "hostname": host,
+            "busy": busy, "barrier_wait": row.get("barrier_wait"),
+            "peer_lost": row.get("peer_lost", 0)}
+
+
+def check_stream(label, paths, tokens, violations):
+    """Evaluate stream tokens against ONE run (a single stream, or the
+    ``.pN`` shard family of one multi-process run). Returns False when
+    the target is unusable."""
+    rows = []
+    for p in paths:
+        try:
+            _ev, _bad, _torn, rs = mr.load_streams(p)
+        except OSError as e:
+            print(f"error: {p}: {e}", file=sys.stderr)
+            return False
+        rows.extend(rs)
+    rows = [r for r in rows if r["events"]]
+    if not rows:
+        # The caller decides whether an eventless run is fatal (a
+        # lone target) or skippable (one empty sink among a glob of
+        # live ones).
+        print(f"warning: {label}: no telemetry events",
+              file=sys.stderr)
+        return "empty"
+    # Aggregate = the primary (lowest-rank) shard, the
+    # metrics_report shard-glob semantics; per-rank metrics below
+    # still see every shard.
+    doc = mr.summarize(min(rows,
+                           key=lambda r: r["process_index"])["events"])
+    fail_on, ceilings, floors = tokens
+    need_busy = any(n == "busy" for n, _ in floors)
+    shards = [_shard_doc(r, need_busy) for r in rows]
+    pattern = label
+
+    def where(s):
+        h = f" on {s['hostname']}" if s.get("hostname") else ""
+        return f"rank {s['rank']}{h}"
+
+    for ev in sorted((fail_on - {"peer_lost"})
+                     & set(doc["events_by_type"])):
+        violations.append(f"{pattern}: {doc['events_by_type'][ev]} "
+                          f"{ev} event(s)")
+    if "peer_lost" in fail_on:
+        # Spec-driven like every other event token — a fleet that
+        # intentionally rides the elastic-degrade path must be able
+        # to pass — but evaluated PER SHARD: only the surviving
+        # ranks' shards carry the event.
+        for s in shards:
+            if s["peer_lost"]:
+                violations.append(
+                    f"{pattern}: PEER_LOST x{s['peer_lost']} "
+                    f"observed by {where(s)}")
+    for name, thr in ceilings:
+        if name == "barrier_wait_p99":
+            # Per-rank consensus wait: the straggler SLO. The rank
+            # with the LOWEST wait is the dominant straggler — it is
+            # the one every other rank sits in the barrier waiting
+            # FOR (metrics_report's shard-glob semantics).
+            waits = [(s, s["barrier_wait"]) for s in shards
+                     if s.get("barrier_wait")]
+            for s, bw in waits:
+                if bw["p99_s"] > thr:
+                    straggler = min(
+                        (o for o, b in waits),
+                        key=lambda o: o["barrier_wait"]["p99_s"])
+                    violations.append(
+                        f"{pattern}: barrier-wait p99 "
+                        f"{bw['p99_s']:.4g}s > {thr:g}s at {where(s)}"
+                        f" — dominant straggler: {where(straggler)} "
+                        f"(p99 "
+                        f"{straggler['barrier_wait']['p99_s']:.4g}s; "
+                        f"the rank that never waits is the one the "
+                        f"others wait for)")
+            continue
+        if name in doc["events_by_type"]:
+            n = doc["events_by_type"][name]
+            if n > thr:
+                violations.append(f"{pattern}: {n} {name} event(s) "
+                                  f"> {thr:g}")
+            continue
+        val = mr.lookup_metric(doc, name)
+        if val is not None and val > thr:
+            violations.append(f"{pattern}: {name} = {val:.4g} > "
+                              f"{thr:g}")
+    for name, thr in floors:
+        if name == "busy":
+            # Per-host busy floor: every rank's own stream carries its
+            # own chunk walls/gaps — a fleet is as fast as its
+            # busiest-idle host.
+            measured = [s for s in shards if s["busy"] is not None]
+            if not measured:
+                violations.append(
+                    f"{pattern}: busy<{thr:g} requested but no shard "
+                    f"carries per-chunk timing fields")
+                continue
+            worst = min(measured, key=lambda s: s["busy"])
+            if worst["busy"] < thr:
+                violations.append(
+                    f"{pattern}: device-busy fraction "
+                    f"{worst['busy']:.2%} < {thr:.2%} at "
+                    f"{where(worst)}")
+            continue
+        val = mr.lookup_metric(doc, name)
+        if val is None:
+            violations.append(f"{pattern}: {name}<{thr:g} requested "
+                              f"but the stream carries no such metric")
+        elif val < thr:
+            violations.append(f"{pattern}: {name} = {val:.4g} < "
+                              f"{thr:g}")
+    return True
+
+
+def check_fleet(root, tokens, hb_max_age_s, violations, now=None):
+    """Evaluate fleet tokens + heartbeat freshness against one queue
+    root. Returns False when the target is unusable."""
+    if not os.path.isfile(os.path.join(root, "journal.jsonl")):
+        print(f"error: {root}: no journal.jsonl — not a heatd queue "
+              f"root", file=sys.stderr)
+        return False
+    doc = mr.summarize_fleet(root)
+    fleet = doc["fleet"]
+    _events, ceilings, floors = tokens
+    for name, thr, is_floor in ([(n, v, False) for n, v in ceilings]
+                                + [(n, v, True) for n, v in floors]):
+        exists, val = mr.resolve_metric(fleet, name)
+        if not exists:
+            print(f"error: {root}: SLO counter {name!r} is not a "
+                  f"fleet counter", file=sys.stderr)
+            return False
+        if val is None:
+            continue  # present but unmeasured yet (e.g. queue-wait
+            # percentiles before the first dispatch): nothing to gate
+        if is_floor and val < thr:
+            violations.append(f"{root}: {name} = {val:g} < {thr:g}")
+        elif not is_floor and val > thr:
+            violations.append(f"{root}: {name} = {val:g} > {thr:g}")
+    for a in doc["anomalies_journal"]:
+        violations.append(f"{root}: journal anomaly: {a}")
+    if hb_max_age_s is not None:
+        hb_path = os.path.join(root, "heatd.json")
+        try:
+            with open(hb_path) as f:
+                hb = json.load(f)
+        except (OSError, ValueError):
+            hb = None
+        # A drained daemon's last heartbeat is legitimately old; only
+        # a daemon still CLAIMING to serve gates on freshness.
+        if isinstance(hb, dict) and hb.get("state") == "serving" \
+                and isinstance(hb.get("t_wall"), (int, float)):
+            now = time.time() if now is None else now
+            age = now - hb["t_wall"]
+            if age > hb_max_age_s:
+                violations.append(
+                    f"{root}: daemon heartbeat {age:.1f}s old > "
+                    f"{hb_max_age_s:g}s while state=serving (hung "
+                    f"daemon?)")
+    return True
+
+
+def load_spec(path):
+    with open(path) as f:
+        spec = json.load(f)
+    if not isinstance(spec, dict):
+        raise ValueError("SLO spec must be a JSON object")
+    return spec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="evaluate a declarative SLO spec over heatd queue "
+                    "roots and telemetry streams (exit 0 held / 2 "
+                    "violated); thresholds use metrics_report's "
+                    "--fail-on grammar")
+    ap.add_argument("targets", nargs="+",
+                    metavar="QUEUE_ROOT_OR_JSONL",
+                    help="heatd queue root directories and/or "
+                         "telemetry JSONL paths/globs")
+    ap.add_argument("--spec", default=None, metavar="FILE",
+                    help="JSON SLO spec "
+                         "({'fleet': [...], 'stream': [...], "
+                         "'heartbeat_max_age_s': N}); see "
+                         "docs/slo.example.json")
+    ap.add_argument("--fleet", default=None, metavar="TOKENS",
+                    help="extra fleet tokens (comma-separated, "
+                         "appended to the spec's)")
+    ap.add_argument("--stream", default=None, metavar="TOKENS",
+                    help="extra stream tokens (appended to the "
+                         "spec's)")
+    ap.add_argument("--now", type=float, default=None,
+                    help="clock override for heartbeat freshness "
+                         "(tests/replays; default: wall now)")
+    args = ap.parse_args(argv)
+
+    spec = {}
+    if args.spec is not None:
+        try:
+            spec = load_spec(args.spec)
+        except (OSError, ValueError) as e:
+            print(f"error: --spec {args.spec}: {e}", file=sys.stderr)
+            return 1
+    try:
+        fleet_tokens = mr.parse_fail_on(
+            ",".join([t for t in spec.get("fleet", [])]
+                     + ([args.fleet] if args.fleet else [])) or "none")
+        stream_tokens = mr.parse_fail_on(
+            ",".join([t for t in spec.get("stream", [])]
+                     + ([args.stream] if args.stream else []))
+            or "none")
+    except ValueError as e:
+        print(f"error: SLO spec: {e}", file=sys.stderr)
+        return 1
+    if not spec and args.fleet is None and args.stream is None:
+        print("error: give --spec and/or inline --fleet/--stream "
+              "tokens (an empty gate gates nothing)", file=sys.stderr)
+        return 1
+    hb_max = spec.get("heartbeat_max_age_s")
+
+    violations = []
+    for target in args.targets:
+        if os.path.isdir(target):
+            ok = check_fleet(target, fleet_tokens, hb_max,
+                             violations, now=args.now)
+            if not ok:
+                return 1
+            continue
+        # A glob may cover several INDEPENDENT runs (per-job heatd
+        # sinks): every run group gates, not just the first match. An
+        # empty sink among live ones is skippable; a target yielding
+        # NO gateable run is unusable input.
+        gated = 0
+        for label, paths in expand_stream_targets(target).items():
+            ok = check_stream(label, paths, stream_tokens, violations)
+            if ok is False:
+                return 1
+            if ok is True:
+                gated += 1
+        if gated == 0:
+            print(f"error: {target}: no telemetry events in any "
+                  f"matched stream", file=sys.stderr)
+            return 1
+    if violations:
+        for v in violations:
+            print(f"SLO VIOLATION: {v}")
+        print(f"slo_gate: {len(violations)} violation(s) across "
+              f"{len(args.targets)} target(s)")
+        return 2
+    print(f"slo_gate: all SLOs held across {len(args.targets)} "
+          f"target(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
