@@ -74,13 +74,17 @@ lint-fast:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m chaos -q
 
-# distributed-supervision smoke: the two multi-process chaos cells on
-# a REAL 2-process gloo boundary (mp_split_brain: a single-rank NaN
+# distributed-supervision smoke: the multi-process chaos cells on a
+# REAL 2-process gloo boundary (mp_split_brain: a single-rank NaN
 # rolls BOTH ranks back to the same generation bitwise; mp_peer_lost:
 # a real rank SIGKILL is detected within one barrier timeout and the
 # printed elastic resume command completes bit-exactly on the
-# surviving mesh). Exit 0 = the SEMANTICS.md "Distributed
-# supervision" contract held across a true process boundary.
+# surviving mesh; mp_overlap_parity: the overlapped exchange schedule
+# is bitwise across the boundary AND the supervisor contract —
+# bounded dead-peer detection + elastic resume carrying
+# --halo-overlap — survives it under a mid-run SIGKILL). Exit 0 = the
+# SEMANTICS.md "Distributed supervision" and "Overlapped exchange"
+# contracts held across a true process boundary.
 mp-smoke:
 	$(PY) tools/heatlint.py --layer ast --fail-on error
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_matrix.py --mp-only

@@ -184,6 +184,16 @@ def default_spmd_targets():
         targets.append(_runner_target(
             HeatConfig(steps=40, converge=True, check_interval=8,
                        **base), "jnp-2d-temporal", "converge"))
+        # Exchange-schedule variants (SEMANTICS.md "Overlapped
+        # exchange"): the overlapped/deferred and phase-separated
+        # schedules of one geometry MUST exchange identical halo
+        # tables — HL302's cross-variant rule proves it statically for
+        # every family that spells both out. The default targets above
+        # resolve halo_overlap=auto (the overlapped schedule), so
+        # adding the "phase" spelling pins the pair.
+        targets.append(_runner_target(
+            HeatConfig(steps=8, halo_overlap="phase", **base),
+            "jnp-2d-temporal", "fixed-phase"))
         basep = dict(nx=32, ny=32, backend="pallas", mesh_shape=(2, 2),
                      halo_depth=8)
         targets.append(_runner_target(
@@ -192,6 +202,26 @@ def default_spmd_targets():
         targets.append(_runner_target(
             HeatConfig(steps=32, converge=True, check_interval=8,
                        **basep), "pallas-2d-temporal", "converge"))
+        # The kernel-G schedule triple: auto resolves to the pipelined
+        # round here, so "fixed" above already audits the
+        # double-buffered tables; these pin the deferred and
+        # phase-separated spellings into the same family.
+        targets.append(_runner_target(
+            HeatConfig(steps=16, halo_overlap="overlap", **basep),
+            "pallas-2d-temporal", "fixed-overlap"))
+        targets.append(_runner_target(
+            HeatConfig(steps=16, halo_overlap="phase", **basep),
+            "pallas-2d-temporal", "fixed-phase"))
+    if mesh_ok((2, 2, 2)):
+        # 3D deferred rounds (the x phase overlapped) vs
+        # phase-separated: same cross-schedule table pin as 2D.
+        base3t = dict(nx=8, ny=8, nz=8, backend="jnp",
+                      mesh_shape=(2, 2, 2), halo_depth=2)
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **base3t), "jnp-3d-temporal", "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=4, halo_overlap="phase", **base3t),
+            "jnp-3d-temporal", "fixed-phase"))
         # Per-step pallas block path (kernel B/C sharded or the jnp
         # fallback — whatever pick_block_2d routes; the exchange
         # protocol must be identical either way).

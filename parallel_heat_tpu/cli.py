@@ -55,6 +55,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "resolved backend is pallas, a mesh is set and "
                          "the geometry admits, else 1 — see --explain "
                          "for the resolved value")
+    ap.add_argument("--halo-overlap", default="auto",
+                    choices=("auto", "phase", "overlap", "pipeline"),
+                    help="exchange/compute schedule of the sharded "
+                         "K-deep rounds (SEMANTICS.md 'Overlapped "
+                         "exchange'; bitwise-invariant): 'phase' "
+                         "serializes every ppermute phase before the "
+                         "round's compute, 'overlap' defers the last "
+                         "phase behind the bulk update, 'pipeline' "
+                         "double-buffers the next round's edge strips "
+                         "so BOTH phases stream during the bulk kernel "
+                         "(2D kernel-G rounds). 'auto' prices pipeline "
+                         "vs overlap with the TpuParams ICI model — "
+                         "see --explain for the resolved schedule")
     ap.add_argument("--accumulate", default="storage",
                     choices=("storage", "f32chunk"),
                     help="sub-f32 accumulation semantics (SEMANTICS.md): "
@@ -295,6 +308,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         check_interval=args.check_interval, dtype=args.dtype,
         backend=args.backend, mesh_shape=mesh_shape,
         overlap=not args.no_overlap, halo_depth=halo_depth,
+        halo_overlap=(None if args.halo_overlap == "auto"
+                      else args.halo_overlap),
         accumulate=args.accumulate, guard_interval=args.guard_interval,
         diag_interval=args.diag_interval, pipeline_depth=pipeline_depth,
     )

@@ -38,7 +38,7 @@ SEMANTIC_FIELDS = (
     "nx", "ny", "nz", "cx", "cy", "cz",
     "steps", "converge", "eps", "check_interval",
     "dtype", "backend", "mesh_shape", "overlap", "halo_depth",
-    "accumulate",
+    "halo_overlap", "accumulate",
 )
 OBSERVATION_ONLY_FIELDS = ("guard_interval", "diag_interval",
                            "pipeline_depth")
@@ -215,6 +215,36 @@ class HeatConfig:
     # default, mpi/...stat.c:130-234) — and 1 otherwise. Explicit
     # values always win (``solver._resolve_halo_depth``).
     halo_depth: Optional[int] = None
+
+    # Exchange/compute schedule of the sharded K-deep rounds
+    # (SEMANTICS.md "Overlapped exchange"). The contract: every value
+    # is BITWISE identical across all three schedules — the flag moves
+    # collective hops off the compute critical path, never a bit.
+    # - "phase":    phase-separated — each round's compute consumes the
+    #               fully assembled exchange (every ppermute phase
+    #               serializes before the first FLOP).
+    # - "overlap":  deferred edge bands — the bulk update consumes only
+    #               the block plus the FIRST exchange phase, so the
+    #               later phase's ppermutes (row strips in 2D, x slabs
+    #               in 3D) overlap the bulk compute; the thin bands are
+    #               then computed from the arrived halos and spliced.
+    # - "pipeline": double-buffered edge strips (2D pallas kernel-G
+    #               rounds) — round r+1's ENTIRE exchange is built from
+    #               thin band/panel passes of round r, so both ppermute
+    #               phases stream while round r's bulk kernel computes.
+    # - None/"auto" (default): "pipeline" where the kernel-G pipelined
+    #               round is available and the TpuParams ICI model
+    #               prices the hidden exchange above the extra edge
+    #               compute, else "overlap". Geometry declines fall
+    #               back one level (pipeline -> overlap -> phase-free
+    #               monolithic jnp), reported by ``solver.explain``.
+    # SEMANTIC: the flag selects the compiled dataflow schedule (a
+    # different XLA program), so it keys the runner/executable caches
+    # like ``overlap`` and ``backend`` — the bitwise-equality contract
+    # is pinned by tests, not by cache sharing. Inert for unsharded
+    # runs and for halo_depth == 1 (the per-step paths already overlap
+    # via the ``overlap`` interior/edge split).
+    halo_overlap: Optional[str] = None
 
     # Sub-f32 accumulation semantics (SEMANTICS.md). "storage" (default):
     # the state rounds to the storage dtype after EVERY step — K-step
@@ -438,6 +468,12 @@ class HeatConfig:
                         f"halo_depth={self.halo_depth} exceeds the "
                         f"smallest block extent {bmin}"
                     )
+        if self.halo_overlap not in (None, "auto", "phase", "overlap",
+                                     "pipeline"):
+            raise ValueError(
+                f"halo_overlap must be one of 'auto'/None, 'phase', "
+                f"'overlap', 'pipeline', got {self.halo_overlap!r}"
+            )
         if self.guard_interval is not None and self.guard_interval < 1:
             raise ValueError(
                 f"guard_interval must be >= 1 (or None to disable the "

@@ -2307,6 +2307,130 @@ def pick_block_temporal_2d_deferred(config, axis_names):
     return None
 
 
+def _panel_strips_2d(block_shape, dtype_name, cx, cy, grid_shape, k,
+                     tail):
+    """``fn(u, tail_arr, row_off, col_off) -> (wmid, emid)``: the next
+    state's W/E k-wide edge columns over rows ``[k, bx-k)``, computed
+    WITHOUT the bulk kernel — the pipelined round's double-buffered
+    edge strips (``temporal._pallas_pipeline_2d``).
+
+    Each side advances k frontier steps on a ``(bx, 3k)`` window
+    (phase-1 halo columns + the block's own 2k edge columns — the
+    K-cone of the k output columns; rows ``[k, bx-k)`` never reach the
+    N/S halos, so the window needs no phase-2 data at all) using the
+    SAME ``_pinned_coeffs``/``_pinned_stepper`` arithmetic as the bulk
+    and band kernels — per-cell values are bitwise the bulk kernel's
+    by construction (the one-site rationale those helpers exist for),
+    which is what lets the pipelined exchange ship these cells while
+    the bulk kernel recomputes them. Volume: ``2 * 3k`` of ``by``
+    columns — <1% of the block at production sizes; the evaluation is
+    XLA-fused jnp (a Mosaic kernel for a k-lane output would fight
+    lane alignment for no measurable gain at this volume).
+
+    The diverging-run re-pin mirrors ``_finish_block_2d``: global
+    Dirichlet columns are re-pinned from ``u`` (the multiplicative
+    pinning's 0*inf would otherwise leak NaN); the mid rows are never
+    global boundary rows (row k of a block starts at global ``ro + k
+    >= 1``), so no row re-pin is needed.
+    """
+    bx, by = block_shape
+    NX, NY = grid_shape
+    dtype = jnp.dtype(dtype_name)
+
+    def one_side(u, tail_arr, row_off, col_off, side):
+        if side == "w":
+            win = jnp.concatenate(
+                [tail_arr[:, tail - k:].astype(dtype), u[:, :2 * k]],
+                axis=1)
+            cols_g = (jnp.int32(col_off) - k
+                      + lax.broadcasted_iota(jnp.int32, (1, 3 * k), 1))
+        else:
+            win = jnp.concatenate(
+                [u[:, -2 * k:], tail_arr[:, :k].astype(dtype)], axis=1)
+            cols_g = (jnp.int32(col_off) + by - 2 * k
+                      + lax.broadcasted_iota(jnp.int32, (1, 3 * k), 1))
+        colmask = (cols_g >= 1) & (cols_g <= NY - 2)
+        coeffs = _pinned_coeffs(colmask, cx, cy)
+        chunk_new, _ = _pinned_stepper(coeffs, jnp.int32(row_off) + 1,
+                                       1, NX, dtype)
+        for _ in range(k):
+            # Row sweep [1, bx-1), column frontier [1, 3k-1) — the
+            # kernels' shrinking-frontier discipline (chunk_new's roll
+            # wrap touches only the discarded edge columns).
+            new, _ = chunk_new(win, 1, bx - 2)
+            win = win.at[1:bx - 1, 1:3 * k - 1].set(
+                new[:, 1:-1].astype(dtype))
+        mid = win[k:bx - k, k:2 * k]
+        co = jnp.int32(col_off)
+        if side == "w":
+            return mid.at[:, 0].set(
+                jnp.where(co == 0, u[k:bx - k, 0], mid[:, 0]))
+        return mid.at[:, -1].set(
+            jnp.where(co + by == NY, u[k:bx - k, by - 1], mid[:, -1]))
+
+    def fn(u, tail_arr, row_off, col_off):
+        return (one_side(u, tail_arr, row_off, col_off, "w"),
+                one_side(u, tail_arr, row_off, col_off, "e"))
+
+    return fn
+
+
+def pick_block_temporal_2d_pipelined(config, axis_names):
+    """The pipelined (double-buffered edge strip) 2D round's pieces:
+    ``(bulk_res, bulk_plain, band_res, band_plain, tail, panel)`` or
+    ``None``.
+
+    Available exactly when the deferred round is AND the block holds
+    two disjoint k-wide column strips (``by >= 2k`` — the panel
+    windows must not wrap). Shares every builder's lru_cache with
+    ``temporal._pallas_pipeline_2d`` (execution), ``solver.explain``
+    (reporting) and ``temporal.resolve_halo_overlap`` (the auto
+    probe).
+    """
+    deferred = pick_block_temporal_2d_deferred(config, axis_names)
+    if deferred is None:
+        return None
+    K = config.halo_depth
+    bx, by = config.block_shape()
+    if by < 2 * K:
+        return None
+    kind, built, _ = pick_block_temporal_2d(config, axis_names)
+    if kind not in ("G-uni", "G-fuse"):
+        return None
+    bulk, bulk_plain, band, band_plain = deferred
+    panel = _panel_strips_2d((bx, by), config.dtype, float(config.cx),
+                             float(config.cy), config.shape, K,
+                             built.tail)
+    return bulk, bulk_plain, band, band_plain, built.tail, panel
+
+
+def pipeline_gain_2d(config):
+    """``(hidden_s, extra_s)`` per K-deep round: the phase-1 exchange
+    wall the pipelined schedule pulls off the critical path vs the
+    extra edge-strip compute it pays — the TpuParams pricing behind
+    ``temporal.resolve_halo_overlap``'s auto decision.
+
+    ``hidden``: one ICI hop latency plus the K-wide column strip's
+    bytes (the phase-1 collective the deferred schedule still
+    serializes before the bulk kernel; phase 2 is already hidden by
+    Level 1). ``extra``: the two (bx, 3k) panel windows' K sweeps at
+    the VPU rate plus their HBM traffic. At pod-scale weak scaling
+    (modest blocks, fixed latency) hidden dominates; at huge blocks
+    the strip bytes and panel cost track each other and the model
+    keeps the simpler deferred schedule.
+    """
+    bx, by = config.block_shape()
+    k = config.halo_depth
+    itemsize = jnp.dtype(config.dtype).itemsize
+    hw = _params()
+    hidden = (hw.collective_latency_s
+              + bx * k * itemsize / hw.ici_bytes_per_s)
+    panel_cells = 2 * bx * 3 * k * k
+    extra = (panel_cells / hw.vpu_cells_per_s
+             + 2 * bx * 3 * k * itemsize * 2 / hw.hbm_stream_bytes_per_s)
+    return hidden, extra
+
+
 def pick_block_temporal_2d(config, axis_names):
     """The 2D K-deep round's kernel decision:
     ``(kind, built, built_plain)`` with kind in {"G-uni", "G-fuse",

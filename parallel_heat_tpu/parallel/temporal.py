@@ -50,6 +50,38 @@ from parallel_heat_tpu.parallel.halo import _shift_down, _shift_up
 _ACC = jnp.float32
 
 
+def _split_exchange_deep_2d(u, k: int, mesh_shape, axis_names,
+                            pad_cols: int = 0):
+    """The two phases of the K-deep 2D exchange, kept apart:
+    ``(lead, halo_n, halo_s)`` where ``lead`` is the column-extended
+    ``(bx, by+2k+pad_cols)`` block (phase 1 arrived) and the row strips
+    are the phase-2 ppermutes of ``lead``'s own edge rows.
+
+    This is THE exchange spelling — :func:`exchange_halos_deep_2d`
+    concatenates the pieces (the phase-separated consumer) and the
+    deferred jnp round consumes them apart (the overlapped consumer),
+    so the two schedules exchange byte-identical halos by construction.
+    The phase-2 ppermutes depend only on ``lead``'s k-row edge strips,
+    never on any compute, which is what lets the overlapped schedule
+    run them concurrently with the bulk update.
+    """
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    dt = u.dtype
+    # Phase 1: K-wide column strips along the y axis.
+    halo_w = _shift_down(u[:, -k:], ay, dy)
+    halo_e = _shift_up(u[:, :k], ay, dy)
+    parts = [halo_w.astype(dt), u, halo_e.astype(dt)]
+    if pad_cols:
+        parts.append(jnp.zeros((u.shape[0], pad_cols), dt))
+    lead = jnp.concatenate(parts, axis=1)
+    # Phase 2: K-tall row strips of the *extended* block along x —
+    # these carry the corner data from the diagonal neighbors.
+    halo_n = _shift_down(lead[-k:, :], ax, dx).astype(dt)
+    halo_s = _shift_up(lead[:k, :], ax, dx).astype(dt)
+    return lead, halo_n, halo_s
+
+
 def exchange_halos_deep_2d(u, k: int, mesh_shape: Tuple[int, int],
                            axis_names: Tuple[str, str] = ("x", "y"),
                            pad_cols: int = 0):
@@ -63,42 +95,66 @@ def exchange_halos_deep_2d(u, k: int, mesh_shape: Tuple[int, int],
     (the Mosaic block kernel needs a lane-aligned width; folding the
     pad here avoids a separate full-block copy).
     """
-    dx, dy = mesh_shape
-    ax, ay = axis_names
-    dt = u.dtype
-    # Phase 1: K-wide column strips along the y axis.
-    halo_w = _shift_down(u[:, -k:], ay, dy)
-    halo_e = _shift_up(u[:, :k], ay, dy)
-    parts = [halo_w.astype(dt), u, halo_e.astype(dt)]
-    if pad_cols:
-        parts.append(jnp.zeros((u.shape[0], pad_cols), dt))
-    uy = jnp.concatenate(parts, axis=1)
-    # Phase 2: K-tall row strips of the *extended* block along x —
-    # these carry the corner data from the diagonal neighbors.
-    halo_n = _shift_down(uy[-k:, :], ax, dx)
-    halo_s = _shift_up(uy[:k, :], ax, dx)
-    return jnp.concatenate([halo_n.astype(dt), uy, halo_s.astype(dt)],
-                           axis=0)
+    lead, halo_n, halo_s = _split_exchange_deep_2d(
+        u, k, mesh_shape, axis_names, pad_cols=pad_cols)
+    return jnp.concatenate([halo_n, lead, halo_s], axis=0)
 
 
-def _inner_mask(padded_shape, k, grid_shape, block_shape, block_index):
-    """Global-interior mask for the padded block's inner region.
+def _region_inner_mask(shape, starts, grid_shape):
+    """Global-interior mask for the inner region of a window whose
+    element ``[0, ..., 0]`` sits at global coordinates ``starts``.
 
-    Inner region = ``padded[1:-1, 1:-1]`` (every cell the stencil can
+    Inner region = ``window[1:-1, ...]`` (every cell the stencil can
     express). Cells outside the global grid, or on its Dirichlet
-    boundary, are masked (held at their current value).
+    boundary, are masked (held at their current value). Shared by the
+    monolithic multistep (window = the full padded block) and the
+    overlapped bulk/band windows, so the two schedules can never mask
+    a cell differently.
     """
-    dims = len(padded_shape)
+    dims = len(shape)
     masks = []
-    for p, n, bs, bi in zip(padded_shape, grid_shape, block_shape,
-                            block_index):
-        idx = bi * bs - k + 1 + jnp.arange(p - 2, dtype=jnp.int32)
+    for p, s, n in zip(shape, starts, grid_shape):
+        idx = s + 1 + jnp.arange(p - 2, dtype=jnp.int32)
         masks.append((idx >= 1) & (idx <= n - 2))
     out = masks[0].reshape(masks[0].shape + (1,) * (dims - 1))
     for d in range(1, dims):
-        shape = (1,) * d + masks[d].shape + (1,) * (dims - 1 - d)
-        out = out & masks[d].reshape(shape)
+        sh = (1,) * d + masks[d].shape + (1,) * (dims - 1 - d)
+        out = out & masks[d].reshape(sh)
     return out
+
+
+def _inner_mask(padded_shape, k, grid_shape, block_shape, block_index):
+    """Global-interior mask for the padded block's inner region."""
+    starts = tuple(bi * bs - k
+                   for bs, bi in zip(block_shape, block_index))
+    return _region_inner_mask(padded_shape, starts, grid_shape)
+
+
+def _frontier_steps(win, k, starts, grid_shape, stencil_interior,
+                    need_diff):
+    """``k`` masked stencil steps on a window under the shrinking-
+    frontier discipline: only the window's inner updates each step, so
+    cells within L1 distance ``k - j`` of the data the window was
+    seeded with stay exact through step ``j`` — the cells the caller
+    slices out. Per-(cell, step) arithmetic is EXACTLY the monolithic
+    ``_block_multistep`` body's (same ops on the same values), which is
+    what makes the overlapped schedule's outputs bitwise the
+    phase-separated ones. ``need_diff`` returns the last step's masked
+    absolute update (the residual quantity) alongside."""
+    dims = win.ndim
+    inner = (slice(1, -1),) * dims
+    mask = _region_inner_mask(win.shape, starts, grid_shape)
+    diff = None
+    for j in range(k):
+        new_inner = stencil_interior(win)
+        cur_inner = win[inner]
+        if need_diff and j == k - 1:
+            diff = jnp.where(mask,
+                             jnp.abs(new_inner - cur_inner.astype(_ACC)),
+                             0.0)
+        upd = jnp.where(mask, new_inner.astype(win.dtype), cur_inner)
+        win = win.at[inner].set(upd)
+    return win, diff
 
 
 def _block_multistep(u, k, exchange, stencil_interior, *, mesh_shape,
@@ -114,36 +170,126 @@ def _block_multistep(u, k, exchange, stencil_interior, *, mesh_shape,
     assert k >= 1
     dims = u.ndim
     block_shape = u.shape
-    inner = (slice(1, -1),) * dims
     core_of_inner = tuple(slice(k - 1, k - 1 + b) for b in block_shape)
     core_of_ext = (slice(k, -k),) * dims
 
     ext = exchange(u, k, mesh_shape, axis_names)
-    mask = _inner_mask(ext.shape, k, grid_shape, block_shape, block_index)
-
-    res = None
-    for j in range(k):
-        new_inner = stencil_interior(ext)
-        cur_inner = ext[inner]
-        if with_residual and j == k - 1:
-            diff = jnp.where(mask, jnp.abs(new_inner - cur_inner.astype(_ACC)),
-                             0.0)[core_of_inner]
-            res = lax.pmax(jnp.max(diff), axis_names)
-        upd = jnp.where(mask, new_inner.astype(ext.dtype), cur_inner)
-        ext = ext.at[inner].set(upd)
-
+    starts = tuple(bi * bs - k
+                   for bs, bi in zip(block_shape, block_index))
+    ext, diff = _frontier_steps(ext, k, starts, grid_shape,
+                                stencil_interior, with_residual)
     core = ext[core_of_ext]
     if with_residual:
-        return core, res
+        return core, lax.pmax(jnp.max(diff[core_of_inner]), axis_names)
+    return core
+
+
+def _block_multistep_deferred(u, k, split_exchange, stencil_interior, *,
+                              mesh_shape, grid_shape, block_index,
+                              axis_names, with_residual):
+    """The overlapped (communication-hiding) K-step round: the same
+    exchange tables and per-cell arithmetic as :func:`_block_multistep`
+    restructured so the LAST exchange phase's ppermutes have no data
+    path into the bulk update (SEMANTICS.md "Overlapped exchange").
+
+    ``split_exchange`` returns ``(lead, halo_top, halo_bot)``: the
+    block extended along every axis except the leading one (all earlier
+    phases arrived), plus the leading-axis strips the final phase
+    permutes. Three windows then advance ``k`` frontier steps each:
+
+    - **bulk** — output slabs ``[k, b0-k)`` of the core, whose K-step
+      dependency cone stays inside ``lead`` (no final-phase halo), so
+      XLA may run the final collective hop concurrently with this, the
+      overwhelming majority of the round's FLOPs (the reference's
+      interior-between-``MPI_Startall``-and-``MPI_Waitall``,
+      ``mpi/...stat.c:160-177``, at depth K);
+    - **top/bottom bands** — output slabs ``[0, k)`` / ``[b0-k, b0)``,
+      the only cells whose cone reaches the permuted strips, computed
+      from a thin ``3k``-slab window once the halos arrive.
+
+    Every (cell, step) value is computed by the same
+    :func:`_frontier_steps` body from the same seed data as the
+    monolithic round, so the spliced core — and the residual, a max of
+    per-cell identical quantities — is bitwise the phase-separated
+    round's (pinned by tests/test_temporal.py). The price is a
+    ``4k``-slab band of redundant compute per round; the caller falls
+    back to the monolithic round when ``b0 < 2k`` (no two disjoint
+    k-bands to defer).
+    """
+    assert k >= 1
+    block_shape = u.shape
+    b0 = block_shape[0]
+    assert b0 >= 2 * k
+    lead, halo_top, halo_bot = split_exchange(u, k, mesh_shape,
+                                              axis_names)
+    # Trailing-axes slices of the final windows (core extent) and of
+    # the window-inner diff arrays (inner index i <-> window index
+    # i+1, core starts at window index k).
+    tail_core = tuple(slice(k, k + b) for b in block_shape[1:])
+    tail_diff = tuple(slice(k - 1, k - 1 + b) for b in block_shape[1:])
+    starts_tail = tuple(bi * bs - k for bs, bi
+                        in zip(block_shape[1:], block_index[1:]))
+    lead0 = block_index[0] * b0
+
+    diffs = []
+    parts = []
+    # Top band: the final phase's received strip + the lead's first 2k
+    # slabs — the K-cone of output slabs [0, k).
+    win_t = jnp.concatenate(
+        [halo_top, lax.slice_in_dim(lead, 0, 2 * k, axis=0)], axis=0)
+    win_t, d_t = _frontier_steps(win_t, k, (lead0 - k,) + starts_tail,
+                                 grid_shape, stencil_interior,
+                                 with_residual)
+    parts.append(win_t[(slice(k, 2 * k),) + tail_core])
+    if with_residual:
+        diffs.append(d_t[(slice(k - 1, 2 * k - 1),) + tail_diff])
+    # Bulk: depends on lead alone (phase-1 data only).
+    if b0 > 2 * k:
+        win_b, d_b = _frontier_steps(lead, k, (lead0,) + starts_tail,
+                                     grid_shape, stencil_interior,
+                                     with_residual)
+        parts.append(win_b[(slice(k, b0 - k),) + tail_core])
+        if with_residual:
+            diffs.append(d_b[(slice(k - 1, b0 - k - 1),) + tail_diff])
+    # Bottom band.
+    win_d = jnp.concatenate(
+        [lax.slice_in_dim(lead, b0 - 2 * k, b0, axis=0), halo_bot],
+        axis=0)
+    win_d, d_d = _frontier_steps(win_d, k,
+                                 (lead0 + b0 - 2 * k,) + starts_tail,
+                                 grid_shape, stencil_interior,
+                                 with_residual)
+    parts.append(win_d[(slice(k, 2 * k),) + tail_core])
+    if with_residual:
+        diffs.append(d_d[(slice(k - 1, 2 * k - 1),) + tail_diff])
+
+    core = jnp.concatenate(parts, axis=0)
+    if with_residual:
+        res = jnp.max(diffs[0])
+        for d in diffs[1:]:
+            res = jnp.maximum(res, jnp.max(d))
+        return core, lax.pmax(res, axis_names)
     return core
 
 
 def block_multistep_2d(u, k: int, *, mesh_shape, grid_shape, block_index,
                        cx, cy, axis_names=("x", "y"),
-                       with_residual: bool = False):
-    """Advance a ``(bx, by)`` block ``k`` steps with ONE halo exchange."""
-    return _block_multistep(
-        u, k, exchange_halos_deep_2d,
+                       with_residual: bool = False,
+                       overlap: bool = False):
+    """Advance a ``(bx, by)`` block ``k`` steps with ONE halo exchange.
+
+    ``overlap`` selects the communication-hiding schedule
+    (:func:`_block_multistep_deferred`: the phase-2 row-strip ppermutes
+    carry no data path into the bulk update) — bitwise identical to the
+    phase-separated round; blocks too short for two disjoint k-bands
+    fall back to the monolithic round.
+    """
+    fn = (_block_multistep_deferred if overlap and u.shape[0] >= 2 * k
+          else _block_multistep)
+    exchange = (_split_exchange_deep_2d if fn is _block_multistep_deferred
+                else exchange_halos_deep_2d)
+    return fn(
+        u, k, exchange,
         lambda ext: stencil_interior_2d(ext, cx, cy),
         mesh_shape=mesh_shape, grid_shape=grid_shape,
         block_index=block_index, axis_names=axis_names,
@@ -151,12 +297,12 @@ def block_multistep_2d(u, k: int, *, mesh_shape, grid_shape, block_index,
     )
 
 
-def exchange_halos_deep_3d(u, k: int, mesh_shape: Tuple[int, int, int],
-                           axis_names: Tuple[str, str, str] = ("x", "y", "z")):
-    """Return the ``(bx+2k, by+2k, bz+2k)`` padded block, edges/corners
-    included — three ppermute phases of two shifts each (6 messages,
-    like the 1-deep face exchange; each later phase sends the already-
-    extended block's strips, so edge and corner data ride along)."""
+def _split_exchange_deep_3d(u, k: int, mesh_shape, axis_names):
+    """The 3D analog of :func:`_split_exchange_deep_2d`: phases z and y
+    assembled into ``lead`` (``(bx, by+2k, bz+2k)``), the final x phase
+    returned apart as the permuted ``(k, by+2k, bz+2k)`` slabs. The
+    x-phase ppermutes read only ``lead``'s edge slabs — the overlapped
+    3D round's bulk never waits on them."""
     dx, dy, dz = mesh_shape
     ax, ay, az = axis_names
     dt = u.dtype
@@ -165,10 +311,21 @@ def exchange_halos_deep_3d(u, k: int, mesh_shape: Tuple[int, int, int],
     u = jnp.concatenate([lo_z.astype(dt), u, hi_z.astype(dt)], axis=2)
     lo_y = _shift_down(u[:, -k:, :], ay, dy)
     hi_y = _shift_up(u[:, :k, :], ay, dy)
-    u = jnp.concatenate([lo_y.astype(dt), u, hi_y.astype(dt)], axis=1)
-    lo_x = _shift_down(u[-k:, :, :], ax, dx)
-    hi_x = _shift_up(u[:k, :, :], ax, dx)
-    return jnp.concatenate([lo_x.astype(dt), u, hi_x.astype(dt)], axis=0)
+    lead = jnp.concatenate([lo_y.astype(dt), u, hi_y.astype(dt)], axis=1)
+    lo_x = _shift_down(lead[-k:, :, :], ax, dx).astype(dt)
+    hi_x = _shift_up(lead[:k, :, :], ax, dx).astype(dt)
+    return lead, lo_x, hi_x
+
+
+def exchange_halos_deep_3d(u, k: int, mesh_shape: Tuple[int, int, int],
+                           axis_names: Tuple[str, str, str] = ("x", "y", "z")):
+    """Return the ``(bx+2k, by+2k, bz+2k)`` padded block, edges/corners
+    included — three ppermute phases of two shifts each (6 messages,
+    like the 1-deep face exchange; each later phase sends the already-
+    extended block's strips, so edge and corner data ride along)."""
+    lead, lo_x, hi_x = _split_exchange_deep_3d(u, k, mesh_shape,
+                                               axis_names)
+    return jnp.concatenate([lo_x, lead, hi_x], axis=0)
 
 
 def exchange_halos_circular_3d(u, k: int, mesh_shape, axis_names,
@@ -276,11 +433,18 @@ def exchange_halos_fused_3d(u, k: int, mesh_shape, axis_names,
 
 def block_multistep_3d(u, k: int, *, mesh_shape, grid_shape, block_index,
                        cx, cy, cz, axis_names=("x", "y", "z"),
-                       with_residual: bool = False):
+                       with_residual: bool = False,
+                       overlap: bool = False):
     """3D analog of :func:`block_multistep_2d` (7-point; the K-step
-    dependency cone is again the L1 ball, covered by the cubic pad)."""
-    return _block_multistep(
-        u, k, exchange_halos_deep_3d,
+    dependency cone is again the L1 ball, covered by the cubic pad).
+    ``overlap`` defers the x-phase ppermutes behind the bulk update,
+    exactly like the 2D round."""
+    fn = (_block_multistep_deferred if overlap and u.shape[0] >= 2 * k
+          else _block_multistep)
+    exchange = (_split_exchange_deep_3d if fn is _block_multistep_deferred
+                else exchange_halos_deep_3d)
+    return fn(
+        u, k, exchange,
         lambda ext: stencil_interior_3d(ext, cx, cy, cz),
         mesh_shape=mesh_shape, grid_shape=grid_shape,
         block_index=block_index, axis_names=axis_names,
@@ -345,7 +509,7 @@ def exchange_halos_fused_2d(u, k: int, mesh_shape, axis_names,
     return tail_arr, halo_n, halo_s
 
 
-def _pallas_round_2d(config, kw):
+def _pallas_round_2d(config, kw, mode: str = "overlap"):
     """Kernel-G round: K-deep exchange + K Mosaic steps, or None.
 
     Available when the round depth equals the dtype's sublane count
@@ -356,6 +520,13 @@ def _pallas_round_2d(config, kw):
     fallbacks — the decision lives in ``ps.pick_block_temporal_2d``
     (shared with explain and the auto-depth probe). ``fn(u, want_res)``
     advances exactly ``config.halo_depth`` steps.
+
+    ``mode`` is the resolved ``halo_overlap`` schedule: ``"phase"``
+    runs the monolithic kernel (every exchange phase serializes before
+    the kernel), anything else prefers the deferred-band overlapped
+    round where it exists. The cross-round ``"pipeline"`` schedule
+    lives in :func:`_pallas_pipeline_2d` (this per-round fn still
+    serves its remainder rounds).
     """
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
@@ -377,8 +548,9 @@ def _pallas_round_2d(config, kw):
                             to="varying")
 
         if kind in ("G-uni", "G-fuse"):
-            deferred = ps.pick_block_temporal_2d_deferred(config,
-                                                          axis_names)
+            deferred = (None if mode == "phase"
+                        else ps.pick_block_temporal_2d_deferred(
+                            config, axis_names))
             if deferred is not None:
                 # Overlapped round (the reference's interior-between-
                 # Startall-and-Waitall at depth K): the bulk kernel
@@ -451,13 +623,15 @@ def _pallas_round_2d(config, kw):
     return fn
 
 
-def _pallas_round_3d(config, kw):
+def _pallas_round_3d(config, kw, mode: str = "overlap"):
     """Kernel-H round: K-deep mixed exchange + K Mosaic steps, or None.
 
     The 3D analog of :func:`_pallas_round_2d` — but with no depth
     constraint beyond geometry (kernel H's X-slab windows are
     alignment-free in the slab dim at any K; see its builder).
     ``fn(u, want_res)`` advances exactly ``config.halo_depth`` steps.
+    ``mode == "phase"`` suppresses the deferred-x-band overlapped
+    round, like the 2D builder.
     """
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
@@ -492,8 +666,9 @@ def _pallas_round_3d(config, kw):
     z_off = _pcast(bi[2] * bz, others(2), to="varying")
 
     if fused:
-        deferred = ps.pick_block_temporal_3d_deferred(config, axis_names,
-                                                      mesh_shape)
+        deferred = (None if mode == "phase"
+                    else ps.pick_block_temporal_3d_deferred(
+                        config, axis_names, mesh_shape))
         if deferred is not None:
             # Overlapped round (3D): the bulk call consumes only the
             # z/y-phase pieces, so the x-phase ppermutes — the third
@@ -548,6 +723,134 @@ def _pallas_round_3d(config, kw):
     return fn
 
 
+def _pallas_pipeline_2d(config, kw):
+    """The double-buffered edge-strip kernel-G round (``halo_overlap=
+    "pipeline"``): ``(start, round_fn)`` or None.
+
+    The deferred round (Level 1) still pays the phase-1 (column)
+    exchange on the critical path: the columns each device sends are
+    computed by the bulk kernel. This round breaks that dependence by
+    computing the next state's k-wide W/E edge strips a SECOND time in
+    a thin panel pass (``ps.pick_block_temporal_2d_pipelined``'s
+    ``panel``: the kernels' shared ``_pinned_stepper`` arithmetic over
+    a 3k-column window, so the duplicated cells are bitwise the bulk
+    kernel's — the ``_pinned_coeffs`` one-site rationale). Round r+1's
+    phase-1 ppermutes then read only round r's panel outputs, and its
+    phase-2 ppermutes only the N/S band kernel's rows plus phase 1 —
+    the ENTIRE next exchange is double-buffered behind round r's bulk
+    kernel. ``start(u)`` is the one phase-separated prologue exchange
+    per chunk entry; ``round_fn(u, tail, hn, hs, want_res, feed_next)``
+    advances K steps and, when ``feed_next``, also returns the next
+    round's already-permuting halo operands.
+
+    Bitwise contract: ``feed_next=False`` is literally the deferred
+    round (same kernels, same splice), and the operands ``feed_next``
+    ships are bitwise the slices ``exchange_halos_fused_2d`` would
+    take of the spliced state — so every neighbor receives identical
+    bytes and the whole run equals the phase-separated schedule bit
+    for bit (pinned by tests/test_temporal.py).
+    """
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    axis_names = tuple(kw["axis_names"])
+    picked = ps.pick_block_temporal_2d_pipelined(config, axis_names)
+    if picked is None:
+        return None
+    bulk, bulk_plain, band, band_plain, tail, panel = picked
+    K = config.halo_depth
+    bx, by = config.block_shape()
+    mesh_shape = kw["mesh_shape"]
+    dx, dy = mesh_shape
+    ax, ay = axis_names
+    block_index = kw["block_index"]
+    row_off = _pcast(block_index[0] * bx, (axis_names[1],),
+                        to="varying")
+    col_off = _pcast(block_index[1] * by, (axis_names[0],),
+                        to="varying")
+    pad = tail - 2 * K
+
+    def start(u):
+        return exchange_halos_fused_2d(u, K, mesh_shape, axis_names,
+                                       tail=tail)
+
+    def round_fn(u, tail_arr, halo_n, halo_s, want_res, feed_next):
+        dt = u.dtype
+        bk = bulk if want_res else bulk_plain
+        bd = band if want_res else band_plain
+        core, res_a = bk(u, tail_arr, row_off, col_off)
+        bands, res_b = bd(u, tail_arr, halo_n, halo_s,
+                          row_off, col_off)
+        new_u = (core.at[:K].set(bands[:K])
+                 .at[bx - K:].set(bands[K:]))
+        if feed_next:
+            # The next state's full-height W/E edge strips: corner
+            # rows from the band kernel, the middle from the panel
+            # pass — bitwise ``new_u[:, :K]`` / ``new_u[:, -K:]``.
+            wmid, emid = panel(u, tail_arr, row_off, col_off)
+            wfull = jnp.concatenate(
+                [bands[:K, :K], wmid, bands[K:, :K]], axis=0)
+            efull = jnp.concatenate(
+                [bands[:K, by - K:], emid, bands[K:, by - K:]], axis=0)
+            # Phase 1 of round r+1 — depends only on band+panel.
+            lo = _shift_down(efull, ay, dy).astype(dt)
+            hi = _shift_up(wfull, ay, dy).astype(dt)
+            parts = [hi] + ([jnp.zeros((bx, pad), dt)] if pad
+                            else []) + [lo]
+            tail_next = jnp.concatenate(parts, axis=1)
+            # Phase 2 — the band rows plus the phase-1 tail, exactly
+            # exchange_halos_fused_2d's strips of the spliced state.
+            top = jnp.concatenate([bands[:K, :], tail_next[:K, :]],
+                                  axis=1)
+            bot = jnp.concatenate([bands[K:, :], tail_next[-K:, :]],
+                                  axis=1)
+            hn_next = _shift_down(bot, ax, dx).astype(dt)
+            hs_next = _shift_up(top, ax, dx).astype(dt)
+            out = (new_u, tail_next, hn_next, hs_next)
+        else:
+            out = new_u
+        if want_res:
+            return out, lax.pmax(jnp.maximum(res_a, res_b), axis_names)
+        return out
+
+    return start, round_fn
+
+
+def resolve_halo_overlap(config, backend: str) -> str:
+    """Resolve ``halo_overlap`` None/"auto" to a concrete schedule —
+    the one decision site shared by the solver driver
+    (``solver._resolved``), the round builders below, and
+    ``solver.explain``, so the reported schedule can never diverge
+    from the built one.
+
+    Auto picks ``"pipeline"`` exactly when the kernel-G pipelined
+    round exists for this geometry (resolved pallas backend, 2D, the
+    y mesh axis actually exchanging) AND the TpuParams ICI model
+    prices the hidden phase-1 exchange above the extra edge-strip
+    compute the pipeline pays (``ps.pipeline_gain_2d``); everything
+    else resolves to ``"overlap"`` — the deferred-band schedule is
+    bitwise-free, so it is never worth declining. Explicit values
+    always win; geometry declines at build time fall back one level
+    silently (the kernel pickers' decline discipline).
+    """
+    mode = config.halo_overlap
+    if mode not in (None, "auto"):
+        return mode
+    mesh_shape = config.mesh_or_unit()
+    depth = config.halo_depth
+    if (backend == "pallas" and config.ndim == 2
+            and depth is not None and depth > 1
+            and mesh_shape[1] > 1):
+        from parallel_heat_tpu.ops import pallas_stencil as ps
+        from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
+        if ps.pick_block_temporal_2d_pipelined(
+                config, AXIS_NAMES[:2]) is not None:
+            hidden, extra = ps.pipeline_gain_2d(config)
+            if hidden > extra:
+                return "pipeline"
+    return "overlap"
+
+
 def block_temporal_multistep(config, kw, backend: str):
     """``(multi_step, multi_step_residual)`` on K-deep exchanges.
 
@@ -561,15 +864,26 @@ def block_temporal_multistep(config, kw, backend: str):
     Mosaic kernel-G path when the backend is pallas and the geometry
     admits (see :func:`_pallas_round_2d`); remainder rounds and
     declined geometries run the jnp rounds — both evaluate the same
-    semantics.
+    semantics. The resolved ``config.halo_overlap`` schedule threads
+    through every round flavor: "phase" forces the phase-separated
+    monolithic rounds, "overlap" the deferred-band rounds (jnp AND
+    Mosaic), "pipeline" the cross-round double-buffered kernel-G
+    schedule — all three bitwise identical (SEMANTICS.md "Overlapped
+    exchange").
     """
     K = config.halo_depth
+    mode = resolve_halo_overlap(config, backend)
+    jnp_overlap = mode != "phase"
     block_fn = (block_multistep_3d if config.ndim == 3
                 else block_multistep_2d)
     pallas_round = None
+    pipe = None
     if backend == "pallas":
-        pallas_round = (_pallas_round_3d(config, kw) if config.ndim == 3
-                        else _pallas_round_2d(config, kw))
+        if config.ndim == 2 and mode == "pipeline":
+            pipe = _pallas_pipeline_2d(config, kw)
+        pallas_round = (_pallas_round_3d(config, kw, mode)
+                        if config.ndim == 3
+                        else _pallas_round_2d(config, kw, mode))
 
     def rounds(u, n, with_residual):
         full, rem = divmod(n, K)
@@ -578,20 +892,40 @@ def block_temporal_multistep(config, kw, backend: str):
         def round_k(uu, depth, want_res):
             if depth == K and pallas_round is not None:
                 return pallas_round(uu, want_res)
-            return block_fn(uu, depth, with_residual=want_res, **kw)
+            return block_fn(uu, depth, with_residual=want_res,
+                            overlap=jnp_overlap, **kw)
 
         # All full rounds except the last run under fori_loop (pure-HLO
         # body: the carry updates in place, no unroll needed).
         last_full_wants_res = with_residual and rem == 0 and full > 0
         plain = full - 1 if full > 0 else 0
-        if plain > 0:
-            u = lax.fori_loop(0, plain,
-                              lambda i, uu: round_k(uu, K, False), u)
-        if full > 0:
+        if pipe is not None and full > 0:
+            # Pipelined (double-buffered edge strip) full rounds: one
+            # prologue exchange, then every fori body computes round
+            # r's bulk WHILE round r+1's exchange — built from the
+            # thin band/panel outputs — is already permuting; the last
+            # full round consumes the final carry without feeding a
+            # next exchange (no wasted collectives).
+            start, p_round = pipe
+            tail_arr, hn, hs = start(u)
+            if plain > 0:
+                u, tail_arr, hn, hs = lax.fori_loop(
+                    0, plain,
+                    lambda i, c: p_round(*c, False, True),
+                    (u, tail_arr, hn, hs))
             if last_full_wants_res:
-                u, out_res = round_k(u, K, True)
+                u, out_res = p_round(u, tail_arr, hn, hs, True, False)
             else:
-                u = round_k(u, K, False)
+                u = p_round(u, tail_arr, hn, hs, False, False)
+        else:
+            if plain > 0:
+                u = lax.fori_loop(0, plain,
+                                  lambda i, uu: round_k(uu, K, False), u)
+            if full > 0:
+                if last_full_wants_res:
+                    u, out_res = round_k(u, K, True)
+                else:
+                    u = round_k(u, K, False)
         if rem:
             if with_residual:
                 u, out_res = round_k(u, rem, True)

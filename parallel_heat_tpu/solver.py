@@ -153,9 +153,10 @@ def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
 
 def _resolved(config: HeatConfig):
     """(config-with-concrete-depth, backend, was_auto) — the one place
-    the None-means-auto depth is substituted, shared by
-    :func:`_build_runner` and :func:`explain` so the reported path can
-    never diverge from the built one."""
+    the None-means-auto depth AND the None-means-auto exchange
+    schedule are substituted, shared by :func:`_build_runner` and
+    :func:`explain` so the reported path can never diverge from the
+    built one."""
     backend = _resolve_backend(config)
     depth = _resolve_halo_depth(config, backend)
     was_auto = config.halo_depth is None
@@ -168,6 +169,16 @@ def _resolved(config: HeatConfig):
         # same bounds an explicit one would (defense in depth against
         # picker bugs like round 4's +1-past-bmin correction).
         config = config.replace(halo_depth=depth).validate()
+    # Exchange schedule (halo_overlap): resolved after the depth — the
+    # pipelined-round probe needs the concrete K. The resolver is
+    # shared with the round builders (temporal.resolve_halo_overlap),
+    # so substituting here only makes the choice visible to explain
+    # and the cache keys; it cannot fork from what the rounds build.
+    from parallel_heat_tpu.parallel.temporal import resolve_halo_overlap
+
+    mode = resolve_halo_overlap(config, backend)
+    if config.halo_overlap != mode:
+        config = config.replace(halo_overlap=mode).validate()
     return config, backend, was_auto
 
 
@@ -380,9 +391,10 @@ def _build_runner(config: HeatConfig):
 
     def local_run(u_local):
         bidx = tuple(lax.axis_index(n) for n in names)
-        # The temporal path has no interior/edge split, so `overlap` is
-        # added only for the per-step paths (same pattern as the 3D
-        # branch above).
+        # The temporal path's comm/compute schedule is halo_overlap
+        # (resolved in config; see temporal.block_temporal_multistep);
+        # the per-step `overlap` interior/edge split is added only for
+        # the per-step paths (same pattern as the 3D branch above).
         kw = dict(mesh_shape=mesh_shape, grid_shape=config.shape,
                   block_index=bidx, cx=config.cx, cy=config.cy,
                   axis_names=names)
@@ -513,6 +525,7 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
     plus the daemon-packing verdict (``ensemble.engine.packable``).
     """
     config = config.validate()
+    auto_overlap = config.halo_overlap in (None, "auto")
     config, backend, auto_depth = _resolved(config)
     mesh_shape = config.mesh_or_unit()
     is_sharded = any(d > 1 for d in mesh_shape)
@@ -554,6 +567,13 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
     if is_sharded:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
+        if config.halo_depth > 1:
+            # The exchange/compute schedule (SEMANTICS.md "Overlapped
+            # exchange") — resolved by the same
+            # temporal.resolve_halo_overlap the rounds build with.
+            out["halo_overlap"] = (f"{config.halo_overlap} (auto)"
+                                   if auto_overlap
+                                   else config.halo_overlap)
     if backend != "pallas":
         if config.accumulate == "f32chunk":
             from parallel_heat_tpu.ops import pallas_stencil as ps
@@ -563,10 +583,20 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
             return out
         out["path"] = "XLA-fused jnp stencil"
         if is_sharded:
+            # Same feasibility check the round builder applies
+            # (block_multistep_*'s b0 >= 2k fallback) — the reported
+            # schedule must match the built one.
+            can_defer = (config.block_shape()[0]
+                         >= 2 * config.halo_depth)
+            deep = ("K-deep temporal exchange rounds"
+                    + (", deferred bands — the last exchange phase's "
+                       "ppermutes overlap the bulk update"
+                       if config.halo_overlap != "phase" and can_defer
+                       else ", phase-separated"))
             out["path"] += (
                 f" on shard blocks (halo_depth={config.halo_depth}: "
-                + ("K-deep temporal exchange rounds"
-                   if config.halo_depth > 1 else "per-step halo exchange")
+                + (deep if config.halo_depth > 1
+                   else "per-step halo exchange")
                 + ")")
         return out
 
@@ -585,15 +615,24 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
                 kind, built, _ = ps.pick_block_temporal_2d(
                     config, AXIS_NAMES[:2])
                 if kind in ("G-uni", "G-fuse"):
-                    overl = ps.pick_block_temporal_2d_deferred(
-                        config, AXIS_NAMES[:2]) is not None
+                    sched = ""
+                    if (config.halo_overlap == "pipeline"
+                            and ps.pick_block_temporal_2d_pipelined(
+                                config, AXIS_NAMES[:2]) is not None):
+                        sched = (", pipelined double-buffered edge "
+                                 "strips — the next round's ppermutes "
+                                 "(both phases) overlap the bulk "
+                                 "kernel")
+                    elif (config.halo_overlap != "phase"
+                          and ps.pick_block_temporal_2d_deferred(
+                              config, AXIS_NAMES[:2]) is not None):
+                        sched = (", deferred N/S bands — phase-2 "
+                                 "ppermutes overlap the bulk kernel")
                     layout = ("uniform-window fused"
                               if kind == "G-uni" else "fused")
                     out["path"] = (
                         f"kernel G (shard-block temporal, K={sub}, "
-                        f"{layout} exchange assembly"
-                        + (", deferred N/S bands — phase-2 ppermutes "
-                           "overlap the bulk kernel" if overl else "")
+                        f"{layout} exchange assembly" + sched
                         + f") per exchange round, tail {built.tail}")
                     return out
                 if kind == "G-circ":
@@ -616,8 +655,11 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
                          config.shape, K, halos, AXIS_NAMES[:3])
                 built = ps._build_temporal_block_3d_fused(*args3)
                 label = "fused exchange assembly"
-                if built is not None and ps.pick_block_temporal_3d_deferred(
-                        config, AXIS_NAMES[:3], mesh_shape) is not None:
+                if (built is not None
+                        and config.halo_overlap != "phase"
+                        and ps.pick_block_temporal_3d_deferred(
+                            config, AXIS_NAMES[:3], mesh_shape)
+                        is not None):
                     label += (", deferred x bands — phase-3 ppermutes "
                               "overlap the bulk kernel")
                 if built is None:

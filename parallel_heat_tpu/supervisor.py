@@ -382,6 +382,8 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
         parts.append("--mesh " + ",".join(map(str, mesh)))
     if config.halo_depth is not None:
         parts.append(f"--halo-depth {config.halo_depth}")
+    if config.halo_overlap not in (None, "auto"):
+        parts.append(f"--halo-overlap {config.halo_overlap}")
     if not config.overlap:
         parts.append("--no-overlap")
     if config.accumulate != "storage":

@@ -435,7 +435,8 @@ class Telemetry:
     def chunk(self, *, step: int, steps: int, wall_s: float, cells: int,
               bytes_per_cell: int, residual=None, converged=None,
               finite=None, gap_s=None, dispatch_s=None,
-              drain_wait_s=None, observe_s=None) -> None:
+              drain_wait_s=None, observe_s=None,
+              exchange_s=None) -> None:
         """Emit one per-chunk progress event. ``step`` is absolute
         (``step_offset`` already applied by the caller or applied here
         via the offset the supervisor set); rates come from
@@ -453,7 +454,15 @@ class Telemetry:
         device-bound signal: ~0 everywhere means the host, not the
         device, is the bottleneck); ``observe_s`` — host time spent on
         this chunk's observers after completion. ``tools/
-        metrics_report.py``'s pipeline section aggregates these."""
+        metrics_report.py``'s pipeline section aggregates these.
+
+        ``exchange_s`` — halo-exchange wall attributed to this chunk's
+        critical path, when the producer measured it (the scaling
+        study's standalone timing of the exchange ops inside the
+        ``heat_halo_exchange_*`` named scopes, or a profiler-derived
+        import); ``metrics_report`` turns it into the gateable
+        ``exchange_share`` metric. Never measured by ``solve_stream``
+        itself — the exchange lives inside the compiled chunk."""
         from parallel_heat_tpu.utils.profiling import StepStats
 
         if wall_s > 0:
@@ -468,7 +477,8 @@ class Telemetry:
         timing = {k: v for k, v in (("gap_s", gap_s),
                                     ("dispatch_s", dispatch_s),
                                     ("drain_wait_s", drain_wait_s),
-                                    ("observe_s", observe_s))
+                                    ("observe_s", observe_s),
+                                    ("exchange_s", exchange_s))
                   if v is not None}
         self.emit("chunk", step=self.step_offset + step, steps=steps,
                   wall_s=wall_s, cells=cells,

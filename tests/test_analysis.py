@@ -795,6 +795,31 @@ def test_hl3xx_real_solver_programs_clean():
     assert audit_spmd() == []
 
 
+def test_audit_targets_cover_overlap_schedules():
+    """The default target matrix pins every halo_overlap schedule
+    into the temporal families (SEMANTICS.md "Overlapped exchange"):
+    HL301 audits the overlapped/pipelined programs' ppermute tables
+    and HL302's cross-variant rule proves the schedules of one
+    geometry exchange IDENTICAL tables — a schedule that permuted
+    differently would fail lint before it could deadlock a mixed
+    deployment."""
+    from parallel_heat_tpu.analysis.spmd import default_spmd_targets
+
+    targets, _skips = default_spmd_targets()
+    fams = {}
+    for t in targets:
+        fams.setdefault(t.family, set()).add(t.variant)
+    # jnp 2D temporal: the auto (overlap) variants + the phase pin.
+    assert {"fixed", "converge", "fixed-phase"} <= \
+        fams["jnp-2d-temporal"]
+    # kernel G: auto resolves to the pipelined round, and the
+    # deferred + phase spellings ride the same family.
+    assert {"fixed", "fixed-overlap", "fixed-phase"} <= \
+        fams["pallas-2d-temporal"]
+    # 3D deferred-x rounds vs phase-separated.
+    assert {"fixed", "fixed-phase"} <= fams["jnp-3d-temporal"]
+
+
 # ---------------------------------------------------------------------------
 # HL302 collective divergence
 # ---------------------------------------------------------------------------

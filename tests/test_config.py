@@ -39,11 +39,25 @@ def test_3d_shape():
         dict(mesh_shape=(3,)),          # rank mismatch
         dict(nx=20, mesh_shape=(3, 1)),  # 20 % 3 != 0
         dict(mesh_shape=(0, 1)),
+        dict(halo_overlap="async"),      # not a schedule name
     ],
 )
 def test_validation_rejects(kw):
     with pytest.raises(ValueError):
         HeatConfig(**kw).validate()
+
+
+def test_halo_overlap_values_validate():
+    # Every schedule spelling validates, on sharded and unsharded
+    # configs alike (inert unsharded — like `overlap`).
+    for v in (None, "auto", "phase", "overlap", "pipeline"):
+        HeatConfig(halo_overlap=v).validate()
+        HeatConfig(nx=32, ny=32, mesh_shape=(2, 2), halo_depth=4,
+                   halo_overlap=v).validate()
+    # and the field is classified SEMANTIC (HL101's partition)
+    from parallel_heat_tpu.config import SEMANTIC_FIELDS
+
+    assert "halo_overlap" in SEMANTIC_FIELDS
 
 
 def test_json_roundtrip():

@@ -166,6 +166,63 @@ print("WORKER-STATIC-OK", pid, flush=True)
 """
 
 
+_WORKER_OVERLAP = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+from parallel_heat_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(4)
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+from parallel_heat_tpu import HeatConfig, solve
+from parallel_heat_tpu.parallel.distributed import gather_to_host
+
+assert len(jax.devices()) == 8, jax.devices()
+
+# Overlapped vs phase-separated jnp deep rounds across a REAL gloo
+# boundary: the deferred phase-2 ppermutes cross DCN and must deliver
+# byte-identical halos — both schedules bitwise the single-device
+# oracle, on fixed (with a remainder round) AND converge modes.
+kw = dict(nx=32, ny=32, backend="jnp", mesh_shape=(2, 4), halo_depth=5)
+for mode_kw in (dict(steps=23),
+                dict(steps=400, converge=True, check_interval=10,
+                     eps=1e-6)):
+    oracle = solve(HeatConfig(nx=32, ny=32, backend="jnp",
+                              **mode_kw)).to_numpy()
+    ph = solve(HeatConfig(**kw, halo_overlap="phase", **mode_kw))
+    ov = solve(HeatConfig(**kw, halo_overlap="overlap", **mode_kw))
+    assert ph.steps_run == ov.steps_run
+    assert np.array_equal(np.asarray(gather_to_host(ph.grid)), oracle)
+    assert np.array_equal(np.asarray(gather_to_host(ov.grid)), oracle)
+
+# Kernel-G pipelined (double-buffered edge strip) round across the
+# boundary: round r+1's exchange operands — band/panel outputs — ride
+# gloo while round r's bulk computes; must be bitwise the
+# phase-separated Mosaic round and match the oracle to the usual
+# stencil-reassociation tolerance.
+pal = dict(nx=32, ny=32, steps=24, backend="pallas", mesh_shape=(2, 4),
+           halo_depth=8)
+pp = solve(HeatConfig(**pal, halo_overlap="pipeline"))
+pg = solve(HeatConfig(**pal, halo_overlap="phase"))
+got_pp = np.asarray(gather_to_host(pp.grid))
+got_pg = np.asarray(gather_to_host(pg.grid))
+assert np.array_equal(got_pp, got_pg), \\
+    "pipelined != phase-separated across the process boundary"
+oracle_p = solve(HeatConfig(nx=32, ny=32, steps=24)).to_numpy()
+np.testing.assert_allclose(got_pp.astype(np.float64),
+                           oracle_p.astype(np.float64),
+                           rtol=1e-4, atol=1e-2)
+print("WORKER-OVERLAP-OK", pid, flush=True)
+"""
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -285,6 +342,34 @@ def test_mp_peer_lost_bounded_detection_elastic_resume(tmp_path):
     assert row["detect_bounded_ok"] and row["peer_lost_event_ok"]
     assert row["elastic_cmd_ok"] and row["resume_exit_ok"]
     assert row["bitwise_match"] and row["resumed_steps"] == 60
+
+
+@pytest.mark.slow
+def test_two_process_overlap_schedules_bitwise(tmp_path):
+    """Overlapped-exchange parity on a REAL 2-process gloo boundary
+    (SEMANTICS.md "Overlapped exchange"): the deferred jnp rounds
+    (fixed with remainder + converge) are bitwise the single-device
+    oracle AND their phase-separated twins, and the kernel-G pipelined
+    round is bitwise its phase-separated twin — the double-buffered
+    exchange operands cross DCN and must deliver identical bytes.
+    Marked slow (two jax.distributed runtimes — the tier-1 870s
+    budget cannot absorb them); CI's mp-smoke job covers the same
+    contract via the mp_overlap_parity chaos cell."""
+    worker = tmp_path / "worker_overlap.py"
+    worker.write_text(_WORKER_OVERLAP.format(repo=REPO))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    for attempt in range(3):
+        port = str(_free_port())
+        procs, outs = _run_workers(worker, port, env, tmp_path)
+        if attempt < 2 and any(p.returncode != 0 for p in procs) \
+                and any("already in use" in o.lower()
+                        or "address in use" in o.lower() for o in outs):
+            continue
+        break
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER-OVERLAP-OK {i}" in out
 
 
 def test_two_process_static_proof_matches_dynamic_parity(tmp_path):

@@ -418,6 +418,236 @@ def test_overlap_bulk_kernel_independent_of_phase2_ppermutes():
         "band kernel should be the phase-2 consumer"
 
 
+def test_halo_overlap_schedules_bitwise_2d():
+    # The Overlapped-exchange contract (SEMANTICS.md): phase /
+    # overlap schedules of the jnp deep rounds are bitwise the
+    # single-device run — fixed with a remainder round, plus bf16
+    # storage rounding — on a mesh with both axes sharded.
+    for kw in (dict(steps=13), dict(steps=17, dtype="bfloat16")):
+        want = _want(32, 32, **kw)
+        for sched in ("phase", "overlap"):
+            got = solve(HeatConfig(nx=32, ny=32, backend="jnp",
+                                   mesh_shape=(2, 4), halo_depth=4,
+                                   halo_overlap=sched, **kw)).to_numpy()
+            np.testing.assert_array_equal(got, want, err_msg=sched)
+
+
+def test_halo_overlap_schedules_bitwise_2d_converge():
+    kw = dict(steps=400, converge=True, check_interval=20, eps=1e-6)
+    want = solve(HeatConfig(nx=24, ny=24, backend="jnp", **kw))
+    for sched in ("phase", "overlap"):
+        got = solve(HeatConfig(nx=24, ny=24, backend="jnp",
+                               mesh_shape=(2, 2), halo_depth=8,
+                               halo_overlap=sched, **kw))
+        assert got.steps_run == want.steps_run
+        assert got.residual == want.residual
+        np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_halo_overlap_schedules_bitwise_3d():
+    want = solve(HeatConfig(nx=12, ny=12, nz=16, steps=7,
+                            backend="jnp")).to_numpy()
+    for mesh in ((2, 2, 2), (2, 1, 2)):
+        for sched in ("phase", "overlap"):
+            got = solve(HeatConfig(nx=12, ny=12, nz=16, steps=7,
+                                   backend="jnp", mesh_shape=mesh,
+                                   halo_depth=3,
+                                   halo_overlap=sched)).to_numpy()
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"{mesh} {sched}")
+
+
+def test_halo_overlap_short_block_falls_back_bitwise():
+    # b0 < 2k: no two disjoint k-bands — the deferred round must fall
+    # back to the monolithic one (not slice garbage) and stay bitwise.
+    want = _want(16, 16, steps=13)
+    got = solve(HeatConfig(nx=16, ny=16, steps=13, backend="jnp",
+                           mesh_shape=(2, 2), halo_depth=8,
+                           halo_overlap="overlap")).to_numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_pipeline_schedule_bitwise():
+    # Kernel-G schedule triple: phase-separated, deferred-band, and
+    # pipelined double-buffered rounds are bitwise identical (the
+    # pipelined round's exchanged edge strips are the band/panel
+    # recomputation of exactly the bytes the other schedules slice
+    # from the assembled state), fixed AND converge.
+    kwp = dict(nx=32, ny=32, backend="pallas", mesh_shape=(2, 2),
+               halo_depth=8)
+    for kw in (dict(steps=24),
+               dict(steps=200, converge=True, check_interval=20,
+                    eps=1e-6)):
+        outs = {}
+        for sched in ("phase", "overlap", "pipeline"):
+            r = solve(HeatConfig(**kwp, halo_overlap=sched, **kw))
+            outs[sched] = r
+        assert (outs["phase"].steps_run == outs["overlap"].steps_run
+                == outs["pipeline"].steps_run)
+        np.testing.assert_array_equal(outs["phase"].to_numpy(),
+                                      outs["overlap"].to_numpy())
+        np.testing.assert_array_equal(outs["overlap"].to_numpy(),
+                                      outs["pipeline"].to_numpy())
+    # and the oracle stays within the usual reassociation tolerance
+    want = _want(32, 32, steps=24)
+    np.testing.assert_allclose(
+        solve(HeatConfig(**kwp, halo_overlap="pipeline",
+                         steps=24)).to_numpy(),
+        want, rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_pipeline_bf16_and_f32chunk_inert():
+    # bf16 pipelined round (K=16, the other sublane depth): bitwise
+    # its phase-separated twin.
+    kwp = dict(nx=64, ny=64, steps=17, dtype="bfloat16",
+               backend="pallas", mesh_shape=(2, 2), halo_depth=16)
+    a = solve(HeatConfig(**kwp, halo_overlap="phase")).to_numpy()
+    b = solve(HeatConfig(**kwp, halo_overlap="pipeline")).to_numpy()
+    np.testing.assert_array_equal(a, b)
+    # f32chunk is single-device by contract, so the schedule flag is
+    # inert there — every spelling validates and produces identical
+    # bits (the f32chunk rounding chains untouched).
+    kwf = dict(nx=32, ny=32, steps=37, dtype="bfloat16",
+               accumulate="f32chunk", backend="jnp")
+    want = solve(HeatConfig(**kwf)).to_numpy()
+    for sched in ("phase", "overlap", "pipeline"):
+        got = solve(HeatConfig(**kwf, halo_overlap=sched)).to_numpy()
+        np.testing.assert_array_equal(got, want, err_msg=sched)
+
+
+def test_resolve_halo_overlap_matrix():
+    """Pin the halo_overlap=None/'auto' resolution: pipeline exactly
+    when the kernel-G pipelined round exists (pallas, 2D, sharded y
+    axis, geometry admits) and the ICI model prices a win; overlap
+    everywhere else; explicit values win."""
+    from parallel_heat_tpu.parallel.temporal import resolve_halo_overlap
+
+    r = resolve_halo_overlap
+    # pallas + both-axes mesh + admitting geometry -> pipeline
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=8),
+             "pallas") == "pipeline"
+    # jnp rounds: the deferred schedule (no pipelined jnp round)
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=4),
+             "jnp") == "overlap"
+    # y axis unsharded: phase 1 exchanges nothing — nothing to hide
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(4, 1), halo_depth=8),
+             "pallas") == "overlap"
+    # explicit always wins
+    assert r(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2), halo_depth=8,
+                        halo_overlap="phase"), "pallas") == "phase"
+    # depth-1 / unsharded: inert, resolves to overlap
+    assert r(HeatConfig(nx=64, ny=64, halo_depth=1), "pallas") \
+        == "overlap"
+
+
+def test_explain_reports_halo_overlap_schedule():
+    from parallel_heat_tpu.solver import explain
+
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="pallas"))
+    assert out["halo_overlap"] == "pipeline (auto)"
+    assert "pipelined double-buffered edge strips" in out["path"]
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="pallas", halo_overlap="overlap"))
+    assert out["halo_overlap"] == "overlap"
+    assert "deferred N/S bands" in out["path"]
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="pallas", halo_overlap="phase"))
+    assert "deferred" not in out["path"] \
+        and "pipelined" not in out["path"]
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="jnp", halo_depth=4))
+    assert "deferred bands" in out["path"]
+    # depth-1 sharded configs carry no schedule row (inert there)
+    out = explain(HeatConfig(nx=64, ny=64, mesh_shape=(2, 2),
+                             backend="jnp"))
+    assert "halo_overlap" not in out
+
+
+def test_overlap_bulk_independent_of_phase2_ppermutes_jnp():
+    # The jnp deferred round's dataflow proof (the pallas twin lives
+    # in test_overlap_bulk_kernel_independent_of_phase2_ppermutes):
+    # the bulk window's K steps must have NO ancestor among the
+    # phase-2 (row strip) ppermutes — those are exactly the ppermutes
+    # that depend on another ppermute — while the band windows must
+    # consume them.
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_heat_tpu.parallel import temporal as tp
+    from parallel_heat_tpu.parallel.mesh import make_heat_mesh
+    from parallel_heat_tpu.utils.compat import shard_map as _shard_map
+
+    mesh = make_heat_mesh((2, 2))
+    names = mesh.axis_names
+
+    def local_round(u):
+        bidx = (lax.axis_index("x"), lax.axis_index("y"))
+        return tp.block_multistep_2d(
+            u, 4, mesh_shape=(2, 2), grid_shape=(32, 32),
+            block_index=bidx, cx=0.1, cy=0.1, axis_names=names,
+            overlap=True)
+
+    f = _shard_map(local_round, mesh=mesh, in_specs=P(*names),
+                   out_specs=P(*names))
+    jx = jax.make_jaxpr(f)(jnp.zeros((32, 32), jnp.float32))
+    levels = [lv for lv in _flat_jaxpr_levels(jx.jaxpr)
+              if any(e.primitive.name == "ppermute" for e in lv.eqns)]
+    assert levels, "no ppermutes found in the traced round"
+    body = levels[0]
+    perms = [i for i, e in enumerate(body.eqns)
+             if e.primitive.name == "ppermute"]
+    assert len(perms) == 4
+    phase2 = {i for i in perms
+              if any(a in perms for a in _ancestor_eqns(body,
+                                                        body.eqns[i]))}
+    assert len(phase2) == 2  # the row strips depend on the tail
+    # The final concatenate assembles (top band, bulk, bottom band);
+    # its middle operand is the bulk slice (the lead assembly is also
+    # 3-ary but wider than the block, so the shape filter is exact).
+    concats = [e for e in body.eqns
+               if e.primitive.name == "concatenate"
+               and len(e.invars) == 3
+               and e.outvars[0].aval.shape == (16, 16)]
+    assert concats, "deferred round's core assembly not found"
+    asm = concats[-1]
+    prod = {v: i for i, e in enumerate(body.eqns) for v in e.outvars}
+    bulk_eqn = body.eqns[prod[asm.invars[1]]]
+    band_eqn_t = body.eqns[prod[asm.invars[0]]]
+    assert not (phase2 & _ancestor_eqns(body, bulk_eqn)), \
+        "bulk window depends on phase-2 ppermutes: no overlap possible"
+    assert phase2 & _ancestor_eqns(body, band_eqn_t), \
+        "band window should be the phase-2 consumer"
+
+
+def test_halo_overlap_observation_fields_share_compiled_programs():
+    """The acceptance pin: flipping observation-only fields on an
+    overlapped-schedule config causes ZERO new _build_runner entries
+    (the guard/diag/pipeline strip applies before the schedule-keyed
+    lookup), and the observed grids stay bitwise."""
+    from parallel_heat_tpu import solver as slv
+    from parallel_heat_tpu.solver import solve_stream
+
+    cfg = HeatConfig(nx=32, ny=32, steps=24, backend="jnp",
+                     mesh_shape=(2, 2), halo_depth=4,
+                     halo_overlap="overlap")
+    base = None
+    for base in solve_stream(cfg, chunk_steps=12):
+        base_grid = base.to_numpy()
+    misses0 = slv._build_runner.cache_info().misses
+    obs = cfg.replace(guard_interval=6, diag_interval=12,
+                      pipeline_depth=1)
+    last = None
+    for last in solve_stream(obs, chunk_steps=12):
+        last_grid = last.to_numpy()
+    assert slv._build_runner.cache_info().misses == misses0, \
+        "observation-only fields forked the overlapped-schedule cache"
+    assert last.finite is True and last.diagnostics is not None
+    np.testing.assert_array_equal(last_grid, base_grid)
+
+
 def test_kernel_g_circular_diverging_boundary_exact():
     import warnings
 

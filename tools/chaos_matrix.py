@@ -325,7 +325,7 @@ SERVICE_FAULTS = ("svc_worker_sigkill", "svc_daemon_restart",
 # 8-device global mesh through jax.distributed.initialize, so the
 # consensus verdicts, two-phase commits and dead-peer detection cross
 # a TRUE process boundary.
-MP_FAULTS = ("mp_split_brain", "mp_peer_lost")
+MP_FAULTS = ("mp_split_brain", "mp_peer_lost", "mp_overlap_parity")
 
 
 # ---------------------------------------------------------------------------
@@ -470,6 +470,43 @@ elif cell == "mp_peer_lost":
     import os as _os
 
     _os._exit(0)
+
+elif cell == "mp_overlap_parity":
+    # The overlapped exchange schedule (SEMANTICS.md "Overlapped
+    # exchange") across a REAL 2-process gloo boundary: (1) a full
+    # overlapped deep-halo solve must be bitwise the single-process
+    # oracle — the deferred phase-2 ppermutes cross DCN and must
+    # deliver identical bytes; (2) the PR-10 distributed-supervision
+    # contract must survive the new schedule — rank 1 SIGKILLs itself
+    # mid-run, rank 0's bounded barrier detects the corpse, journals
+    # peer_lost, and prints an elastic resume command that carries
+    # the overlapped schedule flag.
+    ocfg = cfg.replace(halo_depth=5, halo_overlap="overlap")
+    res = solve(ocfg)
+    full = np.asarray(gather_to_host(res.grid))
+    oracle = solve(HeatConfig(**kw)).to_numpy()
+    bit_ok = bool((full == oracle).all())
+    t0 = time.monotonic()
+    tel = Telemetry("mp_tel.jsonl")
+    sres = run_supervised(ocfg, "mp_ck",
+                          policy=policy(barrier_timeout_s=5.0),
+                          faults=FaultPlan(kill_process_at_chunk=3,
+                                           only_process=1),
+                          telemetry=tel)
+    tel.close()
+    assert pid == 0, "rank 1 must have been SIGKILLed before this"
+    assert sres.interrupted and sres.signal_name == "peer_lost", \\
+        (sres.interrupted, sres.signal_name)
+    with open("mp_overlap_res.json", "w") as f:
+        json.dump({{"bitwise_pre": bit_ok,
+                   "resume_command": sres.resume_command,
+                   "wall_s": time.monotonic() - t0,
+                   "steps_done": sres.steps_done}}, f)
+    print("MP-OVERLAP-OK", pid, flush=True)
+    sys.stdout.flush()
+    import os as _os
+
+    _os._exit(0)  # same atexit-shutdown skip as mp_peer_lost
 
 else:
     raise SystemExit("unknown cell " + cell)
@@ -633,6 +670,68 @@ def run_mp_cell(fault, workdir):
                                   "consensus_events_ok",
                                   "same_rollback_generation_ok"))
         row["outcome"] = "recovered" if ok else "violation"
+        return row
+
+    if fault == "mp_overlap_parity":
+        import shlex
+        import subprocess
+
+        procs, outs = _mp_spawn_workers(fault, root)
+        row["rank1_sigkilled_ok"] = \
+            procs[1].returncode == -signal.SIGKILL
+        row["rank0_ok"] = (procs[0].returncode == 0
+                           and "MP-OVERLAP-OK 0" in outs[0])
+        if not (row["rank0_ok"] and row["rank1_sigkilled_ok"]):
+            row["outcome"] = "violation"
+            row["worker_logs"] = [o[-2000:] for o in outs]
+            return row
+        res = json.load(open(os.path.join(root, "mp_overlap_res.json")))
+        # The overlapped schedule's cross-boundary solve was bitwise
+        # the single-device oracle BEFORE any fault.
+        row["bitwise_pre_ok"] = bool(res["bitwise_pre"])
+        cmd = res["resume_command"]
+        row["resume_command"] = cmd
+        # The printed elastic command must keep the overlapped
+        # schedule AND target a mesh the surviving host can build.
+        row["overlap_cmd_ok"] = ("--halo-overlap overlap" in cmd
+                                 and "--mesh 2,2" in cmd
+                                 and "--resume auto" in cmd)
+        ev = _mp_events(os.path.join(root, "mp_tel.p0.jsonl"))
+        lost = [e for e in ev if e["event"] == "peer_lost"]
+        row["peer_lost_event_ok"] = bool(lost) \
+            and lost[0].get("lost") == [1]
+        row["detect_bounded_ok"] = bool(lost) and (
+            lost[0]["waited_s"] <= lost[0]["timeout_s"] + 3.0)
+        argv = shlex.split(cmd)
+        assert argv[0] == "python"
+        argv[0] = sys.executable
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = (_mp_repo_root() + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        resume = subprocess.run(argv, cwd=root, env=env,
+                                capture_output=True, text=True,
+                                timeout=300)
+        row["resume_exit_ok"] = resume.returncode == 0
+        from parallel_heat_tpu import HeatConfig as _HC
+        from parallel_heat_tpu.utils.checkpoint import (
+            latest_checkpoint, load_checkpoint)
+
+        cfg = _HC(**kw)
+        src = latest_checkpoint(os.path.join(root, "mp_ck"))
+        grid, step, _ = load_checkpoint(src, cfg)
+        row["resumed_steps"] = int(step)
+        row["bitwise_match"] = bool(
+            step == kw["steps"]
+            and (np.asarray(grid) == oracle.to_numpy()).all())
+        ok = all(row[k] for k in ("bitwise_pre_ok", "overlap_cmd_ok",
+                                  "peer_lost_event_ok",
+                                  "detect_bounded_ok", "resume_exit_ok",
+                                  "bitwise_match"))
+        row["outcome"] = "recovered" if ok else "violation"
+        if not ok:
+            row["resume_log"] = (resume.stdout + resume.stderr)[-2000:]
         return row
 
     if fault == "mp_peer_lost":
@@ -1064,6 +1163,15 @@ def main():
                          "elastic_cmd_ok", "peer_lost_event_ok",
                          "detect_bounded_ok", "resume_exit_ok",
                          "bitwise_match"),
+        # The overlapped-exchange schedule across a real process
+        # boundary: bitwise parity pre-fault, then the supervisor
+        # contract (bounded dead-peer detection + elastic resume
+        # carrying the schedule flag) surviving the new schedule.
+        "mp_overlap_parity": ("rank0_ok", "rank1_sigkilled_ok",
+                              "bitwise_pre_ok", "overlap_cmd_ok",
+                              "peer_lost_event_ok",
+                              "detect_bounded_ok", "resume_exit_ok",
+                              "bitwise_match"),
     }
     by_fault = {r["fault"]: r for r in rows}
     OUTCOME = {"nan_recurring": "halted", "unstable": "halted",
@@ -1075,7 +1183,8 @@ def main():
                "svc_daemon_restart": "recovered",
                "svc_overload": "rejected+served",
                "mp_split_brain": "recovered",
-               "mp_peer_lost": "recovered"}
+               "mp_peer_lost": "recovered",
+               "mp_overlap_parity": "recovered"}
     # Gate only the cells that RAN (--mp-only runs two, the default
     # matrix the rest): for every present cell the named measurements
     # must exist AND hold — an absent check is a failure, not a pass.

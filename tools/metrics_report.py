@@ -128,12 +128,20 @@ def parse_fail_on(spec):
     return events, ceilings, floors
 
 
+# Shorthand metric names accepted anywhere a dotted path is (the
+# --fail-on grammar and slo_gate specs — resolve_metric is the one
+# resolution site both share). `busy` is NOT here: its per-rank
+# floor semantics live in the gating loops.
+_METRIC_ALIASES = {"exchange_share": "chunks.exchange_share"}
+
+
 def resolve_metric(doc, name):
     """Dotted-path lookup -> ``(exists, value)``, distinguishing an
     ABSENT path (a misspelled counter — callers should be loud) from a
     present-but-None metric (legitimately unmeasured yet — e.g. a
     queue-wait percentile before the first dispatch; a threshold on it
     passes). Booleans and other non-numbers count as absent."""
+    name = _METRIC_ALIASES.get(name, name)
     cur = doc
     for part in name.split("."):
         if not isinstance(cur, dict) or part not in cur:
@@ -306,6 +314,23 @@ def summarize(events, outlier_mult=5.0):
             "guard_bad": sum(1 for c in chunks
                              if c.get("finite") is False),
         }
+        # Halo-exchange share (sharded runs whose producer measured
+        # the critical-path exchange wall — the scaling study's
+        # standalone timing of the heat_halo_exchange_* named-scope
+        # ops, or a profiler import): exchange seconds over chunk
+        # seconds, the CI-gateable quantity the overlapped schedules
+        # exist to shrink (`--fail-on 'exchange_share>X'`).
+        measured = [c for c in chunks
+                    if isinstance(c.get("exchange_s"), (int, float))]
+        if measured:
+            # Share over the SAME chunks that carry the measurement —
+            # a stream mixing measured and plain chunks must not
+            # dilute the gated ratio toward zero.
+            exch_total = sum(c["exchange_s"] for c in measured)
+            wall_meas = sum(c.get("wall_s", 0.0) for c in measured)
+            doc["chunks"]["exchange_s_total"] = exch_total
+            doc["chunks"]["exchange_share"] = (
+                exch_total / wall_meas if wall_meas > 0 else None)
 
     # Convergence trajectory: chunk residuals (converge mode) + the
     # diagnostics samples (--diag-interval). Same defensive-field rule
@@ -709,6 +734,10 @@ def render_text(doc):
         if c["guard_checked"]:
             out.append(f"guard: {c['guard_checked']} chunk verdicts, "
                        f"{c['guard_bad']} non-finite")
+        if c.get("exchange_share") is not None:
+            out.append(f"halo exchange: {c['exchange_s_total']:.4f}s "
+                       f"critical-path wall "
+                       f"({c['exchange_share']:.1%} of chunk wall)")
     cv = doc.get("convergence")
     if cv:
         if "residual_first" in cv:
