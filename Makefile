@@ -29,7 +29,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        ensemble-smoke trace-smoke bench clean
+        ensemble-smoke trace-smoke cache-smoke bench clean
 
 all: heat
 
@@ -231,13 +231,13 @@ trace-smoke:
 	    --queue .trace_smoke/q --slots 2 --poll-interval 0.1 \
 	    --max-seconds 300 >/dev/null & \
 	DPID=$$!; trap 'kill $$DPID 2>/dev/null || true' EXIT; \
-	SUB="--queue .trace_smoke/q --nx 16 --ny 16 --steps 60 \
+	SUB="--queue .trace_smoke/q --nx 16 --ny 16 \
 	    --checkpoint-every 20 --accept-timeout 120 --wait \
 	    --timeout 180 --quiet"; \
 	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
-	    --job-id trace-a; \
+	    --steps 60 --job-id trace-a; \
 	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
-	    --job-id trace-b; \
+	    --steps 120 --job-id trace-b; \
 	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu drain \
 	    --queue .trace_smoke/q; \
 	rc=0; wait $$DPID || rc=$$?; \
@@ -257,6 +257,54 @@ trace-smoke:
 	    --stream 'permanent_failure,guard_trip' \
 	    .trace_smoke/q '.trace_smoke/q/telemetry/*.jsonl'
 	rm -rf .trace_smoke
+
+# Result-cache run-book as a gate (SEMANTICS.md "Cache soundness"):
+# daemon up, the same spec submitted twice plus one 2x-budget prefix
+# extension. The journal must show exactly one full-solve dispatch,
+# one exact cache hit with ZERO dispatches for the warm job, one
+# prefix resume (second dispatch, resumed at the donor's final
+# generation), three completions, and zero durability anomalies —
+# heatq --check audits the cache index alongside the job journal.
+cache-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .cache_smoke && mkdir -p .cache_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu serve \
+	    --queue .cache_smoke/q --slots 2 --poll-interval 0.1 \
+	    --max-seconds 300 >/dev/null & \
+	DPID=$$!; trap 'kill $$DPID 2>/dev/null || true' EXIT; \
+	SUB="--queue .cache_smoke/q --nx 16 --ny 16 \
+	    --checkpoint-every 20 --accept-timeout 120 --wait \
+	    --timeout 180 --quiet"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --steps 60 --job-id cache-cold; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --steps 60 --job-id cache-warm; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu submit $$SUB \
+	    --steps 120 --job-id cache-prefix; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu drain \
+	    --queue .cache_smoke/q; \
+	rc=0; wait $$DPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "daemon exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	JAX_PLATFORMS=cpu $(PY) tools/heatq.py .cache_smoke/q --check; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .cache_smoke/q \
+	    --fail-on 'quarantined>0,orphaned>0,cache_hit_rate<0.3'; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .cache_smoke/q \
+	    --json | \
+	$(PY) -c "import json,sys; f=json.load(sys.stdin)['fleet']; \
+	assert f['completed'] == 3, f; \
+	assert f['cache_hits'] == 1, f; \
+	assert f['cache_prefix_hits'] == 1, f; \
+	assert f['dispatches'] == 2, f"; \
+	$(PY) -c "import json; \
+	evs=[json.loads(l) for l in open('.cache_smoke/q/journal.jsonl')]; \
+	warm=[e['event'] for e in evs if e.get('job_id')=='cache-warm']; \
+	assert 'dispatched' not in warm, warm; \
+	assert 'cache_hit' in warm and 'completed' in warm, warm; \
+	pre=[e for e in evs if e.get('event')=='cache_prefix']; \
+	assert len(pre)==1 and pre[0]['generation_step']==60, pre"
+	rm -rf .cache_smoke
 
 bench:
 	$(PY) bench.py

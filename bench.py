@@ -30,6 +30,7 @@ them as extra stdout lines after the headline).
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -300,6 +301,107 @@ def _bench_ensemble(backend, size=512, steps=400, batches=(1, 8, 64)):
     }
 
 
+def _bench_serve_cache(backend, size=64, steps=1500):
+    """The serving-cache row (``--row serve_cache``): cold vs warm vs
+    prefix submit->verdict latency through a real served workload —
+    one daemon, inline workers, three submissions of one semantic
+    spec (SEMANTICS.md "Cache soundness"):
+
+    - **cold**: first submission pays the full solve (worker spawn +
+      compile + steps);
+    - **warm**: identical spec — an exact cache hit, O(1): no worker,
+      no solver dispatch, the verdict links the donor's committed
+      final generation;
+    - **prefix**: the same spec at 2x the step budget — resumes from
+      the cached run's newest generation, so only the extension steps
+      are solved (bitwise a from-scratch solve; the chaos cell
+      svc_cache_prefix_parity pins the parity, this row prices it).
+
+    Latency is submit(spool commit)->terminal journal state, stepping
+    the daemon in a tight loop — the client-observable verdict time
+    minus client-side polling cadence.
+    """
+    import shutil
+    import tempfile
+
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+    from parallel_heat_tpu.service.harness import inline_launcher
+    from parallel_heat_tpu.service.store import JobSpec
+
+    root = tempfile.mkdtemp(prefix="bench_serve_cache_")
+    spawns = []
+    daemon = Heatd(HeatdConfig(root=root, slots=1,
+                               launcher=inline_launcher(root, spawns),
+                               requeue_backoff_base_s=0.0))
+
+    def submit_verdict(jid, n_steps):
+        spec = JobSpec(job_id=jid,
+                       config={"nx": size, "ny": size,
+                               "steps": n_steps, "backend": backend},
+                       checkpoint_every=max(1, n_steps // 3))
+        t0 = time.perf_counter()
+        daemon.store.spool_submit(spec)
+        while True:
+            daemon.step()
+            jobs, _ = daemon.store.replay()
+            v = jobs.get(jid)
+            if v is not None and v.terminal:
+                return time.perf_counter() - t0, v
+
+    cold_s, _ = submit_verdict("cold", steps)
+    warm_s, warm_v = submit_verdict("warm", steps)
+    prefix_s, _ = submit_verdict("prefix", steps * 2)
+    events, _, _ = daemon.store.read_journal()
+    cache_events = [(e["event"], e.get("job_id"),
+                     e.get("generation_step"))
+                    for e in events
+                    if str(e.get("event", "")).startswith("cache")]
+    daemon.close()
+    shutil.rmtree(root, ignore_errors=True)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    doc = {
+        "metric": (f"served submit->verdict latency, {size}^2 "
+                   f"{steps}-step jobs (cold / warm exact-hit / "
+                   f"prefix 2x-budget), s"),
+        "size": size, "steps": steps, "backend": backend,
+        "cold_s": round(cold_s, 4),
+        "warm_s": round(warm_s, 4),
+        "prefix_s": round(prefix_s, 4),
+        "warm_speedup": round(cold_s / warm_s, 1),
+        # The prefix row re-solves `steps` of the 2*steps budget: the
+        # honest comparison is vs the ~2x-cold a scratch solve of the
+        # doubled budget would pay.
+        "prefix_vs_2x_cold": round((2 * cold_s) / prefix_s, 2),
+        "worker_spawns": list(spawns),
+        "warm_zero_spawns": "warm" not in spawns,
+        "warm_cached": (warm_v.cached or {}).get("hit"),
+        "cache_events": cache_events,
+        "device": str(jax.devices()[0]),
+        "protocol": ("inline-worker daemon on one queue root; latency "
+                     "= spool rename-commit -> terminal journal "
+                     "state with the daemon stepped in a tight loop "
+                     "(no client poll cadence included). Cold "
+                     "includes the worker's jit compile — exactly "
+                     "what the first user of a spec pays."),
+        "tpu_rerun_protocol": (
+            "python bench.py --row serve_cache --backend auto on a "
+            "TPU host (defaults: 64^2, 1500 steps); warm-hit latency "
+            "is device-free so the >=10x acceptance bar only widens "
+            "with the cold solve's cost"),
+    }
+    if platform not in ("tpu", "axon"):
+        doc["platform_note"] = (
+            "CPU DRYRUN: the cache path is host-side (journal fold + "
+            "hardlink + rename), identical on every backend; the "
+            "cold/prefix rows price CPU jnp solves, so absolute "
+            "latencies shrink on a TPU while the warm-hit O(1) cost "
+            "does not move.")
+    return doc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
@@ -319,7 +421,7 @@ def main(argv=None):
                     help="target seconds for the chained timing batch")
     ap.add_argument("--row", default="headline",
                     choices=("headline", "conv256", "stream512",
-                             "ensemble512"),
+                             "ensemble512", "serve_cache"),
                     help="which single row the one-line stdout "
                          "contract reports: the fixed-step headline "
                          "(default), the 256^2-to-eps converge row "
@@ -343,9 +445,20 @@ def main(argv=None):
     ap.add_argument("--ensemble-batches", default="1,8,64",
                     help="--row ensemble512: comma list of member "
                          "counts B (default 1,8,64)")
+    ap.add_argument("--cache-size", type=int, default=64,
+                    help="--row serve_cache: grid edge (default 64)")
+    ap.add_argument("--cache-steps", type=int, default=1500,
+                    help="--row serve_cache: cold job's steps; the "
+                         "prefix job runs 2x (default 1500)")
     args = ap.parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig
+
+    if args.row == "serve_cache":
+        print(json.dumps(_bench_serve_cache(args.backend,
+                                            size=args.cache_size,
+                                            steps=args.cache_steps)))
+        return
 
     if args.row == "ensemble512":
         batches = tuple(int(b) for b in

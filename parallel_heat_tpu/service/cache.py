@@ -1,0 +1,743 @@
+"""Content-addressed result cache with checkpoint-prefix reuse.
+
+The serving-layer analogue of prefix caching in an inference stack
+(SEMANTICS.md "Cache soundness"): heatd already proves byte-identical
+spec identity — the ensemble pack key compares canonical config JSON —
+and the bitwise contracts PRs 1–12 pinned (same semantic spec -> same
+trajectory, bit for bit, and resume-from-checkpoint == uninterrupted)
+make that identity a sound *memo key*. This module promotes it:
+
+- the **cache key** is derived from the SEMANTIC half of the spec
+  alone, via the same ``config.SEMANTIC_FIELDS`` partition heatlint
+  HL101 audits: observation-only fields (guard/diag/pipeline) are
+  dropped before hashing, so enabling an observer can never fork a
+  cache entry — and an *unclassified* new ``HeatConfig`` field makes
+  key derivation raise, exactly the way it fails HL101, instead of
+  silently keying on (or silently ignoring) an unaudited field;
+- an **exact hit** serves a completed, finite-verified result in O(1):
+  the entry's payload is the donor run's final committed checkpoint
+  generation, hardlinked into the new job's own lineage, so the served
+  job is indistinguishable on disk from one that ran;
+- a **prefix hit** seeds the new job's checkpoint stem with the
+  newest admissible donor generation; the worker's ordinary
+  resume-before-run path does the rest, and the grids are bitwise a
+  from-scratch solve by the PR-2/PR-10 resume-parity contract;
+- the **index** is an append-only fsynced journal
+  (``<root>/cache/index.jsonl``) folded by the pure reducer
+  :func:`reduce_cache_journal` — same discipline as the job journal:
+  torn tails invisible, state always derivable after a daemon SIGKILL,
+  fold law ``reduce(prefix) then reduce(suffix)`` == ``reduce(all)``.
+  Payload directories are rename-committed BEFORE their index line, so
+  a crash between the two leaves an unreferenced payload (garbage,
+  swept later), never an entry naming torn bytes;
+- **eviction** is LRU under a byte/entry budget
+  (``heatd serve --cache-max-bytes``), with in-flight prefix donors
+  pinned; the evict line lands before the payload is deleted, so a
+  crash mid-eviction leaves an orphan payload, never a dangling entry.
+
+Admissibility (the soundness core — every rule is justified by a
+bitwise contract an earlier PR pinned, see SEMANTICS.md):
+
+==========  =================  =======================================
+target      donor entry        rule
+==========  =================  =======================================
+fixed       any                exact: identical semantic key.
+fixed       any                prefix: same base key (semantics minus
+                               stepping), any generation ``k < steps``
+                               — fixed/converge trajectories are the
+                               same stepping, a generation at ``k`` is
+                               the scratch state at ``k``.
+converge    converge, same     exact: identical key; or *converged
+            eps + cadence      dominance* — the donor CONVERGED at
+                               ``m <= target.steps``: the scratch
+                               target converges at the same window
+                               with the same grid.
+converge    converge, same     prefix: donor exhausted its budget
+            eps + cadence      WITHOUT converging — every verdict up
+                               to ``steps_done`` was negative, so
+                               resuming at a window boundary
+                               ``k <= steps_done`` skips only verdicts
+                               known negative.
+converge    fixed              prefix ONLY with non-convergence
+                               evidence: some converge entry (same
+                               base/eps/cadence) proves no verdict
+                               fires through ``k`` (ran past ``k``
+                               unconverged, or converged strictly
+                               later). Without evidence the scratch
+                               run might have stopped before ``k`` —
+                               resuming would skip a real verdict and
+                               break the bitwise contract, so the
+                               lookup declines.
+==========  =================  =======================================
+
+Everything here is jax-free (numpy only, for the finite check): the
+daemon admits, serves and evicts without initializing an accelerator
+backend, same constraint as ``service/admission.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.config import (
+    OBSERVATION_ONLY_FIELDS,
+    SEMANTIC_FIELDS,
+    HeatConfig,
+)
+from parallel_heat_tpu.service.store import Journal, read_journal_file
+from parallel_heat_tpu.utils.checkpoint import (
+    _fsync_replace,
+    generation_paths,
+    link_snapshot,
+)
+
+CACHE_SCHEMA_VERSION = 1
+
+# The stepping half of the semantic partition: fields that size the
+# run, not the per-step trajectory. They stay in the EXACT key (a
+# 100-step result is not a 200-step result) but are excluded from the
+# BASE key, which names the trajectory family prefix reuse ranges
+# over. Every other semantic field must match exactly for any reuse —
+# backend/mesh/halo schedule ARE pinned bitwise-identical by tests,
+# but the cache deliberately refuses to rely on cross-variant parity:
+# one proven contract (resume parity on the SAME spec) is load-bearing
+# here, not all of them.
+STEPPING_FIELDS = ("steps", "converge", "eps", "check_interval")
+
+# The seed marker the daemon drops next to a prefix-seeded generation
+# so the worker can journal its provenance into telemetry
+# (``cache_prefix_resume``). Dot-named: invisible to every discovery
+# scan (generation_paths matches ``<base>.g<step>`` names only).
+SEED_MARKER = ".cache_seed.json"
+
+
+class CacheKeyError(ValueError):
+    """The spec cannot be content-addressed — an unclassified config
+    field (the HL101 failure, surfaced at the serving layer) or an
+    unknown field the solver would reject anyway."""
+
+
+def _partition(config_cls=HeatConfig,
+               semantic: Optional[Tuple[str, ...]] = None,
+               observation: Optional[Tuple[str, ...]] = None):
+    """Validate the cache-key partition against the dataclass and
+    return ``(semantic_fields_in_order, defaults)``. Raises
+    :class:`CacheKeyError` when any field is unclassified or
+    double-classified — the exact condition heatlint HL101 fails CI
+    on, enforced here independently so a doctored config cannot fork
+    cache entries even if lint never ran."""
+    semantic = SEMANTIC_FIELDS if semantic is None else semantic
+    observation = (OBSERVATION_ONLY_FIELDS if observation is None
+                   else observation)
+    fields = dataclasses.fields(config_cls)
+    names = [f.name for f in fields]
+    unclassified = [n for n in names
+                    if n not in semantic and n not in observation]
+    double = [n for n in names if n in semantic and n in observation]
+    if unclassified or double:
+        raise CacheKeyError(
+            f"cache-key partition incomplete for "
+            f"{config_cls.__name__}: unclassified={unclassified} "
+            f"double-classified={double} — every config field must "
+            f"appear in exactly one of SEMANTIC_FIELDS / "
+            f"OBSERVATION_ONLY_FIELDS (heatlint HL101; an unaudited "
+            f"field must not be able to fork or alias cache entries)")
+    defaults = {f.name: f.default for f in fields}
+    return [n for n in names if n in semantic], defaults
+
+
+def canonical_semantic_config(config: dict, config_cls=HeatConfig,
+                              **partition_kw) -> dict:
+    """The canonical content of one spec: semantic fields only,
+    defaults applied, JSON-normalized (tuples -> lists). Unknown keys
+    raise — a spec the solver cannot materialize has no sound key."""
+    sem, defaults = _partition(config_cls, **partition_kw)
+    known = set(defaults)
+    unknown = [k for k in config if k not in known]
+    if unknown:
+        raise CacheKeyError(
+            f"unknown config field(s) {unknown} — not a "
+            f"{config_cls.__name__} spec, nothing sound to key on")
+    out = {}
+    for name in sem:
+        v = config.get(name, defaults[name])
+        if isinstance(v, tuple):
+            v = list(v)
+        out[name] = v
+    return out
+
+
+def _digest(doc: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()[:40]
+
+
+def cache_key(config: dict, config_cls=HeatConfig,
+              **partition_kw) -> Tuple[str, dict]:
+    """``(exact_key, canonical_semantic_dict)`` for one spec config.
+    The key is a content address: byte-identical canonical semantics
+    <=> equal keys, and observation-only fields cannot move it."""
+    canon = canonical_semantic_config(config, config_cls,
+                                      **partition_kw)
+    return _digest(canon), canon
+
+
+def base_key(config: dict, config_cls=HeatConfig,
+             **partition_kw) -> str:
+    """The trajectory-family key: semantics minus the stepping fields
+    (:data:`STEPPING_FIELDS`). Two specs share a base key iff their
+    per-step update programs compute the same trajectory — the set
+    prefix reuse ranges over."""
+    canon = canonical_semantic_config(config, config_cls,
+                                      **partition_kw)
+    for f in STEPPING_FIELDS:
+        canon.pop(f, None)
+    return _digest(canon)
+
+
+# ---------------------------------------------------------------------------
+# Index journal + pure fold
+# ---------------------------------------------------------------------------
+
+def reduce_cache_journal(events, state=None
+                         ) -> Tuple[Dict[str, dict], List[str]]:
+    """Pure fold of cache-index events -> ``(entries, anomalies)``.
+
+    Entry lifecycle: ``cache_put`` creates/replaces, ``cache_touch``
+    bumps the LRU clock (+ hit counters), ``cache_evict`` removes.
+    Same fold law as ``store.reduce_journal``: pass a previous call's
+    state to fold only appended events. Unknown events/fields are
+    ignored (forward compatibility); a touch/evict of an unknown key
+    is an anomaly — the index's own double-terminal analogue."""
+    entries: Dict[str, dict] = state[0] if state else {}
+    anomalies: List[str] = state[1] if state else []
+    for e in events:
+        ev = e.get("event")
+        key = e.get("key")
+        if ev is None or not isinstance(key, str):
+            continue
+        if ev == "cache_put":
+            prior = entries.get(key)
+            entries[key] = {
+                "key": key,
+                "base": e.get("base"),
+                "job_id": e.get("job_id"),
+                "attempt": e.get("attempt"),
+                "steps": e.get("steps"),
+                "converge": bool(e.get("converge")),
+                "eps": e.get("eps"),
+                "check_interval": e.get("check_interval"),
+                "steps_done": e.get("steps_done"),
+                "converged": e.get("converged"),
+                "generations": list(e.get("generations") or []),
+                "bytes": int(e.get("bytes") or 0),
+                "payload": e.get("payload"),
+                "put_t": e.get("t_wall"),
+                "last_used_t": e.get("t_wall"),
+                "hits": 0,
+                "prefix_hits": 0,
+            }
+            if prior is not None:
+                # Re-put of a live key (two twins dispatched before
+                # either completed): same content address, same
+                # bytes — the entry's USAGE history must survive, or
+                # a hot entry would lose its LRU recency and be
+                # evicted ahead of genuinely cold ones.
+                v = entries[key]
+                v["hits"] = prior.get("hits") or 0
+                v["prefix_hits"] = prior.get("prefix_hits") or 0
+                pt = prior.get("last_used_t")
+                if isinstance(pt, (int, float)):
+                    v["last_used_t"] = max(pt, v["last_used_t"]
+                                           or pt)
+        elif ev == "cache_touch":
+            v = entries.get(key)
+            if v is None:
+                anomalies.append(f"cache: touch of unknown entry {key}")
+                continue
+            t = e.get("t_wall")
+            if isinstance(t, (int, float)):
+                v["last_used_t"] = t
+            if e.get("kind") == "prefix":
+                v["prefix_hits"] += 1
+            else:
+                v["hits"] += 1
+        elif ev == "cache_evict":
+            if entries.pop(key, None) is None:
+                anomalies.append(f"cache: evict of unknown entry {key}")
+    return entries, anomalies
+
+
+# ---------------------------------------------------------------------------
+# Lookup (pure functions over the folded entries)
+# ---------------------------------------------------------------------------
+
+def _stepping(canon: dict) -> Tuple[int, bool, float, int]:
+    return (int(canon.get("steps") or 0), bool(canon.get("converge")),
+            float(canon.get("eps") or 0.0),
+            int(canon.get("check_interval") or 1))
+
+
+def _cadence_match(entry: dict, eps: float, ci: int) -> bool:
+    return (bool(entry.get("converge"))
+            and entry.get("eps") == eps
+            and entry.get("check_interval") == ci)
+
+
+def lookup_exact(entries: Dict[str, dict], config: dict
+                 ) -> Optional[Tuple[dict, str]]:
+    """``(entry, kind)`` for an O(1) serve, or None. ``kind`` is
+    ``"exact"`` (identical semantic key) or ``"converged"`` (converged
+    dominance: a converge donor with the same eps/cadence that
+    CONVERGED within this target's budget — the scratch run would stop
+    at the same window with the same grid)."""
+    try:
+        key, canon = cache_key(config)
+    except CacheKeyError:
+        return None
+    e = entries.get(key)
+    if e is not None and e.get("steps_done") in (e.get("generations")
+                                                or []):
+        return e, "exact"
+    steps, converge, eps, ci = _stepping(canon)
+    if not converge:
+        return None
+    base = base_key(config)
+    best = None
+    for e in entries.values():
+        if e.get("base") != base or not _cadence_match(e, eps, ci):
+            continue
+        m = e.get("steps_done")
+        if (e.get("converged") is True and isinstance(m, int)
+                and m <= steps and m in (e.get("generations") or [])):
+            if best is None or m < best.get("steps_done"):
+                best = e
+    return (best, "converged") if best is not None else None
+
+
+def lookup_prefix(entries: Dict[str, dict], config: dict
+                  ) -> Optional[Tuple[dict, int]]:
+    """``(entry, generation_step)`` naming the newest admissible donor
+    generation for a prefix resume, or None. See the module-docstring
+    admissibility table — each arm cites the bitwise contract that
+    makes it sound."""
+    try:
+        canon = canonical_semantic_config(config)
+        base = base_key(config)
+    except CacheKeyError:
+        return None
+    steps, converge, eps, ci = _stepping(canon)
+
+    def gens(e, bound, align=None):
+        return [g for g in e.get("generations") or []
+                if isinstance(g, int) and 0 < g < bound
+                and (align is None or g % align == 0)]
+
+    # Non-convergence evidence for fixed donors under a converge
+    # target: the largest step through which SOME converge entry of
+    # this family (same eps/cadence) proves every verdict negative.
+    evidence_through = -1
+    if converge:
+        for e in entries.values():
+            if e.get("base") != base or not _cadence_match(e, eps, ci):
+                continue
+            m = e.get("steps_done")
+            if not isinstance(m, int):
+                continue
+            if e.get("converged") is False:
+                evidence_through = max(evidence_through, m)
+            elif e.get("converged") is True:
+                # Converged at m: no verdict fired strictly before m.
+                evidence_through = max(evidence_through, m - 1)
+
+    best: Optional[Tuple[dict, int]] = None
+    for e in entries.values():
+        if e.get("base") != base:
+            continue
+        if not converge:
+            # Fixed target: any family member's generations are the
+            # scratch trajectory at that step (fixed/converge share
+            # the stepping; convergence only decides when to STOP, and
+            # a retained generation is by construction from before the
+            # donor stopped).
+            cand = gens(e, steps)
+        elif _cadence_match(e, eps, ci):
+            if e.get("converged") is False:
+                # Budget-exhausted converge donor: verdicts through
+                # steps_done all negative; resume at a window boundary.
+                bound = min(steps, int(e.get("steps_done") or 0) + 1)
+                cand = gens(e, bound, align=ci)
+            else:
+                # Converged donors serve via lookup_exact (dominance)
+                # or, for a SMALLER target budget, not at all — the
+                # scratch run would stop inside the donor's verdict
+                # sequence, nothing to resume past.
+                cand = []
+        elif not e.get("converge"):
+            # Fixed donor under a converge target: sound only through
+            # the family's proven-unconverged horizon.
+            cand = gens(e, min(steps, evidence_through + 1), align=ci)
+        else:
+            cand = []  # converge donor with different eps/cadence
+        for g in cand:
+            if best is None or g > best[1]:
+                best = (e, g)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Payload capture / seeding (rename-committed hardlinks)
+# ---------------------------------------------------------------------------
+
+def payload_stem(payload_dir: str) -> str:
+    """The checkpoint stem inside one payload directory — payloads
+    reuse the generation naming (``ck.g<step>.npz``) so
+    ``generation_paths``/``latest_checkpoint`` read them natively."""
+    return os.path.join(payload_dir, "ck")
+
+
+def _npz_finite(path: str) -> bool:
+    """Host-side finite verification of one gathered generation —
+    numpy only (jax-free daemon). False on unreadable/foreign files:
+    admission to the cache must err toward refusing."""
+    import numpy as np
+
+    try:
+        with np.load(path) as z:
+            return bool(np.isfinite(np.asarray(z["grid"])).all())
+    except Exception:  # noqa: BLE001 — any unreadable payload refuses
+        return False
+
+
+def capture_payload(cache_dir: str, key: str, donor_stem: str,
+                    steps_done: int) -> Optional[Tuple[str, list, int]]:
+    """Rename-commit the donor lineage's gathered generations as the
+    payload of ``key``; returns ``(payload_dir, generation_steps,
+    bytes)`` or None when the lineage is not cacheable (no committed
+    generations, a sharded ``.ckpt`` layout, a final generation that
+    is missing or fails the host finite check).
+
+    Only ``.npz`` (gathered) generations are captured: their finite
+    verification is one numpy read here, and linking them is O(1).
+    Sharded ``.ckpt`` lineages decline — multi-host results resume
+    through their own two-phase-committed families, and caching them
+    is a follow-on, not a silent half-support.
+
+    The temp directory is dot-named (invisible to any scan) and the
+    final ``os.rename`` is the commit: a SIGKILL at any point leaves
+    either no payload or a complete one — and the index line that
+    makes it LIVE is appended by the caller only after this returns.
+    """
+    gens = generation_paths(donor_stem)
+    npz = [(s, p) for s, p in gens if p.endswith(".npz")]
+    if not npz or len(npz) != len(gens):
+        return None  # empty or sharded lineage: decline
+    if npz[-1][0] != int(steps_done):
+        return None  # newest generation is not the committed result
+    if not _npz_finite(npz[-1][1]):
+        return None  # never admit a non-finite (or torn) result
+    dst = os.path.join(cache_dir, key)
+    if os.path.isdir(dst):
+        # Re-put of the same content address: the existing payload is
+        # interchangeable bytes (same key => same trajectory). Reuse
+        # it when its newest generation matches; replace otherwise.
+        have = generation_paths(payload_stem(dst))
+        if have and have[-1][0] == int(steps_done):
+            steps = [s for s, _ in have]
+            size = sum(os.path.getsize(p) for _, p in have)
+            return dst, steps, size
+        shutil.rmtree(dst, ignore_errors=True)
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = os.path.join(cache_dir, f".tmp-{os.getpid()}-{key}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    steps, size = [], 0
+    for s, p in npz:
+        name = f"ck.g{int(s):012d}.npz"
+        link_snapshot(p, os.path.join(tmp, name))
+        steps.append(int(s))
+        size += os.path.getsize(os.path.join(tmp, name))
+    os.rename(tmp, dst)
+    return dst, steps, size
+
+
+def seed_stem(entry: dict, gen_step: int, dst_stem: str,
+              marker: Optional[dict] = None) -> Optional[str]:
+    """Link one payload generation into a job's own checkpoint stem
+    (the prefix seed / exact-hit lineage link); returns the seeded
+    path or None when the payload went missing (evicted/garbage —
+    the caller just solves from scratch). ``marker`` (rename-committed
+    ``.cache_seed.json`` next to the generation) lets the worker
+    journal the provenance into its telemetry stream."""
+    src = os.path.join(str(entry.get("payload") or ""),
+                       f"ck.g{int(gen_step):012d}.npz")
+    if not os.path.isfile(src):
+        return None
+    d = os.path.dirname(os.path.abspath(dst_stem))
+    os.makedirs(d, exist_ok=True)
+    dst = f"{dst_stem}.g{int(gen_step):012d}.npz"
+    try:
+        link_snapshot(src, dst)
+    except OSError:
+        return None
+    if marker is not None:
+        tmp = os.path.join(d, f".tmp-{os.getpid()}-seed")
+        with open(tmp, "w") as f:
+            json.dump(marker, f)
+        _fsync_replace(tmp, os.path.join(d, SEED_MARKER))
+    return dst
+
+
+def read_seed_marker(stem: str) -> Optional[dict]:
+    """The committed seed marker of one checkpoint stem, or None."""
+    path = os.path.join(os.path.dirname(os.path.abspath(stem)),
+                        SEED_MARKER)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Eviction (pure policy; the caller applies the verdicts)
+# ---------------------------------------------------------------------------
+
+def evict_candidates(entries: Dict[str, dict],
+                     max_bytes: Optional[int],
+                     max_entries: Optional[int],
+                     pinned=()) -> List[str]:
+    """Keys to evict, oldest-used first, until both budgets hold.
+    Pinned keys (in-flight prefix donors) are never returned — a
+    budget that only pinned entries could satisfy stays over-budget
+    until the pins release, which the caller re-checks each pass."""
+    pinned = set(pinned)
+    live = [e for e in entries.values() if e["key"] not in pinned]
+    live.sort(key=lambda e: (e.get("last_used_t") or 0.0, e["key"]))
+    total = sum(e.get("bytes") or 0 for e in entries.values())
+    count = len(entries)
+    out = []
+    for e in live:
+        over_bytes = max_bytes is not None and total > max_bytes
+        over_count = max_entries is not None and count > max_entries
+        if not over_bytes and not over_count:
+            break
+        out.append(e["key"])
+        total -= e.get("bytes") or 0
+        count -= 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CacheIndex: the daemon's handle (journal writer + incremental fold)
+# ---------------------------------------------------------------------------
+
+class CacheIndex:
+    """One queue root's cache: the index journal writer plus an
+    incremental fold of it (same offset discipline as the daemon's
+    job-journal fold — only whole lines are consumed, so a read racing
+    an append re-reads the torn tail complete next pass). All writes
+    go through this class so the commit ordering (payload before
+    index line; evict line before payload delete) has one home."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.dir = os.path.join(self.root, "cache")
+        os.makedirs(self.dir, exist_ok=True)
+        self.index_path = os.path.join(self.dir, "index.jsonl")
+        self._journal: Optional[Journal] = None
+        self._offset = 0
+        self._entries: Dict[str, dict] = {}
+        self._anomalies: List[str] = []
+
+    @property
+    def journal(self) -> Journal:
+        if self._journal is None:
+            self._journal = Journal(self.index_path)
+        return self._journal
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    @property
+    def version(self) -> int:
+        """Monotone content version: the byte offset the fold has
+        consumed. Changes exactly when the index gains lines — the
+        daemon's per-tick miss memo keys on it (a job that missed at
+        version V misses at V forever)."""
+        return self._offset
+
+    def entries(self) -> Dict[str, dict]:
+        """The folded index, O(appended bytes) per call."""
+        try:
+            with open(self.index_path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return self._entries
+        end = data.rfind(b"\n")
+        if end >= 0:
+            self._offset += end + 1
+            events = []
+            for line in data[:end + 1].split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    events.append(rec)
+            reduce_cache_journal(events,
+                                 state=(self._entries, self._anomalies))
+        return self._entries
+
+    # -- writes ----------------------------------------------------------
+
+    def put(self, config: dict, donor_stem: str, *, job_id: str,
+            attempt: int, steps_done: int,
+            converged: Optional[bool] = None) -> Optional[dict]:
+        """Admit one completed, finite-verified lineage; returns the
+        entry or None when the lineage declines (see
+        :func:`capture_payload`). Payload commit strictly precedes the
+        index line — the crash window between them loses the ENTRY
+        (re-solved next time), never serves torn bytes."""
+        try:
+            key, canon = cache_key(config)
+            base = base_key(config)
+        except CacheKeyError:
+            return None
+        cap = capture_payload(self.dir, key, donor_stem,
+                              int(steps_done))
+        if cap is None:
+            return None
+        payload, gens, size = cap
+        rec = self.journal.append(
+            "cache_put", key=key, base=base, job_id=job_id,
+            attempt=int(attempt), steps=canon.get("steps"),
+            converge=bool(canon.get("converge")),
+            eps=canon.get("eps"),
+            check_interval=canon.get("check_interval"),
+            steps_done=int(steps_done), converged=converged,
+            generations=gens, bytes=size, payload=payload)
+        self._consume([rec])
+        return self._entries.get(key)
+
+    def touch(self, key: str, kind: str = "exact") -> None:
+        rec = self.journal.append("cache_touch", key=key, kind=kind)
+        self._consume([rec])
+
+    def evict(self, key: str) -> None:
+        """Evict-line first, THEN delete the payload: a crash between
+        the two leaves an orphan payload directory (swept by
+        :meth:`sweep_orphans`), never a live entry naming missing
+        bytes."""
+        e = self._entries.get(key)
+        rec = self.journal.append("cache_evict", key=key,
+                                  bytes=(e or {}).get("bytes"))
+        self._consume([rec])
+        payload = (e or {}).get("payload")
+        if payload and os.path.isdir(payload):
+            shutil.rmtree(payload, ignore_errors=True)
+
+    def sweep_orphans(self) -> int:
+        """Remove payload directories no live entry references —
+        the residue of crashes inside the two commit windows above.
+        Returns the number removed. Safe to reap dead writers' temps
+        too: one daemon per queue root means the only writer is the
+        caller, so any temp directory present here is a corpse's."""
+        live = {os.path.basename(str(e.get("payload") or ""))
+                for e in self.entries().values()}
+        n = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            full = os.path.join(self.dir, name)
+            if not os.path.isdir(full) or name in live:
+                continue
+            shutil.rmtree(full, ignore_errors=True)
+            n += 1
+        return n
+
+    def _consume(self, recs) -> None:
+        """Fold freshly-appended records by hand and advance the
+        offset past them (the append landed at the tail; the next
+        :meth:`entries` read must not double-fold)."""
+        try:
+            self._offset = os.path.getsize(self.index_path)
+        except OSError:
+            pass
+        reduce_cache_journal(recs,
+                             state=(self._entries, self._anomalies))
+
+
+# ---------------------------------------------------------------------------
+# Durability audit (tools/heatq.py --check)
+# ---------------------------------------------------------------------------
+
+def load_cache_index(root: str) -> Tuple[Dict[str, dict], List[str],
+                                         int, bool]:
+    """Cold read of one root's cache index ->
+    ``(entries, anomalies, bad_lines, torn_tail)``."""
+    path = os.path.join(str(root), "cache", "index.jsonl")
+    events, bad, torn = read_journal_file(path)
+    entries, anomalies = reduce_cache_journal(events)
+    return entries, anomalies, bad, torn
+
+
+def audit_cache(root: str, entries: Dict[str, dict],
+                job_views: Optional[dict] = None) -> List[str]:
+    """Durability anomalies of one cache index (heatq ``--check``):
+
+    - **dangling entry**: a live entry whose payload directory or
+      named generation files are missing — the serve path would fail,
+      and the commit ordering should have made this impossible;
+    - **entry naming an uncommitted result**: the donor's result
+      record is missing or not ``completed`` — only committed,
+      completed lineages are admissible (a quarantined/rolled-back
+      lineage must never enter, and a completed job's terminal state
+      is absorbing, so a later quarantine cannot exist either).
+    """
+    out: List[str] = []
+    for key, e in sorted(entries.items()):
+        payload = str(e.get("payload") or "")
+        if not os.path.isdir(payload):
+            out.append(f"cache entry {key}: dangling — payload "
+                       f"directory missing ({payload})")
+            continue
+        for g in e.get("generations") or []:
+            p = os.path.join(payload, f"ck.g{int(g):012d}.npz")
+            if not os.path.isfile(p):
+                out.append(f"cache entry {key}: dangling — named "
+                           f"generation {g} missing from payload")
+        jid, att = e.get("job_id"), e.get("attempt")
+        rec_path = os.path.join(str(root), "results",
+                                f"{jid}.a{int(att or 0):04d}.json")
+        rec = None
+        try:
+            with open(rec_path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = None
+        if not isinstance(rec, dict) or rec.get("outcome") != "completed":
+            out.append(f"cache entry {key}: names an uncommitted "
+                       f"result ({jid} attempt {att}: "
+                       f"{'missing record' if rec is None else rec.get('outcome')})")
+        elif job_views is not None and jid in job_views \
+                and getattr(job_views[jid], "state", None) not in (
+                    "completed", None):
+            out.append(f"cache entry {key}: donor {jid} lineage is "
+                       f"{job_views[jid].state!r} in the journal — "
+                       f"not an admissible completed lineage")
+    return out

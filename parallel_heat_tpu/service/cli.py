@@ -95,12 +95,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "this long before dispatching it solo, so "
                          "bursts of compatible submissions pack "
                          "together (default 0: greedy)")
+    sv.add_argument("--no-cache", action="store_true",
+                    help="disable the content-addressed result cache "
+                         "(on by default: identical semantic specs "
+                         "serve completed verdicts in O(1), and "
+                         "larger-budget re-submissions resume from "
+                         "cached checkpoint generations — SEMANTICS.md "
+                         "'Cache soundness')")
+    sv.add_argument("--cache-max-bytes", type=int, default=None,
+                    metavar="B",
+                    help="LRU-evict cache payloads past this many "
+                         "bytes (default: unbounded; in-flight prefix "
+                         "donors are pinned)")
+    sv.add_argument("--cache-max-entries", type=int, default=None,
+                    metavar="N",
+                    help="LRU-evict cache entries past this count "
+                         "(default: unbounded)")
     sv.add_argument("--chaos-kill-after-accept", type=int, default=None,
                     metavar="N",
                     help="CHAOS HARNESS ONLY: SIGKILL the daemon right "
                          "after journaling the Nth accepted job — the "
                          "crash window the durability contract is "
                          "certified against")
+    sv.add_argument("--chaos-kill-before-cache-put", type=int,
+                    default=None, metavar="N",
+                    help="CHAOS HARNESS ONLY: SIGKILL the daemon on "
+                         "the Nth completion's cache admission, after "
+                         "the result commit but before the "
+                         "cache-index append (the svc_cache_crash "
+                         "window)")
 
     sb = sub.add_parser("submit", help="enqueue one job")
     sb.add_argument("--queue", required=True, metavar="DIR")
@@ -177,7 +200,11 @@ def _cmd_serve(args) -> int:
         drain_grace_s=args.drain_grace,
         pack_jobs=args.pack, pack_max=args.pack_max,
         pack_wait_s=args.pack_wait,
-        chaos_kill_after_accept=args.chaos_kill_after_accept)
+        cache_results=not args.no_cache,
+        cache_max_bytes=args.cache_max_bytes,
+        cache_max_entries=args.cache_max_entries,
+        chaos_kill_after_accept=args.chaos_kill_after_accept,
+        chaos_kill_before_cache_put=args.chaos_kill_before_cache_put)
     try:
         daemon = Heatd(cfg)
     except ValueError as e:
@@ -271,6 +298,9 @@ def _cmd_status(args) -> int:
             extra += f" kind={v['kind']}"
         if v.get("steps_done") is not None:
             extra += f" steps={v['steps_done']}"
+        if v.get("cached"):
+            extra += (f" cache={v['cached'].get('hit')}"
+                      f"<-{v['cached'].get('donor')}")
         print(f"  {jid}: {v['state']} attempts={v['attempts']}{extra}")
     for a in doc["anomalies"]:
         print(f"  ANOMALY: {a}")

@@ -52,6 +52,13 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 from parallel_heat_tpu.service.admission import admission_verdict
+from parallel_heat_tpu.service.cache import (
+    CacheIndex,
+    evict_candidates,
+    lookup_exact,
+    lookup_prefix,
+    seed_stem,
+)
 from parallel_heat_tpu.service.store import (
     FAILFAST_KINDS,
     JobStore,
@@ -121,6 +128,20 @@ class HeatdConfig:
     # instead of the first arrival stealing a slot solo. 0 = dispatch
     # greedily (packing still coalesces whatever is queued together).
     pack_wait_s: float = 0.0
+    # Content-addressed result cache (SEMANTICS.md "Cache soundness").
+    # On by default: an EXACT hit — a completed, finite-verified
+    # lineage with the identical semantic-spec + stepping key — serves
+    # the verdict in O(1) with zero worker spawns and zero HBM priced;
+    # a PREFIX hit seeds the new job's checkpoint stem with the
+    # newest admissible donor generation so the worker resumes instead
+    # of solving from step 0 (bitwise a from-scratch run, by the
+    # resume-parity contract). Specs carrying fault plans never hit
+    # and never populate the cache.
+    cache_results: bool = True
+    # LRU eviction budgets (None = unbounded); in-flight prefix donors
+    # are pinned past both.
+    cache_max_bytes: Optional[int] = None
+    cache_max_entries: Optional[int] = None
     # Extra environment for worker subprocesses (the chaos matrix pins
     # JAX_PLATFORMS=cpu here); inherits os.environ otherwise.
     worker_env: Optional[dict] = None
@@ -136,6 +157,13 @@ class HeatdConfig:
     # between-append-and-dispatch crash window the durability contract
     # is certified against (tools/chaos_matrix.py `svc_daemon_restart`).
     chaos_kill_after_accept: Optional[int] = None
+    # CHAOS HARNESS ONLY: SIGKILL this daemon on the Nth completed
+    # job's cache admission, AFTER the result + `completed` journal
+    # line commit but BEFORE the cache-index append — the window the
+    # cache durability contract is certified against
+    # (`svc_cache_crash`: entry lost, job NOT lost, next identical
+    # submit re-solves; torn bytes are never servable).
+    chaos_kill_before_cache_put: Optional[int] = None
 
     def validate(self) -> "HeatdConfig":
         if self.slots < 1:
@@ -155,6 +183,13 @@ class HeatdConfig:
         if self.pack_max < 2:
             raise ValueError(f"pack_max must be >= 2, got "
                              f"{self.pack_max}")
+        if self.cache_max_bytes is not None and self.cache_max_bytes < 0:
+            raise ValueError(f"cache_max_bytes must be >= 0, got "
+                             f"{self.cache_max_bytes}")
+        if self.cache_max_entries is not None \
+                and self.cache_max_entries < 0:
+            raise ValueError(f"cache_max_entries must be >= 0, got "
+                             f"{self.cache_max_entries}")
         return self
 
 
@@ -185,6 +220,28 @@ class Heatd:
         self._draining = False
         # job_id -> spec-derived pack key (see _spec_pack_key).
         self._pack_key_cache: Dict[str, object] = {}
+        # Content-addressed result cache (None = disabled). Pins map
+        # prefix-resumed job -> donor cache key: the donor is exempt
+        # from eviction while the job is non-terminal.
+        self.cache: Optional[CacheIndex] = (
+            CacheIndex(config.root) if config.cache_results else None)
+        self._cache_pins: Dict[str, str] = {}
+        self._cache_puts = 0
+        # job_id -> committed spec config dict (None = cache-exempt),
+        # same memoization rationale as _pack_key_cache: committed
+        # specs are immutable and the dispatch-time cache sweep
+        # consults every queued job on every poll tick.
+        self._cache_spec_cache: Dict[str, Optional[dict]] = {}
+        # job_id -> cache-index version at which its exact lookup
+        # last MISSED: while the index hasn't grown, re-hashing the
+        # key and re-scanning the entries every tick is wasted work —
+        # a miss is a miss until a new entry lands.
+        self._cache_miss_memo: Dict[str, int] = {}
+        if self.cache is not None:
+            # Crash residue from the two commit windows (payload
+            # committed but never indexed; evicted but never deleted)
+            # is unreferenced garbage — reap it at boot.
+            self.cache.sweep_orphans()
         # Incremental journal fold: byte offset consumed so far + the
         # folded state. Equivalent to store.replay() by the reducer's
         # fold law, but each pass parses only the appended events — a
@@ -238,6 +295,16 @@ class Heatd:
         self._route_failed(now)
         if not self._draining:
             self._dispatch(now)
+        if self._cache_pins:
+            # Release terminal jobs' donor pins every pass — under
+            # unbounded budgets the evict pass (the other prune site)
+            # early-returns, and a long daemon must not grow a pin
+            # per prefix-resumed job forever.
+            jobs, _ = self._replay()
+            self._cache_pins = {jid: key for jid, key
+                                in self._cache_pins.items()
+                                if jid in jobs
+                                and not jobs[jid].terminal}
         return self._publish_status(now)
 
     # -- phase 1: worker exits / liveness --------------------------------
@@ -318,6 +385,11 @@ class Heatd:
                      attempt=v.attempts,
                      steps_done=rec.get("steps_done"),
                      wall_s=rec.get("wall_s"))
+            # Cache admission strictly AFTER the result + journal
+            # commit: a crash here loses the cache ENTRY (the next
+            # identical submit re-solves), never the job and never a
+            # half-committed payload a reader could serve.
+            self._cache_put(v, rec)
         elif outcome == "permanent_failure":
             j.append("worker_failed", job_id=jid, worker=v.worker,
                      attempt=v.attempts, exit_code=rc,
@@ -442,6 +514,30 @@ class Heatd:
             spec = self.store.read_spool(jid)
             if spec is None:
                 continue  # torn/foreign spool entry: leave for inspection
+            if self.cache is not None and not self._draining \
+                    and not self._cache_exempt(spec):
+                hit = lookup_exact(self.cache.entries(), spec.config)
+                if hit is not None:
+                    # Exact hit at the door: accept with ZERO HBM
+                    # priced (no worker will run) and serve the
+                    # verdict in O(1). Spec commit still precedes the
+                    # accepted line (the idempotent-handshake order);
+                    # queue-depth/HBM gates deliberately do not apply
+                    # — an instant completion consumes neither.
+                    self.store.commit_job_record(spec)
+                    recs = [j.append(
+                        "accepted", job_id=jid,
+                        deadline_s=spec.deadline_s, hbm_bytes=0,
+                        submitted_t=spec.submitted_t,
+                        trace_id=(spec.trace or {}).get("trace_id"))]
+                    self._fold(recs)
+                    self._cache_serve(
+                        jid, hit,
+                        trace_id=(spec.trace or {}).get("trace_id"))
+                    # A vanished payload leaves the job accepted and
+                    # queued — dispatch runs it like any other.
+                    self.store.drop_spool(jid)
+                    continue
             active = [v for v in jobs.values()
                       if not v.terminal and v.state != "rejected"]
             ok, reason, retry_after, est = admission_verdict(
@@ -457,10 +553,7 @@ class Heatd:
                 # these bytes, and an unfolded rejection would both
                 # undercount forever and let a re-used id through the
                 # `jid in jobs` dedupe.
-                self._journal_offset = os.path.getsize(
-                    self.store.journal_path)
-                reduce_journal([rec],
-                               state=(self._jobs, self._anomalies))
+                self._fold([rec])
                 self.store.drop_spool(jid)
                 continue
             # Durable spec FIRST, then the accepted line: a crash
@@ -476,9 +569,7 @@ class Heatd:
             # NEXT spool entry's gate sees this job as active without
             # re-reading the journal (the incremental fold will skip
             # these bytes — they are consumed here).
-            self._journal_offset = os.path.getsize(
-                self.store.journal_path)
-            reduce_journal([rec], state=(self._jobs, self._anomalies))
+            self._fold([rec])
             self._accepts += 1
             if cfg.chaos_kill_after_accept is not None \
                     and self._accepts >= cfg.chaos_kill_after_accept:
@@ -521,6 +612,189 @@ class Heatd:
                 j.append("requeued", job_id=jid, reason=last_kind,
                          backoff_s=delay, not_before=now + delay,
                          attempt=v.attempts)
+
+    # -- content-addressed result cache (SEMANTICS.md "Cache
+    # soundness"): exact hits serve in O(1), prefix hits seed the
+    # job's checkpoint stem so the worker resumes instead of solving
+    # from step 0. ---------------------------------------------------------
+
+    def _fold(self, recs) -> None:
+        """Fold freshly-appended journal records into the cached views
+        and advance the incremental-fold offset past them (the appends
+        landed at the tail; the next _replay must not double-fold)."""
+        self._journal_offset = os.path.getsize(self.store.journal_path)
+        reduce_journal(recs, state=(self._jobs, self._anomalies))
+
+    @staticmethod
+    def _cache_exempt(spec) -> bool:
+        """Specs the cache never serves and never admits: fault plans
+        are per-run chaos machinery, not content."""
+        return spec is None or spec.faults is not None
+
+    def _cache_put(self, v: JobView, rec: dict) -> None:
+        """Admit a completed job's lineage. Declines quietly for
+        fault-injected specs, cache-served completions (their lineage
+        IS the entry's payload), sharded layouts, or lineages whose
+        newest generation is not the committed finite result."""
+        if self.cache is None or rec.get("cache") is not None:
+            return
+        try:
+            spec = self.store.load_spec(v.job_id)
+        except (OSError, ValueError):
+            return
+        if self._cache_exempt(spec) or rec.get("steps_done") is None:
+            return
+        self._cache_puts += 1
+        cfg = self.config
+        if cfg.chaos_kill_before_cache_put is not None \
+                and self._cache_puts >= cfg.chaos_kill_before_cache_put:
+            # Chaos window: the job's `completed` line is durable, the
+            # cache index knows nothing — restart must re-solve the
+            # next identical submit, never serve torn bytes.
+            os.kill(os.getpid(), signal.SIGKILL)
+        entry = self.cache.put(
+            spec.config, self.store.checkpoint_stem(v.job_id),
+            job_id=v.job_id, attempt=v.attempts,
+            steps_done=int(rec["steps_done"]),
+            converged=rec.get("converged"))
+        if entry is not None:
+            self._cache_evict_pass()
+
+    def _cache_evict_pass(self) -> None:
+        """LRU eviction to the configured budgets; donors of in-flight
+        prefix resumes are pinned (their payload generation is already
+        hardlinked into the job's stem, but the pin keeps the entry —
+        and its LRU/provenance state — stable until the job lands)."""
+        cfg = self.config
+        if cfg.cache_max_bytes is None and cfg.cache_max_entries is None:
+            return
+        jobs, _ = self._replay()
+        self._cache_pins = {jid: key for jid, key
+                            in self._cache_pins.items()
+                            if jid in jobs and not jobs[jid].terminal}
+        for key in evict_candidates(self.cache.entries(),
+                                    cfg.cache_max_bytes,
+                                    cfg.cache_max_entries,
+                                    pinned=self._cache_pins.values()):
+            self.cache.evict(key)
+
+    def _cache_serve(self, jid: str, hit, trace_id=None) -> bool:
+        """Complete ``jid`` in O(1) from an exact/converged-dominance
+        hit: link the payload's final generation into the job's own
+        checkpoint lineage (the served job is indistinguishable on
+        disk from one that ran), rename-commit an attempt-0 result
+        record carrying the provenance, and journal ``cache_hit`` +
+        ``completed``. Returns False when the payload went missing —
+        the caller falls through to a real solve."""
+        entry, kind = hit
+        steps_done = int(entry.get("steps_done") or 0)
+        linked = seed_stem(entry, steps_done,
+                           self.store.checkpoint_stem(jid))
+        if linked is None:
+            return False
+        prov = {"hit": kind, "key": entry["key"],
+                "donor": entry.get("job_id"),
+                "generation_step": steps_done}
+        self.store.write_result(jid, 0, {
+            "outcome": "completed", "worker": None, "attempt": 0,
+            "job_id": jid, "steps_done": steps_done, "wall_s": 0.0,
+            "cache": prov, "last_checkpoint": linked,
+            "converged": entry.get("converged")})
+        j = self.store.journal
+        recs = [
+            j.append("cache_hit", job_id=jid, key=entry["key"],
+                     kind=kind, donor=entry.get("job_id"),
+                     generation_step=steps_done,
+                     steps_saved=steps_done,
+                     bytes_saved=entry.get("bytes"),
+                     trace_id=trace_id),
+            j.append("completed", job_id=jid, worker=None, attempt=0,
+                     steps_done=steps_done, cache=prov),
+        ]
+        self.cache.touch(entry["key"], kind="exact")
+        self._fold(recs)
+        return True
+
+    def _cacheable_config(self, job_id: str) -> Optional[dict]:
+        """The committed spec's config dict, or None for a job the
+        cache must ignore (fault plan, unloadable record) — memoized
+        per job id: committed specs are immutable and the dispatch
+        sweep asks on every poll tick."""
+        if job_id in self._cache_spec_cache:
+            return self._cache_spec_cache[job_id]
+        try:
+            spec = self.store.load_spec(job_id)
+        except (OSError, ValueError):
+            return None  # not cached: the record may still be landing
+        cfg = None if self._cache_exempt(spec) else spec.config
+        self._cache_spec_cache[job_id] = cfg
+        if len(self._cache_spec_cache) > 4096:
+            self._cache_spec_cache.pop(
+                next(iter(self._cache_spec_cache)))
+        return cfg
+
+    def _cache_serve_queued(self, due, now: float):
+        """Dispatch-time exact-hit sweep over due queued jobs (covers
+        specs admitted BEFORE their twin completed — the burst case
+        packing coalesces and admission-time lookup cannot see);
+        returns the due list minus the served jobs."""
+        if self.cache is None:
+            return due
+        entries = self.cache.entries()
+        if not entries:
+            return due
+        version = self.cache.version
+        out = []
+        for v in due:
+            if v.attempts > 0 or v.requeues > 0 or v.cancel_requested:
+                out.append(v)
+                continue
+            if self._cache_miss_memo.get(v.job_id) == version:
+                out.append(v)  # nothing new to hit since last tick
+                continue
+            config = self._cacheable_config(v.job_id)
+            hit = (lookup_exact(entries, config)
+                   if config is not None else None)
+            if hit is None or not self._cache_serve(
+                    v.job_id, hit, trace_id=v.trace_id):
+                self._cache_miss_memo[v.job_id] = version
+                if len(self._cache_miss_memo) > 4096:
+                    self._cache_miss_memo.pop(
+                        next(iter(self._cache_miss_memo)))
+                out.append(v)
+        return out
+
+    def _maybe_prefix_seed(self, v: JobView, now: float) -> None:
+        """Before a FRESH job's first solo dispatch: seed its
+        checkpoint stem from the newest admissible donor generation
+        and journal ``cache_prefix`` — the worker's ordinary
+        resume-before-run then continues from there, bitwise a
+        from-scratch solve (the resume-parity contract). A missing
+        payload (raced eviction) just means no seed: the job solves
+        from step 0, correct either way."""
+        if self.cache is None or v.attempts > 0 or v.requeues > 0:
+            return
+        config = self._cacheable_config(v.job_id)
+        if config is None:
+            return
+        found = lookup_prefix(self.cache.entries(), config)
+        if found is None:
+            return
+        entry, gen_step = found
+        marker = {"key": entry["key"], "donor": entry.get("job_id"),
+                  "generation_step": int(gen_step),
+                  "job_id": v.job_id}
+        if seed_stem(entry, gen_step,
+                     self.store.checkpoint_stem(v.job_id),
+                     marker=marker) is None:
+            return
+        rec = self.store.journal.append(
+            "cache_prefix", job_id=v.job_id, key=entry["key"],
+            donor=entry.get("job_id"), generation_step=int(gen_step),
+            steps_saved=int(gen_step), trace_id=v.trace_id)
+        self.cache.touch(entry["key"], kind="prefix")
+        self._cache_pins[v.job_id] = entry["key"]
+        self._fold([rec])
 
     # -- phase 5: dispatch -----------------------------------------------
 
@@ -574,6 +848,9 @@ class Heatd:
         due = sorted((v for v in jobs.values()
                       if v.state == "queued" and v.not_before <= now),
                      key=lambda v: (v.accepted_t or 0.0, v.job_id))
+        # Exact-hit sweep first: a queued twin of a job that completed
+        # since admission serves in O(1) instead of taking a slot.
+        due = self._cache_serve_queued(due, now)
         j = self.store.journal
         packed: set = set()
         if cfg.pack_jobs and len(due) > 1:
@@ -632,6 +909,11 @@ class Heatd:
             # poison-job classifier's distinct-worker count is exactly
             # the distinct-attempt count.
             wid = f"w-{v.job_id}-a{attempt:03d}"
+            # Prefix seed BEFORE the dispatch line: the seeded
+            # generation + `cache_prefix` line are durable by the time
+            # the journal says a worker may be running, so a crash
+            # anywhere re-dispatches with the same resume point.
+            self._maybe_prefix_seed(v, now)
             # Journal BEFORE spawn: a crash in between leaves a
             # `dispatched` job with no live worker — the reconcile
             # pass orphans and requeues it. The opposite order could
@@ -748,6 +1030,16 @@ class Heatd:
                                        for h in self._procs.values()}),
                "poll_interval_s": self.config.poll_interval_s,
                "counts": counts, "anomalies": len(anomalies)}
+        if self.cache is not None:
+            entries = self.cache.entries()
+            doc["cache"] = {
+                "entries": len(entries),
+                "bytes": sum(e.get("bytes") or 0
+                             for e in entries.values()),
+                "hits": sum(e.get("hits") or 0
+                            for e in entries.values()),
+                "prefix_hits": sum(e.get("prefix_hits") or 0
+                                   for e in entries.values())}
         self.store.write_daemon_status(doc)
         return doc
 
@@ -813,5 +1105,14 @@ class Heatd:
         self.step()  # final reconcile: orphan anything SIGKILLed above
         self.store.journal.append("daemon_exit", outcome="drained")
         self._publish_status(cfg.clock())
-        self.store.close()
+        self.close()
         return EXIT_PREEMPTED
+
+    def close(self) -> None:
+        """Release the daemon's journal handles — store AND cache
+        index. The teardown every non-``serve()`` driver (tests,
+        benches, chaos cells) should call; ``drain()`` routes through
+        it too."""
+        if self.cache is not None:
+            self.cache.close()
+        self.store.close()

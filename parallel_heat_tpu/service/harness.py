@@ -1,0 +1,68 @@
+"""Inline worker harness: the one spelling of the fake Popen handle.
+
+Benches (``bench.py --row serve_cache``), chaos cells
+(``tools/chaos_matrix.py``) and tests drive :class:`~parallel_heat_tpu.
+service.daemon.Heatd` with in-process workers — real
+``worker.execute_job`` runs, real checkpoints land, no subprocess.
+They all need the same Popen-shaped handle (``poll``/``terminate``/
+``kill``/``pid``); private copies of it had started to drift across
+the suites, and this module is the shared spelling every
+inline-EXECUTION driver now uses (``defer`` covers the
+deferred-occupancy variant too). Handles with genuinely different
+semantics stay local to their suites: ``test_service``'s scripted
+fakes (outcomes written by the test, nothing executes) and
+``test_ensemble``'s pack-routing launcher (``execute_pack`` at launch
+time). Deliberately tiny and dependency-free: production-adjacent
+test plumbing, not a service feature.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+
+class InlineHandle:
+    """Popen-shaped handle that runs its job on the first ``poll``.
+    ``defer`` keeps it 'running' for that many polls first —
+    deterministic queue occupancy for overload/packing scenarios."""
+
+    def __init__(self, run: Callable[[], int], defer: int = 1):
+        self._run = run
+        self._defer = int(defer)
+        self._polls = 0
+        self._rc: Optional[int] = None
+        self.pid = os.getpid()
+
+    def poll(self) -> Optional[int]:
+        self._polls += 1
+        if self._polls < self._defer:
+            return None
+        if self._rc is None:
+            self._rc = self._run()
+        return self._rc
+
+    def terminate(self) -> None:
+        pass
+
+    kill = terminate
+
+
+def inline_launcher(root: str, spawns: Optional[List[str]] = None,
+                    defer: int = 1) -> Callable:
+    """A ``HeatdConfig.launcher`` running solo jobs in-process via
+    ``worker.execute_job``. ``spawns`` (when given) records the job
+    ids actually launched — the zero-spawn assertion of an exact
+    cache hit reads it."""
+    from parallel_heat_tpu.service import worker as svc_worker
+
+    def launcher(job_id, worker_id, attempt, deadline_t):
+        if spawns is not None:
+            spawns.append(job_id)
+        return InlineHandle(
+            lambda: svc_worker.execute_job(str(root), job_id,
+                                           worker_id, attempt,
+                                           deadline_t=deadline_t),
+            defer=defer)
+
+    return launcher

@@ -147,6 +147,11 @@ class JobView:
     # Trace id from the `accepted` journal line (heattrace joins the
     # journal's queue spans to the worker telemetry by this id).
     trace_id: Optional[str] = None
+    # Cache provenance from the `completed` line of a cache-served job
+    # ({"hit": "exact"|"converged", "key", "donor",
+    # "generation_step"}) — the client's round-trip proof that the
+    # verdict came from a committed donor lineage, not a fresh solve.
+    cached: Optional[dict] = None
 
     @property
     def terminal(self) -> bool:
@@ -244,6 +249,8 @@ def reduce_journal(events, state=None
         elif ev in TERMINAL_STATES:
             v.state = ev
             v.terminal_t = t
+            if isinstance(e.get("cache"), dict):
+                v.cached = e["cache"]
             if e.get("kind"):
                 v.kind = e["kind"]
             if e.get("diagnosis"):

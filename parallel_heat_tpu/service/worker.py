@@ -40,6 +40,7 @@ import time
 from typing import Optional
 
 from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.service import cache
 from parallel_heat_tpu.service.store import JobStore
 from parallel_heat_tpu.supervisor import (
     EXIT_PERMANENT_FAILURE,
@@ -164,6 +165,20 @@ def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
             say(f"worker {worker_id}: resuming {job_id} from {src} "
                 f"at step {start_step}")
         telemetry.step_offset = start_step
+        if src is not None:
+            # Cache-seeded resume (SEMANTICS.md "Cache soundness"):
+            # the daemon dropped a marker next to the generation it
+            # linked from a donor lineage — journal the provenance
+            # into this run's stream so heattrace can attribute the
+            # skipped prefix. Only when the marker names the step we
+            # actually resumed at: a later own checkpoint (retry,
+            # orphan re-dispatch) supersedes the seed.
+            seed = cache.read_seed_marker(stem)
+            if seed and seed.get("generation_step") == start_step:
+                telemetry.emit("cache_prefix_resume",
+                               key=seed.get("key"),
+                               donor=seed.get("donor"),
+                               generation_step=start_step)
         run_cfg = config.replace(steps=max(0, total - start_step))
 
         faults = None
@@ -214,6 +229,15 @@ def execute_job(root: str, job_id: str, worker_id: str, attempt: int,
             return EXIT_PREEMPTED
         record("completed", steps_done=sres.steps_done,
                retries=sres.retries,
+               # Converge verdict (None for fixed runs): the cache's
+               # converge admissibility rules key on it — a
+               # budget-exhausted run's generations are provably
+               # verdict-free, a converged run dominates any larger
+               # budget (SEMANTICS.md "Cache soundness").
+               converged=(bool(sres.result.converged)
+                          if config.converge and sres.result is not None
+                          and sres.result.converged is not None
+                          else None),
                last_checkpoint=(str(sres.last_checkpoint)
                                 if sres.last_checkpoint else None))
         return 0

@@ -737,6 +737,31 @@ def latest_checkpoint(path):
     return None
 
 
+def link_snapshot(src: str, dst: str) -> None:
+    """Publish an already-COMMITTED gathered generation file at a
+    second path: hardlink when the filesystem allows (O(1), shares
+    bytes), else copy + fsync-rename. Either way ``dst`` appears
+    complete or not at all — the source is immutable once its own
+    rename landed, so a link is exactly as committed as the original.
+    This is how the heatd result cache captures donor lineages and
+    seeds a new job's stem from one (``service/cache.py``) without a
+    second serialization of the grid. No-op when ``dst`` exists: both
+    spellings of one committed generation hold identical bytes."""
+    if os.path.exists(dst):
+        return
+    try:
+        os.link(src, dst)
+        return
+    except OSError:
+        pass
+    import shutil
+
+    tmp = os.path.join(os.path.dirname(dst) or ".",
+                       f".tmp-{os.getpid()}-{os.path.basename(dst)}")
+    shutil.copyfile(src, tmp)
+    _fsync_replace(tmp, dst)
+
+
 # ---------------------------------------------------------------------------
 # Stem interlock (one writer per checkpoint generation family)
 # ---------------------------------------------------------------------------

@@ -56,7 +56,13 @@ and ``tools/heattrace.py`` are the consumers) — and carry:
   packed heatd dispatch: ``pack``/``members``/``job_ids``/
   ``est_hbm_bytes``); per-member ``diagnostics`` samples likewise
   carry ``member``. ``tools/metrics_report.py``'s ensemble section
-  aggregates these.
+  aggregates these;
+- ``cache_prefix_resume`` (heatd workers, SEMANTICS.md "Cache
+  soundness"): this run resumed from a cache-seeded donor generation
+  instead of step 0 — ``key``/``donor``/``generation_step`` attribute
+  the skipped prefix; the O(1) exact-hit path never runs a worker, so
+  its provenance lives on the JOURNAL (``cache_hit`` line, rendered
+  as a span by ``tools/heattrace.py``), not in any telemetry stream.
 
 The envelope also carries ``process_index``/``process_count``;
 multi-process runs shard the JSONL and heartbeat per process

@@ -483,7 +483,31 @@ def spans_from_journal(events: Sequence[dict]
             a = j.get("open_attempt")
             if a is not None:
                 a["t1"] = max(a["t1"], t)
-            if ev == "requeued":
+            if ev == "cache_hit":
+                # The O(1) serve: accepted -> verdict with no dispatch
+                # in between. Rendered as a real span (accepted_t to
+                # the hit line) so a warm submit's whole latency is
+                # one visible bar — the thing the serve_cache bench
+                # row measures (SEMANTICS.md "Cache soundness").
+                j["n"] += 1
+                spans.append({
+                    "name": f"cache hit ({e.get('kind') or 'exact'})",
+                    "cat": "cache", "t0": j["span"]["t0"], "t1": t,
+                    "trace_id": j["trace_id"],
+                    "span_id": f"{submit_span_id(jid)}.c{j['n']}",
+                    "parent_span_id": submit_span_id(jid),
+                    "pid": pid, "tid": "queue",
+                    "args": {"key": e.get("key"),
+                             "donor": e.get("donor"),
+                             "generation_step": e.get("generation_step"),
+                             "steps_saved": e.get("steps_saved"),
+                             "bytes_saved": e.get("bytes_saved")}})
+                j["wait_from"] = None
+            elif ev == "cache_prefix":
+                mark("cache_prefix",
+                     {"key": e.get("key"), "donor": e.get("donor"),
+                      "generation_step": e.get("generation_step")})
+            elif ev == "requeued":
                 j["wait_from"] = float(e.get("not_before") or t)
                 j["open_attempt"] = None
                 mark("requeued", {"reason": e.get("reason")})

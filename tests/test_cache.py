@@ -1,0 +1,862 @@
+"""The content-addressed result cache (SEMANTICS.md "Cache
+soundness"): key partition discipline, the index journal's fold law,
+the admissibility matrix, LRU eviction, and the daemon's exact/prefix
+serve paths with client provenance round-trips.
+
+Everything except the two inline end-to-end tests runs jax-free on
+fake entries and tmp dirs — the admissibility rules are pure functions
+and are tested as such. The bitwise proof obligation of prefix resume
+is pinned here at 16x16 and certified at the chaos level by
+``tools/chaos_matrix.py`` cell ``svc_cache_prefix_parity``.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.config import (
+    OBSERVATION_ONLY_FIELDS,
+    SEMANTIC_FIELDS,
+    HeatConfig,
+)
+from parallel_heat_tpu.service import cache as C
+from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+from parallel_heat_tpu.service.store import JobSpec, JobStore
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Key derivation: the SEMANTIC_FIELDS partition IS the cache key
+# ---------------------------------------------------------------------------
+
+def test_cache_key_ignores_observation_only_fields():
+    # The HL101 discipline applied to serving: enabling an observer
+    # must not fork (or miss) a cache entry.
+    base = {"nx": 16, "ny": 16, "steps": 60}
+    k1, _ = C.cache_key(base)
+    k2, _ = C.cache_key({**base, "guard_interval": 5,
+                         "diag_interval": 10, "pipeline_depth": 2})
+    assert k1 == k2
+
+
+def test_cache_key_moves_with_every_semantic_field():
+    base, _ = C.cache_key({"nx": 16, "ny": 16, "steps": 60})
+    moved = {
+        "nx": 17, "ny": 17, "nz": 4, "cx": 0.2, "cy": 0.2, "cz": 0.2,
+        "steps": 61, "converge": True, "eps": 1e-4,
+        "check_interval": 7, "dtype": "bfloat16", "backend": "jnp",
+        "mesh_shape": [2, 1], "overlap": False, "halo_depth": 2,
+        "halo_overlap": "phase", "accumulate": "f32chunk",
+    }
+    assert set(moved) == set(SEMANTIC_FIELDS)
+    for field, value in moved.items():
+        k, _ = C.cache_key({"nx": 16, "ny": 16, "steps": 60,
+                            field: value})
+        assert k != base, f"semantic field {field!r} did not move the key"
+
+
+def test_cache_key_defaults_are_canonical():
+    # Spelling a default explicitly cannot fork an entry.
+    k1, _ = C.cache_key({"nx": 16, "ny": 16, "steps": 60})
+    k2, _ = C.cache_key({"nx": 16, "ny": 16, "steps": 60,
+                         "backend": "auto", "overlap": True})
+    assert k1 == k2
+
+
+def test_cache_key_unclassified_field_fails_like_hl101():
+    # A new HeatConfig field in NEITHER partition tuple must fail key
+    # derivation loudly — the exact condition heatlint HL101 fails CI
+    # on, enforced independently at the serving layer.
+    @dataclasses.dataclass(frozen=True)
+    class Doctored(HeatConfig):
+        sneaky_new_field: int = 0
+
+    with pytest.raises(C.CacheKeyError, match="sneaky_new_field"):
+        C.cache_key({"nx": 16}, config_cls=Doctored)
+    with pytest.raises(C.CacheKeyError, match="HL101"):
+        C.cache_key({"nx": 16}, config_cls=Doctored)
+
+
+def test_cache_key_double_classified_field_fails():
+    with pytest.raises(C.CacheKeyError, match="double-classified"):
+        C.cache_key({"nx": 16},
+                    semantic=SEMANTIC_FIELDS + ("guard_interval",),
+                    observation=OBSERVATION_ONLY_FIELDS)
+
+
+def test_cache_key_unknown_field_refuses():
+    with pytest.raises(C.CacheKeyError, match="not_a_field"):
+        C.cache_key({"nx": 16, "not_a_field": 1})
+
+
+def test_base_key_excludes_exactly_the_stepping_fields():
+    b = C.base_key({"nx": 16, "ny": 16, "steps": 60})
+    assert C.base_key({"nx": 16, "ny": 16, "steps": 600,
+                       "converge": True, "eps": 1e-9,
+                       "check_interval": 5}) == b
+    assert C.base_key({"nx": 16, "ny": 16, "steps": 60,
+                       "dtype": "bfloat16"}) != b
+
+
+def test_partition_tuples_cover_heatconfig():
+    # The pin the doctored-subclass test relies on: the REAL config is
+    # fully classified, so key derivation never raises in production.
+    names = {f.name for f in dataclasses.fields(HeatConfig)}
+    assert names == set(SEMANTIC_FIELDS) | set(OBSERVATION_ONLY_FIELDS)
+    assert set(C.STEPPING_FIELDS) <= set(SEMANTIC_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# Index journal fold law
+# ---------------------------------------------------------------------------
+
+def _put(key, base="b", t=1000.0, **kw):
+    e = {"event": "cache_put", "key": key, "base": base, "t_wall": t,
+         "job_id": kw.pop("job_id", f"donor-{key}"), "attempt": 1,
+         "steps": 60, "converge": False, "eps": 1e-3,
+         "check_interval": 20, "steps_done": 60,
+         "generations": [20, 40, 60], "bytes": 100,
+         "payload": f"/p/{key}"}
+    e.update(kw)
+    return e
+
+
+def test_reduce_cache_journal_fold_law():
+    events = [
+        _put("k1", t=1.0), _put("k2", t=2.0),
+        {"event": "cache_touch", "key": "k1", "t_wall": 3.0},
+        {"event": "cache_touch", "key": "k2", "t_wall": 4.0,
+         "kind": "prefix"},
+        {"event": "cache_evict", "key": "k1"},
+        _put("k3", t=5.0),
+    ]
+    whole = C.reduce_cache_journal(events)
+    for cut in range(len(events) + 1):
+        state = C.reduce_cache_journal(events[:cut])
+        folded = C.reduce_cache_journal(events[cut:], state=state)
+        assert folded == whole
+    entries, anomalies = whole
+    assert set(entries) == {"k2", "k3"}
+    assert entries["k2"]["prefix_hits"] == 1
+    assert entries["k2"]["last_used_t"] == 4.0
+    assert anomalies == []
+
+
+def test_reduce_cache_journal_unknown_key_anomalies():
+    _, anomalies = C.reduce_cache_journal([
+        {"event": "cache_touch", "key": "ghost", "t_wall": 1.0},
+        {"event": "cache_evict", "key": "ghost2"},
+    ])
+    assert len(anomalies) == 2
+    assert "touch of unknown" in anomalies[0]
+    assert "evict of unknown" in anomalies[1]
+
+
+def test_reduce_cache_journal_put_replaces_and_reput_after_evict():
+    entries, anomalies = C.reduce_cache_journal([
+        _put("k1", t=1.0, steps_done=60),
+        _put("k1", t=2.0, steps_done=60, bytes=200),
+        {"event": "cache_evict", "key": "k1"},
+        _put("k1", t=3.0),
+    ])
+    assert anomalies == []
+    assert entries["k1"]["put_t"] == 3.0
+    # post-evict re-put starts fresh (the old usage died with the
+    # entry)
+    assert entries["k1"]["hits"] == 0
+    assert entries["k1"]["last_used_t"] == 3.0
+
+
+def test_reduce_cache_journal_reput_of_live_key_keeps_usage():
+    # Two twins dispatched before either completed: the second
+    # completion re-puts the same content address. The entry's LRU
+    # recency and hit counters must survive, or a hot entry gets
+    # evicted ahead of cold ones.
+    entries, anomalies = C.reduce_cache_journal([
+        _put("k1", t=1.0),
+        {"event": "cache_touch", "key": "k1", "t_wall": 50.0},
+        {"event": "cache_touch", "key": "k1", "t_wall": 51.0,
+         "kind": "prefix"},
+        _put("k1", t=2.0, job_id="twin"),
+    ])
+    assert anomalies == []
+    assert entries["k1"]["hits"] == 1
+    assert entries["k1"]["prefix_hits"] == 1
+    assert entries["k1"]["last_used_t"] == 50.0 + 1.0
+    assert entries["k1"]["job_id"] == "twin"  # content refreshed
+
+
+def test_reduce_cache_journal_ignores_foreign_lines():
+    entries, anomalies = C.reduce_cache_journal([
+        {"event": "mystery_event", "key": "k1"},
+        {"event": "cache_put"},  # no key
+        {"not": "an event"},
+    ])
+    assert entries == {} and anomalies == []
+
+
+# ---------------------------------------------------------------------------
+# Admissibility (pure lookups over fake entries; fake clocks)
+# ---------------------------------------------------------------------------
+
+_FIXED60 = {"nx": 16, "ny": 16, "steps": 60}
+
+
+def _entry_for(config, steps_done, converged=None, gens=None,
+               job_id="donor", t=1000.0):
+    key, canon = C.cache_key(config)
+    return _put(key, base=C.base_key(config), t=t, job_id=job_id,
+                steps=canon["steps"], converge=canon["converge"],
+                eps=canon["eps"], check_interval=canon["check_interval"],
+                steps_done=steps_done, converged=converged,
+                generations=gens or [steps_done])
+
+
+def _entries(*events):
+    entries, anomalies = C.reduce_cache_journal(list(events))
+    assert anomalies == []
+    return entries
+
+def test_lookup_exact_same_key():
+    entries = _entries(_entry_for(_FIXED60, 60))
+    hit = C.lookup_exact(entries, dict(_FIXED60, guard_interval=5))
+    assert hit is not None and hit[1] == "exact"
+
+
+def test_lookup_exact_misses_without_final_generation():
+    # An entry whose newest retained generation is not the committed
+    # result (should not exist by the put gate, but the lookup must
+    # not trust it) cannot serve O(1).
+    entries = _entries(_entry_for(_FIXED60, 60, gens=[20, 40]))
+    assert C.lookup_exact(entries, _FIXED60) is None
+
+
+def test_lookup_exact_converged_dominance():
+    conv = {"nx": 16, "ny": 16, "steps": 100, "converge": True,
+            "eps": 1e-2, "check_interval": 10}
+    entries = _entries(_entry_for(conv, 40, converged=True))
+    # Larger budget, same eps/cadence: the scratch run converges at
+    # the donor's window with the donor's grid.
+    hit = C.lookup_exact(entries, dict(conv, steps=400))
+    assert hit is not None and hit[1] == "converged"
+    # Budget BELOW the convergence step: the scratch run would stop
+    # unconverged at 30 — a different grid; must miss.
+    assert C.lookup_exact(entries, dict(conv, steps=30)) is None
+    # Different eps: different verdict sequence; must miss.
+    assert C.lookup_exact(entries, dict(conv, steps=400,
+                                        eps=2e-2)) is None
+    # A fixed target never takes a converged-dominance serve.
+    assert C.lookup_exact(entries, dict(_FIXED60, steps=400)) is None
+
+
+def test_lookup_prefix_fixed_extension():
+    entries = _entries(_entry_for(_FIXED60, 60, gens=[20, 40, 60]))
+    entry, gen = C.lookup_prefix(entries, dict(_FIXED60, steps=120))
+    assert gen == 60
+    # Equal budget is the exact path's job, not a prefix.
+    assert C.lookup_prefix(entries, _FIXED60) == (entry, 40)
+
+
+def test_lookup_prefix_picks_newest_admissible_generation():
+    e1 = _entry_for(_FIXED60, 60, gens=[20, 40, 60], job_id="d1")
+    e2 = _entry_for(dict(_FIXED60, steps=200), 200,
+                    gens=[100, 150, 200], job_id="d2")
+    entries = _entries(e1, e2)
+    _, gen = C.lookup_prefix(entries, dict(_FIXED60, steps=180))
+    assert gen == 150  # 200 is past the budget; 150 beats 60
+    # Converge donors' generations serve fixed targets too — the
+    # trajectory is the same stepping (the cross-arm is sound this
+    # direction: stopping verdicts don't exist in fixed mode).
+    e3 = _entry_for(dict(_FIXED60, steps=400, converge=True,
+                         eps=1e-9, check_interval=10),
+                    400, converged=False, gens=[300, 350, 400],
+                    job_id="d3")
+    entries = _entries(e1, e2, e3)
+    _, gen = C.lookup_prefix(entries, dict(_FIXED60, steps=390))
+    assert gen == 350
+
+
+def test_lookup_prefix_semantic_mismatch_never_crosses():
+    entries = _entries(_entry_for(_FIXED60, 60, gens=[20, 40, 60]))
+    for delta in ({"dtype": "bfloat16"}, {"cx": 0.2},
+                  {"nx": 32, "ny": 32}):
+        target = dict(_FIXED60, steps=120, **delta)
+        assert C.lookup_prefix(entries, target) is None, delta
+
+
+def test_lookup_prefix_converge_needs_unconverged_donor():
+    conv = {"nx": 16, "ny": 16, "steps": 40, "converge": True,
+            "eps": 1e-9, "check_interval": 10}
+    exhausted = _entry_for(conv, 40, converged=False,
+                           gens=[20, 30, 40], job_id="ex")
+    entries = _entries(exhausted)
+    entry, gen = C.lookup_prefix(entries, dict(conv, steps=80))
+    assert gen == 40
+    # A CONVERGED donor has a verdict inside its window sequence —
+    # nothing sound to resume past for a converge target.
+    converged = _entry_for(dict(conv, steps=100), 40, converged=True,
+                           gens=[20, 30, 40], job_id="cv")
+    entries = _entries(converged)
+    assert C.lookup_prefix(entries, dict(conv, steps=80)) is None
+    # Cadence must match: eps or check_interval off by anything kills
+    # the verdict-alignment argument.
+    entries = _entries(exhausted)
+    assert C.lookup_prefix(entries, dict(conv, steps=80,
+                                         eps=1e-8)) is None
+    assert C.lookup_prefix(entries, dict(conv, steps=80,
+                                         check_interval=20)) is None
+
+
+def test_lookup_prefix_fixed_donor_converge_target_needs_evidence():
+    fixed = _entry_for(dict(_FIXED60, steps=200), 200,
+                       gens=[100, 150, 200], job_id="fx")
+    conv_target = {"nx": 16, "ny": 16, "steps": 400, "converge": True,
+                   "eps": 1e-9, "check_interval": 10}
+    # No converge entry proves non-convergence: the scratch run might
+    # stop before any donor generation — MUST decline (the bitwise
+    # contract is the acceptance criterion, not best-effort reuse).
+    assert C.lookup_prefix(_entries(fixed), conv_target) is None
+    # An unconverged converge sibling through step 120 licenses
+    # generations <= 120 — so gen 100, not the newer 150/200.
+    evidence = _entry_for(dict(conv_target, steps=120), 120,
+                          converged=False, gens=[100, 110, 120],
+                          job_id="ev")
+    entries = _entries(fixed, evidence)
+    entry, gen = C.lookup_prefix(entries, conv_target)
+    assert gen == 120  # the evidence entry's own newest window
+    # Strictly-later convergence is evidence too (no verdict BEFORE
+    # it), licensing the fixed donor's 150 (< 160) but not 200.
+    conv_late = _entry_for(dict(conv_target, steps=300), 160,
+                           converged=True, gens=[140, 150, 160],
+                           job_id="cl")
+    entries = _entries(fixed, conv_late)
+    entry, gen = C.lookup_prefix(entries, conv_target)
+    assert (entry["job_id"], gen) == ("fx", 150)
+
+
+def test_lookup_prefix_alignment_to_check_interval():
+    # A converge target may only resume at its own window boundaries:
+    # a mid-window start would shift every later verdict step.
+    conv = {"nx": 16, "ny": 16, "steps": 80, "converge": True,
+            "eps": 1e-9, "check_interval": 25}
+    donor = _entry_for(dict(conv, steps=60), 60, converged=False,
+                       gens=[40, 50, 60], job_id="dx")
+    entries = _entries(donor)
+    found = C.lookup_prefix(entries, conv)
+    assert found is not None and found[1] == 50  # 60, 40 misalign
+
+
+# ---------------------------------------------------------------------------
+# Eviction policy (fake clocks)
+# ---------------------------------------------------------------------------
+
+def test_evict_candidates_lru_order_and_budgets():
+    events = [_put(f"k{i}", t=float(i), bytes=100) for i in range(5)]
+    events.append({"event": "cache_touch", "key": "k0", "t_wall": 99.0})
+    entries = _entries(*events)
+    # 500 B held, budget 250: evict oldest-used first — k1, k2, k3
+    # (k0 was touched to t=99).
+    assert C.evict_candidates(entries, 250, None) == ["k1", "k2", "k3"]
+    assert C.evict_candidates(entries, None, 2) == ["k1", "k2", "k3"]
+    assert C.evict_candidates(entries, None, None) == []
+
+
+def test_evict_candidates_pinned_donors_survive():
+    entries = _entries(*[_put(f"k{i}", t=float(i), bytes=100)
+                         for i in range(3)])
+    # k0 (oldest) is pinned: the budget is met by the next-oldest.
+    assert C.evict_candidates(entries, None, 2,
+                              pinned=["k0"]) == ["k1"]
+    assert C.evict_candidates(entries, None, 1,
+                              pinned=["k0"]) == ["k1", "k2"]
+    # Only pinned entries left: stays over budget rather than evict.
+    assert C.evict_candidates(entries, None, 0,
+                              pinned=["k0", "k1", "k2"]) == []
+
+
+# ---------------------------------------------------------------------------
+# CacheIndex durability (tmp dirs, no jax)
+# ---------------------------------------------------------------------------
+
+def _fake_lineage(tmp_path, job="donor", steps=(20, 40, 60),
+                  shape=(4, 4)):
+    """A committed gathered-generation family a real run would leave."""
+    d = tmp_path / "ck" / job
+    d.mkdir(parents=True, exist_ok=True)
+    stem = str(d / "ck")
+    for s in steps:
+        np.savez(f"{stem}.g{s:012d}.npz",
+                 grid=np.full(shape, float(s), dtype=np.float32),
+                 step=np.int64(s))
+    return stem
+
+
+def test_cache_index_put_lookup_roundtrip(tmp_path):
+    idx = C.CacheIndex(str(tmp_path))
+    stem = _fake_lineage(tmp_path)
+    entry = idx.put(_FIXED60, stem, job_id="donor", attempt=1,
+                    steps_done=60)
+    assert entry is not None
+    assert entry["generations"] == [20, 40, 60]
+    assert entry["bytes"] > 0
+    # Cold reload folds to the same state (daemon restart).
+    entries, anomalies, bad, torn = C.load_cache_index(str(tmp_path))
+    assert anomalies == [] and bad == 0 and not torn
+    assert entries[entry["key"]]["payload"] == entry["payload"]
+    hit = C.lookup_exact(entries, _FIXED60)
+    assert hit is not None
+    idx.close()
+
+
+def test_cache_index_put_declines_nonfinite_and_stale(tmp_path):
+    idx = C.CacheIndex(str(tmp_path))
+    stem = _fake_lineage(tmp_path, job="bad")
+    np.savez(f"{stem}.g{60:012d}.npz",
+             grid=np.full((4, 4), np.nan, dtype=np.float32),
+             step=np.int64(60))
+    assert idx.put(_FIXED60, stem, job_id="bad", attempt=1,
+                   steps_done=60) is None  # non-finite result
+    stem2 = _fake_lineage(tmp_path, job="stale", steps=(20, 40))
+    assert idx.put(_FIXED60, stem2, job_id="stale", attempt=1,
+                   steps_done=60) is None  # newest gen != steps_done
+    assert idx.put(_FIXED60, str(tmp_path / "nothing" / "ck"),
+                   job_id="none", attempt=1, steps_done=60) is None
+    assert idx.entries() == {}
+    idx.close()
+
+
+def test_cache_index_evict_then_sweep(tmp_path):
+    idx = C.CacheIndex(str(tmp_path))
+    stem = _fake_lineage(tmp_path)
+    entry = idx.put(_FIXED60, stem, job_id="donor", attempt=1,
+                    steps_done=60)
+    payload = entry["payload"]
+    assert os.path.isdir(payload)
+    idx.evict(entry["key"])
+    assert not os.path.isdir(payload)
+    assert idx.entries() == {}
+    # Orphan payload (the evict-line-then-delete crash window, or a
+    # put that never reached its index line): swept, never served.
+    os.makedirs(os.path.join(str(tmp_path), "cache", "orphanpayload"))
+    assert idx.sweep_orphans() == 1
+    idx.close()
+
+
+def test_cache_index_torn_tail_invisible(tmp_path):
+    idx = C.CacheIndex(str(tmp_path))
+    stem = _fake_lineage(tmp_path)
+    idx.put(_FIXED60, stem, job_id="donor", attempt=1, steps_done=60)
+    idx.close()
+    with open(os.path.join(str(tmp_path), "cache", "index.jsonl"),
+              "a") as f:
+        f.write('{"event": "cache_put", "key": "torn')  # no newline
+    entries, anomalies, bad, torn = C.load_cache_index(str(tmp_path))
+    assert len(entries) == 1 and anomalies == [] and bad == 0 and torn
+
+
+def test_seed_stem_and_marker_roundtrip(tmp_path):
+    idx = C.CacheIndex(str(tmp_path))
+    stem = _fake_lineage(tmp_path)
+    entry = idx.put(_FIXED60, stem, job_id="donor", attempt=1,
+                    steps_done=60)
+    dst = str(tmp_path / "ck" / "newjob" / "ck")
+    marker = {"key": entry["key"], "donor": "donor",
+              "generation_step": 40}
+    seeded = C.seed_stem(entry, 40, dst, marker=marker)
+    assert seeded == f"{dst}.g{40:012d}.npz"
+    with np.load(seeded) as z:
+        assert float(z["grid"][0, 0]) == 40.0
+    assert C.read_seed_marker(dst) == marker
+    # Missing generation -> None, caller solves from scratch.
+    assert C.seed_stem(entry, 99, dst) is None
+    idx.close()
+
+
+# ---------------------------------------------------------------------------
+# Durability audit (heatq --check's cache half)
+# ---------------------------------------------------------------------------
+
+def _audit_fixture(tmp_path):
+    root = str(tmp_path)
+    store = JobStore(root)
+    idx = C.CacheIndex(root)
+    stem = _fake_lineage(tmp_path, job="donor")
+    store.write_result("donor", 1, {"outcome": "completed",
+                                    "job_id": "donor",
+                                    "steps_done": 60})
+    entry = idx.put(_FIXED60, stem, job_id="donor", attempt=1,
+                    steps_done=60)
+    idx.close()
+    store.close()
+    return root, entry
+
+
+def test_audit_cache_clean(tmp_path):
+    root, _ = _audit_fixture(tmp_path)
+    entries, anomalies, _, _ = C.load_cache_index(root)
+    assert anomalies == []
+    assert C.audit_cache(root, entries) == []
+
+
+def test_audit_cache_dangling_entry(tmp_path):
+    import shutil
+
+    root, entry = _audit_fixture(tmp_path)
+    shutil.rmtree(entry["payload"])
+    entries, _, _, _ = C.load_cache_index(root)
+    anoms = C.audit_cache(root, entries)
+    assert len(anoms) == 1 and "dangling" in anoms[0]
+    # a missing generation FILE (payload dir present) is dangling too
+    root2 = tmp_path / "r2"
+    root2.mkdir()
+    r2, e2 = _audit_fixture(root2)
+    os.unlink(os.path.join(e2["payload"], f"ck.g{40:012d}.npz"))
+    entries, _, _, _ = C.load_cache_index(r2)
+    anoms = C.audit_cache(r2, entries)
+    assert len(anoms) == 1 and "generation 40 missing" in anoms[0]
+
+
+def test_audit_cache_uncommitted_result(tmp_path):
+    root, entry = _audit_fixture(tmp_path)
+    os.unlink(os.path.join(root, "results", "donor.a0001.json"))
+    entries, _, _, _ = C.load_cache_index(root)
+    anoms = C.audit_cache(root, entries)
+    assert len(anoms) == 1 and "uncommitted result" in anoms[0]
+
+
+def test_heatq_check_gates_on_cache_anomalies(tmp_path):
+    import shutil
+    import subprocess
+    import sys
+
+    root, entry = _audit_fixture(tmp_path)
+    heatq = os.path.join(_ROOT, "tools", "heatq.py")
+
+    def run():
+        return subprocess.run(
+            [sys.executable, heatq, root, "--check", "--json"],
+            capture_output=True, text=True)
+
+    r = run()
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["cache"]["entries"] == 1
+    assert doc["cache"]["anomalies"] == []
+    shutil.rmtree(entry["payload"])  # dangling now
+    r = run()
+    assert r.returncode == 2, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert any("dangling" in a for a in doc["cache"]["anomalies"])
+
+
+# ---------------------------------------------------------------------------
+# Daemon integration: serve paths, provenance, pins (fake clocks where
+# no solve is needed; real 16x16 inline solves end-to-end)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _inline_daemon(root, **kw):
+    from parallel_heat_tpu.service.harness import inline_launcher
+
+    spawns = []
+    kw.setdefault("slots", 1)
+    kw.setdefault("requeue_backoff_base_s", 0.0)
+    d = Heatd(HeatdConfig(root=str(root),
+                          launcher=inline_launcher(str(root), spawns),
+                          **kw))
+    return d, spawns
+
+
+def _run_until_terminal(d, jid, passes=40):
+    for _ in range(passes):
+        d.step()
+        jobs, anomalies = d.store.replay()
+        if jid in jobs and jobs[jid].terminal:
+            return jobs, anomalies
+    raise AssertionError(f"{jid} never terminal: {jobs.get(jid)}")
+
+
+def _spec(jid, steps=60, **cfg_kw):
+    cfg = {"nx": 16, "ny": 16, "steps": steps, "backend": "jnp"}
+    cfg.update(cfg_kw)
+    return JobSpec(job_id=jid, config=cfg, checkpoint_every=20)
+
+
+def test_end_to_end_exact_hit_zero_spawns_with_provenance(tmp_path):
+    from parallel_heat_tpu import HeatConfig as HC
+    from parallel_heat_tpu import solve
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    d, spawns = _inline_daemon(tmp_path / "q")
+    d.store.spool_submit(_spec("cold"))
+    _run_until_terminal(d, "cold")
+    d.store.spool_submit(_spec("warm"))
+    jobs, anomalies = _run_until_terminal(d, "warm")
+    assert anomalies == []
+    assert spawns == ["cold"]  # ZERO spawns for the warm submit
+    v = jobs["warm"]
+    assert v.state == "completed" and v.steps_done == 60
+    assert v.attempts == 0  # no dispatch ever journaled
+    assert v.cached == {"hit": "exact",
+                        "key": v.cached["key"],
+                        "donor": "cold", "generation_step": 60}
+    # provenance in the rename-committed result record too
+    rec = d.store.read_result("warm", 0)
+    assert rec["outcome"] == "completed"
+    assert rec["cache"]["donor"] == "cold"
+    # the served job's lineage is on disk, bitwise the real solve
+    cfg = HC(nx=16, ny=16, steps=60, backend="jnp")
+    grid, step, _ = load_checkpoint(
+        latest_checkpoint(d.store.checkpoint_stem("warm")), cfg)
+    assert step == 60
+    np.testing.assert_array_equal(np.asarray(grid),
+                                  solve(cfg).to_numpy())
+    # the accepted line priced zero HBM (nothing will run)
+    events, _, _ = d.store.read_journal()
+    accepted = [e for e in events if e.get("event") == "accepted"
+                and e.get("job_id") == "warm"]
+    assert accepted[0]["hbm_bytes"] == 0
+    d.close()
+
+
+def test_end_to_end_prefix_resume_bitwise(tmp_path):
+    from parallel_heat_tpu import HeatConfig as HC
+    from parallel_heat_tpu import solve
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    d, spawns = _inline_daemon(tmp_path / "q")
+    d.store.spool_submit(_spec("short"))
+    _run_until_terminal(d, "short")
+    d.store.spool_submit(_spec("long", steps=120))
+    jobs, anomalies = _run_until_terminal(d, "long")
+    assert anomalies == []
+    assert spawns == ["short", "long"]  # prefix still runs a worker
+    events, _, _ = d.store.read_journal()
+    pre = [e for e in events if e.get("event") == "cache_prefix"]
+    assert len(pre) == 1
+    assert pre[0]["job_id"] == "long"
+    assert pre[0]["donor"] == "short"
+    assert pre[0]["generation_step"] == 60 == pre[0]["steps_saved"]
+    # THE acceptance criterion: bitwise a from-scratch solve.
+    cfg = HC(nx=16, ny=16, steps=120, backend="jnp")
+    grid, step, _ = load_checkpoint(
+        latest_checkpoint(d.store.checkpoint_stem("long")), cfg)
+    assert step == 120
+    np.testing.assert_array_equal(np.asarray(grid),
+                                  solve(cfg).to_numpy())
+    # the worker journaled its provenance into the telemetry stream
+    with open(d.store.telemetry_path("long")) as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    resumes = [e for e in evs if e.get("event") == "cache_prefix_resume"]
+    assert len(resumes) == 1 and resumes[0]["generation_step"] == 60
+    d.close()
+
+
+def test_faulted_specs_bypass_cache_both_ways(tmp_path):
+    d, spawns = _inline_daemon(tmp_path / "q")
+    # A fault-injected run must not POPULATE the cache...
+    d.store.spool_submit(_spec("chaotic",
+                               faults={"transient_on_chunks": [1]},
+                               faults_on_attempt=2))
+    _run_until_terminal(d, "chaotic")
+    assert d.cache.entries() == {}
+    d.store.spool_submit(_spec("clean"))
+    _run_until_terminal(d, "clean")
+    assert len(d.cache.entries()) == 1
+    # ...and must not be SERVED from it either.
+    d.store.spool_submit(_spec("chaotic2",
+                               faults={"transient_on_chunks": [1]},
+                               faults_on_attempt=2))
+    jobs, anomalies = _run_until_terminal(d, "chaotic2")
+    assert anomalies == []
+    assert jobs["chaotic2"].cached is None
+    assert "chaotic2" in spawns
+    d.close()
+
+
+def test_cache_disabled_runs_every_submit(tmp_path):
+    d, spawns = _inline_daemon(tmp_path / "q", cache_results=False)
+    assert d.cache is None
+    for jid in ("a", "b"):
+        d.store.spool_submit(_spec(jid))
+        jobs, anomalies = _run_until_terminal(d, jid)
+    assert spawns == ["a", "b"]
+    assert jobs["b"].cached is None
+    assert not os.path.exists(os.path.join(str(tmp_path / "q"),
+                                           "cache", "index.jsonl"))
+    d.close()
+
+
+def test_eviction_budget_enforced_end_to_end(tmp_path):
+    # max_entries=1: completing a second distinct spec evicts the
+    # first entry (older LRU stamp) and deletes its payload bytes.
+    d, spawns = _inline_daemon(tmp_path / "q", cache_max_entries=1)
+    d.store.spool_submit(_spec("a", steps=40))
+    _run_until_terminal(d, "a")
+    first = dict(d.cache.entries())
+    d.store.spool_submit(_spec("b", steps=60))
+    _run_until_terminal(d, "b")
+    entries = d.cache.entries()
+    assert len(entries) == 1
+    (key, e), = entries.items()
+    assert e["job_id"] == "b"
+    old_payload = next(iter(first.values()))["payload"]
+    assert not os.path.isdir(old_payload)
+    # the evicted spec re-solves instead of serving
+    d.store.spool_submit(_spec("a2", steps=40))
+    jobs, anomalies = _run_until_terminal(d, "a2")
+    assert anomalies == [] and "a2" in spawns
+    assert jobs["a2"].cached is None
+    d.close()
+
+
+def test_dispatch_time_hit_for_jobs_queued_before_donor_completed(
+        tmp_path):
+    # The burst case: twin specs admitted together, slots=1 — the
+    # second must serve from the first's completion at DISPATCH time
+    # (admission-time lookup saw an empty cache).
+    d, spawns = _inline_daemon(tmp_path / "q", slots=1)
+    d.store.spool_submit(_spec("t1"))
+    d.store.spool_submit(_spec("t2"))
+    d.step()  # both admitted; t1 dispatched (inline: completes on poll)
+    jobs, anomalies = _run_until_terminal(d, "t2")
+    assert anomalies == []
+    assert spawns == ["t1"]
+    assert jobs["t2"].state == "completed"
+    assert (jobs["t2"].cached or {}).get("donor") == "t1"
+    d.close()
+
+
+def test_crash_between_result_and_index_loses_entry_not_job(tmp_path):
+    # The svc_cache_crash window, unit-level (the chaos cell does it
+    # with a real SIGKILL): journal says completed, cache index says
+    # nothing -> a rebuilt daemon re-solves the next identical submit.
+    root = tmp_path / "q"
+    d, spawns = _inline_daemon(root)
+    real_put = d.cache.put
+    d.cache.put = lambda *a, **k: None  # the append never happens
+    d.store.spool_submit(_spec("j1"))
+    jobs, anomalies = _run_until_terminal(d, "j1")
+    assert jobs["j1"].state == "completed" and anomalies == []
+    d.cache.put = real_put
+    d.close()
+
+    d2, spawns2 = _inline_daemon(root)
+    assert d2.cache.entries() == {}  # entry lost
+    d2.store.spool_submit(_spec("j2"))
+    jobs, anomalies = _run_until_terminal(d2, "j2")
+    assert anomalies == []
+    assert spawns2 == ["j2"]  # re-solved, not served
+    assert jobs["j2"].cached is None
+    d2.close()
+
+
+def test_journal_cache_spans_in_heattrace_model():
+    # The acceptance criterion's "visible as a cache_hit span":
+    # spans_from_journal renders the O(1) serve as a real span
+    # (accepted -> verdict) parented under the job, and the prefix
+    # line as an instant.
+    from parallel_heat_tpu.utils.tracing import (
+        chrome_trace,
+        spans_from_journal,
+        submit_span_id,
+    )
+
+    events = [
+        {"event": "accepted", "job_id": "w", "t_wall": 10.0,
+         "trace_id": "t-1"},
+        {"event": "cache_hit", "job_id": "w", "t_wall": 10.01,
+         "key": "k", "kind": "exact", "donor": "d",
+         "generation_step": 60, "steps_saved": 60,
+         "bytes_saved": 1234, "trace_id": "t-1"},
+        {"event": "completed", "job_id": "w", "t_wall": 10.02,
+         "steps_done": 60,
+         "cache": {"hit": "exact", "key": "k", "donor": "d"}},
+        {"event": "accepted", "job_id": "p", "t_wall": 11.0},
+        {"event": "cache_prefix", "job_id": "p", "t_wall": 11.01,
+         "key": "k", "donor": "d", "generation_step": 60},
+        {"event": "dispatched", "job_id": "p", "t_wall": 11.02,
+         "worker": "w-p-a001", "attempt": 1},
+        {"event": "completed", "job_id": "p", "t_wall": 12.0,
+         "steps_done": 120},
+    ]
+    spans, instants = spans_from_journal(events)
+    hit = [s for s in spans if s["name"].startswith("cache hit")]
+    assert len(hit) == 1
+    assert hit[0]["cat"] == "cache"
+    assert hit[0]["parent_span_id"] == submit_span_id("w")
+    assert (hit[0]["t0"], hit[0]["t1"]) == (10.0, 10.01)
+    assert hit[0]["args"]["donor"] == "d"
+    assert hit[0]["trace_id"] == "t-1"
+    pre = [i for i in instants if i["name"] == "cache_prefix"]
+    assert len(pre) == 1 and pre[0]["args"]["generation_step"] == 60
+    # the whole thing still exports as valid Chrome trace JSON
+    doc = chrome_trace(spans, instants)
+    assert any(e.get("name", "").startswith("cache hit")
+               for e in doc["traceEvents"])
+
+
+def test_fleet_counters_and_fail_on_gate(tmp_path):
+    import importlib.util
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_report", os.path.join(_ROOT, "tools",
+                                       "metrics_report.py"))
+    mr = importlib.util.module_from_spec(spec)
+    _sys.modules.setdefault("metrics_report", mr)
+    spec.loader.exec_module(mr)
+
+    d, _ = _inline_daemon(tmp_path / "q")
+    d.store.spool_submit(_spec("c1"))
+    _run_until_terminal(d, "c1")
+    d.store.spool_submit(_spec("c2"))
+    _run_until_terminal(d, "c2")
+    d.store.spool_submit(_spec("c3", steps=120))
+    _run_until_terminal(d, "c3")
+    d.close()
+    doc = mr.summarize_fleet(str(tmp_path / "q"))
+    f = doc["fleet"]
+    assert f["cache_hits"] == 1
+    assert f["cache_prefix_hits"] == 1
+    assert f["cache_hit_rate"] == round(1 / 3, 4)
+    assert f["cache_prefix_rate"] == round(1 / 3, 4)
+    assert f["cache_bytes_saved"] > 0
+    assert f["cache_steps_saved"] == 60 + 60
+    # the shared --fail-on grammar gates the new counters: a floor
+    # that holds, then one that doesn't
+    exists, val = mr.resolve_metric(f, "cache_hit_rate")
+    assert exists and val is not None
+    assert "cache" in mr.render_fleet_text(doc)
+    # Duplicate cache lines for ONE job (a daemon crash between the
+    # cache line and its companion append replays the serve on
+    # restart) must not inflate the distinct-job counters.
+    store = JobStore(str(tmp_path / "q"), create=False)
+    evs, _, _ = store.read_journal()
+    dup = next(e for e in evs if e.get("event") == "cache_hit")
+    store.journal.append("cache_hit", **{k: v for k, v in dup.items()
+                                         if k not in ("schema",
+                                                      "t_wall",
+                                                      "pid",
+                                                      "event")})
+    store.close()
+    f2 = mr.summarize_fleet(str(tmp_path / "q"))["fleet"]
+    assert f2["cache_hits"] == 1
+    assert f2["cache_steps_saved"] == f["cache_steps_saved"]

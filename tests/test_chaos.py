@@ -853,44 +853,18 @@ def test_service_overload_rejects_never_drops(tmp_path):
     # Overload burst past the admission gate: rejected with a
     # retry-after hint, never accepted-then-dropped; the admitted jobs
     # complete bitwise through real (inline) execution.
-    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.harness import inline_launcher
     from parallel_heat_tpu.utils.checkpoint import (
         latest_checkpoint as _latest,
         load_checkpoint as _load,
     )
 
     root = str(tmp_path / "q")
-
-    class DeferredInline:
-        # Stays 'running' for several polls before executing —
-        # deterministic queue occupancy, so the burst actually finds
-        # the gate closed (instant inline completion would drain it).
-        def __init__(self, run, defer=10):
-            self._run = run
-            self._defer = defer
-            self._polls = 0
-            self._rc = None
-            self.pid = os.getpid()
-
-        def poll(self):
-            self._polls += 1
-            if self._polls < self._defer:
-                return None
-            if self._rc is None:
-                self._rc = self._run()
-            return self._rc
-
-        def terminate(self):
-            pass
-
-        kill = terminate
-
-    def launcher(job_id, worker_id, attempt, deadline_t):
-        return DeferredInline(lambda: svc_worker.execute_job(
-            root, job_id, worker_id, attempt, deadline_t=deadline_t))
-
-    d = _service_daemon(root, launcher=launcher, max_queue_depth=2,
-                        worker_env=None)
+    # defer=10: the handle stays 'running' for several polls before
+    # executing — deterministic queue occupancy, so the burst actually
+    # finds the gate closed (instant inline completion would drain it).
+    d = _service_daemon(root, launcher=inline_launcher(root, defer=10),
+                        max_queue_depth=2, worker_env=None)
     for i in range(5):
         d.store.spool_submit(_service_spec(f"j{i}"))
         d.step()
@@ -920,34 +894,14 @@ def test_service_deadline_interrupts_through_supervisor(tmp_path):
     # supervisor's flag-only path: checkpoint flushed, preempted
     # record with reason "deadline", journaled deadline_expired —
     # with the partial progress durable.
-    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.harness import inline_launcher
     from parallel_heat_tpu.utils.checkpoint import (
         latest_checkpoint as _latest,
     )
 
     root = str(tmp_path / "q")
-
-    class InlineHandle:
-        def __init__(self, run):
-            self._run = run
-            self._rc = None
-            self.pid = os.getpid()
-
-        def poll(self):
-            if self._rc is None:
-                self._rc = self._run()
-            return self._rc
-
-        def terminate(self):
-            pass
-
-        kill = terminate
-
-    def launcher(job_id, worker_id, attempt, deadline_t):
-        return InlineHandle(lambda: svc_worker.execute_job(
-            root, job_id, worker_id, attempt, deadline_t=deadline_t))
-
-    d = _service_daemon(root, launcher=launcher, worker_env=None)
+    d = _service_daemon(root, launcher=inline_launcher(root),
+                        worker_env=None)
     # deadline passes before the worker's first boundary poll: the
     # supervisor flushes generation 0+ and exits preempted(deadline)
     d.store.spool_submit(_service_spec("j1", deadline_s=0.05))

@@ -586,7 +586,13 @@ def packing_daemon(tmp_path):
         return _DoneHandle(rc)
 
     t = [0.0]
+    # cache_results=False: these tests pin the PACKING mechanics
+    # (grouping, splitting, dwell) — with the result cache on, later
+    # twins of a completed pack member serve in O(1) instead of
+    # packing, which is the better outcome but not the one under test
+    # (the cache/packing interplay is covered in tests/test_cache.py).
     cfg = HeatdConfig(root=q, slots=1, pack_jobs=True, pack_max=8,
+                      cache_results=False,
                       launcher=launcher, clock=lambda: t[0],
                       sleep_fn=lambda s: None)
     daemon = Heatd(cfg)

@@ -664,35 +664,14 @@ def test_inline_job_executes_and_matches_unsupervised_solve(tmp_path):
     # dispatched, supervised with per-job checkpoint dir + telemetry
     # sink, completed; final checkpoint bitwise the plain solve().
     from parallel_heat_tpu import HeatConfig, solve
-    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.harness import inline_launcher
     from parallel_heat_tpu.utils.checkpoint import (
         latest_checkpoint,
         load_checkpoint,
     )
 
     root = str(tmp_path / "q")
-
-    class InlineHandle:
-        def __init__(self, run):
-            self._run = run
-            self._rc = None
-            self.pid = os.getpid()
-
-        def poll(self):
-            if self._rc is None:
-                self._rc = self._run()
-            return self._rc
-
-        def terminate(self):
-            pass
-
-        kill = terminate
-
-    def launcher(job_id, worker_id, attempt, deadline_t):
-        return InlineHandle(lambda: svc_worker.execute_job(
-            root, job_id, worker_id, attempt, deadline_t=deadline_t))
-
-    d = _daemon(root, launcher=launcher)
+    d = _daemon(root, launcher=inline_launcher(root))
     d.store.spool_submit(_spec("j1", checkpoint_every=20,
                                guard_interval=10))
     for _ in range(4):
@@ -878,32 +857,11 @@ def test_bad_spec_records_failfast_quarantine(tmp_path):
     # rename-committed bad_spec record (fail-fast quarantine with THE
     # diagnosis), not a recordless death churning through
     # orphan/requeue to a mislabeled verdict.
-    from parallel_heat_tpu.service import worker as svc_worker
+    from parallel_heat_tpu.service.harness import inline_launcher
     from parallel_heat_tpu.supervisor import EXIT_PERMANENT_FAILURE
 
     root = str(tmp_path / "q")
-
-    class InlineHandle:
-        def __init__(self, run):
-            self._run = run
-            self._rc = None
-            self.pid = os.getpid()
-
-        def poll(self):
-            if self._rc is None:
-                self._rc = self._run()
-            return self._rc
-
-        def terminate(self):
-            pass
-
-        kill = terminate
-
-    def launcher(job_id, worker_id, attempt, deadline_t):
-        return InlineHandle(lambda: svc_worker.execute_job(
-            root, job_id, worker_id, attempt, deadline_t=deadline_t))
-
-    d = _daemon(root, launcher=launcher)
+    d = _daemon(root, launcher=inline_launcher(root))
     d.store.spool_submit(JobSpec(
         job_id="jbad", config={"nx": 2, "ny": 2, "steps": 60}))  # < 3
     d.step()

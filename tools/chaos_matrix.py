@@ -316,7 +316,8 @@ FAULTS = ("none", "nan_transient", "nan_recurring", "transient_error",
           "sigterm", "unstable", "spike_drift", "stalled_converge",
           "sigterm_async", "nan_async_race")
 
-SERVICE_FAULTS = ("svc_worker_sigkill", "svc_daemon_restart",
+SERVICE_FAULTS = ("svc_cache_crash", "svc_cache_prefix_parity",
+                  "svc_worker_sigkill", "svc_daemon_restart",
                   "svc_overload")
 
 # Real 2-process gloo cells (the distributed-supervision contract,
@@ -849,6 +850,10 @@ def run_service_cell(fault, workdir):
         return _svc_daemon_restart(os.path.join(workdir, fault))
     if fault == "svc_overload":
         return _svc_overload(os.path.join(workdir, fault))
+    if fault == "svc_cache_crash":
+        return _svc_cache_crash(os.path.join(workdir, fault))
+    if fault == "svc_cache_prefix_parity":
+        return _svc_cache_prefix_parity(os.path.join(workdir, fault))
     raise ValueError(fault)
 
 
@@ -969,45 +974,17 @@ def _svc_daemon_restart(root):
 
 
 def _svc_overload(root):
-    from parallel_heat_tpu.service import worker as svc_worker
     from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+    from parallel_heat_tpu.service.harness import inline_launcher
 
     row = {"fault": "svc_overload"}
-
-    class DeferredInline:
-        """Inline worker handle that stays 'running' for a few polls
-        before executing — deterministic occupancy without real
-        subprocesses, so the admission gate sees a busy queue."""
-
-        def __init__(self, run, defer=4):
-            self._run = run
-            self._defer = defer
-            self._polls = 0
-            self._rc = None
-            self.pid = os.getpid()
-
-        def poll(self):
-            self._polls += 1
-            if self._polls < self._defer:
-                return None
-            if self._rc is None:
-                self._rc = self._run()
-            return self._rc
-
-        def terminate(self):
-            pass
-
-        kill = terminate
-
-    def launcher(job_id, worker_id, attempt, deadline_t):
-        return DeferredInline(
-            lambda: svc_worker.execute_job(root, job_id, worker_id,
-                                           attempt,
-                                           deadline_t=deadline_t))
-
+    # defer=4: the handle stays 'running' for a few polls before
+    # executing — deterministic occupancy without real subprocesses,
+    # so the admission gate sees a busy queue.
     d = Heatd(HeatdConfig(root=root, slots=1, max_queue_depth=2,
                           hbm_budget_bytes=64 * 2**20,
-                          retry_after_s=1.0, launcher=launcher))
+                          retry_after_s=1.0,
+                          launcher=inline_launcher(root, defer=4)))
     # Burst: two admitted (slots=1 -> one runs, one queues), then the
     # depth gate closes on the rest of the burst.
     for i in range(4):
@@ -1050,7 +1027,186 @@ def _svc_overload(root):
     row["outcome"] = ("rejected+served"
                       if row["rejected_with_retry_after_ok"]
                       and row["accepted_completed_ok"] else "violation")
-    d.store.close()
+    d.close()
+    return row
+
+
+def _inline_launcher(root):
+    """Inline worker handle factory: real execute_job runs, real
+    checkpoints land, no subprocess (the shared harness spelling)."""
+    from parallel_heat_tpu.service.harness import inline_launcher
+
+    return inline_launcher(root)
+
+
+def _cache_audit_clean(root, store):
+    from parallel_heat_tpu.service.cache import (
+        audit_cache, load_cache_index)
+
+    entries, anoms, _bad, _torn = load_cache_index(root)
+    jobs, _ = store.replay()
+    return not (anoms + audit_cache(root, entries, job_views=jobs))
+
+
+def _svc_cache_crash(root):
+    """Daemon SIGKILL in the exact window between a job's result +
+    `completed` journal commit and the cache-index append
+    (SEMANTICS.md "Cache soundness"): the cache ENTRY is lost, the JOB
+    is not — the restarted daemon serves the journal's completed
+    verdict, the next identical submit RE-SOLVES (a real dispatch, no
+    torn bytes served), and only then does the cache start hitting."""
+    import subprocess
+
+    from parallel_heat_tpu.service import client
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+
+    row = {"fault": "svc_cache_crash"}
+    import parallel_heat_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "parallel_heat_tpu.cli", "serve",
+         "--queue", root, "--slots", "1", "--poll-interval", "0.1",
+         "--chaos-kill-before-cache-put", "1"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        v = client.submit(root, {"nx": 16, "ny": 16, "steps": 60,
+                                 "backend": "jnp"},
+                          job_id="cache-a", checkpoint_every=10,
+                          backoff_base_s=0.0, accept_timeout_s=60)
+        row["accepted_ok"] = v["accepted"]
+        # The worker completes, the daemon journals `completed`, then
+        # dies at the cache-put door. The journal already holds the
+        # verdict, so the wait resolves against a dead daemon.
+        w = client.wait(root, "cache-a", timeout_s=180)
+        row["job_not_lost_ok"] = w.state == "completed"
+        daemon.wait(timeout=60)
+    finally:
+        if daemon.poll() is None:  # pragma: no cover — cleanup only
+            daemon.kill()
+            daemon.wait()
+    row["daemon_killed_ok"] = daemon.returncode == -signal.SIGKILL
+    idx = os.path.join(root, "cache", "index.jsonl")
+    put_lines = []
+    if os.path.isfile(idx):
+        with open(idx) as f:
+            put_lines = [ln for ln in f if '"cache_put"' in ln]
+    row["entry_lost_ok"] = put_lines == []
+
+    # Restart (inline workers): the identical spec must RE-SOLVE —
+    # never a serve from the lost entry — and the solve's own
+    # completion repopulates the cache for the third submit.
+    d2 = Heatd(HeatdConfig(root=root, slots=1,
+                           requeue_backoff_base_s=0.0,
+                           launcher=_inline_launcher(root)))
+    for jid in ("cache-b", "cache-c"):
+        d2.store.spool_submit(_svc_spec(jid))
+        jobs, anomalies = _drive(d2, lambda j, jid=jid: jid in j
+                                 and j[jid].terminal)
+    events, _, _ = d2.store.read_journal()
+    row["resolved_ok"] = any(
+        e.get("event") == "dispatched" and e.get("job_id") == "cache-b"
+        for e in events)
+    row["hit_after_resolve_ok"] = (
+        any(e.get("event") == "cache_hit"
+            and e.get("job_id") == "cache-c" for e in events)
+        and not any(e.get("event") == "dispatched"
+                    and e.get("job_id") == "cache-c" for e in events))
+    row["single_terminal_ok"] = not anomalies
+    row["cache_check_ok"] = _cache_audit_clean(root, d2.store)
+    # The served third job's lineage is bitwise the real solve — a
+    # torn/partial payload could not have produced this.
+    row["bitwise_match"] = all(_svc_bitwise(d2.store, j)
+                               for j in ("cache-b", "cache-c"))
+    ok = all(row.get(k) is True for k in
+             ("daemon_killed_ok", "job_not_lost_ok", "entry_lost_ok",
+              "resolved_ok", "hit_after_resolve_ok",
+              "single_terminal_ok", "cache_check_ok", "bitwise_match"))
+    row["outcome"] = "recovered" if ok else "violation"
+    d2.close()
+    return row
+
+
+def _svc_cache_prefix_parity(root):
+    """Prefix-resumed jobs are bitwise from-scratch solves — the
+    PR-2/PR-10 resume-parity contract as the cache's proof obligation
+    — on both admissible arms: a fixed run extending a cached fixed
+    run, and a converge run outlasting a cached budget-exhausted
+    converge run (same eps/cadence)."""
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+    from parallel_heat_tpu.service.store import JobSpec
+    from parallel_heat_tpu.utils.checkpoint import (
+        latest_checkpoint, load_checkpoint)
+
+    row = {"fault": "svc_cache_prefix_parity"}
+    d = Heatd(HeatdConfig(root=root, slots=1,
+                          requeue_backoff_base_s=0.0,
+                          launcher=_inline_launcher(root)))
+
+    def submit_and_finish(jid, **cfg_kw):
+        cfg = {"nx": 16, "ny": 16, "backend": "jnp"}
+        cfg.update(cfg_kw)
+        d.store.spool_submit(JobSpec(job_id=jid, config=cfg,
+                                     checkpoint_every=10,
+                                     backoff_base_s=0.0))
+        return _drive(d, lambda j: jid in j and j[jid].terminal)
+
+    def bitwise(jid, **cfg_kw):
+        cfg = HeatConfig(nx=16, ny=16, backend="jnp", **cfg_kw)
+        src = latest_checkpoint(d.store.checkpoint_stem(jid))
+        if src is None:
+            return False
+        grid, step, _ = load_checkpoint(src, cfg)
+        ref = solve(cfg)
+        return bool(step == ref.steps_run
+                    and (np.asarray(grid) == ref.to_numpy()).all())
+
+    # Fixed -> fixed: donor 60 steps, target 120 resumes at 60.
+    submit_and_finish("pp-a", steps=60)
+    jobs, anomalies = submit_and_finish("pp-b", steps=120)
+    events, _, _ = d.store.read_journal()
+    pre = [e for e in events if e.get("event") == "cache_prefix"
+           and e.get("job_id") == "pp-b"]
+    row["prefix_event_ok"] = bool(pre)
+    row["prefix_from_final_gen_ok"] = bool(
+        pre and pre[0].get("generation_step") == 60
+        and pre[0].get("donor") == "pp-a")
+    row["bitwise_match"] = bitwise("pp-b", steps=120)
+    # The worker's stream must attribute the skipped prefix.
+    tel = ""
+    try:
+        with open(d.store.telemetry_path("pp-b")) as f:
+            tel = f.read()
+    except OSError:
+        pass
+    row["resume_event_ok"] = "cache_prefix_resume" in tel
+
+    # Converge outlasting converge: eps below the f32 floor never
+    # converges, so the donor exhausts its budget with every verdict
+    # provably negative — the sound converge arm.
+    conv = dict(converge=True, eps=1e-12, check_interval=10)
+    submit_and_finish("pp-c", steps=40, **conv)
+    jobs, anomalies = submit_and_finish("pp-d", steps=80, **conv)
+    events, _, _ = d.store.read_journal()
+    cpre = [e for e in events if e.get("event") == "cache_prefix"
+            and e.get("job_id") == "pp-d"]
+    row["converge_prefix_ok"] = bool(
+        cpre and cpre[0].get("generation_step") == 40)
+    row["converge_bitwise_ok"] = bitwise("pp-d", steps=80, **conv)
+    row["single_terminal_ok"] = not anomalies
+    row["cache_check_ok"] = _cache_audit_clean(root, d.store)
+    ok = all(row.get(k) is True for k in
+             ("prefix_event_ok", "prefix_from_final_gen_ok",
+              "bitwise_match", "resume_event_ok", "converge_prefix_ok",
+              "converge_bitwise_ok", "single_terminal_ok",
+              "cache_check_ok"))
+    row["outcome"] = "recovered" if ok else "violation"
+    d.close()
     return row
 
 
@@ -1149,6 +1305,25 @@ def main():
         "svc_overload": ("rejected_with_retry_after_ok", "hbm_gate_ok",
                          "accepted_completed_ok", "never_dropped_ok",
                          "single_terminal_ok", "bitwise_match"),
+        # The cache durability contract (SEMANTICS.md "Cache
+        # soundness"): a daemon SIGKILL between result commit and
+        # cache-index append loses the ENTRY, never the job, and the
+        # next identical submit re-solves instead of serving torn
+        # bytes; prefix-resumed jobs are bitwise from-scratch solves
+        # on both admissible arms (fixed extension + converge
+        # outlasting an unconverged converge donor).
+        "svc_cache_crash": ("daemon_killed_ok", "job_not_lost_ok",
+                            "entry_lost_ok", "resolved_ok",
+                            "hit_after_resolve_ok",
+                            "single_terminal_ok", "cache_check_ok",
+                            "bitwise_match"),
+        "svc_cache_prefix_parity": ("prefix_event_ok",
+                                    "prefix_from_final_gen_ok",
+                                    "bitwise_match", "resume_event_ok",
+                                    "converge_prefix_ok",
+                                    "converge_bitwise_ok",
+                                    "single_terminal_ok",
+                                    "cache_check_ok"),
         # The distributed-supervision contract (SEMANTICS.md
         # "Distributed supervision"), certified across a REAL process
         # boundary: a single-rank NaN rolls BOTH ranks back to the
@@ -1182,6 +1357,8 @@ def main():
                "svc_worker_sigkill": "recovered",
                "svc_daemon_restart": "recovered",
                "svc_overload": "rejected+served",
+               "svc_cache_crash": "recovered",
+               "svc_cache_prefix_parity": "recovered",
                "mp_split_brain": "recovered",
                "mp_peer_lost": "recovered",
                "mp_overlap_parity": "recovered"}

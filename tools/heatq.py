@@ -10,9 +10,17 @@ history, queue-wait and wall times, the daemon's lifecycle events, and
 here; the chaos suite asserts it stays empty through every injected
 crash).
 
+The result cache rides the same gate: the ``cache`` section folds
+``cache/index.jsonl`` and audits every live entry's durability —
+a dangling entry (payload or named generation missing), an entry
+naming an uncommitted/non-completed donor result record, and index
+fold anomalies (touch/evict of an unknown key) all count as
+``--check`` failures alongside the journal's.
+
 Exit codes: 0 readable (even if empty), 1 unreadable root, 2 when
-``--check`` is set and the journal replay reports anomalies — the CI
-spelling of "the durability invariants held".
+``--check`` is set and the journal replay (or the cache audit)
+reports anomalies — the CI spelling of "the durability invariants
+held".
 """
 
 import argparse
@@ -23,6 +31,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from parallel_heat_tpu.service.cache import (  # noqa: E402
+    audit_cache,
+    load_cache_index,
+)
 from parallel_heat_tpu.service.store import (  # noqa: E402
     JobStore,
     reduce_journal,
@@ -51,6 +63,18 @@ def inspect(root):
         })
     daemon_events = [e for e in events
                      if e.get("event", "").startswith("daemon_")]
+    entries, cache_anoms, cache_bad, cache_torn = load_cache_index(root)
+    cache_anoms = cache_anoms + audit_cache(root, entries,
+                                            job_views=jobs)
+    # Distinct jobs, not raw lines: a crash-replayed serve/seed may
+    # journal the same job's cache line twice (metrics_report counts
+    # the same way).
+    hits = {e.get("job_id") for e in events
+            if e.get("event") == "cache_hit"
+            and e.get("job_id") is not None}
+    prefixes = {e.get("job_id") for e in events
+                if e.get("event") == "cache_prefix"
+                and e.get("job_id") is not None}
     return {
         "root": str(root),
         "events_total": len(events), "bad_lines": bad,
@@ -63,6 +87,15 @@ def inspect(root):
                           for e in daemon_events],
         "jobs": rows,
         "counts": _counts(rows),
+        "cache": {
+            "entries": len(entries),
+            "bytes": sum(e.get("bytes") or 0 for e in entries.values()),
+            "hits": len(hits),
+            "prefix_hits": len(prefixes),
+            "bad_lines": cache_bad,
+            "torn_tail": cache_torn,
+            "anomalies": cache_anoms,
+        },
         "anomalies": anomalies,
     }
 
@@ -99,6 +132,13 @@ def render_text(doc):
     if doc["torn_tail"]:
         out.append("note: torn final journal line skipped (writer "
                    "died/racing mid-append; prefix intact)")
+    c = doc.get("cache") or {}
+    if c.get("entries") or c.get("hits") or c.get("prefix_hits"):
+        out.append(f"cache: {c['entries']} entr(ies) "
+                   f"{c['bytes']} B, {c['hits']} exact hit(s), "
+                   f"{c['prefix_hits']} prefix resume(s)")
+    for a in c.get("anomalies", []):
+        out.append(f"CACHE ANOMALY: {a}")
     for a in doc["anomalies"]:
         out.append(f"ANOMALY: {a}")
     return "\n".join(out)
@@ -111,9 +151,9 @@ def main(argv=None):
     ap.add_argument("root", help="queue root directory")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--check", action="store_true",
-                    help="exit 2 when the journal replay reports "
-                         "anomalies (CI: the durability invariants "
-                         "held)")
+                    help="exit 2 when the journal replay or the "
+                         "cache-index audit reports anomalies (CI: "
+                         "the durability invariants held)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.root):
         print(f"error: {args.root}: not a queue root directory",
@@ -125,7 +165,8 @@ def main(argv=None):
         print()
     else:
         print(render_text(doc))
-    return 2 if (args.check and doc["anomalies"]) else 0
+    return 2 if (args.check and (doc["anomalies"]
+                                 or doc["cache"]["anomalies"])) else 0
 
 
 if __name__ == "__main__":

@@ -589,6 +589,28 @@ def summarize_fleet(root):
     pack_dispatches = len({e.get("worker") for e in disp
                            if e.get("pack") is not None
                            and e.get("worker")})
+    # Result-cache counters (SEMANTICS.md "Cache soundness"; ROADMAP
+    # item 1 names cache hit rate a fleet SLO). Counted per DISTINCT
+    # job, last line wins: a daemon crash between the cache line and
+    # its companion append can replay the serve/seed on restart, and
+    # duplicate lines for one job must not inflate the rates. Hit
+    # rates are over COMPLETED jobs — the population a cache verdict
+    # substitutes for.
+    hit_by_job, prefix_by_job = {}, {}
+    for e in events:
+        if e.get("job_id") is None:
+            continue
+        if e.get("event") == "cache_hit":
+            hit_by_job[e["job_id"]] = e
+        elif e.get("event") == "cache_prefix":
+            prefix_by_job[e["job_id"]] = e
+    cache_hits = len(hit_by_job)
+    cache_prefixes = len(prefix_by_job)
+    cache_bytes_saved = sum(int(e.get("bytes_saved") or 0)
+                            for e in hit_by_job.values())
+    cache_steps_saved = sum(int(e.get("steps_saved") or 0)
+                            for e in list(hit_by_job.values())
+                            + list(prefix_by_job.values()))
     waits = sorted(v.first_dispatch_t - v.accepted_t
                    for v in jobs.values()
                    if v.first_dispatch_t is not None
@@ -624,6 +646,16 @@ def summarize_fleet(root):
             # level packing-efficiency figure.
             "jobs_per_dispatch": (round(len(disp) / len(disp_workers), 3)
                                   if disp_workers else None),
+            "cache_hits": cache_hits,
+            "cache_prefix_hits": cache_prefixes,
+            "cache_hit_rate": (round(cache_hits
+                                     / counts["completed"], 4)
+                               if counts.get("completed") else None),
+            "cache_prefix_rate": (round(cache_prefixes
+                                        / counts["completed"], 4)
+                                  if counts.get("completed") else None),
+            "cache_bytes_saved": cache_bytes_saved,
+            "cache_steps_saved": cache_steps_saved,
             # End-to-end: acceptance -> terminal state (requeue
             # backoffs included — that IS the user-visible latency).
             "queue_wait_s": {"p50": _percentile(waits, 50),
@@ -661,6 +693,16 @@ def render_fleet_text(doc):
                    f"{f['pack_dispatches']} packed dispatch(es), "
                    f"{f['jobs_per_dispatch']} jobs/dispatch over "
                    f"{f['dispatches']} dispatch(es)")
+    if f.get("cache_hits") or f.get("cache_prefix_hits"):
+        rate = f.get("cache_hit_rate")
+        prate = f.get("cache_prefix_rate")
+        out.append(f"cache: {f['cache_hits']} exact hit(s)"
+                   + (f" (rate {rate:.0%})" if rate is not None else "")
+                   + f", {f['cache_prefix_hits']} prefix resume(s)"
+                   + (f" (rate {prate:.0%})" if prate is not None
+                      else "")
+                   + f", {f['cache_bytes_saved']} B and "
+                   f"{f['cache_steps_saved']} step(s) not re-solved")
     qw, jw = f["queue_wait_s"], f["job_wall_s"]
     if qw["p50"] is not None:
         out.append(f"queue wait p50={qw['p50']:.2f}s "

@@ -180,6 +180,11 @@ class DaemonState:
         # indicator — a growing age means dispatch has stalled).
         self.queued_since = {}
         self.rejected = 0
+        # Distinct jobs (crash-replayed duplicate lines must not
+        # inflate the live counters; metrics_report counts the same
+        # way).
+        self.cache_hit_jobs = set()
+        self.cache_prefix_jobs = set()
         self.saw_data = False
         self.exited = False
 
@@ -224,6 +229,10 @@ class DaemonState:
         elif ev == "rejected":
             self.rejected += 1
             self.states.pop(jid, None)
+        elif ev == "cache_hit":
+            self.cache_hit_jobs.add(jid)
+        elif ev == "cache_prefix":
+            self.cache_prefix_jobs.add(jid)
         elif ev == "dispatched":
             self.states[jid] = "running"
             self.queued_since.pop(jid, None)
@@ -267,6 +276,9 @@ class DaemonState:
                                   for k, v in sorted(c.items()))
                          + (f" rejected={self.rejected}"
                             if self.rejected else ""))
+        if self.cache_hit_jobs or self.cache_prefix_jobs:
+            parts.append(f"cache {len(self.cache_hit_jobs)} hit(s)"
+                         f"/{len(self.cache_prefix_jobs)} prefix")
         # Queue depth (the admission gate's view: every non-terminal
         # job) + oldest-accepted age — the live queue-wait SLO signal.
         depth = sum(1 for s in self.states.values()

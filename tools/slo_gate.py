@@ -27,12 +27,21 @@ observability tools share (``metrics_report.parse_fail_on`` — the
 ceiling, ``NAME<NUM`` floor, dotted paths into the summary docs)::
 
     {
-      "fleet":  ["quarantined>0", "orphaned>0", "queue_wait_s.p99>5"],
+      "fleet":  ["quarantined>0", "orphaned>0", "queue_wait_s.p99>5",
+                 "cache_hit_rate<0.3"],
       "stream": ["permanent_failure", "busy<0.25",
                  "barrier_wait_p99>0.25",
                  "checkpoints.overhead_share>0.5"],
       "heartbeat_max_age_s": 120
     }
+
+The result-cache counters (``cache_hits`` / ``cache_prefix_hits`` /
+``cache_hit_rate`` / ``cache_prefix_rate`` / ``cache_bytes_saved`` /
+``cache_steps_saved`` — ROADMAP item 1 names cache hit rate a fleet
+SLO) are ordinary fleet counters: floor a rate with
+``cache_hit_rate<0.3``, ceiling the miss volume with dotted paths
+like any other token. A rate is unmeasured (skipped, not violated)
+until the first job completes.
 
 Exit codes: 0 every SLO held; 1 unusable input (bad spec, unreadable
 target); 2 at least one SLO violated (violations on stdout, one per
