@@ -29,7 +29,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        ensemble-smoke trace-smoke cache-smoke bench clean
+        ensemble-smoke trace-smoke cache-smoke implicit-smoke bench \
+        clean
 
 all: heat
 
@@ -305,6 +306,34 @@ cache-smoke:
 	pre=[e for e in evs if e.get('event')=='cache_prefix']; \
 	assert len(pre)==1 and pre[0]['generation_step']==60, pre"
 	rm -rf .cache_smoke
+
+# Implicit-stepping run-book as a gate (SEMANTICS.md "Implicit
+# stepping"): a stiff converge run at 100x the explicit-stable dt
+# (backward Euler + multigrid V-cycle) must reach eps with vcycle
+# telemetry flowing, --explain must show the level hierarchy, and the
+# metrics report's V-cycle section must pass the shared --fail-on
+# gates (cycles/step and per-cycle contraction within budget; any
+# permanent failure or guard trip fails). Exit 0 = the implicit
+# contract held end to end on this host.
+implicit-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .implicit_smoke && mkdir -p .implicit_smoke
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
+	    --cx 22.5 --cy 22.5 --scheme backward_euler --backend jnp \
+	    --steps 400 --converge --eps 1e-3 --check-interval 4 \
+	    --diag-interval 8 \
+	    --metrics .implicit_smoke/metrics.jsonl --quiet
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
+	    --cx 22.5 --cy 22.5 --scheme backward_euler --backend jnp \
+	    --steps 10 --explain | grep -q "V-cycle"
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py \
+	    .implicit_smoke/metrics.jsonl --json \
+	    --fail-on 'permanent_failure,guard_trip,vcycle.cycles_per_step.p90>12,vcycle.contraction.p50>0.6' | \
+	$(PY) -c "import json,sys; d=json.load(sys.stdin); \
+	assert d['vcycle']['samples'] >= 1, d.get('vcycle'); \
+	assert d['vcycle']['unconverged_samples'] == 0, d['vcycle']; \
+	assert d['convergence']['residual_last'] < 1e-3, d['convergence']"
+	rm -rf .implicit_smoke
 
 bench:
 	$(PY) bench.py

@@ -618,6 +618,22 @@ def _default_dirichlet_targets():
                                        dtype="bfloat16",
                                        accumulate="f32chunk",
                                        backend="jnp")),
+        # The implicit update program (SEMANTICS.md "Implicit
+        # stepping"): the whole V-cycle — smoothing sweeps at every
+        # level, the per-step while_loop, the storage round-off — must
+        # prove its grid-shaped writes interior-only exactly like the
+        # explicit loops (coarse-level arrays are differently shaped
+        # and out of scope by construction).
+        ("jnp-2d-implicit-be", HeatConfig(nx=16, ny=16, steps=4,
+                                          cx=5.0, cy=5.0,
+                                          scheme="backward_euler",
+                                          backend="jnp")),
+        ("jnp-2d-implicit-cn", HeatConfig(nx=16, ny=16, steps=40,
+                                          cx=5.0, cy=5.0,
+                                          scheme="crank_nicolson",
+                                          converge=True,
+                                          check_interval=20,
+                                          backend="jnp")),
     ]
     for label, cfg in matrix:
         ms, msr = _single_multistep(cfg, "jnp")

@@ -203,6 +203,17 @@ def default_kernel_targets() -> List[KernelTarget]:
     add("G-band", lambda u, t, hn, hs, f=gb: f(u, t, hn, hs, 0, 0),
         fuse_args, offs2)
 
+    # Multigrid transfer kernels — whole-array VMEM restriction /
+    # prolongation of the implicit V-cycle (ops/multigrid.py). The
+    # geometry is one real hierarchy edge: fine (34, 34) -> coarse
+    # (18, 18) (config.multigrid_level_shapes((34, 34))[1]).
+    from parallel_heat_tpu.ops import multigrid as mgrid
+
+    add("MG-restrict", mgrid._build_restrict_kernel((34, 34), (18, 18)),
+        [sds((34, 34))])
+    add("MG-prolong", mgrid._build_prolong_kernel((18, 18), (34, 34)),
+        [sds((18, 18))])
+
     # Kernel D — XY-tiled 3D slab.
     add("D", ps._build_slab_kernel_3d((16, 32, 128), f32,
                                       0.1, 0.1, 0.1),
@@ -966,19 +977,22 @@ def _audit_grid_coverage(target, eqn, report):
 
 def _source_kernel_names() -> dict:
     """{literal heat_* name: lineno} for every pallas_call site in the
-    kernel modules — ops/pallas_stencil.py AND ops/batched.py (the
-    member-batched ensemble kernels) — parsed with ast (the same
-    literals HL203 enforces). A new kernel module must be added HERE
-    for its sites to join the coverage cross-check; the pinning test
+    kernel modules — ops/pallas_stencil.py, ops/batched.py (the
+    member-batched ensemble kernels) AND ops/multigrid.py (the
+    implicit V-cycle's restriction/prolongation transfer kernels) —
+    parsed with ast (the same literals HL203 enforces). A new kernel
+    module must be added HERE for its sites to join the coverage
+    cross-check; the pinning test
     (test_analysis.test_kernel_coverage_site_count) counts the total,
-    so an uncounted 19th site fails CI either way."""
+    so an uncounted extra site fails CI either way."""
     import ast
 
     from parallel_heat_tpu.ops import batched as bt
+    from parallel_heat_tpu.ops import multigrid as mgrid
     from parallel_heat_tpu.ops import pallas_stencil as ps
 
     out = {}
-    for mod in (ps, bt):
+    for mod in (ps, bt, mgrid):
         path = mod.__file__
         with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=path)

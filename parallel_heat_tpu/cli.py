@@ -68,6 +68,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "(2D kernel-G rounds). 'auto' prices pipeline "
                          "vs overlap with the TpuParams ICI model — "
                          "see --explain for the resolved schedule")
+    ap.add_argument("--scheme", default="explicit",
+                    choices=("explicit", "backward_euler",
+                             "crank_nicolson"),
+                    help="time integrator (SEMANTICS.md 'Implicit "
+                         "stepping'): the reference's explicit Jacobi "
+                         "update (dt capped by the stability bound), "
+                         "or an unconditionally stable implicit "
+                         "scheme whose per-step linear solve is a "
+                         "sharded geometric-multigrid V-cycle — "
+                         "cx/cy may exceed the explicit bound by "
+                         "orders of magnitude (100-1000x larger "
+                         "steps)")
+    ap.add_argument("--mg-tol", type=float, default=None,
+                    help="implicit schemes: per-step relative "
+                         "residual target of the V-cycle iteration "
+                         "(default 1e-3)")
+    ap.add_argument("--mg-cycles", type=int, default=None,
+                    help="implicit schemes: V-cycle cap per step "
+                         "(default 50)")
+    ap.add_argument("--mg-smooth", type=int, default=None,
+                    help="implicit schemes: weighted-Jacobi pre/post "
+                         "sweeps per level (default 1)")
+    ap.add_argument("--mg-levels", type=int, default=None,
+                    help="implicit schemes: hierarchy depth cap "
+                         "(default: coarsen fully)")
     ap.add_argument("--accumulate", default="storage",
                     choices=("storage", "f32chunk"),
                     help="sub-f32 accumulation semantics (SEMANTICS.md): "
@@ -312,6 +337,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       else args.halo_overlap),
         accumulate=args.accumulate, guard_interval=args.guard_interval,
         diag_interval=args.diag_interval, pipeline_depth=pipeline_depth,
+        scheme=args.scheme,
+        # mg_* flags default to the config's own defaults — only
+        # explicit CLI values override (validate() rejects non-default
+        # mg knobs on explicit-scheme runs, so the None-passthrough
+        # keeps `--scheme explicit` clean).
+        **{k: v for k, v in (("mg_tol", args.mg_tol),
+                             ("mg_cycles", args.mg_cycles),
+                             ("mg_smooth", args.mg_smooth),
+                             ("mg_levels", args.mg_levels))
+           if v is not None},
     )
     try:
         config.validate()

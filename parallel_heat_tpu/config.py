@@ -20,6 +20,15 @@ from typing import Optional, Tuple
 _VALID_DTYPES = ("float32", "bfloat16", "float64")
 _VALID_BACKENDS = ("auto", "jnp", "pallas")
 
+# Time integrators (SEMANTICS.md "Implicit stepping"). "explicit" is
+# the reference's forward-Euler Jacobi update, dt-capped by the von
+# Neumann bound (stability_margin). The implicit schemes solve
+# ``(I - theta*dt*L) u' = b`` each step with a geometric-multigrid
+# V-cycle (ops/multigrid.py) and are unconditionally stable: the
+# coefficients (cx/cy = alpha*dt/dx^2) may exceed the explicit bound
+# by orders of magnitude — that IS the point (ROADMAP item 3).
+_VALID_SCHEMES = ("explicit", "backward_euler", "crank_nicolson")
+
 # --- cache-key partition (SEMANTICS.md "Statically verified contracts")
 #
 # Every HeatConfig field is classified exactly once, here. SEMANTIC
@@ -39,6 +48,7 @@ SEMANTIC_FIELDS = (
     "steps", "converge", "eps", "check_interval",
     "dtype", "backend", "mesh_shape", "overlap", "halo_depth",
     "halo_overlap", "accumulate",
+    "scheme", "mg_tol", "mg_cycles", "mg_smooth", "mg_levels",
 )
 OBSERVATION_ONLY_FIELDS = ("guard_interval", "diag_interval",
                            "pipeline_depth")
@@ -146,6 +156,32 @@ def divisible_factorizations(n_devices: int, shape) -> list:
         return out
 
     return rec(n_devices, list(shape))
+
+
+def multigrid_level_shapes(shape, mg_levels: Optional[int] = None,
+                           min_interior: int = 3) -> list:
+    """The geometric-multigrid level hierarchy for a 2D grid ``shape``
+    (cells INCLUDING the Dirichlet boundary ring): ``[(nx0, ny0),
+    (nx1, ny1), ...]`` finest first, each level's interior extent the
+    floor-half of the previous (``m -> m // 2``, the vertex map
+    ``fine = 2*coarse + 1`` that is well defined for ANY interior
+    size), coarsening until either extent's interior would drop below
+    ``min_interior`` or ``mg_levels`` levels exist.
+
+    jax-free and the ONE source of truth for the hierarchy: the
+    V-cycle builder (``ops/multigrid.py``), ``solver.explain`` and
+    heatd's HBM admission pricing (``service/admission.py``) all call
+    this, so the admitted estimate can never disagree with the arrays
+    the solve actually allocates."""
+    nx, ny = int(shape[0]), int(shape[1])
+    levels = [(nx, ny)]
+    while mg_levels is None or len(levels) < mg_levels:
+        mi, ni = levels[-1][0] - 2, levels[-1][1] - 2
+        mc, nc = mi // 2, ni // 2
+        if mc < min_interior or nc < min_interior:
+            break
+        levels.append((mc + 2, nc + 2))
+    return levels
 
 
 def sublane_count(dtype: str) -> int:
@@ -261,6 +297,44 @@ class HeatConfig:
     # explicit, priced flag.
     accumulate: str = "storage"
 
+    # Time integrator (SEMANTICS.md "Implicit stepping"). "explicit"
+    # (default) is the reference's forward-Euler Jacobi update, whose
+    # dt is capped by the von Neumann bound (stability_margin). The
+    # implicit schemes — "backward_euler" (first order) and
+    # "crank_nicolson" (second order) — solve the linear system
+    # ``(I - theta*L) u' = b`` every step with a sharded geometric-
+    # multigrid V-cycle (ops/multigrid.py) and are unconditionally
+    # stable: coefficients far past the explicit bound (100-1000x the
+    # stable dt) take ONE step where explicit needed hundreds.
+    # SEMANTIC: the scheme selects the compiled per-step program, so
+    # it keys the runner/executable/result caches — cross-scheme cache
+    # reuse is inadmissible by construction (service/cache.py).
+    scheme: str = "explicit"
+
+    # Implicit-solve knobs (inert — and REQUIRED to stay at their
+    # defaults — for scheme="explicit"; validate() rejects non-default
+    # values there so an inert knob can never fork a cache key).
+    # mg_tol: per-step relative residual target of the V-cycle
+    # iteration — cycles stop when ``max|b - A u| <= mg_tol * max|b|``
+    # (the same max-norm machinery converge mode uses; max is exactly
+    # associative, which keeps the verdict bitwise identical under any
+    # sharding). Default 1e-3: the induced per-step solution error is
+    # <= mg_tol * ||b|| (A's spectrum sits in [1, 1+4(cx+cy)]), orders
+    # below the implicit schemes' temporal discretization error at the
+    # large steps they exist for; tighten for converge runs with eps
+    # near the solver floor.
+    mg_tol: float = 1e-3
+    # mg_cycles: hard V-cycle cap per step (the while_loop bound).
+    mg_cycles: int = 50
+    # mg_smooth: weighted-Jacobi pre- AND post-smoothing sweeps per
+    # level per cycle (the V(nu,nu) shape; omega = 0.8).
+    mg_smooth: int = 1
+    # mg_levels: hierarchy depth cap; None = coarsen fully (every
+    # halving until an interior extent would drop below 3 cells). The
+    # level shapes are config.multigrid_level_shapes — one source of
+    # truth shared with heatd's HBM admission pricing.
+    mg_levels: Optional[int] = None
+
     # Runtime blow-up guard (SEMANTICS.md "Runtime guard"): steps between
     # on-device isfinite-all checks of the evolving grid. None (default)
     # = off — no guard program is ever built, and outputs are bitwise
@@ -352,11 +426,14 @@ class HeatConfig:
         return 0.5 - sum(self.coefficients)
 
     def validate(self) -> "HeatConfig":
-        if self.stability_margin() < 0.0:
+        if self.scheme == "explicit" and self.stability_margin() < 0.0:
             # Warn (never error: instability is sometimes the thing
             # being studied) from the one place every entry point —
             # solve, solve_stream, the CLI, make_initial_grid — passes
-            # through.
+            # through. Implicit schemes are unconditionally stable, so
+            # the bound does not apply there — and the warning names
+            # that escape hatch, because "reduce dt" is the wrong fix
+            # when the user WANTS the big step.
             import warnings
 
             # No stacklevel: attributing the warning to this fixed line
@@ -365,7 +442,10 @@ class HeatConfig:
             warnings.warn(
                 f"coefficient sum {sum(self.coefficients):g} exceeds the "
                 f"stability bound 1/2 — the explicit scheme will diverge "
-                f"(values blow up to inf)",
+                f"(values blow up to inf); to take steps this large, "
+                f"switch to the implicit integrator: "
+                f"scheme='backward_euler' (--scheme backward_euler), "
+                f"which is unconditionally stable",
                 RuntimeWarning,
             )
         if self.nx < 3 or self.ny < 3 or (self.nz is not None and self.nz < 3):
@@ -504,6 +584,77 @@ class HeatConfig:
                 f"accumulate must be 'storage' or 'f32chunk', got "
                 f"{self.accumulate!r}"
             )
+        if self.scheme not in _VALID_SCHEMES:
+            raise ValueError(
+                f"scheme must be one of {_VALID_SCHEMES}, got "
+                f"{self.scheme!r}")
+        if self.mg_tol <= 0.0:
+            raise ValueError(f"mg_tol must be > 0, got {self.mg_tol}")
+        if self.mg_cycles < 1:
+            raise ValueError(
+                f"mg_cycles must be >= 1, got {self.mg_cycles}")
+        if self.mg_smooth < 1:
+            raise ValueError(
+                f"mg_smooth must be >= 1, got {self.mg_smooth}")
+        if self.mg_levels is not None and self.mg_levels < 1:
+            raise ValueError(
+                f"mg_levels must be >= 1 (or None for full "
+                f"coarsening), got {self.mg_levels}")
+        if self.scheme == "explicit":
+            # Inert knobs must stay at their defaults (loud declines
+            # over silent no-ops): a non-default mg_* on an explicit
+            # config would fork runner/result-cache keys while
+            # changing nothing the program computes.
+            defaults = HeatConfig()
+            off = [n for n in ("mg_tol", "mg_cycles", "mg_smooth",
+                               "mg_levels")
+                   if getattr(self, n) != getattr(defaults, n)]
+            if off:
+                raise ValueError(
+                    f"{', '.join(off)} only apply to the implicit "
+                    f"schemes (scheme='backward_euler' or "
+                    f"'crank_nicolson'); scheme='explicit' takes no "
+                    f"multigrid knobs")
+        else:
+            if self.ndim != 2:
+                raise ValueError(
+                    f"scheme={self.scheme!r} is 2D-only in this "
+                    f"build: the 3D multigrid transfer operators are "
+                    f"not yet built (the 5-point V-cycle is — use "
+                    f"nz=None)")
+            if self.accumulate != "storage":
+                raise ValueError(
+                    "accumulate='f32chunk' applies to the explicit "
+                    "temporal kernels only; the implicit V-cycle "
+                    "already carries float32 through every step solve "
+                    "and rounds to storage once per step")
+            if self.halo_depth is not None and self.halo_depth != 1:
+                raise ValueError(
+                    f"halo_depth={self.halo_depth} is an explicit-"
+                    f"scheme exchange schedule (K steps per collective "
+                    f"round); the implicit V-cycle exchanges per "
+                    f"smoothing sweep — drop the flag (auto resolves "
+                    f"implicit runs to 1)")
+            if self.halo_overlap not in (None, "auto"):
+                raise ValueError(
+                    f"halo_overlap={self.halo_overlap!r} schedules the "
+                    f"explicit temporal rounds; it does not apply to "
+                    f"scheme={self.scheme!r} — drop the flag")
+            if not self.overlap:
+                # Same inert-knob rule as the mg_* defaults on
+                # explicit configs: `overlap` schedules the explicit
+                # per-step interior/edge split, which the implicit
+                # V-cycle never builds — a non-default value would
+                # fork SEMANTIC cache/runner keys while changing
+                # nothing the program computes.
+                raise ValueError(
+                    "overlap=False schedules the explicit per-step "
+                    "interior/edge split; it does not apply to "
+                    f"scheme={self.scheme!r} — drop the flag")
+            if len(multigrid_level_shapes(self.shape,
+                                          self.mg_levels)) < 1:
+                raise ValueError(  # unreachable (level 0 always exists)
+                    "empty multigrid hierarchy")
         if self.accumulate == "f32chunk":
             # Loud declines over silent fallbacks: the flag changes the
             # numerics contract, so paths that cannot honor it refuse.

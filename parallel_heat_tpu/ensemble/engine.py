@@ -116,6 +116,14 @@ def ensemble_path(config: HeatConfig) -> str:
     the jnp multistep family) for ``config``'s resolved backend. The
     ONE decision site — the runner builder executes it and
     ``solver.explain(..., ensemble=B)`` reports it."""
+    if config.scheme != "explicit":
+        # Implicit V-cycle steps batch over members via vmap — the
+        # per-member while_loop latches each member's iterate at ITS
+        # convergence cycle (jax's while batching rule applies the
+        # select that freezes finished members), so the batched
+        # member is bitwise the solo member; kernel M is an explicit
+        # Jacobi kernel and does not apply.
+        return "vmap"
     backend = _resolve_backend(config)
     if backend == "pallas" and config.ndim == 2:
         from parallel_heat_tpu.ops import batched
@@ -141,6 +149,24 @@ def packable(config: HeatConfig):
     if any(d > 1 for d in config.mesh_or_unit()):
         return False, "sharded configs run solo (no member axis across a mesh)"
     backend = _resolve_backend(config)
+    if config.scheme != "explicit":
+        # Same backend discipline as the explicit arm below: the
+        # batched implicit path is vmap over the JNP V-cycle, so the
+        # member-bitwise claim holds only where the solo solve uses
+        # that spelling too. A pallas-backend solo implicit solve
+        # takes the pallas transfer kernels — bitwise the jnp
+        # spelling in interpreter mode but NOT pinned on hardware —
+        # so those jobs run solo rather than lean on unpinned
+        # cross-backend parity.
+        if backend == "jnp":
+            return True, ("vmap over the implicit V-cycle multistep "
+                          "(member-bitwise: the while batching rule "
+                          "latches each member at its own cycle "
+                          "verdict)")
+        return False, ("solo pallas-backend implicit solves use the "
+                       "pallas transfer kernels; the batched vmap "
+                       "path's jnp spelling has no pinned bitwise "
+                       "twin on hardware — runs solo")
     if backend == "jnp":
         return True, "vmap over the jnp multistep family (member-bitwise)"
     path = ensemble_path(config)

@@ -265,7 +265,8 @@ def _run(config, ensemble, stem, policy, initials, telemetry,
                 trips += 1
                 emit("guard_trip", step=e.step, members=e.bad,
                      ensemble=True)
-                if config.stability_margin() < 0:
+                if config.scheme == "explicit" \
+                        and config.stability_margin() < 0:
                     raise _fail(
                         telemetry, clock, t0, retries, rollbacks, trips,
                         n_ckpt,
@@ -273,7 +274,9 @@ def _run(config, ensemble, stem, policy, initials, telemetry,
                         f"{e.step}: coefficient sum "
                         f"{sum(config.coefficients):g} exceeds the "
                         f"stability bound 1/2 — deterministic "
-                        f"divergence; retrying cannot help.",
+                        f"divergence; retrying cannot help. Reduce the "
+                        f"coefficients or switch to the implicit "
+                        f"integrator (--scheme backward_euler).",
                         kind="unstable") from None
                 retries += 1
                 if retries > policy.max_retries:

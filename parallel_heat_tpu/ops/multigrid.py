@@ -1,0 +1,554 @@
+"""Geometric-multigrid V-cycle backing the implicit time integrators.
+
+The explicit Jacobi scheme's dt is capped by the von Neumann bound
+(``HeatConfig.stability_margin``); stiff or fine-grid problems burn
+millions of steps to reach a fixed physical time. The implicit schemes
+(``HeatConfig.scheme = "backward_euler" | "crank_nicolson"``) instead
+solve, every step, the linear system
+
+    A u' = b,   A = I - theta*L,   L u = cx*(uE + uW - 2u)
+                                       + cy*(uN + uS - 2u)
+
+(theta = 1 for backward Euler with ``b = u``; theta = 1/2 for
+Crank-Nicolson with ``b = (I + L/2) u``), which is unconditionally
+stable — the coefficients may exceed the explicit bound by orders of
+magnitude. Grounded in JAXMg (PAPERS.md: arXiv 2601.14466, a
+multi-device geometric multigrid in JAX) and the TF-TPU fluid-flow
+framework (arXiv 2108.11076, implicit stencil solves as the TPU-native
+escape from explicit step limits).
+
+The solver is a textbook V(nu, nu) geometric cycle:
+
+- **smoother**: weighted Jacobi (omega = 0.8), reusing the explicit
+  path's stencil arithmetic shape — the residual is the same 5-point
+  textbook tree ``ops/stencil.py`` pins for bitwise shard-invariance;
+- **restriction**: 2D full weighting (the 1/16 [1 2 1; 2 4 2; 1 2 1]
+  tensor stencil) centered on the vertex map ``fine = 2*coarse + 1``,
+  well defined for ANY interior extent (``m -> m // 2`` per level, one
+  source of truth: ``config.multigrid_level_shapes``);
+- **prolongation**: bilinear interpolation, the transpose map of the
+  restriction (odd fine lines copy their coarse line, even fine lines
+  average the two neighbors — a missing neighbor is the Dirichlet
+  zero ring);
+- **coarse-grid operators**: rediscretized — level ``l`` carries
+  coefficients ``theta*c / 4**l`` (h doubles per level), so every
+  level's residual/smoother is the SAME stencil program at a smaller
+  shape;
+- **coarsest solve**: ``_COARSE_SWEEPS`` extra Jacobi sweeps (the
+  rediscretized coefficients shrink 4x per level, so the coarsest
+  operator is strongly diagonally dominant and Jacobi contracts fast).
+
+Cycle count per step is driven by the SAME residual machinery converge
+mode uses: iterate until ``max|b - A u| <= mg_tol * max|b|`` (max-norm
+— exactly associative, so the verdict is bitwise identical under any
+GSPMD sharding) or ``mg_cycles`` cycles ran. Everything is carried in
+float32 and rounded to the storage dtype ONCE per step, the explicit
+path's "storage" accumulation semantics; interior writes use the same
+``u.at[1:-1, 1:-1].set`` spelling heatlint HL103 proves boundary-free.
+
+Sharding: the implicit step is a full-grid program. Sharded configs
+execute it REPLICATED — the grid is gathered once per dispatch and
+every device runs the identical full-shape step loop
+(``solver._build_runner``'s implicit branch) — which is what makes
+the pinned contract, BITWISE equality with the single-device run
+(tests/test_implicit.py), hold by construction: a GSPMD-partitioned
+V-cycle is measurably not bitwise-stable on XLA:CPU (per-fusion FMA
+contraction reshuffles under partition layouts). Partitioning the
+levels with padded ``shard_map`` blocks is the roadmap follow-on;
+the hand-scheduled halo spellings stay on the explicit path.
+
+Pallas: restriction and prolongation also exist as whole-array VMEM
+kernels (``heat_mg_restrict`` / ``heat_mg_prolong``) selected on the
+single-device pallas backend; they evaluate the identical expression
+tree, run in interpreter mode off-TPU (bitwise the jnp spelling —
+pinned by tests), and are covered by the heatlint HL401-HL404 kernel
+audits like every other pinned ``pallas_call`` site.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_heat_tpu.config import HeatConfig, multigrid_level_shapes
+
+_ACC = jnp.float32
+
+# Weighted-Jacobi damping: 0.8 is the near-optimal smoothing factor
+# for the 5-point Laplacian (2/3..0.8 textbook range); fixed, not a
+# config knob — it shapes convergence RATE only, never the converged
+# answer, and one less semantic field keeps the cache-key surface
+# small.
+_OMEGA = 0.8
+
+# Extra smoothing sweeps standing in for an exact coarsest-level
+# solve. The rediscretized coefficients shrink 4x per level, so the
+# coarsest A is strongly diagonally dominant and 8 sweeps reduce the
+# coarse error far below the finest level's per-cycle contraction.
+_COARSE_SWEEPS = 8
+
+
+def scheme_theta(scheme: str) -> float:
+    """The implicit weight theta of ``A = I - theta*L``."""
+    return 0.5 if scheme == "crank_nicolson" else 1.0
+
+
+def level_coefficients(config: HeatConfig):
+    """``[(shape, ax, ay), ...]`` finest first — the hierarchy's
+    shapes from the one jax-free source of truth
+    (``config.multigrid_level_shapes``) with the rediscretized
+    operator coefficients ``theta*c / 4**l`` attached."""
+    theta = scheme_theta(config.scheme)
+    shapes = multigrid_level_shapes(config.shape, config.mg_levels)
+    return [(s, theta * config.cx / 4.0 ** l,
+             theta * config.cy / 4.0 ** l)
+            for l, s in enumerate(shapes)]
+
+
+# --------------------------------------------------------------------------
+# Level operations (full arrays WITH the Dirichlet zero/boundary ring;
+# all f32; textbook-tree spellings for bitwise shard-invariance)
+# --------------------------------------------------------------------------
+
+def _lap_interior(u, ax: float, ay: float):
+    """``theta*L u`` on the interior.
+
+    The spelling is load-bearing for the bitwise sharding contract:
+    ``(up - c) + (down - c)`` instead of the explicit path's
+    ``up + down - 2*c``. XLA:CPU contracts every single-consumer
+    multiply into an FMA uniformly, but a multiply whose RESULT is
+    shared (the textbook tree's ``2*c``, CSE-merged across the x and y
+    terms) gets duplicated-then-contracted or kept-shared depending on
+    fusion context — which differs between the partitioned and
+    unpartitioned compilations of the same program, producing one-ulp
+    forks. This form has NO multiply inside the neighbor sums and
+    exactly one single-consumer multiply per axis term, so every
+    contraction decision is context-free and sharded == single-device
+    holds bitwise (stress-pinned by tests/test_implicit.py)."""
+    c = u[1:-1, 1:-1]
+    tx = ax * ((u[2:, 1:-1] - c) + (u[:-2, 1:-1] - c))
+    ty = ay * ((u[1:-1, 2:] - c) + (u[1:-1, :-2] - c))
+    return tx + ty
+
+
+def apply_A_interior(u, ax: float, ay: float):
+    """``(I - theta*L) u`` on the interior of a full level array."""
+    return u[1:-1, 1:-1] - _lap_interior(u, ax, ay)
+
+
+def residual_interior(u, b, ax: float, ay: float):
+    """``b - A u`` on the interior, spelled ``(b - u) + theta*L u`` —
+    a pure add/sub chain around :func:`_lap_interior`'s context-free
+    multiplies (see its docstring for why the spelling is pinned)."""
+    return ((b[1:-1, 1:-1] - u[1:-1, 1:-1])
+            + _lap_interior(u, ax, ay))
+
+
+def residual_norm(u, b, ax: float, ay: float):
+    """Interior max-norm of ``b - A u`` — the V-cycle's convergence
+    quantity. Max is exactly associative, so this scalar is bitwise
+    identical under any sharding of the operands."""
+    return jnp.max(jnp.abs(residual_interior(u, b, ax, ay)))
+
+
+def smooth(u, b, ax: float, ay: float):
+    """One weighted-Jacobi sweep: ``u += omega * (b - A u) / diag A``.
+    Boundary ring untouched (the interior-only write is the HL103
+    contract)."""
+    d = 1.0 + 2.0 * ax + 2.0 * ay
+    new = u[1:-1, 1:-1] + (_OMEGA / d) * residual_interior(u, b, ax, ay)
+    return u.at[1:-1, 1:-1].set(new)
+
+
+def _restrict_interior(r, mc: int, nc: int):
+    """The full-weighting interior expression — coarse interior
+    vertex ``j`` sits at fine interior vertex ``2j + 1`` (full-array
+    index ``2j + 2``); the 1/16 tensor stencil is two [1 2 1]/4
+    passes. The ONE spelling, shared by the jnp path and the Pallas
+    kernel body (like ``_prolong_axis0``), so the jnp/pallas bitwise-
+    parity contract is structural, not hand-mirrored. Strided slices
+    only — no gather, no scatter — so HL103 has nothing to prove,
+    and every multiply is by a power of two (exactly rounded:
+    contraction-immune)."""
+    rows = 0.25 * (r[1:2 * mc:2, :] + 2.0 * r[2:2 * mc + 2:2, :]
+                   + r[3:2 * mc + 3:2, :])
+    return 0.25 * (rows[:, 1:2 * nc:2] + 2.0 * rows[:, 2:2 * nc + 2:2]
+                   + rows[:, 3:2 * nc + 3:2])
+
+
+def restrict_full_weighting(r, coarse_shape: Tuple[int, int]):
+    """Full-weighting restriction of a full fine array ``r`` (ring
+    included) onto the full coarse array (zero ring)."""
+    mc, nc = coarse_shape[0] - 2, coarse_shape[1] - 2
+    return jnp.pad(_restrict_interior(r, mc, nc), 1)
+
+
+def _prolong_axis0(c, mf: int):
+    """Bilinear interpolation along axis 0: full coarse rows (ring
+    included, ``mc + 2``) -> ``mf`` fine interior rows. Odd fine rows
+    copy their coarse row; even fine rows average the two flanking
+    coarse rows (the ring supplies the Dirichlet zero at the ends).
+    Interleaving is stack+reshape — layout ops, no scatter."""
+    mc = c.shape[0] - 2
+    ev = 0.5 * (c[0:mc + 1] + c[1:mc + 2])   # fine rows 0, 2, ..., 2mc
+    od = c[1:mc + 1]                          # fine rows 1, 3, ..., 2mc-1
+    core = jnp.stack([ev[:mc], od], axis=1).reshape(
+        (2 * mc,) + c.shape[1:])
+    if mf == 2 * mc + 1:
+        core = jnp.concatenate([core, ev[mc:mc + 1]], axis=0)
+    return core
+
+
+def prolong_bilinear(c, fine_interior: Tuple[int, int]):
+    """Bilinear prolongation of a full coarse array (ring included)
+    to a FULL fine array with a zero ring — the correction to add to
+    the fine iterate (its zero ring keeps boundary bits exact:
+    ``u + 0.0`` is the identity on every finite boundary value)."""
+    mf, nf = fine_interior
+    rows = _prolong_axis0(c, mf)
+    cols = _prolong_axis0(rows.T, nf).T
+    return jnp.pad(cols, 1)
+
+
+# --------------------------------------------------------------------------
+# Pallas transfer kernels (single-instance VMEM; interpreter off-TPU)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_restrict_kernel(fine_shape: Tuple[int, int],
+                           coarse_shape: Tuple[int, int]):
+    """``fn(r_full_f32) -> coarse_full_f32`` evaluating the exact
+    :func:`restrict_full_weighting` expression in one whole-array VMEM
+    kernel (both levels fit VMEM wherever the picker selects this —
+    the geometry is bounded by the audit's HL402 footprint proof)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from parallel_heat_tpu.ops.pallas_stencil import (
+        _compiler_params, _interpret)
+
+    mc, nc = coarse_shape[0] - 2, coarse_shape[1] - 2
+
+    def kernel(r_ref, c_ref):
+        out = _restrict_interior(r_ref[...], mc, nc)
+        c_ref[...] = jnp.zeros(coarse_shape, _ACC)
+        c_ref[1:mc + 1, 1:nc + 1] = out
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(coarse_shape, _ACC),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+        name="heat_mg_restrict",
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_prolong_kernel(coarse_shape: Tuple[int, int],
+                          fine_shape: Tuple[int, int]):
+    """``fn(coarse_full_f32) -> fine_full_f32`` (zero ring), the exact
+    :func:`prolong_bilinear` expression as a whole-array VMEM kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from parallel_heat_tpu.ops.pallas_stencil import (
+        _compiler_params, _interpret)
+
+    mf, nf = fine_shape[0] - 2, fine_shape[1] - 2
+
+    def kernel(c_ref, f_ref):
+        c = c_ref[...]
+        rows = _prolong_axis0(c, mf)
+        cols = _prolong_axis0(rows.T, nf).T
+        f_ref[...] = jnp.zeros(fine_shape, _ACC)
+        f_ref[1:mf + 1, 1:nf + 1] = cols
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(fine_shape, _ACC),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+        name="heat_mg_prolong",
+    )
+
+
+def transfer_ops(config: HeatConfig, backend: str):
+    """``(restrict(r, coarse_shape), prolong(c, fine_shape))`` — the
+    ONE decision site for the transfer spelling. The Pallas kernels
+    serve the single-device pallas backend; everything else (jnp
+    backend, any sharded mesh — GSPMD cannot partition a
+    ``pallas_call``) takes the jnp spelling. Both evaluate the same
+    expression tree; off-TPU the kernels run interpreted and are
+    bitwise the jnp path (pinned by tests/test_implicit.py)."""
+    sharded = any(d > 1 for d in config.mesh_or_unit())
+    if backend == "pallas" and not sharded:
+        def restrict(r, coarse_shape):
+            return _build_restrict_kernel(tuple(r.shape),
+                                          tuple(coarse_shape))(r)
+
+        def prolong(c, fine_shape):
+            return _build_prolong_kernel(tuple(c.shape),
+                                         tuple(fine_shape))(c)
+
+        return restrict, prolong
+    return (lambda r, coarse_shape:
+            restrict_full_weighting(r, coarse_shape),
+            lambda c, fine_shape:
+            prolong_bilinear(c, (fine_shape[0] - 2, fine_shape[1] - 2)))
+
+
+# --------------------------------------------------------------------------
+# The V-cycle and the implicit step
+# --------------------------------------------------------------------------
+
+def _vcycle_fn(config: HeatConfig, backend: str):
+    """``vcycle(u, b) -> u`` for the finest level, the recursion
+    unrolled over the static hierarchy at trace time."""
+    levels = level_coefficients(config)
+    nu = config.mg_smooth
+    restrict, prolong = transfer_ops(config, backend)
+
+    def cycle(l, u, b):
+        shape, ax, ay = levels[l]
+        for _ in range(nu):
+            u = smooth(u, b, ax, ay)
+        if l + 1 < len(levels):
+            cshape = levels[l + 1][0]
+            r = jnp.pad(residual_interior(u, b, ax, ay), 1)
+            ec = cycle(l + 1, jnp.zeros(cshape, _ACC),
+                       restrict(r, cshape))
+            # The prolonged correction carries a zero ring, so the
+            # boundary bits of u are exact through the add.
+            u = u + prolong(ec, shape)
+            for _ in range(nu):
+                u = smooth(u, b, ax, ay)
+        else:
+            for _ in range(_COARSE_SWEEPS):
+                u = smooth(u, b, ax, ay)
+        return u
+
+    return lambda u, b: cycle(0, u, b)
+
+
+def _rhs_fn(config: HeatConfig):
+    """``(rhs(uf) -> b, finish(x, uf) -> u'_f32)`` for the scheme.
+
+    Backward Euler solves ``A u' = u`` directly. Crank-Nicolson is
+    reformulated: instead of solving ``(I - L/2) u' = (I + L/2) u``
+    (whose right-hand stencil is a second fused stencil program — a
+    fusion-context fork risk for the bitwise sharding pin, see
+    ``_lap_interior``), solve ``(I - L/2) v = 2 u`` and set
+    ``u' = v - u`` — algebraically identical (add ``(I - L/2) u`` to
+    both sides), and the transformed RHS is an EXACT power-of-two
+    multiply with an exact single-op finish, so the only stencil
+    programs anywhere in the implicit step are the V-cycle's own
+    context-free sweeps."""
+    if config.scheme == "crank_nicolson":
+        return (lambda uf: 2.0 * uf,
+                lambda x, uf: x - uf)
+    return lambda uf: uf, lambda x, uf: x
+
+
+def _step_fn(config: HeatConfig, backend: str):
+    """One implicit step ``u -> u'`` in the storage dtype: build b,
+    iterate V-cycles until the residual machinery's verdict, round to
+    storage once."""
+    _, ax, ay = level_coefficients(config)[0]
+    vcycle = _vcycle_fn(config, backend)
+    rhs, finish = _rhs_fn(config)
+    tol_rel = config.mg_tol
+    max_cycles = config.mg_cycles
+
+    def step(u):
+        uf = u.astype(_ACC)
+        b = rhs(uf)
+        # Relative max-norm target; a zero RHS converges immediately
+        # (res0 == 0 <= tol == 0 fails the > test). The initial guess
+        # is b itself (== u for BE, == 2u ~ v for the transformed CN).
+        tol = tol_rel * jnp.max(jnp.abs(b[1:-1, 1:-1]))
+
+        def cond(c):
+            _x, i, res = c
+            return (res > tol) & (i < max_cycles)
+
+        def body(c):
+            x, i, _res = c
+            x = vcycle(x, b)
+            return x, i + 1, residual_norm(x, b, ax, ay)
+
+        x, _, _ = lax.while_loop(
+            cond, body, (b, jnp.int32(0), residual_norm(b, b, ax, ay)))
+        new = finish(x, uf)
+        return u.at[1:-1, 1:-1].set(new[1:-1, 1:-1].astype(u.dtype))
+
+    return step
+
+
+def implicit_multistep(config: HeatConfig, backend: str = "jnp"):
+    """``(multi_step(u, k), multi_step_residual(u, k))`` — the
+    implicit analogue of :func:`solver._single_multistep`'s families,
+    consumed by the same :func:`solver._make_loop` fixed/converge
+    machinery. The residual is ``max |u' - u|`` over the interior of
+    the LAST step, matching the explicit chunked convergence quantity.
+    """
+    step = _step_fn(config, backend)
+
+    def multi_step(u, k):
+        return lax.fori_loop(0, k, lambda i, uu: step(uu), u)
+
+    def multi_step_residual(u, k):
+        u = lax.fori_loop(0, k - 1, lambda i, uu: step(uu), u)
+        new = step(u)
+        res = jnp.max(jnp.abs(new[1:-1, 1:-1].astype(_ACC)
+                              - u[1:-1, 1:-1].astype(_ACC)))
+        return new, res
+
+    return multi_step, multi_step_residual
+
+
+# --------------------------------------------------------------------------
+# Observation-only instrumentation (telemetry / explain / bench)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=16)
+def _cycle_trace_fn(config: HeatConfig, max_cycles: int):
+    vcycle = _vcycle_fn(config, "jnp")
+    rhs, _finish = _rhs_fn(config)
+    _, ax, ay = level_coefficients(config)[0]
+    tol_rel = config.mg_tol
+
+    def trace(u):
+        uf = u.astype(_ACC)
+        b = rhs(uf)
+        tol = tol_rel * jnp.max(jnp.abs(b[1:-1, 1:-1]))
+        res0 = residual_norm(b, b, ax, ay)
+        # The EXACT while_loop shape of _step_fn's solve — same
+        # verdict, same cycle budget — plus a per-cycle residual
+        # record, so the trace can never misreport a step that the
+        # real solve converges (a fixed-length scan that caps below
+        # mg_cycles would).
+        buf0 = jnp.full((max_cycles,), jnp.nan, _ACC)
+
+        def cond(c):
+            _x, i, res, _buf = c
+            return (res > tol) & (i < max_cycles)
+
+        def body(c):
+            x, i, _res, buf = c
+            x = vcycle(x, b)
+            res = residual_norm(x, b, ax, ay)
+            return x, i + 1, res, buf.at[i].set(res)
+
+        _x, i, _res, buf = lax.while_loop(
+            cond, body, (b, jnp.int32(0), res0, buf0))
+        return res0, i, buf, jnp.max(jnp.abs(b[1:-1, 1:-1]))
+
+    return jax.jit(trace)
+
+
+def cycle_trace(config: HeatConfig, grid, max_cycles=None) -> dict:
+    """Observation-only V-cycle trace: re-solves ONE implicit step
+    from ``grid`` (never advancing the caller's state) with the SAME
+    while_loop/verdict the real step solve runs, recording the
+    per-cycle residual, and reports the cycle count under the run's
+    ``mg_tol`` verdict plus the per-cycle contraction factor. Powers
+    the ``vcycle`` telemetry event (solve_stream at the diag cadence)
+    and the bench row's convergence columns. ``max_cycles`` caps the
+    budget only when EXPLICITLY given (an instrumentation cost knob);
+    the default is the config's own ``mg_cycles``, so ``converged``
+    in the trace means exactly what it means in the solve."""
+    config = config.validate()
+    n = (min(config.mg_cycles, max_cycles)
+         if max_cycles is not None else config.mg_cycles)
+    r0, i, buf, bmax = _cycle_trace_fn(config, int(n))(grid)
+    r0, bmax = float(r0), float(bmax)
+    cycles = int(i)
+    tol = config.mg_tol * bmax
+    used = [float(r) for r in buf[:cycles]]
+    contraction = None
+    prev = r0
+    ratios = []
+    for r in used:
+        if prev > 0.0:
+            ratios.append(r / prev)
+        prev = r
+    if ratios:
+        p = 1.0
+        for q in ratios:
+            p *= q
+        contraction = p ** (1.0 / len(ratios))
+    return {"cycles": int(cycles), "tol": tol,
+            "residual_first": r0,
+            "residual_last": used[-1] if used else r0,
+            "residuals": used,
+            "contraction": contraction,
+            "levels": len(multigrid_level_shapes(config.shape,
+                                                 config.mg_levels)),
+            # Converged under the solve's own verdict — including the
+            # zero-cycle case (the initial residual already at/below
+            # tol, e.g. a steady state or a zero RHS).
+            "converged": bool(used[-1] <= tol if used else r0 <= tol)}
+
+
+def level_wall_shares(config: HeatConfig, repeats: int = 3) -> list:
+    """Measured wall share of one smoothing sweep per level —
+    observation-only host timing (each level's sweep jitted and timed
+    standalone, min over ``repeats``), normalized to sum to 1. The
+    bench row and the first ``vcycle`` telemetry event of a stream
+    carry it; ``tools/metrics_report.py`` renders and gates it."""
+    import time
+
+    walls = []
+    for shape, ax, ay in level_coefficients(config.validate()):
+        u = jnp.zeros(shape, _ACC)
+        b = jnp.ones(shape, _ACC)
+        fn = jax.jit(lambda uu, bb, _ax=ax, _ay=ay:
+                     smooth(uu, bb, _ax, _ay))
+        jax.block_until_ready(fn(u, b))  # compile outside the bracket
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(u, b))
+            best = min(best, time.perf_counter() - t0)
+        walls.append(best)
+    total = sum(walls) or 1.0
+    return [round(w / total, 4) for w in walls]
+
+
+def explain_hierarchy(config: HeatConfig, backend: str) -> dict:
+    """The resolved implicit path for ``solver.explain``: scheme,
+    theta, the level hierarchy (shapes + rediscretized coefficients),
+    smoother/transfer picks and the cycle-stop rule — the exact
+    structures :func:`implicit_multistep` builds (shared helpers, no
+    mirroring)."""
+    levels = level_coefficients(config)
+    sharded = any(d > 1 for d in config.mesh_or_unit())
+    transfers = ("pallas heat_mg_restrict/heat_mg_prolong "
+                 "(whole-array VMEM)"
+                 if backend == "pallas" and not sharded
+                 else "jnp full-weighting/bilinear")
+    return {
+        "scheme": config.scheme,
+        "theta": scheme_theta(config.scheme),
+        "levels": [{"shape": list(s), "cx": ax, "cy": ay}
+                   for s, ax, ay in levels],
+        "smoother": (f"weighted-Jacobi(omega={_OMEGA}) "
+                     f"V({config.mg_smooth},{config.mg_smooth}), "
+                     f"{_COARSE_SWEEPS} coarsest sweeps"),
+        "transfers": transfers,
+        "cycle_stop": (f"max|b - A u| <= {config.mg_tol:g} * max|b| "
+                       f"or {config.mg_cycles} cycles"),
+        "sharding": ("replicated full-grid program — every device "
+                     "computes the whole grid (bitwise the single-"
+                     "device run by construction; partitioned levels "
+                     "are the roadmap follow-on)" if sharded
+                     else "single device"),
+    }

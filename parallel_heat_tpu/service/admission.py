@@ -36,16 +36,39 @@ _DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
 _RESIDENT_BUFFERS = 3
 
 
+# Resident f32 arrays per multigrid level of an implicit solve: the
+# iterate, the RHS and the restricted residual live per level across
+# the V-cycle (ops/multigrid.py), on top of the storage-dtype state
+# pair the explicit estimate already prices.
+_MG_LEVEL_BUFFERS = 3
+
+
 def estimate_job_hbm_bytes(config: dict) -> int:
     """Static device-memory estimate for one job's grid state, from the
     job spec's config dict (``HeatConfig`` field names). Conservative
     by construction (see ``_RESIDENT_BUFFERS``); halo/reduction
-    scratch is second-order at the grid sizes the budget matters for."""
-    cells = int(config.get("nx", 20)) * int(config.get("ny", 20))
+    scratch is second-order at the grid sizes the budget matters for.
+
+    Implicit specs (``scheme`` != "explicit") additionally price the
+    multigrid level hierarchy: ``_MG_LEVEL_BUFFERS`` float32 arrays
+    per level, with the level shapes from the SAME jax-free
+    ``config.multigrid_level_shapes`` the V-cycle builder allocates
+    from — the admitted estimate cannot disagree with the solve."""
+    nx, ny = int(config.get("nx", 20)), int(config.get("ny", 20))
+    cells = nx * ny
     if config.get("nz") is not None:
         cells *= int(config["nz"])
     itemsize = _DTYPE_BYTES.get(str(config.get("dtype", "float32")), 4)
-    return cells * itemsize * _RESIDENT_BUFFERS
+    est = cells * itemsize * _RESIDENT_BUFFERS
+    if str(config.get("scheme", "explicit")) != "explicit":
+        from parallel_heat_tpu.config import multigrid_level_shapes
+
+        mg_levels = config.get("mg_levels")
+        for mx, my in multigrid_level_shapes(
+                (nx, ny),
+                int(mg_levels) if mg_levels is not None else None):
+            est += mx * my * 4 * _MG_LEVEL_BUFFERS
+    return est
 
 
 def estimate_pack_hbm_bytes(configs) -> int:

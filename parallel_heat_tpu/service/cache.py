@@ -41,6 +41,16 @@ bitwise contract an earlier PR pinned, see SEMANTICS.md):
 ==========  =================  =======================================
 target      donor entry        rule
 ==========  =================  =======================================
+any         other scheme       NEVER: cross-scheme reuse (explicit
+                               donor -> implicit target or vice
+                               versa) is inadmissible — the schemes
+                               compute different trajectories, so
+                               ``scheme`` (and the mg_* solver
+                               fields) sit in the base key, and the
+                               lookups ALSO re-check the donor's
+                               recorded scheme (defense in depth
+                               against a base-key collision; pinned
+                               by tests/test_cache.py).
 fixed       any                exact: identical semantic key.
 fixed       any                prefix: same base key (semantics minus
                                stepping), any generation ``k < steps``
@@ -226,6 +236,10 @@ def reduce_cache_journal(events, state=None
             entries[key] = {
                 "key": key,
                 "base": e.get("base"),
+                # Donor provenance for the cross-scheme decline; None
+                # on pre-scheme index lines, which were by
+                # construction explicit-scheme runs.
+                "scheme": e.get("scheme"),
                 "job_id": e.get("job_id"),
                 "attempt": e.get("attempt"),
                 "steps": e.get("steps"),
@@ -289,6 +303,19 @@ def _cadence_match(entry: dict, eps: float, ci: int) -> bool:
             and entry.get("check_interval") == ci)
 
 
+def _scheme_match(entry: dict, canon: dict) -> bool:
+    """The cross-scheme decline (see the admissibility table): a donor
+    whose recorded time integrator differs from the target's serves
+    NOTHING — not exact, not prefix. Structurally the base/exact keys
+    already separate schemes (``scheme`` is a non-stepping semantic
+    field), so this re-check is defense in depth: a colliding or
+    hand-edited index line still cannot cross the scheme boundary.
+    Entries from before the scheme field existed (recorded None) were
+    explicit-scheme runs by construction."""
+    return ((entry.get("scheme") or "explicit")
+            == (canon.get("scheme") or "explicit"))
+
+
 def lookup_exact(entries: Dict[str, dict], config: dict
                  ) -> Optional[Tuple[dict, str]]:
     """``(entry, kind)`` for an O(1) serve, or None. ``kind`` is
@@ -301,8 +328,8 @@ def lookup_exact(entries: Dict[str, dict], config: dict
     except CacheKeyError:
         return None
     e = entries.get(key)
-    if e is not None and e.get("steps_done") in (e.get("generations")
-                                                or []):
+    if e is not None and _scheme_match(e, canon) \
+            and e.get("steps_done") in (e.get("generations") or []):
         return e, "exact"
     steps, converge, eps, ci = _stepping(canon)
     if not converge:
@@ -310,7 +337,8 @@ def lookup_exact(entries: Dict[str, dict], config: dict
     base = base_key(config)
     best = None
     for e in entries.values():
-        if e.get("base") != base or not _cadence_match(e, eps, ci):
+        if e.get("base") != base or not _cadence_match(e, eps, ci) \
+                or not _scheme_match(e, canon):
             continue
         m = e.get("steps_done")
         if (e.get("converged") is True and isinstance(m, int)
@@ -344,7 +372,8 @@ def lookup_prefix(entries: Dict[str, dict], config: dict
     evidence_through = -1
     if converge:
         for e in entries.values():
-            if e.get("base") != base or not _cadence_match(e, eps, ci):
+            if e.get("base") != base or not _cadence_match(e, eps, ci) \
+                    or not _scheme_match(e, canon):
                 continue
             m = e.get("steps_done")
             if not isinstance(m, int):
@@ -357,7 +386,7 @@ def lookup_prefix(entries: Dict[str, dict], config: dict
 
     best: Optional[Tuple[dict, int]] = None
     for e in entries.values():
-        if e.get("base") != base:
+        if e.get("base") != base or not _scheme_match(e, canon):
             continue
         if not converge:
             # Fixed target: any family member's generations are the
@@ -627,6 +656,7 @@ class CacheIndex:
             converge=bool(canon.get("converge")),
             eps=canon.get("eps"),
             check_interval=canon.get("check_interval"),
+            scheme=canon.get("scheme"),
             steps_done=int(steps_done), converged=converged,
             generations=gens, bytes=size, payload=payload)
         self._consume([rec])

@@ -125,6 +125,11 @@ def _resolve_halo_depth(config: HeatConfig, backend: str) -> int:
     """
     if config.halo_depth is not None:
         return config.halo_depth
+    if config.scheme != "explicit":
+        # The K-deep temporal exchange is an explicit-scheme schedule;
+        # the implicit V-cycle exchanges 1-deep halos per smoothing
+        # sweep under GSPMD (validate() rejects explicit K > 1 there).
+        return 1
     mesh_shape = config.mesh_or_unit()
     if not any(d > 1 for d in mesh_shape) or backend != "pallas":
         return 1
@@ -174,11 +179,15 @@ def _resolved(config: HeatConfig):
     # shared with the round builders (temporal.resolve_halo_overlap),
     # so substituting here only makes the choice visible to explain
     # and the cache keys; it cannot fork from what the rounds build.
-    from parallel_heat_tpu.parallel.temporal import resolve_halo_overlap
+    # Implicit schemes take no temporal rounds (validate() rejects the
+    # flag there), so the schedule stays unresolved/None for them.
+    if config.scheme == "explicit":
+        from parallel_heat_tpu.parallel.temporal import (
+            resolve_halo_overlap)
 
-    mode = resolve_halo_overlap(config, backend)
-    if config.halo_overlap != mode:
-        config = config.replace(halo_overlap=mode).validate()
+        mode = resolve_halo_overlap(config, backend)
+        if config.halo_overlap != mode:
+            config = config.replace(halo_overlap=mode).validate()
     return config, backend, was_auto
 
 
@@ -304,6 +313,14 @@ def _make_loop(multi_step, multi_step_residual, config: HeatConfig):
 
 def _single_multistep(config: HeatConfig, backend: str):
     """(multi_step, multi_step_residual) on the full grid, one device."""
+    if config.scheme != "explicit":
+        # Implicit schemes: every step is a multigrid V-cycle solve
+        # (ops/multigrid.py). The ONE dispatch site — the ensemble
+        # engine's vmap path and the HL103 trace targets route through
+        # here too, so the batched/audited programs are the program.
+        from parallel_heat_tpu.ops import multigrid
+
+        return multigrid.implicit_multistep(config, backend)
     if backend == "pallas":
         from parallel_heat_tpu.ops import pallas_stencil
 
@@ -347,6 +364,41 @@ def _build_runner(config: HeatConfig):
         multi_step, multi_step_residual = _single_multistep(config, backend)
         run = _make_loop(multi_step, multi_step_residual, config)
         return jax.jit(run, donate_argnums=0), None
+
+    if config.scheme != "explicit":
+        # Sharded implicit runs compute the V-cycle REPLICATED: the
+        # grid enters in its mesh sharding, is gathered once, the
+        # whole step loop runs as full-shape fusions on every device,
+        # and the final grid leaves re-sharded for downstream
+        # consumers (checkpoint gather, diagnostics). This is what
+        # makes the bitwise pin — sharded == single-device, exactly —
+        # hold BY CONSTRUCTION: the replicated module's fusion
+        # computations are identical to the solo module's, so their
+        # codegen is too. A GSPMD-partitioned V-cycle is measurably
+        # NOT bitwise-stable on XLA:CPU (FMA contraction is decided
+        # per fused loop body, and partitioning reshuffles vector
+        # bodies/tails and layouts — one-ulp forks at ~20% of cells,
+        # probed at several meshes); partitioning the levels with
+        # padded shard_map blocks is the roadmap follow-on
+        # (SEMANTICS.md "Implicit stepping"). The pallas transfer
+        # kernels likewise decline here — the jnp spelling is the
+        # pinned one.
+        from parallel_heat_tpu.ops import multigrid
+
+        mesh = make_heat_mesh(mesh_shape)
+        rep = NamedSharding(mesh, P())
+        ms, msr = multigrid.implicit_multistep(config, "jnp")
+        inner = _make_loop(ms, msr, config)
+
+        def run(u_in):
+            # No exit re-shard: a trailing constraint back-propagates
+            # partitioned shardings INTO the loop (probed — it
+            # reintroduces the (2,4) fork), so the result grid stays
+            # replicated (each device holds the full final grid;
+            # gather/checkpoint/IO consume it directly).
+            return inner(jax.lax.with_sharding_constraint(u_in, rep))
+
+        return jax.jit(run, donate_argnums=0), mesh
 
     if config.ndim == 3:
         from parallel_heat_tpu.parallel import halo3d
@@ -535,6 +587,7 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
         "shape": config.shape,
         "mesh": mesh_shape if is_sharded else None,
         "mode": "converge" if config.converge else "fixed",
+        "scheme": config.scheme,
     }
     if ensemble is not None:
         from parallel_heat_tpu.ensemble.engine import (
@@ -564,6 +617,22 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
         out["pipeline"] = (f"depth {config.pipeline_depth} dispatch-"
                            f"ahead stream (dispatch-order only; "
                            f"observer drain overlaps the next chunk)")
+    if config.scheme != "explicit":
+        # Implicit path: report the exact hierarchy/smoother/transfer
+        # structures implicit_multistep builds (shared helpers in
+        # ops/multigrid.py — no mirroring, same no-desync rationale as
+        # the kernel picks below).
+        from parallel_heat_tpu.ops import multigrid
+
+        mg = multigrid.explain_hierarchy(
+            config, backend if not is_sharded else "jnp")
+        out["multigrid"] = mg
+        out["path"] = (
+            f"implicit {config.scheme}: multigrid V-cycle per step "
+            f"({len(mg['levels'])} levels, {mg['smoother']}, "
+            f"{mg['transfers']})")
+        return out
+
     if is_sharded:
         out["halo_depth"] = (f"{config.halo_depth} (auto)" if auto_depth
                              else config.halo_depth)
@@ -1078,6 +1147,11 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
     next_diag = diag_interval if diag_interval is not None else None
     prev_diag = None
     prev_diag_step = 0
+    # Implicit runs: whether the once-per-stream level-wall-share
+    # measurement already rode a vcycle sample (sync loop only — the
+    # pipelined dispatch region must not synchronize, so depth > 1
+    # streams carry chunk/diag events but no vcycle samples).
+    vc_shares_sent = False
     if next_diag is not None:
         # The update-residual baseline: a COPY of the initial state (the
         # first chunk donates `u` itself). This is the one grid-sized
@@ -1298,6 +1372,24 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
             prev_diag_step = done
             while next_diag <= done:
                 next_diag += diag_interval
+            if config.scheme != "explicit":
+                # Implicit runs: the V-cycle convergence sample rides
+                # the diag cadence — an observation-only re-solve of
+                # ONE step from this boundary's state (the yielded
+                # trajectory never moves; SEMANTICS.md "Implicit
+                # stepping"). The first sample of a stream also
+                # carries the measured per-level wall shares.
+                from parallel_heat_tpu.ops import multigrid
+
+                vc = multigrid.cycle_trace(config, grid)
+                if not vc_shares_sent:
+                    vc["level_wall_share"] = {
+                        f"l{i}": s for i, s in enumerate(
+                            multigrid.level_wall_shares(config))}
+                    vc_shares_sent = True
+                diag["vcycle"] = vc
+                if telemetry is not None:
+                    telemetry.emit("vcycle", step=done, **vc)
         if telemetry is not None:
             observe_s = time.perf_counter() - t_complete_prev
             telemetry.chunk(step=done, steps=k, wall_s=chunk_wall,
@@ -1383,6 +1475,10 @@ def solve(config: HeatConfig, initial: Optional[jax.Array] = None,
         diag = grid_stats(grid, prev=diag_baseline)
         diag["step"] = steps_run
         diag["steps_since"] = steps_run
+        if config.scheme != "explicit":
+            from parallel_heat_tpu.ops import multigrid
+
+            diag["vcycle"] = multigrid.cycle_trace(config, grid)
     return HeatResult(grid=grid, steps_run=steps_run, converged=conv,
                       residual=res, elapsed_s=elapsed, finite=finite,
                       diagnostics=diag)

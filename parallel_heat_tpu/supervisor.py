@@ -388,6 +388,22 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
         parts.append("--no-overlap")
     if config.accumulate != "storage":
         parts.append(f"--accumulate {config.accumulate}")
+    if config.scheme != "explicit":
+        # SEMANTIC like everything above it: dropping --scheme would
+        # resume an implicit checkpoint as an EXPLICIT run — at the
+        # super-stability coefficients implicit runs exist for, a
+        # deterministic blow-up (and at any coefficients a different
+        # trajectory, breaking the resume-bitwise contract).
+        parts.append(f"--scheme {config.scheme}")
+        defaults = HeatConfig()
+        for flag, val, default in (
+                ("--mg-tol", config.mg_tol, defaults.mg_tol),
+                ("--mg-cycles", config.mg_cycles, defaults.mg_cycles),
+                ("--mg-smooth", config.mg_smooth, defaults.mg_smooth),
+                ("--mg-levels", config.mg_levels, defaults.mg_levels)):
+            if val != default:
+                parts.append(f"{flag} {val:g}" if isinstance(val, float)
+                             else f"{flag} {val}")
     parts += ["--supervise", f"--checkpoint {shlex.quote(stem)}",
               f"--checkpoint-every {policy.checkpoint_every}",
               f"--keep-checkpoints {policy.keep_checkpoints}",
@@ -1179,7 +1195,8 @@ def _run_supervised(config: HeatConfig, checkpoint,
                             # PermanentFailure below.
                             kind = (f"progress guard: heat-content drift "
                                     f"in steps ({lo}, {hi}]")
-                        elif config.stability_margin() < 0:
+                        elif config.scheme == "explicit" \
+                                and config.stability_margin() < 0:
                             raise fail(
                                 f"non-finite grid values in steps ({lo}, "
                                 f"{hi}]: coefficient sum "
@@ -1189,7 +1206,11 @@ def _run_supervised(config: HeatConfig, checkpoint,
                                 f"explicit scheme diverges deterministically; "
                                 f"retrying cannot help. Reduce the "
                                 f"coefficients (cx/cy/cz) below a sum of "
-                                f"1/2. Last good checkpoint: step {lo}.",
+                                f"1/2, or switch to the implicit "
+                                f"integrator (--scheme backward_euler), "
+                                f"which is unconditionally stable at any "
+                                f"step size. Last good checkpoint: step "
+                                f"{lo}.",
                                 kind="unstable",
                             ) from None
                         else:

@@ -402,6 +402,34 @@ def test_hl103_real_solver_programs_clean():
     assert audit_dirichlet() == []
 
 
+def test_hl103_implicit_update_program_pinned():
+    # The implicit-stepping satellite (SEMANTICS.md "Implicit
+    # stepping"): the default target matrix TRACES the implicit
+    # update programs — the whole V-cycle, per-step while_loop and
+    # storage round-off included — so their grid-shaped writes are
+    # proven interior-only, not just the explicit loops'. Pin the
+    # labels so a refactor cannot silently drop the coverage.
+    from parallel_heat_tpu.analysis.contracts import (
+        _default_dirichlet_targets)
+
+    labels = {t[0] for t in _default_dirichlet_targets()}
+    assert {"jnp-2d-implicit-be", "jnp-2d-implicit-cn"} <= labels
+
+
+def test_hl2xx_scan_scope_covers_multigrid_module():
+    # HL2xx AST coverage pinned over the new implicit modules: the
+    # default scan path set must reach ops/multigrid.py (and keep
+    # reaching the solver), so the AST hygiene rules — dispatch-region
+    # sync bans, kernel-name literals, lock discipline — audit the
+    # V-cycle code like everything else.
+    from parallel_heat_tpu.analysis.astlint import (
+        _iter_py_files, default_scan_paths)
+
+    files = {os.path.basename(p) for p in
+             _iter_py_files(default_scan_paths())}
+    assert "multigrid.py" in files and "solver.py" in files
+
+
 # ---------------------------------------------------------------------------
 # HL104 f32chunk accumulation chain
 # ---------------------------------------------------------------------------
@@ -1076,14 +1104,17 @@ def test_hl401_data_dependent_window_unprovable():
 def test_hl4xx_real_kernels_clean_and_all_sites_covered():
     """The acceptance gate for the kernel layer: every builder passes
     at its representative geometry, and the audit's coverage
-    cross-check pins all 18 pallas_call sites across
-    pallas_stencil.py and the member-batched ops/batched.py (kernel M
-    joined in PR 9 — a 19th site fails this count AND the uncovered-
-    site cross-check until it gets an audit target)."""
+    cross-check pins all 20 pallas_call sites across
+    pallas_stencil.py, the member-batched ops/batched.py (kernel M,
+    PR 9) and the multigrid transfer kernels in ops/multigrid.py
+    (heat_mg_restrict/heat_mg_prolong, the implicit-stepping PR) — a
+    21st site fails this count AND the uncovered-site cross-check
+    until it gets an audit target."""
     assert audit_kernels() == []
     names = _source_kernel_names()
-    assert len(names) == 18
+    assert len(names) == 20
     assert "heat_m_ens_vmem_multistep" in names
+    assert "heat_mg_restrict" in names and "heat_mg_prolong" in names
 
 
 def test_hl401_uncovered_site_mechanism():

@@ -51,6 +51,8 @@ def test_cache_key_moves_with_every_semantic_field():
         "check_interval": 7, "dtype": "bfloat16", "backend": "jnp",
         "mesh_shape": [2, 1], "overlap": False, "halo_depth": 2,
         "halo_overlap": "phase", "accumulate": "f32chunk",
+        "scheme": "backward_euler", "mg_tol": 1e-5, "mg_cycles": 7,
+        "mg_smooth": 2, "mg_levels": 3,
     }
     assert set(moved) == set(SEMANTIC_FIELDS)
     for field, value in moved.items():
@@ -212,6 +214,7 @@ def _entry_for(config, steps_done, converged=None, gens=None,
     return _put(key, base=C.base_key(config), t=t, job_id=job_id,
                 steps=canon["steps"], converge=canon["converge"],
                 eps=canon["eps"], check_interval=canon["check_interval"],
+                scheme=canon.get("scheme"),
                 steps_done=steps_done, converged=converged,
                 generations=gens or [steps_done])
 
@@ -286,6 +289,95 @@ def test_lookup_prefix_semantic_mismatch_never_crosses():
                   {"nx": 32, "ny": 32}):
         target = dict(_FIXED60, steps=120, **delta)
         assert C.lookup_prefix(entries, target) is None, delta
+
+
+def test_cache_key_unclassified_scheme_field_fails_like_hl101():
+    # The satellite contract (SEMANTICS.md "Implicit stepping"): a
+    # NEW scheme-adjacent config field that joins neither partition
+    # tuple must fail key derivation loudly — a doctored subclass
+    # sneaking an unclassified solver knob past HL101 cannot silently
+    # key (or silently ignore) it at the serving layer.
+    @dataclasses.dataclass(frozen=True)
+    class DoctoredScheme(HeatConfig):
+        mg_omega: float = 0.8  # a plausible-looking unclassified knob
+
+    with pytest.raises(C.CacheKeyError, match="mg_omega"):
+        C.cache_key({"nx": 16, "scheme": "backward_euler"},
+                    config_cls=DoctoredScheme)
+
+
+def test_cross_scheme_reuse_declines_both_directions():
+    # Explicit donor must serve NOTHING to an implicit target, and
+    # vice versa — the schemes compute different trajectories
+    # (SEMANTICS.md "Implicit stepping": the admissibility table's
+    # first row). Structurally the scheme sits in the base key, so
+    # both lookups miss without any entry even being scheme-checked.
+    stiff = {"nx": 16, "ny": 16, "steps": 60, "cx": 2.0, "cy": 2.0,
+             "scheme": "backward_euler"}
+    explicit_donor = _entry_for({**stiff, "scheme": "explicit"}, 60,
+                                gens=[20, 40, 60], job_id="exp")
+    implicit_donor = _entry_for(stiff, 60, gens=[20, 40, 60],
+                                job_id="imp")
+    entries = _entries(explicit_donor, implicit_donor)
+    # Exact: each target hits only its own scheme's entry.
+    hit = C.lookup_exact(entries, stiff)
+    assert hit is not None and hit[0]["job_id"] == "imp"
+    hit = C.lookup_exact(entries, {**stiff, "scheme": "explicit"})
+    assert hit is not None and hit[0]["job_id"] == "exp"
+    # Prefix: extensions resume only from the same-scheme donor.
+    entry, gen = C.lookup_prefix(entries, dict(stiff, steps=120))
+    assert entry["job_id"] == "imp" and gen == 60
+    entry, _ = C.lookup_prefix(
+        entries, dict(stiff, steps=120, scheme="explicit"))
+    assert entry["job_id"] == "exp"
+    # A lone cross-scheme donor serves nothing at all.
+    only_explicit = _entries(explicit_donor)
+    assert C.lookup_exact(only_explicit, stiff) is None
+    assert C.lookup_prefix(only_explicit,
+                           dict(stiff, steps=120)) is None
+    only_implicit = _entries(implicit_donor)
+    explicit_target = {**stiff, "scheme": "explicit"}
+    assert C.lookup_exact(only_implicit, explicit_target) is None
+    assert C.lookup_prefix(only_implicit,
+                           dict(explicit_target, steps=120)) is None
+    # mg solver knobs are semantic too: a different mg_tol is a
+    # different trajectory family — no reuse.
+    assert C.lookup_prefix(
+        entries, dict(stiff, steps=120, mg_tol=1e-5)) is None
+
+
+def test_cross_scheme_decline_survives_forged_base_collision():
+    # Defense in depth (cache.py::_scheme_match): even an index line
+    # FORGED to carry the other scheme's base key — a collision the
+    # content address makes cryptographically implausible, a
+    # hand-edited journal does not — must not cross the scheme wall,
+    # because the lookups re-check the donor's recorded scheme.
+    stiff = {"nx": 16, "ny": 16, "steps": 60, "cx": 2.0, "cy": 2.0,
+             "scheme": "backward_euler"}
+    forged = _entry_for({**stiff, "scheme": "explicit"}, 60,
+                        gens=[20, 40, 60], job_id="forged")
+    forged["base"] = C.base_key(stiff)  # the lie
+    entries = _entries(forged)
+    assert C.lookup_prefix(entries, dict(stiff, steps=120)) is None
+    # Converged-dominance arm re-checks too.
+    conv = dict(stiff, converge=True, eps=1e-2, check_interval=10,
+                steps=100)
+    forged2 = _entry_for({**conv, "scheme": "explicit"}, 40,
+                         converged=True, job_id="forged2")
+    forged2["base"] = C.base_key(conv)
+    assert C.lookup_exact(_entries(forged2),
+                          dict(conv, steps=400)) is None
+    # Pre-scheme index lines (scheme unrecorded) remain valid
+    # explicit donors — None means "explicit by construction".
+    legacy = _entry_for({k: v for k, v in stiff.items()
+                         if k != "scheme"}, 60, gens=[20, 40, 60],
+                        job_id="legacy")
+    legacy.pop("scheme", None)
+    entry, gen = C.lookup_prefix(
+        _entries(legacy),
+        {k: v for k, v in dict(stiff, steps=120).items()
+         if k != "scheme"})
+    assert entry["job_id"] == "legacy" and gen == 60
 
 
 def test_lookup_prefix_converge_needs_unconverged_donor():

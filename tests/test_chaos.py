@@ -202,8 +202,23 @@ def test_supervisor_halts_permanent_on_stability_violation(tmp_path):
     # first bad chunk window, and the no-retry verdict
     assert "stability bound" in msg and "margin" in msg
     assert "steps (" in msg and "retrying cannot help" in msg
+    # ...and the escape hatch: the implicit integrator takes steps of
+    # any size (SEMANTICS.md "Implicit stepping"; regression-pinned
+    # alongside config.validate()'s warning string)
+    assert "--scheme backward_euler" in msg
     # no retries were burned on a deterministic blow-up
     assert "rollback retr" not in msg
+    assert ei.value.kind == "unstable"
+
+
+def test_supervisor_implicit_scheme_not_classified_unstable(tmp_path):
+    # The same coefficients under backward_euler are NOT a stability
+    # violation: the implicit run completes supervised, no trips.
+    cfg = HeatConfig(steps=100, cx=5.0, cy=5.0,
+                     scheme="backward_euler", **_BASE)
+    sres = run_supervised(cfg, tmp_path / "ck", policy=_policy())
+    assert sres.result.steps_run == 100
+    assert sres.guard_trips == 0 and sres.retries == 0
 
 
 def test_supervisor_exhausts_retry_budget_on_recurring_fault(tmp_path):

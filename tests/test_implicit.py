@@ -1,0 +1,486 @@
+"""Implicit time-stepping via the multigrid V-cycle (SEMANTICS.md
+"Implicit stepping").
+
+The contracts pinned here:
+
+- **accuracy**: backward Euler tracks the explicit trajectory at the
+  same dt (the schemes differ at O(dt)); at 100x the explicit-stable
+  dt the run stays finite and lands within the documented tolerance
+  of the explicit reference at the same physical time, where explicit
+  at that dt diverges to inf;
+- **order**: Crank-Nicolson's error against a fine-dt reference is
+  strictly below backward Euler's at the same large dt (second vs
+  first order);
+- **bitwise pins**: run-to-run reproducibility; sharded (the 8-device
+  CPU mesh) vs single-device bitwise equality of the same spec;
+  chunked stream vs one-shot bitwise equality; observation-only
+  toggles (guard/diag/pipeline) cause ZERO new ``_build_runner``
+  misses and move no bits;
+- **machinery transfer**: converge mode's residual loop drives
+  implicit steps unchanged; the ensemble engine batches V-cycles over
+  members bitwise the solo member; the Pallas transfer kernels are
+  (in interpreter mode) bitwise the jnp spelling, so the pallas
+  backend's implicit solve equals the jnp backend's exactly;
+- **observability**: ``vcycle`` telemetry events carry cycles,
+  per-cycle residuals, the contraction factor and (once per stream)
+  the measured per-level wall shares; ``solver.explain`` reports the
+  hierarchy the builder actually constructs;
+- **serving**: heatd's HBM admission prices the level hierarchy on
+  top of the explicit estimate, from the same jax-free level-shape
+  source of truth.
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.config import (
+    HeatConfig,
+    multigrid_level_shapes,
+)
+from parallel_heat_tpu.solver import explain, solve, solve_stream
+
+_ACC = jnp.float32
+
+
+def _solve_grid(cfg, **kw):
+    return solve(cfg.validate(), **kw).to_numpy()
+
+
+# ---------------------------------------------------------------------------
+# Hierarchy geometry
+# ---------------------------------------------------------------------------
+
+def test_level_shapes_halving_and_floor():
+    assert multigrid_level_shapes((34, 34)) == [
+        (34, 34), (18, 18), (10, 10), (6, 6)]
+    # Odd interiors coarsen too (m // 2), down to the 3-cell floor.
+    assert multigrid_level_shapes((513, 9)) == [(513, 9), (257, 5)]
+    # mg_levels caps the depth.
+    assert multigrid_level_shapes((34, 34), 2) == [(34, 34), (18, 18)]
+    # Too small to coarsen: single-level hierarchy (smoother-only).
+    assert multigrid_level_shapes((5, 5)) == [(5, 5)]
+
+
+# ---------------------------------------------------------------------------
+# Validation and the stability-warning escape hatch (satellite)
+# ---------------------------------------------------------------------------
+
+def test_scheme_validation_rejections():
+    with pytest.raises(ValueError, match="scheme must be one of"):
+        HeatConfig(scheme="midpoint").validate()
+    with pytest.raises(ValueError, match="only apply to the implicit"):
+        HeatConfig(mg_tol=1e-6).validate()  # mg knob on explicit
+    with pytest.raises(ValueError, match="2D-only"):
+        HeatConfig(nz=8, scheme="backward_euler").validate()
+    with pytest.raises(ValueError, match="f32chunk"):
+        HeatConfig(scheme="backward_euler", dtype="bfloat16",
+                   accumulate="f32chunk").validate()
+    with pytest.raises(ValueError, match="explicit-scheme exchange"):
+        HeatConfig(nx=32, ny=32, scheme="backward_euler",
+                   halo_depth=8).validate()
+    with pytest.raises(ValueError, match="does not apply"):
+        HeatConfig(scheme="crank_nicolson",
+                   halo_overlap="pipeline").validate()
+    with pytest.raises(ValueError, match="overlap=False"):
+        HeatConfig(scheme="backward_euler", overlap=False).validate()
+    # halo_depth=1 (the per-sweep exchange) is the resolved value and
+    # must validate — solver._resolved substitutes it.
+    HeatConfig(scheme="backward_euler", halo_depth=1).validate()
+
+
+def test_stability_warning_names_implicit_escape_hatch():
+    # Satellite contract: the bound-violation warning is actionable —
+    # it names the --scheme backward_euler escape hatch; implicit
+    # schemes (unconditionally stable) never warn.
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        HeatConfig(cx=0.4, cy=0.4).validate()
+    msgs = [str(x.message) for x in w]
+    assert any("stability bound" in m and "--scheme backward_euler"
+               in m for m in msgs), msgs
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        HeatConfig(cx=0.4, cy=0.4, scheme="backward_euler").validate()
+        HeatConfig(cx=40.0, cy=40.0, scheme="crank_nicolson").validate()
+    assert not w, [str(x.message) for x in w]
+
+
+# ---------------------------------------------------------------------------
+# Accuracy
+# ---------------------------------------------------------------------------
+
+def test_backward_euler_tracks_explicit_at_same_dt():
+    base = dict(nx=34, ny=34, cx=0.1, cy=0.1, steps=200, backend="jnp")
+    ge = _solve_grid(HeatConfig(**base))
+    gi = _solve_grid(HeatConfig(scheme="backward_euler", **base))
+    scale = float(np.max(np.abs(ge)))
+    assert np.all(np.isfinite(gi))
+    # The schemes differ at O(dt): small relative to the field.
+    assert float(np.max(np.abs(ge - gi))) < 5e-3 * scale
+
+
+def test_implicit_100x_dt_finite_and_close_where_explicit_diverges():
+    # 100x the explicit-stable step: explicit blows up to inf at this
+    # coefficient sum; backward Euler completes and lands near the
+    # explicit reference run at 100x more, stable, steps to the same
+    # physical time. The bound here is 3e-2 of the problem scale: at
+    # 34^2 one implicit step covers far more diffusion time relative
+    # to the grid than at the bench row's 512^2 (where the documented
+    # 1e-2 tolerance is met at ~2.6e-4 —
+    # BENCH_r15_implicit_dryrun.json), so the first-order damping
+    # error is proportionally larger.
+    ref = _solve_grid(HeatConfig(nx=34, ny=34, cx=0.2, cy=0.2,
+                                 steps=1000, backend="jnp"))
+    gi = _solve_grid(HeatConfig(nx=34, ny=34, cx=20.0, cy=20.0,
+                                steps=10, backend="jnp",
+                                scheme="backward_euler"))
+    assert np.all(np.isfinite(gi))
+    scale = float(np.max(np.abs(
+        _solve_grid(HeatConfig(nx=34, ny=34, steps=0)))))
+    assert float(np.max(np.abs(ref - gi))) < 3e-2 * scale
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # intentional instability
+        # 20 steps: the highest mode amplifies ~159x/step, so the
+        # explicit run provably overflows f32 well within the window
+        # (10 steps would still be finite — ~1e27 of headroom).
+        diverged = solve(HeatConfig(nx=34, ny=34, cx=20.0, cy=20.0,
+                                    steps=20, backend="jnp",
+                                    guard_interval=20))
+    assert diverged.finite is False  # the explicit run at this dt
+
+
+def test_crank_nicolson_beats_backward_euler_at_large_dt():
+    # Second vs first order: against a fine-dt explicit reference,
+    # CN's error at a 50x step is strictly below BE's.
+    ref = _solve_grid(HeatConfig(nx=26, ny=26, cx=0.2, cy=0.2,
+                                 steps=500, backend="jnp"))
+    big = dict(nx=26, ny=26, cx=10.0, cy=10.0, steps=10,
+               backend="jnp")
+    be = _solve_grid(HeatConfig(scheme="backward_euler", **big))
+    cn = _solve_grid(HeatConfig(scheme="crank_nicolson", **big))
+    err_be = float(np.max(np.abs(ref - be)))
+    err_cn = float(np.max(np.abs(ref - cn)))
+    assert err_cn < err_be
+
+
+def test_converge_mode_drives_implicit_steps():
+    # The converge-mode residual machinery transfers unchanged: an
+    # implicit run reaches eps (in a handful of giant steps) and
+    # reports converged with steps_run < budget.
+    cfg = HeatConfig(nx=26, ny=26, cx=50.0, cy=50.0, steps=400,
+                     converge=True, check_interval=4, eps=1e-2,
+                     backend="jnp", scheme="backward_euler")
+    r = solve(cfg)
+    assert r.converged is True
+    assert 0 < r.steps_run < 400
+    assert r.residual is not None and r.residual < 1e-2
+
+
+# ---------------------------------------------------------------------------
+# Bitwise pins
+# ---------------------------------------------------------------------------
+
+def test_bitwise_reproducible_run_to_run():
+    cfg = HeatConfig(nx=34, ny=34, cx=12.5, cy=12.5, steps=6,
+                     backend="jnp", scheme="backward_euler")
+    a = _solve_grid(cfg)
+    b = _solve_grid(cfg)
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("mesh", [(2, 4), (4, 2)])
+def test_sharded_bitwise_identical_to_single_device(mesh):
+    # THE multi-chip pin: the same implicit spec on the 8-device CPU
+    # mesh is bitwise the single-device run — GSPMD partitions the
+    # V-cycle (every reduction is the exactly-associative max).
+    base = dict(nx=32, ny=32, cx=12.5, cy=12.5, steps=4,
+                backend="jnp", scheme="backward_euler")
+    solo = _solve_grid(HeatConfig(**base))
+    sharded = _solve_grid(HeatConfig(mesh_shape=mesh, **base))
+    np.testing.assert_array_equal(solo, sharded)
+
+
+@pytest.mark.slow
+def test_sharded_bitwise_converge_and_cn():
+    # The heavier parity surface (converge-mode while_loop + CN RHS
+    # over the mesh) — slow-marked per the tier-1 wall budget.
+    for scheme in ("backward_euler", "crank_nicolson"):
+        base = dict(nx=64, ny=64, cx=25.0, cy=25.0, steps=120,
+                    converge=True, check_interval=4, eps=1e-3,
+                    backend="jnp", scheme=scheme)
+        solo = solve(HeatConfig(**base))
+        sharded = solve(HeatConfig(mesh_shape=(2, 4), **base))
+        assert solo.steps_run == sharded.steps_run
+        assert solo.residual == sharded.residual
+        np.testing.assert_array_equal(solo.to_numpy(),
+                                      sharded.to_numpy())
+
+
+def test_stream_chunked_bitwise_matches_one_shot():
+    cfg = HeatConfig(nx=26, ny=26, cx=12.5, cy=12.5, steps=9,
+                     backend="jnp", scheme="backward_euler")
+    one = _solve_grid(cfg)
+    last = None
+    for last in solve_stream(cfg, chunk_steps=2):
+        pass
+    np.testing.assert_array_equal(one, last.to_numpy())
+    assert last.steps_run == 9
+
+
+def test_observer_toggles_zero_new_runner_misses_and_zero_bit_drift():
+    # Acceptance pin: guard/diag/pipeline flips on an implicit config
+    # reuse the plain run's compiled programs (no new _build_runner
+    # misses) and move no bits.
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(nx=26, ny=26, cx=12.5, cy=12.5, steps=9,
+                     backend="jnp", scheme="backward_euler")
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=3)]
+    misses = solver._build_runner.cache_info().misses
+    observed = [r.to_numpy() for r in solve_stream(
+        cfg.replace(guard_interval=3, diag_interval=3),
+        chunk_steps=3)]
+    piped = [r.to_numpy() for r in solve_stream(
+        cfg.replace(pipeline_depth=2, converge=False), chunk_steps=3)]
+    assert solver._build_runner.cache_info().misses == misses
+    for a, b in zip(plain, observed):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(plain, piped):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Pallas transfer kernels
+# ---------------------------------------------------------------------------
+
+def test_pallas_transfer_kernels_bitwise_jnp_spelling():
+    from parallel_heat_tpu.ops import multigrid as mg
+
+    rng = np.random.RandomState(7)
+    fine = jnp.asarray(np.pad(
+        rng.randn(32, 32).astype(np.float32), 1))
+    coarse_shape = multigrid_level_shapes((34, 34))[1]
+    r_jnp = mg.restrict_full_weighting(fine, coarse_shape)
+    r_pl = mg._build_restrict_kernel((34, 34), tuple(coarse_shape))(fine)
+    np.testing.assert_array_equal(np.asarray(r_jnp), np.asarray(r_pl))
+    p_jnp = mg.prolong_bilinear(r_jnp, (32, 32))
+    p_pl = mg._build_prolong_kernel(tuple(coarse_shape), (34, 34))(r_jnp)
+    np.testing.assert_array_equal(np.asarray(p_jnp), np.asarray(p_pl))
+    # Boundary ring of the prolonged correction is exactly zero (what
+    # keeps boundary bits exact through the correction add).
+    p = np.asarray(p_pl)
+    assert not p[0].any() and not p[-1].any()
+    assert not p[:, 0].any() and not p[:, -1].any()
+
+
+def test_pallas_backend_implicit_solve_matches_jnp():
+    # Off-TPU the transfer kernels run interpreted and are bitwise the
+    # jnp spelling, so the whole pallas-backend implicit solve equals
+    # the jnp backend's exactly — and explain reports the kernel pick.
+    cfg = dict(nx=34, ny=34, cx=12.5, cy=12.5, steps=4,
+               scheme="backward_euler")
+    gj = _solve_grid(HeatConfig(backend="jnp", **cfg))
+    gp = _solve_grid(HeatConfig(backend="pallas", **cfg))
+    np.testing.assert_array_equal(gj, gp)
+    ex = explain(HeatConfig(backend="pallas", **cfg))
+    assert "heat_mg_restrict" in ex["multigrid"]["transfers"]
+
+
+# ---------------------------------------------------------------------------
+# explain / ensemble / admission / telemetry
+# ---------------------------------------------------------------------------
+
+def test_explain_reports_hierarchy_and_smoother():
+    cfg = HeatConfig(nx=34, ny=34, cx=12.5, cy=12.5, steps=4,
+                     backend="jnp", scheme="backward_euler")
+    ex = explain(cfg)
+    assert ex["scheme"] == "backward_euler"
+    mgx = ex["multigrid"]
+    assert [tuple(lv["shape"]) for lv in mgx["levels"]] == \
+        multigrid_level_shapes((34, 34))
+    # Rediscretized coefficients: theta*c / 4^l.
+    assert mgx["levels"][1]["cx"] == pytest.approx(12.5 / 4)
+    assert mgx["theta"] == 1.0
+    assert "weighted-Jacobi" in mgx["smoother"]
+    assert "V-cycle" in ex["path"]
+    assert explain(cfg.replace(scheme="crank_nicolson")
+                   )["multigrid"]["theta"] == 0.5
+
+
+def test_ensemble_batches_vcycles_bitwise_member_parity():
+    from parallel_heat_tpu.ensemble.engine import (
+        EnsembleSolver, ensemble_path, packable)
+
+    cfg = HeatConfig(nx=20, ny=20, cx=12.5, cy=12.5, steps=4,
+                     backend="jnp", scheme="backward_euler")
+    assert ensemble_path(cfg) == "vmap"
+    ok, reason = packable(cfg)
+    assert ok and "V-cycle" in reason
+    # pallas-backend implicit jobs run solo: the batched vmap path's
+    # jnp transfer spelling has no pinned bitwise twin on hardware
+    # (same backend discipline as the explicit packable arm).
+    ok_p, reason_p = packable(cfg.replace(backend="pallas"))
+    assert not ok_p and "solo" in reason_p
+    solo = _solve_grid(cfg)
+    res = EnsembleSolver(cfg, 3).solve()
+    for i in range(3):
+        np.testing.assert_array_equal(np.asarray(res.grids[i]), solo)
+
+
+def test_admission_prices_level_hierarchy():
+    from parallel_heat_tpu.service.admission import (
+        estimate_job_hbm_bytes)
+
+    base = {"nx": 512, "ny": 512}
+    exp = estimate_job_hbm_bytes(base)
+    imp = estimate_job_hbm_bytes({**base, "scheme": "backward_euler"})
+    extra = sum(mx * my * 4 * 3
+                for mx, my in multigrid_level_shapes((512, 512)))
+    assert imp == exp + extra
+    # mg_levels caps the priced hierarchy exactly like the solve's.
+    capped = estimate_job_hbm_bytes(
+        {**base, "scheme": "backward_euler", "mg_levels": 2})
+    extra2 = sum(mx * my * 4 * 3
+                 for mx, my in multigrid_level_shapes((512, 512), 2))
+    assert capped == exp + extra2
+
+
+def test_heatd_accepts_and_serves_implicit_specs(tmp_path):
+    # Serving end-to-end: an implicit spec is admitted (HBM priced
+    # over the level hierarchy), solved by the worker, completed, and
+    # the SECOND submission of the same spec is an exact cache hit
+    # with zero dispatches — while the explicit spelling of the same
+    # grid shares nothing with it (the cross-scheme wall).
+    from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+    from parallel_heat_tpu.service.harness import inline_launcher
+    from parallel_heat_tpu.service.store import JobSpec
+
+    root = str(tmp_path / "q")
+    spawns = []
+    daemon = Heatd(HeatdConfig(root=root, slots=1,
+                               launcher=inline_launcher(root, spawns),
+                               requeue_backoff_base_s=0.0))
+    try:
+        cfg = {"nx": 16, "ny": 16, "steps": 12, "cx": 5.0, "cy": 5.0,
+               "backend": "jnp", "scheme": "backward_euler"}
+
+        def run(jid, config):
+            daemon.store.spool_submit(JobSpec(
+                job_id=jid, config=config, checkpoint_every=4))
+            for _ in range(400):
+                daemon.step()
+                jobs, _ = daemon.store.replay()
+                v = jobs.get(jid)
+                if v is not None and v.terminal:
+                    return v
+            raise AssertionError(f"{jid} never reached terminal")
+
+        cold = run("imp-cold", cfg)
+        assert cold.state == "completed"
+        warm = run("imp-warm", cfg)
+        assert warm.state == "completed"
+        assert "imp-warm" not in spawns  # served from cache, O(1)
+        # The explicit spelling of the same grid must NOT be served
+        # from the implicit donor (different trajectory family).
+        exp = run("exp-cold", {**cfg, "cx": 0.1, "cy": 0.1,
+                               "scheme": "explicit"})
+        assert exp.state == "completed"
+        assert "exp-cold" in spawns  # a real solve, not a cache serve
+    finally:
+        daemon.close()
+
+
+def test_vcycle_telemetry_event_and_diagnostics(tmp_path):
+    import json
+
+    from parallel_heat_tpu.utils.telemetry import Telemetry
+
+    cfg = HeatConfig(nx=26, ny=26, cx=12.5, cy=12.5, steps=6,
+                     backend="jnp", scheme="backward_euler",
+                     diag_interval=3)
+    path = tmp_path / "m.jsonl"
+    tel = Telemetry(str(path))
+    last = None
+    for last in solve_stream(cfg, chunk_steps=3, telemetry=tel,
+                             pipeline_depth=1):
+        pass
+    tel.close()
+    vc = last.diagnostics["vcycle"]
+    assert vc["cycles"] >= 1
+    assert vc["residuals"] and all(r >= 0 for r in vc["residuals"])
+    assert vc["levels"] == len(multigrid_level_shapes((26, 26)))
+    events = [json.loads(line) for line in path.read_text().splitlines()]
+    vevents = [e for e in events if e.get("event") == "vcycle"]
+    assert len(vevents) == 2  # one per diag boundary
+    assert vevents[0]["cycles"] >= 1
+    # The once-per-stream level wall shares ride the FIRST sample.
+    shares = vevents[0]["level_wall_share"]
+    assert abs(sum(shares.values()) - 1.0) < 0.01
+    assert "level_wall_share" not in vevents[1]
+    if vevents[0].get("contraction") is not None:
+        assert 0 < vevents[0]["contraction"] < 1
+
+
+def test_resume_command_carries_scheme_and_mg_flags():
+    # A supervised implicit run's printed resume line must rebuild the
+    # SAME integrator: without --scheme, the resumed run would be an
+    # explicit solve at super-stability coefficients — a deterministic
+    # blow-up (and at any coefficients a different trajectory,
+    # breaking the resume-bitwise contract).
+    from parallel_heat_tpu.supervisor import (
+        SupervisorPolicy, _resume_command)
+
+    cfg = HeatConfig(nx=64, ny=64, cx=22.5, cy=22.5, steps=400,
+                     backend="jnp", scheme="backward_euler",
+                     mg_tol=1e-4, mg_levels=3)
+    line = _resume_command(cfg, "/tmp/ck", 400,
+                           SupervisorPolicy(checkpoint_every=40))
+    assert "--scheme backward_euler" in line
+    assert "--mg-tol 0.0001" in line
+    assert "--mg-levels 3" in line
+    assert "--mg-cycles" not in line  # defaults stay off the line
+    # Explicit configs stay scheme-flag-free (the default).
+    line_e = _resume_command(
+        HeatConfig(nx=64, ny=64, steps=400, backend="jnp"),
+        "/tmp/ck", 400, SupervisorPolicy(checkpoint_every=40))
+    assert "--scheme" not in line_e and "--mg-" not in line_e
+
+
+def test_cycle_trace_budget_is_the_solve_budget():
+    # The trace runs the solve's OWN while_loop budget (mg_cycles),
+    # not a silent smaller cap: a smoother-only hierarchy
+    # (mg_levels=1) needs well over 16 cycles here, and the trace
+    # must still report the true count and converged=True.
+    from parallel_heat_tpu.ops import multigrid as mg
+    from parallel_heat_tpu.solver import make_initial_grid
+
+    cfg = HeatConfig(nx=18, ny=18, cx=12.5, cy=12.5, steps=1,
+                     backend="jnp", scheme="backward_euler",
+                     mg_levels=1, mg_cycles=500)
+    tr = mg.cycle_trace(cfg, make_initial_grid(cfg))
+    assert tr["converged"] is True
+    assert 16 < tr["cycles"] <= 500
+    assert len(tr["residuals"]) == tr["cycles"]
+    assert tr["residual_last"] <= tr["tol"]
+    # An explicit max_cycles is an instrumentation cap, honestly
+    # reported as unconverged when it bites.
+    capped = mg.cycle_trace(cfg, make_initial_grid(cfg), max_cycles=4)
+    assert capped["cycles"] == 4 and capped["converged"] is False
+
+
+def test_cycle_trace_converges_within_tol():
+    from parallel_heat_tpu.ops import multigrid as mg
+    from parallel_heat_tpu.solver import make_initial_grid
+
+    cfg = HeatConfig(nx=34, ny=34, cx=12.5, cy=12.5, steps=4,
+                     backend="jnp", scheme="backward_euler")
+    tr = mg.cycle_trace(cfg, make_initial_grid(cfg))
+    assert tr["converged"] is True
+    assert tr["cycles"] <= cfg.mg_cycles
+    assert tr["residual_last"] <= tr["tol"]
+    # Residuals contract monotonically on this well-posed solve.
+    assert tr["contraction"] is not None and tr["contraction"] < 0.5

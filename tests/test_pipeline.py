@@ -144,10 +144,14 @@ def test_pipeline_depth_validation():
     assert results[-1].converged
 
 
+@pytest.mark.slow
 def test_pipelined_f32chunk_matches_one_shot():
     # Stream boundaries stay K-aligned rounding points under f32chunk
     # regardless of depth (SEMANTICS.md) — the pipelined stream must be
     # bitwise the one-shot run, like the sync stream is.
+    # slow (tier-1 wall budget, round 15): the composition of two
+    # contracts each pinned separately in tier-1 (pipelined == sync
+    # bitwise; f32chunk stream-boundary alignment vs solve).
     kw = dict(nx=16, ny=128, steps=80, backend="jnp",
               dtype="bfloat16", accumulate="f32chunk")
     direct = solve(HeatConfig(**kw))

@@ -496,9 +496,14 @@ def test_pallas_pipeline_schedule_bitwise():
         want, rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_pallas_pipeline_bf16_and_f32chunk_inert():
     # bf16 pipelined round (K=16, the other sublane depth): bitwise
     # its phase-separated twin.
+    # slow (tier-1 wall budget, round 15): a second-dtype instance of
+    # the schedule-bitwise contract test_pallas_pipeline_schedule_
+    # bitwise pins in tier-1 at f32, plus inertness cross-checks the
+    # resolution-matrix test already covers.
     kwp = dict(nx=64, ny=64, steps=17, dtype="bfloat16",
                backend="pallas", mesh_shape=(2, 2), halo_depth=16)
     a = solve(HeatConfig(**kwp, halo_overlap="phase")).to_numpy()

@@ -343,6 +343,70 @@ def _fake_stream_lines(n_chunks=4):
     return lines
 
 
+def test_metrics_report_vcycle_section_and_gates(tmp_path):
+    # The implicit-stepping V-cycle section (SEMANTICS.md "Implicit
+    # stepping"): `vcycle` events -> cycles/step percentiles,
+    # contraction factor, per-level wall shares — gateable through the
+    # shared --fail-on grammar like every other section.
+    m = tmp_path / "m.jsonl"
+    events = [{"event": "run_header", "schema": 1,
+               "config": {"nx": 26, "ny": 26,
+                          "scheme": "backward_euler"}}]
+    for step, cycles, contr in ((3, 3, 0.21), (6, 2, 0.18)):
+        events.append({"event": "chunk", "schema": 1, "step": step,
+                       "steps": 3, "wall_s": 0.01})
+        ev = {"event": "vcycle", "schema": 1, "step": step,
+              "cycles": cycles, "contraction": contr,
+              "residuals": [1.0, 0.2], "tol": 0.5, "levels": 4,
+              "converged": True}
+        if step == 3:
+            ev["level_wall_share"] = {"l0": 0.7, "l1": 0.2,
+                                      "l2": 0.07, "l3": 0.03}
+        events.append(ev)
+    m.write_text("".join(json.dumps(e) + "\n" for e in events))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    rep = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    doc = json.loads(rep.stdout)
+    vc = doc["vcycle"]
+    assert vc["samples"] == 2
+    assert vc["cycles_per_step"]["max"] == 3
+    assert vc["contraction"]["p50"] in (0.18, 0.21)
+    assert vc["levels"] == 4
+    assert vc["unconverged_samples"] == 0
+    assert vc["level_wall_share"]["l0"] == 0.7
+    # text rendering carries the section
+    txt = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"), str(m)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert txt.returncode == 0 and "vcycle:" in txt.stdout
+    # the shared threshold grammar gates the section (exit 2), both a
+    # cycles ceiling and a contraction ceiling
+    for gate in ("vcycle.cycles_per_step.max>2",
+                 "vcycle.contraction.p50>0.1"):
+        bad = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "metrics_report.py"),
+             str(m), "--fail-on", gate],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert bad.returncode == 2, gate
+        assert "ANOMALY" in bad.stdout
+    # ...and passes at honest thresholds (exit 0)
+    ok = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "metrics_report.py"),
+         str(m), "--fail-on",
+         "permanent_failure,vcycle.cycles_per_step.p90>12,"
+         "vcycle.contraction.p50>0.6"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert ok.returncode == 0, ok.stdout[-2000:]
+
+
 def test_metrics_report_torn_final_line(tmp_path):
     # A mid-write reader sees a torn final line: the report must skip
     # it with a warning and summarize the intact prefix (exit 0), not

@@ -540,6 +540,44 @@ def summarize(events, outlier_mult=5.0):
                 for c in compactions]
         doc["ensemble"] = ens
 
+    # V-cycle section (implicit-scheme streams — SEMANTICS.md
+    # "Implicit stepping"): the solver emits one `vcycle` event per
+    # diagnostics sample (cycles the per-step solve took under the
+    # run's mg_tol verdict, per-cycle residuals, contraction factor;
+    # the first sample also carries the measured per-level wall
+    # shares). Gateable through the shared --fail-on grammar:
+    # 'vcycle.cycles_per_step.p90>8', 'vcycle.contraction.p50>0.5',
+    # 'vcycle.level_wall_share.l0<0.3'.
+    vcs = by.get("vcycle", [])
+    if vcs:
+        cyc = sorted(v["cycles"] for v in vcs
+                     if isinstance(v.get("cycles"), int))
+        contr = sorted(v["contraction"] for v in vcs
+                       if isinstance(v.get("contraction"),
+                                     (int, float)))
+        vdoc = {"samples": len(vcs)}
+        if cyc:
+            vdoc["cycles_per_step"] = {
+                "p50": _percentile(cyc, 50),
+                "p90": _percentile(cyc, 90),
+                "max": cyc[-1]}
+        if contr:
+            vdoc["contraction"] = {
+                "p50": _percentile(contr, 50),
+                "p90": _percentile(contr, 90),
+                "max": contr[-1]}
+        levels = [v.get("levels") for v in vcs
+                  if isinstance(v.get("levels"), int)]
+        if levels:
+            vdoc["levels"] = levels[-1]
+        unconverged = sum(1 for v in vcs if v.get("converged") is False)
+        vdoc["unconverged_samples"] = unconverged
+        shares = [v["level_wall_share"] for v in vcs
+                  if isinstance(v.get("level_wall_share"), dict)]
+        if shares:
+            vdoc["level_wall_share"] = shares[-1]
+        doc["vcycle"] = vdoc
+
     timeline = [
         {"event": e["event"], "t_mono": e.get("t_mono"),
          "step": e.get("step"),
@@ -829,6 +867,28 @@ def render_text(doc):
             tail = traj if len(traj) <= 6 else traj[:3] + traj[-3:]
             out.append("  live fraction: " + " ".join(
                 f"{w['step']}:{w['live']}/{w['batch']}" for w in tail))
+    vc = doc.get("vcycle")
+    if vc:
+        line = f"vcycle: {vc['samples']} sample(s)"
+        cyc = vc.get("cycles_per_step")
+        if cyc:
+            line += (f", cycles/step p50={cyc['p50']} "
+                     f"p90={cyc['p90']} max={cyc['max']}")
+        if vc.get("levels") is not None:
+            line += f", {vc['levels']} levels"
+        out.append(line)
+        contr = vc.get("contraction")
+        if contr:
+            out.append(f"  residual contraction p50={contr['p50']:.3f} "
+                       f"p90={contr['p90']:.3f}")
+        if vc.get("unconverged_samples"):
+            out.append(f"  UNCONVERGED samples: "
+                       f"{vc['unconverged_samples']} (hit mg_cycles "
+                       f"before mg_tol)")
+        shares = vc.get("level_wall_share")
+        if shares:
+            out.append("  level wall share: " + " ".join(
+                f"{k}={v:.0%}" for k, v in sorted(shares.items())))
     pl = doc.get("pipeline")
     if pl:
         busy = pl.get("device_busy_frac")
