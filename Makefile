@@ -29,8 +29,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        ensemble-smoke trace-smoke cache-smoke implicit-smoke bench \
-        clean
+        ensemble-smoke trace-smoke cache-smoke implicit-smoke \
+        tune-smoke bench clean
 
 all: heat
 
@@ -334,6 +334,48 @@ implicit-smoke:
 	assert d['vcycle']['unconverged_samples'] == 0, d['vcycle']; \
 	assert d['convergence']['residual_last'] < 1e-3, d['convergence']"
 	rm -rf .implicit_smoke
+
+# Measured-autotuning run-book as a gate (SEMANTICS.md "Tuning
+# soundness"): a tiny CPU search populates a tuning DB — every
+# feasible Pallas candidate bitwise-verified against the analytic
+# reference BEFORE timing — then a FRESH process with PHT_TUNE_DB set
+# must (a) consult the entry (explain decided_by source "tuned-db")
+# and (b) produce a grid bitwise-identical to a no-DB process's run
+# (tuned selection is schedule-only by construction). Exit 0 = the
+# measured path is live end to end on this host.
+tune-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .tune_smoke && mkdir -p .tune_smoke
+	JAX_PLATFORMS=cpu $(PY) tools/autotune.py --geometry 64x64 \
+	    --rounds 1 --steps-per-call 4 --db .tune_smoke/tunedb \
+	    --json .tune_smoke/tune.json
+	$(PY) -c "import json; \
+	d = json.load(open('.tune_smoke/tune.json')); \
+	r = d['results'][0]; \
+	assert r.get('db_key'), r; \
+	bad = [c for c in r['candidates'] if c['feasible'] \
+	       and c['choice'] != 'jnp' and not c['bitwise_verified']]; \
+	assert not bad, bad"
+	JAX_PLATFORMS=cpu PHT_TUNE_DB=.tune_smoke/tunedb $(PY) -c "\
+	import numpy as np; \
+	from parallel_heat_tpu import solver; \
+	cfg = solver.HeatConfig(nx=64, ny=64, steps=16, backend='pallas'); \
+	ex = solver.explain(cfg); \
+	d = ex['decided_by'].get('single_2d'); \
+	assert d and d['source'] == 'tuned-db', ex['decided_by']; \
+	np.save('.tune_smoke/tuned.npy', \
+	        np.asarray(solver.solve(cfg).grid))"
+	JAX_PLATFORMS=cpu $(PY) -c "\
+	import numpy as np; \
+	from parallel_heat_tpu import solver; \
+	cfg = solver.HeatConfig(nx=64, ny=64, steps=16, backend='pallas'); \
+	np.save('.tune_smoke/plain.npy', \
+	        np.asarray(solver.solve(cfg).grid))"
+	$(PY) -c "import numpy as np; \
+	a = np.load('.tune_smoke/tuned.npy'); \
+	b = np.load('.tune_smoke/plain.npy'); \
+	assert np.array_equal(a, b), 'tuned solve diverged from analytic'"
+	rm -rf .tune_smoke
 
 bench:
 	$(PY) bench.py

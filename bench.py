@@ -38,15 +38,23 @@ BASELINE_MCELLS_PER_S = 3556.0  # derived in BASELINE.md / SURVEY.md §6
 
 
 def _sync_floor(u0):
-    """Median device->host scalar-read latency for this transport."""
-    from parallel_heat_tpu.utils.profiling import sync
+    """Median device->host scalar-read latency for this transport
+    (``utils/measure.py`` owns the protocol)."""
+    from parallel_heat_tpu.utils.measure import sync_floor
 
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        sync(u0)
-        times.append(time.perf_counter() - t0)
-    return sorted(times)[1]
+    return sync_floor(u0)
+
+
+def _path_label(cfg):
+    """The resolved schedule label for an artifact row — ALWAYS via
+    ``solver.explain`` (never re-derived from config by hand), so the
+    label can't drift from what actually ran."""
+    from parallel_heat_tpu.solver import explain
+
+    try:
+        return explain(cfg)["path"]
+    except Exception as e:  # noqa: BLE001 — a label must not kill a bench
+        return f"explain failed: {e!r}"
 
 
 def _bench_fixed(cfg, budget_s=10.0, batches=3):
@@ -61,7 +69,8 @@ def _bench_fixed(cfg, budget_s=10.0, batches=3):
 
     from parallel_heat_tpu.solver import (_build_runner, _observer_free,
                                           make_initial_grid)
-    from parallel_heat_tpu.utils.profiling import chain_slope, chain_time, sync
+    from parallel_heat_tpu.utils.measure import (chain_slope, chain_time,
+                                                 sync)
 
     runner, _ = _build_runner(_observer_free(cfg))
     u0 = jax.block_until_ready(make_initial_grid(cfg))
@@ -81,7 +90,7 @@ def _bench_converge(cfg, repeats=2):
 
     from parallel_heat_tpu import solve
     from parallel_heat_tpu.solver import make_initial_grid
-    from parallel_heat_tpu.utils.profiling import sync
+    from parallel_heat_tpu.utils.measure import sync
 
     u0 = jax.block_until_ready(make_initial_grid(cfg))
     res = solve(cfg, initial=u0)  # compile + warm
@@ -125,7 +134,7 @@ def _bench_stream(backend, size=512, steps=1200, chunk=100):
     from parallel_heat_tpu.solver import solve_stream
     from parallel_heat_tpu.utils.checkpoint import (
         AsyncCheckpointer, save_generation)
-    from parallel_heat_tpu.utils.profiling import sync
+    from parallel_heat_tpu.utils.measure import sync
 
     base = HeatConfig(nx=size, ny=size, steps=steps, backend=backend)
     instr = base.replace(guard_interval=chunk, diag_interval=chunk)
@@ -179,23 +188,33 @@ def _bench_stream(backend, size=512, steps=1200, chunk=100):
         variants = (("bare", base, None, False),
                     ("sync", instr, 1, True),
                     ("pipelined", instr, 2, True))
-        walls = {tag: [] for tag, *_ in variants}
-        # Interleave the variants per round (the paired-measurement
-        # rationale of profiling.calibrated_slope_paired): host clock/
-        # frequency drift on tens-of-seconds scales lands on every
-        # variant alike, so the min-per-variant comparison compares
-        # like with like instead of whichever phase ran on the slow
-        # stretch.
-        for i in range(3):
-            for tag, cfg, depth, instrumented in variants:
-                walls[tag].append(run(cfg, depth, instrumented, wd,
-                                      f"{tag}{i}"))
-        walls = {tag: min(ts) for tag, ts in walls.items()}
+        # Interleave the variants per round (measure.py's paired-
+        # measurement rationale): host clock/frequency drift on
+        # tens-of-seconds scales lands on every variant alike, so the
+        # min-per-variant comparison compares like with like instead
+        # of whichever phase ran on the slow stretch. Self-timed:
+        # run()'s bracket starts after the telemetry sinks open.
+        from parallel_heat_tpu.utils.measure import (
+            interleaved_min_self_timed)
+
+        counter = {"i": 0}
+
+        def variant_fn(tag, cfg, depth, instrumented):
+            def fn():
+                counter["i"] += 1
+                return run(cfg, depth, instrumented, wd,
+                           f"{tag}{counter['i']}")
+            return fn
+
+        walls = interleaved_min_self_timed(
+            {tag: variant_fn(tag, cfg, depth, instrumented)
+             for tag, cfg, depth, instrumented in variants}, rounds=3)
     cells = size * size
     return {
         "metric": (f"{size}^2 streamed x{steps} steps, fully "
                    f"instrumented (guard+diag+telemetry+ckpt/chunk): "
                    f"sync vs pipelined"),
+        "path": _path_label(base),
         "chunk_steps": chunk,
         "wall_bare_s": round(walls["bare"], 4),
         "wall_sync_s": round(walls["sync"], 4),
@@ -236,7 +255,8 @@ def _bench_ensemble(backend, size=512, steps=400, batches=(1, 8, 64)):
     from parallel_heat_tpu.ensemble.engine import EnsembleSolver
     from parallel_heat_tpu.solver import (_build_runner, _observer_free,
                                           make_initial_grid)
-    from parallel_heat_tpu.utils.profiling import sync
+    from parallel_heat_tpu.utils.measure import (interleaved_min_of_n,
+                                                 sync)
 
     cfg = HeatConfig(nx=size, ny=size, steps=steps, backend=backend)
     cells = size * size
@@ -248,19 +268,19 @@ def _bench_ensemble(backend, size=512, steps=400, batches=(1, 8, 64)):
     for B in batches:
         es = EnsembleSolver(cfg, B)
         sync(es.solve().grids)  # compile + warm the batched program
-        ens_walls, seq_walls = [], []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            r = es.solve()
-            sync(r.grids)
-            ens_walls.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
+
+        def seq_run(B=B):
             last = None
             for _i in range(B):
                 last = solve(cfg, initial=u0)
-            sync(last.grid)
-            seq_walls.append(time.perf_counter() - t0)
-        ens_w, seq_w = min(ens_walls), min(seq_walls)
+            return last.grid
+
+        # Interleaved min-of-3 walls (measure.py's protocol — the
+        # flush is the sync read timed_call applies to each output).
+        walls = interleaved_min_of_n(
+            {"ensemble": lambda: es.solve().grids, "sequential": seq_run},
+            rounds=3)
+        ens_w, seq_w = walls["ensemble"], walls["sequential"]
         rows.append({
             "B": B,
             "ensemble_wall_s": round(ens_w, 4),
@@ -431,7 +451,7 @@ def _bench_implicit(backend, size=512, explicit_steps=2000,
     from parallel_heat_tpu.ops import multigrid
     from parallel_heat_tpu.solver import (_build_runner, _observer_free,
                                           make_initial_grid)
-    from parallel_heat_tpu.utils.profiling import sync
+    from parallel_heat_tpu.utils.measure import sync
 
     c_stable = 0.225  # sum 0.45: the stiff edge of the stable region
     if explicit_steps % dt_ratio:
@@ -445,16 +465,12 @@ def _bench_implicit(backend, size=512, explicit_steps=2000,
                        backend=backend, scheme=scheme)
 
     def timed(cfg):
+        from parallel_heat_tpu.utils.measure import min_of_n
+
         runner, _ = _build_runner(_observer_free(cfg))
         u0 = jax.block_until_ready(make_initial_grid(cfg))
         sync(runner(jnp.copy(u0))[0])  # compile + warm
-        best, grid = float("inf"), None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            grid = runner(jnp.copy(u0))[0]
-            sync(grid)
-            best = min(best, time.perf_counter() - t0)
-        return best, grid
+        return min_of_n(lambda: runner(jnp.copy(u0))[0], rounds=3)
 
     wall_e, grid_e = timed(cfg_e)
     wall_i, grid_i = timed(cfg_i)
@@ -472,6 +488,8 @@ def _bench_implicit(backend, size=512, explicit_steps=2000,
         "size": size, "scheme": scheme, "dt_ratio": dt_ratio,
         "explicit_steps": explicit_steps,
         "implicit_steps": cfg_i.steps,
+        "path_explicit": _path_label(cfg_e),
+        "path_implicit": _path_label(cfg_i),
         "coeff_stable": c_stable,
         "coeff_implicit": c_stable * dt_ratio,
         "wall_to_T_explicit_s": round(wall_e, 4),
@@ -636,6 +654,7 @@ def main(argv=None):
         "metric": "Mcells*steps/s/chip (1000^2, 10k steps, f32, fixed)",
         "value": round(mcells, 1),
         "unit": "Mcells*steps/s",
+        "path": _path_label(headline),
         "vs_baseline": round(mcells / BASELINE_MCELLS_PER_S, 3),
     }
     print(json.dumps(headline_row))
@@ -702,6 +721,7 @@ def main(argv=None):
                 cells = cfg.nx * cfg.ny * (cfg.nz or 1)
                 out = {
                     "metric": name,
+                    "path": _path_label(cfg),
                     "wall_s": round(elapsed, 4),
                     "mcells_steps_per_s": round(
                         cells * steps_run / elapsed / 1e6, 1),

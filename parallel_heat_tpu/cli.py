@@ -281,6 +281,12 @@ _SERVICE_COMMANDS = ("serve", "submit", "status", "cancel", "drain")
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "tune":
+        # Offline measured autotuning (tune/search.py): searches are
+        # driven here, never inside a solve.
+        from parallel_heat_tpu.tune.search import main as tune_main
+
+        return tune_main(argv[1:])
     if argv and argv[0] in _SERVICE_COMMANDS:
         from parallel_heat_tpu.service.cli import main as heatd_main
 

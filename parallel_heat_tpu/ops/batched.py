@@ -65,13 +65,32 @@ def pick_ensemble_2d(shape: Tuple[int, int], dtype,
     solo path wherever it runs), ``"vmap"`` otherwise (the general
     path: vmap over the jnp multistep family). One decision site,
     shared by the ensemble engine and ``solver.explain`` — the same
-    never-desynchronize rule as ``pick_single_2d``."""
-    if accumulate != "storage":
-        return "vmap"
-    if len(shape) != 2:
-        return "vmap"
-    return ("M" if fits_vmem(shape, dtype)
-            and fits_vmem_batched(shape, dtype) else "vmap")
+    never-desynchronize rule as ``pick_single_2d``.
+
+    A tuned/forced choice (``tune.consult``, site ``ensemble_2d``)
+    can demote M to vmap freely (vmap is always sound) but can only
+    promote to M where the VMEM admission tests hold — an inadmissible
+    tuned "M" falls back loudly (SEMANTICS.md "Tuning soundness")."""
+    from parallel_heat_tpu.ops.pallas_stencil import _tune_api
+
+    admits_m = (accumulate == "storage" and len(shape) == 2
+                and fits_vmem(shape, dtype)
+                and fits_vmem_batched(shape, dtype))
+    tune = _tune_api()
+    choice, source, entry = tune.consult(
+        "ensemble_2d", tune.geometry_ensemble_2d(shape, dtype,
+                                                 accumulate))
+    if choice is not None:
+        if choice == "vmap" or admits_m:
+            tune.note("ensemble_2d", source, choice, entry=entry)
+            return choice
+        tune.fallback_warning(
+            "ensemble_2d",
+            f"{source} choice 'M' inadmissible at {tuple(shape)} "
+            f"{jnp.dtype(dtype).name}/{accumulate}")
+    kind = "M" if admits_m else "vmap"
+    tune.note("ensemble_2d", "analytic-model", kind)
+    return kind
 
 
 @functools.lru_cache(maxsize=32)

@@ -2431,6 +2431,30 @@ def pipeline_gain_2d(config):
     return hidden, extra
 
 
+def _tune_api():
+    """The tuning consult layer, imported lazily: ``tune`` sits above
+    ``ops`` in the package graph (it pulls in the journal machinery),
+    so a module-level import here would cycle during package init."""
+    from parallel_heat_tpu import tune
+
+    return tune
+
+
+def _resolve_block_temporal_2d(choice, args):
+    """Resolve a tuned/forced block-round kind to
+    ``(kind, built, built_plain)`` — ``None`` when that builder
+    declines the geometry (the loud-fallback trigger). The build goes
+    through the SAME lru_cached builders as the analytic pick, so a
+    tuned kind can only ever name one of the proven-bitwise rounds."""
+    if choice == "jnp":
+        return "jnp", None, None
+    build = _G_BUILDERS[choice]
+    built = build(*args)
+    if built is None:
+        return None
+    return choice, built, build(*args, with_residual=False)
+
+
 def pick_block_temporal_2d(config, axis_names):
     """The 2D K-deep round's kernel decision:
     ``(kind, built, built_plain)`` with kind in {"G-uni", "G-fuse",
@@ -2451,6 +2475,12 @@ def pick_block_temporal_2d(config, axis_names):
     the SAME args so the two variants can never silently diverge
     (rounds whose residual the caller discards use it — kernel E's
     rationale).
+
+    A tuned/forced choice (``tune.consult``, site
+    ``block_temporal_2d``) overrides the preference ORDER only: the
+    chosen kind still builds through the same builders, and an
+    infeasible choice falls back loudly to this analytic order
+    (SEMANTICS.md "Tuning soundness").
     """
     if config.ndim != 2:
         return "jnp", None, None
@@ -2460,6 +2490,26 @@ def pick_block_temporal_2d(config, axis_names):
     bx_by = config.block_shape()
     args = (bx_by, config.dtype, float(config.cx), float(config.cy),
             config.shape, K, tuple(axis_names))
+    tune = _tune_api()
+    choice, source, entry = tune.consult(
+        "block_temporal_2d", tune.geometry_block_temporal_2d(config))
+    if choice is not None:
+        resolved = _resolve_block_temporal_2d(choice, args)
+        if resolved is not None:
+            tune.note("block_temporal_2d", source, choice, entry=entry)
+            return resolved
+        tune.fallback_warning(
+            "block_temporal_2d",
+            f"{source} choice {choice!r} infeasible at block "
+            f"{tuple(bx_by)} {jnp.dtype(config.dtype).name} K={K}")
+    out = _analytic_block_temporal_2d(args)
+    tune.note("block_temporal_2d", "analytic-model", out[0])
+    return out
+
+
+def _analytic_block_temporal_2d(args):
+    """The TpuParams preference order (see
+    :func:`pick_block_temporal_2d`)."""
     built = _build_temporal_block_uniform(*args)
     if built is not None:
         return ("G-uni", built,
@@ -2477,6 +2527,14 @@ def pick_block_temporal_2d(config, axis_names):
         return ("G", built,
                 _build_temporal_block(*args, with_residual=False))
     return "jnp", None, None
+
+
+_G_BUILDERS = {
+    "G-uni": _build_temporal_block_uniform,
+    "G-fuse": _build_temporal_block_fused,
+    "G-circ": _build_temporal_block_circular,
+    "G": _build_temporal_block,
+}
 
 
 # --------------------------------------------------------------------------
@@ -2592,7 +2650,64 @@ def pick_single_2d(shape, dtype, cx, cy, accumulate="storage"):
     comparison against the acc-aware pickers) or the chunked-f32 jnp
     fallback — the single-step kernels (A/B/C) round every step by
     construction and are never picked.
+
+    A tuned/forced choice (``tune.consult``, site ``single_2d``)
+    overrides the cost-model ORDER only: the detail is re-derived from
+    the same ``_pick_*``/``_build_*`` machinery, the f32chunk
+    restriction still binds, and an infeasible choice falls back
+    loudly to the analytic model (SEMANTICS.md "Tuning soundness").
     """
+    tune = _tune_api()
+    choice, source, entry = tune.consult(
+        "single_2d", tune.geometry_single_2d(shape, dtype, accumulate))
+    if choice is not None:
+        resolved = _resolve_single_2d(choice, shape, dtype, cx, cy,
+                                      accumulate)
+        if resolved is not None:
+            tune.note("single_2d", source, choice, entry=entry)
+            return resolved
+        tune.fallback_warning(
+            "single_2d",
+            f"{source} choice {choice!r} infeasible at {tuple(shape)} "
+            f"{jnp.dtype(dtype).name}/{accumulate}")
+    kind, detail = _analytic_single_2d(shape, dtype, cx, cy, accumulate)
+    tune.note("single_2d", "analytic-model", kind)
+    return kind, detail
+
+
+def _resolve_single_2d(choice, shape, dtype, cx, cy, accumulate):
+    """Resolve a tuned/forced kind to :func:`pick_single_2d`'s
+    ``(kind, detail)`` — ``None`` when the choice is infeasible for
+    this geometry (the loud-fallback trigger). Every detail comes from
+    the live ``_pick_*``/``_build_*`` machinery, so a tuned kind can
+    only ever name one of the proven-bitwise builds, and a geometry
+    change can never resurrect a stale strip height or tile shape."""
+    acc_f32 = accumulate == "f32chunk"
+    if choice == "jnp":
+        return "jnp", None
+    if acc_f32 and choice in ("A", "B", "C"):
+        # Single-step kernels round every step — they can never honor
+        # the chunked-f32 contract, whatever a DB entry claims.
+        return None
+    if choice == "A":
+        return ("A", None) if fits_vmem(shape, dtype) else None
+    if choice in ("E", "E-uni"):
+        t = _pick_temporal_strip(shape[0], shape[1], dtype,
+                                 acc_f32=acc_f32,
+                                 uniform=choice == "E-uni")
+        return (choice, t) if t is not None else None
+    if choice in ("I", "I-uni"):
+        ti = _pick_tile_temporal_2d(shape[0], shape[1], dtype,
+                                    acc_f32=acc_f32,
+                                    uniform=choice == "I-uni")
+        return (choice, ti) if ti is not None else None
+    build = _build_strip_kernel if choice == "B" else _build_tiled_kernel
+    built = build(shape, dtype, cx, cy, shape, sharded=False)
+    return (choice, built) if built is not None else None
+
+
+def _analytic_single_2d(shape, dtype, cx, cy, accumulate):
+    """The TpuParams cost-model order (see :func:`pick_single_2d`)."""
     if accumulate == "f32chunk":
         # config.validate() restricts f32chunk to bfloat16, so the
         # E-vs-I comparison applies whenever both pickers accept.

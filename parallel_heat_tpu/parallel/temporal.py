@@ -831,24 +831,46 @@ def resolve_halo_overlap(config, backend: str) -> str:
     bitwise-free, so it is never worth declining. Explicit values
     always win; geometry declines at build time fall back one level
     silently (the kernel pickers' decline discipline).
+
+    On the auto path a tuned/forced choice (``tune.consult``, site
+    ``halo_overlap``) overrides the ICI pricing only: a tuned
+    ``"pipeline"`` still requires the pipelined round to exist for
+    this geometry, and an infeasible choice falls back loudly to the
+    analytic model (SEMANTICS.md "Tuning soundness"). Every schedule
+    this site can return is bitwise-identical by the Level-2/3 parity
+    contracts, so tuning here can never change results.
     """
     mode = config.halo_overlap
     if mode not in (None, "auto"):
         return mode
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+    from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
     mesh_shape = config.mesh_or_unit()
     depth = config.halo_depth
-    if (backend == "pallas" and config.ndim == 2
-            and depth is not None and depth > 1
-            and mesh_shape[1] > 1):
-        from parallel_heat_tpu.ops import pallas_stencil as ps
-        from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
-
-        if ps.pick_block_temporal_2d_pipelined(
-                config, AXIS_NAMES[:2]) is not None:
-            hidden, extra = ps.pipeline_gain_2d(config)
-            if hidden > extra:
-                return "pipeline"
-    return "overlap"
+    pipeline_ok = (backend == "pallas" and config.ndim == 2
+                   and depth is not None and depth > 1
+                   and mesh_shape[1] > 1
+                   and ps.pick_block_temporal_2d_pipelined(
+                       config, AXIS_NAMES[:2]) is not None)
+    tune = ps._tune_api()
+    choice, source, entry = tune.consult(
+        "halo_overlap", tune.geometry_halo_overlap(config))
+    if choice is not None:
+        if choice != "pipeline" or pipeline_ok:
+            tune.note("halo_overlap", source, choice, entry=entry)
+            return choice
+        tune.fallback_warning(
+            "halo_overlap",
+            f"{source} choice 'pipeline' infeasible (no pipelined "
+            f"round for this geometry/backend)")
+    out = "overlap"
+    if pipeline_ok:
+        hidden, extra = ps.pipeline_gain_2d(config)
+        if hidden > extra:
+            out = "pipeline"
+    tune.note("halo_overlap", "analytic-model", out)
+    return out
 
 
 def block_temporal_multistep(config, kw, backend: str):

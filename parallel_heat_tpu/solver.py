@@ -575,7 +575,38 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
     ensemble engine's resolved path for this config — the same
     ``ensemble.engine.ensemble_path`` decision the engine executes —
     plus the daemon-packing verdict (``ensemble.engine.packable``).
+
+    Two keys report the decision provenance:
+
+    - ``decided_by``: per consulted site (``single_2d``,
+      ``block_temporal_2d``, ``ensemble_2d``, ``halo_overlap``),
+      whether the tuning DB (``tuned-db`` — with the winning entry
+      key), a ``forced`` pin, or the ``analytic-model`` made the
+      choice. Collected by re-running the SAME pickers under
+      ``tune.record``, so it can never desynchronize from execution.
+    - ``halo_overlap_effective``: the schedule that actually runs —
+      an explicit/tuned ``"pipeline"`` downgrades to ``"overlap"``
+      at build time when the pipelined round declines the geometry;
+      artifact writers (``bench.py``, ``tools/scaling_study.py``)
+      label rows with this instead of re-deriving it by hand.
     """
+    from parallel_heat_tpu import tune
+
+    with tune.record() as notes:
+        out = _explain_body(config, ensemble)
+    decided: dict = {}
+    for n in notes:
+        d = {"source": n["source"], "choice": n["choice"]}
+        if "entry" in n:
+            d["entry"] = n["entry"]
+        # Last note wins: depth probes consult the same sites with
+        # trial configs before the final resolved pick re-runs them.
+        decided[n["site"]] = d
+    out["decided_by"] = decided
+    return out
+
+
+def _explain_body(config: HeatConfig, ensemble: Optional[int]) -> dict:
     config = config.validate()
     auto_overlap = config.halo_overlap in (None, "auto")
     config, backend, auto_depth = _resolved(config)
@@ -589,6 +620,21 @@ def explain(config: HeatConfig, ensemble: Optional[int] = None) -> dict:
         "mode": "converge" if config.converge else "fixed",
         "scheme": config.scheme,
     }
+    # The schedule that actually runs: resolve_halo_overlap lets an
+    # explicit "pipeline" through unchecked (explicit wins), but the
+    # round builder falls back to the deferred schedule when the
+    # pipelined round declines — report the post-fallback value so
+    # artifact labels can't drift from what ran.
+    effective = config.halo_overlap
+    if effective == "pipeline":
+        from parallel_heat_tpu.ops import pallas_stencil as _ps
+        from parallel_heat_tpu.parallel.mesh import AXIS_NAMES as _AX
+
+        if (backend != "pallas" or config.ndim != 2
+                or _ps.pick_block_temporal_2d_pipelined(
+                    config, _AX[:2]) is None):
+            effective = "overlap"
+    out["halo_overlap_effective"] = effective
     if ensemble is not None:
         from parallel_heat_tpu.ensemble.engine import (
             ensemble_path, packable)

@@ -1,0 +1,250 @@
+"""Measured autotuning — consult layer over the journal-backed tuning DB.
+
+The pickers (``pick_single_2d``, ``pick_block_temporal_2d``,
+``pick_ensemble_2d``, ``temporal.resolve_halo_overlap``) call
+:func:`consult` before their analytic cost models. Resolution order:
+
+1. :func:`force` override (the search harness and parity tests pin one
+   candidate through the REAL picker/factory path);
+2. the active tuning DB (:func:`set_active` / ``PHT_TUNE_DB``), whose
+   entries are measured winners admitted only after a bitwise-verify
+   against the reference schedule;
+3. ``None`` — the analytic model decides, exactly as before.
+
+A tuned choice is ADVISORY at the kind level: the picker re-derives the
+builder-level detail itself and falls back loudly
+(:func:`fallback_warning`) when the choice is no longer feasible for
+the geometry, when the DB entry fails its soundness checks
+(``TuneDB.lookup``'s reject reasons), or when the entry is stale.
+Tuning can therefore never select an unverified schedule and never
+change results — every choice it can return is one of the pickers'
+already-proven-bitwise schedules (SEMANTICS.md "Tuning soundness").
+
+DB state is ORCHESTRATION-only: activation is process-global (no
+``HeatConfig`` field), so enabling/disabling the DB can never perturb
+cache keys or ``_build_runner``'s memo key (HL101 partition).
+
+:func:`record` captures which source decided each site for one region
+of code; ``solver.explain`` wraps itself in a recorder and reports the
+notes as ``decided_by``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.tune.db import (  # noqa: F401 — package API
+    SITE_CHOICES, TUNE_SCHEMA_VERSION, TuneDB, load_tune_db,
+    reduce_tune_journal, tune_key)
+
+# ---------------------------------------------------------------------------
+# Active DB (process-global orchestration state — never config state)
+# ---------------------------------------------------------------------------
+
+_ACTIVE_SENTINEL = object()
+_active_db: Any = _ACTIVE_SENTINEL  # unresolved until first use
+
+
+def set_active(root: Optional[str]) -> None:
+    """Point the consult layer at a DB root (``None`` disables tuning
+    and restores pure-analytic picking). Overrides ``PHT_TUNE_DB``."""
+    global _active_db
+    if _active_db not in (None, _ACTIVE_SENTINEL):
+        _active_db.close()
+    _active_db = TuneDB(root) if root else None
+
+
+def active() -> Optional[TuneDB]:
+    """The active :class:`TuneDB`, or ``None`` when tuning is off.
+    First call resolves the ``PHT_TUNE_DB`` environment variable."""
+    global _active_db
+    if _active_db is _ACTIVE_SENTINEL:
+        root = os.environ.get("PHT_TUNE_DB") or None
+        _active_db = TuneDB(root) if root else None
+    return _active_db
+
+
+def current_topology() -> Dict[str, Any]:
+    """The topology half of a tune key: platform, device generation,
+    device count. Canonical-JSON-stable (plain strs/ints only)."""
+    import jax
+
+    from parallel_heat_tpu.ops import tpu_params
+
+    return {
+        "platform": str(jax.devices()[0].platform),
+        "device_kind": tpu_params.params().kind,
+        "n_devices": int(jax.device_count()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Geometry docs — ONE builder per site, shared by the picker hooks and
+# the search harness so a searched key always matches the consulted one
+# (the repo's one-decision-site rule applied to key construction).
+# cx/cy are deliberately excluded: coefficients never change a schedule
+# choice, and including them would fragment the DB per physics run.
+# ---------------------------------------------------------------------------
+
+def _dtype_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    return str(jnp.dtype(dtype).name)
+
+
+def geometry_single_2d(shape, dtype, accumulate="storage") -> dict:
+    return {"shape": [int(n) for n in shape],
+            "dtype": _dtype_name(dtype),
+            "accumulate": str(accumulate)}
+
+
+def geometry_block_temporal_2d(config) -> dict:
+    return {"shape": [int(n) for n in config.shape],
+            "dtype": _dtype_name(config.dtype),
+            "block_shape": [int(b) for b in config.block_shape()],
+            "halo_depth": int(config.halo_depth)}
+
+
+def geometry_halo_overlap(config) -> dict:
+    depth = config.halo_depth
+    return {"shape": [int(n) for n in config.shape],
+            "dtype": _dtype_name(config.dtype),
+            "mesh_shape": [int(m) for m in config.mesh_or_unit()],
+            "halo_depth": int(depth) if depth is not None else None}
+
+
+def geometry_ensemble_2d(shape, dtype, accumulate="storage") -> dict:
+    return {"shape": [int(n) for n in shape],
+            "dtype": _dtype_name(dtype),
+            "accumulate": str(accumulate)}
+
+
+def geometry_for(site: str, config) -> dict:
+    """Dispatch to the site's geometry builder from a (validated)
+    config — the search harness's entry point."""
+    if site == "single_2d":
+        return geometry_single_2d(config.shape, config.dtype,
+                                  config.accumulate)
+    if site == "block_temporal_2d":
+        return geometry_block_temporal_2d(config)
+    if site == "halo_overlap":
+        return geometry_halo_overlap(config)
+    if site == "ensemble_2d":
+        return geometry_ensemble_2d(config.shape, config.dtype,
+                                    config.accumulate)
+    raise ValueError(f"unknown tune site {site!r}")
+
+
+# ---------------------------------------------------------------------------
+# Force override (search harness / parity tests)
+# ---------------------------------------------------------------------------
+
+_force_var: contextvars.ContextVar[Optional[Dict[str, str]]] = \
+    contextvars.ContextVar("pht_tune_force", default=None)
+
+
+@contextlib.contextmanager
+def force(site: str, choice: str):
+    """Pin one site's decision for the dynamic extent of the block.
+
+    The autotuner and the bitwise-parity sweep drive every candidate
+    through the REAL picker/factory path with this, which is what makes
+    "every candidate the DB can return is one of the already-proven-
+    bitwise schedules" true by construction. The pinned choice is still
+    feasibility-checked by the picker — an infeasible pin falls back
+    loudly just like a stale DB entry."""
+    if choice not in SITE_CHOICES[site]:
+        raise ValueError(f"choice {choice!r} outside site {site!r}'s "
+                         f"vocabulary {SITE_CHOICES[site]}")
+    prev = _force_var.get()
+    nxt = dict(prev or {})
+    nxt[site] = choice
+    token = _force_var.set(nxt)
+    try:
+        yield
+    finally:
+        _force_var.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Decision recorder (solver.explain's decided_by feed)
+# ---------------------------------------------------------------------------
+
+_record_var: contextvars.ContextVar[Optional[List[dict]]] = \
+    contextvars.ContextVar("pht_tune_record", default=None)
+
+
+@contextlib.contextmanager
+def record():
+    """Collect per-site decision notes for the dynamic extent of the
+    block; yields the (mutable) list of notes. ``solver.explain`` wraps
+    its resolution pass in this and attaches the notes as
+    ``decided_by``."""
+    notes: List[dict] = []
+    token = _record_var.set(notes)
+    try:
+        yield notes
+    finally:
+        _record_var.reset(token)
+
+
+def note(site: str, source: str, choice: Any, *,
+         entry: Optional[str] = None,
+         reason: Optional[str] = None) -> None:
+    """Record one decision: ``source`` is ``"tuned-db"``,
+    ``"analytic-model"``, or ``"forced"``. No-op outside
+    :func:`record`."""
+    notes = _record_var.get()
+    if notes is None:
+        return
+    d: Dict[str, Any] = {"site": site, "source": source,
+                         "choice": choice}
+    if entry:
+        d["entry"] = entry
+    if reason:
+        d["reason"] = reason
+    notes.append(d)
+
+
+# ---------------------------------------------------------------------------
+# Consult (the picker hook)
+# ---------------------------------------------------------------------------
+
+def consult(site: str, geometry: Dict[str, Any]
+            ) -> Tuple[Optional[str], Optional[str], Optional[str]]:
+    """``(choice, source, entry_key)`` for one decision context.
+
+    ``(None, None, None)`` means no override and no usable entry — the
+    analytic model decides. A DB entry that exists but fails its
+    soundness checks triggers :func:`fallback_warning` here (the loud
+    fallback: never silently run on rejected evidence) and also returns
+    the analytic triple."""
+    forced = _force_var.get()
+    if forced and site in forced:
+        return forced[site], "forced", None
+    db = active()
+    if db is None:
+        return None, None, None
+    try:
+        entry, reason = db.lookup(site, current_topology(), geometry)
+    except Exception as e:  # noqa: BLE001 — a broken DB must not break solves
+        fallback_warning(site, f"tuning-DB lookup failed: {e!r}")
+        return None, None, None
+    if entry is not None:
+        return entry["choice"], "tuned-db", entry["key"]
+    if reason is not None:
+        fallback_warning(site, reason)
+    return None, None, None
+
+
+def fallback_warning(site: str, reason: str) -> None:
+    """The LOUD analytic fallback (SEMANTICS.md "Tuning soundness"):
+    a rejected/corrupt/stale/infeasible tuned entry warns before the
+    analytic model takes over, so fleet logs show the DB rotting
+    instead of silently losing measured speed."""
+    warnings.warn(f"tune[{site}]: falling back to analytic model: "
+                  f"{reason}", RuntimeWarning, stacklevel=3)

@@ -1,0 +1,342 @@
+"""The tuning database — measured schedule winners under the cache's
+journal discipline (SEMANTICS.md "Tuning soundness").
+
+Layout of one DB root::
+
+    <root>/index.jsonl          append-only fsynced index journal
+    <root>/records/<key>.json   rename-committed measurement records
+
+The index is the authority: a pure fold of its events
+(:func:`reduce_tune_journal`, same fold law as
+``service.cache.reduce_cache_journal``) yields the live entries. Each
+entry names a rename-committed record file holding the full measurement
+evidence (every candidate's bitwise-verify verdict and measured rate).
+Commit ordering mirrors the result cache exactly:
+
+- **put**: record file rename-commits BEFORE the index line — a crash
+  between the two loses the ENTRY (the search re-runs), never serves a
+  torn record;
+- **invalidate**: the index line lands BEFORE the record delete — a
+  crash between the two leaves an orphan record file (swept by
+  :meth:`TuneDB.sweep_orphans`), never a live entry naming missing
+  evidence;
+- a SIGKILL mid-append leaves at most one torn tail line, which the
+  tolerant replay (``service.store.read_journal_file``) skips.
+
+Keys are content addresses over ``(site, topology, geometry)``
+canonical JSON — the same ``_digest`` discipline as the result cache's
+semantic keys, so byte-identical decision contexts share entries and
+nothing else can collide with them. DB contents are ORCHESTRATION
+state: they may only ever select among schedules the repo's parity
+contracts already prove bitwise-identical, so no tune key, entry, or
+enable/disable toggle may enter a config field, a cache key, or a
+runner cache key (rule HL101's partition is the enforcement surface —
+there is deliberately no ``HeatConfig`` field for the DB).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.service.store import Journal, read_journal_file
+from parallel_heat_tpu.utils.checkpoint import _fsync_replace
+
+TUNE_SCHEMA_VERSION = 1
+
+# The discrete choice vocabulary per decision site — exactly the kinds
+# the analytic pickers can already return, so a DB entry can never
+# introduce a schedule outside the proven-bitwise family. Admission is
+# re-checked at consult time on top of this (a stale entry whose
+# builder now declines falls back loudly; see tune.consult).
+SITE_CHOICES: Dict[str, Tuple[str, ...]] = {
+    "single_2d": ("A", "E", "E-uni", "I", "I-uni", "B", "C", "jnp"),
+    "block_temporal_2d": ("G-uni", "G-fuse", "G-circ", "G", "jnp"),
+    "halo_overlap": ("phase", "overlap", "pipeline"),
+    "ensemble_2d": ("M", "vmap"),
+}
+
+
+def _digest(doc: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()[:40]
+
+
+def tune_key(site: str, topology: dict, geometry: dict
+             ) -> Tuple[str, dict]:
+    """``(key, canonical_doc)`` for one decision context. The key is a
+    content address: byte-identical canonical ``(site, topology,
+    geometry)`` <=> equal keys."""
+    if site not in SITE_CHOICES:
+        raise ValueError(f"unknown tune site {site!r} "
+                         f"(have: {sorted(SITE_CHOICES)})")
+    canon = {"schema": TUNE_SCHEMA_VERSION, "site": site,
+             "topology": dict(topology), "geometry": dict(geometry)}
+    return _digest(canon), canon
+
+
+# ---------------------------------------------------------------------------
+# Index journal + pure fold
+# ---------------------------------------------------------------------------
+
+def reduce_tune_journal(events, state=None
+                        ) -> Tuple[Dict[str, dict], List[str]]:
+    """Pure fold of tune-index events -> ``(entries, anomalies)``.
+
+    Entry lifecycle: ``tune_put`` creates/replaces, ``tune_invalidate``
+    removes. Same fold law as ``cache.reduce_cache_journal``: pass a
+    previous call's state to fold only appended events
+    (``reduce(prefix) then reduce(suffix) == reduce(all)``). Unknown
+    events/fields are ignored (forward compatibility); an invalidate of
+    an unknown key is an anomaly — the index's double-terminal
+    analogue."""
+    entries: Dict[str, dict] = state[0] if state else {}
+    anomalies: List[str] = state[1] if state else []
+    for e in events:
+        ev = e.get("event")
+        key = e.get("key")
+        if ev is None or not isinstance(key, str):
+            continue
+        if ev == "tune_put":
+            entries[key] = {
+                "key": key,
+                "schema": e.get("db_schema"),
+                "site": e.get("site"),
+                "topology": e.get("topology"),
+                "geometry": e.get("geometry"),
+                "choice": e.get("choice"),
+                # Builder-level detail of the winner (strip height,
+                # tile shape, ...) — advisory: consult re-derives the
+                # detail from the live pickers so a geometry change
+                # can never resurrect a stale shape.
+                "detail": e.get("detail"),
+                # The soundness latch: True only when the winner's
+                # candidate program was bitwise-equal to the reference
+                # schedule before it was timed. Consult refuses
+                # entries without it (measured-only-after-bitwise-
+                # verify, SEMANTICS.md "Tuning soundness").
+                "verified": bool(e.get("verified")),
+                "record": e.get("record"),
+                "n_candidates": e.get("n_candidates"),
+                "put_t": e.get("t_wall"),
+            }
+        elif ev == "tune_invalidate":
+            if entries.pop(key, None) is None:
+                anomalies.append(
+                    f"tune: invalidate of unknown entry {key}")
+    return entries, anomalies
+
+
+# ---------------------------------------------------------------------------
+# The DB handle (journal writer + incremental fold)
+# ---------------------------------------------------------------------------
+
+class TuneDB:
+    """One tuning-DB root: the index journal writer plus an incremental
+    fold of it (the ``CacheIndex`` offset discipline — only whole lines
+    are consumed, so a read racing an append re-reads the torn tail
+    complete next pass). All writes go through this class so the commit
+    ordering (record before index line; invalidate line before record
+    delete) has one home."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.records_dir = os.path.join(self.root, "records")
+        os.makedirs(self.records_dir, exist_ok=True)
+        self.index_path = os.path.join(self.root, "index.jsonl")
+        self._journal: Optional[Journal] = None
+        self._offset = 0
+        self._entries: Dict[str, dict] = {}
+        self._anomalies: List[str] = []
+
+    @property
+    def journal(self) -> Journal:
+        if self._journal is None:
+            self._journal = Journal(self.index_path)
+        return self._journal
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    def __enter__(self) -> "TuneDB":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def entries(self) -> Dict[str, dict]:
+        """The folded index, O(appended bytes) per call."""
+        try:
+            with open(self.index_path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return self._entries
+        end = data.rfind(b"\n")
+        if end >= 0:
+            self._offset += end + 1
+            events = []
+            for line in data[:end + 1].split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    events.append(rec)
+            reduce_tune_journal(events,
+                                state=(self._entries, self._anomalies))
+        return self._entries
+
+    def anomalies(self) -> List[str]:
+        self.entries()
+        return list(self._anomalies)
+
+    # -- writes ----------------------------------------------------------
+
+    def record_path(self, key: str) -> str:
+        return os.path.join(self.records_dir, f"{key}.json")
+
+    def put(self, site: str, topology: dict, geometry: dict, *,
+            choice: str, detail=None, verified: bool,
+            candidates: Optional[list] = None,
+            protocol: Optional[dict] = None) -> dict:
+        """Admit one measured winner; returns the live entry.
+
+        The record file (full candidate table — per-candidate bitwise
+        verdicts and measured rates, the audit evidence) rename-commits
+        strictly BEFORE the index line: the crash window between them
+        loses the entry (the search re-runs), never publishes an entry
+        whose evidence is torn."""
+        if choice not in SITE_CHOICES[site]:
+            raise ValueError(
+                f"choice {choice!r} is outside site {site!r}'s proven-"
+                f"bitwise vocabulary {SITE_CHOICES[site]}")
+        key, canon = tune_key(site, topology, geometry)
+        rec_path = self.record_path(key)
+        record_doc = {
+            "schema": TUNE_SCHEMA_VERSION,
+            "key": key,
+            "canon": canon,
+            "choice": choice,
+            "detail": detail,
+            "verified": bool(verified),
+            "candidates": list(candidates or []),
+            "protocol": dict(protocol or {}),
+        }
+        tmp = os.path.join(self.records_dir,
+                           f".tmp-{os.getpid()}-{key}.json")
+        with open(tmp, "w") as f:
+            json.dump(record_doc, f, indent=1)
+        _fsync_replace(tmp, rec_path)
+        rec = self.journal.append(
+            "tune_put", key=key, db_schema=TUNE_SCHEMA_VERSION,
+            site=site, topology=canon["topology"],
+            geometry=canon["geometry"], choice=choice, detail=detail,
+            verified=bool(verified),
+            n_candidates=len(candidates or []),
+            record=os.path.basename(rec_path))
+        self._consume([rec])
+        return self._entries[key]
+
+    def invalidate(self, key: str) -> None:
+        """Invalidate-line first, THEN delete the record: a crash
+        between the two leaves an orphan record file (swept by
+        :meth:`sweep_orphans`), never a live entry naming missing
+        evidence."""
+        rec = self.journal.append("tune_invalidate", key=key)
+        self._consume([rec])
+        try:
+            os.unlink(self.record_path(key))
+        except OSError:
+            pass
+
+    def sweep_orphans(self) -> int:
+        """Remove record files no live entry references — the residue
+        of crashes inside the two commit windows above. Returns the
+        number removed."""
+        live = {str(e.get("record") or "")
+                for e in self.entries().values()}
+        n = 0
+        try:
+            names = os.listdir(self.records_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name in live:
+                continue
+            try:
+                os.unlink(os.path.join(self.records_dir, name))
+                n += 1
+            except OSError:
+                pass
+        return n
+
+    def _consume(self, recs) -> None:
+        """Fold freshly-appended records by hand and advance the offset
+        past them (the append landed at the tail; the next
+        :meth:`entries` read must not double-fold)."""
+        try:
+            self._offset = os.path.getsize(self.index_path)
+        except OSError:
+            pass
+        reduce_tune_journal(recs,
+                            state=(self._entries, self._anomalies))
+
+    # -- lookup ----------------------------------------------------------
+
+    def lookup(self, site: str, topology: dict, geometry: dict
+               ) -> Tuple[Optional[dict], Optional[str]]:
+        """``(entry, reject_reason)`` for one decision context.
+
+        ``(None, None)`` is a clean miss. ``(None, reason)`` means an
+        entry EXISTS but fails the soundness checks — schema drift, an
+        unverified winner, a choice outside the site vocabulary, or
+        doctored/missing record evidence — and callers must fall back
+        loudly to the analytic model (never select an unverified
+        schedule)."""
+        key, _canon = tune_key(site, topology, geometry)
+        e = self.entries().get(key)
+        if e is None:
+            return None, None
+        if e.get("schema") != TUNE_SCHEMA_VERSION:
+            return None, (f"entry {key}: schema {e.get('schema')!r} != "
+                          f"{TUNE_SCHEMA_VERSION}")
+        if not e.get("verified"):
+            return None, (f"entry {key}: winner was not bitwise-"
+                          f"verified against the reference schedule")
+        choice = e.get("choice")
+        if choice not in SITE_CHOICES.get(site, ()):
+            return None, (f"entry {key}: choice {choice!r} outside "
+                          f"site {site!r}'s vocabulary")
+        rec = self._read_record(key)
+        if rec is None:
+            return None, f"entry {key}: record file missing/torn"
+        if rec.get("key") != key or rec.get("choice") != choice:
+            return None, (f"entry {key}: record evidence disagrees "
+                          f"with the index line (doctored or stale)")
+        return e, None
+
+    def _read_record(self, key: str) -> Optional[dict]:
+        try:
+            with open(self.record_path(key)) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+
+def load_tune_db(root: str) -> Tuple[Dict[str, dict], List[str],
+                                     int, bool]:
+    """Cold read of one DB root ->
+    ``(entries, anomalies, bad_lines, torn_tail)``."""
+    path = os.path.join(str(root), "index.jsonl")
+    events, bad, torn = read_journal_file(path)
+    entries, anomalies = reduce_tune_journal(events)
+    return entries, anomalies, bad, torn

@@ -1,0 +1,287 @@
+"""Measured schedule search — the offline half of the autotuner.
+
+One search run, for one (site, geometry, topology):
+
+1. **Enumerate** the site's choice vocabulary (``SITE_CHOICES``) and
+   probe feasibility by pinning each choice through the REAL picker
+   with ``tune.force`` — a pin the picker declines is infeasible, and
+   (crucially) a feasible pin builds through the same lru_cached
+   factories production uses, so nothing the search times is a
+   schedule production could not run.
+2. **Bitwise-verify** every feasible candidate against the reference
+   schedule — the ANALYTIC picker's choice on the same inputs — with
+   ``np.array_equal`` BEFORE any timing (measured-only-after-bitwise-
+   verify, SEMANTICS.md "Tuning soundness"). A candidate that is not
+   bit-identical (e.g. the jnp fallback against a Pallas reference)
+   is recorded with its verdict and can never win.
+3. **Time** the verified candidates under the interleaved min-of-N
+   protocol (``utils.measure.interleaved_min_of_n`` — the same one
+   ``bench.py`` uses), and
+4. **Persist** the winner into a :class:`tune.db.TuneDB` with the full
+   per-candidate evidence table in the rename-committed record.
+
+Driven offline by ``heat tune`` / ``tools/autotune.py``; never runs
+inside a solve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import warnings
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from parallel_heat_tpu import tune
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.utils import measure
+
+
+def _quiet_force(site: str, choice: str):
+    """A ``tune.force`` that suppresses the loud fallback warning —
+    the search TRIES infeasible pins on purpose; the picker's decline
+    is the answer, not an incident."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with tune.force(site, choice):
+                yield
+
+    return cm()
+
+
+def picked_kind(site: str, config, choice: Optional[str] = None) -> str:
+    """The site's resolved kind for ``config`` — under a forced pin
+    when ``choice`` is given (feasibility probe: ``picked == choice``
+    iff the pin is feasible), analytic otherwise."""
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    def _pick() -> str:
+        if site == "single_2d":
+            kind, _ = ps.pick_single_2d(
+                config.shape, config.dtype, float(config.cx),
+                float(config.cy), accumulate=config.accumulate)
+            return kind
+        if site == "block_temporal_2d":
+            from parallel_heat_tpu.parallel.mesh import AXIS_NAMES
+
+            kind, _, _ = ps.pick_block_temporal_2d(config,
+                                                   AXIS_NAMES[:2])
+            return kind
+        if site == "ensemble_2d":
+            from parallel_heat_tpu.ops.batched import pick_ensemble_2d
+
+            return pick_ensemble_2d(config.shape, config.dtype,
+                                    config.accumulate)
+        if site == "halo_overlap":
+            from parallel_heat_tpu.parallel.temporal import (
+                resolve_halo_overlap)
+            from parallel_heat_tpu.solver import _resolve_backend
+
+            return resolve_halo_overlap(config, _resolve_backend(config))
+        raise ValueError(f"unknown tune site {site!r}")
+
+    if choice is None:
+        return _pick()
+    with _quiet_force(site, choice):
+        return _pick()
+
+
+def _candidate_fn(site: str, config, choice: str, steps_per_call: int):
+    """A zero-arg measured callable running ``choice``'s schedule
+    through the production factories. For ``single_2d`` the multistep
+    function is timed directly (the quantity the picker prices); the
+    other sites time a full ``solve`` (their schedules only exist at
+    driver level)."""
+    import jax
+    import jax.numpy as jnp
+
+    if site == "single_2d":
+        from parallel_heat_tpu.ops import pallas_stencil as ps
+
+        with _quiet_force(site, choice):
+            multi, _ = ps.single_grid_multistep(config)
+        k = steps_per_call
+        run = jax.jit(lambda u: multi(u, k))
+        from parallel_heat_tpu.solver import make_initial_grid
+
+        u0 = jnp.asarray(make_initial_grid(config))
+        return lambda: run(u0)
+
+    from parallel_heat_tpu import solver
+
+    def fn():
+        with _quiet_force(site, choice):
+            res = solver.solve(config)
+        return res.grid
+
+    return fn
+
+
+def search_site(config: HeatConfig, site: str = "single_2d", *,
+                rounds: int = 3, steps_per_call: int = 16,
+                db=None, clock=None) -> Dict[str, Any]:
+    """One measured search; returns the per-geometry report and (when
+    ``db`` is given) persists a verified winner.
+
+    The reference schedule is the analytic picker's choice on the same
+    inputs; every candidate's output is bitwise-compared against it
+    before timing, so the DB can only ever select among schedules
+    proven interchangeable on THIS geometry.
+    """
+    config = config.validate()
+    geometry = tune.geometry_for(site, config)
+    topology = tune.current_topology()
+    analytic = picked_kind(site, config)
+
+    feasible: List[str] = []
+    for choice in tune.SITE_CHOICES[site]:
+        if picked_kind(site, config, choice) == choice:
+            feasible.append(choice)
+
+    fns = {c: _candidate_fn(site, config, c, steps_per_call)
+           for c in feasible}
+
+    # Warm (compile + first dispatch) and capture each candidate's
+    # output for the bitwise verify — timing a cold compile is the
+    # classic garbage-rate bug.
+    outputs = {}
+    for c, fn in fns.items():
+        outputs[c] = np.asarray(fn())
+    reference = outputs[analytic]
+    verified = {c: bool(np.array_equal(out, reference))
+                for c, out in outputs.items()}
+
+    walls = measure.interleaved_min_of_n(
+        {c: fns[c] for c in feasible if verified[c]},
+        rounds=rounds, clock=clock)
+
+    candidates = []
+    for c in tune.SITE_CHOICES[site]:
+        candidates.append({
+            "choice": c,
+            "feasible": c in feasible,
+            "bitwise_verified": verified.get(c, False),
+            "min_wall_s": walls.get(c),
+        })
+    winner = min(walls, key=walls.get) if walls else analytic
+
+    report = {
+        "site": site,
+        "geometry": geometry,
+        "topology": topology,
+        "analytic_choice": analytic,
+        "winner": winner,
+        "agrees_with_analytic": winner == analytic,
+        "candidates": candidates,
+        "protocol": {
+            "timer": "interleaved_min_of_n",
+            "rounds": rounds,
+            "steps_per_call": (steps_per_call if site == "single_2d"
+                               else int(config.steps)),
+            "reference": f"analytic:{analytic}",
+        },
+    }
+    if db is not None and walls:
+        entry = db.put(site, topology, geometry, choice=winner,
+                       verified=verified[winner],
+                       candidates=candidates,
+                       protocol=report["protocol"])
+        report["db_key"] = entry["key"]
+    return report
+
+
+def _parse_geometry(text: str):
+    nx, _, ny = text.partition("x")
+    return int(nx), int(ny)
+
+
+def main(argv=None) -> int:
+    """``heat tune`` — drive measured searches and persist winners.
+
+    CPU runs are DRYRUNS of the machinery (feasibility, bitwise
+    verify, DB round-trip); their timings rank interpret-mode kernels,
+    not hardware. Re-run the same command on the target TPU topology
+    to produce shippable entries.
+    """
+    ap = argparse.ArgumentParser(
+        prog="heat tune",
+        description="measured schedule search -> tuning DB")
+    ap.add_argument("--site", default="single_2d",
+                    choices=sorted(tune.SITE_CHOICES))
+    ap.add_argument("--geometry", action="append", default=[],
+                    metavar="NXxNY",
+                    help="grid geometry, repeatable (default 256x256)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--accumulate", default="storage",
+                    choices=["storage", "f32chunk"])
+    ap.add_argument("--backend", default="pallas")
+    ap.add_argument("--steps", type=int, default=64,
+                    help="solve steps for driver-level sites")
+    ap.add_argument("--steps-per-call", type=int, default=16)
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved min-of-N rounds")
+    ap.add_argument("--db", default=None,
+                    help="tuning-DB root to persist winners into "
+                         "(omit for a report-only dry run)")
+    ap.add_argument("--json", default=None,
+                    help="write the full report to this path")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    geometries = [_parse_geometry(g) for g in args.geometry] or [(256,
+                                                                  256)]
+    db = tune.TuneDB(args.db) if args.db else None
+    platform = jax.devices()[0].platform
+    results = []
+    try:
+        for nx, ny in geometries:
+            cfg = HeatConfig(nx=nx, ny=ny, steps=args.steps,
+                             dtype=args.dtype,
+                             accumulate=args.accumulate,
+                             backend=args.backend)
+            rep = search_site(cfg, args.site, rounds=args.rounds,
+                              steps_per_call=args.steps_per_call,
+                              db=db)
+            results.append(rep)
+            mark = ("==" if rep["agrees_with_analytic"] else "!=")
+            print(f"{nx}x{ny} {args.dtype}/{args.accumulate} "
+                  f"[{args.site}]: winner {rep['winner']} "
+                  f"{mark} analytic {rep['analytic_choice']}"
+                  + (f" -> {rep.get('db_key', '')}" if db else ""))
+    finally:
+        if db is not None:
+            db.close()
+
+    doc = {
+        "schema": "tune-search-v1",
+        "site": args.site,
+        "topology": tune.current_topology(),
+        "results": results,
+        "platform_note": (
+            None if platform in ("tpu", "axon") else
+            f"CPU DRYRUN ({platform}): validates feasibility, "
+            f"bitwise-verify and DB round-trip; timings rank "
+            f"interpret-mode kernels, not hardware."),
+        "tpu_rerun_protocol": (
+            "Re-run this exact command per target topology (the DB "
+            "keys on platform/device_kind/n_devices, so CPU entries "
+            "never shadow TPU ones); commit the DB root's index.jsonl "
+            "+ records/ as fleet artifacts."),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,316 @@
+"""Measured timing — THE home of the interleaved min-of-N protocol.
+
+Every tool that times kernels against each other (``bench.py``, the
+``tools/ab_*.py`` A/B harnesses, the ``tune/`` autotuner) used to carry
+its own copy of the same three ideas; the round-14/16 timing flakes
+were copies drifting apart. The ideas live here once:
+
+- **Chained dispatch, one terminal flush** (:func:`chain_time`): run
+  ``reps`` donated calls back to back with no host sync between them,
+  then one true device->host read. The slope between two rep counts
+  cancels the constant dispatch+readback latency (~0.2 s per call on
+  the axon tunnel).
+- **Min of raw endpoints** (:func:`chain_slope`): transport noise is
+  strictly additive on wall-clock, so min over the *raw endpoint
+  times* converges on the true time; a min over per-batch slopes would
+  be biased low.
+- **Interleaving** (:func:`calibrated_slope_paired`,
+  :func:`interleaved_min_of_n`): device/host clock state drifts on
+  tens-of-seconds scales (the same kernel read 86 and 123
+  Gcells*steps/s back to back while its competitor held steady).
+  Interleaving every variant inside each round lands the drift on all
+  variants alike, so min-per-variant compares like with like.
+
+Every entry point takes an injectable ``clock`` (a zero-arg callable
+returning seconds, default ``time.perf_counter``), so the min/interleave
+arithmetic is testable against a deterministic fake clock and a future
+transport can substitute its own timebase without forking the protocol.
+``utils/profiling.py`` re-exports the chained-slope family for
+backwards compatibility — import new code from here.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+Clock = Callable[[], float]
+
+
+def default_clock() -> float:
+    """The default injectable timebase (``time.perf_counter``)."""
+    return time.perf_counter()
+
+
+def sync(x) -> None:
+    """True synchronization: a device->host read of one element.
+
+    Element indexing, not ``ravel()[0]`` — ravel would materialize a
+    full copy of the grid just to read one value.
+    """
+    x = getattr(x, "grid", x)  # accept a HeatResult directly
+    jax.block_until_ready(x)
+    float(x[(0,) * x.ndim])
+
+
+def sync_floor(u0, samples: int = 3, *,
+               clock: Optional[Clock] = None) -> float:
+    """Median device->host scalar-read latency for this transport —
+    the constant the one-shot timings subtract (``bench.py``'s
+    converge rows)."""
+    clock = clock or default_clock
+    times = []
+    for _ in range(max(1, samples)):
+        t0 = clock()
+        sync(u0)
+        times.append(clock() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def timed_call(fn: Callable[[], Any], *, flush=sync,
+               clock: Optional[Clock] = None) -> Tuple[float, Any]:
+    """One bracketed measurement: ``(wall_seconds, fn())``.
+
+    ``flush`` is applied to the result before the closing clock read
+    (the true pipeline flush); pass ``flush=None`` when ``fn`` already
+    brackets its own synchronization.
+    """
+    clock = clock or default_clock
+    t0 = clock()
+    out = fn()
+    if flush is not None and out is not None:
+        flush(out)
+    return clock() - t0, out
+
+
+def min_of_n(fn: Callable[[], Any], rounds: int = 3, *, flush=sync,
+             clock: Optional[Clock] = None) -> Tuple[float, Any]:
+    """Min-of-N wall for one already-warmed measurement:
+    ``(min_wall_seconds, last_result)``. Warm ``fn`` (compile + first
+    dispatch) before calling — a cold compile inside the bracket is the
+    classic garbage-rate bug."""
+    best, out = float("inf"), None
+    for _ in range(max(1, rounds)):
+        wall, out = timed_call(fn, flush=flush, clock=clock)
+        best = min(best, wall)
+    return best, out
+
+
+def interleaved_min_of_n(named_fns: Dict[str, Callable[[], Any]],
+                         rounds: int = 3, *, flush=sync,
+                         clock: Optional[Clock] = None
+                         ) -> Dict[str, float]:
+    """THE interleaved min-of-N protocol over whole measured calls:
+    every round measures ALL variants once, in dict order, so clock
+    drift lands on each variant alike; returns ``{name: min wall}``.
+
+    This is the wall-bracket flavor (``bench.py``'s stream/ensemble
+    rows, the autotuner's candidate race); use
+    :func:`calibrated_slope_paired` when the per-call compute is small
+    enough that the dispatch floor must be cancelled by a slope.
+    """
+    walls: Dict[str, list] = {name: [] for name in named_fns}
+    for _ in range(max(1, rounds)):
+        for name, fn in named_fns.items():
+            wall, _ = timed_call(fn, flush=flush, clock=clock)
+            walls[name].append(wall)
+    return {name: min(ts) for name, ts in walls.items()}
+
+
+def interleaved_min_self_timed(named_fns: Dict[str, Callable[[], float]],
+                               rounds: int = 3) -> Dict[str, float]:
+    """:func:`interleaved_min_of_n` for SELF-TIMED callables: each fn
+    returns its own measured wall seconds (use when the bracket must
+    exclude per-call setup — e.g. ``bench.py``'s stream row, whose
+    bracket starts after the telemetry sinks open). Same interleave
+    discipline: every round runs ALL variants in dict order."""
+    walls: Dict[str, list] = {name: [] for name in named_fns}
+    for _ in range(max(1, rounds)):
+        for name, fn in named_fns.items():
+            walls[name].append(float(fn()))
+    return {name: min(ts) for name, ts in walls.items()}
+
+
+# ---------------------------------------------------------------------------
+# The chained-slope family (dispatch-floor cancellation)
+# ---------------------------------------------------------------------------
+
+def chain_time(step_fn, u0, reps: int, *,
+               clock: Optional[Clock] = None) -> float:
+    """Wall-clock seconds for ``reps`` chained ``step_fn`` applications.
+
+    Copy ``u0`` first (compiled runners donate their input buffer — the
+    copy protects the caller's array), apply ``g = step_fn(g)`` ``reps``
+    times with no intermediate host sync, then one terminal
+    :func:`sync` as the true pipeline flush. ``step_fn`` must return
+    the next grid (unwrap any extra outputs).
+    """
+    import jax.numpy as jnp
+
+    clock = clock or default_clock
+    g = jnp.copy(u0)
+    jax.block_until_ready(g)
+    t0 = clock()
+    # heatlint: begin dispatch-region
+    for _ in range(reps):
+        g = step_fn(g)
+    # heatlint: end dispatch-region
+    sync(g)
+    return clock() - t0
+
+
+def chain_slope(step_fn, u0, reps_a: int, reps_b: int,
+                batches: int = 1, *,
+                clock: Optional[Clock] = None) -> float:
+    """Steady-state seconds per ``step_fn`` call via the chained slope.
+
+    Measures each endpoint ``batches`` times, takes the minimum of the
+    *raw times* (transport noise — dispatch jitter, host scheduling —
+    is strictly additive on wall-clock, so min converges on the true
+    time; a min over per-batch *slopes* would instead be biased low,
+    preferentially keeping batches whose short endpoint got inflated),
+    then returns ``(min t_b - min t_a) / (reps_b - reps_a)``. Raises
+    ``RuntimeError`` when the slope is non-positive (noise swamped the
+    measurement — e.g. the per-call compute is far below the
+    transport's dispatch latency); callers must surface that rather
+    than report a garbage throughput number.
+    """
+    assert reps_b > reps_a >= 1 and batches >= 1
+    t_a = min(chain_time(step_fn, u0, reps_a, clock=clock)
+              for _ in range(batches))
+    t_b = min(chain_time(step_fn, u0, reps_b, clock=clock)
+              for _ in range(batches))
+    per = (t_b - t_a) / (reps_b - reps_a)
+    if per <= 0:
+        raise RuntimeError(
+            f"non-positive chained slope ({t_b:.4f}s for {reps_b} reps vs "
+            f"{t_a:.4f}s for {reps_a}): measurement noise exceeds per-call "
+            f"compute; increase the batch budget"
+        )
+    return per
+
+
+def _calibrate_reps(step_fn, u0, span_s: float, max_reps: int, *,
+                    clock: Optional[Clock] = None) -> Tuple[int, bool]:
+    """Size the long endpoint to hold ``span_s`` seconds of REAL device
+    work -> ``(reps_b, short_span)``. Calibration is itself a slope —
+    ``(t_33 - t_1) / 32`` cancels the dispatch floor, so the endpoint
+    really spans ``span_s`` of device time (guessing from one warm call
+    is the classic garbage-rate bug: that call is dominated by the
+    ~0.2 s dispatch+readback floor). ``short_span`` is True when even
+    ``max_reps`` cannot hold 60% of the requested device work — the
+    garbage-rate regime callers must refuse or surface."""
+    t1 = chain_time(step_fn, u0, 1, clock=clock)
+    t33 = chain_time(step_fn, u0, 33, clock=clock)
+    per_est = (t33 - t1) / 32
+    if per_est <= 0:
+        per_est = span_s / max_reps  # fall through to the reps cap
+    want = 1 + max(32, int(span_s / per_est))
+    # >= 2 so the slope divisor is never zero, whatever max_reps a
+    # caller passes.
+    reps_b = max(2, min(want, max_reps))
+    short = reps_b < want and reps_b * per_est < 0.6 * span_s
+    return reps_b, short
+
+
+def calibrated_slope(step_fn, u0, span_s: float = 0.5,
+                     batches: int = 3, max_reps: int = 3000, *,
+                     clock: Optional[Clock] = None) -> float:
+    """:func:`chain_slope` with the long endpoint sized by
+    :func:`_calibrate_reps` so it holds ``span_s`` seconds of real
+    device work. Raises ``RuntimeError`` (from :func:`chain_slope`, or
+    directly in the short-span regime) rather than returning a garbage
+    number."""
+    reps_b, short = _calibrate_reps(step_fn, u0, span_s, max_reps,
+                                    clock=clock)
+    if short:
+        raise RuntimeError(
+            f"per-call compute too small: even {max_reps} reps span "
+            f"<{0.6 * span_s:.2f} s of device work; raise max_reps or "
+            f"use a larger problem")
+    return chain_slope(step_fn, u0, 1, reps_b, batches=batches,
+                       clock=clock)
+
+
+def bench_rounds_paired(named_fns, u0, steps_per_call,
+                        span_s: float = 0.5, batches: int = 3,
+                        max_reps: int = 3000):
+    """Jit, warm, and time a set of round fns with
+    :func:`calibrated_slope_paired`; print one line per variant and
+    return ``{name: Gcells*steps/s}``.
+
+    The shared driver of the A/B tools (``tools/ab_fused_g.py`` /
+    ``ab_fused_h.py`` / ``ab_uni_single.py``): a variant that fails to
+    compile prints FAILED and is excluded; a variant whose slope is
+    noise prints so rather than reporting a garbage rate.
+    ``steps_per_call[name]`` is how many stencil steps one call
+    advances (K for temporal rounds).
+    """
+    import math
+
+    runs = {}
+    for name, fn in named_fns.items():
+        run = jax.jit(fn)
+        try:
+            sync(run(u0))
+        except Exception as e:  # noqa: BLE001 — surface, don't crash the A/B
+            print(f"{name:26s}: FAILED {type(e).__name__}: {e}")
+            continue
+        runs[name] = run
+    pers = calibrated_slope_paired(runs, u0, span_s=span_s,
+                                   batches=batches, max_reps=max_reps)
+    cells = math.prod(u0.shape)
+    out = {}
+    for name, per in pers.items():
+        if per is None:
+            print(f"{name:26s}: no trustworthy slope "
+                  f"(non-positive, or max_reps spans <60% of span_s)")
+            continue
+        k = steps_per_call[name]
+        g = cells * k / per / 1e9
+        print(f"{name:26s}: {per*1e3:8.2f} ms/call {per/k*1e6:9.1f} "
+              f"us/step {g:7.1f} Gcells*steps/s")
+        out[name] = g
+    return out
+
+
+def calibrated_slope_paired(named_fns, u0, span_s: float = 0.5,
+                            batches: int = 3, max_reps: int = 3000, *,
+                            clock: Optional[Clock] = None):
+    """Paired :func:`calibrated_slope` over several step fns.
+
+    Every batch interleaves ALL variants' endpoint measurements, so
+    clock drift lands on each variant alike and the
+    min-of-raw-endpoints slope compares like with like. Returns
+    ``{name: seconds per call}``; a variant whose slope comes out
+    non-positive maps to ``None`` (surface it, don't guess), and so
+    does one in the short-span regime (here a ``None`` keeps the other
+    variants' paired comparison alive where :func:`calibrated_slope`
+    would raise).
+    """
+    reps = {}
+    short_span = set()
+    for name, fn in named_fns.items():
+        reps[name], short = _calibrate_reps(fn, u0, span_s, max_reps,
+                                            clock=clock)
+        if short:
+            short_span.add(name)
+    timed = [n for n in named_fns if n not in short_span]
+    t_a = {n: [] for n in timed}
+    t_b = {n: [] for n in timed}
+    for _ in range(batches):
+        for name in timed:
+            t_a[name].append(chain_time(named_fns[name], u0, 1,
+                                        clock=clock))
+            t_b[name].append(chain_time(named_fns[name], u0,
+                                        reps[name], clock=clock))
+    out = {}
+    for name in named_fns:
+        if name in short_span:
+            out[name] = None
+            continue
+        per = (min(t_b[name]) - min(t_a[name])) / (reps[name] - 1)
+        out[name] = per if per > 0 else None
+    return out

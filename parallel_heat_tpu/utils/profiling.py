@@ -12,6 +12,11 @@ cost, the Allreduce stall pattern. The TPU-native equivalents:
 On transports with deeply asynchronous dispatch, ``block_until_ready``
 alone may under-synchronize; :func:`sync` forces a device->host read,
 which is a true pipeline flush (used by bench.py between repetitions).
+
+The chained-slope / interleaved min-of-N timing protocol itself lives
+in ``utils/measure.py`` (one home, injectable clock); this module
+re-exports it unchanged for the existing tool imports and keeps the
+trace/stats helpers.
 """
 
 from __future__ import annotations
@@ -22,193 +27,9 @@ from dataclasses import dataclass
 
 import jax
 
-
-def sync(x) -> None:
-    """True synchronization: a device->host read of one element.
-
-    Element indexing, not ``ravel()[0]`` — ravel would materialize a
-    full copy of the grid just to read one value.
-    """
-    x = getattr(x, "grid", x)  # accept a HeatResult directly
-    jax.block_until_ready(x)
-    float(x[(0,) * x.ndim])
-
-
-def chain_time(step_fn, u0, reps: int) -> float:
-    """Wall-clock seconds for ``reps`` chained ``step_fn`` applications.
-
-    The chained-slope timing protocol shared by ``bench.py`` and the
-    tuning tools: copy ``u0`` first (compiled runners donate their input
-    buffer — the copy protects the caller's array), apply
-    ``g = step_fn(g)`` ``reps`` times with no intermediate host sync,
-    then one terminal :func:`sync` as the true pipeline flush. Timing
-    the slope between two batch sizes cancels the constant
-    dispatch+readback latency (~0.2 s per call on the axon tunnel).
-    ``step_fn`` must return the next grid (unwrap any extra outputs).
-    """
-    import jax.numpy as jnp
-
-    g = jnp.copy(u0)
-    jax.block_until_ready(g)
-    t0 = time.perf_counter()
-    # heatlint: begin dispatch-region
-    for _ in range(reps):
-        g = step_fn(g)
-    # heatlint: end dispatch-region
-    sync(g)
-    return time.perf_counter() - t0
-
-
-def chain_slope(step_fn, u0, reps_a: int, reps_b: int,
-                batches: int = 1) -> float:
-    """Steady-state seconds per ``step_fn`` call via the chained slope.
-
-    Measures each endpoint ``batches`` times, takes the minimum of the
-    *raw times* (transport noise — dispatch jitter, host scheduling —
-    is strictly additive on wall-clock, so min converges on the true
-    time; a min over per-batch *slopes* would instead be biased low,
-    preferentially keeping batches whose short endpoint got inflated),
-    then returns ``(min t_b - min t_a) / (reps_b - reps_a)``. Raises
-    ``RuntimeError`` when the slope is non-positive (noise swamped the
-    measurement — e.g. the per-call compute is far below the
-    transport's dispatch latency); callers must surface that rather
-    than report a garbage throughput number.
-    """
-    assert reps_b > reps_a >= 1 and batches >= 1
-    t_a = min(chain_time(step_fn, u0, reps_a) for _ in range(batches))
-    t_b = min(chain_time(step_fn, u0, reps_b) for _ in range(batches))
-    per = (t_b - t_a) / (reps_b - reps_a)
-    if per <= 0:
-        raise RuntimeError(
-            f"non-positive chained slope ({t_b:.4f}s for {reps_b} reps vs "
-            f"{t_a:.4f}s for {reps_a}): measurement noise exceeds per-call "
-            f"compute; increase the batch budget"
-        )
-    return per
-
-
-def calibrated_slope(step_fn, u0, span_s: float = 0.5,
-                     batches: int = 3, max_reps: int = 3000) -> float:
-    """:func:`chain_slope` with the long endpoint sized so it holds
-    ``span_s`` seconds of REAL device work.
-
-    The failure mode this prevents (seen repeatedly on the axon
-    tunnel): a caller guesses the rep count from a single warm call,
-    whose time is dominated by the ~0.2 s dispatch+readback floor; for
-    sub-millisecond kernels the guessed span ends up a few ms of
-    device work, noise swamps the slope, and the tool prints garbage
-    rates (e.g. the same kernel reading 56 / 119 / 480 Gcells*steps/s
-    across three invocations). Calibration here is itself a slope —
-    ``(t_33 - t_1) / 32`` cancels the floor — so the final endpoint
-    really spans ``span_s`` of device time. Raises ``RuntimeError``
-    (from :func:`chain_slope`, or directly when even ``max_reps``
-    cannot fill the span) rather than returning a garbage number.
-    """
-    t1 = chain_time(step_fn, u0, 1)
-    t33 = chain_time(step_fn, u0, 33)
-    per_est = (t33 - t1) / 32
-    if per_est <= 0:
-        per_est = span_s / max_reps  # fall through to the reps cap
-    reps_b = 1 + max(32, int(span_s / per_est))
-    if reps_b > max_reps:
-        # Tolerate a modest shortfall (clock drift makes per_est fuzzy
-        # anyway); a span under ~60% of the requested device work is
-        # the garbage-rate regime this function exists to refuse.
-        if max_reps * per_est < 0.6 * span_s:
-            raise RuntimeError(
-                f"per-call compute ~{per_est*1e6:.1f} us: even "
-                f"{max_reps} reps span <{0.6 * span_s:.2f} s of device "
-                f"work; raise max_reps or use a larger problem")
-        reps_b = max_reps
-    return chain_slope(step_fn, u0, 1, reps_b, batches=batches)
-
-
-def calibrated_slope_paired(named_fns, u0, span_s: float = 0.5,
-                            batches: int = 3, max_reps: int = 3000):
-    """Paired :func:`calibrated_slope` over several step fns.
-
-    Device clock state drifts on tens-of-seconds scales (the same
-    kernel read 86 and 123 Gcells*steps/s in back-to-back invocations
-    while its competitor held steady), so sequential per-variant
-    timing can misrank variants. Here every batch interleaves ALL
-    variants' endpoint measurements, so drift lands on each variant
-    alike and the min-of-raw-endpoints slope compares like with like.
-    Returns ``{name: seconds per call}``; a variant whose slope comes
-    out non-positive maps to ``None`` (surface it, don't guess), and so
-    does one whose ``max_reps`` cannot hold at least 60% of ``span_s``
-    of device work — the same garbage-rate regime
-    :func:`calibrated_slope` refuses with an exception (here a ``None``
-    keeps the other variants' paired comparison alive).
-    """
-    reps = {}
-    short_span = set()
-    for name, fn in named_fns.items():
-        t1 = chain_time(fn, u0, 1)
-        t33 = chain_time(fn, u0, 33)
-        per_est = (t33 - t1) / 32
-        if per_est <= 0:
-            per_est = span_s / max_reps
-        want = 1 + max(32, int(span_s / per_est))
-        # >= 2 so the slope divisor below is never zero, whatever
-        # max_reps a caller passes.
-        reps[name] = max(2, min(want, max_reps))
-        if reps[name] < want and reps[name] * per_est < 0.6 * span_s:
-            short_span.add(name)
-    timed = [n for n in named_fns if n not in short_span]
-    t_a = {n: [] for n in timed}
-    t_b = {n: [] for n in timed}
-    for _ in range(batches):
-        for name in timed:
-            t_a[name].append(chain_time(named_fns[name], u0, 1))
-            t_b[name].append(chain_time(named_fns[name], u0, reps[name]))
-    out = {}
-    for name in named_fns:
-        if name in short_span:
-            out[name] = None
-            continue
-        per = (min(t_b[name]) - min(t_a[name])) / (reps[name] - 1)
-        out[name] = per if per > 0 else None
-    return out
-
-
-def bench_rounds_paired(named_fns, u0, steps_per_call, span_s: float = 0.5,
-                        batches: int = 3, max_reps: int = 3000):
-    """Jit, warm, and time a set of round fns with
-    :func:`calibrated_slope_paired`; print one line per variant and
-    return ``{name: Gcells*steps/s}``.
-
-    The shared driver of the A/B tools (``tools/ab_fused_g.py`` /
-    ``ab_fused_h.py``): a variant that fails to compile prints FAILED
-    and is excluded; a variant whose slope is noise prints so rather
-    than reporting a garbage rate. ``steps_per_call[name]`` is how many
-    stencil steps one call advances (K for temporal rounds).
-    """
-    import math
-
-    runs = {}
-    for name, fn in named_fns.items():
-        run = jax.jit(fn)
-        try:
-            sync(run(u0))
-        except Exception as e:  # noqa: BLE001 — surface, don't crash the A/B
-            print(f"{name:26s}: FAILED {type(e).__name__}: {e}")
-            continue
-        runs[name] = run
-    pers = calibrated_slope_paired(runs, u0, span_s=span_s,
-                                   batches=batches, max_reps=max_reps)
-    cells = math.prod(u0.shape)
-    out = {}
-    for name, per in pers.items():
-        if per is None:
-            print(f"{name:26s}: no trustworthy slope "
-                  f"(non-positive, or max_reps spans <60% of span_s)")
-            continue
-        k = steps_per_call[name]
-        g = cells * k / per / 1e9
-        print(f"{name:26s}: {per*1e3:8.2f} ms/call {per/k*1e6:9.1f} "
-              f"us/step {g:7.1f} Gcells*steps/s")
-        out[name] = g
-    return out
+from parallel_heat_tpu.utils.measure import (  # noqa: F401 — re-exports
+    bench_rounds_paired, calibrated_slope, calibrated_slope_paired,
+    chain_slope, chain_time, sync)
 
 
 @contextlib.contextmanager

@@ -430,6 +430,24 @@ def test_hl2xx_scan_scope_covers_multigrid_module():
     assert "multigrid.py" in files and "solver.py" in files
 
 
+def test_hl2xx_scan_scope_covers_tune_package():
+    # Same pin for the measured-autotuning package: the default scan
+    # path set must reach every tune/ module, so the AST hygiene
+    # rules — wallclock-in-traced bans, lock discipline, unused
+    # imports — audit the search/DB/consult layers like everything
+    # else (the autotuner times code; timing code is exactly where
+    # HL201/HL202 violations breed).
+    from parallel_heat_tpu.analysis.astlint import (
+        _iter_py_files, default_scan_paths)
+
+    files = {os.path.relpath(p).replace(os.sep, "/") for p in
+             _iter_py_files(default_scan_paths())}
+    assert {"parallel_heat_tpu/tune/__init__.py",
+            "parallel_heat_tpu/tune/db.py",
+            "parallel_heat_tpu/tune/search.py"} <= files
+    assert "tools/autotune.py" in files
+
+
 # ---------------------------------------------------------------------------
 # HL104 f32chunk accumulation chain
 # ---------------------------------------------------------------------------

@@ -61,15 +61,19 @@ def test_calibrated_slope_sizing_and_refusal(monkeypatch):
     # device work (computed from a two-point slope that cancels the
     # dispatch floor), and must REFUSE rather than return a garbage
     # rate when even max_reps cannot fill ~60% of the span.
+    from parallel_heat_tpu.utils import measure
     from parallel_heat_tpu.utils import profiling as prof
 
     calls = []
 
-    def fake_chain_time(fn, u0, reps, per=1e-3, floor=0.2):
+    # The protocol lives in utils/measure.py now (profiling re-exports
+    # it), so the stub targets the measure module and absorbs the
+    # clock= plumbing kwarg.
+    def fake_chain_time(fn, u0, reps, per=1e-3, floor=0.2, **kw):
         calls.append(reps)
         return floor + per * reps
 
-    monkeypatch.setattr(prof, "chain_time", fake_chain_time)
+    monkeypatch.setattr(measure, "chain_time", fake_chain_time)
     per = prof.calibrated_slope(None, None, span_s=0.5)
     assert abs(per - 1e-3) < 1e-12
     # endpoints: 1, 33 (calibration), then 1 and ~501 (the span)
@@ -77,8 +81,8 @@ def test_calibrated_slope_sizing_and_refusal(monkeypatch):
 
     calls.clear()
     monkeypatch.setattr(
-        prof, "chain_time",
-        lambda fn, u0, reps: 0.2 + 1e-3 * reps)
+        measure, "chain_time",
+        lambda fn, u0, reps, **kw: 0.2 + 1e-3 * reps)
     with pytest.raises(RuntimeError, match="max_reps|span"):
         prof.calibrated_slope(None, None, span_s=10.0, max_reps=100)
 
@@ -87,15 +91,16 @@ def test_calibrated_slope_paired_interleaves(monkeypatch):
     # Paired mode must interleave the variants' endpoint batches (the
     # whole point: clock drift lands on every variant alike) and map a
     # non-positive slope to None instead of a garbage rate.
+    from parallel_heat_tpu.utils import measure
     from parallel_heat_tpu.utils import profiling as prof
 
     seq = []
 
-    def fake_chain_time(fn, u0, reps):
+    def fake_chain_time(fn, u0, reps, **kw):
         seq.append((fn, reps))
         return 0.2 + fn * reps  # fn doubles as the per-call time
 
-    monkeypatch.setattr(prof, "chain_time", fake_chain_time)
+    monkeypatch.setattr(measure, "chain_time", fake_chain_time)
     out = prof.calibrated_slope_paired({ "a": 1e-3, "b": 2e-3 },
                                        None, span_s=0.1, batches=2)
     assert abs(out["a"] - 1e-3) < 1e-12
@@ -104,8 +109,8 @@ def test_calibrated_slope_paired_interleaves(monkeypatch):
     body = [fn for fn, _ in seq[4:]]
     assert body == [1e-3, 1e-3, 2e-3, 2e-3, 1e-3, 1e-3, 2e-3, 2e-3]
 
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.5)  # flat: zero slope
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.5)  # flat: zero slope
     out = prof.calibrated_slope_paired({"a": None}, None, batches=1)
     assert out["a"] is None
 

@@ -33,7 +33,7 @@ def test_bench_helper_on_tiny_config(monkeypatch):
     _repo_on_path()
     import bench
     from parallel_heat_tpu import HeatConfig
-    from parallel_heat_tpu.utils import profiling as prof
+    from parallel_heat_tpu.utils import measure
 
     # _bench_fixed rides chain_slope, which RAISES on a non-positive
     # slope — at this tiny config the per-call compute is sub-ms, so
@@ -44,10 +44,13 @@ def test_bench_helper_on_tiny_config(monkeypatch):
     # clock model makes it load-free, exactly like the calibrated_slope
     # tests in test_aux.py. The real-noise protocol stays covered where
     # it belongs — bench.py's own artifact runs.
-    def fake_chain_time(step_fn, u0, reps, per=1e-4, floor=0.05):
+    # The protocol lives in utils/measure.py now and bench resolves it
+    # from there at call time, so the stub targets the measure module
+    # and absorbs the clock= plumbing kwarg.
+    def fake_chain_time(step_fn, u0, reps, per=1e-4, floor=0.05, **kw):
         return floor + per * reps
 
-    monkeypatch.setattr(prof, "chain_time", fake_chain_time)
+    monkeypatch.setattr(measure, "chain_time", fake_chain_time)
     monkeypatch.setattr(bench, "_sync_floor", lambda u0: 0.05)
     elapsed = bench._bench_fixed(
         HeatConfig(nx=32, ny=32, steps=10, backend="jnp"), budget_s=0.2

@@ -4,34 +4,37 @@ empty-summary behavior (ISSUE 3 satellites)."""
 
 import pytest
 
+from parallel_heat_tpu.utils import measure
 from parallel_heat_tpu.utils import profiling as prof
 
 
 def test_chain_slope_raises_on_non_positive_slope(monkeypatch):
     # Flat endpoints (all dispatch floor, no per-call signal): the
     # slope is zero and chain_slope must refuse, not divide it out.
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.2)
+    # (The protocol lives in utils/measure.py now — profiling
+    # re-exports it — so the stub targets the measure module.)
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.2)
     with pytest.raises(RuntimeError, match="non-positive chained slope"):
         prof.chain_slope(None, None, 1, 33)
     # Inverted endpoints (noise swamped the long batch): same refusal.
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.2 - 1e-4 * reps)
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.2 - 1e-4 * reps)
     with pytest.raises(RuntimeError, match="measurement noise"):
         prof.chain_slope(None, None, 1, 33, batches=2)
 
 
 def test_chain_slope_happy_path(monkeypatch):
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.2 + 2e-3 * reps)
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.2 + 2e-3 * reps)
     assert prof.chain_slope(None, None, 1, 101) == pytest.approx(2e-3)
 
 
 def test_calibrated_slope_short_span_refusal(monkeypatch):
     # max_reps cannot hold 60% of span_s of device work: refuse with
     # the actionable message rather than report a noise-dominated rate.
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.2 + 1e-3 * reps)
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.2 + 1e-3 * reps)
     with pytest.raises(RuntimeError, match="raise max_reps"):
         prof.calibrated_slope(None, None, span_s=10.0, max_reps=100)
 

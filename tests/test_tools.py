@@ -174,10 +174,13 @@ def test_ab_uni_single_smoke(tmp_path, monkeypatch, capsys):
     tool = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(tool)
 
-    from parallel_heat_tpu.utils import profiling as prof
+    from parallel_heat_tpu.utils import measure
 
-    monkeypatch.setattr(prof, "chain_time",
-                        lambda fn, u0, reps: 0.2 + 1e-3 * reps)
+    # The protocol lives in utils/measure.py now (bench_rounds_paired
+    # calls it there), so the stub targets the measure module and
+    # absorbs the clock= plumbing kwarg.
+    monkeypatch.setattr(measure, "chain_time",
+                        lambda fn, u0, reps, **kw: 0.2 + 1e-3 * reps)
     out_json = tmp_path / "ab_uni.json"
     monkeypatch.setattr(sys, "argv",
                         ["ab_uni_single.py", "--size", "64",

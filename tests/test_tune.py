@@ -1,0 +1,445 @@
+"""Measured autotuning (SEMANTICS.md "Tuning soundness"): the tuning
+DB's journal discipline — fold law, torn tails, both crash windows —
+mirrored from tests/test_cache.py; the loud-fallback contract on
+doctored/unverified evidence; the bitwise parity sweep over every
+DB-selectable single-grid schedule; and the HL101 partition (toggling
+the DB never perturbs the runner cache).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu import tune
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.tune import db as T
+
+# ---------------------------------------------------------------------------
+# Isolation: the active DB is process-global orchestration state; every
+# test starts with tuning OFF and leaves no DB behind.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _tuning_off(monkeypatch):
+    monkeypatch.delenv("PHT_TUNE_DB", raising=False)
+    prev = tune._active_db
+    tune._active_db = None
+    yield
+    cur = tune._active_db
+    if cur not in (None, tune._ACTIVE_SENTINEL):
+        cur.close()
+    tune._active_db = prev
+
+
+_TOPO = {"platform": "cpu", "device_kind": "tpu_v4", "n_devices": 1}
+_GEOM = {"shape": [64, 64], "dtype": "float32", "accumulate": "storage"}
+
+
+def _put(key, t=1.0, **kw):
+    e = {"event": "tune_put", "key": key,
+         "db_schema": T.TUNE_SCHEMA_VERSION, "site": "single_2d",
+         "topology": _TOPO, "geometry": _GEOM, "choice": "E",
+         "detail": None, "verified": True, "n_candidates": 4,
+         "record": f"{key}.json", "t_wall": t}
+    e.update(kw)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# Content-addressed keys
+# ---------------------------------------------------------------------------
+
+def test_tune_key_content_address():
+    k1, canon = T.tune_key("single_2d", _TOPO, _GEOM)
+    k2, _ = T.tune_key("single_2d", dict(_TOPO), dict(_GEOM))
+    assert k1 == k2 and len(k1) == 40
+    assert canon["schema"] == T.TUNE_SCHEMA_VERSION
+    # Any coordinate flip moves the key — entries can never shadow a
+    # different site, topology, or geometry.
+    assert T.tune_key("ensemble_2d", _TOPO, _GEOM)[0] != k1
+    assert T.tune_key("single_2d", {**_TOPO, "n_devices": 8},
+                      _GEOM)[0] != k1
+    assert T.tune_key("single_2d", _TOPO,
+                      {**_GEOM, "dtype": "bfloat16"})[0] != k1
+    with pytest.raises(ValueError, match="unknown tune site"):
+        T.tune_key("nosuch", _TOPO, _GEOM)
+
+
+# ---------------------------------------------------------------------------
+# Index journal fold law (the cache's discipline, verbatim)
+# ---------------------------------------------------------------------------
+
+def test_reduce_tune_journal_fold_law():
+    events = [
+        _put("k1", t=1.0), _put("k2", t=2.0, choice="I"),
+        _put("k1", t=3.0, choice="E-uni"),  # re-put replaces
+        {"event": "tune_invalidate", "key": "k2"},
+        _put("k3", t=4.0),
+    ]
+    whole = T.reduce_tune_journal(events)
+    for cut in range(len(events) + 1):
+        state = T.reduce_tune_journal(events[:cut])
+        folded = T.reduce_tune_journal(events[cut:], state=state)
+        assert folded == whole
+    entries, anomalies = whole
+    assert set(entries) == {"k1", "k3"}
+    assert entries["k1"]["choice"] == "E-uni"
+    assert entries["k1"]["put_t"] == 3.0
+    assert anomalies == []
+
+
+def test_reduce_tune_journal_unknown_invalidate_anomaly():
+    _, anomalies = T.reduce_tune_journal(
+        [{"event": "tune_invalidate", "key": "ghost"}])
+    assert len(anomalies) == 1 and "unknown entry ghost" in anomalies[0]
+
+
+def test_reduce_tune_journal_ignores_foreign_lines():
+    entries, anomalies = T.reduce_tune_journal([
+        {"event": "mystery", "key": "k1"},
+        {"event": "tune_put"},  # no key
+        {"not": "an event"},
+    ])
+    assert entries == {} and anomalies == []
+
+
+# ---------------------------------------------------------------------------
+# DB round-trip, torn tail, crash windows
+# ---------------------------------------------------------------------------
+
+def test_tune_db_put_lookup_roundtrip(tmp_path):
+    with T.TuneDB(str(tmp_path)) as db:
+        entry = db.put("single_2d", _TOPO, _GEOM, choice="E",
+                       detail=8, verified=True,
+                       candidates=[{"choice": "E",
+                                    "bitwise_verified": True}],
+                       protocol={"timer": "interleaved_min_of_n"})
+        hit, reason = db.lookup("single_2d", _TOPO, _GEOM)
+        assert reason is None and hit["choice"] == "E"
+        # The record file carries the full evidence table.
+        with open(db.record_path(entry["key"])) as f:
+            rec = json.load(f)
+        assert rec["candidates"][0]["bitwise_verified"] is True
+        assert rec["canon"]["geometry"] == _GEOM
+        # A different geometry is a clean miss, never a reject.
+        assert db.lookup("single_2d", _TOPO,
+                         {**_GEOM, "shape": [128, 128]}) == (None, None)
+        # The vocabulary is enforced at admission, not just consult.
+        with pytest.raises(ValueError, match="proven-bitwise"):
+            db.put("single_2d", _TOPO, _GEOM, choice="G-uni",
+                   verified=True)
+    # Cold reload folds to the same state (fresh process).
+    entries, anomalies, bad, torn = tune.load_tune_db(str(tmp_path))
+    assert anomalies == [] and bad == 0 and not torn
+    assert entries[entry["key"]]["choice"] == "E"
+
+
+def test_tune_db_torn_tail_invisible(tmp_path):
+    with T.TuneDB(str(tmp_path)) as db:
+        db.put("single_2d", _TOPO, _GEOM, choice="E", verified=True)
+    with open(tmp_path / "index.jsonl", "a") as f:
+        f.write('{"event": "tune_put", "key": "torn')  # no newline
+    entries, anomalies, bad, torn = tune.load_tune_db(str(tmp_path))
+    assert len(entries) == 1 and anomalies == [] and bad == 0 and torn
+    # The incremental fold consumes whole lines only: a fresh handle
+    # sees the same single entry, and completing the tail later would
+    # surface it (no byte is ever skipped).
+    db2 = T.TuneDB(str(tmp_path))
+    assert len(db2.entries()) == 1
+    db2.close()
+
+
+def test_crash_window_record_without_index_line(tmp_path):
+    # A crash between the record rename-commit and the index append
+    # loses the ENTRY (the search re-runs) — the record is an orphan,
+    # swept, never served.
+    db = T.TuneDB(str(tmp_path))
+    key, _ = T.tune_key("single_2d", _TOPO, _GEOM)
+    with open(db.record_path(key), "w") as f:
+        json.dump({"key": key, "choice": "E"}, f)
+    assert db.entries() == {}
+    assert db.lookup("single_2d", _TOPO, _GEOM) == (None, None)
+    assert db.sweep_orphans() == 1
+    assert not os.path.exists(db.record_path(key))
+    db.close()
+
+
+def test_crash_window_invalidate_line_before_record_delete(tmp_path):
+    # Invalidate commits its index line BEFORE the record delete: a
+    # crash between the two leaves an orphan record — folded state
+    # shows no entry, and the sweep removes the residue.
+    db = T.TuneDB(str(tmp_path))
+    entry = db.put("single_2d", _TOPO, _GEOM, choice="E",
+                   verified=True)
+    db.journal.append("tune_invalidate", key=entry["key"])
+    db.close()
+    db2 = T.TuneDB(str(tmp_path))
+    assert db2.entries() == {}
+    assert db2.anomalies() == []
+    assert os.path.exists(db2.record_path(entry["key"]))  # the residue
+    assert db2.sweep_orphans() == 1
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# Doctored / unverified evidence -> reject with a reason (the loud-
+# fallback feed)
+# ---------------------------------------------------------------------------
+
+def test_lookup_rejects_unverified_winner(tmp_path):
+    with T.TuneDB(str(tmp_path)) as db:
+        db.put("single_2d", _TOPO, _GEOM, choice="jnp", verified=False)
+        entry, reason = db.lookup("single_2d", _TOPO, _GEOM)
+        assert entry is None and "not bitwise-verified" in reason
+
+
+def test_lookup_rejects_doctored_record(tmp_path):
+    with T.TuneDB(str(tmp_path)) as db:
+        e = db.put("single_2d", _TOPO, _GEOM, choice="E",
+                   verified=True)
+        # Evidence disagreeing with the index line: rejected.
+        with open(db.record_path(e["key"]), "w") as f:
+            json.dump({"key": e["key"], "choice": "I"}, f)
+        entry, reason = db.lookup("single_2d", _TOPO, _GEOM)
+        assert entry is None and "doctored or stale" in reason
+        # Torn/corrupt record: rejected.
+        with open(db.record_path(e["key"]), "w") as f:
+            f.write('{"key": "tor')
+        entry, reason = db.lookup("single_2d", _TOPO, _GEOM)
+        assert entry is None and "missing/torn" in reason
+
+
+def test_lookup_rejects_schema_drift(tmp_path):
+    db = T.TuneDB(str(tmp_path))
+    e = db.put("single_2d", _TOPO, _GEOM, choice="E", verified=True)
+    db.journal.append(
+        "tune_put", key=e["key"], db_schema=T.TUNE_SCHEMA_VERSION + 1,
+        site="single_2d", topology=_TOPO, geometry=_GEOM, choice="E",
+        detail=None, verified=True, n_candidates=0,
+        record=f"{e['key']}.json")
+    db._consume([])  # advance past the raw append
+    db2 = T.TuneDB(str(tmp_path))
+    entry, reason = db2.lookup("single_2d", _TOPO, _GEOM)
+    assert entry is None and "schema" in reason
+    db.close()
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# Consult layer: force pins, tuned picks, loud analytic fallback
+# ---------------------------------------------------------------------------
+
+def _cfg64(**kw):
+    kw.setdefault("steps", 4)
+    return HeatConfig(nx=64, ny=64, backend="pallas",
+                      **kw).validate()
+
+
+def test_force_vocabulary_guard():
+    with pytest.raises(ValueError, match="outside site"):
+        with tune.force("single_2d", "nosuch"):
+            pass
+
+
+def test_force_pins_the_real_picker():
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    cfg = _cfg64()
+    with tune.force("single_2d", "jnp"):
+        kind, detail = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1,
+                                         0.1)
+    assert (kind, detail) == ("jnp", None)
+    with tune.force("single_2d", "E"):
+        kind, detail = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1,
+                                         0.1)
+    assert kind == "E" and isinstance(detail, int)
+
+
+def test_consult_uses_verified_entry_and_explain_reports(tmp_path):
+    from parallel_heat_tpu import solver
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    cfg = _cfg64()
+    geom = tune.geometry_single_2d(cfg.shape, cfg.dtype,
+                                   cfg.accumulate)
+    with T.TuneDB(str(tmp_path)) as db:
+        db.put("single_2d", tune.current_topology(), geom, choice="E",
+               verified=True)
+    tune.set_active(str(tmp_path))
+    kind, detail = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1, 0.1)
+    assert kind == "E"
+    # detail is re-derived live, never read from the entry.
+    assert isinstance(detail, int)
+    ex = solver.explain(cfg)
+    d = ex["decided_by"]["single_2d"]
+    assert d["source"] == "tuned-db" and d["choice"] == "E"
+    assert d["entry"] == T.tune_key("single_2d",
+                                    tune.current_topology(), geom)[0]
+    tune.set_active(None)
+    ex2 = solver.explain(cfg)
+    assert ex2["decided_by"]["single_2d"]["source"] == "analytic-model"
+
+
+def test_doctored_db_falls_back_loudly_to_analytic(tmp_path):
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    cfg = _cfg64()
+    analytic_kind, _ = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1,
+                                         0.1)
+    geom = tune.geometry_single_2d(cfg.shape, cfg.dtype,
+                                   cfg.accumulate)
+    with T.TuneDB(str(tmp_path)) as db:
+        # An unverified winner for THIS topology+geometry: the picker
+        # must warn and run the analytic choice — never the unverified
+        # schedule.
+        db.put("single_2d", tune.current_topology(), geom,
+               choice="jnp", verified=False)
+    tune.set_active(str(tmp_path))
+    with pytest.warns(RuntimeWarning,
+                      match="falling back to analytic"):
+        kind, _ = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1, 0.1)
+    assert kind == analytic_kind
+    assert kind != "jnp"
+
+
+def test_stale_infeasible_entry_falls_back_loudly(tmp_path):
+    from parallel_heat_tpu.ops import pallas_stencil as ps
+
+    cfg = _cfg64()
+    analytic_kind, _ = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1,
+                                         0.1)
+    geom = tune.geometry_single_2d(cfg.shape, cfg.dtype,
+                                   cfg.accumulate)
+    with T.TuneDB(str(tmp_path)) as db:
+        # A verified entry whose choice the builders now decline for
+        # this geometry (C never admits 64x64 here): advisory-only —
+        # the picker re-checks feasibility and falls back loudly.
+        db.put("single_2d", tune.current_topology(), geom, choice="C",
+               verified=True)
+    tune.set_active(str(tmp_path))
+    with pytest.warns(RuntimeWarning,
+                      match="falling back to analytic"):
+        kind, _ = ps.pick_single_2d(cfg.shape, cfg.dtype, 0.1, 0.1)
+    assert kind == analytic_kind
+
+
+# ---------------------------------------------------------------------------
+# Bitwise parity sweep: every DB-selectable single-grid schedule on one
+# geometry produces the identical grid (the contract that makes tuned
+# selection results-invariant BY CONSTRUCTION).
+# ---------------------------------------------------------------------------
+
+def test_parity_sweep_every_db_selectable_single_2d_schedule():
+    from parallel_heat_tpu import solver
+    from parallel_heat_tpu.tune.search import picked_kind
+
+    cfg = HeatConfig(nx=256, ny=256, steps=6,
+                     backend="pallas").validate()
+    reference = None
+    swept = []
+    for choice in tune.SITE_CHOICES["single_2d"]:
+        if choice == "jnp":
+            continue  # the non-Pallas fallback is allclose, not bitwise
+        if picked_kind("single_2d", cfg, choice) != choice:
+            continue  # infeasible on this geometry (e.g. C)
+        with tune.force("single_2d", choice):
+            grid = np.asarray(solver.solve(cfg).grid)
+        if reference is None:
+            reference = grid
+        else:
+            assert np.array_equal(grid, reference), (
+                f"schedule {choice} diverged bitwise")
+        swept.append(choice)
+    # The sweep must actually cover the kernel family, or the parity
+    # claim is vacuous.
+    assert {"A", "E", "E-uni", "I", "I-uni", "B"} <= set(swept)
+
+
+# ---------------------------------------------------------------------------
+# HL101 partition: toggling the DB never perturbs the runner cache
+# ---------------------------------------------------------------------------
+
+def test_db_toggle_causes_zero_new_runner_cache_misses(tmp_path):
+    from parallel_heat_tpu import solver
+
+    cfg = _cfg64()
+    geom = tune.geometry_single_2d(cfg.shape, cfg.dtype,
+                                   cfg.accumulate)
+    with T.TuneDB(str(tmp_path)) as db:
+        db.put("single_2d", tune.current_topology(), geom, choice="E",
+               verified=True)
+    solver._build_runner.cache_clear()
+    solver.solve(cfg)
+    baseline = solver._build_runner.cache_info()
+    tune.set_active(str(tmp_path))
+    solver.solve(cfg)
+    with_db = solver._build_runner.cache_info()
+    tune.set_active(None)
+    solver.solve(cfg)
+    without = solver._build_runner.cache_info()
+    assert with_db.misses == baseline.misses
+    assert without.misses == baseline.misses
+    assert without.hits == baseline.hits + 2
+
+
+# ---------------------------------------------------------------------------
+# The search harness end to end (tiny geometry; the verify gate and the
+# DB round-trip, not the timings, are the contract on CPU)
+# ---------------------------------------------------------------------------
+
+def test_search_site_verifies_before_timing_and_persists(tmp_path):
+    from parallel_heat_tpu.tune.search import search_site
+
+    cfg = _cfg64(steps=8)
+    with T.TuneDB(str(tmp_path)) as db:
+        report = search_site(cfg, "single_2d", rounds=1,
+                             steps_per_call=4, db=db)
+        by = {c["choice"]: c for c in report["candidates"]}
+        # Every feasible Pallas candidate is bitwise-verified against
+        # the analytic reference; the jnp fallback never is (allclose
+        # only), so it can never win on a Pallas geometry.
+        for c, row in by.items():
+            if row["feasible"] and c != "jnp":
+                assert row["bitwise_verified"], row
+        assert not by["jnp"]["bitwise_verified"]
+        assert by["jnp"]["min_wall_s"] is None  # excluded from timing
+        assert report["winner"] != "jnp"
+        assert by[report["winner"]]["bitwise_verified"]
+        assert report["protocol"]["reference"] == (
+            f"analytic:{report['analytic_choice']}")
+        # Persisted winner consults back through the public lookup.
+        entry, reason = db.lookup("single_2d", report["topology"],
+                                  report["geometry"])
+        assert reason is None
+        assert entry["choice"] == report["winner"]
+        assert entry["key"] == report["db_key"]
+
+
+# ---------------------------------------------------------------------------
+# measure.py satellites: the shared timing protocol's new entry points
+# ---------------------------------------------------------------------------
+
+def test_interleaved_min_self_timed_round_robins():
+    from parallel_heat_tpu.utils import measure
+
+    calls = []
+    fns = {"a": lambda: calls.append("a") or 3.0 - len(calls),
+           "b": lambda: calls.append("b") or 10.0 + len(calls)}
+    out = measure.interleaved_min_self_timed(fns, rounds=3)
+    # Interleaved a,b,a,b,a,b — never a,a,a,b,b,b (drift fairness).
+    assert calls == ["a", "b"] * 3
+    assert out == {"a": 3.0 - 5, "b": 10.0 + 2}
+
+
+def test_profiling_reexports_measure_protocol():
+    # bench.py / tools ports moved the protocol to utils/measure.py;
+    # profiling keeps the old names as aliases so existing callers and
+    # artifacts stay valid.
+    from parallel_heat_tpu.utils import measure, profiling
+
+    assert profiling.bench_rounds_paired is measure.bench_rounds_paired
+    assert profiling.chain_slope is measure.chain_slope
+    assert profiling.sync is measure.sync

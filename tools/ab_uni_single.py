@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from parallel_heat_tpu.models import HeatPlate2D
 from parallel_heat_tpu.ops import pallas_stencil as ps
-from parallel_heat_tpu.utils.profiling import bench_rounds_paired
+from parallel_heat_tpu.utils.measure import bench_rounds_paired
 
 
 def main():

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Offline measured autotuning — thin driver over ``tune/search.py``
+(the same surface as ``heat tune``; see that module's docstring for
+the search/verify/persist protocol and the CPU-dryrun discipline).
+
+Run: python tools/autotune.py --geometry 256x256 --geometry 4096x4096 \
+         --db tunedb --json TUNE_dryrun.json
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from parallel_heat_tpu.tune.search import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,11 @@ reference report's Paraver-trace tables (Heat.pdf §7), but computed
 from machine-readable events instead of read off a trace viewer:
 
 - run header(s): config, resolved execution path, topology, versions;
+  plus ``tuned_decision_rate`` — the fraction of run segments whose
+  header ``explain.decided_by`` carries any ``tuned-db`` source, i.e.
+  how much of the fleet ran on measured schedules instead of the
+  analytic cost models (gateable: ``--fail-on
+  'tuned_decision_rate<X'``);
 - throughput: percentiles (p10/p50/p90/max) of per-chunk steps/s and
   Mcells*steps/s, total steps and wall time;
 - chunk-time outliers: chunks slower than ``--outlier-mult`` x the
@@ -269,6 +274,19 @@ def summarize(events, outlier_mult=5.0):
             "jax_version": h.get("jax_version"),
             "segments": len(headers),  # resumed runs append headers
         }
+        # Fraction of run segments whose resolved execution path came
+        # from the measured tuning DB (any picker site with source
+        # "tuned-db" in explain.decided_by) rather than the analytic
+        # cost models.  Gateable: --fail-on 'tuned_decision_rate<1.0'
+        # pins a fleet to measured schedules.
+        tuned = 0
+        for h in headers:
+            decided = ((h.get("explain") or {}).get("decided_by")
+                       or {})
+            if any((d or {}).get("source") == "tuned-db"
+                   for d in decided.values()):
+                tuned += 1
+        doc["tuned_decision_rate"] = tuned / len(headers)
 
     # Defensive field access throughout: a foreign line shaped like an
     # event must degrade the numbers, never traceback past the exit-

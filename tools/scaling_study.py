@@ -327,24 +327,16 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                     halo_depth=depth if _prod(mesh) > 1 else 1,
                     halo_overlap=None if sched == "auto" else sched,
                 ).validate()
-                rcfg, rbackend, _ = _resolved(cfg)
+                rcfg, _rbackend, _ = _resolved(cfg)
                 # An explicit "pipeline" the round builder cannot
                 # honor (jnp backend, 3D, declining geometry) falls
                 # back to the deferred rounds — account the exchange
-                # the run ACTUALLY pays, and record the effective
-                # schedule (the same fallback discipline the builders
-                # apply).
-                effective = rcfg.halo_overlap
-                if effective == "pipeline":
-                    from parallel_heat_tpu.ops import (
-                        pallas_stencil as ps)
-                    from parallel_heat_tpu.parallel.mesh import (
-                        AXIS_NAMES)
-
-                    if (rbackend != "pallas" or rcfg.ndim != 2
-                            or ps.pick_block_temporal_2d_pipelined(
-                                rcfg, AXIS_NAMES[:2]) is None):
-                        effective = "overlap"
+                # the run ACTUALLY pays. explain() owns that fallback
+                # resolution (halo_overlap_effective); labeling from
+                # it instead of re-deriving here keeps this artifact
+                # drift-free against the builders.
+                ex = explain(cfg)
+                effective = ex["halo_overlap_effective"]
                 u0 = jax.block_until_ready(make_initial_grid(cfg))
                 solve(cfg, initial=u0)  # compile + warm
                 best = float("inf")
@@ -384,7 +376,7 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                     "cells_per_device": cells_n // _prod(mesh),
                     "mcells_steps_per_s": round(
                         cells_n * res.steps_run / best / 1e6, 1),
-                    "path": explain(cfg)["path"],
+                    "path": ex["path"],
                 }
                 rows.append(row)
                 print(json.dumps(row))
