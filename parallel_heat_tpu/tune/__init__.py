@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import functools
 import os
 import warnings
 from typing import Any, Dict, List, Optional, Tuple
@@ -68,17 +69,31 @@ def active() -> Optional[TuneDB]:
     return _active_db
 
 
-def current_topology() -> Dict[str, Any]:
-    """The topology half of a tune key: platform, device generation,
-    device count. Canonical-JSON-stable (plain strs/ints only)."""
+@functools.lru_cache(maxsize=1)
+def _device_probe() -> Tuple[str, int]:
+    """One jax.devices()/device_count() query per process — the device
+    set cannot change after jax initializes, and :func:`consult` runs
+    on the hot build path (inside lru-cached build probes), so the
+    probe must not pay the device enumeration per pick. The device
+    KIND is deliberately NOT memoized here: ``tpu_params.params()``
+    honors ``PHT_TPU_KIND``/``set_override`` at call time."""
     import jax
 
+    return str(jax.devices()[0].platform), int(jax.device_count())
+
+
+def current_topology() -> Dict[str, Any]:
+    """The topology half of a tune key: platform, device generation,
+    device count. Canonical-JSON-stable (plain strs/ints only); a
+    fresh dict per call (callers embed it in reports they may
+    mutate)."""
     from parallel_heat_tpu.ops import tpu_params
 
+    platform, n_devices = _device_probe()
     return {
-        "platform": str(jax.devices()[0].platform),
+        "platform": platform,
         "device_kind": tpu_params.params().kind,
-        "n_devices": int(jax.device_count()),
+        "n_devices": n_devices,
     }
 
 

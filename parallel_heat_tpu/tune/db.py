@@ -150,6 +150,12 @@ class TuneDB:
         self._offset = 0
         self._entries: Dict[str, dict] = {}
         self._anomalies: List[str] = []
+        # Validated record evidence, key -> (choice, mtime_ns, size):
+        # lookup() runs on the hot build path, so a record that already
+        # passed the evidence check is re-verified by a stat (any
+        # rewrite/doctor moves mtime or size and forces a re-read)
+        # instead of an open+parse per pick.
+        self._record_ok: Dict[str, Tuple[str, int, int]] = {}
 
     @property
     def journal(self) -> Journal:
@@ -243,6 +249,7 @@ class TuneDB:
             n_candidates=len(candidates or []),
             record=os.path.basename(rec_path))
         self._consume([rec])
+        self._record_ok.pop(key, None)  # fresh evidence, fresh check
         return self._entries[key]
 
     def invalidate(self, key: str) -> None:
@@ -252,6 +259,7 @@ class TuneDB:
         evidence."""
         rec = self.journal.append("tune_invalidate", key=key)
         self._consume([rec])
+        self._record_ok.pop(key, None)
         try:
             os.unlink(self.record_path(key))
         except OSError:
@@ -315,13 +323,30 @@ class TuneDB:
         if choice not in SITE_CHOICES.get(site, ()):
             return None, (f"entry {key}: choice {choice!r} outside "
                           f"site {site!r}'s vocabulary")
+        stamp = self._record_stamp(key, choice)
+        if stamp is not None and self._record_ok.get(key) == stamp:
+            return e, None
         rec = self._read_record(key)
         if rec is None:
+            self._record_ok.pop(key, None)
             return None, f"entry {key}: record file missing/torn"
         if rec.get("key") != key or rec.get("choice") != choice:
+            self._record_ok.pop(key, None)
             return None, (f"entry {key}: record evidence disagrees "
                           f"with the index line (doctored or stale)")
+        # Stamp taken BEFORE the read: a concurrent rewrite between
+        # the two at worst re-validates on the next lookup.
+        if stamp is not None:
+            self._record_ok[key] = stamp
         return e, None
+
+    def _record_stamp(self, key: str, choice: str
+                      ) -> Optional[Tuple[str, int, int]]:
+        try:
+            st = os.stat(self.record_path(key))
+        except OSError:
+            return None
+        return choice, int(st.st_mtime_ns), int(st.st_size)
 
     def _read_record(self, key: str) -> Optional[dict]:
         try:

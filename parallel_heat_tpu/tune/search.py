@@ -5,9 +5,15 @@ One search run, for one (site, geometry, topology):
 1. **Enumerate** the site's choice vocabulary (``SITE_CHOICES``) and
    probe feasibility by pinning each choice through the REAL picker
    with ``tune.force`` — a pin the picker declines is infeasible, and
-   (crucially) a feasible pin builds through the same lru_cached
-   factories production uses, so nothing the search times is a
-   schedule production could not run.
+   (crucially) a feasible pin builds through the same factories
+   production uses, so nothing the search times is a schedule
+   production could not run. The config-keyed runner memos
+   (``solver._build_runner``, the ensemble engine's runner caches) key
+   on config ALONE — two candidates share the config — so each
+   candidate's program is built with those memos cleared and
+   snapshotted into its closure (:func:`_candidate_fn`); without the
+   clear every candidate after the first would silently re-time the
+   first candidate's compiled schedule.
 2. **Bitwise-verify** every feasible candidate against the reference
    schedule — the ANALYTIC picker's choice on the same inputs — with
    ``np.array_equal`` BEFORE any timing (measured-only-after-bitwise-
@@ -74,10 +80,14 @@ def picked_kind(site: str, config, choice: Optional[str] = None) -> str:
                                                    AXIS_NAMES[:2])
             return kind
         if site == "ensemble_2d":
-            from parallel_heat_tpu.ops.batched import pick_ensemble_2d
+            # The driver-level decision site — NOT pick_ensemble_2d
+            # directly: ensemble_path gates on scheme/backend/ndim
+            # before consulting the picker, so a pin the engine would
+            # never see (e.g. kernel M on a jnp backend) probes
+            # infeasible here instead of timing two identical paths.
+            from parallel_heat_tpu.ensemble import engine
 
-            return pick_ensemble_2d(config.shape, config.dtype,
-                                    config.accumulate)
+            return engine.ensemble_path(config)
         if site == "halo_overlap":
             from parallel_heat_tpu.parallel.temporal import (
                 resolve_halo_overlap)
@@ -92,12 +102,29 @@ def picked_kind(site: str, config, choice: Optional[str] = None) -> str:
         return _pick()
 
 
-def _candidate_fn(site: str, config, choice: str, steps_per_call: int):
+def _candidate_fn(site: str, config, choice: str, steps_per_call: int,
+                  members: int = 4):
     """A zero-arg measured callable running ``choice``'s schedule
-    through the production factories. For ``single_2d`` the multistep
-    function is timed directly (the quantity the picker prices); the
-    other sites time a full ``solve`` (their schedules only exist at
-    driver level)."""
+    through the production factories.
+
+    Each candidate's program is built ONCE, under its own ``tune.force``
+    pin, and snapshotted into the closure. The config-keyed runner memos
+    (``solver._build_runner``; the ensemble engine's runner caches) are
+    cleared BEFORE the build (so the pin cannot silently reuse the
+    previous candidate's compiled schedule — the memo keys on config
+    alone and every candidate shares the config) and AFTER it (so no
+    forced runner leaks into production state). Compiles land in the
+    snapshot's first call — the warm pass — never inside the timing
+    bracket.
+
+    ``single_2d`` times the multistep function directly (the quantity
+    the picker prices); ``ensemble_2d`` times the engine's member-
+    batched fixed runner over ``members`` members (the batched path is
+    the ONLY consumer of ``pick_ensemble_2d`` — a plain solve never
+    reaches it); the driver-level sites (``block_temporal_2d``,
+    ``halo_overlap``) time the full compiled simulation program.
+    Donating runners get a fresh ``jnp.copy`` of the prepared initial
+    per call — identical overhead for every candidate."""
     import jax
     import jax.numpy as jnp
 
@@ -115,17 +142,39 @@ def _candidate_fn(site: str, config, choice: str, steps_per_call: int):
 
     from parallel_heat_tpu import solver
 
-    def fn():
+    ocfg = solver._observer_free(config)
+
+    if site == "ensemble_2d":
+        from parallel_heat_tpu.ensemble import engine
+
+        engine._build_fixed_runner.cache_clear()
+        engine._batched_multistep.cache_clear()
         with _quiet_force(site, choice):
-            res = solver.solve(config)
-        return res.grid
+            run = engine._build_fixed_runner(ocfg, members,
+                                             steps_per_call)
+        engine._build_fixed_runner.cache_clear()
+        engine._batched_multistep.cache_clear()
+        u0 = solver._prepare_initial(ocfg, None)
+        u0b = jax.block_until_ready(
+            jnp.stack([u0] * members))
+        return lambda: run(jnp.copy(u0b))
+
+    solver._build_runner.cache_clear()
+    with _quiet_force(site, choice):
+        runner, _ = solver._build_runner(ocfg)
+    solver._build_runner.cache_clear()
+    u0 = solver._prepare_initial(ocfg, None)
+
+    def fn():
+        grid, _steps, _conv, _res = runner(jnp.copy(u0))
+        return grid
 
     return fn
 
 
 def search_site(config: HeatConfig, site: str = "single_2d", *,
                 rounds: int = 3, steps_per_call: int = 16,
-                db=None, clock=None) -> Dict[str, Any]:
+                members: int = 4, db=None, clock=None) -> Dict[str, Any]:
     """One measured search; returns the per-geometry report and (when
     ``db`` is given) persists a verified winner.
 
@@ -135,6 +184,22 @@ def search_site(config: HeatConfig, site: str = "single_2d", *,
     proven interchangeable on THIS geometry.
     """
     config = config.validate()
+    if site in ("block_temporal_2d", "halo_overlap"):
+        # The driver-level sites decide on the RESOLVED config — the
+        # concrete halo depth solver._resolved substitutes — so the
+        # geometry key and the feasibility probes must see exactly
+        # what the consult site will at pick time: an auto depth is
+        # None here but concrete there, and a key built from the raw
+        # config could never be consulted back (and the
+        # block_temporal_2d probe would decline every kernel against
+        # K=None). halo_overlap stays unresolved: an explicit
+        # schedule short-circuits resolve_halo_overlap and would make
+        # every pin but its own infeasible.
+        from parallel_heat_tpu import solver
+
+        mode = config.halo_overlap
+        resolved, _, _ = solver._resolved(config)
+        config = resolved.replace(halo_overlap=mode).validate()
     geometry = tune.geometry_for(site, config)
     topology = tune.current_topology()
     analytic = picked_kind(site, config)
@@ -144,7 +209,8 @@ def search_site(config: HeatConfig, site: str = "single_2d", *,
         if picked_kind(site, config, choice) == choice:
             feasible.append(choice)
 
-    fns = {c: _candidate_fn(site, config, c, steps_per_call)
+    fns = {c: _candidate_fn(site, config, c, steps_per_call,
+                            members=members)
            for c in feasible}
 
     # Warm (compile + first dispatch) and capture each candidate's
@@ -182,11 +248,15 @@ def search_site(config: HeatConfig, site: str = "single_2d", *,
         "protocol": {
             "timer": "interleaved_min_of_n",
             "rounds": rounds,
-            "steps_per_call": (steps_per_call if site == "single_2d"
-                               else int(config.steps)),
+            "steps_per_call": (int(config.steps)
+                               if site in ("block_temporal_2d",
+                                           "halo_overlap")
+                               else steps_per_call),
             "reference": f"analytic:{analytic}",
         },
     }
+    if site == "ensemble_2d":
+        report["protocol"]["members"] = int(members)
     if db is not None and walls:
         entry = db.put(site, topology, geometry, choice=winner,
                        verified=verified[winner],
@@ -221,9 +291,15 @@ def main(argv=None) -> int:
     ap.add_argument("--accumulate", default="storage",
                     choices=["storage", "f32chunk"])
     ap.add_argument("--backend", default="pallas")
+    ap.add_argument("--mesh", default=None, metavar="DXxDY",
+                    help="device mesh for the driver-level sites "
+                         "(block_temporal_2d, halo_overlap)")
+    ap.add_argument("--halo-depth", type=int, default=None)
     ap.add_argument("--steps", type=int, default=64,
                     help="solve steps for driver-level sites")
     ap.add_argument("--steps-per-call", type=int, default=16)
+    ap.add_argument("--members", type=int, default=4,
+                    help="member batch for the ensemble_2d site")
     ap.add_argument("--rounds", type=int, default=3,
                     help="interleaved min-of-N rounds")
     ap.add_argument("--db", default=None,
@@ -245,10 +321,13 @@ def main(argv=None) -> int:
             cfg = HeatConfig(nx=nx, ny=ny, steps=args.steps,
                              dtype=args.dtype,
                              accumulate=args.accumulate,
-                             backend=args.backend)
+                             backend=args.backend,
+                             mesh_shape=(_parse_geometry(args.mesh)
+                                         if args.mesh else None),
+                             halo_depth=args.halo_depth)
             rep = search_site(cfg, args.site, rounds=args.rounds,
                               steps_per_call=args.steps_per_call,
-                              db=db)
+                              members=args.members, db=db)
             results.append(rep)
             mark = ("==" if rep["agrees_with_analytic"] else "!=")
             print(f"{nx}x{ny} {args.dtype}/{args.accumulate} "

@@ -345,6 +345,11 @@ def test_parity_sweep_every_db_selectable_single_2d_schedule():
             continue  # the non-Pallas fallback is allclose, not bitwise
         if picked_kind("single_2d", cfg, choice) != choice:
             continue  # infeasible on this geometry (e.g. C)
+        # The runner memo keys on config ALONE: without the clear every
+        # solve after the first reuses the first choice's compiled
+        # program and the parity claim is vacuous (each grid would be
+        # compared with itself).
+        solver._build_runner.cache_clear()
         with tune.force("single_2d", choice):
             grid = np.asarray(solver.solve(cfg).grid)
         if reference is None:
@@ -416,6 +421,98 @@ def test_search_site_verifies_before_timing_and_persists(tmp_path):
         assert reason is None
         assert entry["choice"] == report["winner"]
         assert entry["key"] == report["db_key"]
+
+
+def test_candidate_fn_builds_driver_candidates_under_their_own_pin():
+    """Each driver-level candidate's compiled runner is built under ITS
+    pin. ``solver._build_runner`` memoizes on config alone and every
+    candidate shares the config, so without the clear-around-build in
+    ``_candidate_fn`` the second candidate would silently reuse the
+    first candidate's compiled schedule (and never consult the picker
+    at all — which is exactly what the decision recorder pins here)."""
+    from parallel_heat_tpu import solver
+    from parallel_heat_tpu.tune import search
+
+    cfg = HeatConfig(nx=64, ny=64, steps=4, backend="jnp",
+                     mesh_shape=(1, 2)).validate()
+    for choice in ("phase", "overlap"):
+        with tune.record() as notes:
+            fn = search._candidate_fn("halo_overlap", cfg, choice, 4)
+        assert {"site": "halo_overlap", "source": "forced",
+                "choice": choice} in [
+            {k: n.get(k) for k in ("site", "source", "choice")}
+            for n in notes], (choice, notes)
+        del fn
+    # No forced runner may leak into production state.
+    assert solver._build_runner.cache_info().currsize == 0
+
+
+def test_search_site_halo_overlap_races_distinct_verified_schedules(
+        tmp_path):
+    """End-to-end driver-level search: the exchange schedules are
+    bitwise-identical by the PR-17 contract, so every feasible
+    candidate must verify, get timed, and the winner persists — under
+    a geometry key the consult site can actually find (the search
+    resolves the auto halo depth exactly like ``solver._resolved``
+    does at pick time; a key built from the raw config's ``None``
+    depth could never be consulted back)."""
+    from parallel_heat_tpu import solver
+    from parallel_heat_tpu.tune.search import search_site
+
+    cfg = HeatConfig(nx=64, ny=64, steps=4, backend="jnp",
+                     mesh_shape=(1, 2)).validate()
+    with T.TuneDB(str(tmp_path)) as db:
+        report = search_site(cfg, "halo_overlap", rounds=1, db=db)
+        by = {c["choice"]: c for c in report["candidates"]}
+        assert by["phase"]["feasible"] and by["overlap"]["feasible"]
+        for c in ("phase", "overlap"):
+            assert by[c]["bitwise_verified"], by[c]
+            assert by[c]["min_wall_s"] is not None
+        assert by[report["winner"]]["bitwise_verified"]
+        entry, reason = db.lookup("halo_overlap", report["topology"],
+                                  report["geometry"])
+        assert reason is None
+        assert entry["choice"] == report["winner"]
+    # The searched entry consults back through a production resolve.
+    tune.set_active(str(tmp_path))
+    try:
+        ex = solver.explain(cfg)
+        d = ex["decided_by"]["halo_overlap"]
+        assert d["source"] == "tuned-db", d
+        assert d["entry"] == report["db_key"]
+        assert d["choice"] == report["winner"]
+    finally:
+        tune.set_active(None)
+
+
+def test_search_site_ensemble_times_the_batched_engine_path(tmp_path):
+    """The ensemble_2d search must race the ENGINE's member-batched
+    programs — a plain solve never consults ``pick_ensemble_2d``. At
+    64² f32 kernel M admits (the analytic choice) and the vmap
+    candidate runs the jnp spelling, which is allclose-only against
+    the Pallas kernels on this geometry (the same pin as the solo
+    jnp row above): the two candidates producing DIFFERENT bits is
+    itself the proof that two genuinely distinct batched programs
+    ran, not one cached program twice."""
+    from parallel_heat_tpu.tune.search import picked_kind, search_site
+
+    cfg = _cfg64(steps=8)
+    assert picked_kind("ensemble_2d", cfg) == "M"
+    with T.TuneDB(str(tmp_path)) as db:
+        report = search_site(cfg, "ensemble_2d", rounds=1,
+                             steps_per_call=4, members=2, db=db)
+        by = {c["choice"]: c for c in report["candidates"]}
+        assert report["analytic_choice"] == "M"
+        assert by["M"]["feasible"] and by["vmap"]["feasible"]
+        assert by["M"]["bitwise_verified"]
+        assert not by["vmap"]["bitwise_verified"]
+        assert by["vmap"]["min_wall_s"] is None  # never timed, never wins
+        assert report["winner"] == "M"
+        assert report["protocol"]["members"] == 2
+        entry, reason = db.lookup("ensemble_2d", report["topology"],
+                                  report["geometry"])
+        assert reason is None
+        assert entry["choice"] == "M"
 
 
 # ---------------------------------------------------------------------------
