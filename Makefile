@@ -29,8 +29,8 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
-        ensemble-smoke trace-smoke cache-smoke implicit-smoke \
-        tune-smoke bench clean
+        fleet-smoke ensemble-smoke trace-smoke cache-smoke \
+        implicit-smoke tune-smoke bench clean
 
 all: heat
 
@@ -175,6 +175,62 @@ serve-smoke:
 	$(PY) -c "import json,sys; f=json.load(sys.stdin)['fleet']; \
 	assert f['completed'] == 3, f"
 	rm -rf .serve_smoke
+
+# Fleet federation run-book as a gate (README "Fleet federation"): a
+# 2-partition fleet root; host A is SIGKILLed with a job in flight
+# (the worker self-kills too — nobody is left to requeue it); host B
+# must take the lease over within one lease timeout, journal
+# host_lost + adopted, and complete the job; SIGTERM drains B with
+# the leases RELEASED; then the federated audit (heatq --check) and
+# the adoption/stale-lease SLOs must hold.
+fleet-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .fleet_smoke && mkdir -p .fleet_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-init \
+	    --fleet .fleet_smoke/f --partitions 2 --lease-timeout 2; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-serve \
+	    --fleet .fleet_smoke/f --host hosta --slots 1 \
+	    --poll-interval 0.05 --lease-renew 0.5 \
+	    --worker-heartbeat 0.25 --heartbeat-timeout 1.5 \
+	    --max-seconds 300 >/dev/null & \
+	APID=$$!; trap 'kill -9 $$APID 2>/dev/null || true' EXIT; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-submit \
+	    --fleet .fleet_smoke/f --nx 16 --ny 16 --steps 60 \
+	    --checkpoint-every 10 --accept-timeout 120 --quiet \
+	    --faults '{"kill_worker_at_chunk": 4}' --job-id fleet-a; \
+	for i in $$(seq 1 600); do \
+	    grep -ls '"event": "dispatched"' \
+	        .fleet_smoke/f/parts/*/journal.jsonl \
+	        >/dev/null 2>&1 && break; \
+	    sleep 0.1; \
+	done; \
+	kill -9 $$APID 2>/dev/null || true; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-serve \
+	    --fleet .fleet_smoke/f --host hostb --slots 1 \
+	    --poll-interval 0.05 --lease-renew 0.5 \
+	    --worker-heartbeat 0.25 --heartbeat-timeout 1.5 \
+	    --max-seconds 300 >/dev/null & \
+	BPID=$$!; \
+	trap 'kill -9 $$APID $$BPID 2>/dev/null || true' EXIT; \
+	JAX_PLATFORMS=cpu $(PY) -c \
+	"from parallel_heat_tpu.service import client; \
+	v = client.fleet_wait('.fleet_smoke/f', 'fleet-a', \
+	                      timeout_s=180); \
+	assert v.state == 'completed', v.state"; \
+	kill -TERM $$BPID; rc=0; wait $$BPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "host exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	JAX_PLATFORMS=cpu $(PY) tools/heatq.py .fleet_smoke/f --check; \
+	JAX_PLATFORMS=cpu $(PY) tools/slo_gate.py .fleet_smoke/f \
+	    --fleet 'stale_leases>0,quarantined>0,completed<1,jobs_adopted<1'; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .fleet_smoke/f \
+	    --json | \
+	$(PY) -c "import json,sys; d=json.load(sys.stdin); \
+	assert d['fleet']['completed'] >= 1, d['fleet']; \
+	assert d['fleet']['jobs_adopted'] >= 1, d['fleet']; \
+	assert d['fleet']['hosts_lost'] >= 1, d['fleet']"
+	rm -rf .fleet_smoke
 
 # Ensemble packing run-book as a gate (README "Ensemble"): daemon up
 # with --pack, 3 compatible jobs submitted WITHOUT --wait (so they

@@ -276,7 +276,9 @@ def _parse_mesh(arg: Optional[str], ndim: int, grid_shape=None,
 # Service subcommands forwarded to the heatd CLI: `python -m
 # parallel_heat_tpu serve/submit/status/cancel/drain ...` is the same
 # surface as the `heatd` console script (service/cli.py).
-_SERVICE_COMMANDS = ("serve", "submit", "status", "cancel", "drain")
+_SERVICE_COMMANDS = ("serve", "submit", "status", "cancel", "drain",
+                     "fleet-init", "fleet-serve", "fleet-submit",
+                     "fleet-status")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -12,6 +12,20 @@ Subcommands (also reachable as ``python -m parallel_heat_tpu serve
   ``tools/heatq.py`` is the richer inspector);
 - ``cancel``  request cancellation of a job;
 - ``drain``   SIGTERM the daemon named in the queue's status heartbeat.
+
+Federated subcommands (SEMANTICS.md "Fleet durability" — many heatds,
+one durable service over a shared fleet root):
+
+- ``fleet-init``    lay out a fleet root (queue partitions + lease/
+  host coordination dirs + the ``fleet.json`` marker);
+- ``fleet-serve``   run one federated host: claims partition leases,
+  steps one ordinary daemon per held partition, reclaims stale peers'
+  leases and adopts their in-flight jobs;
+- ``fleet-submit``  route one job across the fleet (exact peer-cache
+  hit > longest admissible checkpoint prefix > capacity > load) and
+  run the ordinary durable submit handshake on the chosen partition;
+- ``fleet-status``  federated snapshot: leases, hosts, per-partition
+  job counts (``tools/heatq.py <fleet-root> --check`` is the auditor).
 """
 
 from __future__ import annotations
@@ -127,6 +141,92 @@ def build_parser() -> argparse.ArgumentParser:
 
     sb = sub.add_parser("submit", help="enqueue one job")
     sb.add_argument("--queue", required=True, metavar="DIR")
+    _add_submit_flags(sb)
+
+    st = sub.add_parser("status", help="queue + daemon snapshot")
+    st.add_argument("--queue", required=True, metavar="DIR")
+    st.add_argument("--job", default=None, metavar="ID")
+    st.add_argument("--json", action="store_true")
+
+    ca = sub.add_parser("cancel", help="request job cancellation")
+    ca.add_argument("--queue", required=True, metavar="DIR")
+    ca.add_argument("job_id")
+
+    dr = sub.add_parser("drain", help="SIGTERM the serving daemon "
+                                      "(graceful drain)")
+    dr.add_argument("--queue", required=True, metavar="DIR")
+
+    fi = sub.add_parser("fleet-init",
+                        help="lay out a federated fleet root")
+    fi.add_argument("--fleet", required=True, metavar="DIR")
+    fi.add_argument("--partitions", type=int, default=2, metavar="N",
+                    help="queue partitions (each a full single-daemon "
+                         "queue root; a re-init can only grow the "
+                         "count — default 2)")
+    fi.add_argument("--lease-timeout", type=float, default=None,
+                    metavar="S",
+                    help="fleet default lease staleness threshold "
+                         "(hosts may override; default 10)")
+
+    fs = sub.add_parser("fleet-serve",
+                        help="run one federated host (leases, "
+                             "adoption, work stealing)")
+    fs.add_argument("--fleet", required=True, metavar="DIR")
+    fs.add_argument("--host", required=True, metavar="NAME",
+                    help="this host's fleet-unique name (lease files "
+                         "and journal lines carry it)")
+    fs.add_argument("--slots", type=int, default=2,
+                    help="concurrent workers PER PARTITION (default 2)")
+    fs.add_argument("--poll-interval", type=float, default=0.25,
+                    metavar="S")
+    fs.add_argument("--worker-heartbeat", type=float, default=0.5,
+                    metavar="S")
+    fs.add_argument("--heartbeat-timeout", type=float, default=3.0,
+                    metavar="S")
+    fs.add_argument("--lease-timeout", type=float, default=None,
+                    metavar="S",
+                    help="lease staleness threshold this host writes "
+                         "into its leases (default: fleet.json's)")
+    fs.add_argument("--lease-renew", type=float, default=None,
+                    metavar="S",
+                    help="lease renewal cadence (default: timeout/4)")
+    fs.add_argument("--max-partitions", type=int, default=None,
+                    metavar="N",
+                    help="most partitions to hold at once (default: "
+                         "all claimable)")
+    fs.add_argument("--platform", default="cpu",
+                    help="capacity record: accelerator platform tag "
+                         "(default cpu)")
+    fs.add_argument("--max-cells", type=int, default=None, metavar="N",
+                    help="capacity record: largest grid (cells) this "
+                         "host volunteers for — the router sends "
+                         "bigger meshes elsewhere (default: unbounded)")
+    fs.add_argument("--no-steal", action="store_true",
+                    help="disable work stealing (unleased backlog "
+                         "partitions are still claimed, just not "
+                         "counted as steals)")
+    fs.add_argument("--no-cache", action="store_true")
+    fs.add_argument("--max-seconds", type=float, default=None,
+                    metavar="S")
+
+    fb = sub.add_parser("fleet-submit",
+                        help="route one job across the fleet and "
+                             "enqueue it")
+    fb.add_argument("--fleet", required=True, metavar="DIR")
+    _add_submit_flags(fb)
+
+    ft = sub.add_parser("fleet-status", help="federated snapshot "
+                                             "(leases, hosts, "
+                                             "partitions)")
+    ft.add_argument("--fleet", required=True, metavar="DIR")
+    ft.add_argument("--json", action="store_true")
+    return ap
+
+
+def _add_submit_flags(sb: argparse.ArgumentParser) -> None:
+    """The submission surface, shared verbatim by ``submit`` (one
+    queue root) and ``fleet-submit`` (routed) — one flag vocabulary,
+    two targets."""
     sb.add_argument("--nx", type=int, default=20)
     sb.add_argument("--ny", type=int, default=20)
     sb.add_argument("--nz", type=int, default=None)
@@ -169,20 +269,6 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--wait: give up (exit 1) after S seconds")
     sb.add_argument("--quiet", action="store_true")
 
-    st = sub.add_parser("status", help="queue + daemon snapshot")
-    st.add_argument("--queue", required=True, metavar="DIR")
-    st.add_argument("--job", default=None, metavar="ID")
-    st.add_argument("--json", action="store_true")
-
-    ca = sub.add_parser("cancel", help="request job cancellation")
-    ca.add_argument("--queue", required=True, metavar="DIR")
-    ca.add_argument("job_id")
-
-    dr = sub.add_parser("drain", help="SIGTERM the serving daemon "
-                                      "(graceful drain)")
-    dr.add_argument("--queue", required=True, metavar="DIR")
-    return ap
-
 
 def _cmd_serve(args) -> int:
     from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
@@ -215,10 +301,9 @@ def _cmd_serve(args) -> int:
     return daemon.serve(max_seconds=args.max_seconds)
 
 
-def _cmd_submit(args) -> int:
-    from parallel_heat_tpu.service import client
-
-    say = (lambda *a: None) if args.quiet else print
+def _submit_payload(args):
+    """Shared submit/fleet-submit parse: flags -> ``(config, faults)``
+    or an int exit code on a malformed --spec/--faults."""
     config = {"nx": args.nx, "ny": args.ny, "nz": args.nz,
               "steps": args.steps, "converge": args.converge,
               "eps": args.eps, "check_interval": args.check_interval,
@@ -238,6 +323,46 @@ def _cmd_submit(args) -> int:
         except ValueError as e:
             print(f"error: bad --faults JSON: {e}", file=sys.stderr)
             return 2
+    return config, faults
+
+
+def _finish_submit(args, verdict, wait_fn, say) -> int:
+    """Shared verdict/wait/exit-code tail of both submit commands."""
+    jid = verdict["job_id"]
+    if not verdict["accepted"]:
+        retry = verdict.get("retry_after_s")
+        print(f"rejected: {verdict.get('reason')}"
+              + (f" — retry after {retry:.1f}s" if retry else ""),
+              file=sys.stderr)
+        return EXIT_REJECTED
+    say(f"accepted {jid}"
+        + (f" -> partition {verdict['partition']} "
+           f"({verdict['route']['kind']})"
+           if verdict.get("partition") else ""))
+    if not args.wait:
+        return 0
+    try:
+        v = wait_fn(jid)
+    except TimeoutError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    say(f"{jid}: {v.state}"
+        + (f" (steps_done={v.steps_done})"
+           if v.steps_done is not None else "")
+        + (f" kind={v.kind}" if v.kind else ""))
+    return {"completed": 0, "quarantined": EXIT_QUARANTINED,
+            "cancelled": EXIT_CANCELLED,
+            "deadline_expired": EXIT_DEADLINE}.get(v.state, 1)
+
+
+def _cmd_submit(args) -> int:
+    from parallel_heat_tpu.service import client
+
+    say = (lambda *a: None) if args.quiet else print
+    payload = _submit_payload(args)
+    if isinstance(payload, int):
+        return payload
+    config, faults = payload
     try:
         verdict = client.submit(
             args.queue, config, job_id=args.job_id,
@@ -252,28 +377,10 @@ def _cmd_submit(args) -> int:
     except ValueError as e:  # re-used --job-id
         print(f"error: {e}", file=sys.stderr)
         return 2
-    jid = verdict["job_id"]
-    if not verdict["accepted"]:
-        retry = verdict.get("retry_after_s")
-        print(f"rejected: {verdict.get('reason')}"
-              + (f" — retry after {retry:.1f}s" if retry else ""),
-              file=sys.stderr)
-        return EXIT_REJECTED
-    say(f"accepted {jid}")
-    if not args.wait:
-        return 0
-    try:
-        v = client.wait(args.queue, jid, timeout_s=args.timeout)
-    except TimeoutError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    say(f"{jid}: {v.state}"
-        + (f" (steps_done={v.steps_done})"
-           if v.steps_done is not None else "")
-        + (f" kind={v.kind}" if v.kind else ""))
-    return {"completed": 0, "quarantined": EXIT_QUARANTINED,
-            "cancelled": EXIT_CANCELLED,
-            "deadline_expired": EXIT_DEADLINE}.get(v.state, 1)
+    return _finish_submit(
+        args, verdict,
+        lambda jid: client.wait(args.queue, jid,
+                                timeout_s=args.timeout), say)
 
 
 def _cmd_status(args) -> int:
@@ -337,11 +444,110 @@ def _cmd_drain(args) -> int:
     return 0
 
 
+def _cmd_fleet_init(args) -> int:
+    from parallel_heat_tpu.service import fleet
+
+    try:
+        doc = fleet.fleet_init(args.fleet, partitions=args.partitions,
+                               lease_timeout_s=args.lease_timeout)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"fleet root {args.fleet}: {doc['partitions']} partition(s), "
+          f"lease timeout {doc['lease_timeout_s']:g}s")
+    return 0
+
+
+def _cmd_fleet_serve(args) -> int:
+    from parallel_heat_tpu.service.fleet import FleetHost, FleetHostConfig
+
+    cfg = FleetHostConfig(
+        fleet_root=args.fleet, host=args.host,
+        platform=args.platform, max_cells=args.max_cells,
+        lease_timeout_s=args.lease_timeout,
+        lease_renew_s=args.lease_renew,
+        max_partitions=args.max_partitions,
+        steal=not args.no_steal, slots=args.slots,
+        poll_interval_s=args.poll_interval,
+        daemon_opts={"worker_heartbeat_s": args.worker_heartbeat,
+                     "heartbeat_timeout_s": args.heartbeat_timeout,
+                     "cache_results": not args.no_cache})
+    try:
+        host = FleetHost(cfg)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"heatd fleet host {cfg.host!r} serving {args.fleet} "
+          f"(pid {os.getpid()}, {cfg.slots} slot(s)/partition); "
+          f"SIGTERM drains gracefully")
+    return host.serve(max_seconds=args.max_seconds)
+
+
+def _cmd_fleet_submit(args) -> int:
+    from parallel_heat_tpu.service import client
+
+    say = (lambda *a: None) if args.quiet else print
+    payload = _submit_payload(args)
+    if isinstance(payload, int):
+        return payload
+    config, faults = payload
+    try:
+        verdict = client.fleet_submit(
+            args.fleet, config, job_id=args.job_id,
+            deadline_s=args.deadline, max_retries=args.max_retries,
+            checkpoint_every=args.checkpoint_every,
+            guard_interval=args.guard_interval, faults=faults,
+            faults_on_attempt=args.faults_on_attempt,
+            accept_timeout_s=args.accept_timeout)
+    except (TimeoutError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:  # re-used --job-id, or not a fleet root
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return _finish_submit(
+        args, verdict,
+        lambda jid: client.fleet_wait(args.fleet, jid,
+                                      timeout_s=args.timeout), say)
+
+
+def _cmd_fleet_status(args) -> int:
+    from parallel_heat_tpu.service import fleet
+
+    try:
+        doc = fleet.fleet_status(args.fleet)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0
+    for name, h in doc["hosts"].items():
+        print(f"host {name}: {h.get('state')} "
+              f"platform={h.get('platform')} "
+              f"leases={','.join(h.get('leases') or []) or '-'}")
+    for p in doc["partitions"]:
+        holder = (f"{p['host']} e{p['lease_epoch']}"
+                  + (" STALE" if p["lease_stale"] else "")
+                  if p["host"] else "unleased")
+        counts = " ".join(f"{k}={v}" for k, v in
+                          sorted(p["counts"].items()))
+        print(f"  {p['partition']}: {holder} jobs={p['jobs']}"
+              + (f" {counts}" if counts else "")
+              + (f" ANOMALIES={p['anomalies']}"
+                 if p["anomalies"] else ""))
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"serve": _cmd_serve, "submit": _cmd_submit,
             "status": _cmd_status, "cancel": _cmd_cancel,
-            "drain": _cmd_drain}[args.cmd](args)
+            "drain": _cmd_drain, "fleet-init": _cmd_fleet_init,
+            "fleet-serve": _cmd_fleet_serve,
+            "fleet-submit": _cmd_fleet_submit,
+            "fleet-status": _cmd_fleet_status}[args.cmd](args)
 
 
 if __name__ == "__main__":

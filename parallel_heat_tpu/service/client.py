@@ -59,6 +59,7 @@ def submit(root: str, config, *, job_id: Optional[str] = None,
            backoff_base_s: float = 0.5,
            faults: Optional[dict] = None, faults_on_attempt: int = 1,
            accept_timeout_s: float = 15.0, poll_s: float = 0.1,
+           route: Optional[dict] = None,
            clock=time.time, sleep_fn=time.sleep) -> dict:
     """Submit one job; block until the daemon's admission verdict.
 
@@ -93,7 +94,7 @@ def submit(root: str, config, *, job_id: Optional[str] = None,
                    backoff_base_s=backoff_base_s,
                    submitted_t=clock(), faults=faults,
                    faults_on_attempt=faults_on_attempt,
-                   trace=trace.to_dict())
+                   trace=trace.to_dict(), route=route)
     store.spool_submit(spec)
     deadline = clock() + accept_timeout_s
     while True:
@@ -165,3 +166,75 @@ def status(root: str,
 
 def _view_dict(v: Union[JobView, dict]) -> dict:
     return asdict(v) if isinstance(v, JobView) else dict(v)
+
+
+# ---------------------------------------------------------------------------
+# Federated entry points (SEMANTICS.md "Fleet durability"): the same
+# file-based handshake against a FLEET root — the router picks the
+# partition, the spool record carries the routing provenance, and the
+# partition's lease holder answers through that partition's journal.
+# ---------------------------------------------------------------------------
+
+def fleet_submit(fleet_root: str, config, *,
+                 job_id: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 max_retries: int = 3,
+                 checkpoint_every: Optional[int] = None,
+                 guard_interval: Optional[int] = None,
+                 backoff_base_s: float = 0.5,
+                 faults: Optional[dict] = None,
+                 faults_on_attempt: int = 1,
+                 accept_timeout_s: float = 15.0, poll_s: float = 0.1,
+                 clock=time.time, sleep_fn=time.sleep) -> dict:
+    """Route one submission across the fleet
+    (:func:`~parallel_heat_tpu.service.fleet.route_submission`:
+    exact peer-cache hit > longest admissible checkpoint prefix >
+    capacity fit > least loaded), then run the ordinary durable
+    submit handshake against the chosen partition. The returned
+    verdict adds ``partition`` and ``route`` (the decision, also
+    journaled on the ``accepted`` line)."""
+    from parallel_heat_tpu.service.fleet import route_submission
+
+    decision = route_submission(fleet_root, _spec_config(config),
+                                now=clock())
+    route = {k: decision[k] for k in ("kind", "partition",
+                                      "donor_key", "gen_step")}
+    verdict = submit(decision["root"], config, job_id=job_id,
+                     deadline_s=deadline_s, max_retries=max_retries,
+                     checkpoint_every=checkpoint_every,
+                     guard_interval=guard_interval,
+                     backoff_base_s=backoff_base_s, faults=faults,
+                     faults_on_attempt=faults_on_attempt,
+                     accept_timeout_s=accept_timeout_s, poll_s=poll_s,
+                     route=route, clock=clock, sleep_fn=sleep_fn)
+    verdict["partition"] = decision["partition"]
+    verdict["route"] = route
+    return verdict
+
+
+def _locate(fleet_root: str, job_id: str) -> str:
+    from parallel_heat_tpu.service.fleet import find_job
+
+    hit = find_job(fleet_root, job_id)
+    if hit is None:
+        raise KeyError(f"job {job_id!r} is on no partition under "
+                       f"fleet root {fleet_root!r}")
+    return hit[1]
+
+
+def fleet_wait(fleet_root: str, job_id: str,
+               timeout_s: Optional[float] = None, poll_s: float = 0.25,
+               clock=time.time, sleep_fn=time.sleep) -> JobView:
+    """Fleet-level :func:`wait`: locate the job's partition, then poll
+    that partition's journal. Adoption keeps a job on its partition —
+    the waiting client never needs to re-route mid-wait."""
+    return wait(_locate(fleet_root, job_id), job_id,
+                timeout_s=timeout_s, poll_s=poll_s, clock=clock,
+                sleep_fn=sleep_fn)
+
+
+def fleet_cancel(fleet_root: str, job_id: str) -> bool:
+    try:
+        return cancel(_locate(fleet_root, job_id), job_id)
+    except KeyError:
+        return False

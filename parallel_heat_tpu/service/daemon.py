@@ -84,6 +84,12 @@ class HeatdConfig:
     ``SupervisorPolicy.sleep_fn``."""
 
     root: str
+    # Fleet host identity (service/fleet.py): set by FleetHost on its
+    # per-partition daemons so EVERY journal line this daemon appends
+    # carries a `host` field — the attribution the federated audit
+    # (cross-host double-dispatch) and per-host metrics rows fold on.
+    # None = a plain single-host daemon (lines stay host-less).
+    host: Optional[str] = None
     # Concurrent worker processes (one job each).
     slots: int = 2
     poll_interval_s: float = 0.25
@@ -250,6 +256,10 @@ class Heatd:
         self._journal_offset = 0
         self._jobs: Dict[str, JobView] = {}
         self._anomalies: list = []
+        if self.config.host is not None:
+            # Federated identity: stamp the host on every append (the
+            # journal envelope, not per call site).
+            self.store.journal.extra = {"host": self.config.host}
         self.store.journal.append("daemon_start", pid=os.getpid(),
                                   slots=self.config.slots)
 
@@ -529,7 +539,9 @@ class Heatd:
                         "accepted", job_id=jid,
                         deadline_s=spec.deadline_s, hbm_bytes=0,
                         submitted_t=spec.submitted_t,
-                        trace_id=(spec.trace or {}).get("trace_id"))]
+                        trace_id=(spec.trace or {}).get("trace_id"),
+                        **({"route": spec.route} if spec.route
+                           else {}))]
                     self._fold(recs)
                     self._cache_serve(
                         jid, hit,
@@ -564,7 +576,9 @@ class Heatd:
             rec = j.append("accepted", job_id=jid,
                            deadline_s=spec.deadline_s, hbm_bytes=est,
                            submitted_t=spec.submitted_t,
-                           trace_id=(spec.trace or {}).get("trace_id"))
+                           trace_id=(spec.trace or {}).get("trace_id"),
+                           **({"route": spec.route} if spec.route
+                              else {}))
             # Fold the acceptance into the cached view by hand so the
             # NEXT spool entry's gate sees this job as active without
             # re-reading the journal (the incremental fold will skip
@@ -1107,6 +1121,24 @@ class Heatd:
         self._publish_status(cfg.clock())
         self.close()
         return EXIT_PREEMPTED
+
+    def abandon(self) -> None:
+        """Lost-lease teardown (service/fleet.py): the partition now
+        belongs to a peer, so this daemon must stop WITHOUT journaling
+        — it no longer owns the journal (the single-writer-per-
+        partition invariant is exactly this stop). SIGKILL our workers
+        (the adopting host's re-dispatches own the checkpoint stems
+        now; the stem lock would fence a straggler anyway, but a split
+        brain must not keep computing) and release the handles."""
+        for handle in self._procs.values():
+            try:
+                handle.kill()
+            except OSError:
+                pass
+        self._procs.clear()
+        self._term_sent.clear()
+        self._term_pid.clear()
+        self.close()
 
     def close(self) -> None:
         """Release the daemon's journal handles — store AND cache

@@ -1,0 +1,1034 @@
+"""Fleet federation: many heatds, one durable service.
+
+One shared **fleet root** holds N queue *partitions*, each a complete
+single-daemon queue root (its own ``journal.jsonl``, spool, job
+records, checkpoints, cache index). Hosts — :class:`FleetHost`
+processes, each wrapping one ordinary :class:`Heatd` per partition it
+owns — coordinate through exactly two kinds of rename-committed files,
+never through the journals:
+
+- **Lease files** (``leases/<partition>.json``): a host may write a
+  partition's journal iff it holds the partition's lease. Claims are
+  *link-committed* (``os.link`` of a private temp onto the lease path
+  — EEXIST means somebody else won); takeovers of a stale lease are
+  *rename-committed* (exactly one of the racing hosts succeeds in
+  renaming the old lease file away; the loser's rename raises ENOENT).
+  The holder re-writes the lease at ``lease_renew_s`` cadence; a lease
+  older than its recorded ``timeout_s`` is stale and reclaimable.
+  This keeps every partition journal SINGLE-WRITER, so the pure-fold
+  discipline of :func:`~parallel_heat_tpu.service.store.reduce_journal`
+  — and every durability proof built on it — is untouched by
+  federation.
+- **Host records** (``hosts/<host>.json``): each host's journaled
+  capacity/liveness record (platform, ``max_cells``, slots, held
+  leases, adoption/steal counters). The router reads these for
+  heterogeneous admission — a CPU host absorbs small grids while big
+  meshes go to hosts whose declared capacity fits them.
+
+**Cross-host orphan takeover** (the federated half of "no accepted job
+is ever silently lost"): a host whose lease heartbeat goes stale has
+its leases reclaimed by a peer, which journals ``host_lost`` plus one
+``adopted`` line per in-flight job and then just *steps* the partition
+— the single-host reconcile/orphan/requeue machinery re-dispatches
+each adopted job, the worker's resume-before-run picks up the newest
+committed checkpoint generation, and the completed grid is bitwise an
+uninterrupted run's (the PR-2/PR-10/PR-13 resume-parity contracts;
+re-certified across hosts by the ``fleet_host_sigkill`` chaos cell).
+
+**Work stealing**: an idle host claims the oldest unleased partition
+with backlog (spooled or queued jobs) — journaled as a
+``lease_claimed`` line with ``kind="steal"``.
+
+**Cache-aware routing** (:func:`route_submission`): the router folds
+every partition's ``cache/index.jsonl`` and scores it with the same
+pure admissibility functions the daemon serves from —
+:func:`~parallel_heat_tpu.service.cache.lookup_exact` first (an exact
+peer hit routes to the donor's partition, where admission serves the
+verdict with ZERO dispatches fleet-wide), then
+:func:`~parallel_heat_tpu.service.cache.lookup_prefix` (the submission
+goes to the host holding the longest admissible checkpoint prefix for
+its key), then capacity-filtered least-loaded placement. The decision
+rides the spool record (``JobSpec.route``) so the journal's
+``accepted`` line carries the routing provenance metrics_report and
+slo_gate gate on.
+
+``tools/heatq.py --check`` audits the federated invariants
+(:func:`audit_fleet`): stale-lease inventory, cross-host double-claim
+/ double-dispatch detection, and adopted-job lineage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.service.cache import (
+    load_cache_index,
+    lookup_exact,
+    lookup_prefix,
+)
+from parallel_heat_tpu.service.daemon import Heatd, HeatdConfig
+from parallel_heat_tpu.service.store import (
+    JobStore,
+    read_journal_file,
+    reduce_journal,
+)
+from parallel_heat_tpu.supervisor import EXIT_PREEMPTED
+
+FLEET_MARKER = "fleet.json"
+FLEET_SCHEMA_VERSION = 1
+# Default staleness threshold: several renew cadences, same rationale
+# as worker heartbeats — one missed renewal is scheduling noise.
+DEFAULT_LEASE_TIMEOUT_S = 10.0
+
+
+class FleetError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Fleet root layout
+# ---------------------------------------------------------------------------
+
+def fleet_marker_path(root) -> str:
+    return os.path.join(str(root), FLEET_MARKER)
+
+
+def is_fleet_root(root) -> bool:
+    """A directory is a federated root iff it carries the rename-
+    committed ``fleet.json`` marker (heatq/metrics/slo_gate dispatch
+    on this — a plain queue root keeps its single-daemon view)."""
+    return os.path.isfile(fleet_marker_path(root))
+
+
+def fleet_init(root, partitions: int = 2,
+               lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+               clock: Callable[[], float] = time.time) -> dict:
+    """Create (or re-open) a fleet root: ``parts/p00..`` queue
+    partitions + the ``leases/`` and ``hosts/`` coordination dirs +
+    the ``fleet.json`` marker (rename-committed last — a crash mid-init
+    leaves directories no reader mistakes for a fleet)."""
+    if partitions < 1:
+        raise ValueError(f"partitions must be >= 1, got {partitions}")
+    if lease_timeout_s <= 0:
+        raise ValueError(f"lease_timeout_s must be > 0, got "
+                         f"{lease_timeout_s}")
+    root = str(root)
+    existing = fleet_doc(root) if is_fleet_root(root) else None
+    if existing is not None:
+        # Idempotent re-init: partition count can only grow (jobs may
+        # already live in the existing partitions).
+        partitions = max(partitions, int(existing.get("partitions", 0)))
+    os.makedirs(os.path.join(root, "leases"), exist_ok=True)
+    os.makedirs(os.path.join(root, "hosts"), exist_ok=True)
+    for i in range(partitions):
+        JobStore(os.path.join(root, "parts", f"p{i:02d}")).close()
+    doc = {"schema": FLEET_SCHEMA_VERSION, "partitions": partitions,
+           "lease_timeout_s": float(lease_timeout_s),
+           "created_t": (existing or {}).get("created_t", clock())}
+    _write_json_atomic(fleet_marker_path(root), doc)
+    return doc
+
+
+def fleet_doc(root) -> dict:
+    doc = _read_json(fleet_marker_path(root))
+    if not isinstance(doc, dict):
+        raise FleetError(f"{root}: not a fleet root (no readable "
+                         f"{FLEET_MARKER} — run `heatd fleet-init`)")
+    return doc
+
+
+def partition_roots(root) -> List[Tuple[str, str]]:
+    """Sorted ``(name, path)`` of every partition under the root —
+    discovery by directory scan so a grown fleet needs no marker
+    rewrite to be visible."""
+    parts_dir = os.path.join(str(root), "parts")
+    try:
+        names = sorted(n for n in os.listdir(parts_dir)
+                       if not n.startswith(".")
+                       and os.path.isdir(os.path.join(parts_dir, n)))
+    except OSError:
+        names = []
+    return [(n, os.path.join(parts_dir, n)) for n in names]
+
+
+def partition_root(root, name: str) -> str:
+    return os.path.join(str(root), "parts", name)
+
+
+def _write_json_atomic(path: str, doc: dict) -> str:
+    """Rename-commit (the checkpoint protocol's discipline): dotted
+    temp name no discovery scan matches, fsync, atomic replace."""
+    d, base = os.path.split(path)
+    tmp = os.path.join(d, f".{base}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Lease files: link-committed claims, rename-committed takeovers
+# ---------------------------------------------------------------------------
+
+def lease_path(root, part: str) -> str:
+    return os.path.join(str(root), "leases", f"{part}.json")
+
+
+def read_lease(root, part: str) -> Optional[dict]:
+    return _read_json(lease_path(root, part))
+
+
+def list_leases(root) -> Dict[str, dict]:
+    """``partition -> lease doc`` for every committed lease file
+    (dotted temp/steal residue is invisible by construction)."""
+    d = os.path.join(str(root), "leases")
+    out: Dict[str, dict] = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in sorted(names):
+        if n.startswith(".") or not n.endswith(".json"):
+            continue
+        doc = _read_json(os.path.join(d, n))
+        if isinstance(doc, dict):
+            out[n[:-len(".json")]] = doc
+    return out
+
+
+def lease_stale(doc: dict, now: float) -> bool:
+    """Stale = the holder missed its renewals past the lease's own
+    recorded timeout (each lease declares its threshold, so auditors
+    and thieves judge by the holder's contract, not their own)."""
+    t = doc.get("t_wall")
+    timeout = doc.get("timeout_s") or DEFAULT_LEASE_TIMEOUT_S
+    return not isinstance(t, (int, float)) or now - t > timeout
+
+
+def _lease_doc(part: str, host: str, epoch: int, timeout_s: float,
+               now: float, pid: Optional[int]) -> dict:
+    return {"partition": part, "host": host, "epoch": int(epoch),
+            "t_wall": now, "timeout_s": float(timeout_s),
+            "pid": pid if pid is not None else os.getpid()}
+
+
+def _link_commit(root, part: str, doc: dict) -> bool:
+    """Create-if-absent commit: write a private temp, ``os.link`` it
+    onto the lease path. EEXIST = a racer won; any outcome but a clean
+    link is a loss. The temp is always unlinked."""
+    dst = lease_path(root, part)
+    d = os.path.dirname(dst)
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(
+        d, f".{part}.claim.{doc['host']}.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, dst)
+        return True
+    except OSError:
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def claim_lease(root, part: str, host: str, *, epoch: int,
+                timeout_s: float, now: Optional[float] = None,
+                pid: Optional[int] = None) -> Optional[dict]:
+    """Claim an UNLEASED partition. Returns the committed lease doc,
+    or None when another host's link landed first (exactly one
+    claimant ever wins — the link is the commit point)."""
+    now = time.time() if now is None else now
+    doc = _lease_doc(part, host, epoch, timeout_s, now, pid)
+    return doc if _link_commit(root, part, doc) else None
+
+
+def steal_lease(root, part: str, observed: dict, host: str, *,
+                timeout_s: float, now: Optional[float] = None,
+                pid: Optional[int] = None) -> Optional[dict]:
+    """Take over a STALE lease. The commit point is renaming the old
+    lease file to a thief-private dotted name: of N hosts that judged
+    the same lease stale, exactly one rename succeeds (the others get
+    ENOENT) — zero double-claims by construction, which is what the
+    ``fleet_lease_race`` chaos cell certifies. The winner then
+    link-commits its own lease at ``observed["epoch"] + 1``.
+
+    If the stolen bytes show the holder renewed between our staleness
+    read and the rename (a near-miss on a live host), the steal is
+    rolled back: the file is restored by link (or abandoned to the
+    holder's next renewal-failure if a third host claimed meanwhile)
+    and None is returned."""
+    now = time.time() if now is None else now
+    src = lease_path(root, part)
+    stale = os.path.join(
+        os.path.dirname(src),
+        f".{part}.stale.e{int(observed.get('epoch') or 0)}.{host}."
+        f"{os.getpid()}")
+    try:
+        os.rename(src, stale)
+    except OSError:
+        return None  # another thief won the rename (or holder released)
+    try:
+        stolen = _read_json(stale)
+        if (isinstance(stolen, dict)
+                and stolen.get("t_wall") != observed.get("t_wall")
+                and not lease_stale(stolen, now)):
+            # The holder renewed under our feet: not actually dead.
+            # Put the live lease back (best effort — see docstring).
+            try:
+                os.link(stale, src)
+            except OSError:
+                pass
+            return None
+        epoch = int(observed.get("epoch") or 0) + 1
+        doc = _lease_doc(part, host, epoch, timeout_s, now, pid)
+        if _link_commit(root, part, doc):
+            return doc
+        return None
+    finally:
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+
+
+def renew_lease(root, part: str, host: str, epoch: int,
+                now: Optional[float] = None) -> Optional[dict]:
+    """Heartbeat-renew a held lease. Returns the fresh doc, or None
+    when the lease is no longer ours (vanished, different host, or a
+    different epoch) — the holder must then STOP writing the
+    partition's journal and abandon its daemon immediately; a peer
+    owns it now."""
+    now = time.time() if now is None else now
+    cur = read_lease(root, part)
+    if not isinstance(cur, dict) or cur.get("host") != host \
+            or int(cur.get("epoch") or -1) != int(epoch):
+        return None
+    doc = dict(cur)
+    doc["t_wall"] = now
+    _write_json_atomic(lease_path(root, part), doc)
+    return doc
+
+
+def release_lease(root, part: str, host: str, epoch: int) -> bool:
+    """Graceful-drain release: unlink the lease iff still ours."""
+    cur = read_lease(root, part)
+    if not isinstance(cur, dict) or cur.get("host") != host \
+            or int(cur.get("epoch") or -1) != int(epoch):
+        return False
+    try:
+        os.unlink(lease_path(root, part))
+    except OSError:
+        return False
+    return True
+
+
+def journal_lease_epoch(part_root: str) -> int:
+    """Newest lease epoch the partition's journal has ever recorded
+    (0 = never claimed). The journal is the durable monotone record —
+    a fresh claim after a graceful release (lease file gone) continues
+    the epoch chain from here, so the auditor's strictly-increasing
+    epoch invariant survives release/re-claim cycles."""
+    events, _bad, _torn = read_journal_file(
+        os.path.join(part_root, "journal.jsonl"))
+    epoch = 0
+    for e in events:
+        if e.get("event") in ("lease_claimed", "host_lost"):
+            try:
+                epoch = max(epoch, int(e.get("epoch") or 0))
+            except (TypeError, ValueError):
+                continue
+    return epoch
+
+
+# ---------------------------------------------------------------------------
+# Host capacity records (heterogeneous admission)
+# ---------------------------------------------------------------------------
+
+def host_record_path(root, host: str) -> str:
+    return os.path.join(str(root), "hosts", f"{host}.json")
+
+
+def write_host_record(root, doc: dict) -> str:
+    d = os.path.join(str(root), "hosts")
+    os.makedirs(d, exist_ok=True)
+    return _write_json_atomic(
+        os.path.join(d, f"{doc['host']}.json"), doc)
+
+
+def read_host_records(root) -> Dict[str, dict]:
+    d = os.path.join(str(root), "hosts")
+    out: Dict[str, dict] = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in sorted(names):
+        if n.startswith(".") or not n.endswith(".json"):
+            continue
+        doc = _read_json(os.path.join(d, n))
+        if isinstance(doc, dict) and doc.get("host"):
+            out[doc["host"]] = doc
+    return out
+
+
+def host_record_fresh(doc: dict, now: float) -> bool:
+    """A capacity record is believable while younger than its own
+    declared ``ttl_s`` (written as several renew cadences) — the same
+    self-describing staleness rule lease files use."""
+    t = doc.get("t_wall")
+    ttl = doc.get("ttl_s") or (4 * DEFAULT_LEASE_TIMEOUT_S)
+    return isinstance(t, (int, float)) and now - t <= ttl
+
+
+def grid_cells(config: dict) -> int:
+    """Grid size in cells — the router's capacity currency (matches
+    the admission gate's HBM estimate up to the per-cell constant)."""
+    try:
+        nx = int(config.get("nx") or 0)
+        ny = int(config.get("ny") or 0)
+        nz = config.get("nz")
+        return max(nx, 1) * max(ny, 1) * (int(nz) if nz else 1)
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Cache-aware routing
+# ---------------------------------------------------------------------------
+
+def _partition_load(part_root: str) -> int:
+    """Cheap queue-depth proxy: committed spool entries + the daemon
+    status heartbeat's queued/running counts (no journal fold — the
+    router must stay O(partitions), not O(history))."""
+    load = 0
+    try:
+        load += sum(1 for n in os.listdir(os.path.join(part_root,
+                                                       "spool"))
+                    if not n.startswith("."))
+    except OSError:
+        pass
+    status = _read_json(os.path.join(part_root, "heatd.json"))
+    if isinstance(status, dict):
+        counts = status.get("counts") or {}
+        load += int(counts.get("queued") or 0)
+        load += int(counts.get("running") or 0)
+    return load
+
+
+def route_submission(fleet_root, config: dict,
+                     now: Optional[float] = None) -> dict:
+    """Pick the partition one submission should spool into.
+
+    Scoring, in strict priority order (every cache consult is the
+    pure admissibility matrix from ``service/cache.py`` over that
+    partition's folded ``cache/index.jsonl`` — the router never
+    invents its own reuse rule):
+
+    1. ``exact``   — some partition's cache serves this spec outright
+       (:func:`lookup_exact`): route there; admission completes it
+       with zero dispatches fleet-wide.
+    2. ``prefix``  — route to the partition holding the LONGEST
+       admissible checkpoint prefix (:func:`lookup_prefix`, max
+       generation step wins; ties break to the lower partition name).
+    3. ``capacity`` — heterogeneous admission: restrict to partitions
+       leased by fresh hosts whose ``max_cells`` fits the grid (when
+       that filter actually excludes someone), then least-loaded.
+    4. ``load``    — least-loaded partition, ties to the lowest name
+       (deterministic: the same fleet state routes the same way).
+
+    Returns ``{"partition", "root", "kind", "host", "donor_key",
+    "gen_step"}`` (``host`` = the target partition's current lease
+    holder, None when unleased — a spooled submission waits for work
+    stealing to pick the partition up)."""
+    now = time.time() if now is None else now
+    parts = partition_roots(fleet_root)
+    if not parts:
+        raise FleetError(f"{fleet_root}: no partitions — run "
+                         f"`heatd fleet-init`")
+    leases = list_leases(fleet_root)
+
+    def holder(name):
+        doc = leases.get(name)
+        return doc.get("host") if isinstance(doc, dict) \
+            and not lease_stale(doc, now) else None
+
+    def decision(name, proot, kind, donor=None, gen=None):
+        return {"partition": name, "root": proot, "kind": kind,
+                "host": holder(name),
+                "donor_key": donor, "gen_step": gen}
+
+    best_prefix = None  # (gen_step, name, proot, donor_key)
+    for name, proot in parts:
+        entries, _anoms, _bad, _torn = load_cache_index(proot)
+        if not entries:
+            continue
+        hit = lookup_exact(entries, config)
+        if hit is not None:
+            return decision(name, proot, "exact",
+                            donor=hit[0].get("key"))
+        pre = lookup_prefix(entries, config)
+        if pre is not None:
+            gen = pre[1]
+            if best_prefix is None or gen > best_prefix[0]:
+                best_prefix = (gen, name, proot, pre[0].get("key"))
+    if best_prefix is not None:
+        gen, name, proot, donor = best_prefix
+        return decision(name, proot, "prefix", donor=donor, gen=gen)
+
+    # Capacity filter (heterogeneous admission): only bite when fresh
+    # host records exist AND the fit test actually excludes somebody —
+    # a homogeneous (or record-less) fleet falls through to pure load.
+    hosts = {h: d for h, d in read_host_records(fleet_root).items()
+             if host_record_fresh(d, now)}
+    cells = grid_cells(config)
+    kind = "load"
+    candidates = parts
+    if hosts:
+        fits = {h for h, d in hosts.items()
+                if d.get("max_cells") is None
+                or cells <= int(d["max_cells"])}
+        if fits and fits != set(hosts):
+            fitted = [(n, p) for n, p in parts if holder(n) in fits]
+            if fitted:
+                candidates = fitted
+                kind = "capacity"
+    name, proot = min(candidates,
+                      key=lambda np: (_partition_load(np[1]), np[0]))
+    return decision(name, proot, kind)
+
+
+def find_job(fleet_root, job_id: str) -> Optional[Tuple[str, str]]:
+    """Locate a job's partition -> ``(name, root)``: committed job
+    record or spool entry first (O(1)), journal fold as the fallback
+    (a crash between the ``accepted`` append and the record commit is
+    visible only there)."""
+    for name, proot in partition_roots(fleet_root):
+        store = JobStore(proot, create=False)
+        if os.path.isfile(store.job_record_path(job_id)) \
+                or os.path.isfile(store.spool_path(job_id)):
+            return name, proot
+    for name, proot in partition_roots(fleet_root):
+        jobs, _ = JobStore(proot, create=False).replay()
+        if job_id in jobs:
+            return name, proot
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Federated audit (tools/heatq.py --check)
+# ---------------------------------------------------------------------------
+
+def audit_fleet(fleet_root, now: Optional[float] = None
+                ) -> Tuple[dict, List[str]]:
+    """Federation-level durability audit -> ``(info, anomalies)``.
+
+    - **stale-lease inventory**: a lease past its own timeout means a
+      host died and no peer has reclaimed it yet — jobs there are
+      stranded; always an anomaly (a drained host RELEASES, it never
+      abandons);
+    - **cross-host double-claim**: per partition journal, the
+      ``lease_claimed``/``host_lost`` epoch chain must be strictly
+      increasing, and the on-disk lease may never be BEHIND the
+      journal's newest epoch (two live writers would interleave
+      exactly this way);
+    - **cross-host double-dispatch**: a ``dispatched`` line for a job
+      already running with no intervening failure/requeue/terminal —
+      the split-brain signature the lease protocol exists to prevent;
+    - **adopted-job lineage**: every ``adopted`` line must follow a
+      ``host_lost`` of the same epoch, be appended by that epoch's
+      claimant, and name a job that was live at that point.
+    """
+    now = time.time() if now is None else now
+    anomalies: List[str] = []
+    leases = list_leases(fleet_root)
+    part_names = {n for n, _ in partition_roots(fleet_root)}
+    stale = []
+    for part, doc in leases.items():
+        if part not in part_names:
+            anomalies.append(
+                f"lease {part!r} names no partition under parts/")
+        if lease_stale(doc, now):
+            age = now - (doc.get("t_wall") or 0)
+            stale.append({"partition": part, "host": doc.get("host"),
+                          "age_s": round(age, 3),
+                          "timeout_s": doc.get("timeout_s")})
+            anomalies.append(
+                f"{part}: stale lease held by "
+                f"{doc.get('host')!r} (age {age:.1f}s > timeout "
+                f"{doc.get('timeout_s')}s) — host lost and not yet "
+                f"reclaimed by any peer")
+
+    claims_total = 0
+    adopted_total = 0
+    for part, proot in partition_roots(fleet_root):
+        events, _bad, _torn = read_journal_file(
+            os.path.join(proot, "journal.jsonl"))
+        last_epoch = 0
+        epoch_host: Dict[int, str] = {}
+        lost_epochs = set()
+        running: Dict[str, Optional[str]] = {}  # job -> dispatch host
+        jobs_state: Dict[str, str] = {}
+        for e in events:
+            ev = e.get("event")
+            jid = e.get("job_id")
+            if ev in ("lease_claimed", "host_lost"):
+                try:
+                    epoch = int(e.get("epoch") or 0)
+                except (TypeError, ValueError):
+                    continue
+                if ev == "lease_claimed":
+                    claims_total += 1
+                    if epoch <= last_epoch and last_epoch:
+                        anomalies.append(
+                            f"{part}: lease epoch regression — "
+                            f"claimed epoch {epoch} after epoch "
+                            f"{last_epoch} (cross-host double-claim)")
+                    epoch_host[epoch] = e.get("host")
+                    last_epoch = max(last_epoch, epoch)
+                else:
+                    lost_epochs.add(epoch)
+                    last_epoch = max(last_epoch, epoch)
+                continue
+            if jid is None:
+                continue
+            if ev == "adopted":
+                adopted_total += 1
+                try:
+                    epoch = int(e.get("epoch") or 0)
+                except (TypeError, ValueError):
+                    epoch = 0
+                if epoch not in lost_epochs:
+                    anomalies.append(
+                        f"{part}: {jid}: adopted at epoch {epoch} "
+                        f"with no matching host_lost line (broken "
+                        f"adoption lineage)")
+                claimant = epoch_host.get(epoch)
+                if claimant is not None \
+                        and e.get("host") != claimant:
+                    anomalies.append(
+                        f"{part}: {jid}: adopted by "
+                        f"{e.get('host')!r} but epoch {epoch} was "
+                        f"claimed by {claimant!r}")
+                if jobs_state.get(jid) in (None, "completed",
+                                           "quarantined", "cancelled",
+                                           "deadline_expired"):
+                    anomalies.append(
+                        f"{part}: {jid}: adopted while "
+                        f"{jobs_state.get(jid) or 'unknown'} — only "
+                        f"live jobs are adoptable")
+                continue
+            if ev == "accepted":
+                jobs_state[jid] = "queued"
+            elif ev == "dispatched":
+                if running.get(jid) is not None:
+                    anomalies.append(
+                        f"{part}: {jid}: dispatched by host "
+                        f"{e.get('host')!r} while already running "
+                        f"under host {running[jid]!r} (double "
+                        f"dispatch)")
+                running[jid] = e.get("host") or "?"
+                jobs_state[jid] = "running"
+            elif ev in ("worker_failed", "orphaned", "requeued"):
+                running[jid] = None
+                jobs_state[jid] = ("queued" if ev == "requeued"
+                                   else "failed")
+            elif ev in ("completed", "quarantined", "cancelled",
+                        "deadline_expired", "rejected"):
+                running[jid] = None
+                jobs_state[jid] = ev
+        disk = leases.get(part)
+        if isinstance(disk, dict) \
+                and int(disk.get("epoch") or 0) < last_epoch:
+            anomalies.append(
+                f"{part}: on-disk lease epoch "
+                f"{disk.get('epoch')} is behind the journal's newest "
+                f"epoch {last_epoch} (a stale claimant still holds "
+                f"the file — double-claim window)")
+    info = {"partitions": sorted(part_names),
+            "leases": leases, "stale_leases": stale,
+            "hosts": read_host_records(fleet_root),
+            "lease_claims": claims_total,
+            "jobs_adopted": adopted_total}
+    return info, anomalies
+
+
+# ---------------------------------------------------------------------------
+# FleetHost: one process, many leased partitions, each a plain Heatd
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetHostConfig:
+    """One federated host's knobs. Everything below ``daemon_opts``
+    parameterizes the PER-PARTITION ``HeatdConfig`` (the fleet layer
+    adds no scheduler of its own — it only decides which partitions
+    this host may step)."""
+
+    fleet_root: str
+    host: str
+    # Capacity record fields (heterogeneous admission): max_cells is
+    # the largest grid this host volunteers for (None = unbounded —
+    # the TPU-class host); the router filters on it.
+    platform: str = "cpu"
+    max_cells: Optional[int] = None
+    # Lease protocol: None timeout = the fleet.json default; renewal
+    # defaults to a quarter of the timeout (several missable beats).
+    lease_timeout_s: Optional[float] = None
+    lease_renew_s: Optional[float] = None
+    # Most partitions this host will hold at once (None = all of
+    # them); work stealing stays inside the same bound.
+    max_partitions: Optional[int] = None
+    steal: bool = True
+    slots: int = 2
+    poll_interval_s: float = 0.25
+    clock: Callable[[], float] = field(default=time.time)
+    sleep_fn: Callable[[float], None] = field(default=time.sleep)
+    # Extra HeatdConfig kwargs applied to every partition daemon
+    # (tests inject launcher/worker_env/heartbeat knobs here).
+    daemon_opts: Optional[dict] = None
+
+    def validate(self) -> "FleetHostConfig":
+        if not self.host or "/" in self.host or self.host.startswith("."):
+            raise ValueError(f"host must be a plain name, got "
+                             f"{self.host!r}")
+        if self.max_partitions is not None and self.max_partitions < 1:
+            raise ValueError(f"max_partitions must be >= 1, got "
+                             f"{self.max_partitions}")
+        if self.lease_timeout_s is not None \
+                and self.lease_timeout_s <= 0:
+            raise ValueError("lease_timeout_s must be > 0")
+        if self.lease_renew_s is not None and self.lease_renew_s <= 0:
+            raise ValueError("lease_renew_s must be > 0")
+        return self
+
+
+class FleetHost:
+    """One federated heatd host: claims partition leases, steps one
+    ordinary :class:`Heatd` per held partition, renews its leases,
+    reclaims stale peers' leases (adopting their in-flight jobs), and
+    publishes its capacity record. Single-threaded like the daemon it
+    wraps: every cross-host decision is a rename/link commit, every
+    journal write happens under a held lease."""
+
+    def __init__(self, config: FleetHostConfig):
+        self.config = config.validate()
+        fdoc = fleet_doc(config.fleet_root)
+        self.lease_timeout_s = float(
+            config.lease_timeout_s
+            or fdoc.get("lease_timeout_s")
+            or DEFAULT_LEASE_TIMEOUT_S)
+        self.lease_renew_s = float(
+            config.lease_renew_s or self.lease_timeout_s / 4.0)
+        if self.lease_renew_s >= self.lease_timeout_s:
+            raise ValueError(
+                f"lease_renew_s ({self.lease_renew_s}) must be < "
+                f"lease_timeout_s ({self.lease_timeout_s}) — a renew "
+                f"cadence past the timeout makes every live host look "
+                f"dead")
+        self.daemons: Dict[str, Heatd] = {}
+        self.leases: Dict[str, dict] = {}
+        self._last_renew: Dict[str, float] = {}
+        self._last_scan: Optional[float] = None
+        self._last_record: Optional[float] = None
+        self._draining = False
+        self.counters = {"claims": 0, "steals": 0, "takeovers": 0,
+                         "hosts_lost": 0, "jobs_adopted": 0,
+                         "leases_lost": 0}
+
+    # -- lease lifecycle -------------------------------------------------
+
+    def _daemon_config(self, proot: str) -> HeatdConfig:
+        cfg = self.config
+        kw = dict(root=proot, slots=cfg.slots,
+                  poll_interval_s=cfg.poll_interval_s,
+                  clock=cfg.clock, sleep_fn=cfg.sleep_fn,
+                  host=cfg.host)
+        kw.update(cfg.daemon_opts or {})
+        return HeatdConfig(**kw)
+
+    def _attach(self, part: str, proot: str, lease: dict, kind: str,
+                observed: Optional[dict] = None) -> Heatd:
+        """Construct the partition's daemon under our fresh lease and
+        journal the claim — plus, on a takeover, the ``host_lost``
+        line and one ``adopted`` line per in-flight job. Ordering: the
+        lease commit already happened (we are the single writer by the
+        time the first append lands)."""
+        d = Heatd(self._daemon_config(proot))
+        j = d.store.journal
+        epoch = int(lease["epoch"])
+        j.append("lease_claimed", partition=part, epoch=epoch,
+                 kind=kind)
+        if observed is not None:
+            self.counters["hosts_lost"] += 1
+            j.append("host_lost", partition=part, epoch=epoch,
+                     lost_host=observed.get("host"),
+                     last_renew_t=observed.get("t_wall"))
+            jobs, _ = d._replay()
+            for jid in sorted(jobs):
+                v = jobs[jid]
+                if v.state == "running":
+                    self.counters["jobs_adopted"] += 1
+                    j.append("adopted", job_id=jid, epoch=epoch,
+                             from_host=observed.get("host"),
+                             from_worker=v.worker, attempt=v.attempts)
+        self.daemons[part] = d
+        self.leases[part] = lease
+        self._last_renew[part] = float(lease["t_wall"])
+        return d
+
+    def _abandon(self, part: str, reason: str) -> None:
+        """Lease lost while we were alive (wedged past the timeout; a
+        peer legitimately took over): stop IMMEDIATELY — kill our
+        workers (the peer's adopted re-dispatches own the stems now;
+        the stem lock would fence a straggler anyway, but a split
+        brain must not burn the slots) and close the daemon WITHOUT
+        journaling: we no longer own that journal."""
+        self.counters["leases_lost"] += 1
+        d = self.daemons.pop(part, None)
+        self.leases.pop(part, None)
+        self._last_renew.pop(part, None)
+        if d is not None:
+            d.abandon()
+
+    def _idle(self) -> bool:
+        """Idle = no held partition has live or queued work (the
+        work-stealing trigger; counted from the folded views — no
+        extra journal reads, the daemons already fold incrementally)."""
+        for d in self.daemons.values():
+            jobs, _ = d._replay()
+            for v in jobs.values():
+                if v.state in ("queued", "running", "failed"):
+                    return False
+        return True
+
+    @staticmethod
+    def _has_backlog(proot: str) -> bool:
+        if _partition_load(proot) > 0:
+            return True
+        return False
+
+    def _room(self) -> bool:
+        mp = self.config.max_partitions
+        return mp is None or len(self.leases) < mp
+
+    def _lease_pass(self, now: float) -> None:
+        cfg = self.config
+        # 1. Renew what we hold (and detect loss LOUDLY: a renew that
+        # comes back None means a peer's takeover committed — abandon
+        # before the next journal append, not after).
+        for part in list(self.leases):
+            if now - self._last_renew.get(part, 0.0) \
+                    < self.lease_renew_s:
+                continue
+            doc = renew_lease(cfg.fleet_root, part, cfg.host,
+                              int(self.leases[part]["epoch"]), now=now)
+            if doc is None:
+                self._abandon(part, "lease lost (peer takeover)")
+            else:
+                self.leases[part] = doc
+                self._last_renew[part] = now
+        if self._draining:
+            return
+        # 2. Scan for claimable partitions at the renew cadence (the
+        # scan cold-reads lease files and, on a claim, one journal —
+        # too heavy for every poll tick, cheap at heartbeat cadence).
+        if self._last_scan is not None \
+                and now - self._last_scan < self.lease_renew_s:
+            return
+        self._last_scan = now
+        idle = None  # lazily computed: only when a steal is possible
+        for part, proot in partition_roots(cfg.fleet_root):
+            if part in self.leases:
+                continue
+            if not self._room():
+                break
+            observed = read_lease(cfg.fleet_root, part)
+            if observed is None:
+                # Unleased: link-commit a claim. "Steal" (work
+                # stealing) when we are idle and the partition has
+                # backlog another host left behind; plain claim
+                # otherwise. Oldest-first: partitions scan sorted.
+                epoch = journal_lease_epoch(proot) + 1
+                lease = claim_lease(
+                    cfg.fleet_root, part, cfg.host, epoch=epoch,
+                    timeout_s=self.lease_timeout_s, now=now)
+                if lease is None:
+                    continue
+                kind = "claim"
+                if epoch > 1 and self._has_backlog(proot):
+                    if idle is None:
+                        idle = self._idle()
+                    if idle and cfg.steal:
+                        kind = "steal"
+                        self.counters["steals"] += 1
+                self.counters["claims"] += 1
+                self._attach(part, proot, lease, kind)
+            elif observed.get("host") != cfg.host \
+                    and lease_stale(observed, now):
+                # Stale peer: rename-committed takeover + adoption.
+                lease = steal_lease(
+                    cfg.fleet_root, part, observed, cfg.host,
+                    timeout_s=self.lease_timeout_s, now=now)
+                if lease is None:
+                    continue  # a peer won the race — exactly one does
+                self.counters["takeovers"] += 1
+                self._attach(part, proot, lease, "takeover",
+                             observed=observed)
+            elif observed.get("host") == cfg.host \
+                    and part not in self.daemons \
+                    and lease_stale(observed, now):
+                # Our own residue from a crashed predecessor process:
+                # reclaim through the same rename-committed path (a
+                # peer may be racing us for it right now).
+                lease = steal_lease(
+                    cfg.fleet_root, part, observed, cfg.host,
+                    timeout_s=self.lease_timeout_s, now=now)
+                if lease is not None:
+                    self.counters["takeovers"] += 1
+                    self._attach(part, proot, lease, "takeover",
+                                 observed=observed)
+
+    # -- capacity record -------------------------------------------------
+
+    def _publish_host(self, now: float, state: Optional[str] = None
+                      ) -> None:
+        if state is None and self._last_record is not None \
+                and now - self._last_record < self.lease_renew_s:
+            return
+        self._last_record = now
+        cfg = self.config
+        write_host_record(cfg.fleet_root, {
+            "host": cfg.host, "pid": os.getpid(),
+            "platform": cfg.platform, "max_cells": cfg.max_cells,
+            "slots": cfg.slots, "t_wall": now,
+            "ttl_s": 4 * self.lease_renew_s,
+            "state": state or ("draining" if self._draining
+                               else "serving"),
+            "leases": sorted(self.leases),
+            "counters": dict(self.counters)})
+
+    # -- driving ---------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One federated pass: renew/claim/reclaim leases, publish the
+        capacity record, then one ordinary scheduling pass per held
+        partition. Returns a per-partition summary."""
+        cfg = self.config
+        now = cfg.clock() if now is None else now
+        self._lease_pass(now)
+        self._publish_host(now)
+        summaries = {}
+        for part in sorted(self.daemons):
+            summaries[part] = self.daemons[part].step(now)
+        return {"host": cfg.host, "leases": sorted(self.leases),
+                "counters": dict(self.counters),
+                "partitions": summaries}
+
+    def serve(self, max_seconds: Optional[float] = None) -> int:
+        """Poll loop until SIGTERM/SIGINT (or ``max_seconds``), then
+        graceful drain — same lifecycle contract as
+        :meth:`Heatd.serve`, returning ``EXIT_PREEMPTED``."""
+        cfg = self.config
+        stop = {"signum": None}
+
+        def handler(signum, frame):
+            stop["signum"] = signum  # flag only — drain at the loop top
+
+        prev = {}
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                prev[s] = signal.signal(s, handler)
+        except ValueError:  # not the main thread (tests)
+            prev = {}
+        t0 = cfg.clock()
+        try:
+            while stop["signum"] is None:
+                self.step()
+                if max_seconds is not None \
+                        and cfg.clock() - t0 >= max_seconds:
+                    break
+                cfg.sleep_fn(cfg.poll_interval_s)
+            return self.drain()
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+    def drain(self) -> int:
+        """Graceful exit: drain every partition daemon (journals the
+        resume states), RELEASE the leases (a released partition is
+        immediately claimable — no peer waits out a timeout), publish
+        a final drained record."""
+        cfg = self.config
+        self._draining = True
+        for part in sorted(self.daemons):
+            self.daemons[part].drain()
+        for part in sorted(self.leases):
+            release_lease(cfg.fleet_root, part, cfg.host,
+                          int(self.leases[part]["epoch"]))
+        self.daemons.clear()
+        self.leases.clear()
+        self._publish_host(cfg.clock(), state="drained")
+        return EXIT_PREEMPTED
+
+    def close(self) -> None:
+        """Teardown without drain (tests/chaos): release journal
+        handles, keep leases on disk — exactly what a crashed host
+        leaves behind."""
+        for d in self.daemons.values():
+            d.close()
+        self.daemons.clear()
+
+
+# ---------------------------------------------------------------------------
+# Fleet status (CLI / monitor)
+# ---------------------------------------------------------------------------
+
+def fleet_status(fleet_root, now: Optional[float] = None) -> dict:
+    """One federated snapshot: partitions with their lease + job
+    counts (from each journal's pure fold), host records, stale-lease
+    inventory."""
+    now = time.time() if now is None else now
+    leases = list_leases(fleet_root)
+    parts = []
+    for name, proot in partition_roots(fleet_root):
+        events, _bad, _torn = read_journal_file(
+            os.path.join(proot, "journal.jsonl"))
+        jobs, anomalies = reduce_journal(events)
+        counts: Dict[str, int] = {}
+        for v in jobs.values():
+            counts[v.state] = counts.get(v.state, 0) + 1
+        doc = leases.get(name)
+        parts.append({
+            "partition": name,
+            "host": (doc or {}).get("host"),
+            "lease_epoch": (doc or {}).get("epoch"),
+            "lease_age_s": (round(now - doc["t_wall"], 3)
+                            if doc and isinstance(doc.get("t_wall"),
+                                                  (int, float))
+                            else None),
+            "lease_stale": (lease_stale(doc, now)
+                            if doc is not None else None),
+            "jobs": len(jobs), "counts": counts,
+            "anomalies": len(anomalies)})
+    return {"root": str(fleet_root), "partitions": parts,
+            "hosts": read_host_records(fleet_root)}

@@ -107,6 +107,14 @@ class JobSpec:
     # worker via env, so the worker's telemetry envelope joins the
     # same trace. None = an untraced submission (older clients).
     trace: Optional[dict] = None
+    # Fleet-router provenance (service/fleet.py route_submission:
+    # {"kind": "exact"|"prefix"|"capacity"|"load", "partition",
+    # "donor_key", "gen_step"}) — rides the spool record so the
+    # daemon's `accepted` line carries WHY the job landed on this
+    # partition; metrics_report's peer-cache-hit rate and the
+    # fleet_cache_route chaos cell read it back. None = a direct
+    # (unrouted) submission.
+    route: Optional[dict] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -152,6 +160,12 @@ class JobView:
     # "generation_step"}) — the client's round-trip proof that the
     # verdict came from a committed donor lineage, not a fresh solve.
     cached: Optional[dict] = None
+    # Cross-host adoption lineage (service/fleet.py): one record per
+    # `adopted` journal line — {"host", "from_host", "epoch", "t"}.
+    # Pure provenance: adoption changes no job state (the ordinary
+    # orphan/requeue machinery does the re-dispatching); heatq's
+    # federated audit judges the lineage against host_lost lines.
+    adoptions: List[dict] = field(default_factory=list)
 
     @property
     def terminal(self) -> bool:
@@ -215,6 +229,17 @@ def reduce_journal(events, state=None
             continue
         if ev == "cancel_requested":
             v.cancel_requested = True
+            continue
+        if ev == "adopted":
+            # Fleet takeover lineage (recorded even for a terminal
+            # job — the federated AUDIT flags that, the fold stays a
+            # pure recorder): which host adopted the in-flight job at
+            # which lease epoch. State is untouched; the adopting
+            # daemon's reconcile pass drives the orphan->requeue->
+            # re-dispatch transitions through the ordinary events.
+            v.adoptions.append({"host": e.get("host"),
+                                "from_host": e.get("from_host"),
+                                "epoch": e.get("epoch"), "t": t})
             continue
         if v.terminal:
             if ev in TERMINAL_STATES or ev == "dispatched":
@@ -315,12 +340,19 @@ class Journal:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
+        # Envelope fields stamped on EVERY append (the federated
+        # daemon sets {"host": ...} here so per-host attribution —
+        # adoption counters, per-host cache hit rates — needs no
+        # per-call-site plumbing). Unknown fields are ignored by the
+        # reducer; single-daemon roots leave this empty.
+        self.extra: dict = {}
         self._fd = os.open(self.path,
                            os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
 
     def append(self, event: str, **fields) -> dict:
         rec = {"schema": JOURNAL_SCHEMA_VERSION, "event": event,
                "t_wall": time.time(), "pid": os.getpid()}
+        rec.update(self.extra)
         rec.update(fields)
         line = (json.dumps(rec) + "\n").encode()
         with self._lock:

@@ -1479,7 +1479,8 @@ def test_ast_scan_covers_service_package():
     scanned = set(_iter_py_files(default_scan_paths()))
     svc = os.path.join(REPO_ROOT, "parallel_heat_tpu", "service")
     for mod in ("store.py", "daemon.py", "worker.py", "admission.py",
-                "client.py", "cli.py", "cache.py", "harness.py"):
+                "client.py", "cli.py", "cache.py", "harness.py",
+                "fleet.py"):
         assert os.path.join(svc, mod) in scanned, mod
     assert os.path.join(REPO_ROOT, "tools", "heatq.py") in scanned
     findings = lint_paths([svc])

@@ -1,0 +1,697 @@
+"""Fleet federation: lease-partitioned queue, cross-host takeover.
+
+Everything here is fast and deterministic — FleetHosts step on
+injected clocks with scripted worker handles, exactly like
+``tests/test_service.py`` drives a single Heatd. The contract pinned
+(SEMANTICS.md "Fleet durability"): the journal stays single-writer
+per partition (lease link/rename commits decide the writer), a lost
+host's in-flight jobs are adopted by exactly one peer with an audited
+``host_lost``/``adopted`` lineage, and routing is a pure function of
+the fleet's durable state. Real multi-process death lives in the
+``fleet_*`` cells of ``tools/chaos_matrix.py`` and the one
+``slow``-marked subprocess test at the bottom.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from parallel_heat_tpu.service import client, fleet
+from parallel_heat_tpu.service.harness import inline_launcher
+from parallel_heat_tpu.service.store import (
+    JobSpec,
+    JobStore,
+    read_journal_file,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_T0 = 1000.0
+
+
+# ---------------------------------------------------------------------------
+# Test doubles (the test_service.py idiom)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=_T0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeHandle:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = os.getpid()
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+
+class ScriptedLauncher:
+    def __init__(self):
+        self.dispatches = []
+
+    def __call__(self, job_id, worker_id, attempt, deadline_t):
+        h = FakeHandle()
+        self.dispatches.append(
+            {"job_id": job_id, "worker_id": worker_id,
+             "attempt": attempt, "deadline_t": deadline_t,
+             "handle": h})
+        return h
+
+    def last(self, job_id):
+        for d in reversed(self.dispatches):
+            if d["job_id"] == job_id:
+                return d
+        raise KeyError(job_id)
+
+
+def _fleet_root(tmp_path, partitions=2, lease_timeout_s=10.0):
+    root = str(tmp_path / "fleet")
+    fleet.fleet_init(root, partitions=partitions,
+                     lease_timeout_s=lease_timeout_s,
+                     clock=lambda: _T0)
+    return root
+
+
+def _host(root, name, clock, launcher=None, **kw):
+    opts = dict(kw.pop("daemon_opts", {}))
+    opts.setdefault("launcher", launcher or ScriptedLauncher())
+    opts.setdefault("requeue_backoff_base_s", 0.0)
+    cfg = fleet.FleetHostConfig(
+        fleet_root=root, host=name, clock=clock,
+        sleep_fn=lambda s: None, daemon_opts=opts, **kw)
+    return fleet.FleetHost(cfg)
+
+
+def _spec(job_id, nx=16, steps=60, **kw):
+    return JobSpec(job_id=job_id,
+                   config={"nx": nx, "ny": nx, "steps": steps,
+                           "backend": "jnp"}, **kw)
+
+
+def _finish(store, d, outcome, rc=0, **fields):
+    doc = {"outcome": outcome, "worker": d["worker_id"],
+           "attempt": d["attempt"], "job_id": d["job_id"]}
+    doc.update(fields)
+    store.write_result(d["job_id"], d["attempt"], doc)
+    d["handle"].rc = rc
+
+
+def _events(proot, job_id=None, event=None):
+    evs, _bad, _torn = read_journal_file(
+        os.path.join(proot, "journal.jsonl"))
+    return [e for e in evs
+            if (job_id is None or e.get("job_id") == job_id)
+            and (event is None or e.get("event") == event)]
+
+
+# ---------------------------------------------------------------------------
+# Fleet root layout
+# ---------------------------------------------------------------------------
+
+def test_fleet_init_layout_and_grow_only(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    assert fleet.is_fleet_root(root)
+    names = [n for n, _ in fleet.partition_roots(root)]
+    assert names == ["p00", "p01"]
+    for _, proot in fleet.partition_roots(root):
+        assert os.path.isdir(os.path.join(proot, "spool"))
+    assert os.path.isdir(os.path.join(root, "leases"))
+    assert os.path.isdir(os.path.join(root, "hosts"))
+    # Idempotent re-init can only GROW the partition count (jobs may
+    # already live in the existing partitions).
+    doc = fleet.fleet_init(root, partitions=1)
+    assert doc["partitions"] == 2
+    doc = fleet.fleet_init(root, partitions=3)
+    assert doc["partitions"] == 3
+    assert [n for n, _ in fleet.partition_roots(root)] \
+        == ["p00", "p01", "p02"]
+    # A plain queue root is NOT a fleet root: the tools keep their
+    # single-daemon view.
+    q = tmp_path / "plain"
+    JobStore(q).close()
+    assert not fleet.is_fleet_root(str(q))
+
+
+def test_fleet_init_rejects_bad_knobs(tmp_path):
+    with pytest.raises(ValueError):
+        fleet.fleet_init(str(tmp_path / "f1"), partitions=0)
+    with pytest.raises(ValueError):
+        fleet.fleet_init(str(tmp_path / "f2"), lease_timeout_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Lease protocol: link-committed claims, rename-committed takeovers
+# ---------------------------------------------------------------------------
+
+def test_claim_lease_exactly_one_winner(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    a = fleet.claim_lease(root, "p00", "hosta", epoch=1,
+                          timeout_s=10.0, now=_T0)
+    assert a is not None and a["host"] == "hosta" and a["epoch"] == 1
+    # The link is the commit point: a second claimant loses loudly.
+    assert fleet.claim_lease(root, "p00", "hostb", epoch=1,
+                             timeout_s=10.0, now=_T0) is None
+    assert fleet.read_lease(root, "p00")["host"] == "hosta"
+    assert not fleet.lease_stale(a, _T0 + 9.9)
+    assert fleet.lease_stale(a, _T0 + 10.1)
+
+
+def test_steal_lease_exactly_one_winner_from_same_observation(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    fleet.claim_lease(root, "p00", "ghost", epoch=1,
+                      timeout_s=1.0, now=_T0 - 60.0)
+    observed = fleet.read_lease(root, "p00")
+    assert fleet.lease_stale(observed, _T0)
+    # Two peers judged the SAME stale lease: the rename commit lets
+    # exactly one through (the loser gets ENOENT, never a duplicate).
+    wins = [fleet.steal_lease(root, "p00", observed, h,
+                              timeout_s=10.0, now=_T0)
+            for h in ("hostb", "hostc")]
+    winners = [w for w in wins if w is not None]
+    assert len(winners) == 1
+    assert winners[0]["epoch"] == 2
+    assert fleet.read_lease(root, "p00")["host"] == winners[0]["host"]
+
+
+def test_steal_rolls_back_when_holder_renewed_meanwhile(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    fleet.claim_lease(root, "p00", "hosta", epoch=1,
+                      timeout_s=10.0, now=_T0 - 60.0)
+    observed = fleet.read_lease(root, "p00")
+    assert fleet.lease_stale(observed, _T0)
+    # Between the staleness read and the rename, the "dead" holder
+    # heartbeats: the thief must notice the fresher bytes, restore the
+    # live lease, and walk away.
+    renewed = fleet.renew_lease(root, "p00", "hosta", 1, now=_T0)
+    assert renewed is not None
+    assert fleet.steal_lease(root, "p00", observed, "hostb",
+                             timeout_s=10.0, now=_T0) is None
+    cur = fleet.read_lease(root, "p00")
+    assert cur["host"] == "hosta" and cur["epoch"] == 1
+
+
+def test_renew_lease_detects_loss(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    fleet.claim_lease(root, "p00", "hosta", epoch=1,
+                      timeout_s=10.0, now=_T0)
+    assert fleet.renew_lease(root, "p00", "hostb", 1,
+                             now=_T0 + 1) is None  # not ours
+    assert fleet.renew_lease(root, "p00", "hosta", 2,
+                             now=_T0 + 1) is None  # wrong epoch
+    doc = fleet.renew_lease(root, "p00", "hosta", 1, now=_T0 + 1)
+    assert doc is not None and doc["t_wall"] == _T0 + 1
+    assert fleet.release_lease(root, "p00", "hosta", 1)
+    assert fleet.read_lease(root, "p00") is None
+    # A renew after takeover/release = the lease is simply gone.
+    assert fleet.renew_lease(root, "p00", "hosta", 1,
+                             now=_T0 + 2) is None
+
+
+def test_journal_lease_epoch_survives_release(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    proot = fleet.partition_root(root, "p00")
+    assert fleet.journal_lease_epoch(proot) == 0
+    store = JobStore(proot, create=False)
+    store.journal.append("lease_claimed", partition="p00", epoch=1,
+                         kind="claim", host="a")
+    store.journal.append("host_lost", partition="p00", epoch=2,
+                         lost_host="a")
+    store.close()
+    # The journal is the durable monotone record: a fresh claim after
+    # a graceful release continues the chain from here.
+    assert fleet.journal_lease_epoch(proot) == 2
+
+
+# ---------------------------------------------------------------------------
+# Cache-aware routing
+# ---------------------------------------------------------------------------
+
+def test_route_least_loaded_with_deterministic_ties(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    cfg = {"nx": 16, "ny": 16, "steps": 60, "backend": "jnp"}
+    d = fleet.route_submission(root, cfg, now=_T0)
+    assert d["kind"] == "load" and d["partition"] == "p00"
+    assert d["host"] is None  # unleased: work stealing picks it up
+    # One spooled job on p00 tips the balance.
+    s = JobStore(fleet.partition_root(root, "p00"), create=False)
+    s.spool_submit(_spec("j-load"))
+    s.close()
+    d = fleet.route_submission(root, cfg, now=_T0)
+    assert d["kind"] == "load" and d["partition"] == "p01"
+
+
+def test_route_capacity_filter_heterogeneous_hosts(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    fleet.claim_lease(root, "p00", "small", epoch=1,
+                      timeout_s=10.0, now=_T0)
+    fleet.claim_lease(root, "p01", "big", epoch=1,
+                      timeout_s=10.0, now=_T0)
+    for host, cells in (("small", 512), ("big", None)):
+        fleet.write_host_record(root, {
+            "host": host, "platform": "cpu", "max_cells": cells,
+            "t_wall": _T0, "ttl_s": 60.0, "state": "serving"})
+    big_cfg = {"nx": 64, "ny": 64, "steps": 60, "backend": "jnp"}
+    d = fleet.route_submission(root, big_cfg, now=_T0)
+    assert d["kind"] == "capacity"
+    assert d["partition"] == "p01" and d["host"] == "big"
+    # A grid everyone fits falls through to pure load (the filter
+    # only bites when it actually excludes somebody).
+    small_cfg = {"nx": 16, "ny": 16, "steps": 60, "backend": "jnp"}
+    d = fleet.route_submission(root, small_cfg, now=_T0)
+    assert d["kind"] == "load" and d["partition"] == "p00"
+    # Stale capacity records stop biting: the small host's claim is
+    # old news once past its ttl.
+    d = fleet.route_submission(root, big_cfg, now=_T0 + 120.0)
+    assert d["kind"] == "load"
+
+
+# ---------------------------------------------------------------------------
+# FleetHost: claims, scheduling, drain/release, work stealing
+# ---------------------------------------------------------------------------
+
+def test_fleet_host_claims_serves_and_stamps_host(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    clock = FakeClock()
+    launcher = ScriptedLauncher()
+    a = _host(root, "hosta", clock, launcher)
+    a.step()
+    assert sorted(a.leases) == ["p00", "p01"]
+    assert a.counters["claims"] == 2 and a.counters["steals"] == 0
+    proot = fleet.partition_root(root, "p00")
+    store = JobStore(proot, create=False)
+    store.spool_submit(_spec("j1"))
+    clock.advance(0.1)
+    a.step()
+    d = launcher.last("j1")
+    _finish(store, d, "completed", steps_done=60)
+    clock.advance(0.1)
+    a.step()
+    jobs, anomalies = store.replay()
+    assert anomalies == []
+    assert jobs["j1"].state == "completed"
+    # Every append under the lease carries the host name — the
+    # cross-host audit and the per-host metrics fold on it.
+    assert all(e.get("host") == "hosta"
+               for e in _events(proot, job_id="j1"))
+    claims = _events(proot, event="lease_claimed")
+    assert claims and claims[0]["epoch"] == 1 \
+        and claims[0]["kind"] == "claim"
+    _info, fleet_anoms = fleet.audit_fleet(root, now=clock())
+    assert fleet_anoms == []
+    doc = fleet.fleet_status(root, now=clock())
+    by_name = {p["partition"]: p for p in doc["partitions"]}
+    assert by_name["p00"]["host"] == "hosta"
+    assert by_name["p00"]["counts"].get("completed") == 1
+    assert doc["hosts"]["hosta"]["state"] == "serving"
+    store.close()
+    a.close()
+
+
+def test_fleet_host_max_partitions_and_graceful_release(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    clock = FakeClock()
+    a = _host(root, "hosta", clock, max_partitions=1)
+    a.step()
+    assert sorted(a.leases) == ["p00"]
+    assert a.drain() == 3  # EXIT_PREEMPTED
+    # Graceful drain RELEASES: the partition is immediately claimable
+    # (no peer waits out a timeout) and the epoch chain continues.
+    assert fleet.read_lease(root, "p00") is None
+    hosts = fleet.read_host_records(root)
+    assert hosts["hosta"]["state"] == "drained"
+    b = _host(root, "hostb", clock, max_partitions=1)
+    clock.advance(5.0)
+    b.step()
+    assert sorted(b.leases) == ["p00"]
+    claims = _events(fleet.partition_root(root, "p00"),
+                     event="lease_claimed")
+    assert [c["epoch"] for c in claims] == [1, 2]
+    _info, anoms = fleet.audit_fleet(root, now=clock())
+    assert anoms == []
+    b.close()
+
+
+def test_work_stealing_claims_abandoned_backlog(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    proot = fleet.partition_root(root, "p00")
+    # A previous epoch left committed backlog and no lease (released
+    # or reclaimed-and-released): an idle peer steals the work.
+    store = JobStore(proot, create=False)
+    store.journal.append("lease_claimed", partition="p00", epoch=1,
+                         kind="claim", host="gone")
+    store.spool_submit(_spec("j-stolen"))
+    store.close()
+    clock = FakeClock()
+    launcher = ScriptedLauncher()
+    b = _host(root, "hostb", clock, launcher)
+    b.step()
+    assert b.counters["steals"] == 1
+    claims = _events(proot, event="lease_claimed")
+    assert claims[-1]["epoch"] == 2 and claims[-1]["kind"] == "steal"
+    assert launcher.last("j-stolen")["attempt"] == 1
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-host orphan takeover + adoption
+# ---------------------------------------------------------------------------
+
+def test_takeover_adopts_and_reruns_inflight_job(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1, lease_timeout_s=10.0)
+    proot = fleet.partition_root(root, "p00")
+    clock = FakeClock()
+    launcher_a = ScriptedLauncher()
+    a = _host(root, "hosta", clock, launcher_a,
+              daemon_opts={"launcher": launcher_a,
+                           "heartbeat_timeout_s": 5.0})
+    a.step()
+    store = JobStore(proot, create=False)
+    store.spool_submit(_spec("j-adopt"))
+    clock.advance(0.1)
+    a.step()
+    d1 = launcher_a.last("j-adopt")
+    assert d1["attempt"] == 1
+    # The worker got one beat out, then hosta wedged: no renewals, no
+    # further beats. Past the lease timeout a peer takes over.
+    store.write_worker_hb(d1["worker_id"],
+                          {"pid": os.getpid(), "t_wall": clock.t})
+    clock.advance(11.0)
+    launcher_b = ScriptedLauncher()
+    b = _host(root, "hostb", clock, launcher_b,
+              daemon_opts={"launcher": launcher_b,
+                           "heartbeat_timeout_s": 5.0})
+    for _ in range(4):
+        b.step()
+        clock.advance(0.1)
+    assert b.counters["takeovers"] == 1
+    assert b.counters["hosts_lost"] == 1
+    assert b.counters["jobs_adopted"] == 1
+    lost = _events(proot, event="host_lost")
+    assert len(lost) == 1 and lost[0]["lost_host"] == "hosta" \
+        and lost[0]["epoch"] == 2 and lost[0]["host"] == "hostb"
+    adopted = _events(proot, event="adopted")
+    assert len(adopted) == 1 and adopted[0]["job_id"] == "j-adopt" \
+        and adopted[0]["from_host"] == "hosta"
+    # The adopted job was orphaned (dead worker, stale-by-absence
+    # heartbeat) and re-dispatched by the NEW epoch's claimant.
+    d2 = launcher_b.last("j-adopt")
+    assert d2["attempt"] == 2
+    _finish(store, d2, "completed", steps_done=60)
+    clock.advance(0.1)
+    b.step()
+    jobs, anomalies = store.replay()
+    assert anomalies == []
+    v = jobs["j-adopt"]
+    assert v.state == "completed" and v.attempts == 2
+    assert list(v.adoptions) and v.adoptions[0]["from_host"] == "hosta"
+    # The wedged host wakes up: its renew fails, it abandons WITHOUT
+    # journaling — the partition has exactly one writer again.
+    n_events = len(_events(proot))
+    a.step()
+    assert a.counters["leases_lost"] == 1
+    assert a.leases == {} and a.daemons == {}
+    assert len(_events(proot)) == n_events
+    _info, anoms = fleet.audit_fleet(root, now=clock())
+    assert anoms == []
+    store.close()
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# Federated audit (heatq --check)
+# ---------------------------------------------------------------------------
+
+def test_audit_flags_stale_lease_and_epoch_regression(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    fleet.claim_lease(root, "p00", "dead", epoch=1,
+                      timeout_s=1.0, now=_T0 - 60.0)
+    info, anoms = fleet.audit_fleet(root, now=_T0)
+    assert info["stale_leases"] \
+        and info["stale_leases"][0]["host"] == "dead"
+    assert any("stale lease" in a for a in anoms)
+    # Epoch regression = two live writers (the double-claim the lease
+    # protocol exists to prevent).
+    proot = fleet.partition_root(root, "p00")
+    store = JobStore(proot, create=False)
+    store.journal.append("lease_claimed", partition="p00", epoch=2,
+                         kind="claim", host="b")
+    store.journal.append("lease_claimed", partition="p00", epoch=1,
+                         kind="claim", host="c")
+    store.close()
+    _info, anoms = fleet.audit_fleet(root, now=_T0)
+    assert any("epoch regression" in a for a in anoms)
+    # ...and the on-disk epoch-1 lease is now BEHIND the journal.
+    assert any("behind the journal" in a for a in anoms)
+
+
+def test_audit_flags_broken_adoption_lineage(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    proot = fleet.partition_root(root, "p00")
+    store = JobStore(proot, create=False)
+    j = store.journal
+    j.append("lease_claimed", partition="p00", epoch=1, kind="claim",
+             host="a")
+    j.append("accepted", job_id="jx", host="a")
+    j.append("dispatched", job_id="jx", worker="w1", attempt=1,
+             host="a")
+    # An adopted line with NO host_lost of that epoch, appended by a
+    # host that never claimed it, over a job that is still running
+    # under epoch 1 — three lineage breaks at once.
+    j.append("adopted", job_id="jx", epoch=2, from_host="a",
+             host="b")
+    store.close()
+    _info, anoms = fleet.audit_fleet(root, now=_T0)
+    assert any("no matching host_lost" in a for a in anoms)
+
+
+def test_audit_flags_cross_host_double_dispatch(tmp_path):
+    root = _fleet_root(tmp_path, partitions=1)
+    proot = fleet.partition_root(root, "p00")
+    store = JobStore(proot, create=False)
+    j = store.journal
+    j.append("accepted", job_id="jd", host="a")
+    j.append("dispatched", job_id="jd", worker="w1", attempt=1,
+             host="a")
+    j.append("dispatched", job_id="jd", worker="w9", attempt=1,
+             host="b")
+    store.close()
+    _info, anoms = fleet.audit_fleet(root, now=_T0)
+    assert any("double" in a and "dispatch" in a for a in anoms)
+
+
+def test_heatq_check_exits_2_on_federated_anomaly(tmp_path):
+    heatq = os.path.join(_ROOT, "tools", "heatq.py")
+    root = _fleet_root(tmp_path, partitions=1)
+    p = subprocess.run([sys.executable, heatq, root, "--check",
+                        "--json"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["federated"] and list(doc["partitions"]) == ["p00"]
+    fleet.claim_lease(root, "p00", "dead", epoch=1,
+                      timeout_s=0.001, now=time.time() - 60.0)
+    p = subprocess.run([sys.executable, heatq, root, "--check"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "STALE LEASE" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# Fleet observability: metrics_report + slo_gate
+# ---------------------------------------------------------------------------
+
+def _served_fleet(tmp_path):
+    """One completed job under hosta, journal host-stamped — the
+    smallest fleet with a per-host story to report."""
+    root = _fleet_root(tmp_path, partitions=2)
+    clock = FakeClock()
+    launcher = ScriptedLauncher()
+    a = _host(root, "hosta", clock, launcher)
+    a.step()
+    store = JobStore(fleet.partition_root(root, "p00"), create=False)
+    store.spool_submit(_spec("j1"))
+    clock.advance(0.1)
+    a.step()
+    _finish(store, launcher.last("j1"), "completed", steps_done=60)
+    clock.advance(0.1)
+    a.step()
+    store.close()
+    # Graceful drain: leases released on disk (fake-clock lease
+    # stamps would read as ancient to the tools' wall-clock audit).
+    a.drain()
+    return root
+
+
+def test_metrics_report_federation_per_host_rows(tmp_path):
+    root = _served_fleet(tmp_path)
+    mr = os.path.join(_ROOT, "tools", "metrics_report.py")
+    p = subprocess.run([sys.executable, mr, root, "--json"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["federated"] is True
+    f = doc["fleet"]
+    assert f["jobs_accepted"] == 1 and f["completed"] == 1
+    assert f["partitions"] == 2 and f["jobs_adopted"] == 0
+    assert f["stale_leases"] == 0
+    h = doc["hosts"]["hosta"]
+    assert h["lease_claims"] == 2
+    assert h["leases_held"] == 0  # drained: releases are on disk
+    assert h["completed"] == 1 and h["jobs_adopted"] == 0
+    txt = subprocess.run([sys.executable, mr, root],
+                         capture_output=True, text=True, timeout=120)
+    assert txt.returncode == 0
+    assert "hosta" in txt.stdout
+
+
+def test_slo_gate_federated_tokens_and_heartbeat(tmp_path):
+    root = _served_fleet(tmp_path)
+    gate = os.path.join(_ROOT, "tools", "slo_gate.py")
+    ok = subprocess.run([sys.executable, gate, root,
+                         "--fleet", "stale_leases>0,completed<1"],
+                        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    # Now strand a lease: the same tokens must trip the gate.
+    fleet.claim_lease(root, "p01", "dead", epoch=9,
+                      timeout_s=0.001, now=time.time() - 60.0)
+    bad = subprocess.run([sys.executable, gate, root,
+                          "--fleet", "stale_leases>0"],
+                         capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "stale_leases" in bad.stdout
+    # Unknown counters are a loud spec error, never silently held.
+    err = subprocess.run([sys.executable, gate, root,
+                          "--fleet", "no_such_counter>0"],
+                         capture_output=True, text=True, timeout=120)
+    assert err.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# Peer-cache routing end-to-end (inline workers, real solver)
+# ---------------------------------------------------------------------------
+
+def test_fleet_exact_cache_route_zero_dispatch(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2)
+    proot = fleet.partition_root(root, "p00")
+    spawns = []
+    a = _host(root, "hosta", time.time,
+              launcher=inline_launcher(proot, spawns=spawns),
+              max_partitions=1, slots=1,
+              daemon_opts={"launcher": inline_launcher(proot,
+                                                       spawns=spawns),
+                           "worker_env": {"JAX_PLATFORMS": "cpu"}})
+    a.step()
+    assert sorted(a.leases) == ["p00"]
+    cfg = {"nx": 12, "ny": 12, "steps": 30, "backend": "jnp"}
+
+    def run(job_id):
+        route = fleet.route_submission(root, cfg)
+        store = JobStore(route["root"], create=False)
+        store.spool_submit(JobSpec(job_id=job_id, config=dict(cfg),
+                                   route=route))
+        store.close()
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            a.step()
+            jobs, _ = JobStore(proot, create=False).replay()
+            v = jobs.get(job_id)
+            if v is not None and v.terminal:
+                return route, v
+            time.sleep(0.01)
+        raise TimeoutError(job_id)
+
+    route1, v1 = run("donor")
+    assert route1["partition"] == "p00" and v1.state == "completed"
+    assert spawns == ["donor"]
+    # The identical spec routes to the partition whose cache serves it
+    # outright — and admission completes it with ZERO dispatches.
+    route2, v2 = run("hit")
+    assert route2["kind"] == "exact" and route2["partition"] == "p00"
+    assert route2["donor_key"] is not None
+    assert v2.state == "completed"
+    assert spawns == ["donor"]  # no second worker fleet-wide
+    hits = _events(proot, job_id="hit", event="cache_hit")
+    assert hits and hits[0].get("donor") == "donor"
+    _info, anoms = fleet.audit_fleet(root)
+    assert anoms == []
+    a.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_fleet_init_and_status(tmp_path, capsys):
+    from parallel_heat_tpu.service import cli as svc_cli
+
+    root = str(tmp_path / "f")
+    rc = svc_cli.main(["fleet-init", "--fleet", root,
+                       "--partitions", "3", "--lease-timeout", "7"])
+    assert rc == 0
+    assert "3 partition(s)" in capsys.readouterr().out
+    assert fleet.is_fleet_root(root)
+    assert fleet.fleet_doc(root)["lease_timeout_s"] == 7.0
+    rc = svc_cli.main(["fleet-status", "--fleet", root, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["partitions"]) == 3
+    rc = svc_cli.main(["fleet-status", "--fleet", root])
+    assert rc == 0
+    assert "p02" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Real processes (slow tier — the fast suite above stays fake-clocked)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_serve_subprocess_round_trip(tmp_path):
+    root = _fleet_root(tmp_path, partitions=2, lease_timeout_s=5.0)
+    env = dict(os.environ, PYTHONPATH=_ROOT, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "parallel_heat_tpu.cli", "fleet-serve",
+         "--fleet", root, "--host", "h1", "--slots", "1",
+         "--poll-interval", "0.05", "--lease-renew", "0.25",
+         "--worker-heartbeat", "0.25", "--heartbeat-timeout", "2.0"],
+        env=env, cwd=_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        verdict = client.fleet_submit(
+            root, {"nx": 12, "ny": 12, "steps": 30, "backend": "jnp"},
+            job_id="rt", accept_timeout_s=60.0)
+        assert verdict["accepted"], verdict
+        v = client.fleet_wait(root, "rt", timeout_s=90.0)
+        assert v.state == "completed"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == 3  # EXIT_PREEMPTED: graceful drain, leases released
+    assert fleet.read_lease(root, "p00") is None
+    heatq = os.path.join(_ROOT, "tools", "heatq.py")
+    p = subprocess.run([sys.executable, heatq, root, "--check"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr

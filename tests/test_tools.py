@@ -647,16 +647,19 @@ def test_make_lint_fast_smoke():
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_chaos_matrix_dryrun_smoke(tmp_path):
     # The fault x policy sweep must run end to end on CPU and certify
     # its own contract (exit 0 == every bitwise/detection/halt/
     # telemetry check held); the committed chaos_r8_dryrun.json is
-    # this exact run.
+    # this exact run. The full matrix (now including the multi-daemon
+    # fleet cells) takes minutes of wall — slow tier; `make chaos` and
+    # CI's chaos job still run it on every push.
     out_json = tmp_path / "chaos.json"
     out = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "chaos_matrix.py"),
          "--dryrun", "--json", str(out_json)],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_ROOT,
     )
     assert out.returncode == 0, out.stderr[-2000:]
@@ -698,6 +701,17 @@ def test_chaos_matrix_dryrun_smoke(tmp_path):
     assert outcomes["svc_daemon_restart"] == "recovered"
     assert outcomes["svc_overload"] == "rejected+served"
     assert by_fault["svc_overload"]["never_dropped_ok"] is True
+    # the fleet federation cells: a SIGKILLed host's lease is taken
+    # over and its job adopted bitwise within one lease timeout, a
+    # raced takeover has exactly one winner, and an exact peer-cache
+    # hit is served cross-host with zero dispatches
+    assert outcomes["fleet_host_sigkill"] == "recovered"
+    assert by_fault["fleet_host_sigkill"]["takeover_bounded_ok"] is True
+    assert by_fault["fleet_host_sigkill"]["fleet_check_ok"] is True
+    assert outcomes["fleet_lease_race"] == "recovered"
+    assert by_fault["fleet_lease_race"]["one_winner_ok"] is True
+    assert outcomes["fleet_cache_route"] == "recovered"
+    assert by_fault["fleet_cache_route"]["zero_dispatch_ok"] is True
     assert all(r.get("single_terminal_ok", True) for r in doc["rows"])
 
 

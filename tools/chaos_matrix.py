@@ -320,6 +320,14 @@ SERVICE_FAULTS = ("svc_cache_crash", "svc_cache_prefix_parity",
                   "svc_worker_sigkill", "svc_daemon_restart",
                   "svc_overload")
 
+# Federation cells (SEMANTICS.md "Fleet durability"): a real
+# fleet-serve host SIGKILLed mid-job is adopted by a peer and the job
+# completes bitwise; two hosts racing a stale lease produce exactly
+# one rename-commit winner and zero double-dispatch; a second host
+# serves a peer-cache exact hit with zero dispatches fleet-wide.
+FLEET_FAULTS = ("fleet_host_sigkill", "fleet_lease_race",
+                "fleet_cache_route")
+
 # Real 2-process gloo cells (the distributed-supervision contract,
 # SEMANTICS.md "Distributed supervision") — run with --mp / --mp-only
 # (`make mp-smoke`): each spawns two worker processes that form one
@@ -1210,6 +1218,349 @@ def _svc_cache_prefix_parity(root):
     return row
 
 
+def run_fleet_cell(fault, workdir):
+    if fault == "fleet_host_sigkill":
+        return _fleet_host_sigkill(os.path.join(workdir, fault))
+    if fault == "fleet_lease_race":
+        return _fleet_lease_race(os.path.join(workdir, fault))
+    if fault == "fleet_cache_route":
+        return _fleet_cache_route(os.path.join(workdir, fault))
+    raise ValueError(fault)
+
+
+def _fleet_audit_clean(root):
+    """The heatq federated audit, in-process: zero anomalies across
+    the fleet-level rules AND every partition's journal+cache."""
+    import heatq
+
+    return not heatq.inspect_fleet(root)["anomalies"]
+
+
+def _fleet_drive(hosts, proot, done, timeout_s=180.0, poll_s=0.03):
+    """Step every FleetHost until ``done(jobs)`` over ``proot``'s
+    replay, or timeout."""
+    import time as _time
+
+    from parallel_heat_tpu.service.store import JobStore
+
+    store = JobStore(proot, create=False)
+    t0 = _time.monotonic()
+    try:
+        while _time.monotonic() - t0 < timeout_s:
+            for h in hosts:
+                h.step()
+            jobs, anomalies = store.replay()
+            if done(jobs):
+                return jobs, anomalies
+            _time.sleep(poll_s)
+    finally:
+        store.close()
+    raise TimeoutError(f"fleet cell did not converge within "
+                       f"{timeout_s:g}s")
+
+
+def _fleet_host_sigkill(root):
+    """A REAL fleet-serve daemon (own process, real worker) is
+    SIGKILLed while its job is in flight (the worker self-SIGKILLs at
+    chunk 4, so no host is alive to requeue it); the surviving
+    in-process peer must reclaim the lease within one lease timeout
+    of staleness, journal ``host_lost`` + ``adopted``, and complete
+    the job bitwise — the never-interrupted pin."""
+    import subprocess
+    import time as _time
+
+    import parallel_heat_tpu as _pkg
+    from parallel_heat_tpu.service import client, fleet
+    from parallel_heat_tpu.service.store import JobStore
+
+    row = {"fault": "fleet_host_sigkill"}
+    lease_s = 1.0
+    fleet.fleet_init(root, partitions=1, lease_timeout_s=lease_s)
+    part, proot = fleet.partition_roots(root)[0]
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    hosta = subprocess.Popen(
+        [sys.executable, "-m", "parallel_heat_tpu.cli", "fleet-serve",
+         "--fleet", root, "--host", "hosta", "--slots", "1",
+         "--poll-interval", "0.05", "--lease-renew", "0.25",
+         "--worker-heartbeat", "0.25", "--heartbeat-timeout", "1.0"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    b = None
+    try:
+        jid = "job-fleet-kill"
+        v = client.fleet_submit(
+            root, {"nx": 16, "ny": 16, "steps": 60, "backend": "jnp"},
+            job_id=jid, checkpoint_every=10, guard_interval=5,
+            backoff_base_s=0.0,
+            faults={"kill_worker_at_chunk": 4}, faults_on_attempt=1,
+            accept_timeout_s=60)
+        row["accepted_ok"] = v["accepted"]
+        # Kill host A the moment the job is in flight: the window to
+        # its own orphan-requeue is one heartbeat timeout wide.
+        store = JobStore(proot, create=False)
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 60:
+            jobs, _ = store.replay()
+            if jid in jobs and jobs[jid].state == "running":
+                break
+            _time.sleep(0.02)
+        store.close()
+        hosta.send_signal(signal.SIGKILL)
+        hosta.wait(timeout=30)
+        t_kill = _time.time()
+        row["daemon_killed_ok"] = hosta.returncode == -signal.SIGKILL
+
+        b = fleet.FleetHost(fleet.FleetHostConfig(
+            fleet_root=root, host="hostb", slots=1,
+            lease_renew_s=0.25, poll_interval_s=0.05,
+            daemon_opts={"worker_heartbeat_s": 0.25,
+                         "heartbeat_timeout_s": 1.0,
+                         "requeue_backoff_base_s": 0.0,
+                         "worker_env": {"JAX_PLATFORMS": "cpu"}}))
+        jobs, anomalies = _fleet_drive(
+            [b], proot, lambda j: jid in j and j[jid].terminal)
+        events, _, _ = JobStore(proot, create=False).read_journal()
+        lost = [e for e in events if e.get("event") == "host_lost"]
+        adopted = [e for e in events if e.get("event") == "adopted"
+                   and e.get("job_id") == jid]
+        row["host_lost_ok"] = bool(
+            lost and lost[0].get("lost_host") == "hosta"
+            and lost[0].get("host") == "hostb"
+            and lost[0].get("epoch") == 2)
+        row["adopted_ok"] = bool(
+            adopted and adopted[0].get("from_host") == "hosta"
+            and adopted[0].get("host") == "hostb"
+            and adopted[0].get("epoch") == 2)
+        if lost:
+            # Takeover latency: bounded by one lease timeout past the
+            # dead host's last renewal (+ scan cadence slack), and
+            # never BEFORE staleness (no premature steal).
+            lag = lost[0]["t_wall"] - (lost[0].get("last_renew_t")
+                                       or t_kill)
+            row["takeover_lag_s"] = lag
+            row["takeover_bounded_ok"] = bool(lag <= lease_s + 2.0)
+            row["not_premature_ok"] = bool(lag >= lease_s - 0.01)
+        row["attempts"] = jobs[jid].attempts
+        # The adopter re-dispatches at least once past the adopted
+        # attempt, and every failure along the way is the stem lock
+        # FENCING a straggler of the dead host (worker_failed
+        # stem_locked -> requeue -> retry) — never a second fault
+        # class. Attempt-count is adoption-relative, not absolute:
+        # host A may or may not have burned its own requeue on the
+        # self-killed worker before the SIGKILL landed, and the lock
+        # fence may cost one extra bounce; both timelines are
+        # legitimate chaos.
+        adopted_at = adopted[0].get("attempt") if adopted else None
+        row["recovered_ok"] = bool(jobs[jid].state == "completed"
+                                   and adopted_at is not None
+                                   and jobs[jid].attempts
+                                   > adopted_at)
+        row["fence_only_ok"] = not (
+            {k for _w, k in jobs[jid].failures}
+            - {"stem_locked", "orphaned"})
+        row["single_terminal_ok"] = not anomalies
+        st = JobStore(proot, create=False)
+        row["bitwise_match"] = _svc_bitwise(st, jid)
+        st.close()
+        b.drain()
+        row["fleet_check_ok"] = _fleet_audit_clean(root)
+        ok = all(row.get(k) is True for k in
+                 ("accepted_ok", "daemon_killed_ok", "host_lost_ok",
+                  "adopted_ok", "takeover_bounded_ok",
+                  "not_premature_ok", "recovered_ok", "fence_only_ok",
+                  "single_terminal_ok", "bitwise_match",
+                  "fleet_check_ok"))
+        row["outcome"] = "recovered" if ok else "violation"
+    finally:
+        if hosta.poll() is None:  # pragma: no cover — cleanup only
+            hosta.kill()
+            hosta.wait()
+        if b is not None:
+            b.close()
+    return row
+
+
+def _fleet_lease_race(root):
+    """Two live hosts judge the same forged-stale lease dead and race
+    the rename-committed takeover: exactly one wins (the loser's
+    rename hits ENOENT), the loser attaches nothing, and the stranded
+    job gets exactly one dispatch fleet-wide."""
+    import time as _time
+
+    from parallel_heat_tpu.service import fleet
+    from parallel_heat_tpu.service.store import JobStore
+
+    row = {"fault": "fleet_lease_race"}
+    lease_s = 0.5
+    fleet.fleet_init(root, partitions=1, lease_timeout_s=lease_s)
+    part, proot = fleet.partition_roots(root)[0]
+    now = _time.time()
+    # Forge a dead host's residue: a lease whose last renewal is far
+    # past its own timeout, its journal claim line, and a stranded
+    # spooled job.
+    fleet.claim_lease(root, part, "ghost", epoch=1, timeout_s=lease_s,
+                      now=now - 60.0)
+    ghost_store = JobStore(proot)
+    ghost_store.journal.extra = {"host": "ghost"}
+    ghost_store.journal.append("lease_claimed", partition=part,
+                               epoch=1, kind="claim")
+    jid = "job-lease-race"
+    ghost_store.spool_submit(_svc_spec(jid))
+    ghost_store.close()
+
+    mk = lambda h: fleet.FleetHost(fleet.FleetHostConfig(  # noqa: E731
+        fleet_root=root, host=h, slots=1, lease_renew_s=0.1,
+        poll_interval_s=0.05,
+        daemon_opts={"requeue_backoff_base_s": 0.0,
+                     "launcher": _inline_launcher(proot)}))
+    a, b = mk("hosta"), mk("hostb")
+    try:
+        # Both hosts observed the SAME stale doc before either acted —
+        # the adversarial interleave the rename-commit must collapse
+        # to one winner.
+        observed = fleet.read_lease(root, part)
+        row["observed_stale_ok"] = fleet.lease_stale(observed, now)
+        winners = []
+        for h in (a, b):
+            lease = fleet.steal_lease(root, part, observed,
+                                      h.config.host,
+                                      timeout_s=lease_s, now=now)
+            if lease is not None:
+                h.counters["takeovers"] += 1
+                h._attach(part, proot, lease, "takeover",
+                          observed=observed)
+                winners.append(h)
+        row["one_winner_ok"] = len(winners) == 1
+        if not winners:
+            row["outcome"] = "violation"
+            return row
+        w = winners[0]
+        loser = b if w is a else a
+        row["loser_no_lease_ok"] = not loser.leases
+        # Drive BOTH hosts: the loser keeps scanning and must never
+        # poach the winner's fresh lease or dispatch anything.
+        jobs, anomalies = _fleet_drive(
+            [a, b], proot, lambda j: jid in j and j[jid].terminal)
+        events, _, _ = JobStore(proot, create=False).read_journal()
+        disp = [e for e in events if e.get("event") == "dispatched"]
+        claims2 = [e for e in events
+                   if e.get("event") == "lease_claimed"
+                   and e.get("epoch") == 2]
+        lost = [e for e in events if e.get("event") == "host_lost"]
+        row["single_dispatch_ok"] = (
+            len(disp) == 1
+            and disp[0].get("host") == w.config.host)
+        row["single_claim_ok"] = (
+            len(claims2) == 1
+            and claims2[0].get("host") == w.config.host)
+        row["host_lost_ok"] = bool(
+            lost and lost[0].get("lost_host") == "ghost"
+            and lost[0].get("host") == w.config.host)
+        row["completed_ok"] = jobs[jid].state == "completed"
+        row["single_terminal_ok"] = not anomalies
+        a.drain()
+        b.drain()
+        row["fleet_check_ok"] = _fleet_audit_clean(root)
+        ok = all(row.get(k) is True for k in
+                 ("observed_stale_ok", "one_winner_ok",
+                  "loser_no_lease_ok", "single_dispatch_ok",
+                  "single_claim_ok", "host_lost_ok", "completed_ok",
+                  "single_terminal_ok", "fleet_check_ok"))
+        row["outcome"] = "recovered" if ok else "violation"
+    finally:
+        a.close()
+        b.close()
+    return row
+
+
+def _fleet_cache_route(root):
+    """Host A completes a spec on its partition and drains (graceful
+    release); host B takes the partition over and a resubmission of
+    the identical spec routes ``exact`` to A's donor — B serves the
+    PEER's cache entry with zero new dispatches fleet-wide."""
+    from parallel_heat_tpu.service import fleet
+    from parallel_heat_tpu.service.store import JobSpec, JobStore
+
+    row = {"fault": "fleet_cache_route"}
+    fleet.fleet_init(root, partitions=2, lease_timeout_s=5.0)
+    part, proot = fleet.partition_roots(root)[0]
+    cfg = {"nx": 16, "ny": 16, "steps": 60, "backend": "jnp"}
+    mk = lambda h: fleet.FleetHost(fleet.FleetHostConfig(  # noqa: E731
+        fleet_root=root, host=h, slots=1, max_partitions=1,
+        lease_renew_s=0.25, poll_interval_s=0.05,
+        daemon_opts={"requeue_backoff_base_s": 0.0,
+                     "launcher": _inline_launcher(proot)}))
+    a = mk("hosta")
+    try:
+        a.step()  # claims p00 (sorted scan, max_partitions=1)
+        d1 = fleet.route_submission(root, cfg)
+        row["first_routed_p00_ok"] = d1["partition"] == part
+        st = JobStore(d1["root"])
+        st.spool_submit(JobSpec(
+            job_id="route-donor", config=dict(cfg),
+            checkpoint_every=10, backoff_base_s=0.0,
+            route={k: d1[k] for k in ("kind", "partition",
+                                      "donor_key", "gen_step")}))
+        st.close()
+        _fleet_drive([a], proot,
+                     lambda j: "route-donor" in j
+                     and j["route-donor"].terminal)
+        a.drain()  # graceful: lease RELEASED, cache entry committed
+    finally:
+        a.close()
+    b = mk("hostb")
+    try:
+        b.step()  # reclaims p00 at epoch 2 (journal chain continues)
+        d2 = fleet.route_submission(root, cfg)
+        row["route_exact_ok"] = (d2["kind"] == "exact"
+                                 and d2["partition"] == part
+                                 and d2["donor_key"] is not None)
+        events0, _, _ = JobStore(proot, create=False).read_journal()
+        disp0 = sum(1 for e in events0
+                    if e.get("event") == "dispatched")
+        st = JobStore(d2["root"])
+        st.spool_submit(JobSpec(
+            job_id="route-hit", config=dict(cfg),
+            checkpoint_every=10, backoff_base_s=0.0,
+            route={k: d2[k] for k in ("kind", "partition",
+                                      "donor_key", "gen_step")}))
+        st.close()
+        jobs, anomalies = _fleet_drive(
+            [b], proot,
+            lambda j: "route-hit" in j and j["route-hit"].terminal)
+        events, _, _ = JobStore(proot, create=False).read_journal()
+        disp = sum(1 for e in events if e.get("event") == "dispatched")
+        hits = [e for e in events if e.get("event") == "cache_hit"
+                and e.get("job_id") == "route-hit"]
+        claims = [e for e in events
+                  if e.get("event") == "lease_claimed"]
+        row["zero_dispatch_ok"] = disp == disp0 == 1
+        row["served_by_peer_ok"] = bool(
+            hits and hits[0].get("host") == "hostb"
+            and hits[0].get("donor") == "route-donor")
+        row["cache_hit_ok"] = bool(
+            jobs["route-hit"].state == "completed"
+            and (jobs["route-hit"].cached or {}).get("hit") == "exact")
+        row["epoch_chain_ok"] = (
+            [e.get("epoch") for e in claims] == [1, 2]
+            and all(e.get("kind") == "claim" for e in claims))
+        row["single_terminal_ok"] = not anomalies
+        b.drain()
+        row["fleet_check_ok"] = _fleet_audit_clean(root)
+        ok = all(row.get(k) is True for k in
+                 ("first_routed_p00_ok", "route_exact_ok",
+                  "zero_dispatch_ok", "served_by_peer_ok",
+                  "cache_hit_ok", "epoch_chain_ok",
+                  "single_terminal_ok", "fleet_check_ok"))
+        row["outcome"] = "recovered" if ok else "violation"
+    finally:
+        b.close()
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=64)
@@ -1259,6 +1610,13 @@ def main():
                 lag = "" if "orphan_detect_lag_s" not in row else \
                     f"  orphan_lag={row['orphan_detect_lag_s']:.2f}s"
                 print(f"{fault:16s} -> {row['outcome']:20s}"
+                      f"  bitwise={row.get('bitwise_match', '-')}{lag}")
+            for fault in FLEET_FAULTS:
+                row = run_fleet_cell(fault, workdir)
+                rows.append(row)
+                lag = "" if "takeover_lag_s" not in row else \
+                    f"  takeover_lag={row['takeover_lag_s']:.2f}s"
+                print(f"{fault:18s} -> {row['outcome']:20s}"
                       f"  bitwise={row.get('bitwise_match', '-')}{lag}")
         if args.mp or args.mp_only:
             for fault in MP_FAULTS:
@@ -1324,6 +1682,27 @@ def main():
                                     "converge_bitwise_ok",
                                     "single_terminal_ok",
                                     "cache_check_ok"),
+        # The fleet-durability contract (SEMANTICS.md "Fleet
+        # durability"): a SIGKILLed host's lease is reclaimed within
+        # one lease timeout and its in-flight job adopted + completed
+        # bitwise; a stale-lease race has exactly one rename-commit
+        # winner and zero double-dispatch; a peer-cache exact hit is
+        # served by the adopting host with zero dispatches fleet-wide.
+        "fleet_host_sigkill": ("accepted_ok", "daemon_killed_ok",
+                               "host_lost_ok", "adopted_ok",
+                               "takeover_bounded_ok",
+                               "not_premature_ok", "recovered_ok",
+                               "single_terminal_ok", "bitwise_match",
+                               "fleet_check_ok"),
+        "fleet_lease_race": ("observed_stale_ok", "one_winner_ok",
+                             "loser_no_lease_ok",
+                             "single_dispatch_ok", "single_claim_ok",
+                             "host_lost_ok", "completed_ok",
+                             "single_terminal_ok", "fleet_check_ok"),
+        "fleet_cache_route": ("first_routed_p00_ok", "route_exact_ok",
+                              "zero_dispatch_ok", "served_by_peer_ok",
+                              "cache_hit_ok", "epoch_chain_ok",
+                              "single_terminal_ok", "fleet_check_ok"),
         # The distributed-supervision contract (SEMANTICS.md
         # "Distributed supervision"), certified across a REAL process
         # boundary: a single-rank NaN rolls BOTH ranks back to the
@@ -1359,6 +1738,9 @@ def main():
                "svc_overload": "rejected+served",
                "svc_cache_crash": "recovered",
                "svc_cache_prefix_parity": "recovered",
+               "fleet_host_sigkill": "recovered",
+               "fleet_lease_race": "recovered",
+               "fleet_cache_route": "recovered",
                "mp_split_brain": "recovered",
                "mp_peer_lost": "recovered",
                "mp_overlap_parity": "recovered"}

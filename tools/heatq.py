@@ -17,10 +17,19 @@ naming an uncommitted/non-completed donor result record, and index
 fold anomalies (touch/evict of an unknown key) all count as
 ``--check`` failures alongside the journal's.
 
+A FEDERATED root (one carrying the rename-committed ``fleet.json``
+marker) gets the fleet view instead: every partition's journal+cache
+inspection plus the federation-level audit — stale-lease inventory,
+cross-host double-claim (epoch-chain regression / on-disk lease behind
+the journal), cross-host double-dispatch, and adopted-job lineage
+(every ``adopted`` must follow a ``host_lost`` of the same epoch,
+appended by that epoch's claimant, naming a live job). ``--check``
+exits 2 on ANY partition's anomalies or any fleet-level one.
+
 Exit codes: 0 readable (even if empty), 1 unreadable root, 2 when
-``--check`` is set and the journal replay (or the cache audit)
-reports anomalies — the CI spelling of "the durability invariants
-held".
+``--check`` is set and the journal replay (or the cache audit, or the
+fleet audit) reports anomalies — the CI spelling of "the durability
+invariants held".
 """
 
 import argparse
@@ -34,6 +43,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from parallel_heat_tpu.service.cache import (  # noqa: E402
     audit_cache,
     load_cache_index,
+)
+from parallel_heat_tpu.service.fleet import (  # noqa: E402
+    is_fleet_root,
+)
+from parallel_heat_tpu.service.fleet import (  # noqa: E402
+    partition_roots as fleet_partition_roots,
 )
 from parallel_heat_tpu.service.store import (  # noqa: E402
     JobStore,
@@ -60,6 +75,7 @@ def inspect(root):
             "queue_wait_s": wait_s, "wall_s": wall_s,
             "steps_done": v.steps_done, "kind": v.kind,
             "reason": v.reason, "diagnosis": v.diagnosis,
+            "adoptions": list(v.adoptions),
         })
     daemon_events = [e for e in events
                      if e.get("event", "").startswith("daemon_")]
@@ -144,21 +160,91 @@ def render_text(doc):
     return "\n".join(out)
 
 
+def inspect_fleet(fleet_root):
+    """Federated inspection: each partition's full :func:`inspect`
+    doc + the fleet-level audit (stale leases, double-claim, double-
+    dispatch, adoption lineage). ``anomalies`` is the flat roll-up
+    ``--check`` gates on."""
+    from parallel_heat_tpu.service.fleet import audit_fleet
+
+    info, fleet_anoms = audit_fleet(fleet_root)
+    partitions = {}
+    rollup = [f"fleet: {a}" for a in fleet_anoms]
+    for name, proot in fleet_partition_roots(fleet_root):
+        doc = inspect(proot)
+        partitions[name] = doc
+        rollup += [f"{name}: {a}" for a in doc["anomalies"]]
+        rollup += [f"{name}: cache: {a}"
+                   for a in doc["cache"]["anomalies"]]
+    adopted = {}
+    for name, doc in partitions.items():
+        for r in doc["jobs"]:
+            if r.get("adoptions"):
+                adopted[r["job_id"]] = r["adoptions"]
+    return {
+        "root": str(fleet_root), "federated": True,
+        "partitions": partitions,
+        "leases": info["leases"],
+        "stale_leases": info["stale_leases"],
+        "hosts": info["hosts"],
+        "lease_claims": info["lease_claims"],
+        "jobs_adopted": info["jobs_adopted"],
+        "adopted_jobs": adopted,
+        "fleet_anomalies": fleet_anoms,
+        "anomalies": rollup,
+    }
+
+
+def render_fleet_text(doc):
+    out = [f"fleet {doc['root']}: {len(doc['partitions'])} "
+           f"partition(s), {len(doc['hosts'])} host record(s), "
+           f"{doc['lease_claims']} lease claim(s), "
+           f"{doc['jobs_adopted']} adoption(s)"]
+    for host, h in sorted(doc["hosts"].items()):
+        out.append(f"host {host}: {h.get('state')} "
+                   f"platform={h.get('platform')} "
+                   f"leases={','.join(h.get('leases') or []) or '-'}")
+    for name, p in sorted(doc["partitions"].items()):
+        lease = doc["leases"].get(name)
+        holder = (f"{lease['host']} e{lease.get('epoch')}"
+                  if lease else "unleased")
+        out.append(f"partition {name} [{holder}]:")
+        for line in render_text(p).splitlines():
+            out.append("  " + line)
+    for s in doc["stale_leases"]:
+        out.append(f"STALE LEASE: {s['partition']} held by "
+                   f"{s['host']!r} age {s['age_s']:.1f}s")
+    for a in doc["fleet_anomalies"]:
+        out.append(f"FLEET ANOMALY: {a}")
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="inspect a heatd queue root (journal replay + "
-                    "daemon status)")
-    ap.add_argument("root", help="queue root directory")
+                    "daemon status); federated roots (fleet.json) get "
+                    "the fleet audit")
+    ap.add_argument("root", help="queue root directory (or fleet root)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--check", action="store_true",
                     help="exit 2 when the journal replay or the "
-                         "cache-index audit reports anomalies (CI: "
-                         "the durability invariants held)")
+                         "cache-index audit (or, federated, the "
+                         "stale-lease / double-claim / adoption-"
+                         "lineage audit) reports anomalies (CI: the "
+                         "durability invariants held)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.root):
         print(f"error: {args.root}: not a queue root directory",
               file=sys.stderr)
         return 1
+    if is_fleet_root(args.root):
+        doc = inspect_fleet(args.root)
+        if args.json:
+            json.dump(doc, sys.stdout, indent=1)
+            print()
+        else:
+            print(render_fleet_text(doc))
+        return 2 if (args.check and doc["anomalies"]) else 0
     doc = inspect(args.root)
     if args.json:
         json.dump(doc, sys.stdout, indent=1)

@@ -733,6 +733,177 @@ def summarize_fleet(root):
     return doc
 
 
+_FED_SUMMED = (
+    "jobs_accepted", "jobs_rejected", "completed", "quarantined",
+    "cancelled", "deadline_expired", "queued", "running", "failed",
+    "retried", "attempts_total", "requeues", "orphaned", "dispatches",
+    "packed_jobs", "pack_dispatches", "cache_hits",
+    "cache_prefix_hits", "cache_bytes_saved", "cache_steps_saved")
+
+
+def summarize_federation(fleet_root):
+    """Aggregate a FEDERATED root (``fleet.json`` marker): the merged
+    fleet counters over every partition, plus the per-host rows the
+    ISSUE's observability contract names — leases held, jobs adopted,
+    steal count, peer cache hit rate — all gateable through the same
+    ``--fail-on`` grammar (``fleet.<counter>`` dotted paths resolve
+    against the merged section). Latency percentiles are the WORST
+    partition's (per-partition raw samples are not merged — the slow
+    partition is the one the SLO cares about)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from parallel_heat_tpu.service.fleet import (
+        audit_fleet, partition_roots, read_journal_file)
+
+    info, fleet_anoms = audit_fleet(fleet_root)
+    merged = {k: 0 for k in _FED_SUMMED}
+    partitions = {}
+    anomalies_journal = [f"fleet: {a}" for a in fleet_anoms]
+    events_total = bad_total = 0
+    torn_any = False
+    wait = {"p50": None, "p99": None, "max": None}
+    wall = {"p50": None, "p99": None, "max": None}
+    hosts = {}
+
+    def hrow(h):
+        return hosts.setdefault(h, {
+            "leases_held": 0, "lease_claims": 0, "lease_steals": 0,
+            "lease_takeovers": 0, "hosts_lost": 0, "jobs_adopted": 0,
+            "completed": 0, "cache_hits": 0,
+            "peer_cache_hit_rate": None})
+
+    for name, proot in partition_roots(fleet_root):
+        doc = summarize_fleet(proot)
+        partitions[name] = doc["fleet"]
+        anomalies_journal += [f"{name}: {a}"
+                              for a in doc["anomalies_journal"]]
+        events_total += doc["events_total"]
+        bad_total += doc["bad_lines"]
+        torn_any = torn_any or doc["torn_tail"]
+        for k in _FED_SUMMED:
+            merged[k] += doc["fleet"].get(k) or 0
+        for agg, src in ((wait, doc["fleet"]["queue_wait_s"]),
+                         (wall, doc["fleet"]["job_wall_s"])):
+            for q, v in src.items():
+                if v is not None and (agg[q] is None or v > agg[q]):
+                    agg[q] = v
+        # Per-host attribution straight from the host-stamped journal
+        # lines (every daemon append carries its FleetHost's name).
+        events, _bad, _torn = read_journal_file(
+            os.path.join(proot, "journal.jsonl"))
+        done_by, hit_by = {}, {}
+        for e in events:
+            ev, h = e.get("event"), e.get("host")
+            if not h:
+                continue
+            if ev == "lease_claimed":
+                r = hrow(h)
+                r["lease_claims"] += 1
+                kind = e.get("kind")
+                if kind == "steal":
+                    r["lease_steals"] += 1
+                elif kind == "takeover":
+                    r["lease_takeovers"] += 1
+            elif ev == "host_lost":
+                lost = e.get("lost_host")
+                if lost:
+                    hrow(lost)["hosts_lost"] += 1
+            elif ev == "adopted":
+                hrow(h)["jobs_adopted"] += 1
+            elif ev == "completed" and e.get("job_id"):
+                done_by[e["job_id"]] = h
+            elif ev == "cache_hit" and e.get("job_id"):
+                hit_by[e["job_id"]] = h
+        for h in done_by.values():
+            hrow(h)["completed"] += 1
+        for h in hit_by.values():
+            hrow(h)["cache_hits"] += 1
+
+    for part, lease in (info.get("leases") or {}).items():
+        h = (lease or {}).get("host")
+        if h:
+            hrow(h)["leases_held"] += 1
+    for h, r in hosts.items():
+        if r["completed"]:
+            r["peer_cache_hit_rate"] = round(
+                r["cache_hits"] / r["completed"], 4)
+
+    merged.update({
+        "root": str(fleet_root),
+        "partitions": len(partitions),
+        "hosts": len(info.get("hosts") or {}),
+        "lease_claims": info.get("lease_claims", 0),
+        "lease_steals": sum(r["lease_steals"] for r in hosts.values()),
+        "lease_takeovers": sum(r["lease_takeovers"]
+                               for r in hosts.values()),
+        "hosts_lost": sum(r["hosts_lost"] for r in hosts.values()),
+        "jobs_adopted": info.get("jobs_adopted", 0),
+        "stale_leases": len(info.get("stale_leases") or []),
+        "jobs_per_dispatch": None,
+        "cache_hit_rate": (round(merged["cache_hits"]
+                                 / merged["completed"], 4)
+                           if merged["completed"] else None),
+        "cache_prefix_rate": (round(merged["cache_prefix_hits"]
+                                    / merged["completed"], 4)
+                              if merged["completed"] else None),
+        "queue_wait_s": wait, "job_wall_s": wall,
+        "quarantined_jobs": [q for p in partitions.values()
+                             for q in p["quarantined_jobs"]],
+    })
+    return {"fleet": merged, "hosts": hosts, "partitions": partitions,
+            "federated": True, "events_total": events_total,
+            "bad_lines": bad_total, "torn_tail": torn_any,
+            "anomalies_journal": anomalies_journal}
+
+
+def render_federation_text(doc):
+    f = doc["fleet"]
+    out = [f"federation {f['root']}: {f['partitions']} partition(s), "
+           f"{f['hosts']} host record(s) — {f['jobs_accepted']} "
+           f"accepted ({f['completed']} completed, "
+           f"{f['quarantined']} quarantined, {f['queued']} queued, "
+           f"{f['running']} running), {f['jobs_rejected']} rejected"]
+    out.append(f"leases: {f['lease_claims']} claim(s), "
+               f"{f['lease_steals']} steal(s), "
+               f"{f['lease_takeovers']} takeover(s), "
+               f"{f['hosts_lost']} host(s) lost, "
+               f"{f['jobs_adopted']} job(s) adopted, "
+               f"{f['stale_leases']} stale lease(s)")
+    rate = f.get("cache_hit_rate")
+    if f.get("cache_hits") or f.get("cache_prefix_hits"):
+        out.append(f"cache: {f['cache_hits']} exact hit(s)"
+                   + (f" (rate {rate:.0%})" if rate is not None
+                      else "")
+                   + f", {f['cache_prefix_hits']} prefix resume(s), "
+                   f"{f['cache_steps_saved']} step(s) not re-solved")
+    for h, r in sorted(doc["hosts"].items()):
+        phr = r["peer_cache_hit_rate"]
+        out.append(f"  host {h}: leases={r['leases_held']} "
+                   f"claims={r['lease_claims']} "
+                   f"steals={r['lease_steals']} "
+                   f"takeovers={r['lease_takeovers']} "
+                   f"adopted={r['jobs_adopted']} "
+                   f"completed={r['completed']} "
+                   f"cache_hits={r['cache_hits']}"
+                   + (f" hit_rate={phr:.0%}" if phr is not None
+                      else ""))
+    qw, jw = f["queue_wait_s"], f["job_wall_s"]
+    if qw["p50"] is not None:
+        out.append(f"queue wait (worst partition) "
+                   f"p50={qw['p50']:.2f}s p99={qw['p99']:.2f}s "
+                   f"max={qw['max']:.2f}s")
+    if jw["p50"] is not None:
+        out.append(f"job wall  (worst partition) "
+                   f"p50={jw['p50']:.2f}s p99={jw['p99']:.2f}s "
+                   f"max={jw['max']:.2f}s")
+    for q in f["quarantined_jobs"]:
+        out.append(f"  quarantined {q['job_id']}: kind={q['kind']} "
+                   f"({q['reason']})")
+    for a in doc["anomalies_journal"]:
+        out.append(f"JOURNAL ANOMALY: {a}")
+    return "\n".join(out)
+
+
 def render_fleet_text(doc):
     f = doc["fleet"]
     out = [f"fleet {f['root']}: {f['jobs_accepted']} accepted "
@@ -958,14 +1129,23 @@ def _fmt(v):
 
 
 def _fleet_main(args):
-    """Directory input: fleet mode over a heatd queue root."""
+    """Directory input: fleet mode over a heatd queue root, or the
+    federated view when the directory carries the ``fleet.json``
+    marker (same --fail-on grammar against the merged counters)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from parallel_heat_tpu.service.fleet import is_fleet_root
+
+    federated = is_fleet_root(args.metrics)
     journal = os.path.join(args.metrics, "journal.jsonl")
-    if not os.path.isfile(journal):
+    if not federated and not os.path.isfile(journal):
         print(f"error: {args.metrics}: a directory was given but it "
-              f"has no journal.jsonl — not a heatd queue root",
+              f"has no journal.jsonl — not a heatd queue root (and no "
+              f"fleet.json marker)",
               file=sys.stderr)
         return 1
-    doc = summarize_fleet(args.metrics)
+    doc = (summarize_federation(args.metrics) if federated
+           else summarize_fleet(args.metrics))
     anomalies = []
     fleet = doc["fleet"]
     try:
@@ -1005,7 +1185,8 @@ def _fleet_main(args):
         json.dump(doc, sys.stdout, indent=1)
         print()
     else:
-        print(render_fleet_text(doc))
+        print(render_federation_text(doc) if federated
+              else render_fleet_text(doc))
         for a in anomalies:
             print(f"ANOMALY: {a}")
     return 2 if anomalies else 0

@@ -295,6 +295,127 @@ class DaemonState:
         return " | ".join(parts) if parts else None
 
 
+class FleetState:
+    """Probe-side view of a FEDERATED root (``fleet.json`` +
+    ``parts/``): one :class:`DaemonState` per partition for job
+    counts, plus an incremental per-host fold of the host-stamped
+    lease/adoption journal lines — leases held, jobs adopted, steal
+    count, peer cache hit rate per host, same artifact-only
+    discipline (the authoritative audit is ``heatq --check`` /
+    ``metrics_report`` on the fleet root)."""
+
+    def __init__(self, root):
+        self.root = root
+        self.parts = {}
+        self._offsets = {}
+        self._partials = {}
+        self.hosts = {}
+
+    def _hrow(self, h):
+        return self.hosts.setdefault(h, {
+            "claims": 0, "steals": 0, "adopted": 0,
+            "completed": set(), "cache_hits": set()})
+
+    def poll(self):
+        parts_dir = os.path.join(self.root, "parts")
+        try:
+            names = sorted(n for n in os.listdir(parts_dir)
+                           if not n.startswith("."))
+        except OSError:
+            names = []
+        for n in names:
+            proot = os.path.join(parts_dir, n)
+            if n not in self.parts and os.path.isdir(proot):
+                self.parts[n] = DaemonState(proot)
+        for n, d in self.parts.items():
+            d.poll()
+            self._poll_hosts(n)
+
+    def _poll_hosts(self, name):
+        path = os.path.join(self.parts[name].root, "journal.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offsets.get(name, 0))
+                data = f.read()
+        except OSError:
+            return
+        if not data:
+            return
+        self._offsets[name] = self._offsets.get(name, 0) + len(data)
+        buf = self._partials.get(name, b"") + data
+        lines = buf.split(b"\n")
+        self._partials[name] = lines[-1]
+        for line in lines[:-1]:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            ev, h = rec.get("event"), rec.get("host")
+            if not h:
+                continue
+            if ev == "lease_claimed":
+                r = self._hrow(h)
+                r["claims"] += 1
+                if rec.get("kind") in ("steal", "takeover"):
+                    r["steals"] += 1
+            elif ev == "adopted":
+                self._hrow(h)["adopted"] += 1
+            elif ev == "completed" and rec.get("job_id"):
+                self._hrow(h)["completed"].add(rec["job_id"])
+            elif ev == "cache_hit" and rec.get("job_id"):
+                self._hrow(h)["cache_hits"].add(rec["job_id"])
+
+    def _leases_held(self):
+        held = {}
+        d = os.path.join(self.root, "leases")
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return held
+        for n in names:
+            if n.startswith(".") or not n.endswith(".json"):
+                continue
+            doc = read_heartbeat(os.path.join(d, n))
+            if doc and doc.get("host"):
+                held[doc["host"]] = held.get(doc["host"], 0) + 1
+        return held
+
+    @property
+    def exited(self):
+        return bool(self.parts) and all(d.exited
+                                        for d in self.parts.values())
+
+    def render(self, now=None):
+        now = time.time() if now is None else now
+        parts = [f"fleet {len(self.parts)} partition(s)"]
+        counts = {}
+        rejected = 0
+        for d in self.parts.values():
+            for k, v in d.counts().items():
+                counts[k] = counts.get(k, 0) + v
+            rejected += d.rejected
+        if counts or rejected:
+            parts.append(" ".join(f"{k}={v}"
+                                  for k, v in sorted(counts.items()))
+                         + (f" rejected={rejected}" if rejected
+                            else ""))
+        held = self._leases_held()
+        for h in sorted(set(self.hosts) | set(held)):
+            r = self.hosts.get(h) or self._hrow(h)
+            done = len(r["completed"])
+            hits = len(r["cache_hits"])
+            row = (f"{h}: leases={held.get(h, 0)} "
+                   f"adopted={r['adopted']} steals={r['steals']}")
+            if done:
+                row += f" cache_hit_rate={hits / done:.0%}"
+            parts.append(row)
+        if self.exited:
+            parts.append("all hosts exited (drained)")
+        return " | ".join(parts) if len(parts) > 1 else None
+
+
 def render(state, hb, now=None):
     """One status line from whatever is observable. Returns None when
     neither source yielded anything yet."""
@@ -357,6 +478,12 @@ def main(argv=None):
                     help="heatd queue root: show the daemon heartbeat "
                          "+ per-state job counts (live mode exits on "
                          "daemon_exit)")
+    ap.add_argument("--fleet", default=None, metavar="FLEET_ROOT",
+                    help="federated root (fleet.json): merged job "
+                         "counts + per-host rows (leases held, jobs "
+                         "adopted, steal count, peer cache hit rate); "
+                         "live mode exits when every partition's "
+                         "daemon exited")
     ap.add_argument("--once", action="store_true",
                     help="render one status line and exit (0 = data "
                          "observed, 1 = nothing readable)")
@@ -367,23 +494,32 @@ def main(argv=None):
                     help="stop after S seconds even without a run_end "
                          "(for scripts; default: watch forever)")
     args = ap.parse_args(argv)
-    if not args.heartbeat and not args.metrics and not args.daemon:
-        ap.error("give --heartbeat, --metrics and/or --daemon")
+    if not args.heartbeat and not args.metrics and not args.daemon \
+            and not args.fleet:
+        ap.error("give --heartbeat, --metrics, --daemon and/or "
+                 "--fleet")
 
     state = StreamState(args.metrics) if args.metrics else None
     daemon = DaemonState(args.daemon) if args.daemon else None
+    fleet = FleetState(args.fleet) if args.fleet else None
 
     def snapshot():
         if state is not None:
             state.poll()
         if daemon is not None:
             daemon.poll()
+        if fleet is not None:
+            fleet.poll()
         hb = read_heartbeat(args.heartbeat) if args.heartbeat else None
         line = render(state, hb)
         if daemon is not None:
             dline = daemon.render()
             if dline is not None:
                 line = dline if line is None else f"{dline} || {line}"
+        if fleet is not None:
+            fline = fleet.render()
+            if fline is not None:
+                line = fline if line is None else f"{fline} || {line}"
         return line, hb
 
     if args.once:
@@ -416,7 +552,8 @@ def main(argv=None):
             # Exit when the watched thing finished: a drained daemon
             # ends the service view; a run_end ends the run view.
             if ((state is not None and state.outcome is not None)
-                    or (daemon is not None and daemon.exited)):
+                    or (daemon is not None and daemon.exited)
+                    or (fleet is not None and fleet.exited)):
                 if is_tty:
                     sys.stdout.write("\n")
                 return 0

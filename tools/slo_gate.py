@@ -208,7 +208,53 @@ def check_stream(label, paths, tokens, violations):
 
 def check_fleet(root, tokens, hb_max_age_s, violations, now=None):
     """Evaluate fleet tokens + heartbeat freshness against one queue
-    root. Returns False when the target is unusable."""
+    root — or, when the directory carries the ``fleet.json`` marker,
+    against the FEDERATED summary (merged counters, so the same token
+    grammar gates ``jobs_adopted>0``, ``stale_leases>0``,
+    ``cache_hit_rate<0.5`` fleet-wide; heartbeat freshness is judged
+    per fresh-claiming host record). Returns False when the target is
+    unusable."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from parallel_heat_tpu.service.fleet import (
+        host_record_fresh, is_fleet_root, read_host_records)
+
+    if is_fleet_root(root):
+        doc = mr.summarize_federation(root)
+        fleet = doc["fleet"]
+        _events, ceilings, floors = tokens
+        for name, thr, is_floor in (
+                [(n, v, False) for n, v in ceilings]
+                + [(n, v, True) for n, v in floors]):
+            exists, val = mr.resolve_metric(fleet, name)
+            if not exists:
+                print(f"error: {root}: SLO counter {name!r} is not a "
+                      f"federated fleet counter", file=sys.stderr)
+                return False
+            if val is None:
+                continue
+            if is_floor and val < thr:
+                violations.append(f"{root}: {name} = {val:g} < "
+                                  f"{thr:g}")
+            elif not is_floor and val > thr:
+                violations.append(f"{root}: {name} = {val:g} > "
+                                  f"{thr:g}")
+        for a in doc["anomalies_journal"]:
+            violations.append(f"{root}: journal anomaly: {a}")
+        if hb_max_age_s is not None:
+            now = time.time() if now is None else now
+            for host, rec in read_host_records(root).items():
+                if rec.get("state") != "serving":
+                    continue  # drained hosts are legitimately silent
+                if not host_record_fresh(rec, now):
+                    t = rec.get("t_wall")
+                    age = (now - t if isinstance(t, (int, float))
+                           else float("inf"))
+                    violations.append(
+                        f"{root}: host {host!r} record {age:.1f}s old "
+                        f"past its own ttl while state=serving (lost "
+                        f"host? its leases will go stale)")
+        return True
     if not os.path.isfile(os.path.join(root, "journal.jsonl")):
         print(f"error: {root}: no journal.jsonl — not a heatd queue "
               f"root", file=sys.stderr)
