@@ -30,7 +30,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
         fleet-smoke ensemble-smoke trace-smoke cache-smoke \
-        implicit-smoke tune-smoke bench clean
+        implicit-smoke tune-smoke obs-smoke bench clean
 
 all: heat
 
@@ -432,6 +432,93 @@ tune-smoke:
 	b = np.load('.tune_smoke/plain.npy'); \
 	assert np.array_equal(a, b), 'tuned solve diverged from analytic'"
 	rm -rf .tune_smoke
+
+# Flight-recorder run-book as a gate (docs/OBSERVABILITY.md "Fleet
+# flight recorder"): a live 2-host fleet serves two jobs; the recorder
+# folds both hosts into the series DB; the HTTP endpoint must return
+# OpenMetrics with per-host series; a doctored tuning DB (an
+# impossibly fast measured winner for ONE job's geometry) must trip
+# exactly ONE journaled perf_regression — and the latch must hold it
+# at one across a re-evaluation; then the windowed slo_gate and the
+# rollup report over the recorder's own series must both pass.
+obs-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .obs_smoke && mkdir -p .obs_smoke
+	set -e; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-init \
+	    --fleet .obs_smoke/f --partitions 2 --lease-timeout 5; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-serve \
+	    --fleet .obs_smoke/f --host hosta --slots 1 \
+	    --poll-interval 0.1 --max-seconds 300 >/dev/null & \
+	APID=$$!; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-serve \
+	    --fleet .obs_smoke/f --host hostb --slots 1 \
+	    --poll-interval 0.1 --max-seconds 300 >/dev/null & \
+	BPID=$$!; \
+	trap 'kill -9 $$APID $$BPID $$MPID 2>/dev/null || true' EXIT; \
+	JAX_PLATFORMS=cpu $(PY) -c "\
+	from parallel_heat_tpu import tune; \
+	from parallel_heat_tpu.tune.db import TuneDB; \
+	db = TuneDB('.obs_smoke/tunedb'); \
+	db.put('single_2d', tune.current_topology(), \
+	       {'shape': [16, 16], 'dtype': 'float32', \
+	        'accumulate': 'storage'}, \
+	       choice='A', verified=True, \
+	       candidates=[{'choice': 'A', 'feasible': True, \
+	                    'bitwise_verified': True, \
+	                    'min_wall_s': 1e-07}], \
+	       protocol={'timer': 'smoke', 'rounds': 1, \
+	                 'steps_per_call': 1000, 'reference': 'jnp'}); \
+	db.close()"; \
+	SUB="--fleet .obs_smoke/f --checkpoint-every 10 \
+	    --accept-timeout 120 --wait --timeout 180 --quiet"; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-submit $$SUB \
+	    --nx 16 --ny 16 --steps 60 --job-id obs-slow; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu fleet-submit $$SUB \
+	    --nx 24 --ny 24 --steps 60 --job-id obs-ok; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu metrics-serve \
+	    --root .obs_smoke/f --interval 0.2 --max-seconds 120 \
+	    --tune-db .obs_smoke/tunedb >/dev/null 2>&1 & \
+	MPID=$$!; \
+	for i in $$(seq 1 300); do \
+	    [ -s .obs_smoke/f/obs/expo.json ] && break; sleep 0.2; \
+	done; \
+	$(PY) -c "\
+	import json, urllib.request; \
+	doc = json.load(open('.obs_smoke/f/obs/expo.json')); \
+	url = 'http://%s:%d/metrics' % (doc['bind'], doc['port']); \
+	text = urllib.request.urlopen(url, timeout=30).read().decode(); \
+	assert text.endswith('# EOF\n'), text[-80:]; \
+	assert 'heat_completed_total' in text, text[:400]; \
+	assert 'host=\"hosta\"' in text and 'host=\"hostb\"' in text, \
+	    'missing per-host series'"; \
+	kill -TERM $$MPID; wait $$MPID || true; \
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu metrics-serve \
+	    --root .obs_smoke/f --once --tune-db .obs_smoke/tunedb \
+	    >/dev/null; \
+	kill -TERM $$APID $$BPID; \
+	rc=0; wait $$APID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "hosta exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	rc=0; wait $$BPID || rc=$$?; \
+	if [ $$rc -ne 3 ]; then \
+	    echo "hostb exit $$rc != EXIT_PREEMPTED(3)"; exit 1; fi; \
+	$(PY) -c "import json; \
+	evs = [json.loads(l) for l in \
+	       open('.obs_smoke/f/obs/alerts.jsonl')]; \
+	trips = [e for e in evs if e.get('event') == 'alert_tripped' \
+	         and e.get('kind') == 'perf_regression']; \
+	assert len(trips) == 1, trips; \
+	assert 'obs-slow' in trips[0]['key'], trips[0]"; \
+	JAX_PLATFORMS=cpu $(PY) tools/heatq.py .obs_smoke/f --check; \
+	JAX_PLATFORMS=cpu $(PY) tools/slo_gate.py .obs_smoke/f \
+	    --fleet 'quarantined>0,orphaned>0,completed<2' --window 3600; \
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_report.py .obs_smoke/f \
+	    --rollup --fail-on 'quarantined>0,completed<2' --json | \
+	$(PY) -c "import json,sys; d=json.load(sys.stdin); \
+	assert d['completed'] >= 2, d; \
+	assert d['chunks'] >= 3, d"
+	rm -rf .obs_smoke
 
 bench:
 	$(PY) bench.py

@@ -278,7 +278,7 @@ def _parse_mesh(arg: Optional[str], ndim: int, grid_shape=None,
 # surface as the `heatd` console script (service/cli.py).
 _SERVICE_COMMANDS = ("serve", "submit", "status", "cancel", "drain",
                      "fleet-init", "fleet-serve", "fleet-submit",
-                     "fleet-status")
+                     "fleet-status", "metrics-serve")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

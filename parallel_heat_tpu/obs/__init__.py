@@ -1,0 +1,35 @@
+"""Fleet flight recorder — a journal-backed time-series metrics plane.
+
+Three layers, each under the store's proven disk discipline
+(SEMANTICS.md "Job durability"; docs/OBSERVABILITY.md "Time series"):
+
+- :mod:`parallel_heat_tpu.obs.series` — the recorder: folds fleet/queue
+  journals and telemetry streams into per-``(host, partition, counter)``
+  ring-buffer series through a pure, fold-law-tested reducer. Persists
+  as an append-only fsynced delta journal plus a rename-committed
+  snapshot (compaction), so a SIGKILLed recorder recovers by
+  construction — torn tails are invisible to the replay.
+- :mod:`parallel_heat_tpu.obs.expo` — exposition: renders the live
+  series as OpenMetrics/Prometheus text (atomic textfile and a stdlib
+  HTTP endpoint) so standard scrapers watch a fleet with zero custom
+  tooling.
+- :mod:`parallel_heat_tpu.obs.alerts` — alerting: joins live run
+  throughput against the tuning DB's measured winner for the same
+  ``(site, topology, geometry)`` key (``perf_regression``) plus trend
+  alerts (queue-wait growth, cache-hit-rate collapse, heartbeat gaps),
+  journaled with a latch so each condition trips exactly once.
+
+Everything here is OBSERVATION-ONLY orchestration state: no
+``HeatConfig`` field, no cache-key input, no ``_build_runner`` memo-key
+input — enabling or disabling the recorder can never perturb a grid
+(the tune-DB/HL101 partition, pinned by
+``test_obs_observation_only_bitwise``).
+"""
+
+from parallel_heat_tpu.obs.series import (  # noqa: F401 — package API
+    OBS_SCHEMA_VERSION, Recorder, harvest, load_state, new_state,
+    obs_dir_for, reduce_obs, summarize_window)
+from parallel_heat_tpu.obs.expo import (  # noqa: F401 — package API
+    render_openmetrics, write_textfile)
+from parallel_heat_tpu.obs.alerts import (  # noqa: F401 — package API
+    AlertEngine, AlertPolicy, reduce_alerts)

@@ -1,0 +1,393 @@
+"""Alerting: the series joined against tuned baselines and trends.
+
+The headline alert converts PR 16's measurements from a schedule
+picker into a fleet-wide performance baseline: for every dispatched
+job, :func:`tune_expectation` derives the throughput the tuning DB
+MEASURED the hardware can do for the job's own ``(site, topology,
+geometry)`` tune key (winner's ``min_wall_s`` under the recorded
+protocol), and a run whose observed ``steps_per_s`` series sustains
+below ``perf_fraction`` of it trips a journaled ``perf_regression``.
+Trend alerts watch the series alone: queue-wait growth, cache-hit-rate
+collapse, heartbeat gaps.
+
+Alerts are a journal like everything else: ``alert_tripped`` /
+``alert_cleared`` lines in ``obs/alerts.jsonl`` (fsynced appends, torn
+tails skipped), folded by the pure :func:`reduce_alerts`. The fold is
+the LATCH — a condition that stays true trips exactly once until its
+clear line lands, which is what lets the smoke gate assert "exactly
+one journaled perf_regression" across any number of evaluation passes.
+
+Observation-only: evaluating alerts reads journals, series state and
+the tuning DB; it never touches a config, a cache key, or a runner.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.service.store import (
+    Journal, read_journal_file, reduce_journal)
+
+ALERT_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class AlertPolicy:
+    """Thresholds for every alert kind (CLI-overridable; the defaults
+    are deliberately conservative — a trend alert that cries wolf
+    trains operators to ignore the one that matters)."""
+
+    # perf_regression: sustained mean of the run's steps_per_s window
+    # below this fraction of the tuned expectation, with at least this
+    # many chunk samples observed.
+    perf_fraction: float = 0.5
+    perf_min_samples: int = 3
+    # queue_wait_growth: recent-half mean exceeds growth_factor x the
+    # older-half mean AND an absolute floor (tiny waits growing 10x
+    # are still tiny).
+    wait_growth_factor: float = 3.0
+    wait_min_s: float = 5.0
+    wait_min_samples: int = 6
+    # cache_hit_collapse: windowed hit rate below this fraction of the
+    # all-time rate, with enough windowed completions to mean it.
+    cache_collapse_fraction: float = 0.5
+    cache_window_s: float = 300.0
+    cache_min_completed: int = 8
+    # heartbeat_gap: newest sampled heartbeat age past this.
+    hb_max_age_s: float = 30.0
+
+
+def reduce_alerts(events, state=None
+                  ) -> Tuple[Dict[str, dict], List[str]]:
+    """Pure fold of alert-journal events -> ``(active, anomalies)``.
+
+    ``alert_tripped`` latches a key active, ``alert_cleared`` releases
+    it; a duplicate trip or a clear of an unlatched key is an anomaly
+    (the alert plane's double-terminal analogue). Same incremental
+    fold law as every reducer in the repo."""
+    active: Dict[str, dict] = state[0] if state else {}
+    anomalies: List[str] = state[1] if state else []
+    for e in events:
+        ev = e.get("event")
+        key = e.get("key")
+        if not isinstance(key, str):
+            continue
+        if ev == "alert_tripped":
+            if key in active:
+                anomalies.append(f"alerts: duplicate trip of {key}")
+                continue
+            active[key] = {k: e.get(k) for k in
+                           ("key", "kind", "host", "part", "job_id",
+                            "t_wall", "detail")}
+        elif ev == "alert_cleared":
+            if active.pop(key, None) is None:
+                anomalies.append(f"alerts: clear of unlatched {key}")
+    return active, anomalies
+
+
+# ---------------------------------------------------------------------------
+# Tuned-baseline expectation lookup
+# ---------------------------------------------------------------------------
+
+def tune_expectation(config: dict, db_root: str,
+                     topology: Optional[dict] = None
+                     ) -> Optional[float]:
+    """Expected ``steps_per_s`` for one job config from the tuning
+    DB's measured winner, or ``None`` when the DB has no sound entry
+    for the job's tune key (no alert without measured evidence —
+    mirrors ``TuneDB.lookup``'s refusal to act on rejected entries).
+
+    The join reuses the DB's own key discipline: ``tune_key(site,
+    topology, geometry)`` over the ``single_2d`` geometry built from
+    the job's committed config. ``topology`` defaults to
+    ``tune.current_topology()`` (needs jax); tests inject it."""
+    from parallel_heat_tpu import tune
+    from parallel_heat_tpu.tune.db import load_tune_db, tune_key
+
+    if not isinstance(config, dict) or config.get("nz"):
+        return None  # only the 2D single-grid site carries a baseline
+    try:
+        nx, ny = int(config.get("nx") or 0), int(config.get("ny") or 0)
+    except (TypeError, ValueError):
+        return None
+    if nx <= 0 or ny <= 0:
+        return None
+    geometry = {"shape": [nx, ny],
+                "dtype": str(config.get("dtype") or "float32"),
+                "accumulate": str(config.get("accumulate")
+                                  or "storage")}
+    if topology is None:
+        try:
+            topology = tune.current_topology()
+        except Exception:  # noqa: BLE001 — no devices = no baseline
+            return None
+    try:
+        key, _canon = tune_key("single_2d", topology, geometry)
+    except ValueError:
+        return None
+    entries, _anom, _bad, _torn = load_tune_db(db_root)
+    e = entries.get(key)
+    if e is None or not e.get("verified"):
+        return None
+    record = _read_record(db_root, key)
+    if record is None or record.get("choice") != e.get("choice"):
+        return None
+    wall = None
+    for c in record.get("candidates") or []:
+        if (isinstance(c, dict) and c.get("choice") == e.get("choice")
+                and isinstance(c.get("min_wall_s"), (int, float))):
+            wall = float(c["min_wall_s"])
+    protocol = record.get("protocol") or {}
+    steps = protocol.get("steps_per_call")
+    if (wall is None or wall <= 0.0
+            or not isinstance(steps, (int, float)) or steps <= 0):
+        return None
+    return float(steps) / wall
+
+
+def _read_record(db_root: str, key: str) -> Optional[dict]:
+    import json
+
+    try:
+        with open(os.path.join(str(db_root), "records",
+                               f"{key}.json")) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The engine: journal writer + condition evaluation
+# ---------------------------------------------------------------------------
+
+class AlertEngine:
+    """The write handle of one alert journal + the evaluators.
+
+    :meth:`evaluate` computes every condition from the series state
+    (plus the job journals and tuning DB for ``perf_regression``),
+    trips latched keys that became true and clears keys that became
+    false; it returns the NEWLY tripped alerts so a caller can react
+    (the CLI prints them, the smoke gate counts them)."""
+
+    def __init__(self, obs_dir: str,
+                 policy: Optional[AlertPolicy] = None):
+        self.obs_dir = str(obs_dir)
+        self.policy = policy or AlertPolicy()
+        self.path = os.path.join(self.obs_dir, "alerts.jsonl")
+        self._journal: Optional[Journal] = None
+
+    @property
+    def journal(self) -> Journal:
+        if self._journal is None:
+            os.makedirs(self.obs_dir, exist_ok=True)
+            self._journal = Journal(self.path)
+        return self._journal
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    def __enter__(self) -> "AlertEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def active(self) -> Dict[str, dict]:
+        events, _bad, _torn = read_journal_file(self.path)
+        active, _anom = reduce_alerts(events)
+        return active
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, state: dict, *, root: Optional[str] = None,
+                 tune_db: Optional[str] = None,
+                 topology: Optional[dict] = None,
+                 now: Optional[float] = None) -> List[dict]:
+        now = time.time() if now is None else float(now)
+        conditions: Dict[str, dict] = {}
+        self._trend_conditions(state, conditions)
+        if root and tune_db:
+            self._perf_conditions(state, root, tune_db, topology,
+                                  conditions)
+        active = self.active()
+        tripped = []
+        for key, alert in sorted(conditions.items()):
+            if key in active:
+                continue
+            rec = self.journal.append("alert_tripped", key=key,
+                                      **alert)
+            tripped.append(rec)
+        for key in sorted(active):
+            kind = key.split("|", 1)[0]
+            # perf_regression latches per JOB: a finished run cannot
+            # "recover", and re-clearing would re-arm the latch the
+            # smoke gate counts on. Trend alerts clear on recovery.
+            if kind == "perf_regression":
+                continue
+            if key not in conditions:
+                self.journal.append("alert_cleared", key=key)
+        return tripped
+
+    def _trend_conditions(self, state: dict,
+                          conditions: Dict[str, dict]) -> None:
+        p = self.policy
+        series = state.get("series", {})
+        by_part: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        for ser in series.values():
+            by_part.setdefault((ser["host"], ser["part"]),
+                               {})[ser["counter"]] = ser
+        for (host, part), group in sorted(by_part.items()):
+            wait = group.get("queue_wait_s")
+            if wait:
+                vals = [v for _t, v in wait["raw"]]
+                if len(vals) >= p.wait_min_samples:
+                    half = len(vals) // 2
+                    older = sum(vals[:half]) / half
+                    recent = sum(vals[half:]) / (len(vals) - half)
+                    if (recent >= p.wait_min_s
+                            and recent > p.wait_growth_factor
+                            * max(older, 1e-9)):
+                        key = f"queue_wait_growth|{host}|{part}"
+                        conditions[key] = {
+                            "kind": "queue_wait_growth", "host": host,
+                            "part": part,
+                            "detail": {"older_mean_s": older,
+                                       "recent_mean_s": recent}}
+            completed = group.get("completed")
+            hits = group.get("cache_hits")
+            if completed and hits and completed["raw"]:
+                total_c = completed["raw"][-1][1]
+                total_h = hits["raw"][-1][1]
+                t_cut = completed["raw"][-1][0] - p.cache_window_s
+                win_c = total_c - _counter_at(completed["raw"], t_cut)
+                win_h = total_h - _counter_at(hits["raw"], t_cut)
+                if (total_c > 0 and win_c >= p.cache_min_completed):
+                    overall = total_h / total_c
+                    recent = win_h / win_c
+                    if (overall > 0
+                            and recent < p.cache_collapse_fraction
+                            * overall):
+                        key = f"cache_hit_collapse|{host}|{part}"
+                        conditions[key] = {
+                            "kind": "cache_hit_collapse",
+                            "host": host, "part": part,
+                            "detail": {"overall_rate": overall,
+                                       "recent_rate": recent}}
+            for age_counter in ("daemon_hb_age_s",
+                                "host_record_age_s"):
+                ser = group.get(age_counter)
+                if ser and ser["raw"]:
+                    age = ser["raw"][-1][1]
+                    if age > p.hb_max_age_s:
+                        key = f"heartbeat_gap|{host}|{part}"
+                        conditions[key] = {
+                            "kind": "heartbeat_gap", "host": host,
+                            "part": part,
+                            "detail": {"source": age_counter,
+                                       "age_s": age,
+                                       "max_age_s": p.hb_max_age_s}}
+
+    def _perf_conditions(self, state: dict, root: str, tune_db: str,
+                         topology: Optional[dict],
+                         conditions: Dict[str, dict]) -> None:
+        """One condition per dispatched job whose observed throughput
+        window sustains below the tuned baseline. The join: the job's
+        partition names the ``steps_per_s`` series; the job's
+        dispatch/terminal times bound the window; the job's committed
+        config names the tune key."""
+        p = self.policy
+        expectations: Dict[str, Optional[float]] = {}
+        for part, proot in _partitions(root):
+            events, _bad, _torn = read_journal_file(
+                os.path.join(proot, "journal.jsonl"))
+            jobs, _anom = reduce_journal(events)
+            for jid in sorted(jobs):
+                v = jobs[jid]
+                if v.first_dispatch_t is None:
+                    continue
+                if v.cached is not None:
+                    continue  # cache-served: no solve to regress
+                spec = _read_json(os.path.join(proot, "jobs",
+                                               f"{jid}.json"))
+                if spec is None:
+                    continue
+                cfg = spec.get("config")
+                cfg_key = _stable(cfg)
+                if cfg_key not in expectations:
+                    expectations[cfg_key] = tune_expectation(
+                        cfg, tune_db, topology=topology)
+                expected = expectations[cfg_key]
+                if expected is None:
+                    continue
+                t0 = v.first_dispatch_t
+                t1 = v.terminal_t if v.terminal_t is not None \
+                    else math.inf
+                obs = []
+                for ser in state.get("series", {}).values():
+                    if (ser["part"] == part
+                            and ser["counter"] == "steps_per_s"):
+                        obs.extend(val for t, val in ser["raw"]
+                                   if t0 <= t <= t1)
+                if len(obs) < p.perf_min_samples:
+                    continue
+                sustained = sum(obs) / len(obs)
+                if sustained < p.perf_fraction * expected:
+                    key = f"perf_regression|{part}|{jid}"
+                    conditions[key] = {
+                        "kind": "perf_regression", "host": "",
+                        "part": part, "job_id": jid,
+                        "detail": {
+                            "observed_steps_per_s": sustained,
+                            "expected_steps_per_s": expected,
+                            "fraction": p.perf_fraction,
+                            "n_samples": len(obs)}}
+
+
+def _counter_at(raw, t: float) -> float:
+    v = 0.0
+    for ts, val in raw:
+        if ts > t:
+            break
+        v = val
+    return v
+
+
+def _partitions(root: str) -> List[Tuple[str, str]]:
+    root = str(root)
+    if os.path.isfile(os.path.join(root, "fleet.json")):
+        parts_dir = os.path.join(root, "parts")
+        try:
+            names = sorted(n for n in os.listdir(parts_dir)
+                           if not n.startswith(".") and
+                           os.path.isdir(os.path.join(parts_dir, n)))
+        except OSError:
+            return []
+        return [(n, os.path.join(parts_dir, n)) for n in names]
+    return [("", root)]
+
+
+def _read_json(path: str) -> Optional[dict]:
+    import json
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _stable(doc) -> str:
+    import json
+
+    try:
+        return json.dumps(doc, sort_keys=True)
+    except (TypeError, ValueError):
+        return repr(doc)

@@ -1,0 +1,200 @@
+"""Exposition: live series -> OpenMetrics text, two transports.
+
+- :func:`render_openmetrics` — pure render of a recorder state into
+  the OpenMetrics text exposition format (``# TYPE``/``# HELP`` per
+  family, ``heat_``-prefixed sample lines, ``# EOF`` terminator), the
+  grammar ``test_obs_openmetrics_grammar`` validates line by line;
+- :func:`write_textfile` — rename-committed textfile export for the
+  node-exporter textfile-collector pattern (a scraper never reads a
+  torn file);
+- :class:`ExpoServer` — a stdlib ``http.server`` endpoint serving
+  ``GET /metrics`` so a standard Prometheus scrape config watches a
+  fleet with zero custom tooling. Read-only by construction: the
+  handler renders whatever state the recorder last folded.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from parallel_heat_tpu.utils.checkpoint import _fsync_replace
+
+CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+
+# Family name prefix: every series this plane exposes is greppable as
+# heat_* (the obs-smoke gate curls for it).
+METRIC_PREFIX = "heat_"
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+_HELP = {
+    "jobs_accepted": "jobs admitted into the durable queue",
+    "jobs_rejected": "submissions refused by the admission gate",
+    "dispatches": "job dispatches to workers (includes re-dispatch)",
+    "completed": "jobs reaching the completed terminal state",
+    "quarantined": "jobs quarantined as poison",
+    "cancelled": "jobs cancelled",
+    "deadline_expired": "jobs interrupted at their deadline",
+    "requeues": "failed/preempted jobs re-admitted under backoff",
+    "orphaned": "jobs orphaned by dead workers",
+    "worker_failures": "worker attempts that failed",
+    "hosts_lost": "stale fleet hosts detected at lease takeover",
+    "jobs_adopted": "in-flight jobs adopted across hosts",
+    "lease_claims": "partition lease claims",
+    "lease_takeovers": "partition leases taken over from stale hosts",
+    "cache_hits": "completions served from the result cache",
+    "chunks": "solver chunks reported by telemetry",
+    "steps_per_s": "solver throughput (steps per second)",
+    "mcells_steps_per_s": "solver throughput (Mcell-steps per second)",
+    "gap_s": "device idle seconds charged to a chunk",
+    "queue_wait_s": "acceptance to first dispatch wait (seconds)",
+    "daemon_hb_age_s": "age of the partition daemon's heartbeat",
+    "host_record_age_s": "age of a fleet host's capacity record",
+    "leases_held": "partition leases currently held by a host",
+    "queued": "queued jobs per the daemon status heartbeat",
+    "running": "running workers per the daemon status heartbeat",
+}
+
+
+def _metric_name(counter: str) -> str:
+    return METRIC_PREFIX + _NAME_SANITIZE_RE.sub("_", str(counter))
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\"", "\\\"")
+            .replace("\n", "\\n"))
+
+
+def _labels(ser: dict) -> str:
+    pairs = [(k, ser.get(k)) for k in ("host", "part")]
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in pairs if v)
+    return "{" + inner + "}" if inner else ""
+
+
+def _fmt(value: float) -> str:
+    # OpenMetrics numbers: plain decimal; integral values render
+    # without a trailing .0 so counter lines stay grep-friendly.
+    f = float(value)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_openmetrics(state: dict) -> str:
+    """Render one recorder state as OpenMetrics text. Families are
+    emitted sorted and contiguously (TYPE/HELP before their samples,
+    never interleaved), counters get the ``_total`` sample suffix, and
+    the document ends with the mandatory ``# EOF``."""
+    families: dict = {}
+    for key in sorted(state.get("series", {})):
+        ser = state["series"][key]
+        raw = ser.get("raw") or []
+        if not raw:
+            continue
+        name = _metric_name(ser["counter"])
+        kind = "counter" if ser.get("kind") == "counter" else "gauge"
+        fam = families.setdefault(name, {"kind": kind,
+                                         "counter": ser["counter"],
+                                         "samples": []})
+        if fam["kind"] != kind:
+            continue  # same counter name with two kinds: first wins
+        fam["samples"].append((_labels(ser), raw[-1][1], raw[-1][0]))
+    lines = []
+    for name in sorted(families):
+        fam = families[name]
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        help_text = _HELP.get(fam["counter"])
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        suffix = "_total" if fam["kind"] == "counter" else ""
+        for labels, value, _t in fam["samples"]:
+            lines.append(f"{name}{suffix}{labels} {_fmt(value)}")
+    lines.append("# TYPE heat_obs_samples counter")
+    lines.append("# HELP heat_obs_samples samples folded into the "
+                 "recorder's series state")
+    lines.append(f"heat_obs_samples_total "
+                 f"{_fmt(state.get('n_samples', 0))}")
+    lines.append("# TYPE heat_obs_harvests counter")
+    lines.append("# HELP heat_obs_harvests recorder harvest passes "
+                 "journaled")
+    lines.append(f"heat_obs_harvests_total "
+                 f"{_fmt(state.get('n_harvests', 0))}")
+    last_t = state.get("last_t")
+    if isinstance(last_t, (int, float)):
+        lines.append("# TYPE heat_obs_last_harvest_timestamp_seconds "
+                     "gauge")
+        lines.append(f"heat_obs_last_harvest_timestamp_seconds "
+                     f"{_fmt(last_t)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def write_textfile(path: str, text: str) -> str:
+    """Rename-committed exposition export (the checkpoint discipline
+    on a text file): a concurrent scraper reads the previous complete
+    document or the new one, never a torn mix."""
+    path = str(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".tmp-{os.getpid()}-"
+                          f"{os.path.basename(path)}")
+    with open(tmp, "w") as f:
+        f.write(text)
+    _fsync_replace(tmp, path)
+    return path
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "heatd-obs/1"
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+            self.send_error(404)
+            return
+        try:
+            body = self.server.render().encode("utf-8")  # type: ignore[attr-defined]
+        except Exception as e:  # noqa: BLE001 — a scrape must not kill the server
+            self.send_error(500, explain=repr(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet by design
+        pass
+
+
+class ExpoServer:
+    """One scrape endpoint over a render callback. ``port=0`` binds an
+    ephemeral port (read it back from :attr:`port` — the CLI publishes
+    it in ``obs/expo.json`` so smoke scripts and scrapers can find
+    it)."""
+
+    def __init__(self, render: Callable[[], str],
+                 bind: str = "127.0.0.1", port: int = 0):
+        self._httpd = ThreadingHTTPServer((bind, int(port)), _Handler)
+        self._httpd.render = render  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.bind = bind
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ExpoServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="heatd-obs-expo", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

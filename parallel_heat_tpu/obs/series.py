@@ -1,0 +1,634 @@
+"""The recorder: journals + telemetry -> durable ring-buffer series.
+
+One :class:`Recorder` watches a queue root (or a whole fleet root) and
+folds everything the service already writes — partition state journals,
+per-job telemetry sinks, daemon/host heartbeats, lease files — into
+per-``(host, partition, counter)`` time series with three retention
+tiers (raw points -> 1-minute rollups -> 1-hour rollups). Nothing in
+the serving path changes: the recorder is a pure reader of artifacts
+other processes commit, exactly like ``tools/monitor.py``, but it
+PERSISTS what it reads so trends survive the recorder itself.
+
+Durability is the store's own discipline, applied twice:
+
+- every harvest pass appends ONE fsynced line to the active delta
+  journal — ``{"event": "harvest", "t", "samples": [...], "cursors":
+  {...}}`` — carrying both the new samples and the advanced source
+  cursors, so a SIGKILL between any two passes loses nothing and a
+  SIGKILL mid-append leaves one torn tail line the replay skips.
+  Samples and cursor advance commit TOGETHER or not at all: a replayed
+  recorder can never double-count a source line;
+- compaction rename-commits a snapshot (``snapshot.json``, folded
+  state + generation) and rotates to a fresh delta file; recovery
+  loads the snapshot and refolds only delta files of its generation or
+  newer. A crash inside the compaction window leaves either the old
+  snapshot + full deltas (refold) or the new snapshot + stale delta
+  files it ignores by generation — both exact.
+
+The fold itself (:func:`reduce_obs`) is a pure left fold with the
+journal reducers' incremental law — ``reduce(prefix) then
+reduce(suffix, state) == reduce(prefix + suffix)`` at EVERY cut
+(pinned by ``test_obs_fold_law_every_cut``) — which is what makes the
+snapshot/delta split correct by construction rather than by protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from parallel_heat_tpu.service.store import (
+    Journal, read_journal_file)
+from parallel_heat_tpu.utils.checkpoint import _fsync_replace
+
+OBS_SCHEMA_VERSION = 1
+
+# Retention tiers: raw points per series, then 1-minute and 1-hour
+# downsampled rollup buckets. Caps bound the snapshot (and therefore
+# recorder memory) to O(series * caps) regardless of fleet age:
+# ~8.5 hours of 1 Hz raw, 24 hours of minutes, 30 days of hours.
+RAW_CAP = 512
+M1_CAP = 1440
+H1_CAP = 720
+M1_BUCKET_S = 60.0
+H1_BUCKET_S = 3600.0
+
+# Compaction threshold for the active delta journal. Small enough that
+# recovery refolds are cheap, large enough that steady-state polling
+# rarely compacts.
+COMPACT_BYTES = 1 << 18
+
+# State-journal event -> fleet counter. Every entry is a monotone
+# per-(host, partition) event count; the fold accumulates the
+# cumulative totals OpenMetrics counters want.
+JOURNAL_COUNTERS = {
+    "accepted": "jobs_accepted",
+    "rejected": "jobs_rejected",
+    "dispatched": "dispatches",
+    "completed": "completed",
+    "quarantined": "quarantined",
+    "cancelled": "cancelled",
+    "deadline_expired": "deadline_expired",
+    "requeued": "requeues",
+    "orphaned": "orphaned",
+    "worker_failed": "worker_failures",
+    "host_lost": "hosts_lost",
+    "adopted": "jobs_adopted",
+    "lease_claimed": "lease_claims",
+}
+
+
+def obs_dir_for(root) -> str:
+    """The observability plane of one root lives beside the data it
+    observes — ``<root>/obs/`` — so a fleet root carries exactly one
+    recorder the same way it carries one ``fleet.json``."""
+    return os.path.join(str(root), "obs")
+
+
+def new_state() -> dict:
+    return {"schema": OBS_SCHEMA_VERSION, "series": {}, "cursors": {},
+            "last_t": None, "n_samples": 0, "n_harvests": 0}
+
+
+def series_key(host: str, part: str, counter: str) -> str:
+    return f"{host}|{part}|{counter}"
+
+
+# ---------------------------------------------------------------------------
+# The pure fold
+# ---------------------------------------------------------------------------
+
+def _bucket_fold(buckets: List[list], bucket_t: float, value: float,
+                 cap: int) -> None:
+    """Fold one point into a rollup tier (in place). Downsampling is
+    itself a left fold: the newest bucket aggregates min/max/sum/count/
+    last, a new bucket time appends, the cap trims from the front. A
+    sample older than the newest bucket merges into its own bucket if
+    that bucket is still retained and is dropped otherwise — late data
+    can never reorder the ring."""
+    if buckets and bucket_t < buckets[-1][0]:
+        for b in reversed(buckets):
+            if b[0] == bucket_t:
+                agg = b[1]
+                break
+            if b[0] < bucket_t:
+                return  # its bucket was never created: drop
+        else:
+            return  # older than the whole ring: drop
+    elif buckets and bucket_t == buckets[-1][0]:
+        agg = buckets[-1][1]
+    else:
+        buckets.append([bucket_t, {"min": value, "max": value,
+                                   "sum": value, "count": 1,
+                                   "last": value}])
+        del buckets[:-cap]
+        return
+    agg["min"] = min(agg["min"], value)
+    agg["max"] = max(agg["max"], value)
+    agg["sum"] += value
+    agg["count"] += 1
+    agg["last"] = value
+
+
+def _fold_sample(state: dict, s: dict) -> None:
+    try:
+        t = float(s["t"])
+        value = float(s["value"])
+        counter = str(s["counter"])
+    except (KeyError, TypeError, ValueError):
+        return  # foreign/torn sample: ignored, never fatal
+    if not (math.isfinite(t) and math.isfinite(value)):
+        return
+    host = str(s.get("host") or "")
+    part = str(s.get("part") or "")
+    kind = "counter" if s.get("kind") == "counter" else "gauge"
+    key = series_key(host, part, counter)
+    ser = state["series"].get(key)
+    if ser is None:
+        ser = state["series"][key] = {
+            "host": host, "part": part, "counter": counter,
+            "kind": kind, "raw": [], "m1": [], "h1": []}
+    if ser["kind"] == "counter":
+        # Samples carry INCREMENTS; the fold owns the cumulative total
+        # (what a restart-spanning OpenMetrics counter needs), so the
+        # harvester stays stateless about totals.
+        prev = ser["raw"][-1][1] if ser["raw"] else 0.0
+        value = prev + value
+    ser["raw"].append([t, value])
+    del ser["raw"][:-RAW_CAP]
+    _bucket_fold(ser["m1"], math.floor(t / M1_BUCKET_S) * M1_BUCKET_S,
+                 value, M1_CAP)
+    _bucket_fold(ser["h1"], math.floor(t / H1_BUCKET_S) * H1_BUCKET_S,
+                 value, H1_CAP)
+    state["n_samples"] += 1
+
+
+def reduce_obs(events, state: Optional[dict] = None) -> dict:
+    """Pure left fold of delta-journal events -> series state.
+
+    Same incremental law as ``reduce_journal``/``reduce_tune_journal``:
+    pass a previous call's state to fold only appended events —
+    ``reduce(prefix) then reduce(suffix, state)`` equals
+    ``reduce(prefix + suffix)`` at every cut. Unknown events and
+    fields are ignored (forward compatibility)."""
+    if state is None:
+        state = new_state()
+    for e in events:
+        if e.get("event") != "harvest":
+            continue
+        for s in e.get("samples") or []:
+            if isinstance(s, dict):
+                _fold_sample(state, s)
+        if isinstance(e.get("cursors"), dict):
+            state["cursors"] = e["cursors"]
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            state["last_t"] = (t if state["last_t"] is None
+                               else max(state["last_t"], t))
+        state["n_harvests"] += 1
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Harvest: source artifacts -> samples (the impure edge)
+# ---------------------------------------------------------------------------
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _new_complete_lines(path: str, offset: int) -> Tuple[list, int]:
+    """JSON records appended past ``offset``, consuming only WHOLE
+    lines (the ``TuneDB.entries`` offset discipline): a read racing an
+    appender re-reads the torn tail complete next pass, so a record is
+    harvested exactly once or not yet — never half."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    recs = []
+    for line in data[:end + 1].split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            recs.append(rec)
+    return recs, offset + end + 1
+
+
+def _is_fleet_root(root: str) -> bool:
+    return os.path.isfile(os.path.join(root, "fleet.json"))
+
+
+def _partition_roots(root: str) -> List[Tuple[str, str]]:
+    parts_dir = os.path.join(root, "parts")
+    try:
+        names = sorted(n for n in os.listdir(parts_dir)
+                       if not n.startswith(".")
+                       and os.path.isdir(os.path.join(parts_dir, n)))
+    except OSError:
+        return []
+    return [(n, os.path.join(parts_dir, n)) for n in names]
+
+
+def _sample(samples: list, *, t, host, part, counter, kind, value
+            ) -> None:
+    samples.append({"t": float(t), "host": str(host or ""),
+                    "part": str(part or ""), "counter": str(counter),
+                    "kind": kind, "value": float(value)})
+
+
+def _harvest_journal(part_root: str, part: str, pc: dict,
+                     samples: list, now: float) -> None:
+    recs, off = _new_complete_lines(
+        os.path.join(part_root, "journal.jsonl"),
+        int(pc.get("journal") or 0))
+    pc["journal"] = off
+    accepted = pc.setdefault("accepted", {})
+    job_host = pc.setdefault("job_host", {})
+    for e in recs:
+        ev = e.get("event")
+        if not isinstance(ev, str):
+            continue
+        t = e.get("t_wall")
+        t = float(t) if isinstance(t, (int, float)) else now
+        host = str(e.get("host") or "")
+        jid = e.get("job_id")
+        counter = JOURNAL_COUNTERS.get(ev)
+        if counter:
+            _sample(samples, t=t, host=host, part=part,
+                    counter=counter, kind="counter", value=1)
+        if ev == "completed" and isinstance(e.get("cache"), dict):
+            _sample(samples, t=t, host=host, part=part,
+                    counter="cache_hits", kind="counter", value=1)
+        if ev == "lease_claimed" and e.get("kind") in ("steal",
+                                                       "takeover"):
+            _sample(samples, t=t, host=host, part=part,
+                    counter="lease_takeovers", kind="counter", value=1)
+        if not isinstance(jid, str):
+            continue
+        if ev == "accepted":
+            accepted[jid] = t
+        elif ev == "dispatched":
+            job_host[jid] = host
+            t_acc = accepted.pop(jid, None)
+            if t_acc is not None:
+                # First dispatch only (the pop is the latch): the
+                # queue-wait gauge mirrors metrics_report's
+                # accepted -> first-dispatch join.
+                _sample(samples, t=t, host=host, part=part,
+                        counter="queue_wait_s", kind="gauge",
+                        value=max(0.0, t - t_acc))
+        elif ev in ("completed", "quarantined", "cancelled",
+                    "deadline_expired", "rejected"):
+            accepted.pop(jid, None)
+
+
+def _harvest_telemetry(part_root: str, part: str, pc: dict,
+                       samples: list) -> None:
+    tdir = os.path.join(part_root, "telemetry")
+    try:
+        names = sorted(n for n in os.listdir(tdir)
+                       if n.endswith(".jsonl") and not n.startswith("."))
+    except OSError:
+        return
+    offsets = pc.setdefault("telemetry", {})
+    for gone in [n for n in offsets if n not in names]:
+        del offsets[gone]
+    job_host = pc.get("job_host") or {}
+    for name in names:
+        recs, off = _new_complete_lines(os.path.join(tdir, name),
+                                        int(offsets.get(name) or 0))
+        offsets[name] = off
+        host = job_host.get(name.partition(".")[0], "")
+        for e in recs:
+            if e.get("event") != "chunk":
+                continue
+            t = e.get("t_wall")
+            if not isinstance(t, (int, float)):
+                continue
+            _sample(samples, t=t, host=host, part=part,
+                    counter="chunks", kind="counter", value=1)
+            for gauge in ("steps_per_s", "mcells_steps_per_s",
+                          "gap_s"):
+                v = e.get(gauge)
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    _sample(samples, t=t, host=host, part=part,
+                            counter=gauge, kind="gauge", value=v)
+
+
+def _harvest_daemon_status(part_root: str, part: str, samples: list,
+                           now: float) -> None:
+    doc = _read_json(os.path.join(part_root, "heatd.json"))
+    if doc is None:
+        return
+    t = doc.get("t_wall")
+    if isinstance(t, (int, float)):
+        _sample(samples, t=now, host=str(doc.get("host") or ""),
+                part=part, counter="daemon_hb_age_s", kind="gauge",
+                value=max(0.0, now - t))
+    counts = doc.get("counts") or {}
+    for gauge in ("queued", "running"):
+        v = counts.get(gauge)
+        if isinstance(v, (int, float)):
+            _sample(samples, t=now, host=str(doc.get("host") or ""),
+                    part=part, counter=gauge, kind="gauge", value=v)
+
+
+def _harvest_fleet_level(root: str, samples: list, now: float) -> None:
+    hosts_dir = os.path.join(root, "hosts")
+    try:
+        names = sorted(n for n in os.listdir(hosts_dir)
+                       if n.endswith(".json") and not n.startswith("."))
+    except OSError:
+        names = []
+    for n in names:
+        doc = _read_json(os.path.join(hosts_dir, n))
+        if doc is None or not doc.get("host"):
+            continue
+        t = doc.get("t_wall")
+        if isinstance(t, (int, float)):
+            _sample(samples, t=now, host=doc["host"], part="",
+                    counter="host_record_age_s", kind="gauge",
+                    value=max(0.0, now - t))
+    leases_dir = os.path.join(root, "leases")
+    held: Dict[str, int] = {}
+    try:
+        lnames = sorted(n for n in os.listdir(leases_dir)
+                        if n.endswith(".json") and not n.startswith("."))
+    except OSError:
+        lnames = []
+    for n in lnames:
+        doc = _read_json(os.path.join(leases_dir, n))
+        if doc is not None and doc.get("host"):
+            held[doc["host"]] = held.get(doc["host"], 0) + 1
+    for host, count in sorted(held.items()):
+        _sample(samples, t=now, host=host, part="",
+                counter="leases_held", kind="gauge", value=count)
+
+
+def harvest(root, cursors: dict, now: Optional[float] = None
+            ) -> Tuple[list, dict]:
+    """One incremental pass over a queue/fleet root ->
+    ``(samples, advanced_cursors)``.
+
+    Deterministic given the disk and ``now``; never mutates its
+    ``cursors`` argument (the caller commits samples and cursors
+    together in one journal line, so an append that fails must leave
+    the in-memory cursors untouched)."""
+    now = time.time() if now is None else float(now)
+    root = str(root)
+    cursors = json.loads(json.dumps(cursors)) if cursors else {}
+    samples: list = []
+    fleet = _is_fleet_root(root)
+    parts = _partition_roots(root) if fleet else [("", root)]
+    pcs = cursors.setdefault("parts", {})
+    for name, path in parts:
+        pc = pcs.setdefault(name or "_", {})
+        _harvest_journal(path, name, pc, samples, now)
+        _harvest_telemetry(path, name, pc, samples)
+        _harvest_daemon_status(path, name, samples, now)
+    if fleet:
+        _harvest_fleet_level(root, samples, now)
+    return samples, cursors
+
+
+# ---------------------------------------------------------------------------
+# Persistence: delta journal generations + snapshot compaction
+# ---------------------------------------------------------------------------
+
+def _snapshot_path(obs_dir: str) -> str:
+    return os.path.join(obs_dir, "snapshot.json")
+
+
+def _delta_path(obs_dir: str, gen: int) -> str:
+    return os.path.join(obs_dir, f"deltas.{int(gen):08d}.jsonl")
+
+
+def _delta_gens(obs_dir: str) -> List[int]:
+    try:
+        names = os.listdir(obs_dir)
+    except OSError:
+        return []
+    gens = []
+    for n in names:
+        if n.startswith("deltas.") and n.endswith(".jsonl"):
+            try:
+                gens.append(int(n[len("deltas."):-len(".jsonl")]))
+            except ValueError:
+                continue
+    return sorted(gens)
+
+
+def load_state(obs_dir: str) -> Tuple[dict, int]:
+    """Recover ``(state, active_generation)`` from one obs dir — the
+    read-only loader ``monitor``/``slo_gate``/``metrics_report`` share
+    with the recorder's own startup.
+
+    Snapshot generation N covers every delta file of generation < N;
+    recovery folds files of generation >= N in order through the same
+    pure reducer the live recorder uses, skipping torn tails. A
+    missing/torn snapshot degrades to a full refold of the deltas — a
+    crash can delay compaction, never lose samples."""
+    obs_dir = str(obs_dir)
+    state, gen = new_state(), 1
+    snap = _read_json(_snapshot_path(obs_dir))
+    if (snap is not None
+            and snap.get("schema") == OBS_SCHEMA_VERSION
+            and isinstance(snap.get("state"), dict)
+            and isinstance(snap.get("gen"), int)):
+        state, gen = snap["state"], snap["gen"]
+    for g in _delta_gens(obs_dir):
+        if g < gen:
+            continue  # compaction residue: already inside the snapshot
+        events, _bad, _torn = read_journal_file(_delta_path(obs_dir, g))
+        reduce_obs(events, state)
+        gen = max(gen, g)
+    return state, gen
+
+
+class Recorder:
+    """The write handle of one obs dir: harvest -> fsynced delta line
+    -> in-memory fold, with snapshot compaction past a size threshold.
+    One recorder per root by design (like one daemon per queue root);
+    the heartbeat file names the owner for ``monitor``'s
+    recorder-down rendering."""
+
+    def __init__(self, root, obs_dir: Optional[str] = None):
+        self.root = str(root)
+        self.obs_dir = str(obs_dir) if obs_dir else obs_dir_for(root)
+        os.makedirs(self.obs_dir, exist_ok=True)
+        self.state, self.gen = load_state(self.obs_dir)
+        self._journal: Optional[Journal] = None
+
+    @property
+    def journal(self) -> Journal:
+        if self._journal is None:
+            self._journal = Journal(_delta_path(self.obs_dir, self.gen))
+        return self._journal
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    def __enter__(self) -> "Recorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def poll(self, now: Optional[float] = None,
+             compact: bool = True) -> int:
+        """One harvest pass: samples + advanced cursors land in ONE
+        journal line (commit or vanish together), then fold into the
+        live state. Returns the number of new samples."""
+        now = time.time() if now is None else float(now)
+        samples, cursors = harvest(self.root, self.state["cursors"],
+                                   now)
+        rec = self.journal.append("harvest", t=now, samples=samples,
+                                  cursors=cursors)
+        reduce_obs([rec], self.state)
+        if compact:
+            try:
+                if (os.path.getsize(_delta_path(self.obs_dir,
+                                                self.gen))
+                        > COMPACT_BYTES):
+                    self.compact()
+            except OSError:
+                pass
+        return len(samples)
+
+    def compact(self) -> int:
+        """Rename-commit the folded state as generation ``gen + 1``,
+        rotate to a fresh delta file, sweep superseded delta files.
+        Crash windows: before the snapshot rename -> old snapshot +
+        full deltas refold; after it -> stale delta files are ignored
+        by generation. Returns the new generation."""
+        new_gen = self.gen + 1
+        snap = {"schema": OBS_SCHEMA_VERSION, "gen": new_gen,
+                "state": self.state, "t_wall": time.time()}
+        path = _snapshot_path(self.obs_dir)
+        tmp = os.path.join(self.obs_dir,
+                           f".tmp-{os.getpid()}-snapshot.json")
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        _fsync_replace(tmp, path)
+        self.close()
+        old = self.gen
+        self.gen = new_gen
+        for g in _delta_gens(self.obs_dir):
+            if g <= old:
+                try:
+                    os.unlink(_delta_path(self.obs_dir, g))
+                except OSError:
+                    pass
+        return new_gen
+
+    # -- recorder heartbeat (monitor's down-vs-idle discriminator) ----
+
+    def heartbeat_path(self) -> str:
+        return os.path.join(self.obs_dir, "recorder.json")
+
+    def write_heartbeat(self, interval_s: float,
+                        now: Optional[float] = None) -> None:
+        now = time.time() if now is None else float(now)
+        doc = {"schema": OBS_SCHEMA_VERSION, "pid": os.getpid(),
+               "t_wall": now, "interval_s": float(interval_s),
+               "n_samples": self.state["n_samples"],
+               "n_harvests": self.state["n_harvests"],
+               "last_t": self.state["last_t"]}
+        tmp = os.path.join(self.obs_dir,
+                           f".tmp-{os.getpid()}-recorder.json")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            _fsync_replace(tmp, self.heartbeat_path())
+        except OSError:
+            pass  # liveness probe only — never kill the recorder
+
+
+def read_recorder_heartbeat(obs_dir: str) -> Optional[dict]:
+    return _read_json(os.path.join(str(obs_dir), "recorder.json"))
+
+
+# ---------------------------------------------------------------------------
+# Windowed summaries (slo_gate --window / metrics_report --rollup)
+# ---------------------------------------------------------------------------
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = max(0, min(len(vs) - 1,
+                     int(math.ceil(q / 100.0 * len(vs))) - 1))
+    return vs[idx]
+
+
+def _value_at(raw: List[list], t: float) -> float:
+    """Cumulative counter value as of ``t`` (0 before the first
+    retained point — a window older than the raw ring under-reports
+    the delta rather than inventing one)."""
+    v = 0.0
+    for ts, val in raw:
+        if ts > t:
+            break
+        v = val
+    return v
+
+
+def summarize_window(state: dict, t0: Optional[float] = None,
+                     t1: Optional[float] = None) -> dict:
+    """Aggregate the series over ``[t0, t1]`` into the flat metric doc
+    the shared ``--fail-on`` grammar gates on (``None`` bounds are
+    unbounded). Counters become window deltas summed across all
+    (host, partition) series; gauges become percentile dicts over the
+    window's raw samples. ``cache_hit_rate`` is derived from the
+    windowed deltas, ``None`` until the window holds a completion —
+    same unmeasured-passes convention as the snapshot summaries."""
+    lo = -math.inf if t0 is None else float(t0)
+    hi = math.inf if t1 is None else float(t1)
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, List[float]] = {}
+    for ser in state.get("series", {}).values():
+        raw = ser.get("raw") or []
+        if ser.get("kind") == "counter":
+            delta = (_value_at(raw, hi)
+                     - (_value_at(raw, lo) if lo > -math.inf else 0.0))
+            counters[ser["counter"]] = (counters.get(ser["counter"],
+                                                     0.0) + delta)
+        else:
+            vals = [v for t, v in raw if lo <= t <= hi]
+            if vals:
+                gauges.setdefault(ser["counter"], []).extend(vals)
+    doc: dict = {"window": {"since": t0, "until": t1},
+                 "n_samples": state.get("n_samples", 0),
+                 "last_sample_t": state.get("last_t")}
+    for name, v in sorted(counters.items()):
+        doc[name] = v
+    completed = counters.get("completed", 0.0)
+    doc["cache_hit_rate"] = (counters.get("cache_hits", 0.0) / completed
+                             if completed > 0 else None)
+    for name, vals in sorted(gauges.items()):
+        doc[name] = {"p50": _percentile(vals, 50.0),
+                     "p99": _percentile(vals, 99.0),
+                     "max": max(vals), "mean": sum(vals) / len(vals),
+                     "last": vals[-1], "n": len(vals)}
+    return doc

@@ -26,6 +26,15 @@ one durable service over a shared fleet root):
   run the ordinary durable submit handshake on the chosen partition;
 - ``fleet-status``  federated snapshot: leases, hosts, per-partition
   job counts (``tools/heatq.py <fleet-root> --check`` is the auditor).
+
+Observability (docs/OBSERVABILITY.md "Time series"):
+
+- ``metrics-serve``  run the fleet flight recorder over a queue or
+  fleet root: folds journals + telemetry into the durable series DB
+  under ``<root>/obs/``, serves the live series as OpenMetrics on a
+  stdlib HTTP endpoint, and trips journaled alerts (tuned-baseline
+  ``perf_regression``, queue-wait growth, cache-hit collapse,
+  heartbeat gaps). Strictly observation-only.
 """
 
 from __future__ import annotations
@@ -220,6 +229,49 @@ def build_parser() -> argparse.ArgumentParser:
                                              "partitions)")
     ft.add_argument("--fleet", required=True, metavar="DIR")
     ft.add_argument("--json", action="store_true")
+
+    ms = sub.add_parser(
+        "metrics-serve",
+        help="run the flight recorder + OpenMetrics endpoint over a "
+             "queue or fleet root")
+    ms.add_argument("--root", required=True, metavar="DIR",
+                    help="queue root or fleet root to observe (the "
+                         "series DB lives under <root>/obs/)")
+    ms.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="harvest cadence (default 2s)")
+    ms.add_argument("--bind", default="127.0.0.1", metavar="ADDR",
+                    help="HTTP bind address (default 127.0.0.1)")
+    ms.add_argument("--port", type=int, default=0, metavar="N",
+                    help="HTTP port (default 0: ephemeral; the bound "
+                         "port is published in <root>/obs/expo.json)")
+    ms.add_argument("--no-http", action="store_true",
+                    help="recorder + textfile only, no endpoint")
+    ms.add_argument("--textfile", default=None, metavar="FILE",
+                    help="also rename-commit the exposition text here "
+                         "each pass (default <root>/obs/metrics.prom)")
+    ms.add_argument("--once", action="store_true",
+                    help="one harvest + textfile + alert evaluation, "
+                         "then exit (smoke/cron use)")
+    ms.add_argument("--max-seconds", type=float, default=None,
+                    metavar="S",
+                    help="serve for at most S seconds then exit "
+                         "(harness/smoke use; default: until SIGTERM)")
+    ms.add_argument("--tune-db", default=None, metavar="DIR",
+                    help="tuning DB whose measured winners become the "
+                         "perf_regression baseline (default: "
+                         "PHT_TUNE_DB; alerts need it)")
+    ms.add_argument("--no-alerts", action="store_true",
+                    help="disable alert evaluation (recorder + "
+                         "exposition only)")
+    ms.add_argument("--perf-fraction", type=float, default=0.5,
+                    metavar="F",
+                    help="perf_regression trips when a run sustains "
+                         "below F x its tuned expectation "
+                         "(default 0.5)")
+    ms.add_argument("--perf-min-samples", type=int, default=3,
+                    metavar="N",
+                    help="chunk samples required before judging a "
+                         "run's throughput (default 3)")
     return ap
 
 
@@ -540,6 +592,100 @@ def _cmd_fleet_status(args) -> int:
     return 0
 
 
+def _cmd_metrics_serve(args) -> int:
+    from parallel_heat_tpu.obs.alerts import AlertEngine, AlertPolicy
+    from parallel_heat_tpu.obs.expo import (
+        ExpoServer, render_openmetrics, write_textfile)
+    from parallel_heat_tpu.obs.series import Recorder
+
+    if not os.path.isdir(args.root):
+        print(f"error: {args.root}: not a directory", file=sys.stderr)
+        return 2
+    recorder = Recorder(args.root)
+    tune_db = args.tune_db or os.environ.get("PHT_TUNE_DB") or None
+    engine = None
+    if not args.no_alerts:
+        engine = AlertEngine(
+            recorder.obs_dir,
+            policy=AlertPolicy(perf_fraction=args.perf_fraction,
+                               perf_min_samples=args.perf_min_samples))
+    textfile = args.textfile or os.path.join(recorder.obs_dir,
+                                             "metrics.prom")
+
+    def _pass() -> int:
+        n = recorder.poll()
+        text = render_openmetrics(recorder.state)
+        write_textfile(textfile, text)
+        tripped = []
+        if engine is not None:
+            tripped = engine.evaluate(recorder.state,
+                                      root=recorder.root,
+                                      tune_db=tune_db)
+        for a in tripped:
+            print(f"ALERT {a.get('kind')}: key={a.get('key')} "
+                  f"{a.get('detail')}", file=sys.stderr)
+        recorder.write_heartbeat(args.interval)
+        return n
+
+    if args.once:
+        n = _pass()
+        print(f"obs: {n} new sample(s), "
+              f"{recorder.state['n_samples']} folded, "
+              f"{len(recorder.state['series'])} series -> {textfile}")
+        recorder.close()
+        if engine is not None:
+            engine.close()
+        return 0
+
+    server = None
+    if not args.no_http:
+        try:
+            server = ExpoServer(
+                lambda: render_openmetrics(recorder.state),
+                bind=args.bind, port=args.port).start()
+        except OSError as e:
+            print(f"error: cannot bind {args.bind}:{args.port}: {e}",
+                  file=sys.stderr)
+            return 2
+        from parallel_heat_tpu.service.store import JobStore
+
+        JobStore(recorder.obs_dir, create=False).write_json_atomic(
+            os.path.join(recorder.obs_dir, "expo.json"),
+            {"bind": server.bind, "port": server.port,
+             "pid": os.getpid()})
+        print(f"obs: serving OpenMetrics on "
+              f"http://{server.bind}:{server.port}/metrics "
+              f"(pid {os.getpid()}); SIGTERM exits cleanly")
+    stop = {"flag": False}
+
+    def _sigterm(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    import time as _time
+
+    t0 = _time.time()
+    try:
+        while not stop["flag"]:
+            _pass()
+            if (args.max_seconds is not None
+                    and _time.time() - t0 >= args.max_seconds):
+                break
+            deadline = _time.time() + max(args.interval, 0.05)
+            while not stop["flag"] and _time.time() < deadline:
+                _time.sleep(0.05)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if server is not None:
+            server.stop()
+        recorder.compact()
+        recorder.close()
+        if engine is not None:
+            engine.close()
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"serve": _cmd_serve, "submit": _cmd_submit,
@@ -547,7 +693,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "drain": _cmd_drain, "fleet-init": _cmd_fleet_init,
             "fleet-serve": _cmd_fleet_serve,
             "fleet-submit": _cmd_fleet_submit,
-            "fleet-status": _cmd_fleet_status}[args.cmd](args)
+            "fleet-status": _cmd_fleet_status,
+            "metrics-serve": _cmd_metrics_serve}[args.cmd](args)
 
 
 if __name__ == "__main__":

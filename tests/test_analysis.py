@@ -448,6 +448,24 @@ def test_hl2xx_scan_scope_covers_tune_package():
     assert "tools/autotune.py" in files
 
 
+def test_hl2xx_scan_scope_covers_obs_package():
+    # Same pin for the flight-recorder package: the default scan path
+    # set must reach every obs/ module, so the AST hygiene rules audit
+    # the recorder/exposition/alert layers like everything else (the
+    # recorder runs inside the serving perimeter; a stray blocking
+    # call or wallclock-in-traced slip there stalls the fleet, not a
+    # report).
+    from parallel_heat_tpu.analysis.astlint import (
+        _iter_py_files, default_scan_paths)
+
+    files = {os.path.relpath(p).replace(os.sep, "/") for p in
+             _iter_py_files(default_scan_paths())}
+    assert {"parallel_heat_tpu/obs/__init__.py",
+            "parallel_heat_tpu/obs/series.py",
+            "parallel_heat_tpu/obs/expo.py",
+            "parallel_heat_tpu/obs/alerts.py"} <= files
+
+
 # ---------------------------------------------------------------------------
 # HL104 f32chunk accumulation chain
 # ---------------------------------------------------------------------------

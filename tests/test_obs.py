@@ -1,0 +1,752 @@
+"""Flight recorder: the journal-backed time-series metrics plane.
+
+What is pinned here (ISSUE 18's acceptance surface):
+
+- the series reducer obeys the incremental fold law at EVERY journal
+  cut — ``reduce(prefix) then reduce(suffix, state) ==
+  reduce(prefix + suffix)`` — including both downsampling tiers, which
+  is what makes the snapshot/delta recovery exact by construction;
+- crash-window recovery: a torn delta tail, deltas newer than the
+  snapshot, compaction residue older than it, and a SIGKILLed live
+  recorder all recover to the same state a clean fold produces;
+- OpenMetrics exposition validated line-by-line against the format's
+  grammar (TYPE before samples, contiguous families, ``_total`` on
+  counters, terminal ``# EOF``);
+- regression alerts: true-positive AND true-negative against a
+  doctored tuning DB, with the journal latch holding exactly one
+  ``alert_tripped`` across re-evaluations;
+- observation-only: running the whole obs machinery between two
+  identical solves changes neither the bits of the result nor the
+  ``_build_runner`` miss count.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.obs.alerts import (
+    AlertEngine,
+    AlertPolicy,
+    reduce_alerts,
+    tune_expectation,
+)
+from parallel_heat_tpu.obs.expo import (
+    CONTENT_TYPE,
+    ExpoServer,
+    render_openmetrics,
+    write_textfile,
+)
+from parallel_heat_tpu.obs.series import (
+    M1_BUCKET_S,
+    RAW_CAP,
+    Recorder,
+    _bucket_fold,
+    load_state,
+    obs_dir_for,
+    reduce_obs,
+    summarize_window,
+)
+from parallel_heat_tpu.service.store import JobStore, read_journal_file
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_ROOT, "tools")
+_T0 = 1_700_000_000.0
+
+# Injected topology: the tune-key join must work without devices (the
+# alert engine runs on an ops box, not the TPU host).
+_TOPO = {"platform": "cpu", "device_kind": "fixture", "n_devices": 1}
+
+
+def _s(t, counter, value, kind="counter", host="h", part="p0"):
+    return {"t": t, "host": host, "part": part, "counter": counter,
+            "kind": kind, "value": value}
+
+
+def _h(t, samples, cursors=None):
+    return {"schema": 1, "event": "harvest", "t": t,
+            "samples": samples, "cursors": cursors or {"parts": {}}}
+
+
+def _mixed_events():
+    """Harvest events spanning raw points, several 1-minute buckets
+    and two 1-hour buckets, over two series kinds."""
+    out = []
+    for i in range(24):
+        t = _T0 + i * 400.0  # crosses m1 buckets every event, h1 twice
+        out.append(_h(t, [
+            _s(t, "completed", 1 + (i % 3)),
+            _s(t + 1, "steps_per_s", 100.0 + 10 * i, kind="gauge"),
+            _s(t + 2, "queue_wait_s", 0.5 * i, kind="gauge",
+               host="g", part="p1"),
+        ], cursors={"parts": {"p0": {"journal": 10 * i}}}))
+    return out
+
+
+def _dumps(state):
+    return json.dumps(state, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# The pure fold
+# ---------------------------------------------------------------------------
+
+def test_obs_fold_law_every_cut():
+    events = _mixed_events()
+    want = _dumps(reduce_obs(events))
+    for cut in range(len(events) + 1):
+        state = reduce_obs(events[:cut])
+        reduce_obs(events[cut:], state)
+        assert _dumps(state) == want, f"fold law broke at cut {cut}"
+
+
+def test_obs_counter_cumulative_gauge_raw():
+    ev = [_h(_T0, [_s(_T0, "completed", 2)]),
+          _h(_T0 + 5, [_s(_T0 + 5, "completed", 3),
+                       _s(_T0 + 5, "steps_per_s", 123.0, kind="gauge")])]
+    st = reduce_obs(ev)
+    cser = st["series"]["h|p0|completed"]
+    # Counter samples carry INCREMENTS; the fold owns cumulative.
+    assert [v for _t, v in cser["raw"]] == [2.0, 5.0]
+    gser = st["series"]["h|p0|steps_per_s"]
+    assert gser["kind"] == "gauge"
+    assert [v for _t, v in gser["raw"]] == [123.0]
+    assert st["n_samples"] == 3 and st["n_harvests"] == 2
+    # Cursors: last harvest line wins (commit-together semantics).
+    st2 = reduce_obs([_h(_T0 + 9, [], cursors={"parts": {"x": 1}})], st)
+    assert st2["cursors"] == {"parts": {"x": 1}}
+
+
+def test_obs_rollup_bucket_fold_tiers():
+    st = reduce_obs([_h(_T0, [
+        _s(_T0 + 1, "steps_per_s", 10.0, kind="gauge"),
+        _s(_T0 + 2, "steps_per_s", 30.0, kind="gauge"),
+        _s(_T0 + 61, "steps_per_s", 20.0, kind="gauge"),
+    ])])
+    ser = st["series"]["h|p0|steps_per_s"]
+    assert len(ser["m1"]) == 2  # two distinct 1-minute buckets
+    agg = ser["m1"][0][1]
+    assert agg == {"min": 10.0, "max": 30.0, "sum": 40.0, "count": 2,
+                   "last": 30.0}
+    assert len(ser["h1"]) == 1  # one hour bucket holds all three
+    assert ser["h1"][0][1]["count"] == 3
+    # The m1 bucket time is the floor of the sample time.
+    assert ser["m1"][0][0] == (_T0 + 1) // M1_BUCKET_S * M1_BUCKET_S
+
+
+def test_obs_bucket_fold_cap_and_late_samples():
+    buckets = []
+    for i in range(5):
+        _bucket_fold(buckets, 60.0 * i, float(i), cap=3)
+    assert [b[0] for b in buckets] == [120.0, 180.0, 240.0]
+    # Late sample into a RETAINED bucket merges...
+    _bucket_fold(buckets, 180.0, 99.0, cap=3)
+    assert buckets[1][1]["max"] == 99.0 and buckets[1][1]["count"] == 2
+    # ...into a trimmed/never-created bucket drops (the ring never
+    # reorders).
+    before = _dumps(buckets)
+    _bucket_fold(buckets, 0.0, 7.0, cap=3)
+    _bucket_fold(buckets, 150.0, 7.0, cap=3)
+    assert _dumps(buckets) == before
+
+
+def test_obs_raw_cap():
+    samples = [_s(_T0 + i, "completed", 1) for i in range(RAW_CAP + 40)]
+    st = reduce_obs([_h(_T0, samples)])
+    ser = st["series"]["h|p0|completed"]
+    assert len(ser["raw"]) == RAW_CAP
+    # The cumulative total survives the trim: the newest point carries
+    # the full count even though the oldest raw points are gone.
+    assert ser["raw"][-1][1] == RAW_CAP + 40
+    assert st["n_samples"] == RAW_CAP + 40
+
+
+def test_obs_foreign_samples_ignored():
+    st = reduce_obs([
+        {"event": "not_harvest", "samples": [_s(_T0, "completed", 1)]},
+        _h(_T0, [{"counter": "completed"},  # no t/value
+                 {"t": float("nan"), "counter": "x", "value": 1},
+                 "not-a-dict", None,
+                 _s(_T0, "completed", 1)]),
+    ])
+    assert st["n_samples"] == 1 and len(st["series"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Recorder: harvest + delta journal + snapshot compaction
+# ---------------------------------------------------------------------------
+
+def _queue_with_jobs(tmp_path, n=3, name="q"):
+    root = str(tmp_path / name)
+    store = JobStore(root, create=True)
+    j = store.journal
+    for k in range(n):
+        jid = f"j{k}"
+        j.append("accepted", job_id=jid, t_wall=_T0 + 10 * k,
+                 hbm_bytes=1, host="hosta")
+        j.append("dispatched", job_id=jid, t_wall=_T0 + 10 * k + 1,
+                 worker=f"w{k}", attempt=1, host="hosta")
+        j.append("completed", job_id=jid, t_wall=_T0 + 10 * k + 2,
+                 host="hosta")
+    j.close()
+    return root
+
+
+def test_recorder_poll_idempotent_and_reload(tmp_path):
+    root = _queue_with_jobs(tmp_path)
+    with Recorder(root) as r:
+        n = r.poll(now=_T0 + 100, compact=False)
+        assert n > 0
+        # Nothing new on disk -> nothing harvested (cursor discipline).
+        assert r.poll(now=_T0 + 101, compact=False) == 0
+        ser = r.state["series"]["hosta||completed"]
+        assert ser["raw"][-1][1] == 3.0
+        # queue_wait_s gauge: accepted -> first dispatch.
+        wait = r.state["series"]["hosta||queue_wait_s"]
+        assert [v for _t, v in wait["raw"]] == [1.0, 1.0, 1.0]
+        live = _dumps(r.state)
+    state, _gen = load_state(obs_dir_for(root))
+    assert _dumps(state) == live
+
+
+def test_recorder_compaction_equivalence(tmp_path):
+    root = _queue_with_jobs(tmp_path, n=2)
+    with Recorder(root) as r:
+        r.poll(now=_T0 + 50, compact=False)
+        before = _dumps(r.state)
+        gen0 = r.gen
+        r.compact()
+        assert r.gen == gen0 + 1
+        assert _dumps(r.state) == before
+    # Reload reads snapshot + (empty) new-gen deltas.
+    state, gen = load_state(obs_dir_for(root))
+    assert _dumps(state) == before and gen == gen0 + 1
+    # More activity after compaction folds on top.
+    store = JobStore(root, create=False)
+    store.journal.append("accepted", job_id="late", t_wall=_T0 + 60,
+                         hbm_bytes=1, host="hosta")
+    store.journal.close()
+    with Recorder(root) as r2:
+        r2.poll(now=_T0 + 70, compact=False)
+        assert r2.state["series"]["hosta||jobs_accepted"]["raw"][-1][1] \
+            == 3.0
+
+
+def test_recorder_crash_windows(tmp_path):
+    root = _queue_with_jobs(tmp_path)
+    obs = obs_dir_for(root)
+    with Recorder(root) as r:
+        r.poll(now=_T0 + 100, compact=False)
+        clean = _dumps(r.state)
+        gen = r.gen
+    # Window 1: torn final delta line (killed mid-append) — the torn
+    # tail is invisible, the prefix state stands.
+    delta = os.path.join(obs, f"deltas.{gen:08d}.jsonl")
+    with open(delta, "ab") as f:
+        f.write(b'{"event": "harvest", "t": 1, "samples": [{"t": 1,')
+    state, _ = load_state(obs)
+    assert _dumps(state) == clean
+    with open(delta, "rb") as f:
+        data = f.read()
+    with open(delta, "wb") as f:
+        f.write(data[:data.rfind(b"{")])
+    # Window 2: compaction crashed AFTER the snapshot rename but
+    # BEFORE the old delta unlink — stale deltas are ignored by
+    # generation, not double-folded.
+    snap_state, _ = load_state(obs)
+    with open(os.path.join(obs, "snapshot.json"), "w") as f:
+        json.dump({"schema": 1, "gen": gen + 1, "state": snap_state},
+                  f)
+    state2, gen2 = load_state(obs)
+    assert _dumps(state2) == clean and gen2 == gen + 1
+    # Window 3: snapshot itself torn -> full delta refold.
+    with open(os.path.join(obs, "snapshot.json"), "w") as f:
+        f.write('{"schema": 1, "gen": ')
+    state3, _ = load_state(obs)
+    assert _dumps(state3) == clean
+
+
+def test_recorder_sigkill_recovery(tmp_path):
+    """A live recorder SIGKILLed mid-poll recovers by construction:
+    whatever prefix of harvest lines hit the disk folds to a valid
+    state, a restarted recorder continues from it, and re-harvest
+    never double-counts a source line."""
+    root = _queue_with_jobs(tmp_path, n=5)
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from parallel_heat_tpu.obs.series import Recorder\n"
+        "r = Recorder(%r)\n"
+        "print('ready', flush=True)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    r.poll(now=%r + i, compact=(i %% 7 == 6))\n"
+        "    i += 1\n" % (_ROOT, root, _T0))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, env=env)
+    try:
+        assert proc.stdout.readline().strip() == b"ready"
+        time.sleep(0.5)
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    state, _gen = load_state(obs_dir_for(root))
+    # Exactly the journal's activity, counted once: 5 jobs' worth of
+    # counters regardless of how many polls/compactions ran.
+    assert state["series"]["hosta||completed"]["raw"][-1][1] == 5.0
+    assert state["series"]["hosta||dispatches"]["raw"][-1][1] == 5.0
+    # A restarted recorder resumes from the recovered cursors: nothing
+    # new on disk means nothing harvested.
+    with Recorder(root) as r:
+        assert _dumps(r.state) == _dumps(state)
+        assert r.poll(now=_T0 + 999, compact=False) == 0
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) \S.*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" -?[0-9][0-9.eE+-]*$")
+
+
+def test_openmetrics_grammar():
+    st = reduce_obs(_mixed_events())
+    text = render_openmetrics(st)
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF" and text.endswith("# EOF\n")
+    declared = {}   # family -> kind
+    seen_samples = set()
+    current = None
+    for ln in lines[:-1]:
+        m = _TYPE_RE.match(ln)
+        if m:
+            name, kind = m.groups()
+            # Families are contiguous and declared once.
+            assert name not in declared, f"re-declared family {name}"
+            assert name not in seen_samples
+            declared[name] = kind
+            current = name
+            continue
+        m = _HELP_RE.match(ln)
+        if m:
+            assert m.group(1) == current
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"line fails the exposition grammar: {ln!r}"
+        sample_name = m.group(1)
+        fam = (sample_name[:-len("_total")]
+               if sample_name.endswith("_total") else sample_name)
+        assert fam == current, f"interleaved family at {ln!r}"
+        # Counter samples carry _total; gauges must not.
+        if declared[fam] == "counter":
+            assert sample_name.endswith("_total"), ln
+        else:
+            assert not sample_name.endswith("_total"), ln
+        seen_samples.add(fam)
+    assert "heat_completed" in declared
+    assert declared["heat_completed"] == "counter"
+    assert declared["heat_steps_per_s"] == "gauge"
+    assert declared["heat_obs_samples"] == "counter"
+
+
+def test_openmetrics_label_escaping_and_values():
+    st = reduce_obs([_h(_T0, [
+        _s(_T0, "completed", 2, host='we"ird\\h'),
+        _s(_T0, "steps_per_s", 1234.5, kind="gauge"),
+    ])])
+    text = render_openmetrics(st)
+    assert 'host="we\\"ird\\\\h"' in text
+    # Integral counters render without a trailing .0.
+    assert re.search(r'^heat_completed_total\{[^}]*\} 2$', text,
+                     re.M), text
+    assert "1234.5" in text
+
+
+def test_expo_textfile_and_server(tmp_path):
+    st = reduce_obs(_mixed_events())
+    text = render_openmetrics(st)
+    path = str(tmp_path / "metrics.prom")
+    write_textfile(path, text)
+    with open(path) as f:
+        assert f.read() == text
+    server = ExpoServer(lambda: text, bind="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            assert resp.read().decode() == text
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Windowed summaries
+# ---------------------------------------------------------------------------
+
+def test_summarize_window():
+    st = reduce_obs([
+        _h(_T0, [_s(_T0, "completed", 4),
+                 _s(_T0, "steps_per_s", 100.0, kind="gauge")]),
+        _h(_T0 + 100, [_s(_T0 + 100, "completed", 6),
+                       _s(_T0 + 100, "cache_hits", 3),
+                       _s(_T0 + 100, "steps_per_s", 300.0,
+                          kind="gauge")]),
+    ])
+    full = summarize_window(st)
+    assert full["completed"] == 10.0 and full["cache_hits"] == 3.0
+    assert full["cache_hit_rate"] == pytest.approx(0.3)
+    assert full["steps_per_s"]["max"] == 300.0
+    assert full["steps_per_s"]["n"] == 2
+    # Window covering only the second harvest: counter DELTAS, not
+    # totals; gauge percentiles over windowed samples only.
+    win = summarize_window(st, _T0 + 50, _T0 + 200)
+    assert win["completed"] == 6.0
+    assert win["cache_hit_rate"] == pytest.approx(0.5)
+    assert win["steps_per_s"]["n"] == 1
+    assert win["steps_per_s"]["last"] == 300.0
+    # Empty window: zero deltas, unmeasured rate.
+    empty = summarize_window(st, _T0 + 500, _T0 + 600)
+    assert empty["completed"] == 0.0
+    assert empty["cache_hit_rate"] is None
+    assert "steps_per_s" not in empty
+
+
+# ---------------------------------------------------------------------------
+# Alerts: tuned-baseline regression + trends + the journal latch
+# ---------------------------------------------------------------------------
+
+def _doctored_tune_db(tmp_path, config, min_wall_s=0.1,
+                      steps_per_call=1000, verified=True):
+    """A tuning DB holding one measured winner for ``config``'s tune
+    key: expectation = steps_per_call / min_wall_s steps/s."""
+    from parallel_heat_tpu.tune.db import TuneDB
+
+    geometry = {"shape": [config["nx"], config["ny"]],
+                "dtype": str(config.get("dtype") or "float32"),
+                "accumulate": str(config.get("accumulate")
+                                  or "storage")}
+    db_root = str(tmp_path / "tunedb")
+    with TuneDB(db_root) as db:
+        db.put("single_2d", _TOPO, geometry, choice="A",
+               verified=verified,
+               candidates=[{"choice": "A", "feasible": True,
+                            "bitwise_verified": True,
+                            "min_wall_s": min_wall_s}],
+               protocol={"timer": "fixture", "rounds": 1,
+                         "steps_per_call": steps_per_call,
+                         "reference": "jnp"})
+    return db_root
+
+
+def _job_with_throughput(root, jid, config, sps, t0, n_chunks=4):
+    """One dispatched+completed job whose committed spec is ``config``
+    and whose observed steps_per_s series sits at ``sps``."""
+    store = JobStore(root, create=False) if os.path.isdir(root) \
+        else JobStore(root, create=True)
+    with open(os.path.join(root, "jobs", f"{jid}.json"), "w") as f:
+        json.dump({"job_id": jid, "config": config}, f)
+    j = store.journal
+    j.append("accepted", job_id=jid, t_wall=t0, hbm_bytes=1)
+    j.append("dispatched", job_id=jid, t_wall=t0 + 1, worker=f"w-{jid}",
+             attempt=1)
+    j.append("completed", job_id=jid, t_wall=t0 + 20)
+    j.close()
+    samples = [_s(t0 + 2 + i * 4, "steps_per_s", sps, kind="gauge",
+                  host="", part="") for i in range(n_chunks)]
+    return _h(t0 + 21, samples)
+
+
+_CFG = {"nx": 32, "ny": 32, "steps": 100, "backend": "jnp"}
+
+
+def test_tune_expectation_join(tmp_path):
+    db_root = _doctored_tune_db(tmp_path, _CFG, min_wall_s=0.1,
+                                steps_per_call=1000)
+    assert tune_expectation(_CFG, db_root, topology=_TOPO) \
+        == pytest.approx(10_000.0)
+    # Different geometry -> different key -> no baseline.
+    other = dict(_CFG, nx=64)
+    assert tune_expectation(other, db_root, topology=_TOPO) is None
+    # 3D and malformed configs carry no baseline.
+    assert tune_expectation(dict(_CFG, nz=8), db_root,
+                            topology=_TOPO) is None
+    assert tune_expectation({"nx": "x"}, db_root,
+                            topology=_TOPO) is None
+    # An unverified entry is refused (measured-only-after-bitwise).
+    db2 = _doctored_tune_db(tmp_path / "u", _CFG, verified=False)
+    assert tune_expectation(_CFG, db2, topology=_TOPO) is None
+
+
+def test_perf_regression_tp_tn_and_latch(tmp_path):
+    root = str(tmp_path / "q")
+    JobStore(root, create=True)
+    db_root = _doctored_tune_db(tmp_path, _CFG, min_wall_s=0.1,
+                                steps_per_call=1000)  # expect 10k
+    ev_slow = _job_with_throughput(root, "slow", _CFG, sps=1000.0,
+                                   t0=_T0)          # 10% of tuned: TP
+    ev_fast = _job_with_throughput(root, "fast", _CFG, sps=9000.0,
+                                   t0=_T0 + 100)    # 90%: TN
+    state = reduce_obs([ev_slow, ev_fast])
+    with AlertEngine(obs_dir_for(root)) as eng:
+        tripped = eng.evaluate(state, root=root, tune_db=db_root,
+                               topology=_TOPO, now=_T0 + 200)
+        assert [a["key"] for a in tripped] == \
+            ["perf_regression||slow"]
+        d = tripped[0]["detail"]
+        assert d["expected_steps_per_s"] == pytest.approx(10_000.0)
+        assert d["observed_steps_per_s"] == pytest.approx(1000.0)
+        # The latch: the same (still-true) condition trips nothing
+        # new, and never clears — exactly one journaled trip, ever.
+        for _ in range(3):
+            assert eng.evaluate(state, root=root, tune_db=db_root,
+                                topology=_TOPO, now=_T0 + 300) == []
+        active = eng.active()
+        assert set(active) == {"perf_regression||slow"}
+    events, _bad, _torn = read_journal_file(
+        os.path.join(obs_dir_for(root), "alerts.jsonl"))
+    assert sum(1 for e in events
+               if e.get("event") == "alert_tripped") == 1
+
+
+def test_perf_regression_needs_samples_and_baseline(tmp_path):
+    root = str(tmp_path / "q")
+    JobStore(root, create=True)
+    db_root = _doctored_tune_db(tmp_path, _CFG)
+    # Too few windowed samples: no verdict (perf_min_samples).
+    ev = _job_with_throughput(root, "thin", _CFG, sps=10.0, t0=_T0,
+                              n_chunks=2)
+    state = reduce_obs([ev])
+    with AlertEngine(obs_dir_for(root)) as eng:
+        assert eng.evaluate(state, root=root, tune_db=db_root,
+                            topology=_TOPO) == []
+    # A config with no DB entry: silent (no alert without evidence).
+    root2 = str(tmp_path / "q2")
+    JobStore(root2, create=True)
+    ev2 = _job_with_throughput(root2, "nokey", dict(_CFG, nx=48),
+                               sps=10.0, t0=_T0)
+    with AlertEngine(obs_dir_for(root2)) as eng:
+        assert eng.evaluate(reduce_obs([ev2]), root=root2,
+                            tune_db=db_root, topology=_TOPO) == []
+
+
+def test_trend_alerts_trip_and_clear(tmp_path):
+    obs = str(tmp_path / "obs")
+    pol = AlertPolicy(wait_min_samples=4, wait_min_s=5.0,
+                      wait_growth_factor=3.0, hb_max_age_s=30.0)
+    grow = reduce_obs([_h(_T0, [
+        _s(_T0 + i, "queue_wait_s", v, kind="gauge")
+        for i, v in enumerate([1.0, 1.0, 20.0, 30.0])]
+        + [_s(_T0 + 9, "daemon_hb_age_s", 45.0, kind="gauge")])])
+    with AlertEngine(obs, policy=pol) as eng:
+        kinds = {a["kind"] for a in eng.evaluate(grow)}
+        assert kinds == {"queue_wait_growth", "heartbeat_gap"}
+        # Recovery: waits flat again, heartbeat fresh -> trend alerts
+        # CLEAR (unlike the per-job perf latch).
+        calm = reduce_obs([_h(_T0 + 100, [
+            _s(_T0 + 100 + i, "queue_wait_s", 1.0, kind="gauge")
+            for i in range(4)]
+            + [_s(_T0 + 109, "daemon_hb_age_s", 1.0, kind="gauge")])])
+        assert eng.evaluate(calm) == []
+        assert eng.active() == {}
+
+
+def test_cache_hit_collapse_alert(tmp_path):
+    obs = str(tmp_path / "obs")
+    pol = AlertPolicy(cache_window_s=100.0, cache_min_completed=8,
+                      cache_collapse_fraction=0.5)
+    # History: 20 completions, 10 hits (rate .5); recent window: 10
+    # completions, 0 hits -> collapse.
+    ev = [_h(_T0, [_s(_T0, "completed", 10), _s(_T0, "cache_hits", 10)]),
+          _h(_T0 + 300, [_s(_T0 + 300, "completed", 10)])]
+    with AlertEngine(obs, policy=pol) as eng:
+        tripped = eng.evaluate(reduce_obs(ev))
+        assert [a["kind"] for a in tripped] == ["cache_hit_collapse"]
+
+
+def test_alert_fold_law_and_anomalies():
+    trip = {"event": "alert_tripped", "key": "k1", "kind": "x"}
+    clear = {"event": "alert_cleared", "key": "k1"}
+    events = [trip, clear, dict(trip, key="k2"), dict(trip, key="k2"),
+              {"event": "alert_cleared", "key": "ghost"}]
+    whole = reduce_alerts(events)
+    state = reduce_alerts(events[:2])
+    assert reduce_alerts(events[2:], state) == whole
+    active, anomalies = whole
+    assert set(active) == {"k2"}
+    assert any("duplicate trip of k2" in a for a in anomalies)
+    assert any("unlatched ghost" in a for a in anomalies)
+
+
+# ---------------------------------------------------------------------------
+# The observation-only pin
+# ---------------------------------------------------------------------------
+
+def test_obs_plane_is_observation_only(tmp_path):
+    """Running the ENTIRE obs machinery between two identical solves
+    changes nothing: bitwise-identical grids, zero new
+    ``_build_runner`` misses."""
+    from parallel_heat_tpu import HeatConfig, solve
+    from parallel_heat_tpu.solver import _build_runner
+
+    cfg = HeatConfig(nx=16, ny=16, steps=30, backend="jnp")
+    before = np.asarray(solve(cfg).grid)
+    misses = _build_runner.cache_info().misses
+
+    root = _queue_with_jobs(tmp_path)
+    db_root = _doctored_tune_db(tmp_path, _CFG)
+    with Recorder(root) as r:
+        r.poll(now=_T0 + 100)
+        text = render_openmetrics(r.state)
+        write_textfile(str(tmp_path / "m.prom"), text)
+        summarize_window(r.state, _T0, _T0 + 100)
+        with AlertEngine(r.obs_dir) as eng:
+            eng.evaluate(r.state, root=root, tune_db=db_root,
+                         topology=_TOPO)
+        r.compact()
+
+    after = np.asarray(solve(cfg).grid)
+    assert before.tobytes() == after.tobytes()
+    assert _build_runner.cache_info().misses == misses
+
+
+# ---------------------------------------------------------------------------
+# CLI + tools integration
+# ---------------------------------------------------------------------------
+
+def test_cli_metrics_serve_once(tmp_path, capsys):
+    from parallel_heat_tpu.service.cli import main as heatd_main
+
+    root = _queue_with_jobs(tmp_path)
+    rc = heatd_main(["metrics-serve", "--root", root, "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "series ->" in out
+    prom = os.path.join(obs_dir_for(root), "metrics.prom")
+    with open(prom) as f:
+        text = f.read()
+    assert "heat_completed_total" in text and text.endswith("# EOF\n")
+    # Recorder heartbeat landed for monitor's down-vs-idle probe.
+    with open(os.path.join(obs_dir_for(root), "recorder.json")) as f:
+        assert json.load(f)["n_samples"] > 0
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        import importlib
+        return importlib.import_module(name)
+    finally:
+        sys.path.remove(_TOOLS)
+
+
+def test_metrics_report_window_and_rollup(tmp_path, capsys):
+    mr = _tool("metrics_report")
+    now = time.time()
+    root = str(tmp_path / "q")
+    store = JobStore(root, create=True)
+    j = store.journal
+    for jid, base in (("old", now - 1000), ("new", now - 10)):
+        j.append("accepted", job_id=jid, t_wall=base, hbm_bytes=1)
+        j.append("dispatched", job_id=jid, t_wall=base + 1,
+                 worker="w-" + jid, attempt=1)
+        j.append("completed", job_id=jid, t_wall=base + 2)
+    j.close()
+    assert mr.main([root, "--json", "--since", "-60"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fleet"]["completed"] == 1  # old job outside window
+    assert doc["window"]["since"] is not None
+    # --rollup: same answers from the recorder's folded series.
+    with Recorder(root) as r:
+        r.poll(now=now)
+    assert mr.main([root, "--rollup", "--json",
+                    "--fail-on", "quarantined>0"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["completed"] == 2.0
+    assert mr.main([root, "--rollup", "--json", "--since", "-60"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["completed"] == 1.0
+    # Unknown ceilings stay loud in rollup mode too.
+    assert mr.main([root, "--rollup", "--fail-on", "nonsense>0"]) == 1
+    capsys.readouterr()
+
+
+def test_slo_gate_window(tmp_path, capsys):
+    sg = _tool("slo_gate")
+    now = time.time()
+    root = str(tmp_path / "q")
+    store = JobStore(root, create=True)
+    j = store.journal
+    j.append("accepted", job_id="bad", t_wall=now - 1000, hbm_bytes=1)
+    j.append("dispatched", job_id="bad", t_wall=now - 999, worker="w1",
+             attempt=1)
+    j.append("quarantined", job_id="bad", t_wall=now - 998,
+             kind="poison", reason="fixture")
+    j.append("accepted", job_id="ok", t_wall=now - 10, hbm_bytes=1)
+    j.append("dispatched", job_id="ok", t_wall=now - 9, worker="w2",
+             attempt=1)
+    j.append("completed", job_id="ok", t_wall=now - 8)
+    j.close()
+    assert sg.main([root, "--fleet", "quarantined>0"]) == 2
+    assert sg.main([root, "--fleet", "quarantined>0",
+                    "--window", "60"]) == 0
+    spec = str(tmp_path / "slo.json")
+    with open(spec, "w") as f:
+        json.dump({"fleet": ["quarantined>0"], "window_s": 60}, f)
+    assert sg.main([root, "--spec", spec]) == 0
+    # CLI --window overrides the spec's window_s.
+    assert sg.main([root, "--spec", spec, "--window", "2000"]) == 2
+    capsys.readouterr()
+
+
+def test_monitor_obs_columns_and_recorder_down(tmp_path):
+    mon = _tool("monitor")
+    from parallel_heat_tpu.service import fleet as fleetmod
+
+    now = time.time()
+    froot = str(tmp_path / "fleet")
+    fleetmod.fleet_init(froot, partitions=1, clock=lambda: now)
+    pname, proot = fleetmod.partition_roots(froot)[0]
+    store = JobStore(proot, create=False)
+    j = store.journal
+    for k in range(3):
+        j.append("accepted", job_id=f"j{k}", t_wall=now - 30 + 10 * k,
+                 hbm_bytes=1, host="hosta")
+        j.append("completed", job_id=f"j{k}", t_wall=now - 29 + 10 * k,
+                 host="hosta")
+    j.close()
+    with Recorder(froot) as r:
+        r.poll(now=now)
+        r.write_heartbeat(2.0, now=now)
+    fs = mon.FleetState(froot)
+    fs.poll()
+    line = fs.render(now=now)
+    # Fresh recorder + sparkline trend column: the live fleet view.
+    assert "done:" in line and "obs hb" in line
+    assert "(stale?)" not in line
+    # Recorder down: heartbeat goes stale, the row says so — this is
+    # what distinguishes a dead recorder from an idle fleet (whose
+    # heartbeat stays fresh over flat sparklines).
+    with Recorder(froot) as r:
+        r.write_heartbeat(2.0, now=now - 300)
+    fs2 = mon.FleetState(froot)
+    fs2.poll()
+    assert "(stale?)" in fs2.render(now=now)

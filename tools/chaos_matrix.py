@@ -328,6 +328,15 @@ SERVICE_FAULTS = ("svc_cache_crash", "svc_cache_prefix_parity",
 FLEET_FAULTS = ("fleet_host_sigkill", "fleet_lease_race",
                 "fleet_cache_route")
 
+# Flight-recorder cell (docs/OBSERVABILITY.md "Fleet flight
+# recorder"): a real metrics-serve-shaped recorder process is
+# SIGKILLed while the journal it harvests is still growing; the
+# committed obs state must load cleanly, a restarted recorder resumes
+# from the committed cursors, and the resumed series is IDENTICAL to
+# a from-scratch refold of the same disk — nothing lost, nothing
+# double-counted.
+OBS_FAULTS = ("obs_recorder_sigkill",)
+
 # Real 2-process gloo cells (the distributed-supervision contract,
 # SEMANTICS.md "Distributed supervision") — run with --mp / --mp-only
 # (`make mp-smoke`): each spawns two worker processes that form one
@@ -1561,6 +1570,110 @@ def _fleet_cache_route(root):
     return row
 
 
+def run_obs_cell(fault, workdir):
+    if fault == "obs_recorder_sigkill":
+        return _obs_recorder_sigkill(os.path.join(workdir, fault))
+    raise ValueError(fault)
+
+
+def _obs_recorder_sigkill(root):
+    """A REAL flight-recorder process (own pid, polling + compacting
+    at full speed) is SIGKILLed while the journal it harvests is
+    still growing. The crash can land inside any of the recorder's
+    windows — mid-harvest, mid-delta-append, mid-compaction — and the
+    contract is the same for all of them: the committed obs state
+    loads cleanly, a restarted recorder resumes from the committed
+    cursors, and the resumed series is bitwise the series a
+    from-scratch refold of the same disk produces (the harvest line
+    commits samples and cursor advance atomically, so a torn tail
+    re-harvests instead of double-counting)."""
+    import json as _json
+    import subprocess
+    import time as _time
+
+    import parallel_heat_tpu as _pkg
+    from parallel_heat_tpu.obs import series as obs_series
+    from parallel_heat_tpu.service.store import JobStore
+
+    row = {"fault": "obs_recorder_sigkill"}
+    store = JobStore(root, create=True)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    code = (
+        "from parallel_heat_tpu.obs.series import Recorder\n"
+        "r = Recorder(%r)\n"
+        "print('ready', flush=True)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    r.poll(compact=(i %% 3 == 2))\n"
+        "    i += 1\n" % root)
+    rec = subprocess.Popen([sys.executable, "-c", code], env=env,
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.DEVNULL)
+    j = store.journal
+    n = 0
+    try:
+        rec.stdout.readline()  # recorder is live and polling
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 1.5:
+            jid = "obs-%04d" % n
+            j.append("accepted", job_id=jid, hbm_bytes=1)
+            j.append("dispatched", job_id=jid, worker="w", attempt=1)
+            j.append("completed", job_id=jid)
+            n += 1
+            _time.sleep(0.005)
+    finally:
+        rec.send_signal(signal.SIGKILL)
+        rec.wait(timeout=30)
+        j.close()
+    row["events_journaled"] = 3 * n
+    row["recorder_killed_ok"] = rec.returncode == -signal.SIGKILL
+
+    obs_dir = obs_series.obs_dir_for(root)
+    state, _gen = obs_series.load_state(obs_dir)
+    row["recovered_state_ok"] = isinstance(state.get("series"), dict)
+    key = "||completed"
+    committed = state["series"].get(key, {}).get("raw") or [[0, 0.0]]
+    row["committed_completed"] = committed[-1][1]
+    # Resume: a restarted recorder continues from the committed
+    # cursors and harvests exactly the unobserved tail.
+    with obs_series.Recorder(root) as r:
+        r.poll(compact=False)
+        resumed = r.state
+    resumed_total = resumed["series"][key]["raw"][-1][1]
+    row["resumed_completed"] = resumed_total
+    row["resume_no_double_count_ok"] = resumed_total == float(n)
+    # Fold consistency: incremental (survived a SIGKILL, resumed)
+    # vs one-shot refold of the same disk — identical series.
+    samples, cursors = obs_series.harvest(root, {})
+    fresh = obs_series.reduce_obs([
+        {"schema": 1, "event": "harvest", "t": _time.time(),
+         "samples": samples, "cursors": cursors}])
+    row["fold_consistency_ok"] = (
+        _json.dumps(fresh["series"], sort_keys=True)
+        == _json.dumps(resumed["series"], sort_keys=True))
+    # Snapshot integrity: compaction rename-commits, the reloaded
+    # generation is the committed one, and the state round-trips.
+    with obs_series.Recorder(root) as r2:
+        g0 = r2.gen
+        r2.compact()
+        mem = _json.dumps(r2.state["series"], sort_keys=True)
+    state2, gen2 = obs_series.load_state(obs_dir)
+    row["snapshot_roundtrip_ok"] = bool(
+        gen2 == g0 + 1
+        and _json.dumps(state2["series"], sort_keys=True) == mem)
+    store.close()
+    ok = all(row.get(k) is True for k in
+             ("recorder_killed_ok", "recovered_state_ok",
+              "resume_no_double_count_ok", "fold_consistency_ok",
+              "snapshot_roundtrip_ok"))
+    row["outcome"] = "recovered" if ok else "violation"
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=64)
@@ -1618,6 +1731,12 @@ def main():
                     f"  takeover_lag={row['takeover_lag_s']:.2f}s"
                 print(f"{fault:18s} -> {row['outcome']:20s}"
                       f"  bitwise={row.get('bitwise_match', '-')}{lag}")
+            for fault in OBS_FAULTS:
+                row = run_obs_cell(fault, workdir)
+                rows.append(row)
+                print(f"{fault:18s} -> {row['outcome']:20s}"
+                      f"  events={row.get('events_journaled', '-')}"
+                      f"  fold={row.get('fold_consistency_ok', '-')}")
         if args.mp or args.mp_only:
             for fault in MP_FAULTS:
                 row = run_mp_cell(fault, workdir)
@@ -1703,6 +1822,15 @@ def main():
                               "zero_dispatch_ok", "served_by_peer_ok",
                               "cache_hit_ok", "epoch_chain_ok",
                               "single_terminal_ok", "fleet_check_ok"),
+        # The flight-recorder durability contract
+        # (docs/OBSERVABILITY.md): a SIGKILLed recorder's committed
+        # state loads, the restarted recorder resumes without loss or
+        # double-count, and the resumed series refolds bitwise.
+        "obs_recorder_sigkill": ("recorder_killed_ok",
+                                 "recovered_state_ok",
+                                 "resume_no_double_count_ok",
+                                 "fold_consistency_ok",
+                                 "snapshot_roundtrip_ok"),
         # The distributed-supervision contract (SEMANTICS.md
         # "Distributed supervision"), certified across a REAL process
         # boundary: a single-rank NaN rolls BOTH ranks back to the
@@ -1741,6 +1869,7 @@ def main():
                "fleet_host_sigkill": "recovered",
                "fleet_lease_race": "recovered",
                "fleet_cache_route": "recovered",
+               "obs_recorder_sigkill": "recovered",
                "mp_split_brain": "recovered",
                "mp_peer_lost": "recovered",
                "mp_overlap_parity": "recovered"}

@@ -78,6 +78,7 @@ import json
 import math
 import os
 import sys
+import time
 
 
 def _percentile(sorted_vals, q):
@@ -87,6 +88,38 @@ def _percentile(sorted_vals, q):
     i = min(len(sorted_vals) - 1,
             max(0, round(q / 100 * (len(sorted_vals) - 1))))
     return sorted_vals[i]
+
+
+def resolve_window(since, until, now=None):
+    """``--since``/``--until`` values -> absolute ``(t0, t1)`` bounds.
+
+    Non-negative values are absolute unix timestamps (what the journal
+    and telemetry ``t_wall`` fields carry); negative values are
+    relative to now — ``--since -3600`` reports the last hour, the
+    spelling ``tools/slo_gate.py --window`` builds on. ``None`` stays
+    unbounded."""
+    now = time.time() if now is None else float(now)
+
+    def _abs(v):
+        if v is None:
+            return None
+        v = float(v)
+        return now + v if v < 0 else v
+
+    return _abs(since), _abs(until)
+
+
+def in_window(t, t0, t1):
+    """True when timestamp ``t`` falls inside ``[t0, t1]`` (``None``
+    bounds unbounded; an event WITHOUT a wall clock is kept — the
+    window filters activity, it must not eat schema-less lines)."""
+    if t is None:
+        return True
+    if t0 is not None and t < t0:
+        return False
+    if t1 is not None and t > t1:
+        return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -615,12 +648,20 @@ def summarize(events, outlier_mult=5.0):
     return doc
 
 
-def summarize_fleet(root):
+def summarize_fleet(root, since=None, until=None):
     """Aggregate a heatd queue root into the fleet summary document.
 
     Imported lazily (and with the repo root on sys.path) because the
     journal reducer lives in the package — single-file telemetry mode
-    stays stdlib-only and fast."""
+    stays stdlib-only and fast.
+
+    ``since``/``until`` (absolute unix timestamps, ``None`` =
+    unbounded) window the report to journal activity inside the
+    bounds: a job counts when any of its journal events falls in the
+    window, event counters count windowed lines only. The durability
+    fold always runs over the FULL journal — anomalies are a
+    whole-history invariant, a window must not hide (or fabricate) a
+    double-terminal."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from parallel_heat_tpu.service.store import (
@@ -629,6 +670,11 @@ def summarize_fleet(root):
     store = JobStore(root, create=False)
     events, bad, torn = store.read_journal()
     jobs, anomalies = reduce_journal(events)
+    if since is not None or until is not None:
+        events = [e for e in events
+                  if in_window(e.get("t_wall"), since, until)]
+        active = {e.get("job_id") for e in events if e.get("job_id")}
+        jobs = {jid: v for jid, v in jobs.items() if jid in active}
     counts = {}
     for v in jobs.values():
         counts[v.state] = counts.get(v.state, 0) + 1
@@ -730,6 +776,8 @@ def summarize_fleet(root):
         "torn_tail": torn,
         "anomalies_journal": anomalies,
     }
+    if since is not None or until is not None:
+        doc["window"] = {"since": since, "until": until}
     return doc
 
 
@@ -741,7 +789,7 @@ _FED_SUMMED = (
     "cache_prefix_hits", "cache_bytes_saved", "cache_steps_saved")
 
 
-def summarize_federation(fleet_root):
+def summarize_federation(fleet_root, since=None, until=None):
     """Aggregate a FEDERATED root (``fleet.json`` marker): the merged
     fleet counters over every partition, plus the per-host rows the
     ISSUE's observability contract names — leases held, jobs adopted,
@@ -749,7 +797,9 @@ def summarize_federation(fleet_root):
     ``--fail-on`` grammar (``fleet.<counter>`` dotted paths resolve
     against the merged section). Latency percentiles are the WORST
     partition's (per-partition raw samples are not merged — the slow
-    partition is the one the SLO cares about)."""
+    partition is the one the SLO cares about). ``since``/``until``
+    window every partition and the per-host attribution identically
+    (see :func:`summarize_fleet` for the windowing contract)."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from parallel_heat_tpu.service.fleet import (
@@ -773,7 +823,7 @@ def summarize_federation(fleet_root):
             "peer_cache_hit_rate": None})
 
     for name, proot in partition_roots(fleet_root):
-        doc = summarize_fleet(proot)
+        doc = summarize_fleet(proot, since=since, until=until)
         partitions[name] = doc["fleet"]
         anomalies_journal += [f"{name}: {a}"
                               for a in doc["anomalies_journal"]]
@@ -791,6 +841,9 @@ def summarize_federation(fleet_root):
         # lines (every daemon append carries its FleetHost's name).
         events, _bad, _torn = read_journal_file(
             os.path.join(proot, "journal.jsonl"))
+        if since is not None or until is not None:
+            events = [e for e in events
+                      if in_window(e.get("t_wall"), since, until)]
         done_by, hit_by = {}, {}
         for e in events:
             ev, h = e.get("event"), e.get("host")
@@ -850,10 +903,13 @@ def summarize_federation(fleet_root):
         "quarantined_jobs": [q for p in partitions.values()
                              for q in p["quarantined_jobs"]],
     })
-    return {"fleet": merged, "hosts": hosts, "partitions": partitions,
-            "federated": True, "events_total": events_total,
-            "bad_lines": bad_total, "torn_tail": torn_any,
-            "anomalies_journal": anomalies_journal}
+    out = {"fleet": merged, "hosts": hosts, "partitions": partitions,
+           "federated": True, "events_total": events_total,
+           "bad_lines": bad_total, "torn_tail": torn_any,
+           "anomalies_journal": anomalies_journal}
+    if since is not None or until is not None:
+        out["window"] = {"since": since, "until": until}
+    return out
 
 
 def render_federation_text(doc):
@@ -1128,6 +1184,86 @@ def _fmt(v):
     return "-" if v is None else f"{v:,.0f}"
 
 
+def _rollup_main(args, since, until):
+    """``--rollup``: answer from the obs recorder's folded series DB
+    (``<root>/obs/`` — snapshot + delta journal) instead of re-folding
+    the raw journals. O(series) regardless of journal length, and the
+    ONLY mode that can window into the recorder's retention tiers
+    after the raw journals rotate. Same ``--fail-on`` grammar; the
+    rollup doc is flat (windowed counter deltas, gauge percentile
+    dicts), so the same dotted paths resolve."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from parallel_heat_tpu.obs.series import (
+        JOURNAL_COUNTERS, load_state, obs_dir_for, summarize_window)
+
+    obs_dir = obs_dir_for(args.metrics)
+    if not os.path.isdir(obs_dir):
+        print(f"error: {args.metrics}: --rollup needs a recorder "
+              f"state under {obs_dir} — run `heatd metrics-serve "
+              f"--root {args.metrics}` first", file=sys.stderr)
+        return 1
+    state, _gen = load_state(obs_dir)
+    if not state.get("series"):
+        print(f"error: {obs_dir}: recorder state holds no series "
+              f"(nothing harvested yet)", file=sys.stderr)
+        return 1
+    doc = summarize_window(state, since, until)
+    anomalies = []
+    try:
+        _events, ceilings, floors = parse_fail_on(args.fail_on)
+    except ValueError as e:
+        print(f"error: --fail-on: {e}", file=sys.stderr)
+        return 1
+    # A counter the recorder KNOWS but never saw an event for has no
+    # series — for gating that is a measured zero ('quarantined>0'
+    # must pass on a healthy root, not error), while a name outside
+    # the recorder's vocabulary stays a loud error.
+    known_zero = (set(JOURNAL_COUNTERS.values())
+                  | {"cache_hits", "lease_takeovers", "chunks"})
+    for name, thr in ceilings:
+        exists, val = resolve_metric(doc, name)
+        if not exists:
+            if name in known_zero:
+                exists, val = True, 0.0
+            else:
+                print(f"error: --fail-on counter {name!r} is not a "
+                      f"rollup metric (have: "
+                      f"{', '.join(sorted(k for k in doc if k != 'window'))}, "
+                      f"plus any recorder counter as an implicit 0)",
+                      file=sys.stderr)
+                return 1
+        if val is not None and val > thr:
+            anomalies.append(f"{name} = {val:g} > {thr:g}")
+    for name, thr in floors:
+        val = lookup_metric(doc, name)
+        if val is not None and val < thr:
+            anomalies.append(f"{name} = {val:g} < {thr:g}")
+    doc["anomalies"] = anomalies
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    else:
+        w = doc["window"]
+        out = [f"rollup {args.metrics} (obs series, window "
+               f"{w['since']}..{w['until']}): "
+               f"{doc['n_samples']} sample(s) folded"]
+        for k in sorted(doc):
+            if k in ("window", "anomalies", "n_samples",
+                     "last_sample_t"):
+                continue
+            v = doc[k]
+            if isinstance(v, dict):
+                out.append(f"  {k}: p50={v['p50']:g} p99={v['p99']:g} "
+                           f"max={v['max']:g} (n={v['n']})")
+            elif v is not None:
+                out.append(f"  {k}: {v:g}")
+        print("\n".join(out))
+        for a in anomalies:
+            print(f"ANOMALY: {a}")
+    return 2 if anomalies else 0
+
+
 def _fleet_main(args):
     """Directory input: fleet mode over a heatd queue root, or the
     federated view when the directory carries the ``fleet.json``
@@ -1136,6 +1272,9 @@ def _fleet_main(args):
         os.path.abspath(__file__))))
     from parallel_heat_tpu.service.fleet import is_fleet_root
 
+    since, until = resolve_window(args.since, args.until)
+    if args.rollup:
+        return _rollup_main(args, since, until)
     federated = is_fleet_root(args.metrics)
     journal = os.path.join(args.metrics, "journal.jsonl")
     if not federated and not os.path.isfile(journal):
@@ -1144,8 +1283,10 @@ def _fleet_main(args):
               f"fleet.json marker)",
               file=sys.stderr)
         return 1
-    doc = (summarize_federation(args.metrics) if federated
-           else summarize_fleet(args.metrics))
+    doc = (summarize_federation(args.metrics, since=since, until=until)
+           if federated
+           else summarize_fleet(args.metrics, since=since,
+                                until=until))
     anomalies = []
     fleet = doc["fleet"]
     try:
@@ -1229,7 +1370,29 @@ def main(argv=None):
                          "tokens threshold counts: event counts on a "
                          "stream, fleet counters on a queue root "
                          "('quarantined>0' is the serving CI gate)")
+    ap.add_argument("--since", type=float, default=None, metavar="T",
+                    help="window start: wall-clock unix timestamp, or "
+                         "negative = seconds before now (--since "
+                         "-3600 reports the last hour). Applies to "
+                         "streams, fleet roots, and --rollup alike")
+    ap.add_argument("--until", type=float, default=None, metavar="T",
+                    help="window end (same spelling as --since; "
+                         "default: unbounded)")
+    ap.add_argument("--rollup", action="store_true",
+                    help="directory targets only: report from the obs "
+                         "recorder's folded series DB (<root>/obs/) "
+                         "instead of re-folding the raw journals — "
+                         "O(series) and able to window past journal "
+                         "rotation; same --fail-on grammar over the "
+                         "windowed counter deltas and gauge "
+                         "percentiles")
     args = ap.parse_args(argv)
+
+    if args.rollup and not os.path.isdir(args.metrics):
+        print("error: --rollup needs a queue/fleet ROOT directory "
+              "(the recorder state lives under <root>/obs/)",
+              file=sys.stderr)
+        return 1
 
     if os.path.isdir(args.metrics):
         return _fleet_main(args)
@@ -1252,10 +1415,20 @@ def main(argv=None):
               f"telemetry stream (or one from a newer schema)",
               file=sys.stderr)
         return 1
+    if args.since is not None or args.until is not None:
+        # Window the activity; run headers survive regardless — they
+        # carry the config/topology identity the summary hangs off,
+        # windowing is about WHEN work happened, not whose run it was.
+        since, until = resolve_window(args.since, args.until)
+        events = [e for e in events
+                  if e.get("event") == "run_header"
+                  or in_window(e.get("t_wall"), since, until)]
 
     doc = summarize(events, outlier_mult=args.outlier_mult)
     doc["bad_lines"] = bad
     doc["torn_tail"] = bool(torn_paths)
+    if args.since is not None or args.until is not None:
+        doc["window"] = {"since": since, "until": until}
     if len(shards) > 1:
         doc["shards"] = [{"path": r["path"],
                           "process_index": r["process_index"],

@@ -22,6 +22,16 @@ Robust by construction: a torn final line (the writer is mid-append),
 foreign lines, or a missing/partially-renamed heartbeat are skipped,
 never fatal — a monitor must not crash because it raced a writer.
 
+``--fleet`` rows carry sparkline trend columns (``done:▁▂▅█``
+completions, ``sps:▃▅▇`` solver throughput per host) when the root
+has a flight recorder (``heatd metrics-serve`` writing ``<root>/obs/``
+— tools/monitor reads only the recorder's artifacts, never folds the
+raw journals twice), plus the recorder's own heartbeat with the same
+``(stale?)`` convention as every other heartbeat here. That is the
+recorder-down-vs-idle-fleet distinction: a FRESH recorder heartbeat
+over flat/empty sparklines is an idle fleet; a STALE one means the
+series' age tells you about the recorder, not the fleet.
+
 ``--daemon QUEUE_ROOT`` adds the heatd service view: the daemon's
 status heartbeat (``heatd.json``) plus a lightweight fold of the job
 journal into per-state counts, queue depth and the oldest-accepted
@@ -295,6 +305,133 @@ class DaemonState:
         return " | ".join(parts) if parts else None
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def spark(points, width=10, agg="sum"):
+    """Unicode sparkline over ``(t, value)`` samples: the time span is
+    cut into ``width`` buckets, each bucket is the sum (counters:
+    activity volume) or mean (gauges: level) of its samples, scaled to
+    the max bucket. Empty input renders nothing; an all-zero window
+    renders the floor glyph for every bucket (a visibly flat line IS
+    the idle signal)."""
+    if not points:
+        return ""
+    ts = [t for t, _ in points]
+    t0, span = min(ts), max(max(ts) - min(ts), 1e-9)
+    buckets = [[] for _ in range(width)]
+    for t, v in points:
+        buckets[min(width - 1, int((t - t0) / span * width))].append(v)
+    vals = [(sum(b) if agg == "sum" else sum(b) / len(b)) if b else 0.0
+            for b in buckets]
+    vmax = max(vals)
+    if vmax <= 0:
+        return _SPARK[0] * width
+    top = len(_SPARK) - 1
+    return "".join(_SPARK[min(top, int(v / vmax * top + 0.5))]
+                   for v in vals)
+
+
+class ObsState:
+    """Probe-side read of the flight recorder's artifacts
+    (``<root>/obs/``): the recorder heartbeat (``recorder.json``) for
+    the recorder-down-vs-idle distinction, and the delta journals'
+    recent samples for the per-host sparkline columns. Incremental and
+    stdlib-only like every state here — the authoritative fold is
+    ``parallel_heat_tpu/obs/series.py``; a status line only needs the
+    delta tail (recent activity), so torn lines and unknown sample
+    shapes are skipped, never fatal."""
+
+    _KEEP = 4096  # samples retained per (host, counter) column
+
+    def __init__(self, root):
+        self.dir = os.path.join(root, "obs")
+        self._offsets = {}
+        self._partials = {}
+        # (host, counter) -> [(t, value)]: increments for counters
+        # (bucket-sum = completions per bucket), raw values for gauges.
+        self.points = {}
+
+    def poll(self):
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith("deltas.")
+                           and n.endswith(".jsonl"))
+        except OSError:
+            return
+        for n in names:
+            self._poll_file(os.path.join(self.dir, n))
+
+    def _poll_file(self, path):
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offsets.get(path, 0))
+                data = f.read()
+        except OSError:
+            return
+        if not data:
+            return
+        self._offsets[path] = self._offsets.get(path, 0) + len(data)
+        buf = self._partials.get(path, b"") + data
+        lines = buf.split(b"\n")
+        self._partials[path] = lines[-1]
+        for line in lines[:-1]:
+            self._ingest(line)
+
+    def _ingest(self, line):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return
+        if not isinstance(rec, dict) or rec.get("event") != "harvest":
+            return
+        for s in rec.get("samples") or []:
+            if not isinstance(s, dict):
+                continue
+            c = s.get("counter")
+            if c not in ("completed", "steps_per_s"):
+                continue
+            try:
+                t, v = float(s["t"]), float(s["value"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            pts = self.points.setdefault((str(s.get("host") or ""), c),
+                                         [])
+            pts.append((t, v))
+            del pts[:-self._KEEP]
+
+    def render_status(self, now=None):
+        """``obs hb 0.3s ago`` / ``... (stale?)`` — ``None`` when the
+        root has no ``obs/`` dir at all (a fleet without a recorder
+        shows nothing rather than a false alarm)."""
+        if not os.path.isdir(self.dir):
+            return None
+        hb = read_heartbeat(os.path.join(self.dir, "recorder.json"))
+        if hb is None or not isinstance(hb.get("t_wall"), (int, float)):
+            return "obs: no recorder heartbeat"
+        now = time.time() if now is None else now
+        age = max(0.0, now - hb["t_wall"])
+        iv = hb.get("interval_s") or 1.0
+        stale = " (stale?)" if age > max(3.0 * iv, 5.0) else ""
+        return f"obs hb {age:.1f}s ago{stale}"
+
+    def host_columns(self, host):
+        """Sparkline columns for one host row (empty string when the
+        recorder has no samples for it)."""
+        done = spark(self.points.get((host, "completed"), []))
+        sps = spark(self.points.get((host, "steps_per_s"), []),
+                    agg="mean")
+        out = ""
+        if done:
+            out += f" done:{done}"
+        if sps:
+            out += f" sps:{sps}"
+        return out
+
+
 class FleetState:
     """Probe-side view of a FEDERATED root (``fleet.json`` +
     ``parts/``): one :class:`DaemonState` per partition for job
@@ -310,6 +447,7 @@ class FleetState:
         self._offsets = {}
         self._partials = {}
         self.hosts = {}
+        self.obs = ObsState(root)
 
     def _hrow(self, h):
         return self.hosts.setdefault(h, {
@@ -330,6 +468,7 @@ class FleetState:
         for n, d in self.parts.items():
             d.poll()
             self._poll_hosts(n)
+        self.obs.poll()
 
     def _poll_hosts(self, name):
         path = os.path.join(self.parts[name].root, "journal.jsonl")
@@ -410,7 +549,11 @@ class FleetState:
                    f"adopted={r['adopted']} steals={r['steals']}")
             if done:
                 row += f" cache_hit_rate={hits / done:.0%}"
+            row += self.obs.host_columns(h)
             parts.append(row)
+        ob = self.obs.render_status(now)
+        if ob is not None:
+            parts.append(ob)
         if self.exited:
             parts.append("all hosts exited (drained)")
         return " | ".join(parts) if len(parts) > 1 else None

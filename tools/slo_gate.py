@@ -32,8 +32,18 @@ ceiling, ``NAME<NUM`` floor, dotted paths into the summary docs)::
       "stream": ["permanent_failure", "busy<0.25",
                  "barrier_wait_p99>0.25",
                  "checkpoints.overhead_share>0.5"],
-      "heartbeat_max_age_s": 120
+      "heartbeat_max_age_s": 120,
+      "window_s": 3600
     }
+
+``window_s`` (spec key) / ``--window SECONDS`` (CLI, overriding the
+spec) gate the LAST W seconds instead of all history: fleet counters
+become windowed activity (journal durability anomalies still judge
+the full history — a window must not hide a double-terminal), stream
+tokens see only windowed events. This is what lets one long-lived
+fleet pass a "quarantined>0" gate forever on the strength of its
+recent behaviour while an old, already-diagnosed incident stays in
+the journal.
 
 The result-cache counters (``cache_hits`` / ``cache_prefix_hits`` /
 ``cache_hit_rate`` / ``cache_prefix_rate`` / ``cache_bytes_saved`` /
@@ -98,7 +108,30 @@ def _shard_doc(row, need_busy):
             "peer_lost": row.get("peer_lost", 0)}
 
 
-def check_stream(label, paths, tokens, violations):
+def _window_row(row, since):
+    """One shard row restricted to events at/after ``since`` (run
+    headers survive — they carry the identity the summary hangs off).
+    The per-rank folds load_streams precomputed (barrier-wait
+    percentiles, peer_lost) are re-derived from the windowed events so
+    every gated metric sees the same window."""
+    ev = [e for e in row["events"]
+          if e.get("event") == "run_header"
+          or mr.in_window(e.get("t_wall"), since, None)]
+    waits = sorted(e["wait_s"] for e in ev
+                   if e.get("event") == "barrier_wait"
+                   and isinstance(e.get("wait_s"), (int, float)))
+    bw = None
+    if waits:
+        bw = {"n": len(waits), "p50_s": mr._percentile(waits, 50),
+              "p99_s": mr._percentile(waits, 99), "max_s": waits[-1]}
+    out = dict(row)
+    out.update(events=ev, barrier_wait=bw,
+               peer_lost=sum(1 for e in ev
+                             if e.get("event") == "peer_lost"))
+    return out
+
+
+def check_stream(label, paths, tokens, violations, since=None):
     """Evaluate stream tokens against ONE run (a single stream, or the
     ``.pN`` shard family of one multi-process run). Returns False when
     the target is unusable."""
@@ -110,6 +143,8 @@ def check_stream(label, paths, tokens, violations):
             print(f"error: {p}: {e}", file=sys.stderr)
             return False
         rows.extend(rs)
+    if since is not None:
+        rows = [_window_row(r, since) for r in rows]
     rows = [r for r in rows if r["events"]]
     if not rows:
         # The caller decides whether an eventless run is fatal (a
@@ -206,7 +241,8 @@ def check_stream(label, paths, tokens, violations):
     return True
 
 
-def check_fleet(root, tokens, hb_max_age_s, violations, now=None):
+def check_fleet(root, tokens, hb_max_age_s, violations, now=None,
+                since=None):
     """Evaluate fleet tokens + heartbeat freshness against one queue
     root — or, when the directory carries the ``fleet.json`` marker,
     against the FEDERATED summary (merged counters, so the same token
@@ -220,7 +256,7 @@ def check_fleet(root, tokens, hb_max_age_s, violations, now=None):
         host_record_fresh, is_fleet_root, read_host_records)
 
     if is_fleet_root(root):
-        doc = mr.summarize_federation(root)
+        doc = mr.summarize_federation(root, since=since)
         fleet = doc["fleet"]
         _events, ceilings, floors = tokens
         for name, thr, is_floor in (
@@ -259,7 +295,7 @@ def check_fleet(root, tokens, hb_max_age_s, violations, now=None):
         print(f"error: {root}: no journal.jsonl — not a heatd queue "
               f"root", file=sys.stderr)
         return False
-    doc = mr.summarize_fleet(root)
+    doc = mr.summarize_fleet(root, since=since)
     fleet = doc["fleet"]
     _events, ceilings, floors = tokens
     for name, thr, is_floor in ([(n, v, False) for n, v in ceilings]
@@ -331,6 +367,12 @@ def main(argv=None):
     ap.add_argument("--now", type=float, default=None,
                     help="clock override for heartbeat freshness "
                          "(tests/replays; default: wall now)")
+    ap.add_argument("--window", type=float, default=None,
+                    metavar="SECONDS",
+                    help="gate only the last SECONDS of activity "
+                         "(overrides the spec's window_s; journal "
+                         "durability anomalies still judge the full "
+                         "history)")
     args = ap.parse_args(argv)
 
     spec = {}
@@ -356,12 +398,27 @@ def main(argv=None):
               "tokens (an empty gate gates nothing)", file=sys.stderr)
         return 1
     hb_max = spec.get("heartbeat_max_age_s")
+    window = args.window if args.window is not None \
+        else spec.get("window_s")
+    since = None
+    if window is not None:
+        try:
+            window = float(window)
+        except (TypeError, ValueError):
+            print(f"error: window_s must be a number, got "
+                  f"{window!r}", file=sys.stderr)
+            return 1
+        if window <= 0:
+            print("error: window_s must be positive", file=sys.stderr)
+            return 1
+        since = (args.now if args.now is not None
+                 else time.time()) - window
 
     violations = []
     for target in args.targets:
         if os.path.isdir(target):
             ok = check_fleet(target, fleet_tokens, hb_max,
-                             violations, now=args.now)
+                             violations, now=args.now, since=since)
             if not ok:
                 return 1
             continue
@@ -371,7 +428,8 @@ def main(argv=None):
         # NO gateable run is unusable input.
         gated = 0
         for label, paths in expand_stream_targets(target).items():
-            ok = check_stream(label, paths, stream_tokens, violations)
+            ok = check_stream(label, paths, stream_tokens, violations,
+                              since=since)
             if ok is False:
                 return 1
             if ok is True:
