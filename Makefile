@@ -30,7 +30,7 @@ RUN = $(PY) -m parallel_heat_tpu --nx $(SIZE) --ny $(SIZE) --steps $(STEPS) \
 .PHONY: all heat heat_con native test lint lint-fast chaos mp-smoke \
         telemetry-smoke monitor-smoke overlap-smoke serve-smoke \
         fleet-smoke ensemble-smoke trace-smoke cache-smoke \
-        implicit-smoke tune-smoke obs-smoke bench clean
+        implicit-smoke tune-smoke obs-smoke prof-smoke bench clean
 
 all: heat
 
@@ -519,6 +519,47 @@ obs-smoke:
 	assert d['completed'] >= 2, d; \
 	assert d['chunks'] >= 3, d"
 	rm -rf .obs_smoke
+
+# Performance-attribution run-book as a gate (docs/OBSERVABILITY.md
+# "Performance attribution"): an instrumented CPU run must emit live
+# profile events; heatprof must join them and name the expected bound
+# (the plain f32 stencil is hbm-bound on the modeled v5e roofline);
+# the clean stream must pass a roofline floor it honestly meets, and a
+# doctored (collapsed-fraction) stream must trip the SAME floor with
+# exit 2 — the shared --fail-on grammar, exercised end to end.
+prof-smoke:
+	$(PY) tools/heatlint.py --layer ast --fail-on error
+	rm -rf .prof_smoke && mkdir -p .prof_smoke
+	JAX_PLATFORMS=cpu $(PY) -m parallel_heat_tpu --nx 512 --ny 512 \
+	    --steps 120 --backend jnp --supervise \
+	    --checkpoint .prof_smoke/ck --checkpoint-every 40 \
+	    --guard-interval 20 --metrics .prof_smoke/m.jsonl --quiet
+	JAX_PLATFORMS=cpu $(PY) tools/heatprof.py .prof_smoke/m.jsonl \
+	    --json --fail-on 'roofline_frac<1e-5' | $(PY) -c "\
+	import json, sys; \
+	doc = json.load(sys.stdin)['runs'][0]; \
+	assert doc['live_profile'], 'no live profile events'; \
+	assert doc['segments'], doc; \
+	hist = doc['bound_histogram']; \
+	dom = max(hist, key=hist.get); \
+	assert dom == 'hbm', (dom, hist); \
+	assert doc['model']['predicted_bound'] == 'hbm', doc['model']"
+	$(PY) -c "\
+	import json; \
+	lines = [json.loads(l) for l in open('.prof_smoke/m.jsonl')]; \
+	out = open('.prof_smoke/doctored.jsonl', 'w'); \
+	[out.write(json.dumps(dict(e, roofline_frac=e['roofline_frac'] \
+	 * 1e-3) if e.get('event') == 'profile' else e) + chr(10)) \
+	 for e in lines]; \
+	out.close()"
+	rc=0; JAX_PLATFORMS=cpu $(PY) tools/heatprof.py \
+	    .prof_smoke/doctored.jsonl --fail-on 'roofline_frac<1e-5' \
+	    || rc=$$?; \
+	if [ $$rc -ne 2 ]; then \
+	    echo "doctored stream: heatprof exit $$rc != 2"; exit 1; fi
+	JAX_PLATFORMS=cpu $(PY) tools/monitor.py --once \
+	    --metrics .prof_smoke/m.jsonl | grep -q "roofline"
+	rm -rf .prof_smoke
 
 bench:
 	$(PY) bench.py

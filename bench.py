@@ -57,6 +57,25 @@ def _path_label(cfg):
         return f"explain failed: {e!r}"
 
 
+def _work_model_stamp(cfg):
+    """Static roofline prediction for an artifact row (prof plane) —
+    the model the measured ``mcells_steps_per_s`` is judged against by
+    ``tools/heatprof.py``. Same defensive contract as ``_path_label``:
+    a missing model must not kill a bench."""
+    from parallel_heat_tpu.prof import work_model
+
+    try:
+        m = work_model(cfg)
+        return {
+            "tune_key": m["tune_key"],
+            "predicted_bound": m["predicted_bound"],
+            "roofline_mcells_steps_per_s":
+                round(m["roofline_mcells_steps_per_s"], 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)}
+
+
 def _bench_fixed(cfg, budget_s=10.0, batches=3):
     """Steady-state seconds per run (fixed-step configs, chained slope).
 
@@ -656,6 +675,7 @@ def main(argv=None):
         "unit": "Mcells*steps/s",
         "path": _path_label(headline),
         "vs_baseline": round(mcells / BASELINE_MCELLS_PER_S, 3),
+        "work_model": _work_model_stamp(headline),
     }
     print(json.dumps(headline_row))
     sys.stdout.flush()
@@ -725,6 +745,7 @@ def main(argv=None):
                     "wall_s": round(elapsed, 4),
                     "mcells_steps_per_s": round(
                         cells * steps_run / elapsed / 1e6, 1),
+                    "work_model": _work_model_stamp(cfg),
                 }
                 if cfg.converge and not chainable:
                     out["steps_to_converge"] = steps_run

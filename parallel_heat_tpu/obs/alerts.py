@@ -59,6 +59,16 @@ class AlertPolicy:
     cache_min_completed: int = 8
     # heartbeat_gap: newest sampled heartbeat age past this.
     hb_max_age_s: float = 30.0
+    # efficiency_regression: a job's windowed mean roofline_frac
+    # (prof's profile events via the series harvest) below this
+    # fraction of the partition's own PRE-WINDOW baseline. Relative by
+    # design — on CPU the peaks are the v5e row's, so every fraction
+    # is honestly tiny and an absolute floor would trip on every CPU
+    # run; only a collapse against the same site's history means
+    # anything. Needs no TuneDB (complements perf_regression).
+    eff_collapse_fraction: float = 0.5
+    eff_min_samples: int = 3
+    eff_min_baseline: int = 3
 
 
 def reduce_alerts(events, state=None
@@ -216,6 +226,8 @@ class AlertEngine:
         if root and tune_db:
             self._perf_conditions(state, root, tune_db, topology,
                                   conditions)
+        if root:
+            self._eff_conditions(state, root, conditions)
         active = self.active()
         tripped = []
         for key, alert in sorted(conditions.items()):
@@ -226,10 +238,11 @@ class AlertEngine:
             tripped.append(rec)
         for key in sorted(active):
             kind = key.split("|", 1)[0]
-            # perf_regression latches per JOB: a finished run cannot
-            # "recover", and re-clearing would re-arm the latch the
-            # smoke gate counts on. Trend alerts clear on recovery.
-            if kind == "perf_regression":
+            # perf_regression / efficiency_regression latch per JOB: a
+            # finished run cannot "recover", and re-clearing would
+            # re-arm the latch the smoke gates count on. Trend alerts
+            # clear on recovery.
+            if kind in ("perf_regression", "efficiency_regression"):
                 continue
             if key not in conditions:
                 self.journal.append("alert_cleared", key=key)
@@ -348,6 +361,61 @@ class AlertEngine:
                             "expected_steps_per_s": expected,
                             "fraction": p.perf_fraction,
                             "n_samples": len(obs)}}
+
+
+    def _eff_conditions(self, state: dict, root: str,
+                        conditions: Dict[str, dict]) -> None:
+        """One condition per dispatched job whose windowed mean
+        roofline fraction collapses against the partition's own
+        pre-window history. The join mirrors ``_perf_conditions``
+        (partition names the series, dispatch/terminal times bound
+        the window) but the baseline is the series itself — the
+        samples BEFORE the job's window — so no tuning DB and no
+        absolute-peak assumption is needed (the roofline fraction is
+        only meaningful relative to the same site's history; see
+        ``AlertPolicy``'s field comment)."""
+        p = self.policy
+        for part, proot in _partitions(root):
+            events, _bad, _torn = read_journal_file(
+                os.path.join(proot, "journal.jsonl"))
+            jobs, _anom = reduce_journal(events)
+            samples: List[Tuple[float, float]] = []
+            for ser in state.get("series", {}).values():
+                if (ser["part"] == part
+                        and ser["counter"] == "roofline_frac"):
+                    samples.extend(ser["raw"])
+            if not samples:
+                continue
+            samples.sort()
+            for jid in sorted(jobs):
+                v = jobs[jid]
+                if v.first_dispatch_t is None:
+                    continue
+                if v.cached is not None:
+                    continue  # cache-served: no solve to regress
+                t0 = v.first_dispatch_t
+                t1 = v.terminal_t if v.terminal_t is not None \
+                    else math.inf
+                base = [val for t, val in samples if t < t0]
+                obs = [val for t, val in samples if t0 <= t <= t1]
+                if (len(obs) < p.eff_min_samples
+                        or len(base) < p.eff_min_baseline):
+                    continue
+                baseline = sum(base) / len(base)
+                sustained = sum(obs) / len(obs)
+                if (baseline > 0
+                        and sustained < p.eff_collapse_fraction
+                        * baseline):
+                    key = f"efficiency_regression|{part}|{jid}"
+                    conditions[key] = {
+                        "kind": "efficiency_regression", "host": "",
+                        "part": part, "job_id": jid,
+                        "detail": {
+                            "observed_roofline_frac": sustained,
+                            "baseline_roofline_frac": baseline,
+                            "fraction": p.eff_collapse_fraction,
+                            "n_samples": len(obs),
+                            "n_baseline": len(base)}}
 
 
 def _counter_at(raw, t: float) -> float:

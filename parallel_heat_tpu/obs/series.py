@@ -319,10 +319,28 @@ def _harvest_telemetry(part_root: str, part: str, pc: dict,
         offsets[name] = off
         host = job_host.get(name.partition(".")[0], "")
         for e in recs:
-            if e.get("event") != "chunk":
-                continue
+            ev = e.get("event")
             t = e.get("t_wall")
             if not isinstance(t, (int, float)):
+                continue
+            if ev == "profile":
+                # The efficiency plane (prof): roofline fraction as a
+                # per-(host, part) gauge — the efficiency_regression
+                # alert and monitor --fleet read this series — plus a
+                # per-bound counter for the attribution mix.
+                v = e.get("roofline_frac")
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    _sample(samples, t=t, host=host, part=part,
+                            counter="roofline_frac", kind="gauge",
+                            value=v)
+                b = e.get("bound")
+                if isinstance(b, str) and b in ("compute", "hbm",
+                                                "ici", "host"):
+                    _sample(samples, t=t, host=host, part=part,
+                            counter=f"bound_{b}", kind="counter",
+                            value=1)
+                continue
+            if ev != "chunk":
                 continue
             _sample(samples, t=t, host=host, part=part,
                     counter="chunks", kind="counter", value=1)

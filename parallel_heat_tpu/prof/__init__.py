@@ -1,0 +1,29 @@
+"""heatprof — the roofline-attributed performance plane.
+
+The stack can detect that a run is slow (``obs``'s ``perf_regression``
+latch) but not say *why*: telemetry records walls, TuneDB records
+winners, and the measured VPU roofline exists only as a standalone
+study. This package is the join: a STATIC work model per resolved
+schedule (:mod:`prof.model` — FLOPs/step, HBM bytes/step, ICI bytes
+per exchange, derived from the config + the resolved path, priced
+against :mod:`ops.tpu_params` peaks) folded against a run's MEASURED
+telemetry stream (:mod:`prof.attrib` — per-chunk achieved throughput,
+achieved-roofline fraction, and a named dominant bound from the
+compute / hbm / ici / host taxonomy).
+
+Everything here is host-side observation: the model is pure
+arithmetic over an already-resolved config, the join is a pure fold
+over already-emitted events, and neither touches a compiled program
+(the ``tests/test_prof.py`` observation-only pin holds this to the
+same contract as telemetry itself). Surfaces: ``solver.explain``'s
+``work_model`` key, the schema-versioned ``profile`` telemetry event,
+Perfetto counter tracks on the heattrace export, the
+``roofline_frac`` series in ``obs``, and the ``tools/heatprof.py``
+CLI.
+"""
+
+from parallel_heat_tpu.prof.attrib import (  # noqa: F401 — package API
+    PROFILE_SCHEMA, attribute_chunk, attribute_stream,
+    model_from_header)
+from parallel_heat_tpu.prof.model import (  # noqa: F401 — package API
+    BOUNDS, MODEL_VERSION, work_model)

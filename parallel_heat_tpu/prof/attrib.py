@@ -1,0 +1,216 @@
+"""Attribution join: measured telemetry x static work model.
+
+:func:`attribute_chunk` prices ONE chunk event against a work model:
+achieved throughput, achieved-roofline fraction, and a named dominant
+bound from the ``compute / hbm / ici / host`` taxonomy. The lane
+accounting is deliberately simple and host-visible:
+
+- ``host``: the chunk's ``gap_s`` (device idle charged to this chunk —
+  the observer/checkpoint/caller tax the stream measured);
+- ``ici``: measured ``exchange_s`` when the producer attributed one,
+  else the model's predicted exchange share of the wall;
+- the remaining device-busy wall goes to ``compute`` or ``hbm`` —
+  whichever lane the model says is slower for this schedule.
+
+The dominant bound is the largest lane. The roofline fraction is the
+chunk's achieved Mcells*steps/s over the model's roofline rate — on
+CPU this is honestly tiny (the peaks are the v5e row's; see
+``prof.model``), which is why every alerting consumer treats it as a
+relative series, never an absolute floor.
+
+:func:`attribute_stream` folds a whole event stream: live-emitted
+``profile`` events are used verbatim when present (they are the
+producer's own join); otherwise chunks are re-attributed here against
+the header's embedded ``work_model`` (or one rebuilt from the header
+config — the degradation ladder is explicit in the output's
+``degraded`` field, mirroring metrics_report's torn/foreign-line
+contract: bad inputs degrade the report, they never throw).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from parallel_heat_tpu.prof.model import valid_model
+
+# Schema of the `profile` telemetry event and of attribute_stream's
+# document. Bump on any field rename/retype; consumers ignore unknown
+# fields by the telemetry contract.
+PROFILE_SCHEMA = 1
+
+
+def attribute_chunk(chunk: dict, model: dict) -> dict:
+    """One chunk event + one work model -> one profile segment."""
+    wall = chunk.get("wall_s")
+    steps = chunk.get("steps")
+    wall = float(wall) if isinstance(wall, (int, float)) else 0.0
+    steps = int(steps) if isinstance(steps, (int, float)) else 0
+
+    seg = {
+        "prof_schema": PROFILE_SCHEMA,
+        "model_version": model.get("model_version"),
+        "tune_key": model.get("tune_key"),
+        "site": model.get("site"),
+        "step": chunk.get("step"),
+        "steps": steps,
+        "wall_s": wall,
+    }
+    if wall <= 0 or steps <= 0:
+        # Sub-resolution chunk: unmeasured, not wrong (same null
+        # convention as the chunk event's own rates).
+        seg.update(mcells_steps_per_s=None, roofline_frac=None,
+                   bound=None, shares=None)
+        return seg
+
+    cells = model["cells"]
+    mcells = cells * steps / wall / 1e6
+    roof = model["roofline_mcells_steps_per_s"]
+
+    gap = chunk.get("gap_s")
+    host_s = float(gap) if isinstance(gap, (int, float)) else 0.0
+    host_s = min(max(host_s, 0.0), wall)
+    ex = chunk.get("exchange_s")
+    if isinstance(ex, (int, float)):
+        ici_s = min(max(float(ex), 0.0), wall - host_s)
+    else:
+        ici_s = min(model.get("t_ici_s", 0.0) * steps, wall - host_s)
+    device_s = max(wall - host_s - ici_s, 0.0)
+    device_lane = ("compute"
+                   if model.get("t_compute_s", 0.0)
+                   >= model.get("t_hbm_s", 0.0) else "hbm")
+    shares = {"compute": 0.0, "hbm": 0.0, "ici": ici_s / wall,
+              "host": host_s / wall}
+    shares[device_lane] = device_s / wall
+    bound = max(shares, key=lambda k: shares[k])
+    seg.update(mcells_steps_per_s=mcells,
+               roofline_frac=mcells / roof,
+               bound=bound, shares=shares)
+    return seg
+
+
+def model_from_header(header: dict) -> Tuple[Optional[dict],
+                                             Optional[str]]:
+    """``(model, degraded_reason)`` from a run_header event.
+
+    Ladder: the header's embedded ``explain.work_model`` (stamped by
+    the producer — authoritative for the machine that ran); else a
+    model rebuilt from the header's config on THIS machine (honest but
+    re-resolved, flagged); else ``(None, reason)``.
+    """
+    ex = header.get("explain")
+    if isinstance(ex, dict):
+        m = valid_model(ex.get("work_model"))
+        if m is not None:
+            return m, None
+    cfg_doc = header.get("config")
+    if isinstance(cfg_doc, dict):
+        try:
+            import json
+
+            from parallel_heat_tpu.config import HeatConfig
+            from parallel_heat_tpu.prof.model import work_model
+
+            m = work_model(HeatConfig.from_json(json.dumps(cfg_doc)))
+            return m, "work model rebuilt from header config"
+        except Exception as e:  # noqa: BLE001 — degrade, never throw
+            return None, (f"work model unavailable "
+                          f"({type(e).__name__}: {e})")
+    return None, "run_header carries no work model and no config"
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def attribute_stream(events: Sequence[dict],
+                     model: Optional[dict] = None) -> dict:
+    """Fold one telemetry event stream into an attribution document."""
+    degraded: Optional[str] = None
+    segments: List[dict] = []
+    live_profile = False
+    chunks: List[dict] = []
+    totals = {"wall_s": 0.0, "steps": 0, "checkpoint_s": 0.0,
+              "barrier_s": 0.0, "chunks": 0}
+    model = valid_model(model)
+
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ev = e.get("event")
+        if ev == "run_header" and model is None:
+            model, degraded = model_from_header(e)
+        elif ev == "profile":
+            live_profile = True
+            segments.append(e)
+        elif ev == "chunk":
+            chunks.append(e)
+            totals["chunks"] += 1
+            w = e.get("wall_s")
+            if isinstance(w, (int, float)):
+                totals["wall_s"] += float(w)
+            s = e.get("steps")
+            if isinstance(s, (int, float)):
+                totals["steps"] += int(s)
+        elif ev in ("checkpoint_save",):
+            w = e.get("wall_s")
+            if isinstance(w, (int, float)):
+                totals["checkpoint_s"] += float(w)
+        elif ev in ("checkpoint_barrier", "barrier_wait"):
+            w = e.get("wait_s")
+            if isinstance(w, (int, float)):
+                totals["barrier_s"] += float(w)
+
+    if not live_profile and model is not None:
+        segments = [attribute_chunk(c, model) for c in chunks]
+    if not segments and model is None and degraded is None:
+        degraded = "no run_header in stream"
+
+    hist: dict = {}
+    fracs: List[float] = []
+    mcells: List[float] = []
+    worst: Optional[dict] = None
+    for seg in segments:
+        b = seg.get("bound")
+        if isinstance(b, str):
+            hist[b] = hist.get(b, 0) + 1
+        f = seg.get("roofline_frac")
+        if isinstance(f, (int, float)):
+            fracs.append(float(f))
+            if worst is None or f < worst["roofline_frac"]:
+                worst = {"step": seg.get("step"),
+                         "roofline_frac": float(f),
+                         "bound": seg.get("bound")}
+        m = seg.get("mcells_steps_per_s")
+        if isinstance(m, (int, float)):
+            mcells.append(float(m))
+
+    doc = {
+        "schema": PROFILE_SCHEMA,
+        "model": model,
+        "degraded": degraded,
+        "live_profile": live_profile,
+        "segments": segments,
+        "bound_histogram": hist,
+        "totals": totals,
+        "worst": worst,
+    }
+    if fracs:
+        sf = sorted(fracs)
+        doc["roofline_frac"] = {
+            "mean": sum(sf) / len(sf), "min": sf[0], "max": sf[-1],
+            "p50": _pct(sf, 0.50), "p90": _pct(sf, 0.90),
+            "n": len(sf)}
+    else:
+        doc["roofline_frac"] = None
+    if model is not None and mcells:
+        measured = sum(mcells) / len(mcells)
+        predicted = model["roofline_mcells_steps_per_s"]
+        doc["model_vs_measured"] = {
+            "predicted_mcells_steps_per_s": predicted,
+            "measured_mean_mcells_steps_per_s": measured,
+            "achieved_fraction": measured / predicted,
+        }
+    else:
+        doc["model_vs_measured"] = None
+    return doc

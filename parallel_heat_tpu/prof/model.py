@@ -1,0 +1,174 @@
+"""Static work model of one resolved schedule.
+
+The model answers "how fast could this config possibly run on this
+hardware" without running anything: per-step FLOPs and HBM traffic
+from the grid geometry (the same ``profiling.cell_count`` /
+``bytes_per_cell`` accounting the telemetry chunk events use — they
+must never disagree), per-exchange ICI traffic from the halo depth and
+the shard boundary extents, all priced against the
+:mod:`ops.tpu_params` generation peaks. The slowest of the three
+lanes is the roofline step time; its name is the PREDICTED bound.
+
+Identity: the model is keyed by the same (site, topology, geometry)
+content address TuneDB uses (``tune.tune_key``), so a measured row, a
+tuned entry, and a work model for one decision context all carry the
+same key and can be joined by content, not by path convention.
+
+FLOP accounting matches ``tools/vpu_roofline.py``'s study: a 5-point
+2D cell-step is 7 flops (3 mul + 4 add), the 3*ndim+1 generalization
+gives the 3D 7-point star 10. The VPU peak in the table is the
+sustained stencil rate in cells/s, so the compute lane is priced in
+cells directly; the flop counts are carried for report readers.
+
+On CPU ``tpu_params.params()`` deliberately falls back to the v5e row
+(picker decisions stay identical to hardware), so a CPU run's
+achieved-roofline fraction is honestly tiny (~1e-3). Consumers must
+therefore treat roofline fractions as RELATIVE instruments — the
+``efficiency_regression`` alert compares a window against the same
+site's own history, never against an absolute floor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+MODEL_VERSION = 1
+
+# The bound taxonomy, in attribution-priority order (docs/
+# OBSERVABILITY.md "Performance attribution" is the prose contract).
+BOUNDS = ("compute", "hbm", "ici", "host")
+
+
+def _flops_per_cell(ndim: int) -> int:
+    """Per cell-step flops of the ndim-dimensional star stencil:
+    ndim axis contributions (1 mul + 1 add each) + center (1 mul) +
+    (ndim - 1) adds folding the axes + 1 add into the center
+    = 3*ndim + 1 (2D 5-point: 7; 3D 7-point: 10)."""
+    return 3 * int(ndim) + 1
+
+
+def work_model(config, *, resolved: bool = False) -> dict:
+    """The static work model for one config, as a plain JSON-safe dict.
+
+    ``resolved=True`` promises the caller already ran the config
+    through ``solver._resolved`` (explain's body does); otherwise the
+    auto depth/schedule are concretized here through the same resolver
+    the build uses, so the modeled exchange traffic can never describe
+    a different schedule than the one that runs. Pure host arithmetic
+    after resolution — nothing is compiled or dispatched.
+    """
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu import tune
+    from parallel_heat_tpu.ops import tpu_params
+    from parallel_heat_tpu.utils import profiling
+
+    config = config.validate()
+    if not resolved:
+        from parallel_heat_tpu.solver import _resolved
+
+        config, _, _ = _resolved(config)
+
+    mesh = config.mesh_or_unit()
+    is_sharded = any(d > 1 for d in mesh)
+    n_shards = 1
+    for d in mesh:
+        n_shards *= int(d)
+
+    cells = profiling.cell_count(config)
+    bpc = profiling.bytes_per_cell(config)
+    itemsize = int(jnp.dtype(config.dtype).itemsize)
+    flops_cell = _flops_per_cell(config.ndim)
+
+    # --- identity: the TuneDB content address for this context -------
+    site = "halo_overlap" if is_sharded else "single_2d"
+    topology = tune.current_topology()
+    geometry = tune.geometry_for(site, config)
+    key, _ = tune.tune_key(site, topology, geometry)
+
+    # --- per-step work ----------------------------------------------
+    flops_per_step = flops_cell * cells
+    hbm_bytes_per_step = cells * bpc
+
+    # --- per-exchange ICI traffic (sharded explicit runs only) ------
+    # One exchange round per halo_depth steps; per device the round
+    # moves, for each partitioned axis, two directions x depth x the
+    # local boundary slab x itemsize (matches the temporal rounds'
+    # ppermute payloads; the deferred/pipelined schedules move the
+    # same bytes, just overlapped).
+    depth = config.halo_depth if config.scheme == "explicit" else 1
+    depth = int(depth) if depth else 1
+    block = config.block_shape()
+    ici_bytes_per_exchange = 0
+    if is_sharded:
+        for ax, d in enumerate(mesh):
+            if d <= 1:
+                continue
+            slab = 1
+            for j, b in enumerate(block):
+                if j != ax:
+                    slab *= int(b)
+            ici_bytes_per_exchange += 2 * depth * slab * itemsize
+    exchanges_per_step = (1.0 / depth) if is_sharded else 0.0
+
+    # --- roofline lanes (whole-grid rates: per-device peaks scale by
+    # the shard count — HBM and VPU are per-chip resources, ICI is
+    # per-link and every shard exchanges concurrently) ---------------
+    p = tpu_params.params()
+    t_compute = cells / (p.vpu_cells_per_s * n_shards)
+    t_hbm = hbm_bytes_per_step / (p.hbm_stream_bytes_per_s * n_shards)
+    t_ici = 0.0
+    if is_sharded:
+        t_ici = exchanges_per_step * (
+            ici_bytes_per_exchange / p.ici_bytes_per_s
+            + p.collective_latency_s)
+    step_time = max(t_compute, t_hbm, t_ici)
+    lanes = {"compute": t_compute, "hbm": t_hbm, "ici": t_ici}
+    predicted = max(lanes, key=lambda k: lanes[k])
+
+    return {
+        "model_version": MODEL_VERSION,
+        "site": site,
+        "tune_key": key,
+        "topology": topology,
+        "geometry": geometry,
+        "scheme": str(config.scheme),
+        "ndim": int(config.ndim),
+        "cells": int(cells),
+        "n_shards": n_shards,
+        "bytes_per_cell": int(bpc),
+        "flops_per_cell": flops_cell,
+        "flops_per_step": int(flops_per_step),
+        "hbm_bytes_per_step": int(hbm_bytes_per_step),
+        "halo_depth": depth if is_sharded else None,
+        "ici_bytes_per_exchange": int(ici_bytes_per_exchange),
+        "exchanges_per_step": exchanges_per_step,
+        "device_kind": p.kind,
+        "peaks": {
+            "vpu_cells_per_s": p.vpu_cells_per_s,
+            "hbm_stream_bytes_per_s": p.hbm_stream_bytes_per_s,
+            "ici_bytes_per_s": p.ici_bytes_per_s,
+            "collective_latency_s": p.collective_latency_s,
+        },
+        "t_compute_s": t_compute,
+        "t_hbm_s": t_hbm,
+        "t_ici_s": t_ici,
+        "step_time_s": step_time,
+        "predicted_bound": predicted,
+        "roofline_steps_per_s": 1.0 / step_time,
+        "roofline_mcells_steps_per_s": cells / step_time / 1e6,
+    }
+
+
+def valid_model(doc) -> Optional[dict]:
+    """``doc`` if it is a usable work-model dict (version we can read,
+    positive roofline), else ``None`` — the one acceptance gate every
+    consumer (attribution, monitor, bench stamping) shares."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("model_version") != MODEL_VERSION:
+        return None
+    roof = doc.get("roofline_mcells_steps_per_s")
+    if not isinstance(roof, (int, float)) or not roof > 0:
+        return None
+    return doc

@@ -620,6 +620,19 @@ def _explain_body(config: HeatConfig, ensemble: Optional[int]) -> dict:
         "mode": "converge" if config.converge else "fixed",
         "scheme": config.scheme,
     }
+    # The static work model (prof/model.py): FLOPs + HBM + ICI per
+    # step for THIS resolved schedule, priced against the generation
+    # peaks — the roofline denominator every attribution consumer
+    # joins against. Computed here (config is already resolved) so a
+    # run_header's embedded explain carries it for free.
+    try:
+        from parallel_heat_tpu.prof import model as _prof_model
+
+        out["work_model"] = _prof_model.work_model(config,
+                                                   resolved=True)
+    except Exception as e:  # noqa: BLE001 — explain must still
+        # resolve when the model cannot (observation-only plane)
+        out["work_model_error"] = f"{type(e).__name__}: {e}"
     # The schedule that actually runs: resolve_halo_overlap lets an
     # explicit "pipeline" through unchecked (explicit wins), but the
     # round builder falls back to the deferred schedule when the
@@ -1089,6 +1102,24 @@ def resolved_pipeline_depth(config: HeatConfig,
     return 2 if plat in ("tpu", "axon", "gpu", "cuda", "rocm") else 1
 
 
+def _emit_profile(telemetry, model, *, step: int, steps: int,
+                  wall_s: float, gap_s=None) -> None:
+    """Join one chunk against the work model and emit the `profile`
+    event (prof/attrib.py). Observation-only: any failure is swallowed
+    — attribution must never be able to end a stream."""
+    if model is None:
+        return
+    try:
+        from parallel_heat_tpu.prof import attrib as _prof_attrib
+
+        seg = _prof_attrib.attribute_chunk(
+            {"step": telemetry.step_offset + step, "steps": steps,
+             "wall_s": wall_s, "gap_s": gap_s}, model)
+        telemetry.emit("profile", **seg)
+    except Exception:  # noqa: BLE001 — observation-only
+        pass
+
+
 def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                  chunk_steps: Optional[int] = None, telemetry=None,
                  pipeline_depth: Optional[int] = None):
@@ -1182,10 +1213,21 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
         chunk = ((chunk + sub - 1) // sub) * sub
     u = _prepare_initial(config, initial)
 
+    prof_model = None
     if telemetry is not None:
         telemetry.run_header(config, pipeline_depth=depth)
         cells = profiling.cell_count(config)
         bytes_per_cell = profiling.bytes_per_cell(config)
+        # Work model for the per-chunk `profile` events: pure host
+        # arithmetic over the resolved schedule (prof/model.py); a
+        # model that cannot build silently disables attribution — the
+        # stream itself must never depend on the observer.
+        try:
+            from parallel_heat_tpu.prof import model as _prof_model
+
+            prof_model = _prof_model.work_model(config)
+        except Exception:  # noqa: BLE001 — observation-only
+            prof_model = None
 
     done = 0
     elapsed = 0.0
@@ -1349,6 +1391,8 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                                 dispatch_s=dispatch_s,
                                 drain_wait_s=drain_wait_s,
                                 observe_s=observe_s)
+                _emit_profile(telemetry, prof_model, step=done,
+                              steps=k, wall_s=chunk_wall, gap_s=gap_s)
                 if diag is not None:
                     telemetry.diagnostics(**diag)
             yield HeatResult(grid=keep, steps_run=done, converged=None,
@@ -1443,6 +1487,8 @@ def solve_stream(config: HeatConfig, initial: Optional[jax.Array] = None,
                             residual=out_res, converged=out_conv,
                             finite=finite, gap_s=gap_s,
                             observe_s=observe_s)
+            _emit_profile(telemetry, prof_model, step=done, steps=k,
+                          wall_s=chunk_wall, gap_s=gap_s)
             if diag is not None:
                 telemetry.diagnostics(
                     **{**diag, "step": done})

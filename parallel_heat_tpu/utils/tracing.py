@@ -527,8 +527,61 @@ def spans_from_journal(events: Sequence[dict]
 # Chrome trace-event export
 # ---------------------------------------------------------------------------
 
+def counters_from_stream(events: Sequence[dict],
+                         pid_label: Optional[str] = None
+                         ) -> List[dict]:
+    """Derive Perfetto counter-track samples from a stream's
+    ``profile`` events (prof/attrib.py): one ``roofline_frac`` series
+    and one stacked ``bound_share`` series (the compute/hbm/ici/host
+    lane split) per lane. Same wall anchoring as
+    :func:`spans_from_stream` (t_mono + the run_header offset), so the
+    counters line up under the chunk spans on the shared timeline.
+    Foreign or torn-in lines are skipped — degrade, never crash."""
+    counters: List[dict] = []
+    offsets: Dict[int, float] = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ev = e.get("event")
+        rank = e.get("process_index")
+        rank = rank if isinstance(rank, int) else 0
+        tm, tw = e.get("t_mono"), e.get("t_wall")
+        if ev == "run_header":
+            if isinstance(tm, (int, float)) and isinstance(tw,
+                                                           (int, float)):
+                offsets[rank] = tw - tm
+            continue
+        if ev != "profile":
+            continue
+        if rank in offsets and isinstance(tm, (int, float)):
+            t = tm + offsets[rank]
+        elif isinstance(tw, (int, float)):
+            t = tw
+        else:
+            continue
+        job_id = e.get("job_id")
+        pid = pid_label or (f"job {job_id}"
+                            if isinstance(job_id, str) else "run")
+        tid = f"rank {rank}"
+        rf = e.get("roofline_frac")
+        if isinstance(rf, (int, float)):
+            counters.append({"name": "roofline_frac", "t0": t,
+                             "pid": pid, "tid": tid,
+                             "value": float(rf)})
+        shares = e.get("shares")
+        if isinstance(shares, dict):
+            vals = {k: float(v) for k, v in shares.items()
+                    if isinstance(v, (int, float))}
+            if vals:
+                counters.append({"name": "bound_share", "t0": t,
+                                 "pid": pid, "tid": tid,
+                                 "value": vals})
+    return counters
+
+
 def chrome_trace(spans: Sequence[dict],
-                 instants: Sequence[dict] = ()) -> dict:
+                 instants: Sequence[dict] = (),
+                 counters: Sequence[dict] = ()) -> dict:
     """Render spans + instants as a Chrome trace-event document
     (``{"traceEvents": [...], "displayTimeUnit": "ms"}``) that opens
     in Perfetto / ``chrome://tracing``. Lanes (string ``pid``/``tid``)
@@ -556,7 +609,8 @@ def chrome_trace(spans: Sequence[dict],
                          "tid": t, "args": {"name": span["tid"]}})
         return p, t
 
-    t_min = min((s["t0"] for s in list(spans) + list(instants)),
+    t_min = min((s["t0"] for s in (list(spans) + list(instants)
+                                   + list(counters))),
                 default=0.0)
     for s in spans:
         p, t = ids(s)
@@ -578,6 +632,17 @@ def chrome_trace(spans: Sequence[dict],
         out.append({"name": s["name"], "cat": s.get("cat", "mark"),
                     "ph": "i", "s": "t",
                     "ts": (s["t0"] - t_min) * 1e6,
+                    "pid": p, "tid": t, "args": args})
+    for c in counters:
+        # Counter tracks ("C" phase): Perfetto renders one track per
+        # (pid, name); a dict value becomes a stacked multi-series
+        # track (the bound_share lane split).
+        p, t = ids(c)
+        v = c["value"]
+        args = ({k: v[k] for k in sorted(v)} if isinstance(v, dict)
+                else {"value": v})
+        out.append({"name": c["name"], "cat": "counter", "ph": "C",
+                    "ts": (c["t0"] - t_min) * 1e6,
                     "pid": p, "tid": t, "args": args})
     return {"traceEvents": meta + out, "displayTimeUnit": "ms",
             "otherData": {"t_min_wall": t_min,

@@ -343,6 +343,7 @@ def test_heartbeat_payload_self_sufficient(tmp_path):
                 pass
     doc = json.load(open(hb))
     assert doc["last_step"] == 200 and doc["step"] == 200
-    assert doc["last_event"] == "chunk"
+    # the chunk's prof-plane attribution segment lands right after it
+    assert doc["last_event"] == "profile"
     assert doc["residual"] is not None
     assert math.isfinite(doc["residual"])

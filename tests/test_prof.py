@@ -1,0 +1,411 @@
+"""prof plane: static work models, the attribution join, and the
+roofline-relative efficiency alert (ISSUE 19's acceptance surface).
+
+What is pinned here:
+
+- the work model's arithmetic: flops/cell (3*ndim+1), HBM bytes/step
+  (profiling's bytes_per_cell accounting), sharded ICI bytes per
+  exchange scaling with halo depth, and the TuneDB content-address
+  identity (one key joins tuned entries, measured rows and models);
+- the attribution join: lane shares, the dominant-bound argmax, the
+  gap_s clamp (sync-loop gaps may exceed the chunk wall), and the
+  null convention for sub-resolution chunks;
+- the degradation ladder: embedded model -> rebuilt from config ->
+  named reason; foreign streams degrade the report, never throw;
+- observation-only: profile emission between two identical solves
+  changes neither the result bits nor the ``_build_runner`` miss
+  count (the telemetry contract extended to the prof plane);
+- the series harvester folds profile events into the roofline_frac
+  gauge + per-bound counters, and ``efficiency_regression`` trips
+  exactly once on a doctored sub-roofline window while staying
+  silent on a clean one — with NO tuning DB (relative-to-own-history
+  by design: CPU runs price the v5e roofline, so absolute floors
+  would always trip);
+- ``tools/heatprof.py`` on the committed artifact names a dominant
+  bound per segment and the shared --fail-on grammar gates it;
+- the heatlint default scan paths cover the prof package.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from parallel_heat_tpu.config import HeatConfig
+from parallel_heat_tpu.prof import (
+    BOUNDS,
+    attribute_chunk,
+    attribute_stream,
+    work_model,
+)
+from parallel_heat_tpu.prof.model import valid_model
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_T0 = 1_700_000_000.0
+_BASE = dict(nx=16, ny=16, backend="jnp")
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Work model arithmetic
+# ---------------------------------------------------------------------------
+
+def test_work_model_2d_f32_pins():
+    m = work_model(HeatConfig(nx=64, ny=64, steps=10, backend="jnp"))
+    assert m["site"] == "single_2d"
+    assert m["ndim"] == 2 and m["flops_per_cell"] == 7  # 5-point star
+    assert m["cells"] == 64 * 64
+    assert m["bytes_per_cell"] == 8  # read + write, f32
+    assert m["hbm_bytes_per_step"] == 64 * 64 * 8
+    assert m["flops_per_step"] == 7 * 64 * 64
+    assert m["n_shards"] == 1
+    assert m["ici_bytes_per_exchange"] == 0
+    assert m["exchanges_per_step"] == 0.0
+    # Every generation in the tpu_params table is bandwidth-bound on
+    # the plain stencil, so the roofline rate is exactly the HBM peak
+    # over bytes/cell — the same identity tools/vpu_roofline.py pins.
+    assert m["predicted_bound"] == "hbm"
+    assert m["roofline_mcells_steps_per_s"] == pytest.approx(
+        m["peaks"]["hbm_stream_bytes_per_s"] / 8 / 1e6)
+    # Identity: the model is addressed by the same content hash TuneDB
+    # uses for this (site, topology, geometry) — joinable by content.
+    from parallel_heat_tpu import tune
+
+    key, _doc = tune.tune_key(m["site"], m["topology"], m["geometry"])
+    assert m["tune_key"] == key and len(key) == 40
+
+
+def test_work_model_3d_and_bf16():
+    m3 = work_model(HeatConfig(nx=32, ny=32, nz=32, steps=5,
+                               backend="jnp"))
+    assert m3["ndim"] == 3 and m3["flops_per_cell"] == 10  # 7-point
+    assert m3["cells"] == 32 ** 3
+    mb = work_model(HeatConfig(nx=64, ny=64, steps=5,
+                               dtype="bfloat16", backend="jnp"))
+    assert mb["bytes_per_cell"] == 4  # half the f32 traffic, and with
+    # it the stencil flips from bandwidth- to compute-bound on the v5e
+    # ratios (4/650e9 < 1/140e9 per cell): the roofline is the VPU peak.
+    assert mb["predicted_bound"] == "compute"
+    assert mb["roofline_mcells_steps_per_s"] == pytest.approx(
+        mb["peaks"]["vpu_cells_per_s"] / 1e6)
+
+
+def test_work_model_sharded_ici_scales_with_halo_depth():
+    d1 = work_model(HeatConfig(nx=64, ny=64, steps=10,
+                               mesh_shape=(2, 2), halo_depth=1,
+                               backend="jnp"))
+    assert d1["site"] == "halo_overlap" and d1["n_shards"] == 4
+    # Per device, per partitioned axis: 2 directions x depth rows of
+    # the 32-wide local block x 4 bytes; both axes partitioned.
+    assert d1["ici_bytes_per_exchange"] == 2 * (2 * 1 * 32 * 4)
+    assert d1["exchanges_per_step"] == 1.0
+    d2 = work_model(HeatConfig(nx=64, ny=64, steps=10,
+                               mesh_shape=(2, 2), halo_depth=2,
+                               backend="jnp"))
+    # K-deep halos: 2x the bytes per exchange, half the exchanges.
+    assert d2["ici_bytes_per_exchange"] == 2 * d1["ici_bytes_per_exchange"]
+    assert d2["exchanges_per_step"] == 0.5
+    assert d2["halo_depth"] == 2
+    assert d1["tune_key"] != d2["tune_key"]  # depth is in the geometry
+
+
+def test_valid_model_gate():
+    m = work_model(HeatConfig(steps=5, **_BASE))
+    assert valid_model(m) is m
+    assert valid_model(None) is None
+    assert valid_model("not a dict") is None
+    assert valid_model(dict(m, model_version=99)) is None
+    assert valid_model(dict(m, roofline_mcells_steps_per_s=0)) is None
+
+
+# ---------------------------------------------------------------------------
+# Attribution join
+# ---------------------------------------------------------------------------
+
+def _model(**kw):
+    base = {"model_version": 1, "tune_key": "k" * 40,
+            "site": "single_2d", "cells": 1_000_000,
+            "roofline_mcells_steps_per_s": 100.0,
+            "t_compute_s": 1e-9, "t_hbm_s": 2e-9, "t_ici_s": 0.0}
+    base.update(kw)
+    return base
+
+
+def test_attribute_chunk_lane_shares_and_bound():
+    m = _model()
+    # 1e6 cells x 10 steps / 0.5 s = 20 Mcells*steps/s -> 0.2 of roof.
+    seg = attribute_chunk({"step": 20, "steps": 10, "wall_s": 0.5,
+                           "gap_s": 0.1}, m)
+    assert seg["prof_schema"] == 1
+    assert seg["mcells_steps_per_s"] == pytest.approx(20.0)
+    assert seg["roofline_frac"] == pytest.approx(0.2)
+    assert seg["shares"]["host"] == pytest.approx(0.2)
+    assert seg["shares"]["hbm"] == pytest.approx(0.8)  # t_hbm slower
+    assert seg["shares"]["compute"] == 0.0
+    assert seg["bound"] == "hbm" and seg["bound"] in BOUNDS
+    # A producer-measured exchange_s wins the ici lane.
+    seg = attribute_chunk({"steps": 10, "wall_s": 0.5, "gap_s": 0.05,
+                           "exchange_s": 0.3}, m)
+    assert seg["shares"]["ici"] == pytest.approx(0.6)
+    assert seg["bound"] == "ici"
+    # Sync-loop gap_s measures BETWEEN-chunk host time and may exceed
+    # this chunk's wall: the host lane clamps at 100%.
+    seg = attribute_chunk({"steps": 10, "wall_s": 0.5, "gap_s": 2.0}, m)
+    assert seg["shares"]["host"] == 1.0 and seg["bound"] == "host"
+    # A compute-heavier model routes the device lane to compute.
+    seg = attribute_chunk({"steps": 10, "wall_s": 0.5},
+                          _model(t_compute_s=3e-9))
+    assert seg["bound"] == "compute"
+    # Null convention: a sub-resolution chunk is unmeasured, not wrong.
+    seg = attribute_chunk({"steps": 0, "wall_s": 0.0}, m)
+    assert seg["mcells_steps_per_s"] is None
+    assert seg["roofline_frac"] is None and seg["bound"] is None
+
+
+def test_attribute_stream_degradation_ladder():
+    cfg = HeatConfig(steps=20, **_BASE)
+    m = work_model(cfg)
+    chunk = {"event": "chunk", "step": 10, "steps": 10, "wall_s": 0.2}
+    # Rung 1: the header's embedded model is authoritative.
+    doc = attribute_stream([
+        {"event": "run_header", "explain": {"work_model": m}},
+        chunk, dict(chunk, step=20)])
+    assert doc["degraded"] is None and not doc["live_profile"]
+    assert len(doc["segments"]) == 2
+    assert doc["segments"][0]["tune_key"] == m["tune_key"]
+    assert doc["roofline_frac"]["n"] == 2
+    assert doc["model_vs_measured"]["achieved_fraction"] > 0
+    # Rung 2: no embedded model -> rebuilt from the header config,
+    # and the report says so.
+    doc = attribute_stream([
+        {"event": "run_header", "config": json.loads(cfg.to_json())},
+        chunk])
+    assert "rebuilt" in doc["degraded"]
+    assert doc["segments"][0]["tune_key"] == m["tune_key"]
+    # Rung 3: nothing to rebuild from -> named reason, empty join.
+    doc = attribute_stream([{"event": "run_header"}, chunk])
+    assert "no work model" in doc["degraded"]
+    assert doc["segments"] == [] and doc["roofline_frac"] is None
+    # No header at all; foreign lines never throw.
+    doc = attribute_stream([chunk, "garbage", 17, {"event": "huh"}])
+    assert doc["degraded"] == "no run_header in stream"
+    # Live profile events are the producer's own join: used verbatim,
+    # chunks are NOT re-attributed on top.
+    prof = {"event": "profile", "prof_schema": 1, "step": 10,
+            "steps": 10, "wall_s": 0.2, "roofline_frac": 0.4,
+            "bound": "hbm", "mcells_steps_per_s": 40.0}
+    doc = attribute_stream([
+        {"event": "run_header", "explain": {"work_model": m}},
+        chunk, prof])
+    assert doc["live_profile"] and len(doc["segments"]) == 1
+    assert doc["bound_histogram"] == {"hbm": 1}
+    assert doc["worst"]["roofline_frac"] == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# Emission: profile events ride the stream, observation-only
+# ---------------------------------------------------------------------------
+
+def test_profile_emission_is_observation_only(tmp_path):
+    from parallel_heat_tpu import solver
+    from parallel_heat_tpu.solver import solve_stream
+    from parallel_heat_tpu.utils.telemetry import Telemetry
+
+    cfg = HeatConfig(steps=30, **_BASE)
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=10)]
+    misses_before = solver._build_runner.cache_info().misses
+    with Telemetry(tmp_path / "t.jsonl") as tel:
+        instr = [r.to_numpy()
+                 for r in solve_stream(cfg, chunk_steps=10,
+                                       telemetry=tel)]
+    assert solver._build_runner.cache_info().misses == misses_before
+    for a, b in zip(plain, instr):
+        np.testing.assert_array_equal(a, b)
+    ev = _events(tmp_path / "t.jsonl")
+    profs = [e for e in ev if e["event"] == "profile"]
+    assert [p["step"] for p in profs] == [10, 20, 30]
+    for p in profs:
+        assert p["prof_schema"] == 1
+        assert p["steps"] == 10 and p["wall_s"] > 0
+        assert p["bound"] in BOUNDS
+        assert 0 < p["roofline_frac"] < 1
+        assert p["shares"][p["bound"]] == max(p["shares"].values())
+    # One identity across the stream: the header's embedded model is
+    # the model the live segments were priced against.
+    header = next(e for e in ev if e["event"] == "run_header")
+    wm = header["explain"]["work_model"]
+    assert wm["tune_key"] == profs[0]["tune_key"]
+    assert valid_model(wm) is not None
+
+
+# ---------------------------------------------------------------------------
+# Fleet plane: series harvest + efficiency_regression
+# ---------------------------------------------------------------------------
+
+def _prof_line(t, frac, bound="hbm"):
+    return {"schema": 2, "event": "profile", "t_wall": t,
+            "prof_schema": 1, "roofline_frac": frac, "bound": bound}
+
+
+def test_harvest_folds_profile_events(tmp_path):
+    from parallel_heat_tpu.obs.series import harvest
+    from parallel_heat_tpu.service.store import JobStore
+
+    root = str(tmp_path / "q")
+    JobStore(root, create=True)
+    tdir = os.path.join(root, "telemetry")
+    os.makedirs(tdir, exist_ok=True)
+    with open(os.path.join(tdir, "j1.jsonl"), "w") as f:
+        for i, frac in enumerate([0.5, 0.6, float("nan")]):
+            f.write(json.dumps(_prof_line(_T0 + i, frac)) + "\n")
+        f.write(json.dumps(_prof_line(_T0 + 3, 0.7, bound="ici"))
+                + "\n")
+        f.write(json.dumps(_prof_line(_T0 + 4, 0.7, bound="weird"))
+                + "\n")
+    samples, _cur = harvest(root, {}, now=_T0 + 10)
+    fracs = [s for s in samples if s["counter"] == "roofline_frac"]
+    # NaN dropped; the foreign-bound line still carries a valid gauge.
+    assert [s["value"] for s in fracs] == [0.5, 0.6, 0.7, 0.7]
+    assert all(s["kind"] == "gauge" for s in fracs)
+    bounds = sorted(s["counter"] for s in samples
+                    if s["counter"].startswith("bound_"))
+    # The NaN-frac line still counts its (valid) bound; the foreign
+    # bound name is dropped.
+    assert bounds == ["bound_hbm"] * 3 + ["bound_ici"]
+
+
+def _s(t, counter, value, kind="gauge"):
+    return {"t": t, "host": "", "part": "", "counter": counter,
+            "kind": kind, "value": value}
+
+
+def _h(t, samples):
+    return {"schema": 1, "event": "harvest", "t": t,
+            "samples": samples, "cursors": {"parts": {}}}
+
+
+def _job_with_fracs(root, jid, t0, before, during):
+    """One dispatched+completed job on a root whose roofline_frac
+    series reads ``before`` ahead of the dispatch and ``during``
+    inside the job's window."""
+    from parallel_heat_tpu.service.store import JobStore
+
+    store = JobStore(root, create=not os.path.isdir(root))
+    j = store.journal
+    j.append("accepted", job_id=jid, t_wall=t0, hbm_bytes=1)
+    j.append("dispatched", job_id=jid, t_wall=t0 + 1,
+             worker=f"w-{jid}", attempt=1)
+    j.append("completed", job_id=jid, t_wall=t0 + 50)
+    j.close()
+    samples = [_s(t0 - 20 + i, "roofline_frac", v)
+               for i, v in enumerate(before)]
+    samples += [_s(t0 + 2 + i * 4, "roofline_frac", v)
+                for i, v in enumerate(during)]
+    return _h(t0 + 60, samples)
+
+
+def test_efficiency_regression_tp_tn_and_latch(tmp_path):
+    from parallel_heat_tpu.obs.alerts import AlertEngine
+    from parallel_heat_tpu.obs.series import obs_dir_for, reduce_obs
+    from parallel_heat_tpu.service.store import read_journal_file
+
+    # TP: window mean 0.001 vs own baseline 0.005 -> collapse. The
+    # absolute values are CPU-scale tiny ON PURPOSE: the alert is
+    # relative to the partition's own history (the v5e-priced roofline
+    # makes every CPU fraction ~1e-3), so no TuneDB and no floor.
+    root = str(tmp_path / "q")
+    ev = _job_with_fracs(root, "slow", _T0,
+                         before=[0.005, 0.005, 0.005],
+                         during=[0.001, 0.001, 0.001])
+    state = reduce_obs([ev])
+    with AlertEngine(obs_dir_for(root)) as eng:
+        tripped = eng.evaluate(state, root=root, now=_T0 + 100)
+        assert [a["key"] for a in tripped] == \
+            ["efficiency_regression||slow"]
+        d = tripped[0]["detail"]
+        assert d["observed_roofline_frac"] == pytest.approx(0.001)
+        assert d["baseline_roofline_frac"] == pytest.approx(0.005)
+        # The latch: exactly one journaled trip, ever (re-evaluating
+        # the same still-true condition is not news).
+        for _ in range(3):
+            assert eng.evaluate(state, root=root,
+                                now=_T0 + 200) == []
+        assert set(eng.active()) == {"efficiency_regression||slow"}
+    events, _bad, _torn = read_journal_file(
+        os.path.join(obs_dir_for(root), "alerts.jsonl"))
+    assert sum(1 for e in events
+               if e.get("event") == "alert_tripped") == 1
+
+    # TN: a clean stream (window at ~baseline) stays silent.
+    root2 = str(tmp_path / "q2")
+    ev2 = _job_with_fracs(root2, "fine", _T0,
+                          before=[0.005, 0.005, 0.005],
+                          during=[0.0045, 0.005, 0.0055])
+    with AlertEngine(obs_dir_for(root2)) as eng:
+        assert eng.evaluate(reduce_obs([ev2]), root=root2,
+                            now=_T0 + 100) == []
+
+    # No baseline (first-ever job on the partition): no verdict.
+    root3 = str(tmp_path / "q3")
+    ev3 = _job_with_fracs(root3, "first", _T0, before=[],
+                          during=[0.001, 0.001, 0.001])
+    with AlertEngine(obs_dir_for(root3)) as eng:
+        assert eng.evaluate(reduce_obs([ev3]), root=root3,
+                            now=_T0 + 100) == []
+
+
+# ---------------------------------------------------------------------------
+# heatprof CLI on the committed artifact
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_heatprof_cli_on_committed_artifact():
+    art = os.path.join(_ROOT, "runs", "prof_r19_cpu.jsonl")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "heatprof.py"),
+         art, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)["runs"][0]
+    assert doc["model"]["site"] == "single_2d"
+    assert doc["segments"] and doc["bound_histogram"]
+    for seg in doc["segments"]:
+        assert seg["bound"] in BOUNDS
+    assert 0 < doc["roofline_frac"]["mean"] < 1
+    # The shared --fail-on grammar gates the same report: a roofline
+    # floor a CPU run cannot meet exits 2 (the doctored-gate smoke).
+    gated = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "heatprof.py"),
+         art, "--fail-on", "roofline_frac<0.5"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert gated.returncode == 2, gated.stderr[-2000:]
+    assert "roofline_frac" in gated.stderr
+
+
+# ---------------------------------------------------------------------------
+# Hygiene scan scope
+# ---------------------------------------------------------------------------
+
+def test_hl2xx_scan_scope_covers_prof_package():
+    # Same pin as the obs package: the AST hygiene rules must audit
+    # the prof plane like everything else (its emission path runs
+    # inside solve_stream's loop — a stray blocking call there would
+    # tax every instrumented run).
+    from parallel_heat_tpu.analysis.astlint import (
+        _iter_py_files, default_scan_paths)
+
+    files = {os.path.relpath(p).replace(os.sep, "/") for p in
+             _iter_py_files(default_scan_paths())}
+    assert {"parallel_heat_tpu/prof/__init__.py",
+            "parallel_heat_tpu/prof/model.py",
+            "parallel_heat_tpu/prof/attrib.py"} <= files

@@ -36,7 +36,11 @@ def test_stream_emits_header_and_chunk_events(tmp_path):
                               chunk_steps=10, telemetry=tel):
             pass
     ev = _events(p)
-    assert [e["event"] for e in ev] == ["run_header"] + ["chunk"] * 3
+    # Every chunk is followed by its prof-plane attribution segment
+    # (tests/test_prof.py pins the profile payload itself).
+    assert [e["event"] for e in ev] == \
+        ["run_header"] + ["chunk", "profile"] * 3
+    ev = [e for e in ev if e["event"] != "profile"]
     # envelope on every record
     for e in ev:
         assert e["schema"] == SCHEMA_VERSION
@@ -279,9 +283,9 @@ def test_cli_metrics_and_heartbeat_unsupervised(tmp_path):
                  "--out", str(out), "--quiet"]) == 0
     ev = _events(m)
     assert [e["event"] for e in ev] == ["run_header", "chunk",
-                                        "run_end"]
+                                        "profile", "run_end"]
     assert ev[1]["step"] == 20
-    assert ev[2]["outcome"] == "complete"
+    assert ev[3]["outcome"] == "complete"
     assert (tmp_path / "hb.json").exists()
     # the metrics path is bitwise the plain path (one-chunk stream runs
     # the same compiled program)

@@ -277,10 +277,10 @@ def _validate_chrome(doc):
     """The Chrome trace-event contract the export must satisfy."""
     assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
     for e in doc["traceEvents"]:
-        assert e["ph"] in ("X", "i", "M")
+        assert e["ph"] in ("X", "i", "M", "C")
         assert isinstance(e["name"], str)
         assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
-        if e["ph"] in ("X", "i"):
+        if e["ph"] in ("X", "i", "C"):
             assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
         if e["ph"] == "X":
             assert e["dur"] >= 0

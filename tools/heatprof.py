@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""heatprof: roofline-attributed performance reports.
+
+The read side of the ``prof`` plane: join telemetry streams against
+their static work models (``prof/attrib.py``) and name, per segment
+and per run, WHERE the time went — the ``compute / hbm / ici / host``
+bound taxonomy — and how far from the hardware roofline the run
+actually sat. The modern answer to "the run is slow" after
+``perf_regression`` said so.
+
+Modes (combine with ``--json`` for the machine form):
+
+- per-run: positional telemetry JSONL paths/globs — each stream is
+  attributed (live ``profile`` events when the producer emitted them,
+  else re-joined here from its chunks + the header's embedded work
+  model) and rendered as a per-segment report with the bound
+  histogram, worst chunk, and model-vs-measured delta;
+- fleet: ``--fleet ROOT`` — a heatd root with a flight-recorder state
+  (``obs/``): renders the per-(host, partition) roofline-fraction
+  series and attribution mix the obs harvester collected.
+
+``--fail-on`` speaks the shared threshold grammar of
+``tools/metrics_report.py`` (one resolution site: its aliases apply,
+so the bare ``roofline_frac`` token floors the windowed mean —
+``--fail-on 'roofline_frac<0.5'``); ``--bound`` filters the rendered
+segments to one bound (``--bound ici`` shows only exchange-bound
+chunks). Torn/foreign lines degrade per the metrics_report contract.
+
+Exit codes: 0 clean; 1 unusable input (no events, no attribution
+derivable anywhere); 2 a ``--fail-on`` threshold was violated.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import metrics_report as mr  # noqa: E402 — shared grammar + loaders
+
+BOUNDS = ("compute", "hbm", "ici", "host")
+
+
+def expand(patterns):
+    paths = []
+    for pat in patterns:
+        paths.extend(sorted(glob.glob(pat)) or [pat])
+    seen, out = set(), []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def gate(doc, fail_on):
+    """Apply a --fail-on spec to a summary document via the ONE shared
+    resolution site (metrics_report.resolve_metric — aliases and the
+    absent-vs-unmeasured distinction included). Returns
+    ``(violations, error)``."""
+    try:
+        events, ceilings, floors = mr.parse_fail_on(fail_on)
+    except ValueError as e:
+        return None, str(e)
+    violations = []
+    counts = doc.get("events_by_type") or {}
+    for name in sorted(events):
+        if counts.get(name):
+            violations.append(f"event {name} occurred "
+                              f"x{counts[name]}")
+    for name, thr in ceilings:
+        exists, val = mr.resolve_metric(doc, name)
+        if not exists:
+            return None, (f"--fail-on counter {name!r} is not a "
+                          f"metric of this report")
+        if val is not None and val > thr:
+            violations.append(f"{name} = {val:g} > {thr:g}")
+    for name, thr in floors:
+        exists, val = mr.resolve_metric(doc, name)
+        if not exists:
+            return None, (f"--fail-on counter {name!r} is not a "
+                          f"metric of this report")
+        if val is not None and val < thr:
+            violations.append(f"{name} = {val:g} < {thr:g}")
+    return violations, None
+
+
+def run_report(path, bound_filter=None):
+    """Attribute one stream -> ``(doc, mr_doc)`` where ``doc`` is the
+    heatprof document (attribution + provenance) and ``mr_doc`` the
+    full metrics summary the --fail-on grammar gates against."""
+    from parallel_heat_tpu.prof import attrib
+
+    events, bad, torn = mr.load_events(path)
+    mr_doc = mr.summarize(events)
+    doc = attrib.attribute_stream(events)
+    doc["path"] = path
+    doc["bad_lines"] = bad
+    doc["torn_tail"] = torn
+    # Streams without live profile events (older producers) get their
+    # attribution re-joined here; mirror it into the metrics doc so
+    # the shared alias (attribution.roofline_frac.mean) gates either
+    # way.
+    if "attribution" not in mr_doc and doc.get("roofline_frac"):
+        mr_doc["attribution"] = {"roofline_frac": doc["roofline_frac"]}
+    if bound_filter:
+        doc["segments"] = [s for s in doc["segments"]
+                           if s.get("bound") == bound_filter]
+        doc["bound_filter"] = bound_filter
+    return doc, mr_doc
+
+
+def render_run(doc, max_segments=8):
+    out = [f"heatprof {doc['path']}"
+           + ("  TORN" if doc.get("torn_tail") else "")]
+    model = doc.get("model")
+    if model:
+        out.append(
+            f"model: {model['site']} key={model['tune_key'][:12]} "
+            f"{model['device_kind']} x{model['n_shards']} "
+            f"predicted bound {model['predicted_bound']} "
+            f"(roofline "
+            f"{model['roofline_mcells_steps_per_s']:,.0f} "
+            f"Mcells*steps/s)")
+    if doc.get("degraded"):
+        out.append(f"degraded: {doc['degraded']}")
+    hist = doc.get("bound_histogram") or {}
+    if hist:
+        dom = max(hist, key=lambda k: hist[k])
+        out.append(f"bounds: dominant {dom} (" + " ".join(
+            f"{k}={v}" for k, v in sorted(hist.items())) + ")")
+    rf = doc.get("roofline_frac")
+    if rf:
+        out.append(f"roofline fraction mean={rf['mean']:.4f} "
+                   f"p50={rf['p50']:.4f} min={rf['min']:.4f} "
+                   f"max={rf['max']:.4f} (n={rf['n']})")
+    w = doc.get("worst")
+    if w:
+        out.append(f"worst chunk: step {w.get('step')} at "
+                   f"{w['roofline_frac']:.4f} of roofline "
+                   f"({w.get('bound')}-bound)")
+    mv = doc.get("model_vs_measured")
+    if mv:
+        out.append(f"model vs measured: predicted "
+                   f"{mv['predicted_mcells_steps_per_s']:,.0f} "
+                   f"Mcells*steps/s, measured mean "
+                   f"{mv['measured_mean_mcells_steps_per_s']:,.0f} "
+                   f"({mv['achieved_fraction']:.2%} achieved)")
+    segs = doc.get("segments") or []
+    label = (f" ({doc['bound_filter']}-bound only)"
+             if doc.get("bound_filter") else "")
+    out.append(f"segments: {len(segs)}{label}")
+    shown = segs if len(segs) <= max_segments else \
+        segs[:max_segments // 2] + segs[-max_segments // 2:]
+    for s in shown:
+        f = s.get("roofline_frac")
+        out.append(
+            f"  step {s.get('step')}: {s.get('steps')} steps in "
+            f"{(s.get('wall_s') or 0.0):.4f}s"
+            + (f", {f:.4f} of roofline ({s.get('bound')})"
+               if isinstance(f, (int, float)) else " (unmeasured)"))
+    if len(segs) > len(shown):
+        out.insert(len(out) - max_segments // 2,
+                   f"  ... {len(segs) - len(shown)} more")
+    return "\n".join(out)
+
+
+def fleet_report(root):
+    """Fold the flight recorder's state into the fleet attribution
+    document: per (host, part), the roofline_frac gauge series and the
+    cumulative per-bound counters."""
+    from parallel_heat_tpu.obs.series import load_state, obs_dir_for
+
+    obs_dir = obs_dir_for(root)
+    if not os.path.isdir(obs_dir):
+        return None, (f"{root}: no recorder state under {obs_dir} — "
+                      f"run `heatd metrics-serve --root {root}` first")
+    state, _gen = load_state(obs_dir)
+    series = state.get("series") or {}
+    rows = {}
+    fracs = []
+    for ser in series.values():
+        host, part, counter = ser["host"], ser["part"], ser["counter"]
+        if counter != "roofline_frac" \
+                and not counter.startswith("bound_"):
+            continue
+        row = rows.setdefault((host, part),
+                              {"host": host, "part": part,
+                               "bounds": {}})
+        if counter == "roofline_frac":
+            vals = [v for _t, v in ser["raw"]]
+            if vals:
+                row["roofline_frac"] = {
+                    "last": vals[-1],
+                    "mean": sum(vals) / len(vals),
+                    "min": min(vals), "n": len(vals)}
+                fracs.extend(vals)
+        else:
+            if ser["raw"]:
+                row["bounds"][counter[len("bound_"):]] = \
+                    int(ser["raw"][-1][1])
+    doc = {"root": root, "hosts": sorted(rows.values(),
+                                         key=lambda r: (r["host"],
+                                                        r["part"]))}
+    # The shared alias path (attribution.roofline_frac.mean) resolves
+    # against this doc too, so one --fail-on spelling gates both modes.
+    if fracs:
+        doc["attribution"] = {"roofline_frac": {
+            "mean": sum(fracs) / len(fracs), "min": min(fracs),
+            "n": len(fracs)}}
+    return doc, None
+
+
+def render_fleet(doc):
+    out = [f"heatprof --fleet {doc['root']}"]
+    att = doc.get("attribution")
+    if att:
+        rf = att["roofline_frac"]
+        out.append(f"fleet roofline fraction mean={rf['mean']:.4f} "
+                   f"min={rf['min']:.4f} over {rf['n']} sample(s)")
+    if not doc["hosts"]:
+        out.append("no roofline series harvested yet (runs must emit "
+                   "profile events; heatd metrics-serve folds them)")
+    for r in doc["hosts"]:
+        rf = r.get("roofline_frac")
+        line = (f"  host {r['host'] or '?'}"
+                + (f" part {r['part']}" if r["part"] else "") + ": ")
+        line += (f"roofline mean={rf['mean']:.4f} last={rf['last']:.4f} "
+                 f"(n={rf['n']})" if rf else "no gauge")
+        if r["bounds"]:
+            line += " bounds " + " ".join(
+                f"{k}={v}" for k, v in sorted(r["bounds"].items()))
+        out.append(line)
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="roofline-attributed performance reports from "
+                    "telemetry streams (prof plane)")
+    ap.add_argument("streams", nargs="*", metavar="JSONL_OR_GLOB",
+                    help="telemetry streams to attribute")
+    ap.add_argument("--fleet", default=None, metavar="ROOT",
+                    help="heatd root with a flight-recorder state: "
+                         "render the fleet-wide efficiency plane")
+    ap.add_argument("--bound", default=None, choices=BOUNDS,
+                    help="show only segments with this dominant bound")
+    ap.add_argument("--fail-on", default="none", metavar="SPEC",
+                    help="shared threshold grammar (metrics_report): "
+                         "'roofline_frac<0.5' floors the mean "
+                         "roofline fraction; tokens compose with "
+                         "commas; 'none' disables")
+    ap.add_argument("--json", action="store_true",
+                    help="print the document(s) as JSON")
+    args = ap.parse_args(argv)
+    if not args.streams and args.fleet is None:
+        ap.error("give telemetry streams and/or --fleet ROOT")
+
+    docs = []
+    violations = []
+    usable = False
+    for p in expand(args.streams):
+        try:
+            doc, mr_doc = run_report(p, args.bound)
+        except OSError as e:
+            print(f"warning: {p}: {e}", file=sys.stderr)
+            continue
+        docs.append(doc)
+        if doc.get("segments") or doc.get("model"):
+            usable = True
+        v, err = gate(mr_doc, args.fail_on)
+        if err:
+            print(f"error: {p}: {err}", file=sys.stderr)
+            return 1
+        violations.extend(f"{p}: {x}" for x in v)
+
+    fleet_doc = None
+    if args.fleet is not None:
+        fleet_doc, err = fleet_report(args.fleet)
+        if err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+        if fleet_doc.get("hosts"):
+            usable = True
+        v, err = gate(fleet_doc, args.fail_on)
+        if err:
+            print(f"error: --fleet: {err}", file=sys.stderr)
+            return 1
+        violations.extend(f"fleet: {x}" for x in v)
+
+    if args.json:
+        out = {"runs": docs, "violations": violations}
+        if fleet_doc is not None:
+            out["fleet"] = fleet_doc
+        json.dump(out, sys.stdout, indent=1)
+        print()
+    else:
+        for doc in docs:
+            print(render_run(doc))
+        if fleet_doc is not None:
+            print(render_fleet(fleet_doc))
+        for v in violations:
+            print(f"FAIL: {v}", file=sys.stderr)
+    if not usable:
+        print("error: no attribution derivable from the given inputs",
+              file=sys.stderr)
+        return 1
+    return 2 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

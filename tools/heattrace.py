@@ -89,6 +89,7 @@ def build_trace(stream_paths, queue_root=None):
                               "jobs": sum(1 for s in js
                                           if s["cat"] == "job")}
     stream_spans = []
+    counters = []
     for p in stream_paths:
         try:
             events, bad, torn = load_events(p)
@@ -99,14 +100,19 @@ def build_trace(stream_paths, queue_root=None):
         # collide across files — their synthetic span ids seed off the
         # path, so merge_spans can never fuse two unrelated runs.
         ss, si = tracing.spans_from_stream(events, stream_key=p)
+        # Roofline counter tracks (prof): profile events become
+        # per-lane "C"-phase series under the same pid as the spans.
+        cs = tracing.counters_from_stream(events)
         stream_spans.extend(ss)
         instants.extend(si)
+        counters.extend(cs)
         ranks = sorted({e.get("process_index") for e in events
                         if isinstance(e.get("process_index"), int)})
         summary["streams"].append(
             {"path": p, "events": len(events), "bad_lines": bad,
              "torn_tail": torn, "ranks": ranks,
-             "spans": len(ss), "instants": len(si)})
+             "spans": len(ss), "instants": len(si),
+             "counters": len(cs)})
     # Shards of one run parsed as separate files re-observe the same
     # logical spans (the envelope's worker span): coalesce by id
     # before linking, so the chain has one node per span.
@@ -116,7 +122,8 @@ def build_trace(stream_paths, queue_root=None):
     spans = journal_spans + stream_spans
     if not spans and not instants:
         return None, summary
-    doc = tracing.chrome_trace(spans, instants)
+    doc = tracing.chrome_trace(spans, instants, counters)
+    summary["counter_samples"] = len(counters)
     by_cat = {}
     for s in spans:
         by_cat[s["cat"]] = by_cat.get(s["cat"], 0) + 1

@@ -170,7 +170,8 @@ def parse_fail_on(spec):
 # --fail-on grammar and slo_gate specs — resolve_metric is the one
 # resolution site both share). `busy` is NOT here: its per-rank
 # floor semantics live in the gating loops.
-_METRIC_ALIASES = {"exchange_share": "chunks.exchange_share"}
+_METRIC_ALIASES = {"exchange_share": "chunks.exchange_share",
+                   "roofline_frac": "attribution.roofline_frac.mean"}
 
 
 def resolve_metric(doc, name):
@@ -628,6 +629,61 @@ def summarize(events, outlier_mult=5.0):
         if shares:
             vdoc["level_wall_share"] = shares[-1]
         doc["vcycle"] = vdoc
+
+    # Attribution section (prof): per-segment `profile` events — the
+    # producer's own join of measured walls against the static work
+    # model (prof/attrib.py). Self-contained stdlib fold (same
+    # foreign/torn degradation as every section); the bare
+    # `roofline_frac` token gates the windowed mean through
+    # _METRIC_ALIASES in both --fail-on and slo_gate specs.
+    profiles = by.get("profile", [])
+    if profiles:
+        hist = {}
+        fracs = []
+        mcells = []
+        worst = None
+        for pe in profiles:
+            b = pe.get("bound")
+            if isinstance(b, str):
+                hist[b] = hist.get(b, 0) + 1
+            f = pe.get("roofline_frac")
+            if isinstance(f, (int, float)) and math.isfinite(f):
+                fracs.append(float(f))
+                if worst is None or f < worst["roofline_frac"]:
+                    worst = {"step": pe.get("step"),
+                             "roofline_frac": float(f),
+                             "bound": pe.get("bound")}
+            m = pe.get("mcells_steps_per_s")
+            if isinstance(m, (int, float)) and math.isfinite(m):
+                mcells.append(float(m))
+        att = {"segments": len(profiles),
+               "bound_histogram": dict(sorted(hist.items())),
+               "dominant_bound": (max(hist, key=lambda k: hist[k])
+                                  if hist else None),
+               "worst": worst}
+        if fracs:
+            sf = sorted(fracs)
+            att["roofline_frac"] = {
+                "mean": sum(sf) / len(sf),
+                "p10": _percentile(sf, 10),
+                "p50": _percentile(sf, 50),
+                "p90": _percentile(sf, 90),
+                "min": sf[0], "max": sf[-1]}
+        # Model-vs-measured delta: the header's embedded work model
+        # is the prediction; the profile segments carry the measured
+        # rate. None when either side is missing (older streams).
+        wm = ((doc.get("header") or {}).get("explain")
+              or {}).get("work_model")
+        roof = (wm or {}).get("roofline_mcells_steps_per_s")
+        if isinstance(roof, (int, float)) and roof > 0 and mcells:
+            measured = sum(mcells) / len(mcells)
+            att["model_vs_measured"] = {
+                "predicted_mcells_steps_per_s": roof,
+                "measured_mean_mcells_steps_per_s": measured,
+                "achieved_fraction": measured / roof,
+                "predicted_bound": (wm or {}).get("predicted_bound"),
+            }
+        doc["attribution"] = att
 
     timeline = [
         {"event": e["event"], "t_mono": e.get("t_mono"),
@@ -1134,6 +1190,35 @@ def render_text(doc):
         if shares:
             out.append("  level wall share: " + " ".join(
                 f"{k}={v:.0%}" for k, v in sorted(shares.items())))
+    att = doc.get("attribution")
+    if att:
+        hist = att.get("bound_histogram") or {}
+        line = f"attribution: {att['segments']} segment(s)"
+        if att.get("dominant_bound"):
+            line += f", dominant bound {att['dominant_bound']}"
+        if hist:
+            line += " (" + " ".join(f"{k}={v}" for k, v in
+                                    sorted(hist.items())) + ")"
+        out.append(line)
+        rf = att.get("roofline_frac")
+        if rf:
+            out.append(f"  roofline fraction mean={rf['mean']:.4f} "
+                       f"p50={rf['p50']:.4f} min={rf['min']:.4f} "
+                       f"max={rf['max']:.4f}")
+        w = att.get("worst")
+        if w and w.get("roofline_frac") is not None:
+            out.append(f"  worst chunk: step {w.get('step')} at "
+                       f"{w['roofline_frac']:.4f} of roofline "
+                       f"({w.get('bound')}-bound)")
+        mv = att.get("model_vs_measured")
+        if mv:
+            out.append(
+                f"  model vs measured: predicted "
+                f"{mv['predicted_mcells_steps_per_s']:,.0f} "
+                f"Mcells*steps/s ({mv.get('predicted_bound')}-bound "
+                f"roofline), measured mean "
+                f"{mv['measured_mean_mcells_steps_per_s']:,.0f} "
+                f"({mv['achieved_fraction']:.1%} achieved)")
     pl = doc.get("pipeline")
     if pl:
         busy = pl.get("device_busy_frac")
@@ -1220,7 +1305,9 @@ def _rollup_main(args, since, until):
     # must pass on a healthy root, not error), while a name outside
     # the recorder's vocabulary stays a loud error.
     known_zero = (set(JOURNAL_COUNTERS.values())
-                  | {"cache_hits", "lease_takeovers", "chunks"})
+                  | {"cache_hits", "lease_takeovers", "chunks",
+                     "bound_compute", "bound_hbm", "bound_ici",
+                     "bound_host"})
     for name, thr in ceilings:
         exists, val = resolve_metric(doc, name)
         if not exists:

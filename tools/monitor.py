@@ -94,6 +94,8 @@ class StreamState:
         self.residual = None
         self.heat = None
         self.update_linf = None
+        self.roofline_frac = None
+        self.bound = None
         self.last_event = None
         self.outcome = None
         self.trips = 0
@@ -161,6 +163,14 @@ class StreamState:
                 self.heat = rec["heat"]
             if rec.get("update_linf") is not None:
                 self.update_linf = rec["update_linf"]
+        elif ev == "profile":
+            # prof plane (roofline attribution): latest measured
+            # roofline fraction + dominant bound; absent when the run
+            # has no work model — render() just omits the column.
+            if isinstance(rec.get("roofline_frac"), (int, float)):
+                self.roofline_frac = rec["roofline_frac"]
+            if rec.get("bound") is not None:
+                self.bound = rec["bound"]
         elif ev in ("guard_trip", "progress_trip"):
             self.trips += 1
         elif ev == "run_end":
@@ -392,7 +402,7 @@ class ObsState:
             if not isinstance(s, dict):
                 continue
             c = s.get("counter")
-            if c not in ("completed", "steps_per_s"):
+            if c not in ("completed", "steps_per_s", "roofline_frac"):
                 continue
             try:
                 t, v = float(s["t"]), float(s["value"])
@@ -424,11 +434,15 @@ class ObsState:
         done = spark(self.points.get((host, "completed"), []))
         sps = spark(self.points.get((host, "steps_per_s"), []),
                     agg="mean")
+        eff = spark(self.points.get((host, "roofline_frac"), []),
+                    agg="mean")
         out = ""
         if done:
             out += f" done:{done}"
         if sps:
             out += f" sps:{sps}"
+        if eff:
+            out += f" eff:{eff}"
         return out
 
 
@@ -583,6 +597,9 @@ def render(state, hb, now=None):
             parts.append(f"step {step}")
     if state is not None and state.steps_per_s:
         parts.append(f"{state.steps_per_s:,.0f} steps/s")
+    if state is not None and state.roofline_frac is not None:
+        b = f" ({state.bound}-bound)" if state.bound else ""
+        parts.append(f"roofline {state.roofline_frac:.1%}{b}")
     if residual is not None:
         tgt = (f" (eps {state.eps:g})"
                if state is not None and state.converge and state.eps
