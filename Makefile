@@ -389,6 +389,22 @@ implicit-smoke:
 	assert d['vcycle']['samples'] >= 1, d.get('vcycle'); \
 	assert d['vcycle']['unconverged_samples'] == 0, d['vcycle']; \
 	assert d['convergence']['residual_last'] < 1e-3, d['convergence']"
+	# Partitioned V-cycle on the simulated 8-device mesh: one forced-
+	# partitioned converge-to-eps run (SEMANTICS.md "Partitioned
+	# V-cycle"), then --explain must report the per-level partition
+	# plan.
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
+	    --cx 22.5 --cy 22.5 --scheme backward_euler --backend jnp \
+	    --mesh 2,4 --mg-partition partitioned \
+	    --steps 400 --converge --eps 1e-3 --check-interval 4 --quiet
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m parallel_heat_tpu --nx 64 --ny 64 \
+	    --cx 22.5 --cy 22.5 --scheme backward_euler --backend jnp \
+	    --mesh 2,4 --mg-partition partitioned --steps 10 --explain \
+	| grep "partitioned multigrid V-cycle" > /dev/null
 	rm -rf .implicit_smoke
 
 # Measured-autotuning run-book as a gate (SEMANTICS.md "Tuning

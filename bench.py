@@ -553,6 +553,149 @@ def _bench_implicit(backend, size=512, explicit_steps=2000,
     return doc
 
 
+def _bench_implicit_sharded(backend, size=512, steps=10, mesh=(2, 4),
+                            scheme="backward_euler", metrics=None):
+    """The partitioned-V-cycle row (``--row implicit_sharded``): ONE
+    stiff sharded implicit config run under both ``mg_partition``
+    spellings —
+
+    - **replicated**: every device sweeps the full grid each V-cycle
+      (the original spelling; zero speedup from the mesh by
+      construction);
+    - **partitioned**: per-level padded ``shard_map`` blocks with a
+      1-deep exchange per smoothing sweep, coarse levels below the
+      profitability threshold agglomerated back to the replicated
+      spelling (``ops/multigrid_sharded.py``).
+
+    The figure of merit is the per-device mg wall per step (in SPMD
+    lockstep the program wall IS each device's wall; the implicit
+    step is mg-dominated — the RHS build is one stencil application).
+    The acceptance bar is the partitioned wall strictly below the
+    replicated one on the 8-device mesh.
+
+    Exchange share is model-priced (``prof/model.py`` per-level mg
+    ICI/HBM lanes): the in-program ppermutes cannot be bracketed
+    host-side, and CPU has no ICI to profile. With ``--metrics FILE``
+    the row also appends a telemetry stream (run_header + one chunk
+    per spelling) whose partitioned chunk carries ``exchange_s`` =
+    that model share of the measured wall, so ``tools/
+    metrics_report.py`` can turn it into the gateable
+    ``exchange_share``; the TPU re-run replaces it with the
+    XProf-derived number.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_heat_tpu import HeatConfig
+    from parallel_heat_tpu.prof import work_model
+    from parallel_heat_tpu.solver import (_build_runner, _observer_free,
+                                          explain, make_initial_grid)
+    from parallel_heat_tpu.utils import profiling
+    from parallel_heat_tpu.utils.compat import request_cpu_devices
+    from parallel_heat_tpu.utils.measure import min_of_n, sync
+
+    n_dev = 1
+    for d in mesh:
+        n_dev *= int(d)
+    try:
+        request_cpu_devices(n_dev)  # no-op once a backend initialized
+    except RuntimeError:
+        pass
+    if len(jax.devices()) < n_dev:
+        raise SystemExit(f"--row implicit_sharded needs {n_dev} "
+                         f"devices for mesh {mesh}; "
+                         f"{len(jax.devices())} visible")
+
+    c_stiff = 22.5  # 100x the explicit stable coefficient (0.225)
+    base = dict(nx=size, ny=size, cx=c_stiff, cy=c_stiff, steps=steps,
+                backend=backend, scheme=scheme, mesh_shape=mesh)
+
+    def timed(cfg):
+        runner, _ = _build_runner(_observer_free(cfg))
+        u0 = jax.block_until_ready(make_initial_grid(cfg))
+        sync(runner(jnp.copy(u0))[0])  # compile + warm
+        return min_of_n(lambda: runner(jnp.copy(u0))[0], rounds=3)
+
+    cfg_r = HeatConfig(mg_partition="replicated", **base)
+    cfg_p = HeatConfig(mg_partition="partitioned", **base)
+    wall_r, grid_r = timed(cfg_r)
+    wall_p, grid_p = timed(cfg_p)
+    drift = float(jnp.max(jnp.abs(grid_r.astype(jnp.float32)
+                                  - grid_p.astype(jnp.float32))))
+
+    ex = explain(cfg_p)
+    plan = ex["multigrid"]["partition_plan"]
+    model = work_model(cfg_p)
+    exch_share_model = (model["t_ici_s"] / model["step_time_s"]
+                        if model["step_time_s"] > 0 else 0.0)
+
+    platform = jax.devices()[0].platform
+    doc = {
+        "metric": (f"{size}^2 {scheme} on a "
+                   f"{'x'.join(map(str, mesh))} mesh: per-device mg "
+                   f"wall per step, partitioned vs replicated "
+                   f"V-cycle (s)"),
+        "size": size, "scheme": scheme,
+        "mesh": list(mesh), "devices": n_dev,
+        "steps": steps, "coeff": c_stiff,
+        "path_replicated": _path_label(cfg_r),
+        "path_partitioned": _path_label(cfg_p),
+        "mg_wall_per_step_replicated_s": round(wall_r / steps, 5),
+        "mg_wall_per_step_partitioned_s": round(wall_p / steps, 5),
+        "speedup": round(wall_r / wall_p, 2),
+        "partitioned_below_replicated": bool(wall_p < wall_r),
+        "final_max_abs_drift": drift,  # parity contract: tests pin it
+        "partition_plan": {
+            "partitioned_levels": plan["partitioned_levels"],
+            "n_levels": len(plan["levels"]),
+            "agglomerate_from": plan["agglomerate_from"],
+            "decided_by": ex.get("decided_by"),
+        },
+        "exchange_share_model": round(exch_share_model, 4),
+        "mg_model": {k: model["mg"][k] for k in
+                     ("partitioned_levels", "hbm_bytes_per_cycle",
+                      "ici_bytes_per_cycle", "exchanges_per_cycle")},
+        "device": str(getattr(jax.devices()[0], "device_kind",
+                              platform)),
+        "tpu_rerun_protocol": (
+            "python bench.py --row implicit_sharded --backend auto "
+            "--metrics runs/mgshard.jsonl on a pod slice (defaults: "
+            "512^2, (2,4) mesh, 10 steps at 100x the stable dt). On "
+            "hardware the replicated baseline pays the full-grid "
+            "HBM sweep on EVERY chip while partitioned divides it by "
+            "the shard count, so the gap only widens; replace "
+            "exchange_share_model with the XProf wall of the "
+            "per-level ppermute scopes, and confirm parity per the "
+            "protocol in ops/multigrid_sharded.py's docstring "
+            "(1-level prefixes bitwise; deeper chains allclose "
+            "rtol 1e-6 pending the TPU bitwise re-measurement)."),
+    }
+    if platform not in ("tpu", "axon"):
+        doc["platform_note"] = (
+            "CPU DRYRUN on a simulated mesh: every virtual device is "
+            "a host thread, so the replicated spelling really does "
+            "pay the full V-cycle 8x while partitioned splits the "
+            "partitioned levels' sweeps — the wall gap measures the "
+            "algorithmic work split, not ICI placement; "
+            "exchange_share_model prices a v5e ICI, not the host "
+            "memcpy the CPU ppermute actually is.")
+
+    if metrics:
+        from parallel_heat_tpu.utils.telemetry import Telemetry
+
+        tel = Telemetry(metrics)
+        tel.run_header(cfg_p, row="implicit_sharded")
+        cells = profiling.cell_count(cfg_p)
+        bpc = profiling.bytes_per_cell(cfg_p)
+        tel.chunk(step=steps, steps=steps, wall_s=wall_r,
+                  cells=cells, bytes_per_cell=bpc)
+        tel.chunk(step=steps, steps=steps, wall_s=wall_p,
+                  cells=cells, bytes_per_cell=bpc,
+                  exchange_s=exch_share_model * wall_p)
+        tel.close()
+    return doc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
@@ -573,7 +716,7 @@ def main(argv=None):
     ap.add_argument("--row", default="headline",
                     choices=("headline", "conv256", "stream512",
                              "ensemble512", "serve_cache",
-                             "implicit512"),
+                             "implicit512", "implicit_sharded"),
                     help="which single row the one-line stdout "
                          "contract reports: the fixed-step headline "
                          "(default), the 256^2-to-eps converge row "
@@ -608,6 +751,22 @@ def main(argv=None):
     ap.add_argument("--implicit-scheme", default="backward_euler",
                     choices=("backward_euler", "crank_nicolson"),
                     help="--row implicit512: implicit integrator")
+    ap.add_argument("--mgshard-size", type=int, default=512,
+                    help="--row implicit_sharded: grid edge "
+                         "(default 512)")
+    ap.add_argument("--mgshard-steps", type=int, default=10,
+                    help="--row implicit_sharded: implicit steps per "
+                         "timed run (default 10)")
+    ap.add_argument("--mgshard-mesh", default="2x4",
+                    help="--row implicit_sharded: mesh shape dxXdy "
+                         "(default 2x4; CPU simulates the devices)")
+    ap.add_argument("--metrics", default=None, metavar="FILE",
+                    help="--row implicit_sharded: also append a "
+                         "telemetry stream (run_header + one chunk "
+                         "per mg_partition spelling; the partitioned "
+                         "chunk carries the model-priced exchange_s) "
+                         "so tools/metrics_report.py can gate "
+                         "exchange_share on the row's output")
     ap.add_argument("--cache-size", type=int, default=64,
                     help="--row serve_cache: grid edge (default 64)")
     ap.add_argument("--cache-steps", type=int, default=1500,
@@ -616,6 +775,16 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from parallel_heat_tpu import HeatConfig
+
+    if args.row == "implicit_sharded":
+        mesh = tuple(int(p) for p in
+                     args.mgshard_mesh.replace("x", ",").split(",")
+                     if p)
+        print(json.dumps(_bench_implicit_sharded(
+            args.backend, size=args.mgshard_size,
+            steps=args.mgshard_steps, mesh=mesh,
+            scheme=args.implicit_scheme, metrics=args.metrics)))
+        return
 
     if args.row == "implicit512":
         print(json.dumps(_bench_implicit(

@@ -233,6 +233,39 @@ def default_spmd_targets():
         targets.append(_runner_target(
             HeatConfig(steps=40, converge=True, check_interval=20,
                        **basebs), "pallas-2d-perstep", "converge"))
+    # Partitioned multigrid V-cycle (ops/multigrid_sharded.py): the
+    # per-sweep halo exchanges, the restrict/prolong seam shifts (the
+    # one-sided north+west / south+east pairs — HL301's per-jaxpr
+    # direction symmetry holds because every restriction seam has its
+    # prolongation transpose in the same unrolled cycle body) and the
+    # agglomeration all_gather/dynamic_slice dataflow. fixed, converge
+    # and the Crank-Nicolson RHS must exchange IDENTICAL tables
+    # (HL302's cross-variant rule); the P() convergence scalars must
+    # prove replicated through the pmax verdicts (HL303).
+    if mesh_ok((2, 4)):
+        basem = dict(nx=16, ny=16, cx=6.5, cy=6.5, backend="jnp",
+                     mesh_shape=(2, 4), scheme="backward_euler",
+                     mg_partition="partitioned")
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **basem), "jnp-2d-mgpart", "fixed"))
+        targets.append(_runner_target(
+            HeatConfig(steps=40, converge=True, check_interval=4,
+                       **basem), "jnp-2d-mgpart", "converge"))
+        targets.append(_runner_target(
+            HeatConfig(steps=4, **dict(basem,
+                                       scheme="crank_nicolson")),
+            "jnp-2d-mgpart", "fixed-cn"))
+        # Deep partitioned chain: at 4096^2 the analytic plan keeps
+        # TWO levels partitioned, so the partitioned->partitioned
+        # restriction/prolongation tables (not just the agglomeration
+        # transition) enter the proof. Tracing only — the audit never
+        # executes, so the grid size costs nothing.
+        targets.append(_runner_target(
+            HeatConfig(nx=4096, ny=4096, cx=1400.0, cy=1400.0,
+                       steps=2, backend="jnp", mesh_shape=(2, 4),
+                       scheme="backward_euler",
+                       mg_partition="partitioned"),
+            "jnp-2d-mgpart-deep", "fixed"))
     # f32chunk variants are single-device by contract
     # (config.validate()); their collective signature must be EMPTY —
     # a collective appearing here would be an SPMD call outside any

@@ -93,6 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mg-levels", type=int, default=None,
                     help="implicit schemes: hierarchy depth cap "
                          "(default: coarsen fully)")
+    ap.add_argument("--mg-partition", default=None,
+                    choices=("auto", "replicated", "partitioned"),
+                    help="sharded implicit schemes: how the V-cycle "
+                         "executes over the mesh (SEMANTICS.md "
+                         "'Partitioned V-cycle') — per-level "
+                         "shard_map blocks with coarse-level "
+                         "agglomeration ('partitioned'), the "
+                         "full-grid-per-device spelling "
+                         "('replicated'), or the profitability "
+                         "model's pick ('auto', default)")
     ap.add_argument("--accumulate", default="storage",
                     choices=("storage", "f32chunk"),
                     help="sub-f32 accumulation semantics (SEMANTICS.md): "
@@ -353,7 +363,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         **{k: v for k, v in (("mg_tol", args.mg_tol),
                              ("mg_cycles", args.mg_cycles),
                              ("mg_smooth", args.mg_smooth),
-                             ("mg_levels", args.mg_levels))
+                             ("mg_levels", args.mg_levels),
+                             ("mg_partition", args.mg_partition))
            if v is not None},
     )
     try:

@@ -49,6 +49,7 @@ SEMANTIC_FIELDS = (
     "dtype", "backend", "mesh_shape", "overlap", "halo_depth",
     "halo_overlap", "accumulate",
     "scheme", "mg_tol", "mg_cycles", "mg_smooth", "mg_levels",
+    "mg_partition",
 )
 OBSERVATION_ONLY_FIELDS = ("guard_interval", "diag_interval",
                            "pipeline_depth")
@@ -334,6 +335,26 @@ class HeatConfig:
     # level shapes are config.multigrid_level_shapes — one source of
     # truth shared with heatd's HBM admission pricing.
     mg_levels: Optional[int] = None
+    # mg_partition: how the V-cycle executes on a SHARDED mesh
+    # (SEMANTICS.md "Partitioned V-cycle").
+    # - "replicated":  every device runs the full-grid cycle (the
+    #   original spelling; bitwise the single-device run by
+    #   construction).
+    # - "partitioned": per-level padded shard_map blocks with a 1-deep
+    #   halo exchange per smoothing sweep and per transfer seam
+    #   (ops/multigrid_sharded.py); coarse levels below the
+    #   profitability threshold agglomerate back to the replicated
+    #   spelling.
+    # - "auto" (default): partitioned where the prof/model ICI-vs-
+    #   compute lanes say it wins (consultable at the "mg_partition"
+    #   TuneDB site), replicated otherwise. Resolved once in
+    #   solver._resolved, like halo_depth.
+    # SEMANTIC: the flag selects the compiled step program, so it keys
+    # the runner/executable caches. Inert — and required to stay
+    # "auto" — for scheme="explicit" and for unsharded implicit runs
+    # (a non-default value there would fork cache keys while changing
+    # nothing the program computes).
+    mg_partition: str = "auto"
 
     # Runtime blow-up guard (SEMANTICS.md "Runtime guard"): steps between
     # on-device isfinite-all checks of the evolving grid. None (default)
@@ -600,6 +621,11 @@ class HeatConfig:
             raise ValueError(
                 f"mg_levels must be >= 1 (or None for full "
                 f"coarsening), got {self.mg_levels}")
+        if self.mg_partition not in ("auto", "replicated",
+                                     "partitioned"):
+            raise ValueError(
+                f"mg_partition must be one of 'auto', 'replicated', "
+                f"'partitioned', got {self.mg_partition!r}")
         if self.scheme == "explicit":
             # Inert knobs must stay at their defaults (loud declines
             # over silent no-ops): a non-default mg_* on an explicit
@@ -607,7 +633,7 @@ class HeatConfig:
             # changing nothing the program computes.
             defaults = HeatConfig()
             off = [n for n in ("mg_tol", "mg_cycles", "mg_smooth",
-                               "mg_levels")
+                               "mg_levels", "mg_partition")
                    if getattr(self, n) != getattr(defaults, n)]
             if off:
                 raise ValueError(
@@ -651,6 +677,15 @@ class HeatConfig:
                     "overlap=False schedules the explicit per-step "
                     "interior/edge split; it does not apply to "
                     f"scheme={self.scheme!r} — drop the flag")
+            if (self.mg_partition != "auto"
+                    and not any(d > 1 for d in mesh)):
+                # Same inert-knob rule: partition modes only select a
+                # program on a sharded mesh — a single-device config
+                # has exactly one V-cycle spelling.
+                raise ValueError(
+                    f"mg_partition={self.mg_partition!r} selects the "
+                    f"sharded V-cycle spelling; it does not apply "
+                    f"without a device mesh — drop the flag (auto)")
             if len(multigrid_level_shapes(self.shape,
                                           self.mg_levels)) < 1:
                 raise ValueError(  # unreachable (level 0 always exists)

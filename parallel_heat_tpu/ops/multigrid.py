@@ -46,16 +46,19 @@ float32 and rounded to the storage dtype ONCE per step, the explicit
 path's "storage" accumulation semantics; interior writes use the same
 ``u.at[1:-1, 1:-1].set`` spelling heatlint HL103 proves boundary-free.
 
-Sharding: the implicit step is a full-grid program. Sharded configs
-execute it REPLICATED — the grid is gathered once per dispatch and
-every device runs the identical full-shape step loop
-(``solver._build_runner``'s implicit branch) — which is what makes
-the pinned contract, BITWISE equality with the single-device run
-(tests/test_implicit.py), hold by construction: a GSPMD-partitioned
-V-cycle is measurably not bitwise-stable on XLA:CPU (per-fusion FMA
-contraction reshuffles under partition layouts). Partitioning the
-levels with padded ``shard_map`` blocks is the roadmap follow-on;
-the hand-scheduled halo spellings stay on the explicit path.
+Sharding: this module is the full-grid (single-device / replicated)
+spelling. Sharded configs pick between two spellings via
+``HeatConfig.mg_partition`` (resolved in ``solver._resolved``):
+``"replicated"`` runs this module's full-shape step loop identically
+on every device — bitwise the single-device run BY CONSTRUCTION —
+while ``"partitioned"`` (the default where the work model says it
+wins) runs per-level padded ``shard_map`` blocks with a halo exchange
+per smoothing sweep and coarse-level agglomeration
+(``ops/multigrid_sharded.py``, which reuses this module's level-op
+spellings cell-for-cell). A GSPMD-partitioned V-cycle is measurably
+not bitwise-stable on XLA:CPU (per-fusion FMA contraction reshuffles
+under partition layouts), which is why the partitioned spelling is
+hand-scheduled manual blocks, never a GSPMD constraint.
 
 Pallas: restriction and prolongation also exist as whole-array VMEM
 kernels (``heat_mg_restrict`` / ``heat_mg_prolong``) selected on the
@@ -280,16 +283,24 @@ def _build_prolong_kernel(coarse_shape: Tuple[int, int],
     )
 
 
-def transfer_ops(config: HeatConfig, backend: str):
+def transfer_ops(config: HeatConfig, backend: str, *,
+                 agglomerated: bool = False):
     """``(restrict(r, coarse_shape), prolong(c, fine_shape))`` — the
     ONE decision site for the transfer spelling. The Pallas kernels
-    serve the single-device pallas backend; everything else (jnp
-    backend, any sharded mesh — GSPMD cannot partition a
-    ``pallas_call``) takes the jnp spelling. Both evaluate the same
-    expression tree; off-TPU the kernels run interpreted and are
-    bitwise the jnp path (pinned by tests/test_implicit.py)."""
+    serve the pallas backend wherever the transfer actually runs as a
+    single whole-array instance: the single-device path, and (with
+    ``agglomerated=True``) the agglomerated coarse levels of the
+    partitioned V-cycle — those run per-device inside ``shard_map``,
+    where a ``pallas_call`` is a plain manual call, not something
+    GSPMD must partition. The REPLICATED sharded path still declines
+    (GSPMD cannot partition a ``pallas_call`` over a full-grid
+    program); before the agglomerated route existed this decline
+    silently covered every sharded mesh — the bug the partitioned
+    path fixes. Both spellings evaluate the same expression tree;
+    off-TPU the kernels run interpreted and are bitwise the jnp path
+    (pinned by tests/test_implicit.py)."""
     sharded = any(d > 1 for d in config.mesh_or_unit())
-    if backend == "pallas" and not sharded:
+    if backend == "pallas" and (agglomerated or not sharded):
         def restrict(r, coarse_shape):
             return _build_restrict_kernel(tuple(r.shape),
                                           tuple(coarse_shape))(r)
@@ -309,12 +320,12 @@ def transfer_ops(config: HeatConfig, backend: str):
 # The V-cycle and the implicit step
 # --------------------------------------------------------------------------
 
-def _vcycle_fn(config: HeatConfig, backend: str):
-    """``vcycle(u, b) -> u`` for the finest level, the recursion
-    unrolled over the static hierarchy at trace time."""
-    levels = level_coefficients(config)
-    nu = config.mg_smooth
-    restrict, prolong = transfer_ops(config, backend)
+def _cycle_from_levels(levels, nu: int, restrict, prolong):
+    """``vcycle(u, b) -> u`` over an explicit ``[(shape, ax, ay), ...]``
+    hierarchy (finest first), the recursion unrolled at trace time.
+    Shared by the full replicated cycle and the partitioned path's
+    agglomerated coarse subtree (``ops/multigrid_sharded.py``), so
+    the two can never desynchronize."""
 
     def cycle(l, u, b):
         shape, ax, ay = levels[l]
@@ -336,6 +347,13 @@ def _vcycle_fn(config: HeatConfig, backend: str):
         return u
 
     return lambda u, b: cycle(0, u, b)
+
+
+def _vcycle_fn(config: HeatConfig, backend: str):
+    """``vcycle(u, b) -> u`` for the finest level."""
+    restrict, prolong = transfer_ops(config, backend)
+    return _cycle_from_levels(level_coefficients(config),
+                              config.mg_smooth, restrict, prolong)
 
 
 def _rhs_fn(config: HeatConfig):
@@ -531,10 +549,31 @@ def explain_hierarchy(config: HeatConfig, backend: str) -> dict:
     mirroring)."""
     levels = level_coefficients(config)
     sharded = any(d > 1 for d in config.mesh_or_unit())
-    transfers = ("pallas heat_mg_restrict/heat_mg_prolong "
-                 "(whole-array VMEM)"
-                 if backend == "pallas" and not sharded
-                 else "jnp full-weighting/bilinear")
+    partitioned = sharded and config.mg_partition == "partitioned"
+    if backend == "pallas" and not sharded:
+        transfers = ("pallas heat_mg_restrict/heat_mg_prolong "
+                     "(whole-array VMEM)")
+    elif partitioned:
+        transfers = ("partitioned full-weighting/bilinear with 1-deep "
+                     "seam exchange"
+                     + ("; agglomerated subtree: pallas "
+                        "heat_mg_restrict/heat_mg_prolong"
+                        if backend == "pallas"
+                        else "; agglomerated subtree: jnp"))
+    else:
+        transfers = "jnp full-weighting/bilinear"
+    if partitioned:
+        sharding = ("partitioned V-cycle — per-level padded "
+                    "shard_map blocks, halo exchange per sweep, "
+                    "coarse-level agglomeration (see partition plan)")
+    elif sharded:
+        sharding = ("replicated full-grid program — every device "
+                    "computes the whole grid (bitwise the single-"
+                    "device run by construction; "
+                    "mg_partition='partitioned' is the sharded "
+                    "spelling)")
+    else:
+        sharding = "single device"
     return {
         "scheme": config.scheme,
         "theta": scheme_theta(config.scheme),
@@ -546,9 +585,5 @@ def explain_hierarchy(config: HeatConfig, backend: str) -> dict:
         "transfers": transfers,
         "cycle_stop": (f"max|b - A u| <= {config.mg_tol:g} * max|b| "
                        f"or {config.mg_cycles} cycles"),
-        "sharding": ("replicated full-grid program — every device "
-                     "computes the whole grid (bitwise the single-"
-                     "device run by construction; partitioned levels "
-                     "are the roadmap follow-on)" if sharded
-                     else "single device"),
+        "sharding": sharding,
     }

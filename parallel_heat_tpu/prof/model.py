@@ -81,7 +81,10 @@ def work_model(config, *, resolved: bool = False) -> dict:
     flops_cell = _flops_per_cell(config.ndim)
 
     # --- identity: the TuneDB content address for this context -------
-    site = "halo_overlap" if is_sharded else "single_2d"
+    if config.scheme != "explicit":
+        site = "mg_partition" if is_sharded else "single_2d"
+    else:
+        site = "halo_overlap" if is_sharded else "single_2d"
     topology = tune.current_topology()
     geometry = tune.geometry_for(site, config)
     key, _ = tune.tune_key(site, topology, geometry)
@@ -115,13 +118,86 @@ def work_model(config, *, resolved: bool = False) -> dict:
     # the shard count — HBM and VPU are per-chip resources, ICI is
     # per-link and every shard exchanges concurrently) ---------------
     p = tpu_params.params()
-    t_compute = cells / (p.vpu_cells_per_s * n_shards)
-    t_hbm = hbm_bytes_per_step / (p.hbm_stream_bytes_per_s * n_shards)
-    t_ici = 0.0
-    if is_sharded:
-        t_ici = exchanges_per_step * (
-            ici_bytes_per_exchange / p.ici_bytes_per_s
-            + p.collective_latency_s)
+    mg = None
+    if config.scheme != "explicit":
+        # --- implicit: per-level V-cycle lanes ----------------------
+        # The work unit is ONE V-cycle (cycles per step are a runtime
+        # quantity — the vcycle telemetry event measures them); every
+        # level is carried in f32 regardless of storage dtype. Per
+        # level: 2*mg_smooth Jacobi sweeps (pre+post; the coarsest
+        # runs mg_smooth + _COARSE_SWEEPS), each sweep streaming
+        # u-read + b-read + u-write (12 B/cell f32). Partitioned
+        # levels (mg_partition; ops/multigrid_sharded.py) divide
+        # compute/HBM by the shard count and pay one 1-deep exchange
+        # per sweep plus two extras per non-coarsest level (the
+        # pre-restriction residual exchange and the restrict/prolong
+        # seam shifts); replicated levels run full-shape on EVERY
+        # device — divisor 1, the honest zero-speedup accounting.
+        from parallel_heat_tpu.config import multigrid_level_shapes
+        from parallel_heat_tpu.ops.multigrid import _COARSE_SWEEPS
+
+        nu = int(config.mg_smooth)
+        shapes = multigrid_level_shapes(config.shape, config.mg_levels)
+        n_levels = len(shapes)
+        k_part = 0
+        blocks = None
+        if is_sharded and config.mg_partition == "partitioned":
+            from parallel_heat_tpu.ops import multigrid_sharded
+
+            plan = multigrid_sharded.partition_plan(
+                config, min_partitioned=1)
+            k_part = plan["partitioned_levels"]
+            blocks = [lv.get("block_shape") for lv in plan["levels"]]
+        level_cells = [(s[0] - 2) * (s[1] - 2) for s in shapes]
+        sweeps = [2 * nu if l < n_levels - 1 else nu + _COARSE_SWEEPS
+                  for l in range(n_levels)]
+        t_compute = t_hbm = t_ici = 0.0
+        mg_hbm = mg_ici = 0
+        exchanges = 0
+        for l in range(n_levels):
+            div = n_shards if l < k_part else 1
+            t_compute += level_cells[l] * sweeps[l] / (
+                p.vpu_cells_per_s * div)
+            lvl_hbm = level_cells[l] * sweeps[l] * 12
+            mg_hbm += lvl_hbm
+            t_hbm += lvl_hbm / (p.hbm_stream_bytes_per_s * div)
+            if l < k_part:
+                perim = 0
+                for ax, d in enumerate(mesh):
+                    if d <= 1:
+                        continue
+                    slab = 1
+                    for j, b in enumerate(blocks[l]):
+                        if j != ax:
+                            slab *= int(b)
+                    perim += 2 * slab * 4
+                n_ex = sweeps[l] + (2 if l < n_levels - 1 else 0)
+                exchanges += n_ex
+                lvl_ici = n_ex * perim
+                mg_ici += lvl_ici
+                t_ici += (lvl_ici / p.ici_bytes_per_s
+                          + n_ex * 2.0 * p.collective_latency_s)
+        mg = {
+            "work_unit": "vcycle",
+            "mg_partition": (config.mg_partition if is_sharded
+                             else None),
+            "n_levels": n_levels,
+            "partitioned_levels": k_part,
+            "level_cells": level_cells,
+            "sweeps_per_cycle": sweeps,
+            "hbm_bytes_per_cycle": int(mg_hbm),
+            "ici_bytes_per_cycle": int(mg_ici),
+            "exchanges_per_cycle": int(exchanges),
+        }
+    else:
+        t_compute = cells / (p.vpu_cells_per_s * n_shards)
+        t_hbm = hbm_bytes_per_step / (
+            p.hbm_stream_bytes_per_s * n_shards)
+        t_ici = 0.0
+        if is_sharded:
+            t_ici = exchanges_per_step * (
+                ici_bytes_per_exchange / p.ici_bytes_per_s
+                + p.collective_latency_s)
     step_time = max(t_compute, t_hbm, t_ici)
     lanes = {"compute": t_compute, "hbm": t_hbm, "ici": t_ici}
     predicted = max(lanes, key=lambda k: lanes[k])
@@ -157,6 +233,11 @@ def work_model(config, *, resolved: bool = False) -> dict:
         "predicted_bound": predicted,
         "roofline_steps_per_s": 1.0 / step_time,
         "roofline_mcells_steps_per_s": cells / step_time / 1e6,
+        # Implicit-only: the per-level V-cycle lane decomposition
+        # (None for the explicit scheme). When present, the lane
+        # times above are per V-CYCLE, not per step — see the mg
+        # block comment.
+        "mg": mg,
     }
 
 

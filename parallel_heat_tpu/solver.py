@@ -188,6 +188,17 @@ def _resolved(config: HeatConfig):
         mode = resolve_halo_overlap(config, backend)
         if config.halo_overlap != mode:
             config = config.replace(halo_overlap=mode).validate()
+    elif any(d > 1 for d in config.mesh_or_unit()):
+        # Sharded implicit: resolve mg_partition="auto" to the
+        # concrete V-cycle spelling here (same discipline as the
+        # depth/schedule above — one resolution site shared by
+        # _build_runner and explain, consulting the "mg_partition"
+        # TuneDB site over the analytic partition plan).
+        from parallel_heat_tpu.ops import multigrid_sharded
+
+        mg_mode = multigrid_sharded.resolve_mg_partition(config)
+        if config.mg_partition != mg_mode:
+            config = config.replace(mg_partition=mg_mode).validate()
     return config, backend, was_auto
 
 
@@ -364,6 +375,20 @@ def _build_runner(config: HeatConfig):
         multi_step, multi_step_residual = _single_multistep(config, backend)
         run = _make_loop(multi_step, multi_step_residual, config)
         return jax.jit(run, donate_argnums=0), None
+
+    if config.scheme != "explicit" and config.mg_partition == "partitioned":
+        # Partitioned V-cycle: per-level padded shard_map blocks with
+        # a halo exchange per smoothing sweep and coarse-level
+        # agglomeration (ops/multigrid_sharded.py). The parity pin is
+        # on the hand-scheduled block programs themselves — never a
+        # GSPMD partition constraint (see the replicated branch below
+        # for why GSPMD-partitioned V-cycles fork bits on XLA:CPU).
+        from parallel_heat_tpu.ops import multigrid_sharded
+
+        mesh = make_heat_mesh(mesh_shape)
+        run = multigrid_sharded.build_partitioned_runner(
+            config, backend, mesh)
+        return jax.jit(run, donate_argnums=0), mesh
 
     if config.scheme != "explicit":
         # Sharded implicit runs compute the V-cycle REPLICATED: the
@@ -683,13 +708,33 @@ def _explain_body(config: HeatConfig, ensemble: Optional[int]) -> dict:
         # the kernel picks below).
         from parallel_heat_tpu.ops import multigrid
 
+        partitioned = (is_sharded
+                       and config.mg_partition == "partitioned")
         mg = multigrid.explain_hierarchy(
-            config, backend if not is_sharded else "jnp")
+            config,
+            backend if (not is_sharded or partitioned) else "jnp")
         out["multigrid"] = mg
-        out["path"] = (
-            f"implicit {config.scheme}: multigrid V-cycle per step "
-            f"({len(mg['levels'])} levels, {mg['smoother']}, "
-            f"{mg['transfers']})")
+        if partitioned:
+            from parallel_heat_tpu.ops import multigrid_sharded
+
+            mg["partition_plan"] = multigrid_sharded.explain_partition(
+                config)
+            agg = mg["partition_plan"]["agglomerate_from"]
+            out["path"] = (
+                f"implicit {config.scheme}: partitioned multigrid "
+                f"V-cycle per step "
+                f"({mg['partition_plan']['partitioned_levels']} of "
+                f"{len(mg['levels'])} levels on shard blocks, "
+                + (f"agglomerated from level {agg}, "
+                   if agg is not None else "no agglomeration, ")
+                + f"{mg['smoother']}, {mg['transfers']})")
+        else:
+            if is_sharded:
+                out["mg_partition"] = config.mg_partition
+            out["path"] = (
+                f"implicit {config.scheme}: multigrid V-cycle per "
+                f"step ({len(mg['levels'])} levels, {mg['smoother']}, "
+                f"{mg['transfers']})")
         return out
 
     if is_sharded:

@@ -400,7 +400,9 @@ def _resume_command(config: HeatConfig, stem: str, total_abs: int,
                 ("--mg-tol", config.mg_tol, defaults.mg_tol),
                 ("--mg-cycles", config.mg_cycles, defaults.mg_cycles),
                 ("--mg-smooth", config.mg_smooth, defaults.mg_smooth),
-                ("--mg-levels", config.mg_levels, defaults.mg_levels)):
+                ("--mg-levels", config.mg_levels, defaults.mg_levels),
+                ("--mg-partition", config.mg_partition,
+                 defaults.mg_partition)):
             if val != default:
                 parts.append(f"{flag} {val:g}" if isinstance(val, float)
                              else f"{flag} {val}")

@@ -138,6 +138,18 @@ def geometry_ensemble_2d(shape, dtype, accumulate="storage") -> dict:
             "accumulate": str(accumulate)}
 
 
+def geometry_mg_partition(config) -> dict:
+    from parallel_heat_tpu.config import multigrid_level_shapes
+
+    return {"shape": [int(n) for n in config.shape],
+            "dtype": _dtype_name(config.dtype),
+            "mesh_shape": [int(m) for m in config.mesh_or_unit()],
+            "scheme": str(config.scheme),
+            "mg_levels": len(multigrid_level_shapes(
+                config.shape, config.mg_levels)),
+            "mg_smooth": int(config.mg_smooth)}
+
+
 def geometry_for(site: str, config) -> dict:
     """Dispatch to the site's geometry builder from a (validated)
     config — the search harness's entry point."""
@@ -151,6 +163,8 @@ def geometry_for(site: str, config) -> dict:
     if site == "ensemble_2d":
         return geometry_ensemble_2d(config.shape, config.dtype,
                                     config.accumulate)
+    if site == "mg_partition":
+        return geometry_mg_partition(config)
     raise ValueError(f"unknown tune site {site!r}")
 
 

@@ -56,6 +56,12 @@ SITE_CHOICES: Dict[str, Tuple[str, ...]] = {
     "block_temporal_2d": ("G-uni", "G-fuse", "G-circ", "G", "jnp"),
     "halo_overlap": ("phase", "overlap", "pipeline"),
     "ensemble_2d": ("M", "vmap"),
+    # Sharded implicit V-cycle spelling (ops/multigrid_sharded.py):
+    # padded per-level shard_map blocks vs the replicated full-grid
+    # program. The per-level agglomeration threshold inside the
+    # partitioned spelling stays analytic (prof/model lanes) — the
+    # site decides the spelling, the plan reports the depth.
+    "mg_partition": ("replicated", "partitioned"),
 }
 
 

@@ -52,7 +52,7 @@ def test_cache_key_moves_with_every_semantic_field():
         "mesh_shape": [2, 1], "overlap": False, "halo_depth": 2,
         "halo_overlap": "phase", "accumulate": "f32chunk",
         "scheme": "backward_euler", "mg_tol": 1e-5, "mg_cycles": 7,
-        "mg_smooth": 2, "mg_levels": 3,
+        "mg_smooth": 2, "mg_levels": 3, "mg_partition": "partitioned",
     }
     assert set(moved) == set(SEMANTIC_FIELDS)
     for field, value in moved.items():

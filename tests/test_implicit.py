@@ -443,6 +443,14 @@ def test_resume_command_carries_scheme_and_mg_flags():
     assert "--mg-tol 0.0001" in line
     assert "--mg-levels 3" in line
     assert "--mg-cycles" not in line  # defaults stay off the line
+    assert "--mg-partition" not in line  # "auto" is the default
+    # A forced partition spelling is SEMANTIC — dropping it would let
+    # the resumed run's auto resolution pick a different program.
+    cfg_p = cfg.replace(mesh_shape=(2, 4),
+                        mg_partition="partitioned")
+    line_p = _resume_command(cfg_p, "/tmp/ck", 400,
+                             SupervisorPolicy(checkpoint_every=40))
+    assert "--mg-partition partitioned" in line_p
     # Explicit configs stay scheme-flag-free (the default).
     line_e = _resume_command(
         HeatConfig(nx=64, ny=64, steps=400, backend="jnp"),
@@ -484,3 +492,282 @@ def test_cycle_trace_converges_within_tol():
     assert tr["residual_last"] <= tr["tol"]
     # Residuals contract monotonically on this well-posed solve.
     assert tr["contraction"] is not None and tr["contraction"] < 0.5
+
+
+# ---------------------------------------------------------------------------
+# Partitioned V-cycle (ops/multigrid_sharded.py; SEMANTICS.md
+# "Partitioned V-cycle")
+# ---------------------------------------------------------------------------
+
+def _ms():
+    from parallel_heat_tpu.ops import multigrid_sharded
+    return multigrid_sharded
+
+
+@pytest.mark.parametrize("scheme", ["backward_euler", "crank_nicolson"])
+def test_partitioned_bitwise_identical_to_single_device(scheme):
+    # THE partitioned pin: a one-level partitioned prefix (the floored
+    # explicit plan at CPU-testable sizes) is BITWISE the
+    # single-device run. Non-square geometry, so every coarse level
+    # shape is mesh-indivisible and the padded-block layout is load-
+    # bearing, not incidental.
+    base = dict(nx=64, ny=32, cx=18.5, cy=11.5, steps=3,
+                backend="jnp", scheme=scheme)
+    solo = _solve_grid(HeatConfig(**base))
+    part = _solve_grid(HeatConfig(mesh_shape=(2, 4),
+                                  mg_partition="partitioned", **base))
+    np.testing.assert_array_equal(solo, part)
+
+
+@pytest.mark.slow
+def test_partitioned_converge_bitwise():
+    # Converge mode over the partitioned program: the pmax residual
+    # verdict steers the same host control flow, so steps_run,
+    # residual and the grid are all bitwise the single-device run.
+    base = dict(nx=64, ny=64, cx=25.0, cy=25.0, steps=60,
+                converge=True, check_interval=4, eps=1e-3,
+                backend="jnp", scheme="backward_euler")
+    solo = solve(HeatConfig(**base))
+    part = solve(HeatConfig(mesh_shape=(2, 4),
+                            mg_partition="partitioned", **base))
+    assert solo.steps_run == part.steps_run
+    assert solo.residual == part.residual
+    np.testing.assert_array_equal(solo.to_numpy(), part.to_numpy())
+
+
+def test_partitioned_deep_chain_allclose_contract(monkeypatch):
+    # The documented parity BOUNDARY: with two+ partitioned levels the
+    # REPLICATED reference itself recomputes its level-1 smooth chain
+    # in fusion clusters whose FMA contraction differs (its fused
+    # u1 + prolong(e2) stops matching the sum of its own materialized
+    # operands on XLA:CPU), so deep chains are pinned allclose at
+    # rtol 1e-6 (~100x the observed 1-ulp fork); the TPU re-run
+    # protocol lives in the bench artifact. The block programs stay
+    # self-consistent; the one-level prefix above stays bitwise.
+    ms = _ms()
+    monkeypatch.setattr(ms, "_MIN_PARTITIONED_FLOOR", 3)
+    base = dict(nx=64, ny=64, cx=21.25, cy=21.25, steps=2,
+                backend="jnp", scheme="backward_euler")
+    solo = _solve_grid(HeatConfig(**base))
+    part = _solve_grid(HeatConfig(mesh_shape=(2, 4),
+                                  mg_partition="partitioned", **base))
+    np.testing.assert_allclose(part, solo, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_partitioned_fully_partitioned_chain_allclose(monkeypatch):
+    # No agglomeration at all (floor beyond the hierarchy): every
+    # level runs as shard blocks, Crank-Nicolson RHS included.
+    ms = _ms()
+    monkeypatch.setattr(ms, "_MIN_PARTITIONED_FLOOR", 99)
+    for scheme in ("backward_euler", "crank_nicolson"):
+        base = dict(nx=64, ny=32, cx=20.5, cy=10.25, steps=3,
+                    backend="jnp", scheme=scheme)
+        solo = _solve_grid(HeatConfig(**base))
+        part = _solve_grid(HeatConfig(mesh_shape=(2, 4),
+                                      mg_partition="partitioned",
+                                      **base))
+        np.testing.assert_allclose(part, solo, rtol=1e-6)
+
+
+def test_partition_plan_threshold_boundary_and_floor():
+    # Host-arithmetic invariants of the agglomeration plan.
+    ms = _ms()
+    small = HeatConfig(nx=64, ny=64, cx=22.5, cy=22.5, steps=1,
+                       scheme="backward_euler",
+                       mesh_shape=(2, 4)).validate()
+    plan = ms.partition_plan(small)
+    # At CPU-testable sizes the v5e collective latency outprices the
+    # saved compute on every level: analytic verdict is replicated.
+    assert plan["auto_wins"] is False
+    assert plan["partitioned_levels"] == 0
+    assert all(lv["partition"] == "replicated" for lv in plan["levels"])
+    assert plan["threshold"]["t_sweep_partitioned_s"] > \
+        plan["threshold"]["t_sweep_replicated_s"]
+    # The explicit-request floor: at least one level partitions, the
+    # analytic verdict is preserved alongside.
+    forced = ms.partition_plan(small, min_partitioned=1)
+    assert forced["partitioned_levels"] == 1
+    assert forced["analytic_partitioned_levels"] == 0
+    assert forced["auto_wins"] is False
+    assert forced["levels"][0]["partition"] == "partitioned"
+    assert forced["levels"][1]["partition"] == "agglomerated"
+    # Padded chain: each partitioned level's padded extent doubles the
+    # next coarser one and covers the authentic shape.
+    for fine, coarse in zip(forced["levels"], forced["levels"][1:]):
+        if coarse.get("padded_shape") and fine.get("padded_shape"):
+            assert tuple(fine["padded_shape"]) == tuple(
+                2 * n for n in coarse["padded_shape"])
+        if fine.get("padded_shape"):
+            assert all(p >= s and p % d == 0 for p, s, d in zip(
+                fine["padded_shape"], fine["shape"], (2, 4)))
+    # Large grids flip the analytic verdict (monotone prefix).
+    big = HeatConfig(nx=4096, ny=4096, cx=1400.0, cy=1400.0, steps=1,
+                     scheme="backward_euler",
+                     mesh_shape=(2, 4)).validate()
+    bplan = ms.partition_plan(big)
+    assert bplan["auto_wins"] is True
+    assert bplan["partitioned_levels"] == 2
+    kinds = [lv["partition"] for lv in bplan["levels"]]
+    assert kinds[:2] == ["partitioned", "partitioned"]
+    assert all(k == "agglomerated" for k in kinds[2:])
+    assert ms.resolve_mg_partition(big) == "partitioned"
+    assert ms.resolve_mg_partition(small) == "replicated"
+
+
+def test_mg_partition_resolution_order_and_validation():
+    # forced > tuned-db > analytic; the field is SEMANTIC (HL101) and
+    # inert-knob-validated like the other mg_* flags.
+    from parallel_heat_tpu import tune
+    from parallel_heat_tpu.config import SEMANTIC_FIELDS
+
+    ms = _ms()
+    assert "mg_partition" in SEMANTIC_FIELDS
+    small = HeatConfig(nx=64, ny=64, cx=22.5, cy=22.5, steps=1,
+                       scheme="backward_euler",
+                       mesh_shape=(2, 4)).validate()
+    with tune.force("mg_partition", "partitioned"):
+        assert ms.resolve_mg_partition(small) == "partitioned"
+    with tune.force("mg_partition", "replicated"):
+        assert ms.resolve_mg_partition(small) == "replicated"
+    # Explicit values win over everything.
+    with tune.force("mg_partition", "replicated"):
+        assert ms.resolve_mg_partition(
+            small.replace(mg_partition="partitioned")) == "partitioned"
+    # Vocabulary and inert-knob rejections.
+    with pytest.raises(ValueError, match="mg_partition"):
+        HeatConfig(nx=16, ny=16, steps=1, scheme="backward_euler",
+                   mesh_shape=(2, 2),
+                   mg_partition="sideways").validate()
+    with pytest.raises(ValueError, match="mg_partition"):
+        HeatConfig(nx=16, ny=16, steps=1,
+                   mg_partition="partitioned").validate()  # explicit
+    with pytest.raises(ValueError, match="mg_partition"):
+        HeatConfig(nx=16, ny=16, steps=1, scheme="backward_euler",
+                   mg_partition="partitioned").validate()  # unsharded
+
+
+def test_partitioned_stream_chunked_bitwise_matches_one_shot():
+    cfg = HeatConfig(nx=32, ny=16, cx=11.5, cy=5.5, steps=9,
+                     backend="jnp", scheme="backward_euler",
+                     mesh_shape=(2, 4), mg_partition="partitioned")
+    one = _solve_grid(cfg)
+    last = None
+    for last in solve_stream(cfg, chunk_steps=2):
+        pass
+    np.testing.assert_array_equal(one, last.to_numpy())
+    assert last.steps_run == 9
+
+
+def test_partitioned_observer_toggles_zero_new_runner_misses():
+    # Observation-only flips on a PARTITIONED config reuse the
+    # compiled shard_map programs (no new _build_runner misses) and
+    # move no bits — mg_partition partitions into SEMANTIC_FIELDS,
+    # the observers stay out of the memo key.
+    from parallel_heat_tpu import solver
+
+    cfg = HeatConfig(nx=32, ny=16, cx=11.25, cy=5.25, steps=6,
+                     backend="jnp", scheme="backward_euler",
+                     mesh_shape=(2, 4), mg_partition="partitioned")
+    solver._build_runner.cache_clear()
+    plain = [r.to_numpy() for r in solve_stream(cfg, chunk_steps=3)]
+    misses = solver._build_runner.cache_info().misses
+    observed = [r.to_numpy() for r in solve_stream(
+        cfg.replace(guard_interval=3, diag_interval=3),
+        chunk_steps=3)]
+    assert solver._build_runner.cache_info().misses == misses
+    for a, b in zip(plain, observed):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_partitioned_elastic_resume_reshard_on_load(tmp_path):
+    # PR-10 elastic recovery through the partitioned program: a
+    # checkpoint from a partitioned sharded run resumes onto a single
+    # device, onto the replicated spelling, and back onto the
+    # partitioned one — all bitwise an uninterrupted solo run.
+    from parallel_heat_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    base = dict(nx=32, ny=16, cx=11.75, cy=5.75, backend="jnp",
+                scheme="backward_euler")
+    mid = solve(HeatConfig(steps=10, mesh_shape=(2, 4),
+                           mg_partition="partitioned", **base))
+    p = tmp_path / "mgpart.npz"
+    save_checkpoint(p, mid.to_numpy(), 10,
+                    HeatConfig(steps=10, **base))
+    grid, step, _ = load_checkpoint(p)
+    assert step == 10
+    want = solve(HeatConfig(steps=20, **base)).to_numpy()
+    for kw in (dict(),
+               dict(mesh_shape=(2, 4), mg_partition="replicated"),
+               dict(mesh_shape=(2, 4), mg_partition="partitioned")):
+        rest = solve(HeatConfig(steps=10, **base, **kw), initial=grid)
+        np.testing.assert_array_equal(rest.to_numpy(), want,
+                                      err_msg=f"resume {kw}")
+
+
+def test_transfer_ops_agglomerated_pallas_selection():
+    # Satellite bugfix pin: the Pallas transfer kernels decline on the
+    # REPLICATED sharded path (GSPMD cannot partition a pallas_call)
+    # but are admissible again on the agglomerated coarse levels of
+    # the partitioned V-cycle, which run per-device inside shard_map.
+    from parallel_heat_tpu.ops.multigrid import transfer_ops
+
+    solo = HeatConfig(nx=34, ny=34, cx=12.5, cy=12.5, steps=1,
+                      backend="pallas",
+                      scheme="backward_euler").validate()
+    sharded = HeatConfig(nx=32, ny=32, cx=12.5, cy=12.5, steps=1,
+                         backend="pallas", scheme="backward_euler",
+                         mesh_shape=(2, 4)).validate()
+
+    def is_pallas(ops):
+        return ops[0].__name__ == "restrict"
+
+    assert is_pallas(transfer_ops(solo, "pallas"))
+    assert not is_pallas(transfer_ops(sharded, "pallas"))
+    assert is_pallas(transfer_ops(sharded, "pallas",
+                                  agglomerated=True))
+    assert not is_pallas(transfer_ops(sharded, "jnp",
+                                      agglomerated=True))
+
+
+def test_partitioned_pallas_backend_matches_jnp():
+    # The agglomerated subtree serves the pallas transfer kernels
+    # through the REAL partitioned path; interpreted off-TPU they are
+    # bitwise the jnp spelling, so the whole solve matches exactly.
+    base = dict(nx=32, ny=32, cx=12.25, cy=12.25, steps=2,
+                scheme="backward_euler", mesh_shape=(2, 4),
+                mg_partition="partitioned")
+    a = _solve_grid(HeatConfig(backend="jnp", **base))
+    b = _solve_grid(HeatConfig(backend="pallas", **base))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_partitioned_explain_reports_plan_and_decided_by():
+    base = dict(nx=64, ny=64, cx=22.5, cy=22.5, steps=3,
+                backend="jnp", scheme="backward_euler",
+                mesh_shape=(2, 4))
+    ex = explain(HeatConfig(mg_partition="partitioned", **base))
+    assert "partitioned multigrid V-cycle" in ex["path"]
+    plan = ex["multigrid"]["partition_plan"]
+    assert plan["mode"] == "partitioned"
+    assert plan["partitioned_levels"] == 1
+    assert plan["agglomerate_from"] == 1
+    kinds = [lv["partition"] for lv in plan["levels"]]
+    assert kinds[0] == "partitioned"
+    assert all(k == "agglomerated" for k in kinds[1:])
+    assert plan["threshold"] is not None
+    assert "partitioned full-weighting" in ex["multigrid"]["transfers"]
+    # auto on a small grid: analytic model decides replicated, and
+    # explain says who decided.
+    ex2 = explain(HeatConfig(**base))
+    assert ex2["mg_partition"] == "replicated"
+    assert ex2["decided_by"]["mg_partition"]["source"] == \
+        "analytic-model"
+    assert ex2["decided_by"]["mg_partition"]["choice"] == "replicated"
+    # forced pin surfaces as the decider through the same recorder.
+    from parallel_heat_tpu import tune
+    with tune.force("mg_partition", "partitioned"):
+        ex3 = explain(HeatConfig(**base))
+    assert ex3["decided_by"]["mg_partition"]["source"] == "forced"
+    assert "partition_plan" in ex3["multigrid"]

@@ -116,6 +116,85 @@ def test_work_model_sharded_ici_scales_with_halo_depth():
     assert d1["tune_key"] != d2["tune_key"]  # depth is in the geometry
 
 
+def test_work_model_mg_lanes_partitioned_arithmetic():
+    """Implicit sharded models carry the per-level V-cycle lane
+    decomposition: sweeps per level (2*nu, coarsest nu+_COARSE_SWEEPS),
+    12 B/cell f32 HBM per sweep, and for partitioned levels one 1-deep
+    exchange per sweep plus two seam/residual extras — priced against
+    the plan's padded block extents, with replicated levels at the
+    honest divisor-1 zero-speedup accounting."""
+    from parallel_heat_tpu.config import multigrid_level_shapes
+    from parallel_heat_tpu.ops import multigrid_sharded
+    from parallel_heat_tpu.ops.multigrid import _COARSE_SWEEPS
+
+    cfg = HeatConfig(nx=64, ny=64, steps=5, backend="jnp",
+                     mesh_shape=(2, 4), scheme="backward_euler",
+                     mg_partition="partitioned")
+    m = work_model(cfg)
+    assert m["site"] == "mg_partition" and m["n_shards"] == 8
+    mg = m["mg"]
+    assert mg["work_unit"] == "vcycle"
+    assert mg["mg_partition"] == "partitioned"
+
+    shapes = multigrid_level_shapes(cfg.validate().shape, cfg.mg_levels)
+    n = len(shapes)
+    assert mg["n_levels"] == n
+    assert mg["level_cells"] == [(s[0] - 2) * (s[1] - 2) for s in shapes]
+    nu = cfg.mg_smooth
+    assert mg["sweeps_per_cycle"] == (
+        [2 * nu] * (n - 1) + [nu + _COARSE_SWEEPS])
+    # Every level is carried in f32: u-read + b-read + u-write per
+    # sweep = 12 B/cell regardless of the storage dtype.
+    assert mg["hbm_bytes_per_cycle"] == sum(
+        c * s * 12 for c, s in zip(mg["level_cells"],
+                                   mg["sweeps_per_cycle"]))
+
+    # 64^2 is below the analytic profitability threshold, so the plan
+    # partitions exactly the forced floor of one level; its ICI bytes
+    # come from that level's block perimeter alone.
+    plan = multigrid_sharded.partition_plan(cfg.validate(),
+                                            min_partitioned=1)
+    assert mg["partitioned_levels"] == plan["partitioned_levels"] == 1
+    blk = plan["levels"][0]["block_shape"]
+    # (64/2, 64/4) top-level blocks plus the 1-deep exchange ring.
+    assert list(blk) == [34, 18]
+    perim = 2 * blk[1] * 4 + 2 * blk[0] * 4  # both axes partitioned
+    n_ex = mg["sweeps_per_cycle"][0] + 2  # +residual +seam exchanges
+    assert mg["exchanges_per_cycle"] == n_ex
+    assert mg["ici_bytes_per_cycle"] == n_ex * perim
+    # Lane times: the partitioned level divides by the shard count,
+    # replicated levels run full-shape on every device (divisor 1).
+    pk = m["peaks"]
+    t_hbm = sum(
+        c * s * 12 / (pk["hbm_stream_bytes_per_s"]
+                      * (8 if l < 1 else 1))
+        for l, (c, s) in enumerate(zip(mg["level_cells"],
+                                       mg["sweeps_per_cycle"])))
+    assert m["t_hbm_s"] == pytest.approx(t_hbm)
+    assert m["t_ici_s"] == pytest.approx(
+        n_ex * perim / pk["ici_bytes_per_s"]
+        + n_ex * 2.0 * pk["collective_latency_s"])
+
+    # Replicated sharded implicit: same site (the decision context is
+    # the mg_partition tune site), zero partitioned levels, zero ICI.
+    r = work_model(cfg.replace(mg_partition="replicated"))
+    assert r["site"] == "mg_partition"
+    assert r["mg"]["partitioned_levels"] == 0
+    assert r["mg"]["ici_bytes_per_cycle"] == 0
+    assert r["mg"]["exchanges_per_cycle"] == 0
+    assert r["t_ici_s"] == 0.0
+
+    # Solo implicit keys the single-device site and models no ICI;
+    # explicit configs carry no mg block at all.
+    solo = work_model(HeatConfig(nx=64, ny=64, steps=5, backend="jnp",
+                                 scheme="backward_euler"))
+    assert solo["site"] == "single_2d"
+    assert solo["mg"]["mg_partition"] is None
+    assert solo["mg"]["partitioned_levels"] == 0
+    expl = work_model(HeatConfig(nx=64, ny=64, steps=5, backend="jnp"))
+    assert expl["mg"] is None
+
+
 def test_valid_model_gate():
     m = work_model(HeatConfig(steps=5, **_BASE))
     assert valid_model(m) is m

@@ -153,6 +153,24 @@ def main(argv=None):
                          "overlap, pipeline, auto (default: auto "
                          "only) — the phase-vs-overlapped comparison "
                          "MULTICHIP_r*.json commits")
+    ap.add_argument("--scheme", default="explicit",
+                    choices=("explicit", "backward_euler",
+                             "crank_nicolson"),
+                    help="(--weak) time integrator; the implicit "
+                         "schemes run the multigrid V-cycle per step "
+                         "and sweep --mg-partition spellings per "
+                         "cell instead of --schedules (the exchange "
+                         "lives per level inside the cycle, so the "
+                         "standalone probe split does not apply — "
+                         "exchange_share is null; the model-priced "
+                         "share rides exchange_share_model)")
+    ap.add_argument("--mg-partition", default="auto",
+                    metavar="M,M",
+                    help="(--weak, implicit --scheme) comma list of "
+                         "mg_partition spellings to sweep per cell: "
+                         "auto, replicated, partitioned (default: "
+                         "auto only; single-device meshes run only "
+                         "'auto' — they have one V-cycle spelling)")
     ap.add_argument("--metrics", default=None, metavar="FILE",
                     help="(--weak) also append one telemetry chunk "
                          "event per cell (wall_s + exchange_s) to "
@@ -211,6 +229,10 @@ def main(argv=None):
     if not usable:
         raise SystemExit(f"no requested mesh fits the {n_dev} visible devices")
 
+    if args.scheme != "explicit" and not args.weak:
+        raise SystemExit("--scheme backward_euler/crank_nicolson is "
+                         "a --weak mode (the strong-scaling sweep "
+                         "times the explicit step loop)")
     if args.weak:
         return _weak_main(args, usable, sizes, depth, n_dev)
 
@@ -301,12 +323,30 @@ def _weak_main(args, usable, sizes, depth, n_dev):
     from parallel_heat_tpu.utils import profiling
     from parallel_heat_tpu.utils.profiling import sync
 
-    schedules = [s.strip() for s in
-                 (args.schedules or "auto").split(",") if s.strip()]
-    bad = [s for s in schedules
-           if s not in ("auto", "phase", "overlap", "pipeline")]
-    if bad:
-        raise SystemExit(f"--schedules: unknown schedule(s) {bad}")
+    implicit = args.scheme != "explicit"
+    if implicit:
+        # Implicit mode: the exchange lives per level inside the
+        # V-cycle, so the sweep axis is the mg_partition spelling,
+        # not the explicit rounds' overlap schedule.
+        if args.schedules:
+            raise SystemExit("--schedules schedules the explicit "
+                             "exchange rounds; with an implicit "
+                             "--scheme sweep --mg-partition instead")
+        schedules = [s.strip() for s in
+                     args.mg_partition.split(",") if s.strip()]
+        bad = [s for s in schedules
+               if s not in ("auto", "replicated", "partitioned")]
+        if bad:
+            raise SystemExit(f"--mg-partition: unknown spelling(s) "
+                             f"{bad}")
+    else:
+        schedules = [s.strip() for s in
+                     (args.schedules or "auto").split(",")
+                     if s.strip()]
+        bad = [s for s in schedules
+               if s not in ("auto", "phase", "overlap", "pipeline")]
+        if bad:
+            raise SystemExit(f"--schedules: unknown schedule(s) {bad}")
     tel = None
     if args.metrics:
         from parallel_heat_tpu.utils.telemetry import Telemetry
@@ -318,15 +358,32 @@ def _weak_main(args, usable, sizes, depth, n_dev):
         for block in sizes:
             grid = tuple(block * d for d in mesh)
             for sched in schedules:
-                cfg = HeatConfig(
-                    nx=grid[0], ny=grid[1],
-                    nz=grid[2] if args.ndim == 3 else None,
-                    steps=args.steps, dtype=args.dtype,
-                    backend=args.backend, converge=args.converge,
-                    mesh_shape=None if _prod(mesh) == 1 else mesh,
-                    halo_depth=depth if _prod(mesh) > 1 else 1,
-                    halo_overlap=None if sched == "auto" else sched,
-                ).validate()
+                if implicit:
+                    if _prod(mesh) == 1 and sched != "auto":
+                        continue  # one V-cycle spelling off-mesh
+                    cfg = HeatConfig(
+                        nx=grid[0], ny=grid[1],
+                        nz=grid[2] if args.ndim == 3 else None,
+                        steps=args.steps, dtype=args.dtype,
+                        backend=args.backend,
+                        converge=args.converge,
+                        mesh_shape=None if _prod(mesh) == 1 else mesh,
+                        scheme=args.scheme,
+                        mg_partition=(sched if _prod(mesh) > 1
+                                      else "auto"),
+                    ).validate()
+                else:
+                    cfg = HeatConfig(
+                        nx=grid[0], ny=grid[1],
+                        nz=grid[2] if args.ndim == 3 else None,
+                        steps=args.steps, dtype=args.dtype,
+                        backend=args.backend,
+                        converge=args.converge,
+                        mesh_shape=None if _prod(mesh) == 1 else mesh,
+                        halo_depth=depth if _prod(mesh) > 1 else 1,
+                        halo_overlap=None if sched == "auto"
+                        else sched,
+                    ).validate()
                 rcfg, _rbackend, _ = _resolved(cfg)
                 # An explicit "pipeline" the round builder cannot
                 # honor (jnp backend, 3D, declining geometry) falls
@@ -334,9 +391,15 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                 # the run ACTUALLY pays. explain() owns that fallback
                 # resolution (halo_overlap_effective); labeling from
                 # it instead of re-deriving here keeps this artifact
-                # drift-free against the builders.
+                # drift-free against the builders. Implicit cells
+                # label the RESOLVED mg_partition the same way (an
+                # "auto" cell shows what the profitability model
+                # picked).
                 ex = explain(cfg)
-                effective = ex["halo_overlap_effective"]
+                effective = (rcfg.mg_partition if implicit
+                             and _prod(mesh) > 1 else
+                             "n/a" if implicit else
+                             ex["halo_overlap_effective"])
                 u0 = jax.block_until_ready(make_initial_grid(cfg))
                 solve(cfg, initial=u0)  # compile + warm
                 best = float("inf")
@@ -344,21 +407,29 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                     res = solve(cfg, initial=u0)
                     sync(res.grid)
                     best = min(best, res.elapsed_s)
-                # Exchange rounds actually run: full K-deep rounds
-                # plus one remainder round (its shallower exchange is
-                # counted at full-round cost — a <=1-round
-                # overestimate the protocol notes).
                 K = rcfg.halo_depth
-                rounds = args.steps // K + (1 if args.steps % K else 0)
-                probe = _exchange_probe(rcfg, effective, rounds)
-                if probe is not None:
-                    exch = _time_best(probe, u0, args.repeats)
-                elif effective == "pipeline" and _prod(mesh) > 1:
-                    # One phase-separated prologue exchange per run.
-                    full = _exchange_probe(rcfg, "phase", 1)
-                    exch = _time_best(full, u0, args.repeats)
+                if implicit:
+                    # The V-cycle's exchanges are per level inside
+                    # the compiled step — no standalone probe can
+                    # time them (prof/model.py's mg ICI lane is the
+                    # priced stand-in, reported below).
+                    exch = None
                 else:
-                    exch = 0.0
+                    # Exchange rounds actually run: full K-deep
+                    # rounds plus one remainder round (its shallower
+                    # exchange is counted at full-round cost — a
+                    # <=1-round overestimate the protocol notes).
+                    rounds = (args.steps // K
+                              + (1 if args.steps % K else 0))
+                    probe = _exchange_probe(rcfg, effective, rounds)
+                    if probe is not None:
+                        exch = _time_best(probe, u0, args.repeats)
+                    elif effective == "pipeline" and _prod(mesh) > 1:
+                        # One phase-separated prologue exchange.
+                        full = _exchange_probe(rcfg, "phase", 1)
+                        exch = _time_best(full, u0, args.repeats)
+                    else:
+                        exch = 0.0
                 cells_n = _prod(grid)
                 row = {
                     "mesh": "x".join(map(str, mesh)),
@@ -369,15 +440,28 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                     "halo_depth": K,
                     "steps": res.steps_run,
                     "wall_s": round(best, 5),
-                    "exchange_wall_s": round(exch, 5),
-                    "compute_wall_s": round(max(0.0, best - exch), 5),
-                    "exchange_share": round(exch / best, 4) if best > 0
-                    else None,
+                    "exchange_wall_s": (None if exch is None
+                                        else round(exch, 5)),
+                    "compute_wall_s": (None if exch is None else
+                                       round(max(0.0, best - exch),
+                                             5)),
+                    "exchange_share": (round(exch / best, 4)
+                                       if exch is not None and best > 0
+                                       else None),
                     "cells_per_device": cells_n // _prod(mesh),
                     "mcells_steps_per_s": round(
                         cells_n * res.steps_run / best / 1e6, 1),
                     "path": ex["path"],
                 }
+                if implicit:
+                    row["scheme"] = args.scheme
+                    if _prod(mesh) > 1:
+                        from parallel_heat_tpu.prof import work_model
+
+                        m = work_model(rcfg, resolved=True)
+                        row["exchange_share_model"] = (
+                            round(m["t_ici_s"] / m["step_time_s"], 4)
+                            if m["step_time_s"] > 0 else None)
                 rows.append(row)
                 print(json.dumps(row))
                 sys.stdout.flush()
@@ -398,9 +482,12 @@ def _weak_main(args, usable, sizes, depth, n_dev):
     print("\n| mesh      | schedule | wall_s   | exch_s   | share  |")
     print("|-----------|----------|----------|----------|--------|")
     for r in rows:
+        exch_c = ("     n/a" if r["exchange_wall_s"] is None
+                  else f"{r['exchange_wall_s']:>8.4f}")
+        share_c = ("   n/a" if r["exchange_share"] is None
+                   else f"{r['exchange_share']:>6.2%}")
         print(f"| {r['mesh']:<9} | {r['schedule']:<8} "
-              f"| {r['wall_s']:>8.4f} | {r['exchange_wall_s']:>8.4f} "
-              f"| {r['exchange_share']:>6.2%} |")
+              f"| {r['wall_s']:>8.4f} | {exch_c} | {share_c} |")
 
     if args.out:
         import jax as _jax
@@ -408,6 +495,7 @@ def _weak_main(args, usable, sizes, depth, n_dev):
         doc = {
             "mode": "weak",
             "ndim": args.ndim,
+            "scheme": args.scheme,
             "backend_arg": args.backend,
             "dtype": args.dtype,
             "steps": args.steps,
@@ -427,7 +515,12 @@ def _weak_main(args, usable, sizes, depth, n_dev):
                 "pipeline: one prologue exchange per run), all "
                 "exchange rounds chained in ONE dispatch (remainder "
                 "round counted at full-round cost); exchange_share "
-                "= exchange_wall_s / wall_s"),
+                "= exchange_wall_s / wall_s. Implicit --scheme "
+                "cells sweep mg_partition spellings instead of "
+                "schedules; their per-level V-cycle exchanges "
+                "cannot be probed standalone, so exchange_share is "
+                "null and exchange_share_model carries the "
+                "prof/model.py mg ICI-lane share"),
             "cells": rows,
         }
         if _jax.devices()[0].platform not in ("tpu", "axon"):
